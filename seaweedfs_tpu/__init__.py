@@ -1,0 +1,30 @@
+"""seaweedfs_tpu — a TPU-native distributed object store framework.
+
+A from-scratch rebuild of the capabilities of SeaweedFS (reference:
+/root/reference, v3.57): Haystack-style needle volumes, replication,
+RS(10,4) erasure coding, master/volume/filer architecture, S3 gateway,
+admin shell, metadata event log — with the storage hot paths (Reed-Solomon
+erasure coding encode/reconstruct, CRC32C scrub) re-expressed as batched
+GF(256) bit-plane matrix multiplies on TPU via JAX/XLA/Pallas.
+
+Layout:
+    ops/        TPU compute primitives: GF(256) math, RS matrices,
+                bit-plane matmul codecs (numpy / jax / pallas), crc32c
+    ec/         erasure-coding subsystem: geometry, interval math,
+                file-level encode/rebuild/decode, shard objects
+    storage/    storage engine: needle format, needle map, volume,
+                super block, idx files, store, disk backends
+    master/     cluster control plane: topology, volume growth, assign
+    filer/      namespace tier: entries, chunks, stores, event log
+    server/     HTTP/RPC servers: master, volume, filer
+    s3/         S3 gateway (V4 auth, multipart)
+    shell/      admin shell commands (ec.encode, volume.balance, ...)
+    wdclient/   client-side volume-location cache
+    operation/  client SDK verbs (assign, upload, delete)
+    rpc/        lightweight msgpack-over-HTTP rpc substrate
+    parallel/   jax mesh/sharding helpers for the codec data plane
+    models/     flagship pipelines exposed as jittable step functions
+    utils/      config, logging, misc
+"""
+
+__version__ = "0.1.0"

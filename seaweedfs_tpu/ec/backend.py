@@ -1,0 +1,163 @@
+"""Codec backend registry for the erasure-coding subsystem.
+
+Mirrors the reference's storage-backend plugin pattern — a factory registry
+keyed by a type string (/root/reference/weed/storage/backend/backend.go:
+25-45 `BackendStorageFactory` / `BackendStorages`) — applied to the RS
+codec, selected via config `ec.backend=numpy|jax|native|pallas` (the
+north-star `-ec.backend=tpu` switch from BASELINE.json).
+
+A backend implements one method:
+
+    coded_matmul(coef: (m,k) uint8, shards: (k,n) uint8) -> (m,n) uint8
+
+computing out[i] = XOR_j coef[i,j]*shards[j] over GF(256). Everything else
+(encode, reconstruct, verify) is built on top here, using the systematic
+matrices from ops.rs_matrix.
+"""
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import numpy as np
+
+from ..ops import rs_matrix
+
+
+class CodecBackend(Protocol):
+    name: str
+
+    def coded_matmul(self, coef: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        ...
+
+
+_factories: dict[str, Callable[[], CodecBackend]] = {}
+_instances: dict[str, CodecBackend] = {}
+
+
+def register(name: str, factory: Callable[[], CodecBackend]) -> None:
+    _factories[name] = factory
+
+
+def backend_names() -> list[str]:
+    return sorted(_factories)
+
+
+def get_backend(name: str = "numpy") -> CodecBackend:
+    inst = _instances.get(name)
+    if inst is None:
+        try:
+            factory = _factories[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown codec backend {name!r}; known: {backend_names()}"
+            ) from None
+        try:
+            inst = factory()
+        except ImportError as e:
+            raise KeyError(
+                f"codec backend {name!r} is registered but unavailable "
+                f"in this environment: {e}") from e
+        _instances[name] = inst
+    return inst
+
+
+def available_backend_names() -> list[str]:
+    """Backends that actually construct in this environment."""
+    out = []
+    for name in backend_names():
+        try:
+            get_backend(name)
+        except KeyError:
+            continue
+        out.append(name)
+    return out
+
+
+def _register_builtins() -> None:
+    from ..ops import codec_numpy
+
+    register("numpy", codec_numpy.NumpyCodec)
+
+    def _jax_factory():
+        from ..ops import codec_jax
+
+        return codec_jax.JaxCodec()
+
+    register("jax", _jax_factory)
+
+    def _native_factory():
+        from ..ops import codec_native
+
+        return codec_native.NativeCodec()
+
+    register("native", _native_factory)
+
+    def _pallas_factory():
+        from ..ops import codec_pallas
+
+        return codec_pallas.PallasCodec()
+
+    register("pallas", _pallas_factory)
+
+
+_register_builtins()
+
+
+class ReedSolomon:
+    """RS(k, m) erasure codec over a pluggable coded-matmul backend.
+
+    API shape follows the reference's codec dependency (Encode /
+    Reconstruct / Verify, /root/reference/weed/storage/erasure_coding/
+    ec_encoder.go:190,274, store_ec.go:384) but operates on (shards, n)
+    numpy arrays so callers can batch arbitrarily many stripes per call.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 backend: str | CodecBackend = "numpy"):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("data_shards and parity_shards must be > 0")
+        if data_shards + parity_shards > 256:
+            raise ValueError("data+parity shards must be <= 256")
+        self.k = data_shards
+        self.m = parity_shards
+        self.n = data_shards + parity_shards
+        self.backend = (get_backend(backend) if isinstance(backend, str)
+                        else backend)
+        self._parity_rows = rs_matrix.parity_rows(self.k, self.m)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """(k, n) data shards -> (m, n) parity shards."""
+        data = np.asarray(data, dtype=np.uint8)
+        assert data.shape[0] == self.k, data.shape
+        return self.backend.coded_matmul(self._parity_rows, data)
+
+    def reconstruct(self, shards: dict[int, np.ndarray],
+                    missing: list[int] | None = None) -> dict[int, np.ndarray]:
+        """Recover shards from any >= k present ones.
+
+        shards: {shard_id: (n,) or (n_cols,) uint8 row}; missing: which ids
+        to produce (default: all absent ids 0..k+m-1). Returns {id: row}.
+        """
+        present = sorted(shards)
+        if missing is None:
+            missing = [i for i in range(self.n) if i not in shards]
+        if not missing:
+            return {}
+        rows, inputs = rs_matrix.recovery_rows(self.k, self.m, present, missing)
+        stack = np.stack([np.asarray(shards[i], dtype=np.uint8)
+                          for i in inputs])
+        out = self.backend.coded_matmul(rows, stack)
+        return {sid: out[i] for i, sid in enumerate(missing)}
+
+    def reconstruct_data(self, shards: dict[int, np.ndarray]) -> dict[int, np.ndarray]:
+        """Recover only missing DATA shards (reference ReconstructData,
+        /root/reference/weed/storage/store_ec.go:384)."""
+        missing = [i for i in range(self.k) if i not in shards]
+        return self.reconstruct(shards, missing)
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """(k+m, n) full shard stack -> parity consistency check."""
+        shards = np.asarray(shards, dtype=np.uint8)
+        assert shards.shape[0] == self.n
+        expect = self.encode(shards[: self.k])
+        return bool(np.array_equal(expect, shards[self.k:]))

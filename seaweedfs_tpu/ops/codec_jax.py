@@ -1,0 +1,123 @@
+"""TPU codec: GF(256) coded matmul as a bit-plane matmul on the MXU.
+
+The trick (SURVEY.md section 7 "GF(256) as MXU work"): multiplication by a
+GF(256) constant is linear over GF(2)^8, so the whole m x k coefficient
+matrix expands to an (8m x 8k) 0/1 matrix A_bits (gf256.expand_to_bits) and
+
+    out_bytes = pack( (A_bits @ unpack(shards)) mod 2 )
+
+where unpack turns (k, n) bytes into (8k, n) bit-planes. The matmul runs in
+bf16 on the MXU with f32 accumulation — sums of 8k <= 2048 zeros/ones are
+exact in f32 — and `mod 2` is a cheap elementwise op XLA fuses into the
+epilogue. One compiled kernel serves encode AND any reconstruction: the
+coefficient bit-matrix is a runtime argument, only shapes are static.
+
+Equivalent of the reference's hot loops enc.Encode / enc.Reconstruct
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go:190,274), but
+batched: callers collapse (batch, k, stripe) into (k, batch*stripe) columns
+so thousands of stripes ride one dispatch.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256
+
+# Column slab each jitted call processes; callers pad up to a multiple.
+# 2 MiB columns x 8k bit-rows in bf16 keeps the working set well inside HBM
+# while amortizing dispatch overhead.
+DEFAULT_SLAB = 1 << 21
+
+
+@partial(jax.jit, donate_argnums=())
+def _bit_matmul(a_bits: jax.Array, shards: jax.Array) -> jax.Array:
+    """a_bits: (8m, 8k) bf16 0/1; shards: (k, n) uint8 -> (m, n) uint8."""
+    k, n = shards.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = ((shards[:, None, :] >> shifts) & 1).reshape(8 * k, n)
+    acc = jax.lax.dot_general(
+        a_bits,
+        bits.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    par_bits = acc.astype(jnp.int32) & 1                      # (8m, n)
+    m8 = a_bits.shape[0]
+    par = par_bits.reshape(m8 // 8, 8, n).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
+    return (par * weights).sum(axis=1, dtype=jnp.uint8)
+
+
+def bit_matrix(coef: np.ndarray) -> jax.Array:
+    """Host byte matrix -> device bf16 bit-matrix (cacheable by caller)."""
+    return jnp.asarray(gf256.expand_to_bits(coef), dtype=jnp.bfloat16)
+
+
+class JaxCodec:
+    """Coded-matmul backend running on the default jax device.
+
+    Caches expanded coefficient bit-matrices keyed by the coefficient
+    bytes, and pads the column count to `slab` multiples so XLA compiles a
+    handful of shapes no matter the file size.
+    """
+
+    name = "jax"
+
+    def __init__(self, slab: int = DEFAULT_SLAB):
+        self.slab = slab
+        self._bitmats: dict[bytes, jax.Array] = {}
+
+    def _coef_bits(self, coef: np.ndarray) -> jax.Array:
+        key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
+        bm = self._bitmats.get(key)
+        if bm is None:
+            bm = bit_matrix(coef)
+            self._bitmats[key] = bm
+        return bm
+
+    def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
+        coef = np.asarray(coef, dtype=np.uint8)
+        m, k = coef.shape
+        shards = np.asarray(shards, dtype=np.uint8)
+        assert shards.ndim == 2 and shards.shape[0] == k
+        n = shards.shape[1]
+        if n == 0:
+            return np.zeros((m, 0), dtype=np.uint8)
+        a_bits = self._coef_bits(coef)
+        slab = self.slab
+        if n <= slab:
+            # pad to power-of-two buckets (>=256) so XLA compiles at most
+            # log2(slab/256) shapes for sub-slab calls
+            padded = 256
+            while padded < n:
+                padded <<= 1
+            padded = min(padded, slab)  # n <= slab, so padded >= n still
+            out = self._run(a_bits, _pad_cols(shards, padded))
+            return np.asarray(out)[:, :n]
+        outs = []
+        for off in range(0, n, slab):
+            chunk = shards[:, off:off + slab]
+            w = chunk.shape[1]
+            if w < slab:
+                chunk = _pad_cols(chunk, slab)
+            outs.append(np.asarray(self._run(a_bits, chunk))[:, :w])
+        return np.concatenate(outs, axis=1)
+
+    def _run(self, a_bits: jax.Array, shards: np.ndarray) -> jax.Array:
+        return _bit_matmul(a_bits, jnp.asarray(shards))
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _pad_cols(arr: np.ndarray, n: int) -> np.ndarray:
+    if arr.shape[1] == n:
+        return arr
+    out = np.zeros((arr.shape[0], n), dtype=arr.dtype)
+    out[:, : arr.shape[1]] = arr
+    return out

@@ -1,0 +1,89 @@
+"""Reed-Solomon coding matrices over GF(256).
+
+Uses the systematic-Vandermonde construction (Backblaze / klauspost
+`buildMatrix` lineage — the default of the reference's codec dependency,
+/root/reference/go.mod:62): rows of a Vandermonde matrix are made systematic
+by right-multiplying with the inverse of its top k x k square, so shards
+0..k-1 are the data bytes verbatim and shards k..n-1 are parity.
+
+All matrices are small ((k+m) x k, k+m <= 256) host-side numpy uint8.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from . import gf256
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """v[r, c] = r ** c in GF(256). Any k of the rows are independent."""
+    v = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            v[r, c] = gf256.gf_pow(r, c)
+    return v
+
+
+@lru_cache(maxsize=64)
+def _encode_matrix_cached(data_shards: int, parity_shards: int) -> bytes:
+    total = data_shards + parity_shards
+    if total > gf256.FIELD:
+        raise ValueError("data+parity shards must be <= 256")
+    vm = vandermonde(total, data_shards)
+    top_inv = gf256.mat_inv(vm[:data_shards, :data_shards])
+    m = gf256.mat_mul(vm, top_inv)
+    return m.tobytes()
+
+
+def encode_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The (k+m) x k systematic encode matrix: identity on top, parity
+    coefficient rows below."""
+    total = data_shards + parity_shards
+    raw = _encode_matrix_cached(data_shards, parity_shards)
+    return np.frombuffer(raw, dtype=np.uint8).reshape(total, data_shards).copy()
+
+
+def parity_rows(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Just the m x k parity coefficient block."""
+    return encode_matrix(data_shards, parity_shards)[data_shards:, :]
+
+
+def reconstruction_matrix(
+    data_shards: int,
+    parity_shards: int,
+    present: list[int],
+) -> tuple[np.ndarray, list[int]]:
+    """Matrix recovering ALL k+m shards from k present ones.
+
+    `present` lists >= k available shard indices (0..k+m-1); the first k of
+    them (sorted) are used as inputs. Returns (R, input_shard_ids) with
+        all_shards = R @ stack(shards[i] for i in input_shard_ids)
+    R is (k+m) x k; rows for the input shards are unit vectors.
+    """
+    k = data_shards
+    present = sorted(set(present))
+    if len(present) < k:
+        raise ValueError(
+            f"need >= {k} shards to reconstruct, have {len(present)}")
+    inputs = present[:k]
+    enc = encode_matrix(data_shards, parity_shards)
+    sub = enc[inputs, :]                      # (k, k): inputs = sub @ data
+    data_from_inputs = gf256.mat_inv(sub)     # (k, k): data = inv @ inputs
+    return gf256.mat_mul(enc, data_from_inputs), inputs
+
+
+def recovery_rows(
+    data_shards: int,
+    parity_shards: int,
+    present: list[int],
+    missing: list[int],
+) -> tuple[np.ndarray, list[int]]:
+    """Rows of the reconstruction matrix for `missing` shards only.
+
+    Returns (matrix of shape (len(missing), k), input_shard_ids) where
+        missing_shards = matrix @ stack(shards[i] for i in input_shard_ids)
+    """
+    full, inputs = reconstruction_matrix(data_shards, parity_shards, present)
+    return full[missing, :].copy(), inputs

@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax import.
+
+Multi-chip hardware is not available in CI; sharding correctness is tested
+on a virtual CPU mesh per the build contract (see repo root docs).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

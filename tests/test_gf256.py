@@ -1,0 +1,111 @@
+"""GF(256) field + matrix algebra unit tests."""
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256, rs_matrix
+
+
+def test_field_axioms_spot():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+            gf256.gf_mul(a, b), c)
+        # distributive over XOR (field addition)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+        assert gf256.gf_mul(a, 1) == a
+        assert gf256.gf_mul(a, 0) == 0
+
+
+def test_known_products_poly_0x11d():
+    # 2*128 = 0x100 -> reduced by 0x11d -> 0x1d
+    assert gf256.gf_mul(2, 128) == 0x1D
+    # generator powers: exp[1]=2, exp[2]=4, exp[8]=0x1d^... spot known values
+    assert int(gf256.EXP[0]) == 1 and int(gf256.EXP[1]) == 2
+    assert int(gf256.EXP[8]) == 0x1D  # 2^8 reduced by 0x11d
+    assert gf256.gf_mul(0x53, gf256.gf_inv(0x53)) == 1
+
+
+def test_inverse_table():
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+
+
+def test_div_pow():
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        a = int(rng.integers(1, 256))
+        b = int(rng.integers(1, 256))
+        assert gf256.gf_mul(gf256.gf_div(a, b), b) == a
+    assert gf256.gf_pow(2, 8) == 0x1D
+    assert gf256.gf_pow(3, 0) == 1
+    assert gf256.gf_pow(0, 5) == 0
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_div(1, 0)
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 5, 10, 14):
+        # random matrices are invertible w.h.p.; retry until one is
+        for _ in range(20):
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.mat_inv(m)
+            except ValueError:
+                continue
+            prod = gf256.mat_mul(m, inv)
+            assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+            break
+        else:
+            pytest.fail("no invertible matrix found")
+
+
+def test_mat_inv_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.mat_inv(m)
+
+
+def test_bitmat_matches_scalar_mul():
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        c = int(rng.integers(0, 256))
+        x = int(rng.integers(0, 256))
+        xb = np.array([(x >> t) & 1 for t in range(8)], dtype=np.uint8)
+        yb = (gf256.BITMAT[c] @ xb) % 2
+        y = int(sum(int(b) << s for s, b in enumerate(yb)))
+        assert y == gf256.gf_mul(c, x)
+
+
+def test_expand_pack_unpack_roundtrip():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, (5, 33)).astype(np.uint8)
+    assert np.array_equal(gf256.pack_bits(gf256.unpack_bits(data)), data)
+
+
+def test_bit_matrix_matmul_equals_byte_matmul():
+    rng = np.random.default_rng(5)
+    m, k, n = 4, 10, 57
+    coef = rng.integers(0, 256, (m, k)).astype(np.uint8)
+    data = rng.integers(0, 256, (k, n)).astype(np.uint8)
+    byte_out = np.zeros((m, n), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            byte_out[i] ^= gf256.MUL_TABLE[coef[i, j]][data[j]]
+    a_bits = gf256.expand_to_bits(coef)
+    bit_out = gf256.pack_bits(
+        (a_bits.astype(np.int32) @ gf256.unpack_bits(data).astype(np.int32)) % 2)
+    assert np.array_equal(bit_out, byte_out)
+
+
+def test_encode_matrix_systematic():
+    enc = rs_matrix.encode_matrix(10, 4)
+    assert enc.shape == (14, 10)
+    assert np.array_equal(enc[:10], np.eye(10, dtype=np.uint8))
+    # any k rows must be invertible (MDS property) — spot-check a few subsets
+    rng = np.random.default_rng(6)
+    for _ in range(10):
+        rows = sorted(rng.choice(14, size=10, replace=False).tolist())
+        gf256.mat_inv(enc[rows, :])  # must not raise
