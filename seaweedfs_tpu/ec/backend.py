@@ -62,14 +62,17 @@ def get_backend(name: str = "numpy") -> CodecBackend:
 
 
 def available_backend_names() -> list[str]:
-    """Backends that actually construct in this environment."""
+    """Backends usable in this environment — probed cheaply (module
+    lookup), without constructing instances or importing jax."""
+    import importlib.util
+
+    deps = {"numpy": "numpy", "jax": "jax", "pallas": "jax",
+            "native": "seaweedfs_tpu.ops.codec_native"}
     out = []
     for name in backend_names():
-        try:
-            get_backend(name)
-        except KeyError:
-            continue
-        out.append(name)
+        dep = deps.get(name)
+        if dep is None or importlib.util.find_spec(dep) is not None:
+            out.append(name)
     return out
 
 

@@ -1,0 +1,109 @@
+"""Erasure-coding geometry: RS(10,4), block layout, needle-location math.
+
+Byte-layout-compatible with the reference (/root/reference/weed/storage/
+erasure_coding/ec_encoder.go:17-23, ec_locate.go): a volume's .dat is
+striped row-major — while more than one full large row (10 x 1GB) remains,
+emit large rows; then 10 x 1MB small rows, the last one zero-padded. Data
+shard i of a row holds block i; parity shards .ec10-.ec13 extend each row.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+LARGE_BLOCK = 1 << 30  # 1GB
+SMALL_BLOCK = 1 << 20  # 1MB
+
+
+def shard_ext(index: int) -> str:
+    """Shard file extension '.ec00'..'.ec13' (ToExt, ec_encoder.go:65)."""
+    return f".ec{index:02d}"
+
+
+def row_layout(dat_size: int, large_block: int = LARGE_BLOCK,
+               small_block: int = SMALL_BLOCK) -> tuple[int, int]:
+    """-> (n_large_rows, n_small_rows) for a .dat of dat_size bytes.
+
+    Matches encodeDatFile's loop structure (ec_encoder.go:198-235): large
+    rows are emitted while remaining > 10*large_block (strictly), then
+    small rows while remaining > 0, last one zero-padded.
+    """
+    remaining = dat_size
+    n_large = 0
+    while remaining > large_block * DATA_SHARDS:
+        n_large += 1
+        remaining -= large_block * DATA_SHARDS
+    n_small = 0
+    while remaining > 0:
+        n_small += 1
+        remaining -= small_block * DATA_SHARDS
+    return n_large, n_small
+
+
+def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK,
+                    small_block: int = SMALL_BLOCK) -> int:
+    n_large, n_small = row_layout(dat_size, large_block, small_block)
+    return n_large * large_block + n_small * small_block
+
+
+@dataclass(frozen=True)
+class Interval:
+    """A run of logical .dat bytes inside one striped block."""
+
+    block_index: int        # index within its region (large or small area)
+    inner_offset: int       # offset inside the block
+    size: int
+    is_large_block: bool
+    large_block_rows: int   # large-row count of the volume
+
+    def to_shard_and_offset(self, large_block: int = LARGE_BLOCK,
+                            small_block: int = SMALL_BLOCK) -> tuple[int, int]:
+        """-> (shard_id, offset within shard file) — Interval.
+        ToShardIdAndOffset (ec_locate.go:77)."""
+        row = self.block_index // DATA_SHARDS
+        off = self.inner_offset
+        if self.is_large_block:
+            off += row * large_block
+        else:
+            off += self.large_block_rows * large_block + row * small_block
+        return self.block_index % DATA_SHARDS, off
+
+
+def locate(dat_size: int, offset: int, size: int,
+           large_block: int = LARGE_BLOCK,
+           small_block: int = SMALL_BLOCK) -> list[Interval]:
+    """Map a logical [offset, offset+size) range of the original .dat to
+    shard-block intervals (LocateData, ec_locate.go:15).
+
+    Deviation from the reference: the large-row count here is taken from
+    the ACTUAL encode layout (row_layout) rather than re-derived as
+    `(datSize + 10*small) / (10*large)` — the two disagree when datSize
+    is within 10*small of an exact large-row multiple, where the
+    reference's locate would point into the wrong region.
+    """
+    n_large_rows, _ = row_layout(dat_size, large_block, small_block)
+    large_row = large_block * DATA_SHARDS
+
+    if offset < n_large_rows * large_row:
+        is_large = True
+        block_index, inner = divmod(offset, large_block)
+    else:
+        is_large = False
+        block_index, inner = divmod(offset - n_large_rows * large_row,
+                                    small_block)
+
+    out: list[Interval] = []
+    while size > 0:
+        block = large_block if is_large else small_block
+        take = min(size, block - inner)
+        out.append(Interval(int(block_index), int(inner), int(take),
+                            is_large, int(n_large_rows)))
+        size -= take
+        block_index += 1
+        if is_large and block_index == n_large_rows * DATA_SHARDS:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return out

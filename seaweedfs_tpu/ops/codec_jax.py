@@ -19,6 +19,7 @@ so thousands of stripes ride one dispatch.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 
 import jax
@@ -67,9 +68,13 @@ class JaxCodec:
 
     name = "jax"
 
+    # bound the per-instance coefficient-matrix cache: reconstruction over
+    # wide codes can see tens of thousands of distinct recovery matrices
+    BITMAT_CACHE_MAX = 256
+
     def __init__(self, slab: int = DEFAULT_SLAB):
         self.slab = slab
-        self._bitmats: dict[bytes, jax.Array] = {}
+        self._bitmats: "OrderedDict[bytes, jax.Array]" = OrderedDict()
 
     def _coef_bits(self, coef: np.ndarray) -> jax.Array:
         key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
@@ -77,6 +82,10 @@ class JaxCodec:
         if bm is None:
             bm = bit_matrix(coef)
             self._bitmats[key] = bm
+            if len(self._bitmats) > self.BITMAT_CACHE_MAX:
+                self._bitmats.popitem(last=False)
+        else:
+            self._bitmats.move_to_end(key)
         return bm
 
     def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
@@ -98,21 +107,20 @@ class JaxCodec:
             padded = min(padded, slab)  # n <= slab, so padded >= n still
             out = self._run(a_bits, _pad_cols(shards, padded))
             return np.asarray(out)[:, :n]
-        outs = []
+        # dispatch all slabs asynchronously, then sync once at the end so
+        # device compute overlaps host-side slicing/transfer
+        pending: list[tuple[jax.Array, int]] = []
         for off in range(0, n, slab):
             chunk = shards[:, off:off + slab]
             w = chunk.shape[1]
             if w < slab:
                 chunk = _pad_cols(chunk, slab)
-            outs.append(np.asarray(self._run(a_bits, chunk))[:, :w])
-        return np.concatenate(outs, axis=1)
+            pending.append((self._run(a_bits, chunk), w))
+        return np.concatenate(
+            [np.asarray(dev)[:, :w] for dev, w in pending], axis=1)
 
     def _run(self, a_bits: jax.Array, shards: np.ndarray) -> jax.Array:
         return _bit_matmul(a_bits, jnp.asarray(shards))
-
-
-def _round_up(n: int, mult: int) -> int:
-    return ((n + mult - 1) // mult) * mult
 
 
 def _pad_cols(arr: np.ndarray, n: int) -> np.ndarray:

@@ -1,0 +1,126 @@
+"""Volume-file storage backends.
+
+Mirrors the reference's plugin pattern (/root/reference/weed/storage/
+backend/backend.go:15-45): a `StorageFile` is the random-access byte
+store a volume's .dat lives on; factories are registered by type string so
+tiered backends (s3, memory, ...) can be added without touching the
+engine.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Protocol
+
+
+class StorageFile(Protocol):
+    def read_at(self, size: int, offset: int) -> bytes: ...
+    def write_at(self, data: bytes, offset: int) -> int: ...
+    def append(self, data: bytes) -> int: ...
+    def truncate(self, size: int) -> None: ...
+    def size(self) -> int: ...
+    def sync(self) -> None: ...
+    def close(self) -> None: ...
+    @property
+    def name(self) -> str: ...
+
+
+class DiskFile:
+    """Local-disk backend (backend/disk_file.go equivalent)."""
+
+    def __init__(self, path: str, create: bool = False):
+        mode = "r+b" if os.path.exists(path) else ("w+b" if create else None)
+        if mode is None:
+            raise FileNotFoundError(path)
+        self._f = open(path, mode)
+        self._path = path
+
+    @property
+    def name(self) -> str:
+        return self._path
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(size)
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        self._f.seek(offset)
+        return self._f.write(data)
+
+    def append(self, data: bytes) -> int:
+        self._f.seek(0, os.SEEK_END)
+        offset = self._f.tell()
+        self._f.write(data)
+        return offset
+
+    def truncate(self, size: int) -> None:
+        self._f.truncate(size)
+
+    def size(self) -> int:
+        return os.fstat(self._f.fileno()).st_size
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+
+
+class MemoryFile:
+    """In-memory backend for tests and the memory_map analogue."""
+
+    def __init__(self, name: str = "<memory>"):
+        self._buf = bytearray()
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        return bytes(self._buf[offset:offset + size])
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        end = offset + len(data)
+        if end > len(self._buf):
+            self._buf.extend(b"\x00" * (end - len(self._buf)))
+        self._buf[offset:end] = data
+        return len(data)
+
+    def append(self, data: bytes) -> int:
+        offset = len(self._buf)
+        self._buf.extend(data)
+        return offset
+
+    def truncate(self, size: int) -> None:
+        del self._buf[size:]
+
+    def size(self) -> int:
+        return len(self._buf)
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+_factories: dict[str, Callable[..., StorageFile]] = {
+    "disk": DiskFile,
+    "memory": MemoryFile,
+}
+
+
+def register(name: str, factory: Callable[..., StorageFile]) -> None:
+    _factories[name] = factory
+
+
+def create(kind: str, *args, **kwargs) -> StorageFile:
+    try:
+        return _factories[kind](*args, **kwargs)
+    except KeyError:
+        raise KeyError(f"unknown storage backend {kind!r}; "
+                       f"known: {sorted(_factories)}") from None

@@ -1,0 +1,53 @@
+""".idx / .ecx index file IO: flat arrays of 16-byte (key, offset, size)
+entries, big-endian.
+
+Reference: /root/reference/weed/storage/idx/walk.go:12,45. Unlike the
+row-at-a-time Go walker, reads are vectorized through a numpy structured
+dtype — the whole index becomes three columns in one shot, which is also
+the layout the TPU scrub pipeline wants.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import types as t
+
+IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">u4")])
+assert IDX_DTYPE.itemsize == t.NEEDLE_MAP_ENTRY_SIZE
+
+
+def read_index(path: str) -> np.ndarray:
+    """Whole index file -> structured array (key, offset, size-u32)."""
+    size = os.path.getsize(path)
+    usable = (size // t.NEEDLE_MAP_ENTRY_SIZE) * t.NEEDLE_MAP_ENTRY_SIZE
+    with open(path, "rb") as f:
+        buf = f.read(usable)
+    return np.frombuffer(buf, dtype=IDX_DTYPE)
+
+
+def write_index(path: str, entries: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(np.ascontiguousarray(entries, dtype=IDX_DTYPE).tobytes())
+
+
+def append_entry(f, key: int, offset: int, size: int) -> None:
+    """Append one entry to an open binary file object."""
+    f.write(t.NeedleValue(key, offset, size).to_bytes())
+
+
+def walk(path: str, fn: Callable[[int, int, int], None],
+         start_from: int = 0) -> None:
+    """Visit (key, offset, signed size) for each entry in file order."""
+    arr = read_index(path)
+    for rec in arr[start_from:]:
+        fn(int(rec["key"]), int(rec["offset"]), t.u32_to_size(int(rec["size"])))
+
+
+def iter_entries(path: str) -> Iterator[t.NeedleValue]:
+    arr = read_index(path)
+    for rec in arr:
+        yield t.NeedleValue(int(rec["key"]), int(rec["offset"]),
+                            t.u32_to_size(int(rec["size"])))

@@ -1,0 +1,143 @@
+"""In-memory needle maps.
+
+The reference keeps three index-persistence strategies (memory / leveldb /
+sorted-file, weed/storage/needle_map*.go) over a compact sharded map
+(needle_map/compact_map.go:28). Here the core map is a python dict over
+vectorized numpy loads — idiomatic and fast enough for the control plane;
+the batched scrub/EC paths never touch it per-needle, they consume whole
+index columns (storage/idx.py).
+
+MemDb mirrors needle_map/memdb.go: an insert-ordered map with an
+ascending-key visit used to produce sorted .ecx files
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go:27-55).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import idx as idxmod
+from . import types as t
+
+
+class NeedleMap:
+    """Live per-volume map: key -> (offset, size), with accounting
+    mirroring the reference's mapMetric (file/deleted counts and bytes)."""
+
+    def __init__(self) -> None:
+        self._m: dict[int, tuple[int, int]] = {}
+        self.file_count = 0
+        self.deleted_count = 0
+        self.file_bytes = 0
+        self.deleted_bytes = 0
+        self.max_key = 0
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        """-> (stored offset, size) for live needles, else None."""
+        v = self._m.get(key)
+        if v is None or t.size_is_deleted(v[1]):
+            return None
+        return v
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        old = self._m.get(key)
+        if old is not None and t.size_is_valid(old[1]):
+            self.deleted_count += 1
+            self.deleted_bytes += old[1]
+            self.file_count -= 1
+            self.file_bytes -= old[1]
+        self._m[key] = (offset, size)
+        if t.size_is_valid(size):
+            self.file_count += 1
+            self.file_bytes += size
+        self.max_key = max(self.max_key, key)
+
+    def delete(self, key: int) -> int:
+        """Mark deleted; returns reclaimed bytes (0 if absent)."""
+        old = self._m.get(key)
+        if old is None or not t.size_is_valid(old[1]):
+            return 0
+        self._m[key] = (old[0], t.TOMBSTONE_SIZE)
+        self.deleted_count += 1
+        self.deleted_bytes += old[1]
+        self.file_count -= 1
+        self.file_bytes -= old[1]
+        return old[1]
+
+    def items(self) -> Iterator[tuple[int, int, int]]:
+        for k, (off, size) in self._m.items():
+            yield k, off, size
+
+    def live_items(self) -> Iterator[tuple[int, int, int]]:
+        for k, (off, size) in self._m.items():
+            if t.size_is_valid(size):
+                yield k, off, size
+
+
+def load_needle_map(idx_path: str) -> NeedleMap:
+    """Replay an .idx log into a live map (needle_map_memory.go
+    LoadCompactNeedleMap equivalent): later entries win; tombstones
+    (size<0 or offset==0&&size==0 per reference semantics) delete."""
+    nm = NeedleMap()
+    if not os.path.exists(idx_path):
+        return nm
+    arr = idxmod.read_index(idx_path)
+    for rec in arr:
+        key = int(rec["key"])
+        off = int(rec["offset"])
+        size = t.u32_to_size(int(rec["size"]))
+        if off > 0 and t.size_is_valid(size):
+            nm.put(key, off, size)
+        else:
+            nm.delete(key)
+    return nm
+
+
+class MemDb:
+    """Sorted-visit map used for .ecx generation and idx compaction."""
+
+    def __init__(self) -> None:
+        self._m: dict[int, tuple[int, int]] = {}
+
+    def set(self, key: int, offset: int, size: int) -> None:
+        self._m[key] = (offset, size)
+
+    def delete(self, key: int) -> None:
+        self._m.pop(key, None)
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        return self._m.get(key)
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def ascending_visit(self, fn: Callable[[int, int, int], None]) -> None:
+        for key in sorted(self._m):
+            off, size = self._m[key]
+            fn(key, off, size)
+
+    def load_from_idx(self, idx_path: str) -> None:
+        """Replay .idx: valid entries set, tombstones remove
+        (needle_map/memdb.go LoadFromIdx semantics)."""
+        arr = idxmod.read_index(idx_path)
+        for rec in arr:
+            key = int(rec["key"])
+            off = int(rec["offset"])
+            size = t.u32_to_size(int(rec["size"]))
+            if off == 0 or t.size_is_deleted(size):
+                self._m.pop(key, None)
+            else:
+                self._m[key] = (off, size)
+
+    def save_to_idx(self, idx_path: str) -> None:
+        keys = sorted(self._m)
+        arr = np.empty(len(keys), dtype=idxmod.IDX_DTYPE)
+        for i, k in enumerate(keys):
+            off, size = self._m[k]
+            arr[i] = (k, off, t.size_to_u32(size))
+        idxmod.write_index(idx_path, arr)

@@ -1,0 +1,92 @@
+"""Core storage value types and on-disk constants.
+
+Byte-compatible with the reference formats (so fixtures and tools
+interoperate): /root/reference/weed/storage/types/needle_types.go:33-40 and
+offset_4bytes.go:14-17. Offsets are stored in units of NEEDLE_PADDING (8
+bytes) as 4-byte big-endian, giving a 32GB max volume; sizes are int32 with
+-1 as the tombstone marker.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+NEEDLE_ID_SIZE = 8
+OFFSET_SIZE = 4
+SIZE_SIZE = 4
+COOKIE_SIZE = 4
+NEEDLE_PADDING = 8
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+TIMESTAMP_SIZE = 8
+TOMBSTONE_SIZE = -1  # Size value marking a deleted needle
+MAX_VOLUME_SIZE = 8 * (1 << 32)  # 32GB with 4-byte padded offsets
+
+SIZE_MASK = 0xFFFFFFFF
+
+
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_SIZE
+
+
+def size_to_u32(size: int) -> int:
+    return size & SIZE_MASK
+
+
+def u32_to_size(u: int) -> int:
+    """Stored uint32 -> signed Size."""
+    return u - (1 << 32) if u & 0x80000000 else u
+
+
+def offset_to_actual(stored: int) -> int:
+    """Stored (padded-unit) offset -> byte offset in the volume file."""
+    return stored * NEEDLE_PADDING
+
+
+def actual_to_offset(byte_offset: int) -> int:
+    if byte_offset % NEEDLE_PADDING:
+        raise ValueError(f"offset {byte_offset} not {NEEDLE_PADDING}-aligned")
+    stored = byte_offset // NEEDLE_PADDING
+    if stored >= 1 << (8 * OFFSET_SIZE):
+        raise ValueError(f"offset {byte_offset} exceeds max volume size")
+    return stored
+
+
+@dataclass(frozen=True)
+class NeedleValue:
+    """One needle-map entry: (key, stored offset, size)."""
+
+    key: int          # NeedleId, uint64
+    offset: int       # stored units of NEEDLE_PADDING
+    size: int         # signed; TOMBSTONE_SIZE or negative = deleted
+
+    def to_bytes(self) -> bytes:
+        return (self.key.to_bytes(NEEDLE_ID_SIZE, "big")
+                + self.offset.to_bytes(OFFSET_SIZE, "big")
+                + size_to_u32(self.size).to_bytes(SIZE_SIZE, "big"))
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "NeedleValue":
+        key = int.from_bytes(b[:8], "big")
+        offset = int.from_bytes(b[8:12], "big")
+        size = u32_to_size(int.from_bytes(b[12:16], "big"))
+        return cls(key, offset, size)
+
+
+def format_file_id(volume_id: int, key: int, cookie: int) -> str:
+    """'vid,khexchex' — reference fid string (needle/file_id.go)."""
+    return f"{volume_id},{key:x}{cookie:08x}"
+
+
+def parse_file_id(fid: str) -> tuple[int, int, int]:
+    """fid string -> (volume_id, key, cookie)."""
+    vid_s, _, rest = fid.partition(",")
+    if not rest or len(rest) <= 8:
+        raise ValueError(f"bad file id {fid!r}")
+    volume_id = int(vid_s)
+    key = int(rest[:-8], 16)
+    cookie = int(rest[-8:], 16)
+    return volume_id, key, cookie

@@ -1,0 +1,195 @@
+"""Storage engine unit tests: needle format, idx, needle map, volume."""
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import idx as idxmod
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage import needle_map as nmap
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock
+from seaweedfs_tpu.storage.volume import Volume
+
+
+class TestNeedleFormat:
+    def test_roundtrip_simple(self):
+        n = ndl.Needle(id=0x1234, cookie=0xDEADBEEF, data=b"hello world")
+        blob = n.to_bytes()
+        assert len(blob) % t.NEEDLE_PADDING == 0
+        m = ndl.Needle.from_bytes(blob)
+        assert m.id == n.id and m.cookie == n.cookie and m.data == n.data
+
+    def test_roundtrip_all_fields(self):
+        n = ndl.Needle(id=7, cookie=9, data=b"x" * 100, name=b"a.txt",
+                       mime=b"text/plain", pairs=b'{"k":"v"}',
+                       last_modified=1700000000, ttl=b"\x05\x02")
+        m = ndl.Needle.from_bytes(n.to_bytes())
+        assert m.name == b"a.txt" and m.mime == b"text/plain"
+        assert m.pairs == b'{"k":"v"}'
+        assert m.last_modified == 1700000000
+        assert m.ttl == b"\x05\x02"
+
+    def test_crc_detects_corruption(self):
+        n = ndl.Needle(id=1, cookie=2, data=b"payload bytes")
+        blob = bytearray(n.to_bytes())
+        blob[t.NEEDLE_HEADER_SIZE + 5] ^= 0xFF  # flip a data byte
+        with pytest.raises(ValueError, match="CRC"):
+            ndl.Needle.from_bytes(bytes(blob))
+
+    def test_legacy_crc_accepted(self):
+        n = ndl.Needle(id=1, cookie=2, data=b"data")
+        blob = bytearray(n.to_bytes())
+        import struct
+        actual = ndl.crc32c(b"data")
+        struct.pack_into(">I", blob, t.NEEDLE_HEADER_SIZE + n.size,
+                         ndl.legacy_crc_value(actual))
+        m = ndl.Needle.from_bytes(bytes(blob))
+        assert m.data == b"data"
+
+    def test_disk_size_alignment(self):
+        for size in (0, 1, 7, 8, 100, 4096):
+            assert ndl.disk_size(size, 2) % 8 == 0
+            assert ndl.disk_size(size, 3) % 8 == 0
+        # reference quirk: aligned sizes still get a full 8-byte pad
+        assert ndl.padding_length(0, 2) in range(1, 9)
+
+    def test_empty_tombstone_needle(self):
+        n = ndl.Needle(id=42)
+        m = ndl.Needle.from_bytes(n.to_bytes())
+        assert m.id == 42 and m.size == 0 and m.data == b""
+
+    def test_v2_layout(self):
+        n = ndl.Needle(id=3, cookie=4, data=b"v2 data")
+        m = ndl.Needle.from_bytes(n.to_bytes(ndl.VERSION2), ndl.VERSION2)
+        assert m.data == b"v2 data"
+
+
+class TestFileId:
+    def test_roundtrip(self):
+        fid = t.format_file_id(3, 0x1637037D6, 0x12345678)
+        vid, key, cookie = t.parse_file_id(fid)
+        assert (vid, key, cookie) == (3, 0x1637037D6, 0x12345678)
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            t.parse_file_id("3,123")
+
+
+class TestIdx:
+    def test_write_read(self, tmp_path):
+        p = str(tmp_path / "v.idx")
+        arr = np.zeros(3, dtype=idxmod.IDX_DTYPE)
+        arr[0] = (1, 1, 100)
+        arr[1] = (2, 20, 200)
+        arr[2] = (1, 0, t.size_to_u32(t.TOMBSTONE_SIZE))
+        idxmod.write_index(p, arr)
+        assert os.path.getsize(p) == 48
+        back = idxmod.read_index(p)
+        assert list(back["key"]) == [1, 2, 1]
+        entries = list(idxmod.iter_entries(p))
+        assert entries[2].size == t.TOMBSTONE_SIZE
+
+    def test_needle_value_bytes(self):
+        v = t.NeedleValue(0xAABBCCDD, 7, -1)
+        assert t.NeedleValue.from_bytes(v.to_bytes()) == v
+
+
+class TestNeedleMap:
+    def test_put_get_delete_accounting(self):
+        nm = nmap.NeedleMap()
+        nm.put(1, 10, 100)
+        nm.put(2, 20, 200)
+        assert nm.file_count == 2 and nm.file_bytes == 300
+        nm.put(1, 30, 150)  # overwrite
+        assert nm.file_count == 2 and nm.file_bytes == 350
+        assert nm.deleted_count == 1 and nm.deleted_bytes == 100
+        assert nm.delete(2) == 200
+        assert nm.get(2) is None
+        assert nm.delete(2) == 0
+
+    def test_memdb_sorted_visit(self, tmp_path):
+        db = nmap.MemDb()
+        for k in (5, 1, 9, 3):
+            db.set(k, k * 10, k * 100)
+        seen = []
+        db.ascending_visit(lambda k, o, s: seen.append(k))
+        assert seen == [1, 3, 5, 9]
+        p = str(tmp_path / "sorted.idx")
+        db.save_to_idx(p)
+        keys = [e.key for e in idxmod.iter_entries(p)]
+        assert keys == [1, 3, 5, 9]
+
+
+class TestSuperBlock:
+    def test_roundtrip(self):
+        sb = SuperBlock(version=3,
+                        replica_placement=ReplicaPlacement.parse("012"),
+                        ttl=b"\x03\x01", compaction_revision=7)
+        back = SuperBlock.from_bytes(sb.to_bytes())
+        assert back.version == 3
+        assert str(back.replica_placement) == "012"
+        assert back.ttl == b"\x03\x01"
+        assert back.compaction_revision == 7
+
+    def test_replica_placement(self):
+        rp = ReplicaPlacement.parse("112")
+        assert rp.copy_count == 5
+        assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+        with pytest.raises(ValueError):
+            ReplicaPlacement.parse("9")
+
+
+class TestVolume:
+    def test_write_read_delete(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1, create=True)
+        n = ndl.Needle(id=101, cookie=0xAB, data=b"the quick brown fox")
+        off, size = v.append_needle(n)
+        assert off == 8  # right after super block
+        got = v.read_needle(101, cookie=0xAB)
+        assert got.data == b"the quick brown fox"
+        with pytest.raises(PermissionError):
+            v.read_needle(101, cookie=0xFF)
+        assert v.delete_needle(101) > 0
+        with pytest.raises(KeyError):
+            v.read_needle(101)
+        v.close()
+
+    def test_reload_from_disk(self, tmp_path):
+        v = Volume(str(tmp_path), "col", 2, create=True)
+        for i in range(10):
+            v.append_needle(ndl.Needle(id=i + 1, cookie=i, data=bytes([i]) * 50))
+        v.delete_needle(3)
+        v.close()
+
+        v2 = Volume(str(tmp_path), "col", 2)
+        assert v2.nm.file_count == 9
+        assert v2.read_needle(5).data == bytes([4]) * 50
+        with pytest.raises(KeyError):
+            v2.read_needle(3)
+        v2.close()
+
+    def test_compact_reclaims_space(self, tmp_path):
+        v = Volume(str(tmp_path), "", 3, create=True)
+        for i in range(20):
+            v.append_needle(ndl.Needle(id=i + 1, cookie=1, data=b"z" * 1000))
+        for i in range(10):
+            v.delete_needle(i + 1)
+        size_before = v.content_size()
+        assert v.garbage_ratio() > 0.4
+        v.compact()
+        assert v.content_size() < size_before
+        assert v.garbage_ratio() == 0.0
+        # survivors still readable, deleted still gone
+        assert v.read_needle(15).data == b"z" * 1000
+        with pytest.raises(KeyError):
+            v.read_needle(5)
+        assert v.super_block.compaction_revision == 1
+        v.close()
+
+    def test_read_only(self, tmp_path):
+        v = Volume(str(tmp_path), "", 4, create=True)
+        v.read_only = True
+        with pytest.raises(PermissionError):
+            v.append_needle(ndl.Needle(id=1, data=b"x"))
+        v.close()
