@@ -1,0 +1,89 @@
+"""Flagship pipeline: batched erasure-coding encode + scrub as one
+jittable, mesh-shardable step.
+
+This is the framework's "model": the computation the TPU sidecar runs in
+steady state (BASELINE.json north_star) — thousands of stripes per
+dispatch, RS(10,4) parity generation fused with the parity-consistency
+scrub, sharded over a (vol, col) device mesh with psum aggregation.
+
+The step takes a (batch, k, cols) uint8 stripe tensor and the parity
+bit-matrix, and returns the (batch, m, cols) parity plus a global scrub
+scalar (count of mismatched bytes vs a provided expected-parity tensor;
+zero when clean). Encode-only callers pass expected=None logic via the
+`encode_step` wrapper.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import gf256, rs_matrix
+
+
+def parity_bit_matrix(k: int = 10, m: int = 4) -> np.ndarray:
+    """Host-side (8m, 8k) 0/1 matrix for the systematic parity rows."""
+    return gf256.expand_to_bits(rs_matrix.parity_rows(k, m))
+
+
+def _unpack_bits(x: jax.Array) -> jax.Array:
+    """(..., k, n) uint8 -> (..., 8k, n) bf16 bit-planes."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., :, None, :] >> shifts[None, :, None]) & 1
+    shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
+    return bits.reshape(shape).astype(jnp.bfloat16)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """(..., 8m, n) int32 0/1 -> (..., m, n) uint8."""
+    m8, n = bits.shape[-2], bits.shape[-1]
+    b = bits.reshape(bits.shape[:-2] + (m8 // 8, 8, n)).astype(jnp.uint8)
+    w = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
+    return (b * w).sum(axis=-2, dtype=jnp.uint8)
+
+
+def encode_batch(a_bits: jax.Array, stripes: jax.Array) -> jax.Array:
+    """(batch, k, n) uint8 -> (batch, m, n) uint8 parity. Pure function,
+    jit/shard_map-safe; batch and n dims are embarrassingly parallel."""
+    bits = _unpack_bits(stripes)                          # (B, 8k, n)
+    acc = jnp.einsum("st,btn->bsn", a_bits, bits,
+                     preferred_element_type=jnp.float32)
+    return _pack_bits(acc.astype(jnp.int32) & 1)
+
+
+def encode_scrub_step(a_bits: jax.Array, stripes: jax.Array,
+                      expected_parity: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Full step: encode parity AND count bytes differing from
+    expected_parity (the scrub check). Returns (parity, mismatches)."""
+    parity = encode_batch(a_bits, stripes)
+    mism = jnp.sum((parity != expected_parity).astype(jnp.int64))
+    return parity, mism
+
+
+def jitted_encode(k: int = 10, m: int = 4):
+    """-> (fn, a_bits) with fn(a_bits, stripes) jitted."""
+    a_bits = jnp.asarray(parity_bit_matrix(k, m), dtype=jnp.bfloat16)
+    return jax.jit(encode_batch), a_bits
+
+
+def sharded_encode_scrub(mesh, k: int = 10, m: int = 4):
+    """The multi-chip training-step analogue: jit encode+scrub over a
+    (vol, col) mesh. Stripes shard (batch->vol, cols->col); the scrub
+    count all-reduces via the sharded sum (XLA inserts the psum).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import COL_AXIS, VOL_AXIS
+
+    a_bits = jnp.asarray(parity_bit_matrix(k, m), dtype=jnp.bfloat16)
+    data_sh = NamedSharding(mesh, P(VOL_AXIS, None, COL_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    step = jax.jit(
+        encode_scrub_step,
+        in_shardings=(repl, data_sh, data_sh),
+        out_shardings=(data_sh, repl),
+    )
+    return step, a_bits, data_sh

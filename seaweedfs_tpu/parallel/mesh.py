@@ -1,0 +1,45 @@
+"""Device-mesh helpers for the codec data plane.
+
+The TPU-native analogue of the reference's parallelism axes (SURVEY.md
+section 2.10): stripes of independent volumes ride a `vol` (data-parallel)
+mesh axis, and the columns of a stripe — the long-sequence dimension of
+this domain — ride a `col` (sequence-parallel) axis. Encode/rebuild are
+column-local so they scale linearly over ICI; scrub aggregation reduces
+with psum collectives over both axes.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+VOL_AXIS = "vol"
+COL_AXIS = "col"
+
+
+def make_mesh(n_devices: int | None = None,
+              col_parallel: int | None = None) -> Mesh:
+    """A (vol, col) mesh over the first n devices.
+
+    col_parallel defaults to 2 when n is even and > 1 (so both axes are
+    exercised), else 1.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    if col_parallel is None:
+        col_parallel = 2 if (n % 2 == 0 and n > 1) else 1
+    if n % col_parallel:
+        raise ValueError(f"{n} devices not divisible by col={col_parallel}")
+    grid = np.array(devs[:n]).reshape(n // col_parallel, col_parallel)
+    return Mesh(grid, (VOL_AXIS, COL_AXIS))
+
+
+def stripe_sharding(mesh: Mesh) -> NamedSharding:
+    """(batch, k, cols) stripes: batch over vol, cols over col."""
+    return NamedSharding(mesh, P(VOL_AXIS, None, COL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,16 +1,30 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before jax import.
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax use.
 
-Multi-chip hardware is not available in CI; sharding correctness is tested
-on a virtual CPU mesh per the build contract (see repo root docs).
+Multi-chip hardware is not available in CI; sharding correctness is
+tested on a virtual CPU mesh per the build contract. Note the image
+presets JAX_PLATFORMS=axon (real TPU) and registers the axon PJRT plugin
+in sitecustomize — a plain env setdefault is NOT enough, we must
+overwrite the env and the jax config.
 """
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jeb
+
+_jeb.clear_backends()  # unconditional: a pre-initialized backend would
+                       # otherwise pin the axon platform
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_sessionstart(session):
+    assert jax.devices()[0].platform == "cpu", jax.devices()
