@@ -53,22 +53,14 @@ def bench_tpu(coef, rng, width=32 << 20, batch=16, reps=3) -> float:
 
     from seaweedfs_tpu.ops import gf256
 
-    m = coef.shape[0]
     a_bits = jnp.asarray(gf256.expand_to_bits(coef), dtype=jnp.bfloat16)
+
+    from seaweedfs_tpu.ops.bits import coded_matmul_bits
 
     @jax.jit
     def chained(a_bits, data):  # (B, k, W) -> checksum of all parity
         def body(acc, d):
-            k, n = d.shape
-            shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
-            bits = ((d[:, None, :] >> shifts) & 1).reshape(8 * k, n)
-            prod = jax.lax.dot_general(
-                a_bits, bits.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            par = prod.astype(jnp.int32) & 1
-            p = par.reshape(m, 8, n).astype(jnp.uint8)
-            w = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
-            parity = (p * w).sum(axis=1, dtype=jnp.uint8)
+            parity = coded_matmul_bits(a_bits, d)
             return acc + jnp.sum(parity.astype(jnp.uint32)), None
 
         acc, _ = jax.lax.scan(body, jnp.uint32(0), data)

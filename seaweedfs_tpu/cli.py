@@ -1,0 +1,289 @@
+"""Command-line entry point — the `weed` binary equivalent.
+
+Mirrors /root/reference/weed/weed.go:48 + command/command.go:11-45:
+one binary, subcommand dispatch. Run as `python -m seaweedfs_tpu <cmd>`.
+
+Subcommands: master, volume, server (combined), shell, benchmark,
+upload, download, filer, s3, version.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="seaweedfs-tpu",
+        description="TPU-native distributed object store")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("master", help="start a master server")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-jwt.secret", dest="jwt_secret", default="")
+
+    p = sub.add_parser("volume", help="start a volume server")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-dir", default="./data", help="comma-separated dirs")
+    p.add_argument("-max", type=int, default=8)
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-dataCenter", default="DefaultDataCenter")
+    p.add_argument("-rack", default="DefaultRack")
+    p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+
+    p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-master.port", dest="master_port", type=int,
+                   default=9333)
+    p.add_argument("-volume.port", dest="volume_port", type=int,
+                   default=8080)
+    p.add_argument("-filer", action="store_true")
+    p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    p.add_argument("-s3", action="store_true")
+    p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+
+    p = sub.add_parser("shell", help="interactive admin shell")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+
+    p = sub.add_parser("upload", help="upload files")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("files", nargs="+")
+
+    p = sub.add_parser("download", help="download a fid")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-o", dest="output", default="")
+    p.add_argument("fid")
+
+    p = sub.add_parser("benchmark", help="write/read load generator")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-n", type=int, default=1000)
+    p.add_argument("-size", type=int, default=1024)
+    p.add_argument("-c", dest="concurrency", type=int, default=16)
+    p.add_argument("-collection", default="benchmark")
+
+    p = sub.add_parser("version")
+
+    args = parser.parse_args(argv)
+    return _dispatch(args)
+
+
+def _dispatch(args) -> int:
+    if args.cmd == "version":
+        from . import __version__
+
+        print(f"seaweedfs-tpu {__version__}")
+        return 0
+    if args.cmd == "master":
+        return _run_master(args)
+    if args.cmd == "volume":
+        return _run_volume(args)
+    if args.cmd == "server":
+        return _run_server(args)
+    if args.cmd == "shell":
+        from .shell.repl import run_shell
+
+        return run_shell(args.master)
+    if args.cmd == "upload":
+        from .operation import verbs
+
+        for path in args.files:
+            with open(path, "rb") as f:
+                data = f.read()
+            fid = verbs.upload_data(
+                args.master, data, name=os.path.basename(path),
+                collection=args.collection, replication=args.replication)
+            print(json.dumps({"file": path, "fid": fid,
+                              "size": len(data)}))
+        return 0
+    if args.cmd == "download":
+        from .operation import verbs
+        from .wdclient.client import MasterClient
+
+        mc = MasterClient(args.master)
+        data = verbs.download(mc.lookup_file_id(args.fid))
+        out = args.output or args.fid.replace(",", "_")
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"{args.fid} -> {out} ({len(data)} bytes)")
+        return 0
+    if args.cmd == "benchmark":
+        return _run_benchmark(args)
+    return 1
+
+
+def _run_master(args) -> int:
+    from .rpc.http import ServerThread, run_apps_forever
+    from .server.master_server import MasterServer
+
+    ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20,
+                      default_replication=args.defaultReplication,
+                      jwt_secret=args.jwt_secret)
+    t = ServerThread(ms.app, host=args.ip, port=args.port).start()
+    print(f"master listening on {t.url}")
+    run_apps_forever([t])
+    return 0
+
+
+def _run_volume(args) -> int:
+    from .rpc.http import ServerThread, run_apps_forever
+    from .server.volume_server import VolumeServer
+    from .storage.store import Store
+
+    dirs = args.dir.split(",")
+    store = Store(dirs, ip=args.ip, port=args.port,
+                  ec_backend=args.ec_backend)
+    for loc in store.locations:
+        loc.max_volumes = args.max
+    master = args.mserver if args.mserver.startswith("http") else \
+        f"http://{args.mserver}"
+    vs = VolumeServer(store, master, data_center=args.dataCenter,
+                      rack=args.rack)
+    t = ServerThread(vs.app, host=args.ip, port=args.port).start()
+    store.port = t.port
+    store.public_url = t.address
+    print(f"volume server listening on {t.url}, dirs={dirs}")
+    run_apps_forever([t])
+    return 0
+
+
+def _run_server(args) -> int:
+    from .rpc.http import ServerThread, run_apps_forever
+    from .server.master_server import MasterServer
+    from .server.volume_server import VolumeServer
+    from .storage.store import Store
+
+    threads = []
+    ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20)
+    mt = ServerThread(ms.app, host=args.ip, port=args.master_port).start()
+    threads.append(mt)
+    print(f"master listening on {mt.url}")
+
+    vol_dir = os.path.join(args.dir, "volume")
+    os.makedirs(vol_dir, exist_ok=True)
+    store = Store([vol_dir], ip=args.ip, port=args.volume_port,
+                  ec_backend=args.ec_backend)
+    vs = VolumeServer(store, mt.url)
+    vt = ServerThread(vs.app, host=args.ip, port=args.volume_port).start()
+    store.port = vt.port
+    store.public_url = vt.address
+    threads.append(vt)
+    print(f"volume server listening on {vt.url}")
+
+    if args.filer or args.s3:
+        from .filer.filer import Filer
+        from .server.filer_server import FilerServer
+
+        filer_dir = os.path.join(args.dir, "filer")
+        os.makedirs(filer_dir, exist_ok=True)
+        filer = Filer(filer_dir, mt.url)
+        fs = FilerServer(filer)
+        ft = ServerThread(fs.app, host=args.ip, port=args.filer_port).start()
+        threads.append(ft)
+        print(f"filer listening on {ft.url}")
+        if args.s3:
+            from .s3.server import S3Server
+
+            s3 = S3Server(ft.url)
+            st = ServerThread(s3.app, host=args.ip,
+                              port=args.s3_port).start()
+            threads.append(st)
+            print(f"s3 gateway listening on {st.url}")
+    run_apps_forever(threads)
+    return 0
+
+
+def _run_benchmark(args) -> int:
+    """weed benchmark equivalent (command/benchmark.go:111): concurrent
+    write then read with latency percentiles."""
+    import threading
+    import time
+
+    import numpy as np
+    import requests
+
+    from .operation import verbs
+
+    n, size, conc = args.n, args.size, args.concurrency
+    payload_rng = np.random.default_rng(0)
+    payload = payload_rng.bytes(size)
+    fids: list[str] = []
+    fid_lock = threading.Lock()
+    write_lat: list[float] = []
+    read_lat: list[float] = []
+    err = [0]
+
+    def writer(count):
+        sess = requests.Session()
+        for _ in range(count):
+            t0 = time.perf_counter()
+            try:
+                a = verbs.assign(args.master, collection=args.collection)
+                sess.post(f"http://{a.url}/{a.fid}",
+                          files={"file": ("bench", payload)}, timeout=30)
+                with fid_lock:
+                    fids.append(a.fid)
+                    write_lat.append(time.perf_counter() - t0)
+            except Exception:
+                err[0] += 1
+
+    def reader(my_fids):
+        from .wdclient.client import MasterClient
+
+        mc = MasterClient(args.master)
+        sess = requests.Session()
+        for fid in my_fids:
+            t0 = time.perf_counter()
+            try:
+                resp = sess.get(mc.lookup_file_id(fid), timeout=30)
+                assert len(resp.content) == size
+                with fid_lock:
+                    read_lat.append(time.perf_counter() - t0)
+            except Exception:
+                err[0] += 1
+
+    def run_phase(name, fn, work):
+        threads = [threading.Thread(target=fn, args=(w,)) for w in work]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return dt
+
+    per = [n // conc + (1 if i < n % conc else 0) for i in range(conc)]
+    wdt = run_phase("write", writer, per)
+    chunks = [fids[i::conc] for i in range(conc)]
+    rdt = run_phase("read", reader, chunks)
+
+    def pct(lat, p):
+        return sorted(lat)[int(len(lat) * p / 100)] * 1000 if lat else 0
+
+    out = {
+        "write_rps": round(len(write_lat) / wdt, 1),
+        "write_mbps": round(len(write_lat) * size / wdt / 1e6, 2),
+        "write_p50_ms": round(pct(write_lat, 50), 2),
+        "write_p99_ms": round(pct(write_lat, 99), 2),
+        "read_rps": round(len(read_lat) / rdt, 1),
+        "read_mbps": round(len(read_lat) * size / rdt / 1e6, 2),
+        "read_p50_ms": round(pct(read_lat, 50), 2),
+        "read_p99_ms": round(pct(read_lat, 99), 2),
+        "errors": err[0],
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,7 +66,8 @@ def available_backend_names() -> list[str]:
     lookup), without constructing instances or importing jax."""
     import importlib.util
 
-    deps = {"numpy": "numpy", "jax": "jax", "pallas": "jax",
+    deps = {"numpy": "numpy", "jax": "jax",
+            "pallas": "seaweedfs_tpu.ops.codec_pallas",
             "native": "seaweedfs_tpu.ops.codec_native"}
     out = []
     for name in backend_names():

@@ -26,7 +26,9 @@ def write_dat_file(base: str, dat_size: int,
     missing_data = [i for i in range(geo.DATA_SHARDS)
                     if not os.path.exists(base + geo.shard_ext(i))]
     if missing_data:
-        rebuild_ec_files(base, backend=backend)
+        # only data shards are read below — don't waste compute/disk
+        # regenerating absent parity files (reference ReconstructData)
+        rebuild_ec_files(base, backend=backend, only_shards=missing_data)
 
     n_large, n_small = geo.row_layout(dat_size, large_block, small_block)
     shards = [np.memmap(base + geo.shard_ext(i), dtype=np.uint8, mode="r")
@@ -51,13 +53,11 @@ def write_idx_from_ecx(base: str) -> None:
     ec_decoder.go:18): copy sorted entries, then append tombstones for
     journaled deletions."""
     arr = idxmod.read_index(base + ".ecx")
-    entries = list(arr)
     deleted_keys = read_ecj(base)
     with open(base + ".idx", "wb") as f:
         f.write(arr.tobytes())
         for key in deleted_keys:
             f.write(t.NeedleValue(key, 0, t.TOMBSTONE_SIZE).to_bytes())
-    _ = entries
 
 
 def read_ecj(base: str) -> list[int]:

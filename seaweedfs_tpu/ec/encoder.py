@@ -121,13 +121,17 @@ def _gather_columns(dat: np.ndarray, row_start: int, block: int,
 
 
 def rebuild_ec_files(base: str, backend: str = "numpy",
-                     chunk: int = DEFAULT_CHUNK) -> list[int]:
+                     chunk: int = DEFAULT_CHUNK,
+                     only_shards: list[int] | None = None) -> list[int]:
     """Regenerate missing .ecXX files from the present ones
-    (RebuildEcFiles, ec_encoder.go:61). Returns rebuilt shard ids."""
+    (RebuildEcFiles, ec_encoder.go:61). Returns rebuilt shard ids.
+    `only_shards` restricts which missing shards are produced."""
     present, missing = [], []
     for i in range(geo.TOTAL_SHARDS):
         (present if os.path.exists(base + geo.shard_ext(i)) else
          missing).append(i)
+    if only_shards is not None:
+        missing = [i for i in missing if i in set(only_shards)]
     if not missing:
         return []
     if len(present) < geo.DATA_SHARDS:

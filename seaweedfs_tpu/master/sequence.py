@@ -1,0 +1,61 @@
+"""File-id sequencers (/root/reference/weed/sequence/sequence.go:3-7,
+snowflake_sequencer.go:16): a monotonic in-memory counter and a
+snowflake generator (41-bit ms timestamp | 10-bit node | 12-bit seq).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MemorySequencer:
+    def __init__(self, start: int = 1):
+        self._next = start
+        self._lock = threading.Lock()
+
+    def next_ids(self, count: int = 1) -> int:
+        """Reserve `count` ids; returns the first."""
+        with self._lock:
+            first = self._next
+            self._next += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen >= self._next:
+                self._next = seen + 1
+
+    def peek(self) -> int:
+        return self._next
+
+
+_EPOCH_MS = 1_577_836_800_000  # 2020-01-01
+
+
+class SnowflakeSequencer:
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id & 0x3FF
+        self._lock = threading.Lock()
+        self._last_ms = 0
+        self._seq = 0
+
+    def next_ids(self, count: int = 1) -> int:
+        with self._lock:
+            first = None
+            for _ in range(count):
+                now = int(time.time() * 1000) - _EPOCH_MS
+                if now == self._last_ms:
+                    self._seq = (self._seq + 1) & 0xFFF
+                    if self._seq == 0:
+                        while now <= self._last_ms:
+                            now = int(time.time() * 1000) - _EPOCH_MS
+                else:
+                    self._seq = 0
+                self._last_ms = now
+                fid = (now << 22) | (self.node_id << 12) | self._seq
+                if first is None:
+                    first = fid
+            return first
+
+    def set_max(self, seen: int) -> None:
+        pass  # time-derived; nothing to advance

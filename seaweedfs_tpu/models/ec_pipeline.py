@@ -28,29 +28,15 @@ def parity_bit_matrix(k: int = 10, m: int = 4) -> np.ndarray:
     return gf256.expand_to_bits(rs_matrix.parity_rows(k, m))
 
 
-def _unpack_bits(x: jax.Array) -> jax.Array:
-    """(..., k, n) uint8 -> (..., 8k, n) bf16 bit-planes."""
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = (x[..., :, None, :] >> shifts[None, :, None]) & 1
-    shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
-    return bits.reshape(shape).astype(jnp.bfloat16)
-
-
-def _pack_bits(bits: jax.Array) -> jax.Array:
-    """(..., 8m, n) int32 0/1 -> (..., m, n) uint8."""
-    m8, n = bits.shape[-2], bits.shape[-1]
-    b = bits.reshape(bits.shape[:-2] + (m8 // 8, 8, n)).astype(jnp.uint8)
-    w = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
-    return (b * w).sum(axis=-2, dtype=jnp.uint8)
-
-
 def encode_batch(a_bits: jax.Array, stripes: jax.Array) -> jax.Array:
     """(batch, k, n) uint8 -> (batch, m, n) uint8 parity. Pure function,
     jit/shard_map-safe; batch and n dims are embarrassingly parallel."""
-    bits = _unpack_bits(stripes)                          # (B, 8k, n)
+    from ..ops.bits import pack_bits_uint8, unpack_bits_bf16
+
+    bits = unpack_bits_bf16(stripes)                      # (B, 8k, n)
     acc = jnp.einsum("st,btn->bsn", a_bits, bits,
                      preferred_element_type=jnp.float32)
-    return _pack_bits(acc.astype(jnp.int32) & 1)
+    return pack_bits_uint8(acc.astype(jnp.int32) & 1)
 
 
 def encode_scrub_step(a_bits: jax.Array, stripes: jax.Array,

@@ -1,0 +1,95 @@
+"""Client SDK verbs: assign, upload, download, delete.
+
+Equivalent of /root/reference/weed/operation/ (Assign
+assign_file_id.go:141, upload_content.go, delete batch, lookup). Sync
+`requests`-based — the client side is host code, not server asyncio.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import requests
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    count: int = 1
+    auth: str = ""
+    replicas: list[dict] = field(default_factory=list)
+
+
+def assign(master_url: str, count: int = 1, collection: str = "",
+           replication: str = "", ttl: str = "",
+           data_center: str = "") -> AssignResult:
+    params = {"count": count}
+    if collection:
+        params["collection"] = collection
+    if replication:
+        params["replication"] = replication
+    if ttl:
+        params["ttl"] = ttl
+    if data_center:
+        params["dataCenter"] = data_center
+    resp = requests.get(f"{master_url.rstrip('/')}/dir/assign",
+                        params=params, timeout=30)
+    body = resp.json()
+    if resp.status_code != 200 or "error" in body:
+        raise RuntimeError(f"assign: {body.get('error', resp.status_code)}")
+    return AssignResult(fid=body["fid"], url=body["url"],
+                        public_url=body.get("publicUrl", body["url"]),
+                        count=body.get("count", count),
+                        auth=body.get("auth", ""),
+                        replicas=body.get("replicas", []))
+
+
+def upload(url_or_assign, data: bytes, name: str = "",
+           mime: str = "", auth: str = "", ts: int = 0) -> dict:
+    """Upload bytes to a volume server. Accepts an AssignResult or a full
+    'http://host:port/fid' url."""
+    if isinstance(url_or_assign, AssignResult):
+        url = f"http://{url_or_assign.url}/{url_or_assign.fid}"
+        auth = auth or url_or_assign.auth
+    else:
+        url = url_or_assign
+    headers = {}
+    if auth:
+        headers["Authorization"] = f"Bearer {auth}"
+    params = {}
+    if ts:
+        params["ts"] = str(ts)
+    files = {"file": (name or "file", data,
+                      mime or "application/octet-stream")}
+    resp = requests.post(url, files=files, headers=headers, params=params,
+                         timeout=60)
+    body = resp.json()
+    if resp.status_code >= 300 or "error" in body:
+        raise RuntimeError(f"upload: {body.get('error', resp.status_code)}")
+    return body
+
+
+def download(url: str, auth: str = "") -> bytes:
+    headers = {"Authorization": f"Bearer {auth}"} if auth else {}
+    resp = requests.get(url, headers=headers, timeout=60)
+    if resp.status_code != 200:
+        raise RuntimeError(f"download {url}: {resp.status_code}")
+    return resp.content
+
+
+def delete(url: str, auth: str = "") -> None:
+    headers = {"Authorization": f"Bearer {auth}"} if auth else {}
+    resp = requests.delete(url, headers=headers, timeout=30)
+    if resp.status_code not in (200, 202, 404):
+        raise RuntimeError(f"delete {url}: {resp.status_code}")
+
+
+def upload_data(master_url: str, data: bytes, name: str = "",
+                collection: str = "", replication: str = "",
+                ttl: str = "", mime: str = "") -> str:
+    """assign + upload in one call; returns the fid."""
+    a = assign(master_url, collection=collection, replication=replication,
+               ttl=ttl)
+    upload(a, data, name=name, mime=mime)
+    return a.fid

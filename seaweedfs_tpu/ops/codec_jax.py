@@ -37,20 +37,9 @@ DEFAULT_SLAB = 1 << 21
 @partial(jax.jit, donate_argnums=())
 def _bit_matmul(a_bits: jax.Array, shards: jax.Array) -> jax.Array:
     """a_bits: (8m, 8k) bf16 0/1; shards: (k, n) uint8 -> (m, n) uint8."""
-    k, n = shards.shape
-    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
-    bits = ((shards[:, None, :] >> shifts) & 1).reshape(8 * k, n)
-    acc = jax.lax.dot_general(
-        a_bits,
-        bits.astype(jnp.bfloat16),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    par_bits = acc.astype(jnp.int32) & 1                      # (8m, n)
-    m8 = a_bits.shape[0]
-    par = par_bits.reshape(m8 // 8, 8, n).astype(jnp.uint8)
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
-    return (par * weights).sum(axis=1, dtype=jnp.uint8)
+    from .bits import coded_matmul_bits
+
+    return coded_matmul_bits(a_bits, shards)
 
 
 def bit_matrix(coef: np.ndarray) -> jax.Array:

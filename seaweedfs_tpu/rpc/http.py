@@ -1,0 +1,118 @@
+"""HTTP/WebSocket RPC substrate.
+
+The reference talks gRPC (control) + HTTP (data) over DCN
+(/root/reference/weed/pb/*.proto). This build keeps the same process
+topology but speaks JSON-over-HTTP for control verbs and WebSockets for
+the three long-lived streams (heartbeat master.proto:10, KeepConnected
+:12, metadata subscribe filer.proto:57-60) — idiomatic for the asyncio
+server stack, zero codegen, and debuggable with curl. Data bytes ride
+plain HTTP exactly like the reference.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Awaitable, Callable
+
+from aiohttp import web
+
+
+def json_ok(data: Any = None, **extra) -> web.Response:
+    body = dict(data or {})
+    body.update(extra)
+    return web.json_response(body)
+
+
+def json_error(msg: str, status: int = 400) -> web.Response:
+    return web.json_response({"error": msg}, status=status)
+
+
+class ServerThread:
+    """Run an aiohttp app on its own event loop in a daemon thread —
+    lets a whole cluster (master + volumes + filer + s3) live in one
+    process for tests and `weed server`-style combined startup."""
+
+    def __init__(self, app_factory: Callable[[], Awaitable[web.Application]]
+                 | web.Application, host: str = "127.0.0.1", port: int = 0):
+        self._app_factory = app_factory
+        self.host = host
+        self.port = port
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._runner: web.AppRunner | None = None
+        self.app: web.Application | None = None
+
+    def start(self) -> "ServerThread":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise TimeoutError("server failed to start")
+        return self
+
+    def _run(self) -> None:
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self._serve())
+        self.loop.run_forever()
+
+    async def _serve(self) -> None:
+        app = self._app_factory
+        if not isinstance(app, web.Application):
+            app = await app()
+        self.app = app
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve ephemeral port
+        server = site._server
+        if server and server.sockets:
+            self.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def call_soon(self, coro) -> None:
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        if self.loop is None:
+            return
+
+        async def _shutdown():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            self.loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def run_apps_forever(servers: list[ServerThread]) -> None:
+    import time
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for s in servers:
+            s.stop()
+
+
+def parse_json_body(text: str) -> dict:
+    try:
+        v = json.loads(text) if text else {}
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad json body: {e}") from e
+    if not isinstance(v, dict):
+        raise ValueError("json body must be an object")
+    return v

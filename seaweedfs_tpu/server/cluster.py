@@ -1,0 +1,102 @@
+"""In-process cluster harness: master + N volume servers (+ filer) on
+localhost ports, each on its own event-loop thread.
+
+The single-host analogue of the reference's docker-compose cluster
+fixtures (/root/reference/docker/compose/local-cluster-compose.yml) and
+the `weed server` combined command (command/server.go:94-107) — used by
+tests, the CLI, and the benchmark tool.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import requests
+
+from ..rpc.http import ServerThread
+from ..storage.store import Store
+from .master_server import MasterServer
+from .volume_server import VolumeServer
+
+
+class Cluster:
+    def __init__(self, base_dir: str, n_volume_servers: int = 2,
+                 dirs_per_server: int = 1, max_volumes: int = 16,
+                 volume_size_limit: int = 1 << 30,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 0.4,
+                 ec_backend: str = "numpy",
+                 jwt_secret: str = "",
+                 topology: list[tuple[str, str]] | None = None):
+        """topology: optional per-server (data_center, rack) labels."""
+        self.base_dir = base_dir
+        self.master = MasterServer(
+            volume_size_limit=volume_size_limit,
+            default_replication=default_replication,
+            pulse_seconds=pulse_seconds, jwt_secret=jwt_secret)
+        self.master_thread = ServerThread(self.master.app).start()
+        self.volume_servers: list[VolumeServer] = []
+        self.volume_threads: list[ServerThread] = []
+        self.stores: list[Store] = []
+        for i in range(n_volume_servers):
+            dirs = []
+            for d in range(dirs_per_server):
+                path = os.path.join(base_dir, f"vol{i}_{d}")
+                os.makedirs(path, exist_ok=True)
+                dirs.append(path)
+            store = Store(dirs, ip="127.0.0.1", port=0,
+                          ec_backend=ec_backend)
+            for loc in store.locations:
+                loc.max_volumes = max_volumes
+            dc, rack = (topology[i] if topology else
+                        ("DefaultDataCenter", "DefaultRack"))
+            vs = VolumeServer(store, self.master_url, data_center=dc,
+                              rack=rack, jwt_secret=jwt_secret,
+                              pulse_seconds=pulse_seconds)
+            thread = ServerThread(vs.app).start()
+            store.port = thread.port
+            store.public_url = thread.address
+            self.volume_servers.append(vs)
+            self.volume_threads.append(thread)
+            self.stores.append(store)
+        self.wait_for_nodes(n_volume_servers)
+
+    @property
+    def master_url(self) -> str:
+        return self.master_thread.url
+
+    def volume_url(self, i: int) -> str:
+        return self.volume_threads[i].url
+
+    def wait_for_nodes(self, n: int, timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.master.topo.nodes) >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"only {len(self.master.topo.nodes)}/{n} volume servers "
+            "registered")
+
+    def wait_for_ec_shards(self, vid: int, min_shards: int = 14,
+                           timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            shards = self.master.topo.lookup_ec_shards(vid)
+            if sum(len(v) for v in shards.values()) >= min_shards:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"ec shards of {vid} not fully registered")
+
+    def admin(self, server_i: int, path: str, body: dict) -> dict:
+        resp = requests.post(f"{self.volume_url(server_i)}{path}",
+                             json=body, timeout=120)
+        out = resp.json()
+        if resp.status_code >= 300:
+            raise RuntimeError(f"{path}: {out}")
+        return out
+
+    def stop(self) -> None:
+        for t in self.volume_threads:
+            t.stop()
+        self.master_thread.stop()

@@ -1,0 +1,337 @@
+"""Master server: cluster control plane over HTTP + WebSocket streams.
+
+Equivalent of /root/reference/weed/server/master_server.go (HTTP routes
+:135-149) and master_grpc_server*.go: /dir/assign (Assign,
+master_grpc_server_assign.go:37), /dir/lookup, /vol/grow
+(ProcessGrowRequest, master_grpc_server_volume.go:21-77), streaming
+heartbeat (SendHeartbeat, master_grpc_server.go:61) and KeepConnected
+location-delta push (:250-330) — both as WebSockets.
+
+Leadership: single-master stands alone; multi-master runs the Raft
+elector in master/raft.py with leader-proxying of control verbs, same
+shape as the reference's raft integration (master_server.go:167,219).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import aiohttp
+from aiohttp import web
+
+from ..master.sequence import MemorySequencer, SnowflakeSequencer
+from ..master.topology import (NoFreeSlots, NoWritableVolume, Topology,
+                               VolumeInfo)
+from ..rpc.http import json_error, json_ok
+from ..storage import types as t
+from ..utils.security import Guard
+
+
+class MasterServer:
+    def __init__(self, volume_size_limit: int = 30 << 30,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 sequencer: str = "memory",
+                 jwt_secret: str = "",
+                 garbage_threshold: float = 0.3):
+        self.topo = Topology(volume_size_limit, pulse_seconds)
+        self.default_replication = default_replication
+        self.seq = (SnowflakeSequencer() if sequencer == "snowflake"
+                    else MemorySequencer())
+        self.guard = Guard(jwt_secret)
+        self.garbage_threshold = garbage_threshold
+        self.pulse_seconds = pulse_seconds
+        self._clients: set[web.WebSocketResponse] = set()
+        self._grow_lock = asyncio.Lock()
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 20)
+        app.add_routes([
+            web.get("/dir/assign", self.handle_assign),
+            web.post("/dir/assign", self.handle_assign),
+            web.get("/dir/lookup", self.handle_lookup),
+            web.get("/vol/grow", self.handle_grow),
+            web.post("/vol/grow", self.handle_grow),
+            web.get("/vol/status", self.handle_vol_status),
+            web.get("/dir/status", self.handle_dir_status),
+            web.get("/cluster/status", self.handle_cluster_status),
+            web.get("/cluster/ec_shards", self.handle_ec_shards),
+            web.get("/ws/heartbeat", self.handle_heartbeat_ws),
+            web.get("/ws/keepconnected", self.handle_keepconnected_ws),
+            web.get("/metrics", self.handle_metrics),
+            web.get("/", self.handle_ui),
+        ])
+        return app
+
+    # ------------------------------------------------------------------
+    # assignment
+    # ------------------------------------------------------------------
+    async def handle_assign(self, req: web.Request) -> web.Response:
+        q = req.query
+        count = int(q.get("count", 1))
+        collection = q.get("collection", "")
+        replication = q.get("replication") or self.default_replication
+        ttl = _parse_ttl(q.get("ttl", ""))
+        dc = q.get("dataCenter") or None
+        try:
+            vid, nodes = self.topo.pick_for_write(collection, replication, ttl)
+        except NoWritableVolume:
+            try:
+                await self._grow(collection, replication, ttl, dc)
+            except NoFreeSlots as e:
+                return json_error(str(e), status=500)
+            try:
+                vid, nodes = self.topo.pick_for_write(
+                    collection, replication, ttl)
+            except NoWritableVolume as e:
+                return json_error(str(e), status=500)
+        key = self.seq.next_ids(count)
+        node = nodes[0]
+        fid = t.format_file_id(vid, key, _new_cookie())
+        return json_ok({
+            "fid": fid,
+            "url": node.url,
+            "publicUrl": node.public_url,
+            "count": count,
+            "replicas": [{"url": n.url, "publicUrl": n.public_url}
+                         for n in nodes[1:]],
+            "auth": self.guard.sign(fid),
+        })
+
+    async def handle_lookup(self, req: web.Request) -> web.Response:
+        vid_s = req.query.get("volumeId", "")
+        vid = int(vid_s.split(",")[0]) if vid_s else 0
+        nodes = self.topo.lookup(vid)
+        if not nodes:
+            return json_error(f"volume {vid} not found", status=404)
+        return json_ok({
+            "volumeId": str(vid),
+            "locations": [{"url": n.url, "publicUrl": n.public_url}
+                          for n in nodes],
+        })
+
+    async def handle_grow(self, req: web.Request) -> web.Response:
+        q = req.query
+        count = int(q.get("count", 1))
+        collection = q.get("collection", "")
+        replication = q.get("replication") or self.default_replication
+        ttl = _parse_ttl(q.get("ttl", ""))
+        try:
+            grown = 0
+            for _ in range(count):
+                await self._grow(collection, replication, ttl,
+                                 q.get("dataCenter") or None, force=True)
+                grown += 1
+        except NoFreeSlots as e:
+            return json_error(str(e), status=500)
+        return json_ok({"count": grown})
+
+    async def _grow(self, collection: str, replication: str,
+                    ttl: tuple[int, int], dc: str | None = None,
+                    force: bool = False) -> int:
+        """findAndGrow (volume_growth.go:107): pick servers, allocate the
+        volume on each over its admin API, let heartbeats register it.
+        Without `force`, skips when another waiter already grew the
+        layout (the assign-path contention case)."""
+        async with self._grow_lock:
+            if not force:
+                try:
+                    self.topo.pick_for_write(collection, replication, ttl)
+                    return 0
+                except NoWritableVolume:
+                    pass
+            nodes = self.topo.find_empty_slots(replication, dc)
+            vid = self.topo.next_volume_id()
+            ttl_b = bytes(ttl)
+            async with aiohttp.ClientSession() as sess:
+                for node in nodes:
+                    async with sess.post(
+                            f"http://{node.url}/admin/assign_volume",
+                            json={"volume": vid, "collection": collection,
+                                  "replication": replication,
+                                  "ttl": list(ttl_b)}) as resp:
+                        if resp.status != 200:
+                            raise NoFreeSlots(
+                                f"allocate volume {vid} on {node.url}: "
+                                f"{await resp.text()}")
+            # optimistic local registration so assigns can proceed before
+            # the next heartbeat confirms
+            for node in nodes:
+                v = VolumeInfo(vid=vid, collection=collection,
+                               replica_placement=replication, ttl=ttl)
+                node.volumes[vid] = v
+                self.topo._register_volume(v, node)
+            await self._broadcast_location(vid, nodes)
+            return vid
+
+    # ------------------------------------------------------------------
+    # streams
+    # ------------------------------------------------------------------
+    async def handle_heartbeat_ws(self, req: web.Request) -> web.WebSocketResponse:
+        """One volume server's heartbeat stream; registers on first
+        message, unregisters on disconnect (master_grpc_server.go:61)."""
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(req)
+        node_id = None
+        try:
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                hb = json.loads(msg.data)
+                node_id = f"{hb['ip']}:{hb['port']}"
+                node = self.topo.register_node(
+                    node_id, hb["ip"], hb["port"],
+                    hb.get("public_url", node_id),
+                    hb.get("max_volume_count", 8),
+                    hb.get("data_center", "DefaultDataCenter"),
+                    hb.get("rack", "DefaultRack"))
+                if "volumes" in hb:
+                    self.topo.sync_node_volumes(
+                        node, [VolumeInfo(
+                            vid=v["id"], collection=v.get("collection", ""),
+                            size=v.get("size", 0),
+                            file_count=v.get("file_count", 0),
+                            delete_count=v.get("delete_count", 0),
+                            deleted_bytes=v.get("deleted_bytes", 0),
+                            read_only=v.get("read_only", False),
+                            replica_placement=v.get(
+                                "replica_placement", "000"),
+                            ttl=tuple(v.get("ttl", (0, 0))),
+                        ) for v in hb["volumes"]])
+                if "ec_shards" in hb:
+                    self.topo.sync_node_ec_shards(
+                        node, [(e["id"], e.get("collection", ""),
+                                e["shard_bits"]) for e in hb["ec_shards"]])
+                await ws.send_json({
+                    "volume_size_limit": self.topo.volume_size_limit,
+                    "pulse_seconds": self.pulse_seconds,
+                })
+                await self._broadcast_node_update(node)
+        finally:
+            if node_id is not None:
+                self.topo.unregister_data_node(node_id)
+                await self._broadcast_all_locations()
+        return ws
+
+    async def handle_keepconnected_ws(self, req: web.Request) -> web.WebSocketResponse:
+        """Client cache-invalidation stream (KeepConnected,
+        master_grpc_server.go:250): full snapshot on connect, deltas
+        after."""
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(req)
+        self._clients.add(ws)
+        try:
+            await ws.send_json({"snapshot": self._location_snapshot()})
+            async for _ in ws:
+                pass
+        finally:
+            self._clients.discard(ws)
+        return ws
+
+    def _location_snapshot(self) -> dict:
+        out: dict[str, list[dict]] = {}
+        with self.topo.lock:
+            for layout in self.topo.layouts.values():
+                for vid, nodes in layout.locations.items():
+                    out[str(vid)] = [
+                        {"url": n.url, "publicUrl": n.public_url}
+                        for n in nodes]
+            for vid in self.topo.ec_locations:
+                nodes = self.topo.lookup(vid)
+                out[str(vid)] = [
+                    {"url": n.url, "publicUrl": n.public_url,
+                     "ec": True} for n in nodes]
+        return out
+
+    async def _broadcast_location(self, vid: int, nodes) -> None:
+        msg = {"updates": {str(vid): [
+            {"url": n.url, "publicUrl": n.public_url} for n in nodes]}}
+        await self._send_to_clients(msg)
+
+    async def _broadcast_node_update(self, node) -> None:
+        updates = {}
+        with self.topo.lock:
+            for vid in node.volumes:
+                updates[str(vid)] = [
+                    {"url": n.url, "publicUrl": n.public_url}
+                    for n in self.topo.lookup(vid)]
+            for vid in node.ec_shards:
+                updates[str(vid)] = [
+                    {"url": n.url, "publicUrl": n.public_url, "ec": True}
+                    for n in self.topo.lookup(vid)]
+        if updates:
+            await self._send_to_clients({"updates": updates})
+
+    async def _broadcast_all_locations(self) -> None:
+        await self._send_to_clients({"snapshot": self._location_snapshot()})
+
+    async def _send_to_clients(self, msg: dict) -> None:
+        dead = []
+        for ws in self._clients:
+            try:
+                await ws.send_json(msg)
+            except Exception:
+                dead.append(ws)
+        for ws in dead:
+            self._clients.discard(ws)
+
+    # ------------------------------------------------------------------
+    # status / introspection
+    # ------------------------------------------------------------------
+    async def handle_cluster_status(self, req: web.Request) -> web.Response:
+        return json_ok({
+            "IsLeader": True,
+            "Topology": self.topo.to_dict(),
+        })
+
+    async def handle_dir_status(self, req: web.Request) -> web.Response:
+        return json_ok({"Topology": self.topo.to_dict()})
+
+    async def handle_vol_status(self, req: web.Request) -> web.Response:
+        return json_ok({"Volumes": self.topo.to_dict()})
+
+    async def handle_ec_shards(self, req: web.Request) -> web.Response:
+        vid = int(req.query.get("volumeId", 0))
+        shards = self.topo.lookup_ec_shards(vid)
+        return json_ok({
+            "volumeId": vid,
+            "collection": self.topo.ec_collections.get(vid, ""),
+            "shards": {str(sid): [n.url for n in nodes]
+                       for sid, nodes in shards.items()},
+        })
+
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        from ..utils import metrics
+
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")
+
+    async def handle_ui(self, req: web.Request) -> web.Response:
+        topo = self.topo.to_dict()
+        n_nodes = sum(len(r["nodes"]) for dc in topo["datacenters"]
+                      for r in dc["racks"])
+        return web.Response(
+            text=f"<html><body><h1>seaweedfs-tpu master</h1>"
+                 f"<p>nodes: {n_nodes}, max volume id: "
+                 f"{topo['max_volume_id']}</p>"
+                 f"<pre>{json.dumps(topo, indent=2)}</pre></body></html>",
+            content_type="text/html")
+
+
+def _parse_ttl(s: str) -> tuple[int, int]:
+    """'3m'/'4h'/'5d'/'6w'/'7M'/'8y' -> stored (count, unit) pair
+    (needle/volume_ttl.go:33)."""
+    if not s:
+        return (0, 0)
+    units = {"m": 1, "h": 2, "d": 3, "w": 4, "M": 5, "y": 6}
+    if s[-1].isdigit():
+        return (int(s), 1)
+    return (int(s[:-1]), units.get(s[-1], 1))
+
+
+def _new_cookie() -> int:
+    import secrets
+
+    return secrets.randbits(32)

@@ -1,0 +1,625 @@
+"""Volume server: data-plane HTTP + admin API + master heartbeat loop.
+
+Equivalents: /root/reference/weed/server/volume_server_handlers_read.go:31
+(GetOrHeadHandler), _write.go:18 (PostHandler) with replica fan-out
+(topology/store_replicate.go:24 ReplicatedWrite), the VolumeServer admin
+rpcs (volume_grpc_admin.go, volume_grpc_erasure_coding.go:38-407,
+volume_grpc_copy.go file streaming, volume_grpc_vacuum.go), and the
+heartbeat loop (volume_grpc_client_to_master.go:50-120).
+
+In-flight byte accounting backpressure (volume_server.go:17-40) is
+replaced by aiohttp's connection limits + an asyncio semaphore around
+writes — same guarantee, idiomatic asyncio.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import aiohttp
+from aiohttp import web
+
+from ..ec import geometry as geo
+from ..ec.decoder import find_dat_size, write_dat_file, write_idx_from_ecx
+from ..storage import needle as ndl
+from ..storage import types as t
+from ..storage.store import Store
+from ..utils import metrics
+from ..utils.security import Guard
+
+
+class VolumeServer:
+    def __init__(self, store: Store, master_url: str,
+                 data_center: str = "DefaultDataCenter",
+                 rack: str = "DefaultRack",
+                 jwt_secret: str = "",
+                 pulse_seconds: float = 5.0,
+                 max_concurrent_writes: int = 64):
+        self.store = store
+        self.master_url = master_url.rstrip("/")
+        self.data_center = data_center
+        self.rack = rack
+        self.guard = Guard(jwt_secret)
+        self.pulse_seconds = pulse_seconds
+        self._write_sem = asyncio.Semaphore(max_concurrent_writes)
+        self._hb_task: asyncio.Task | None = None
+        self._hb_wake = asyncio.Event()
+        self.store.remote_shard_reader = self._remote_shard_read_sync
+        self.app = self._build_app()
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+
+    def _build_app(self) -> web.Application:
+        @web.middleware
+        async def error_mw(request, handler):
+            try:
+                return await handler(request)
+            except web.HTTPException:
+                raise
+            except (json.JSONDecodeError, KeyError, ValueError,
+                    TypeError) as e:
+                return web.json_response(
+                    {"error": f"bad request: {e}"}, status=400)
+
+        app = web.Application(client_max_size=256 << 20,
+                              middlewares=[error_mw])
+        app.add_routes([
+            web.get("/status", self.handle_status),
+            web.get("/metrics", self.handle_metrics),
+            web.post("/admin/assign_volume", self.handle_assign_volume),
+            web.post("/admin/delete_volume", self.handle_delete_volume),
+            web.post("/admin/mark_readonly", self.handle_mark_readonly),
+            web.post("/admin/mark_writable", self.handle_mark_writable),
+            web.post("/admin/volume_copy", self.handle_volume_copy),
+            web.post("/admin/volume_replication",
+                     self.handle_volume_replication),
+            web.post("/admin/vacuum_check", self.handle_vacuum_check),
+            web.post("/admin/vacuum_compact", self.handle_vacuum_compact),
+            web.post("/admin/ec/generate", self.handle_ec_generate),
+            web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
+            web.post("/admin/ec/copy", self.handle_ec_copy),
+            web.post("/admin/ec/mount", self.handle_ec_mount),
+            web.post("/admin/ec/unmount", self.handle_ec_unmount),
+            web.post("/admin/ec/delete", self.handle_ec_delete),
+            web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
+            web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
+            web.get("/admin/copy_file", self.handle_copy_file),
+            web.get("/admin/volume_info", self.handle_volume_info),
+            web.route("*", "/{fid:[0-9]+,[0-9a-fA-F]+}", self.handle_fid),
+        ])
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except asyncio.CancelledError:
+                pass
+        await asyncio.to_thread(self.store.close)
+
+    # ------------------------------------------------------------------
+    # heartbeat (volume_grpc_client_to_master.go:50 doHeartbeat)
+    # ------------------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        ws_url = self.master_url.replace("http", "ws", 1) + "/ws/heartbeat"
+        while self.store.port == 0:
+            # ephemeral listen port not resolved yet (set by the runner
+            # right after the site binds) — don't register as :0
+            await asyncio.sleep(0.02)
+        while True:
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.ws_connect(ws_url) as ws:
+                        while True:
+                            hb = self.store.collect_heartbeat()
+                            hb["data_center"] = self.data_center
+                            hb["rack"] = self.rack
+                            await ws.send_json(hb)
+                            msg = await ws.receive(
+                                timeout=self.pulse_seconds * 4)
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                break
+                            try:
+                                await asyncio.wait_for(
+                                    self._hb_wake.wait(),
+                                    timeout=self.pulse_seconds)
+                                self._hb_wake.clear()
+                            except asyncio.TimeoutError:
+                                pass
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                await asyncio.sleep(1)
+
+    def poke_heartbeat(self) -> None:
+        self._hb_wake.set()
+
+    # ------------------------------------------------------------------
+    # data plane: GET/HEAD/POST/DELETE /<vid>,<fid>
+    # ------------------------------------------------------------------
+    async def handle_fid(self, req: web.Request) -> web.Response:
+        fid = req.match_info["fid"]
+        try:
+            vid, key, cookie = t.parse_file_id(fid)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        if req.method in ("GET", "HEAD"):
+            return await self._read_fid(req, vid, key, cookie)
+        if req.method == "POST" or req.method == "PUT":
+            return await self._write_fid(req, fid, vid, key, cookie)
+        if req.method == "DELETE":
+            return await self._delete_fid(req, fid, vid, key)
+        return web.Response(status=405)
+
+    async def _read_fid(self, req, vid, key, cookie) -> web.Response:
+        start = time.perf_counter()
+        if not self.store.has_volume(vid) and \
+                vid not in self.store.ec_volumes:
+            # not local: redirect via master lookup (handlers_read.go:48)
+            url = await self._lookup_volume(vid)
+            if url:
+                raise web.HTTPMovedPermanently(
+                    f"http://{url}/{req.match_info['fid']}")
+            return web.Response(status=404, text=f"volume {vid} not found")
+        try:
+            n = await asyncio.to_thread(
+                self.store.read_needle, vid, key, cookie)
+        except KeyError:
+            return web.Response(status=404)
+        except PermissionError:
+            return web.Response(status=403)
+        except (ValueError, IOError) as e:
+            return web.Response(status=500, text=str(e))
+        metrics.histogram_observe("volume_server_read_seconds",
+                                  time.perf_counter() - start)
+        headers = {"Etag": f'"{n.etag()}"'}
+        if n.last_modified:
+            headers["Last-Modified"] = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+        body = n.data
+        if n.is_compressed and "gzip" not in \
+                req.headers.get("Accept-Encoding", ""):
+            import gzip
+
+            body = gzip.decompress(body)
+        elif n.is_compressed:
+            headers["Content-Encoding"] = "gzip"
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if req.method == "HEAD":
+            headers["Content-Length"] = str(len(body))
+            return web.Response(status=200, headers=headers)
+        # range support (handlers_read.go writeResponseContent)
+        rng = req.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            try:
+                s, _, e = rng[len("bytes="):].partition("-")
+                start_i = int(s) if s else 0
+                end_i = int(e) if e else len(body) - 1
+                if start_i > end_i or start_i >= len(body):
+                    raise ValueError
+                part = body[start_i:end_i + 1]
+                headers["Content-Range"] = \
+                    f"bytes {start_i}-{end_i}/{len(body)}"
+                return web.Response(status=206, body=part,
+                                    content_type=ct, headers=headers)
+            except ValueError:
+                return web.Response(status=416)
+        return web.Response(body=body, content_type=ct, headers=headers)
+
+    async def _write_fid(self, req, fid, vid, key, cookie) -> web.Response:
+        start = time.perf_counter()
+        try:
+            self.guard.check(req.headers.get("Authorization"), fid)
+        except PermissionError as e:
+            return web.Response(status=401, text=str(e))
+        if not self.store.has_volume(vid):
+            return web.Response(status=404, text=f"volume {vid} not found")
+        n = ndl.Needle(id=key, cookie=cookie)
+        ctype = req.content_type or ""
+        if ctype.startswith("multipart/"):
+            reader = await req.multipart()
+            part = await reader.next()
+            if part is None:
+                return web.Response(status=400, text="empty multipart body")
+            n.data = bytes(await part.read(decode=False))
+            if part.filename:
+                n.name = part.filename.encode()
+            pct = part.headers.get("Content-Type", "")
+            if pct and pct != "application/octet-stream":
+                n.mime = pct.encode()
+        else:
+            n.data = await req.read()
+            if ctype and ctype != "application/octet-stream":
+                n.mime = ctype.encode()
+        if req.query.get("ts"):
+            n.last_modified = int(req.query["ts"])
+        async with self._write_sem:
+            try:
+                _, size = await asyncio.to_thread(
+                    self.store.write_needle, vid, n)
+            except KeyError:
+                return web.Response(status=404)
+            except PermissionError as e:
+                return web.Response(status=409, text=str(e))
+        # replica fan-out (store_replicate.go:24): skip when this IS the
+        # replicated copy (type=replicate marks secondary writes)
+        if req.query.get("type") != "replicate":
+            err = await self._replicate(req, fid, n.data, "POST")
+            if err:
+                return web.Response(status=500, text=err)
+        self.poke_heartbeat()
+        metrics.histogram_observe("volume_server_write_seconds",
+                                  time.perf_counter() - start)
+        return web.json_response(
+            {"name": n.name.decode() if n.name else "",
+             "size": len(n.data), "eTag": n.etag()}, status=201)
+
+    async def _delete_fid(self, req, fid, vid, key) -> web.Response:
+        try:
+            self.guard.check(req.headers.get("Authorization"), fid)
+        except PermissionError as e:
+            return web.Response(status=401, text=str(e))
+        try:
+            size = await asyncio.to_thread(
+                self.store.delete_needle, vid, key)
+        except KeyError:
+            return web.Response(status=404)
+        if req.query.get("type") != "replicate":
+            err = await self._replicate(req, fid, b"", "DELETE")
+            if err:
+                return web.Response(status=500, text=err)
+        return web.json_response({"size": size}, status=202)
+
+    async def _replicate(self, req, fid: str, data: bytes,
+                         method: str) -> str | None:
+        """Fan out to replica peers from master lookup, excluding self
+        (DistributedOperation, store_replicate.go:171)."""
+        vid = int(fid.split(",")[0])
+        locations = await self._lookup_volume_all(vid)
+        me = f"{self.store.ip}:{self.store.port}"
+        peers = [u for u in locations if u != me]
+        if not peers:
+            return None
+        async with aiohttp.ClientSession() as sess:
+            for peer in peers:
+                url = f"http://{peer}/{fid}?type=replicate"
+                try:
+                    if method == "POST":
+                        async with sess.post(url, data=data) as resp:
+                            if resp.status >= 300:
+                                return (f"replicate to {peer}: "
+                                        f"{resp.status}")
+                    else:
+                        async with sess.delete(url) as resp:
+                            if resp.status >= 300 and resp.status != 404:
+                                return (f"replicate delete {peer}: "
+                                        f"{resp.status}")
+                except aiohttp.ClientError as e:
+                    return f"replicate to {peer}: {e}"
+        return None
+
+    async def _lookup_volume(self, vid: int) -> str | None:
+        urls = await self._lookup_volume_all(vid)
+        return urls[0] if urls else None
+
+    async def _lookup_volume_all(self, vid: int) -> list[str]:
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                        f"{self.master_url}/dir/lookup",
+                        params={"volumeId": str(vid)}) as resp:
+                    if resp.status != 200:
+                        return []
+                    body = await resp.json()
+                    return [l["url"] for l in body.get("locations", [])]
+        except aiohttp.ClientError:
+            return []
+
+    # ------------------------------------------------------------------
+    # admin: volume lifecycle
+    # ------------------------------------------------------------------
+    async def handle_assign_volume(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid = int(body["volume"])
+        try:
+            await asyncio.to_thread(
+                self.store.add_volume, vid, body.get("collection", ""),
+                body.get("replication", "000"),
+                bytes(body.get("ttl", (0, 0))))
+        except FileExistsError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        self.poke_heartbeat()
+        return web.json_response({"volume": vid})
+
+    async def handle_delete_volume(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            await asyncio.to_thread(
+                self.store.delete_volume, int(body["volume"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_mark_readonly(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            self.store.mark_readonly(int(body["volume"]), True)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_mark_writable(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            self.store.mark_readonly(int(body["volume"]), False)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_volume_copy(self, req: web.Request) -> web.Response:
+        """VolumeCopy (volume_grpc_copy.go): pull .dat/.idx from a source
+        server and mount the volume locally."""
+        body = await req.json()
+        vid = int(body["volume"])
+        collection = body.get("collection", "")
+        source = body["source"]
+        if self.store.has_volume(vid):
+            return web.json_response({"error": "volume exists"}, status=409)
+        loc = min(self.store.locations, key=lambda l: l.volume_count)
+        base = loc.base_name(collection, vid)
+        async with aiohttp.ClientSession() as sess:
+            for ext in (".dat", ".idx"):
+                async with sess.get(
+                        f"http://{source}/admin/copy_file",
+                        params={"volume": vid, "collection": collection,
+                                "ext": ext}) as resp:
+                    if resp.status != 200:
+                        return web.json_response(
+                            {"error": f"copy {ext} from {source}: "
+                                      f"{resp.status}"}, status=502)
+                    with open(base + ext, "wb") as f:
+                        async for chunk in resp.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+        from ..storage.volume import Volume
+
+        loc.volumes[vid] = await asyncio.to_thread(
+            Volume, loc.dir, collection, vid)
+        self.poke_heartbeat()
+        return web.json_response({"volume": vid})
+
+    async def handle_volume_replication(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.find_volume(int(body["volume"]))
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(
+            {"replication": str(v.super_block.replica_placement)})
+
+    async def handle_vacuum_check(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.find_volume(int(body["volume"]))
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"garbage_ratio": v.garbage_ratio()})
+
+    async def handle_vacuum_compact(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.find_volume(int(body["volume"]))
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        await asyncio.to_thread(v.compact)
+        self.poke_heartbeat()
+        return web.json_response({"size": v.content_size()})
+
+    async def handle_volume_info(self, req: web.Request) -> web.Response:
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({
+            "volume": vid, "size": v.content_size(),
+            "file_count": v.nm.file_count,
+            "deleted_bytes": v.nm.deleted_bytes,
+            "garbage_ratio": v.garbage_ratio(),
+            "read_only": v.read_only,
+        })
+
+    # ------------------------------------------------------------------
+    # admin: erasure coding (volume_grpc_erasure_coding.go)
+    # ------------------------------------------------------------------
+    async def handle_ec_generate(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid = int(body["volume"])
+        try:
+            await asyncio.to_thread(self.store.generate_ec_shards, vid)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"volume": vid})
+
+    async def handle_ec_rebuild(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid = int(body["volume"])
+        try:
+            rebuilt = await asyncio.to_thread(
+                self.store.rebuild_ec_shards, vid)
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"rebuilt_shards": rebuilt})
+
+    async def handle_ec_copy(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsCopy (:126): pull shard files (and optionally
+        .ecx/.ecj) from a source server's copy_file endpoint."""
+        body = await req.json()
+        vid = int(body["volume"])
+        collection = body.get("collection", "")
+        shard_ids = body["shard_ids"]
+        source = body["source"]
+        loc = self.store.locations[0]
+        base = loc.base_name(collection, vid)
+        exts = [geo.shard_ext(sid) for sid in shard_ids]
+        if body.get("copy_ecx", True):
+            exts += [".ecx"]
+        if body.get("copy_ecj", False):
+            exts += [".ecj"]
+        async with aiohttp.ClientSession() as sess:
+            for ext in exts:
+                async with sess.get(
+                        f"http://{source}/admin/copy_file",
+                        params={"volume": vid, "collection": collection,
+                                "ext": ext}) as resp:
+                    if resp.status == 404 and ext == ".ecj":
+                        continue
+                    if resp.status != 200:
+                        return web.json_response(
+                            {"error": f"copy {ext} from {source}: "
+                                      f"{resp.status}"}, status=502)
+                    with open(base + ext, "wb") as f:
+                        async for chunk in resp.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+        return web.json_response({"copied": exts})
+
+    async def handle_ec_mount(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.store.mount_ec_shards(int(body["volume"]),
+                                   body.get("collection", ""),
+                                   body["shard_ids"])
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_ec_unmount(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.store.unmount_ec_shards(int(body["volume"]), body["shard_ids"])
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_ec_delete(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.store.delete_ec_shards(int(body["volume"]),
+                                    body.get("shard_ids"))
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_ec_to_volume(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsToVolume (:407): decode shards back to .dat/.idx
+        and mount as a normal volume."""
+        body = await req.json()
+        vid = int(body["volume"])
+        collection = body.get("collection", "")
+        ecv = self.store.ec_volumes.get(vid)
+        if ecv is None:
+            return web.json_response({"error": "ec volume not mounted"},
+                                     status=404)
+        base = ecv.base_name()
+
+        def _decode():
+            dat_size = find_dat_size(base)
+            write_dat_file(base, dat_size, backend=self.store.ec_backend)
+            write_idx_from_ecx(base)
+
+        await asyncio.to_thread(_decode)
+        self.store.delete_ec_shards(vid, None)
+        for loc in self.store.locations:
+            if os.path.dirname(base) == loc.dir:
+                from ..storage.volume import Volume
+
+                loc.volumes[vid] = Volume(loc.dir, collection, vid)
+        self.poke_heartbeat()
+        return web.json_response({"volume": vid})
+
+    async def handle_ec_shard_read(self, req: web.Request) -> web.StreamResponse:
+        """VolumeEcShardRead (:309): stream a byte range of a local
+        shard."""
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        offset = int(req.query.get("offset", 0))
+        size = int(req.query.get("size", -1))
+        ecv = self.store.ec_volumes.get(vid)
+        shard = ecv.shards.get(sid) if ecv else None
+        if shard is None:
+            return web.Response(status=404, text="shard not found")
+        if size < 0:
+            size = shard.size - offset
+        data = await asyncio.to_thread(shard.read_at, offset, size)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def handle_copy_file(self, req: web.Request) -> web.StreamResponse:
+        """CopyFile rpc (volume_grpc_copy.go): stream any volume/shard
+        file by extension."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        ext = req.query["ext"]
+        if ext not in {".dat", ".idx", ".ecx", ".ecj", ".vif"} and \
+                not (ext.startswith(".ec") and ext[3:].isdigit()):
+            return web.Response(status=400, text=f"bad ext {ext}")
+        if ext in (".dat", ".idx"):
+            v = self.store.find_volume(vid)
+            if v is not None:
+                await asyncio.to_thread(v.sync)
+        path = None
+        for loc in self.store.locations:
+            cand = loc.base_name(collection, vid) + ext
+            if os.path.exists(cand):
+                path = cand
+                break
+        if path is None:
+            return web.Response(status=404, text=f"{ext} not found")
+        resp = web.StreamResponse()
+        resp.content_length = os.path.getsize(path)
+        await resp.prepare(req)
+        with open(path, "rb") as f:
+            while True:
+                chunk = await asyncio.to_thread(f.read, 1 << 20)
+                if not chunk:
+                    break
+                await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    # ------------------------------------------------------------------
+    # degraded reads: fetch remote shard intervals synchronously (called
+    # from store threads, store_ec.go:299 readRemoteEcShardInterval)
+    # ------------------------------------------------------------------
+    def _remote_shard_read_sync(self, vid: int, sid: int, offset: int,
+                                size: int) -> bytes | None:
+        import requests
+
+        try:
+            resp = requests.get(
+                f"{self.master_url}/cluster/ec_shards",
+                params={"volumeId": vid}, timeout=5)
+            holders = resp.json().get("shards", {}).get(str(sid), [])
+        except requests.RequestException:
+            return None
+        me = f"{self.store.ip}:{self.store.port}"
+        for holder in holders:
+            if holder == me:
+                continue
+            try:
+                r = requests.get(
+                    f"http://{holder}/admin/ec/shard_read",
+                    params={"volume": vid, "shard": sid,
+                            "offset": offset, "size": size}, timeout=10)
+                if r.status_code == 200:
+                    return r.content
+            except requests.RequestException:
+                continue
+        return None
+
+    # ------------------------------------------------------------------
+    async def handle_status(self, req: web.Request) -> web.Response:
+        hb = self.store.collect_heartbeat()
+        return web.json_response({"Version": "seaweedfs-tpu", **hb})
+
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")

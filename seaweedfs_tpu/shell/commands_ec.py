@@ -1,0 +1,215 @@
+"""EC orchestration shell commands.
+
+Equivalents of /root/reference/weed/shell/command_ec_encode.go (freeze ->
+generate -> spread -> delete original, :95-192), command_ec_rebuild.go
+(:58-229), command_ec_balance.go + command_ec_common.go:111-170, and
+command_ec_decode.go.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..ec import geometry as geo
+from .env import CommandEnv, ShellError
+
+
+def ec_encode(env: CommandEnv, volume_id: int,
+              collection: str = "") -> dict:
+    """Mark readonly, generate 14 shards on the source server, spread
+    them across servers by free slots, then delete the original volume
+    everywhere (command_ec_encode.go:95-192)."""
+    env.confirm_locked()
+    sources = env.volume_locations(volume_id)
+    if not sources:
+        raise ShellError(f"volume {volume_id} not found")
+    if not collection:
+        collection = env.volume_collection(volume_id)
+    for url in sources:
+        env.vs_post(url, "/admin/mark_readonly", {"volume": volume_id})
+    source = sources[0]
+    env.vs_post(source, "/admin/ec/generate",
+                {"volume": volume_id, "collection": collection})
+    placement = spread_ec_shards(env, volume_id, collection, source)
+    # delete original replicas now that shards are mounted
+    for url in sources:
+        env.vs_post(url, "/admin/delete_volume", {"volume": volume_id})
+    env.wait_for_ec_registration(volume_id, geo.TOTAL_SHARDS)
+    return {sid: url for sid, url in placement.items()}
+
+
+def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
+                     source: str) -> dict[int, str]:
+    """Allocate shards to servers by descending free slots
+    (command_ec_encode.go:145 spreadEcShards, balanced like
+    command_ec_common.go:111)."""
+    nodes = env.data_nodes()
+    if not nodes:
+        raise ShellError("no data nodes")
+    # round-robin over nodes sorted by free capacity
+    def free(n):
+        return n["max_volumes"] - len(n["volumes"]) - \
+            sum(bin(b).count("1") for b in n["ec_volumes"].values()) / \
+            geo.TOTAL_SHARDS
+
+    order = sorted(nodes, key=free, reverse=True)
+    placement: dict[int, str] = {}
+    per_node: dict[str, list[int]] = defaultdict(list)
+    for sid in range(geo.TOTAL_SHARDS):
+        node = order[sid % len(order)]
+        placement[sid] = node["url"]
+        per_node[node["url"]].append(sid)
+    for url, sids in per_node.items():
+        if url != source:
+            env.vs_post(url, "/admin/ec/copy",
+                        {"volume": vid, "collection": collection,
+                         "shard_ids": sids, "source": source,
+                         "copy_ecx": True, "copy_ecj": True})
+        env.vs_post(url, "/admin/ec/mount",
+                    {"volume": vid, "collection": collection,
+                     "shard_ids": sids})
+    # source keeps only its assigned shards
+    source_keeps = set(per_node.get(source, []))
+    drop = [sid for sid in range(geo.TOTAL_SHARDS)
+            if sid not in source_keeps]
+    if drop:
+        env.vs_post(source, "/admin/ec/delete",
+                    {"volume": vid, "shard_ids": drop})
+    return placement
+
+
+def ec_rebuild(env: CommandEnv, volume_id: int,
+               collection: str = "") -> dict:
+    """Rebuild missing shards of an EC volume on the emptiest server
+    (command_ec_rebuild.go:58-229): copy >= k present shards to the
+    rebuilder, run the local rebuild, mount the rebuilt shards, drop the
+    borrowed copies."""
+    env.confirm_locked()
+    if not collection:
+        collection = env.ec_collection(volume_id)
+    locations = env.ec_shard_locations(volume_id)
+    present = set(locations)
+    missing = [sid for sid in range(geo.TOTAL_SHARDS)
+               if sid not in present]
+    if not missing:
+        return {"rebuilt": []}
+    if len(present) < geo.DATA_SHARDS:
+        raise ShellError(
+            f"volume {volume_id}: only {len(present)} shards survive, "
+            f"need {geo.DATA_SHARDS}")
+    nodes = env.data_nodes()
+    rebuilder = max(
+        nodes,
+        key=lambda n: n["max_volumes"] - len(n["volumes"]))["url"]
+    local = set()
+    for sid, urls in locations.items():
+        if rebuilder in urls:
+            local.add(sid)
+    # copy ALL present-elsewhere shards to the rebuilder so the local
+    # rebuild regenerates exactly the globally-missing ones
+    # (prepareDataToRecover, command_ec_rebuild.go:193)
+    borrowed = []
+    for sid in sorted(present - local):
+        src = locations[sid][0]
+        env.vs_post(rebuilder, "/admin/ec/copy",
+                    {"volume": volume_id, "collection": collection,
+                     "shard_ids": [sid], "source": src,
+                     "copy_ecx": not local and not borrowed,
+                     "copy_ecj": False})
+        borrowed.append(sid)
+    out = env.vs_post(rebuilder, "/admin/ec/rebuild",
+                      {"volume": volume_id})
+    rebuilt = out["rebuilt_shards"]
+    env.vs_post(rebuilder, "/admin/ec/mount",
+                {"volume": volume_id, "collection": collection,
+                 "shard_ids": rebuilt})
+    if borrowed:
+        env.vs_post(rebuilder, "/admin/ec/delete",
+                    {"volume": volume_id, "shard_ids": borrowed})
+    env.wait_for_ec_registration(volume_id, geo.TOTAL_SHARDS)
+    return {"rebuilt": rebuilt, "rebuilder": rebuilder}
+
+
+def ec_balance(env: CommandEnv, collection: str = "") -> list[dict]:
+    """Even out shard counts across servers (command_ec_balance.go):
+    move shards from overloaded to underloaded nodes."""
+    env.confirm_locked()
+    nodes = env.data_nodes()
+    if not nodes:
+        return []
+    shard_count = {n["url"]: sum(bin(b).count("1")
+                                 for b in n["ec_volumes"].values())
+                   for n in nodes}
+    holdings: dict[str, list[tuple[int, int]]] = defaultdict(list)
+    for n in nodes:
+        for vid_s, bits in n["ec_volumes"].items():
+            for sid in range(geo.TOTAL_SHARDS):
+                if bits >> sid & 1:
+                    holdings[n["url"]].append((int(vid_s), sid))
+    total = sum(shard_count.values())
+    target = -(-total // len(nodes))  # ceil
+    moves = []
+    under = [u for u in shard_count if shard_count[u] < target]
+    for src in sorted(shard_count, key=shard_count.get, reverse=True):
+        while shard_count[src] > target and under:
+            dst = under[0]
+            vid, sid = holdings[src].pop()
+            col = collection or env.ec_collection(vid)
+            env.vs_post(dst, "/admin/ec/copy",
+                        {"volume": vid, "collection": col,
+                         "shard_ids": [sid], "source": src,
+                         "copy_ecx": True, "copy_ecj": True})
+            env.vs_post(dst, "/admin/ec/mount",
+                        {"volume": vid, "collection": col,
+                         "shard_ids": [sid]})
+            env.vs_post(src, "/admin/ec/delete",
+                        {"volume": vid, "shard_ids": [sid]})
+            shard_count[src] -= 1
+            shard_count[dst] += 1
+            moves.append({"volume": vid, "shard": sid,
+                          "from": src, "to": dst})
+            if shard_count[dst] >= target:
+                under.pop(0)
+            if not under:
+                break
+    return moves
+
+
+def ec_decode(env: CommandEnv, volume_id: int,
+              collection: str = "") -> dict:
+    """Collect all shards onto one server and decode back to a normal
+    volume (command_ec_decode.go)."""
+    env.confirm_locked()
+    if not collection:
+        collection = env.ec_collection(volume_id)
+    locations = env.ec_shard_locations(volume_id)
+    if not locations:
+        raise ShellError(f"ec volume {volume_id} not found")
+    present = set(locations)
+    if len(present) < geo.DATA_SHARDS:
+        raise ShellError(f"only {len(present)} shards survive")
+    # choose the server with most shards as the collector
+    count_by_server: dict[str, int] = defaultdict(int)
+    for sid, urls in locations.items():
+        for u in urls:
+            count_by_server[u] += 1
+    collector = max(count_by_server, key=count_by_server.get)
+    have = {sid for sid, urls in locations.items() if collector in urls}
+    need = sorted((present - have))[:geo.TOTAL_SHARDS]
+    for sid in need:
+        src = locations[sid][0]
+        env.vs_post(collector, "/admin/ec/copy",
+                    {"volume": volume_id, "collection": collection,
+                     "shard_ids": [sid], "source": src,
+                     "copy_ecx": False, "copy_ecj": True})
+    env.vs_post(collector, "/admin/ec/mount",
+                {"volume": volume_id, "collection": collection,
+                 "shard_ids": need})
+    env.vs_post(collector, "/admin/ec/to_volume",
+                {"volume": volume_id, "collection": collection})
+    # drop shards elsewhere
+    for sid, urls in locations.items():
+        for u in urls:
+            if u != collector:
+                env.vs_post(u, "/admin/ec/delete",
+                            {"volume": volume_id, "shard_ids": [sid]})
+    return {"volume": volume_id, "server": collector}

@@ -1,0 +1,130 @@
+"""Volume maintenance shell commands.
+
+Equivalents of /root/reference/weed/shell/command_volume_fix_replication
+.go (re-replicate under-replicated volumes), command_volume_balance.go,
+command_volume_vacuum.go (vacuum driver topology_vacuum.go:20-216), and
+command_volume_list.go.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..storage.super_block import ReplicaPlacement
+from .env import CommandEnv, ShellError
+
+
+def volume_list(env: CommandEnv) -> list[dict]:
+    out = []
+    for n in env.data_nodes():
+        for vid in n["volumes"]:
+            out.append({"volume": vid, "server": n["url"],
+                        "dc": n["dc"], "rack": n["rack"]})
+        for vid_s, bits in n["ec_volumes"].items():
+            out.append({"volume": int(vid_s), "server": n["url"],
+                        "ec_shards": bin(bits).count("1")})
+    return out
+
+
+def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]:
+    """Scan all volumes' garbage ratios; compact those above threshold
+    (topology_vacuum.go:216 Vacuum)."""
+    done = []
+    seen: set[int] = set()
+    for n in env.data_nodes():
+        for vid in n["volumes"]:
+            if vid in seen:
+                continue
+            seen.add(vid)
+            try:
+                check = env.vs_post(n["url"], "/admin/vacuum_check",
+                                    {"volume": vid})
+            except ShellError:
+                continue
+            if check["garbage_ratio"] > garbage_threshold:
+                for url in env.volume_locations(vid):
+                    env.vs_post(url, "/admin/vacuum_compact",
+                                {"volume": vid})
+                done.append({"volume": vid,
+                             "garbage_ratio": check["garbage_ratio"]})
+    return done
+
+
+def volume_fix_replication(env: CommandEnv) -> list[dict]:
+    """Re-replicate under-replicated volumes: copy .dat/.idx from a
+    healthy replica to a server that lacks the volume
+    (command_volume_fix_replication.go)."""
+    env.confirm_locked()
+    nodes = env.data_nodes()
+    by_vid: dict[int, list[dict]] = defaultdict(list)
+    for n in nodes:
+        for vid in n["volumes"]:
+            by_vid[vid].append(n)
+    fixes = []
+    for vid, holders in by_vid.items():
+        rp = _volume_replication(env, vid, holders)
+        want = rp.copy_count
+        have = len(holders)
+        if have >= want:
+            continue
+        holder_urls = {n["url"] for n in holders}
+        candidates = [n for n in nodes if n["url"] not in holder_urls
+                      and len(n["volumes"]) < n["max_volumes"]]
+        candidates.sort(key=lambda n: len(n["volumes"]))
+        src = holders[0]["url"]
+        col = env.volume_collection(vid)
+        for target in candidates[:want - have]:
+            env.vs_post(target["url"], "/admin/volume_copy",
+                        {"volume": vid, "collection": col, "source": src})
+            fixes.append({"volume": vid, "from": src,
+                          "to": target["url"]})
+    return fixes
+
+
+def _volume_replication(env: CommandEnv, vid: int,
+                        holders: list[dict]) -> ReplicaPlacement:
+    try:
+        info = env.vs_post(holders[0]["url"],
+                           "/admin/volume_replication",
+                           {"volume": vid})
+        return ReplicaPlacement.parse(info.get("replication", "000"))
+    except ShellError:
+        return ReplicaPlacement.parse("000")
+
+
+def volume_balance(env: CommandEnv) -> list[dict]:
+    """Move volumes from overloaded to underloaded servers
+    (command_volume_balance.go)."""
+    env.confirm_locked()
+    nodes = env.data_nodes()
+    if len(nodes) < 2:
+        return []
+    counts = {n["url"]: len(n["volumes"]) for n in nodes}
+    holdings = {n["url"]: list(n["volumes"]) for n in nodes}
+    total = sum(counts.values())
+    target = -(-total // len(nodes))
+    moves = []
+    for src in sorted(counts, key=counts.get, reverse=True):
+        for dst in sorted(counts, key=counts.get):
+            while counts[src] > target and counts[dst] < target and \
+                    holdings[src]:
+                vid = holdings[src].pop()
+                env.vs_post(dst, "/admin/volume_copy",
+                            {"volume": vid,
+                             "collection": env.volume_collection(vid),
+                             "source": src})
+                env.vs_post(src, "/admin/delete_volume", {"volume": vid})
+                counts[src] -= 1
+                counts[dst] += 1
+                moves.append({"volume": vid, "from": src, "to": dst})
+    return moves
+
+
+def cluster_check(env: CommandEnv) -> dict:
+    """Basic cluster health summary (command_cluster_check.go)."""
+    nodes = env.data_nodes()
+    vols = volume_list(env)
+    return {
+        "nodes": len(nodes),
+        "volumes": len([v for v in vols if "ec_shards" not in v]),
+        "ec_entries": len([v for v in vols if "ec_shards" in v]),
+    }

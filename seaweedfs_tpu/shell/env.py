@@ -1,0 +1,105 @@
+"""Shell command environment: master access + cluster-wide admin lock.
+
+Equivalent of /root/reference/weed/shell/commands.go:41-78 (command
+interface + CommandEnv.confirmIsLocked). The exclusive admin lock is held
+in the master process here (single control plane) rather than a filer
+DLM; the filer-side distributed lock manager lives in
+filer/lock_manager.py.
+"""
+from __future__ import annotations
+
+import time
+
+import requests
+
+
+class ShellError(Exception):
+    pass
+
+
+class CommandEnv:
+    def __init__(self, master_url: str):
+        self.master_url = master_url.rstrip("/")
+        self.locked = False
+
+    # -- master helpers -------------------------------------------------
+    def master_get(self, path: str, **params) -> dict:
+        resp = requests.get(f"{self.master_url}{path}", params=params,
+                            timeout=60)
+        body = resp.json()
+        if resp.status_code >= 300:
+            raise ShellError(f"{path}: {body.get('error', resp.status_code)}")
+        return body
+
+    def topology(self) -> dict:
+        return self.master_get("/cluster/status")["Topology"]
+
+    def data_nodes(self) -> list[dict]:
+        out = []
+        for dc in self.topology()["datacenters"]:
+            for rack in dc["racks"]:
+                for n in rack["nodes"]:
+                    n = dict(n)
+                    n["dc"] = dc["id"]
+                    n["rack"] = rack["id"]
+                    out.append(n)
+        return out
+
+    def ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        body = self.master_get("/cluster/ec_shards", volumeId=vid)
+        return {int(sid): urls for sid, urls in body["shards"].items()}
+
+    def ec_collection(self, vid: int) -> str:
+        return self.master_get("/cluster/ec_shards",
+                               volumeId=vid).get("collection", "")
+
+    def volume_collection(self, vid: int) -> str:
+        for n in self.data_nodes():
+            col = n.get("collections", {}).get(str(vid))
+            if col is not None:
+                return col
+        return ""
+
+    def volume_locations(self, vid: int) -> list[str]:
+        try:
+            body = self.master_get("/dir/lookup", volumeId=str(vid))
+        except ShellError:
+            return []
+        return [l["url"] for l in body["locations"]]
+
+    # -- volume server admin -------------------------------------------
+    def vs_post(self, server: str, path: str, body: dict,
+                timeout: float = 600) -> dict:
+        resp = requests.post(f"http://{server}{path}", json=body,
+                             timeout=timeout)
+        try:
+            out = resp.json()
+        except Exception:
+            out = {"error": resp.text}
+        if resp.status_code >= 300:
+            raise ShellError(
+                f"{server}{path}: {out.get('error', resp.status_code)}")
+        return out
+
+    # -- admin lock (commands.go:78 confirmIsLocked) --------------------
+    def confirm_locked(self) -> None:
+        if not self.locked:
+            raise ShellError(
+                "lock is required: run `lock` before cluster-mutating "
+                "commands")
+
+    def acquire_lock(self) -> None:
+        self.locked = True
+
+    def release_lock(self) -> None:
+        self.locked = False
+
+    def wait_for_ec_registration(self, vid: int, min_shards: int,
+                                 timeout: float = 20.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            locs = self.ec_shard_locations(vid)
+            if sum(len(v) for v in locs.values()) >= min_shards:
+                return
+            time.sleep(0.1)
+        raise ShellError(f"ec shards of volume {vid} not registered in time")

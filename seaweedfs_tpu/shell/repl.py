@@ -1,0 +1,96 @@
+"""Interactive admin shell REPL.
+
+Equivalent of /root/reference/weed/shell/shell_liner.go: a line-based
+REPL over the command registry, with the cluster-wide admin lock
+(commands.go:78).
+"""
+from __future__ import annotations
+
+import json
+import shlex
+
+from . import commands_ec, commands_volume
+from .env import CommandEnv, ShellError
+
+HELP = """commands:
+  lock / unlock                     acquire/release the admin lock
+  cluster.check                     cluster health summary
+  volume.list                       list volumes and ec shards
+  volume.vacuum [-threshold=0.3]    compact garbage-heavy volumes
+  volume.balance                    even out volume counts
+  volume.fix.replication            re-replicate under-replicated volumes
+  ec.encode -volumeId=N             erasure-code a volume
+  ec.rebuild -volumeId=N            rebuild missing shards
+  ec.balance                        even out shard counts
+  ec.decode -volumeId=N             decode shards back to a volume
+  help / exit
+"""
+
+
+def run_command(env: CommandEnv, line: str) -> object:
+    parts = shlex.split(line)
+    if not parts:
+        return None
+    cmd, args = parts[0], parts[1:]
+    opts = {}
+    for a in args:
+        if a.startswith("-") and "=" in a:
+            k, _, v = a[1:].partition("=")
+            opts[k] = v
+
+    if cmd == "lock":
+        env.acquire_lock()
+        return "locked"
+    if cmd == "unlock":
+        env.release_lock()
+        return "unlocked"
+    if cmd == "cluster.check":
+        return commands_volume.cluster_check(env)
+    if cmd == "volume.list":
+        return commands_volume.volume_list(env)
+    if cmd == "volume.vacuum":
+        return commands_volume.volume_vacuum(
+            env, float(opts.get("threshold", 0.3)))
+    if cmd == "volume.balance":
+        return commands_volume.volume_balance(env)
+    if cmd == "volume.fix.replication":
+        return commands_volume.volume_fix_replication(env)
+    if cmd == "ec.encode":
+        return commands_ec.ec_encode(env, int(opts["volumeId"]),
+                                     opts.get("collection", ""))
+    if cmd == "ec.rebuild":
+        return commands_ec.ec_rebuild(env, int(opts["volumeId"]),
+                                      opts.get("collection", ""))
+    if cmd == "ec.balance":
+        return commands_ec.ec_balance(env, opts.get("collection", ""))
+    if cmd == "ec.decode":
+        return commands_ec.ec_decode(env, int(opts["volumeId"]),
+                                     opts.get("collection", ""))
+    if cmd == "help":
+        return HELP
+    raise ShellError(f"unknown command {cmd!r} (try `help`)")
+
+
+def run_shell(master_url: str) -> int:
+    env = CommandEnv(master_url)
+    print(f"seaweedfs-tpu shell connected to {master_url}")
+    print("type `help` for commands, `exit` to quit")
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if line in ("exit", "quit"):
+            return 0
+        if not line:
+            continue
+        try:
+            out = run_command(env, line)
+            if out is not None:
+                print(out if isinstance(out, str)
+                      else json.dumps(out, indent=2, default=str))
+        except ShellError as e:
+            print(f"error: {e}")
+        except Exception as e:
+            print(f"error: {type(e).__name__}: {e}")

@@ -56,7 +56,12 @@ class DiskFile:
         self._f.truncate(size)
 
     def size(self) -> int:
+        self._f.flush()
         return os.fstat(self._f.fileno()).st_size
+
+    def flush(self) -> None:
+        """Userspace buffer -> OS (no fsync)."""
+        self._f.flush()
 
     def sync(self) -> None:
         self._f.flush()
@@ -100,6 +105,9 @@ class MemoryFile:
 
     def size(self) -> int:
         return len(self._buf)
+
+    def flush(self) -> None:
+        pass
 
     def sync(self) -> None:
         pass

@@ -128,6 +128,14 @@ class Needle:
         """Full padded on-disk record."""
         if version not in (VERSION2, VERSION3):
             raise ValueError(f"unsupported needle version {version}")
+        if len(self.mime) > 255:
+            raise ValueError(
+                f"mime too long ({len(self.mime)} bytes, max 255)")
+        if len(self.pairs) > 0xFFFF:
+            raise ValueError(
+                f"pairs too long ({len(self.pairs)} bytes, max 65535)")
+        if len(self.data) > 0xFFFFFFFF - 1024:
+            raise ValueError("needle data exceeds 4GB limit")
         # auto-set presence flags from populated fields
         if self.name:
             self.flags |= FLAG_HAS_NAME

@@ -1,0 +1,267 @@
+"""Store: the per-server registry of disk locations, volumes and EC
+volumes — the engine behind every volume-server handler.
+
+Equivalent of /root/reference/weed/storage/store.go (WriteVolumeNeedle
+:386, ReadVolumeNeedle :410, CollectHeartbeat :249) and store_ec.go (EC
+mount/read/delete incl. the degraded-read ladder: local shard -> remote
+shard fetch -> on-the-fly reconstruction from >= k shards,
+store_ec.go:199-393). Remote fetch is injected as a callback so the
+transport lives in the server layer.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable
+
+import numpy as np
+
+from ..ec import geometry as geo
+from ..ec.backend import ReedSolomon
+from ..ec.encoder import rebuild_ec_files, write_ec_files, write_sorted_ecx
+from ..ec.volume import EcVolume
+from . import needle as ndl
+from . import types as t
+from .disk_location import DiskLocation
+from .needle import Needle
+from .super_block import ReplicaPlacement
+
+# fetch(vid, shard_id, offset, size) -> bytes | None
+RemoteShardReader = Callable[[int, int, int, int], "bytes | None"]
+
+
+class Store:
+    def __init__(self, dirnames: Iterable[str], ip: str = "localhost",
+                 port: int = 8080, public_url: str = "",
+                 ec_backend: str = "numpy"):
+        self.locations = [DiskLocation(d) for d in dirnames]
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.ec_backend = ec_backend
+        self.ec_volumes: dict[int, EcVolume] = {}
+        self.remote_shard_reader: RemoteShardReader | None = None
+        self._rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS,
+                               backend=ec_backend)
+        for loc in self.locations:
+            loc.load_existing()
+            for vid, entry in loc.ec_shards.items():
+                ecv = EcVolume(loc.dir, entry.collection, vid)
+                for sid in entry.shard_ids:
+                    ecv.mount_shard(sid)
+                self.ec_volumes[vid] = ecv
+
+    # -- volume management --------------------------------------------
+    def find_volume(self, vid: int):
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "000", ttl: bytes = b"\x00\x00"):
+        if self.find_volume(vid) is not None:
+            raise FileExistsError(f"volume {vid} already exists")
+        loc = min(self.locations, key=lambda l: l.volume_count)
+        return loc.new_volume(
+            collection, vid,
+            replica_placement=ReplicaPlacement.parse(replication), ttl=ttl)
+
+    def delete_volume(self, vid: int) -> None:
+        for loc in self.locations:
+            if vid in loc.volumes:
+                loc.delete_volume(vid)
+                return
+        raise KeyError(f"volume {vid} not found")
+
+    def mark_readonly(self, vid: int, read_only: bool = True) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.read_only = read_only
+
+    # -- needle IO ------------------------------------------------------
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.append_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        if vid in self.ec_volumes:
+            return self.read_ec_needle(vid, needle_id, cookie)
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int) -> int:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.delete_needle(needle_id)
+        if vid in self.ec_volumes:
+            self.ec_volumes[vid].delete_needle(needle_id)
+            return 0
+        raise KeyError(f"volume {vid} not found")
+
+    # -- EC lifecycle ---------------------------------------------------
+    def generate_ec_shards(self, vid: int) -> None:
+        """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:38):
+        .dat -> 14 shards + .ecx, using the configured codec backend."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.sync()
+        base = v.file_name()
+        write_ec_files(base, backend=self.ec_backend)
+        write_sorted_ecx(base)
+
+    def rebuild_ec_shards(self, vid: int) -> list[int]:
+        """VolumeEcShardsRebuild (:84): regenerate missing local shards."""
+        base = self._ec_base(vid)
+        if base is None:
+            raise KeyError(f"ec volume {vid} not found")
+        return rebuild_ec_files(base, backend=self.ec_backend)
+
+    def mount_ec_shards(self, vid: int, collection: str,
+                        shard_ids: Iterable[int]) -> None:
+        ecv = self.ec_volumes.get(vid)
+        if ecv is None:
+            loc = self._loc_with_ec_files(vid, collection)
+            ecv = EcVolume(loc.dir, collection, vid)
+            self.ec_volumes[vid] = ecv
+        for sid in shard_ids:
+            ecv.mount_shard(sid)
+            for loc in self.locations:
+                if loc.dir == ecv.dir:
+                    loc.add_ec_shard(collection, vid, sid)
+
+    def unmount_ec_shards(self, vid: int, shard_ids: Iterable[int]) -> None:
+        ecv = self.ec_volumes.get(vid)
+        if ecv is None:
+            return
+        for sid in shard_ids:
+            ecv.unmount_shard(sid)
+        if not ecv.shards:
+            self.ec_volumes.pop(vid, None)
+
+    def delete_ec_shards(self, vid: int,
+                         shard_ids: Iterable[int] | None = None) -> None:
+        ids = set(shard_ids) if shard_ids is not None else None
+        self.unmount_ec_shards(vid, ids or range(geo.TOTAL_SHARDS))
+        for loc in self.locations:
+            loc.remove_ec_shards(vid, ids)
+
+    def _ec_base(self, vid: int) -> str | None:
+        for loc in self.locations:
+            entry = loc.ec_shards.get(vid)
+            if entry is not None:
+                return entry.base_name(loc.dir)
+            # also look for shard files not yet registered
+            v = loc.volumes.get(vid)
+            if v is not None and os.path.exists(
+                    v.file_name() + geo.shard_ext(0)):
+                return v.file_name()
+        ecv = self.ec_volumes.get(vid)
+        return ecv.base_name() if ecv is not None else None
+
+    def _loc_with_ec_files(self, vid: int, collection: str) -> DiskLocation:
+        for loc in self.locations:
+            name = f"{collection}_{vid}" if collection else str(vid)
+            for sid in range(geo.TOTAL_SHARDS):
+                if os.path.exists(os.path.join(
+                        loc.dir, name + geo.shard_ext(sid))):
+                    return loc
+        return self.locations[0]
+
+    # -- EC degraded read ladder ----------------------------------------
+    def read_ec_needle(self, vid: int, needle_id: int,
+                       cookie: int | None = None) -> Needle:
+        """ReadEcShardNeedle (store_ec.go:136): locate via .ecx, read each
+        interval locally, else via remote fetch, else reconstruct."""
+        ecv = self.ec_volumes.get(vid)
+        if ecv is None:
+            raise KeyError(f"ec volume {vid} not found")
+        intervals, size = ecv.needle_intervals(needle_id)
+        blob = b"".join(self._read_interval(ecv, iv) for iv in intervals)
+        n = Needle.from_bytes(blob)
+        if n.size != size:
+            raise ValueError(f"size mismatch: ecx {size} vs disk {n.size}")
+        if cookie is not None and n.cookie != cookie:
+            raise PermissionError("cookie mismatch")
+        return n
+
+    def _read_interval(self, ecv: EcVolume, iv: geo.Interval) -> bytes:
+        data = ecv.read_interval_local(iv)
+        if data is not None:
+            return data
+        sid, off = iv.to_shard_and_offset()
+        if self.remote_shard_reader is not None:
+            data = self.remote_shard_reader(ecv.vid, sid, off, iv.size)
+            if data is not None:
+                return data
+        return self._reconstruct_interval(ecv, sid, off, iv.size)
+
+    def _reconstruct_interval(self, ecv: EcVolume, missing_sid: int,
+                              offset: int, size: int) -> bytes:
+        """recoverOneRemoteEcShardInterval (store_ec.go:339): gather the
+        same byte range from >= k other shards and reconstruct."""
+        rows: dict[int, np.ndarray] = {}
+        for sid in range(geo.TOTAL_SHARDS):
+            if sid == missing_sid or len(rows) >= geo.DATA_SHARDS:
+                continue
+            shard = ecv.shards.get(sid)
+            if shard is not None:
+                rows[sid] = np.frombuffer(
+                    shard.read_at(offset, size), dtype=np.uint8)
+            elif self.remote_shard_reader is not None:
+                data = self.remote_shard_reader(ecv.vid, sid, offset, size)
+                if data is not None:
+                    rows[sid] = np.frombuffer(data, dtype=np.uint8)
+        if len(rows) < geo.DATA_SHARDS:
+            raise IOError(
+                f"cannot reconstruct shard {missing_sid} of volume "
+                f"{ecv.vid}: only {len(rows)} shards reachable")
+        rec = self._rs.reconstruct(rows, [missing_sid])
+        return rec[missing_sid].tobytes()
+
+    # -- heartbeat -------------------------------------------------------
+    def collect_heartbeat(self) -> dict:
+        """CollectHeartbeat (store.go:249): full volume + EC shard report
+        for the master."""
+        volumes = []
+        for loc in self.locations:
+            for vid, v in loc.volumes.items():
+                volumes.append({
+                    "id": vid,
+                    "collection": v.collection,
+                    "size": v.content_size(),
+                    "file_count": v.nm.file_count,
+                    "delete_count": v.nm.deleted_count,
+                    "deleted_bytes": v.nm.deleted_bytes,
+                    "read_only": v.read_only,
+                    "replica_placement":
+                        str(v.super_block.replica_placement),
+                    "ttl": list(v.super_block.ttl),
+                    "version": v.version,
+                })
+        ec_shards = [
+            {"id": vid, "collection": ecv.collection,
+             "shard_bits": ecv.shard_bits().bits}
+            for vid, ecv in self.ec_volumes.items()
+        ]
+        return {
+            "ip": self.ip, "port": self.port, "public_url": self.public_url,
+            "max_volume_count": sum(l.max_volumes for l in self.locations),
+            "volumes": volumes, "ec_shards": ec_shards,
+        }
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()
+        for ecv in self.ec_volumes.values():
+            ecv.close()

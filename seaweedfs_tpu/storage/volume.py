@@ -13,6 +13,7 @@ needle map is the dict-based storage.needle_map.NeedleMap.
 from __future__ import annotations
 
 import os
+import struct
 import time
 
 from . import backend as bk
@@ -80,6 +81,9 @@ class Volume:
             # torn previous write: realign (reference truncates on load)
             pad = t.NEEDLE_PADDING - offset % t.NEEDLE_PADDING
             raise IOError(f".dat misaligned by {pad} bytes")
+        # data reaches the OS before the index entry does — the recovery
+        # path assumes index entries never point past .dat EOF
+        self.dat.flush()
         stored = t.actual_to_offset(offset)
         self.nm.put(n.id, stored, n.size)
         idxmod.append_entry(self._idx_f, n.id, stored, n.size)
@@ -134,16 +138,75 @@ class Volume:
         return (self.nm.deleted_bytes / used) if used else 0.0
 
     def check_integrity(self) -> None:
-        """Truncate a torn tail so the .dat ends on a record boundary
-        (CheckAndFixVolumeDataIntegrity, volume_checking.go:17).
+        """Crash recovery on load (CheckAndFixVolumeDataIntegrity,
+        volume_checking.go:17):
 
-        Walks from the last indexed needle; if the bytes after it don't
-        form complete records, truncates to the last good boundary.
+        1. truncate a torn .dat tail to the 8-byte record grid;
+        2. drop index entries pointing at/past the .dat EOF (idx flushed
+           ahead of an unwritten data record);
+        3. spot-check the last live entry parses with the right id — a
+           mismatch means the whole index is stale (e.g. torn compact
+           commit) and is rebuilt by scanning the .dat.
         """
         size = self.dat.size()
         aligned = size - (size % t.NEEDLE_PADDING)
         if aligned != size:
             self.dat.truncate(aligned)
+            size = aligned
+        stale = []
+        last = None
+        for key, off, sz in self.nm.live_items():
+            end = t.offset_to_actual(off) + ndl.disk_size(sz, self.version)
+            if end > size:
+                stale.append(key)
+            elif last is None or off > last[1]:
+                last = (key, off, sz)
+        consistent = not stale
+        if consistent and last is None and \
+                size > self.super_block.block_size:
+            consistent = False  # data present but index knows nothing
+        if consistent and last is not None:
+            key, off, sz = last
+            try:
+                blob = self.dat.read_at(
+                    ndl.disk_size(sz, self.version), t.offset_to_actual(off))
+                n = ndl.Needle.from_bytes(blob, self.version)
+                if n.id != key or n.size != sz:
+                    consistent = False
+            except Exception:
+                consistent = False
+        if not consistent:
+            self.rebuild_index()
+
+    def rebuild_index(self) -> None:
+        """Offline .idx reconstruction by scanning the .dat — the
+        `weed fix` tool (command/fix.go:24-40) as an engine method, also
+        the recovery path for a torn compact commit."""
+        base = self.file_name()
+        self._idx_f.close()
+        self.nm = nmap.NeedleMap()
+        with open(base + ".idx", "wb") as idxf:
+            offset = self.super_block.block_size
+            size = self.dat.size()
+            while offset + t.NEEDLE_HEADER_SIZE <= size:
+                head = self.dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
+                _, nid, size_u32 = struct.unpack(">IQI", head)
+                nsize = t.u32_to_size(size_u32)
+                if nsize < 0:
+                    nsize = 0
+                disk = ndl.disk_size(nsize, self.version)
+                if offset + disk > size:
+                    self.dat.truncate(offset)
+                    break
+                stored = t.actual_to_offset(offset)
+                if nsize > 0:
+                    self.nm.put(nid, stored, nsize)
+                    idxmod.append_entry(idxf, nid, stored, nsize)
+                else:
+                    self.nm.delete(nid)
+                    idxmod.append_entry(idxf, nid, 0, t.TOMBSTONE_SIZE)
+                offset += disk
+        self._idx_f = open(base + ".idx", "ab")
 
     def compact(self) -> None:
         """Two-phase vacuum: write surviving live needles to .cpd/.cpx,

@@ -1,0 +1,88 @@
+"""Minimal Prometheus-style metrics registry.
+
+Equivalent role to /root/reference/weed/stats/metrics.go:31-140: counters,
+gauges and latency histograms exposed at /metrics in the text exposition
+format. Stdlib-only.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_lock = threading.Lock()
+_counters: dict[tuple[str, tuple], float] = defaultdict(float)
+_gauges: dict[tuple[str, tuple], float] = {}
+_histograms: dict[tuple[str, tuple], list[int]] = {}
+_HIST_BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10]
+
+
+def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+def counter_add(name: str, value: float = 1,
+                labels: dict | None = None) -> None:
+    with _lock:
+        _counters[_key(name, labels)] += value
+
+
+def gauge_set(name: str, value: float, labels: dict | None = None) -> None:
+    with _lock:
+        _gauges[_key(name, labels)] = value
+
+
+def histogram_observe(name: str, seconds: float,
+                      labels: dict | None = None) -> None:
+    key = _key(name, labels)
+    with _lock:
+        buckets = _histograms.get(key)
+        if buckets is None:
+            buckets = [0] * (len(_HIST_BUCKETS) + 1)
+            _histograms[key] = buckets
+        for i, ub in enumerate(_HIST_BUCKETS):
+            if seconds <= ub:
+                buckets[i] += 1
+                break
+        else:
+            buckets[-1] += 1
+        _counters[_key(name + "_sum", labels)] += seconds
+        _counters[_key(name + "_count", labels)] += 1
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def render() -> str:
+    lines = []
+    with _lock:
+        for (name, labels), v in sorted(_counters.items()):
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), v in sorted(_gauges.items()):
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), buckets in sorted(_histograms.items()):
+            cum = 0
+            for i, ub in enumerate(_HIST_BUCKETS):
+                cum += buckets[i]
+                lab = dict(labels)
+                lab["le"] = str(ub)
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(tuple(sorted(lab.items())))}"
+                    f" {cum}")
+            cum += buckets[-1]
+            lab = dict(labels)
+            lab["le"] = "+Inf"
+            lines.append(
+                f"{name}_bucket{_fmt_labels(tuple(sorted(lab.items())))}"
+                f" {cum}")
+    return "\n".join(lines) + "\n"
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _histograms.clear()

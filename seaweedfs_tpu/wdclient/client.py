@@ -1,0 +1,119 @@
+"""MasterClient: client-side volume-location cache.
+
+Equivalent of /root/reference/weed/wdclient/masterclient.go:20 +
+vid_map.go:37 — a vid -> locations map kept fresh by the master's
+KeepConnected push stream (here a WebSocket consumed on a background
+thread), with HTTP lookup fallback and master failover.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import requests
+
+
+class MasterClient:
+    def __init__(self, master_urls: list[str] | str,
+                 subscribe: bool = False):
+        if isinstance(master_urls, str):
+            master_urls = [master_urls]
+        self.masters = [u.rstrip("/") for u in master_urls]
+        self._current = 0
+        self._vid_cache: dict[int, list[dict]] = {}
+        self._cache_time: dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._ws_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        if subscribe:
+            self.start_subscription()
+
+    @property
+    def master_url(self) -> str:
+        return self.masters[self._current]
+
+    def _failover(self) -> None:
+        self._current = (self._current + 1) % len(self.masters)
+
+    # -- lookups --------------------------------------------------------
+    def lookup(self, vid: int, max_age: float = 600.0) -> list[dict]:
+        """-> [{'url':..., 'publicUrl':...}] for a volume id, cached."""
+        with self._lock:
+            locs = self._vid_cache.get(vid)
+            if locs is not None and \
+                    time.monotonic() - self._cache_time.get(vid, 0) < max_age:
+                return locs
+        for _ in range(len(self.masters)):
+            try:
+                resp = requests.get(f"{self.master_url}/dir/lookup",
+                                    params={"volumeId": str(vid)},
+                                    timeout=10)
+                if resp.status_code == 404:
+                    return []
+                resp.raise_for_status()
+                locs = resp.json().get("locations", [])
+                with self._lock:
+                    self._vid_cache[vid] = locs
+                    self._cache_time[vid] = time.monotonic()
+                return locs
+            except requests.RequestException:
+                self._failover()
+        return []
+
+    def lookup_file_id(self, fid: str) -> str:
+        """fid -> full url (GetLookupFileIdFunction equivalent)."""
+        vid = int(fid.split(",")[0])
+        locs = self.lookup(vid)
+        if not locs:
+            raise LookupError(f"volume {vid} has no locations")
+        return f"http://{locs[0]['url']}/{fid}"
+
+    def invalidate(self, vid: int) -> None:
+        with self._lock:
+            self._vid_cache.pop(vid, None)
+            self._cache_time.pop(vid, None)
+
+    # -- KeepConnected subscription -------------------------------------
+    def start_subscription(self) -> None:
+        if self._ws_thread is not None:
+            return
+        self._ws_thread = threading.Thread(target=self._ws_loop, daemon=True)
+        self._ws_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _ws_loop(self) -> None:
+        asyncio.run(self._ws_main())
+
+    async def _ws_main(self) -> None:
+        import aiohttp
+
+        while not self._stop.is_set():
+            url = self.master_url.replace("http", "ws", 1) + \
+                "/ws/keepconnected"
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.ws_connect(url, heartbeat=30) as ws:
+                        async for msg in ws:
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                break
+                            self._apply(json.loads(msg.data))
+                            if self._stop.is_set():
+                                break
+            except Exception:
+                self._failover()
+                await asyncio.sleep(1)
+
+    def _apply(self, msg: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if "snapshot" in msg:
+                self._vid_cache = {
+                    int(vid): locs for vid, locs in msg["snapshot"].items()}
+                self._cache_time = {v: now for v in self._vid_cache}
+            for vid, locs in msg.get("updates", {}).items():
+                self._vid_cache[int(vid)] = locs
+                self._cache_time[int(vid)] = now

@@ -1,0 +1,197 @@
+"""Cluster integration tests: master + volume servers in one process.
+
+Covers the reference's core call stacks (SURVEY.md section 3): assign ->
+upload -> direct read; replication fan-out; delete; vacuum; heartbeat
+registration and node death; lookup/redirect.
+"""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.wdclient.client import MasterClient
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("cluster")),
+                n_volume_servers=2, volume_size_limit=8 << 20)
+    yield c
+    c.stop()
+
+
+class TestWriteReadDelete:
+    def test_assign_upload_read(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        assert "," in a.fid
+        verbs.upload(a, b"hello object store", name="greet.txt",
+                     mime="text/plain")
+        data = verbs.download(f"http://{a.url}/{a.fid}")
+        assert data == b"hello object store"
+
+    def test_upload_via_helper_and_headers(self, cluster):
+        fid = verbs.upload_data(cluster.master_url, b"x" * 1000,
+                                name="x.bin")
+        mc = MasterClient(cluster.master_url)
+        url = mc.lookup_file_id(fid)
+        resp = requests.get(url)
+        assert resp.status_code == 200
+        assert resp.content == b"x" * 1000
+        assert resp.headers["Etag"]
+
+    def test_range_read(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, bytes(range(200)))
+        resp = requests.get(f"http://{a.url}/{a.fid}",
+                            headers={"Range": "bytes=10-19"})
+        assert resp.status_code == 206
+        assert resp.content == bytes(range(10, 20))
+
+    def test_delete_then_404(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, b"to be deleted")
+        verbs.delete(f"http://{a.url}/{a.fid}")
+        resp = requests.get(f"http://{a.url}/{a.fid}")
+        assert resp.status_code == 404
+
+    def test_wrong_cookie_forbidden(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, b"cookie test")
+        vid_key = a.fid.rsplit(",", 1)[0] if False else a.fid
+        # flip last cookie hex digit
+        bad = a.fid[:-1] + ("0" if a.fid[-1] != "0" else "1")
+        resp = requests.get(f"http://{a.url}/{bad}")
+        assert resp.status_code in (403, 404)
+
+    def test_bad_fid_400(self, cluster):
+        resp = requests.get(f"{cluster.volume_url(0)}/abc,zz")
+        assert resp.status_code in (400, 404)
+
+
+class TestReplication:
+    def test_replicated_write_lands_on_both(self, cluster):
+        a = verbs.assign(cluster.master_url, replication="001")
+        verbs.upload(a, b"replicated payload")
+        vid = int(a.fid.split(",")[0])
+        nodes = cluster.master.topo.lookup(vid)
+        assert len(nodes) == 2
+        # read directly from each server without redirect
+        for node in nodes:
+            resp = requests.get(f"http://{node.url}/{a.fid}")
+            assert resp.status_code == 200, node.url
+            assert resp.content == b"replicated payload"
+
+    def test_replicated_delete(self, cluster):
+        a = verbs.assign(cluster.master_url, replication="001")
+        verbs.upload(a, b"replicated delete")
+        vid = int(a.fid.split(",")[0])
+        nodes = cluster.master.topo.lookup(vid)
+        verbs.delete(f"http://{nodes[0].url}/{a.fid}")
+        for node in nodes:
+            assert requests.get(
+                f"http://{node.url}/{a.fid}").status_code == 404
+
+
+class TestMasterBehavior:
+    def test_lookup(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        vid = a.fid.split(",")[0]
+        resp = requests.get(f"{cluster.master_url}/dir/lookup",
+                            params={"volumeId": vid})
+        locs = resp.json()["locations"]
+        assert any(l["url"] == a.url for l in locs)
+
+    def test_lookup_missing_volume(self, cluster):
+        resp = requests.get(f"{cluster.master_url}/dir/lookup",
+                            params={"volumeId": "99999"})
+        assert resp.status_code == 404
+
+    def test_cluster_status(self, cluster):
+        body = requests.get(f"{cluster.master_url}/cluster/status").json()
+        assert body["IsLeader"] is True
+        n_nodes = sum(len(r["nodes"])
+                      for dc in body["Topology"]["datacenters"]
+                      for r in dc["racks"])
+        assert n_nodes == 2
+
+    def test_grow(self, cluster):
+        before = cluster.master.topo.max_volume_id
+        resp = requests.get(f"{cluster.master_url}/vol/grow",
+                            params={"count": "2"})
+        assert resp.status_code == 200
+        assert cluster.master.topo.max_volume_id >= before + 2
+
+    def test_collection_isolation(self, cluster):
+        a1 = verbs.assign(cluster.master_url, collection="pics")
+        a2 = verbs.assign(cluster.master_url)
+        assert a1.fid.split(",")[0] != a2.fid.split(",")[0]
+
+    def test_metrics_endpoint(self, cluster):
+        resp = requests.get(f"{cluster.master_url}/metrics")
+        assert resp.status_code == 200
+
+
+class TestVacuum:
+    def test_vacuum_compact_via_admin(self, cluster):
+        a = verbs.assign(cluster.master_url, collection="vac")
+        verbs.upload(a, b"a" * 10000)
+        vid = int(a.fid.split(",")[0])
+        # write + delete more needles on same volume to create garbage
+        server_i = next(i for i, s in enumerate(cluster.stores)
+                        if s.has_volume(vid))
+        for j in range(5):
+            a2 = verbs.assign(cluster.master_url, collection="vac")
+            if int(a2.fid.split(",")[0]) == vid:
+                verbs.upload(a2, b"b" * 20000)
+                verbs.delete(f"http://{a2.url}/{a2.fid}")
+        check = cluster.admin(server_i, "/admin/vacuum_check",
+                              {"volume": vid})
+        ratio = check["garbage_ratio"]
+        cluster.admin(server_i, "/admin/vacuum_compact", {"volume": vid})
+        check2 = cluster.admin(server_i, "/admin/vacuum_check",
+                               {"volume": vid})
+        assert check2["garbage_ratio"] <= ratio
+        # original still readable after compaction
+        assert verbs.download(f"http://{a.url}/{a.fid}") == b"a" * 10000
+
+
+class TestKeepConnected:
+    def test_client_receives_updates(self, cluster):
+        mc = MasterClient(cluster.master_url, subscribe=True)
+        try:
+            a = verbs.assign(cluster.master_url, collection="kc")
+            vid = int(a.fid.split(",")[0])
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with mc._lock:
+                    if vid in mc._vid_cache:
+                        break
+                time.sleep(0.05)
+            with mc._lock:
+                assert vid in mc._vid_cache
+        finally:
+            mc.stop()
+
+
+class TestNodeDeath:
+    def test_unregister_on_disconnect(self, tmp_path):
+        c = Cluster(str(tmp_path), n_volume_servers=2,
+                    volume_size_limit=8 << 20, pulse_seconds=0.2)
+        try:
+            a = verbs.assign(c.master_url)
+            verbs.upload(a, b"data before death")
+            vid = int(a.fid.split(",")[0])
+            owner = next(i for i, s in enumerate(c.stores)
+                         if s.has_volume(vid))
+            c.volume_threads[owner].stop()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(c.master.topo.nodes) == 1:
+                    break
+                time.sleep(0.1)
+            assert len(c.master.topo.nodes) == 1
+            assert c.master.topo.lookup(vid) == []
+        finally:
+            c.stop()

@@ -1,0 +1,173 @@
+"""End-to-end EC workflow over a live cluster: the reference's
+ec.encode/ec.rebuild/ec.decode shell flows (SURVEY.md section 3.5) plus
+degraded reads through on-the-fly reconstruction (store_ec.go:339)."""
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.ec import geometry as geo
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell import commands_ec, commands_volume
+from seaweedfs_tpu.shell.env import CommandEnv, ShellError
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("ec_cluster")),
+                n_volume_servers=3, volume_size_limit=4 << 20,
+                max_volumes=40)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    e = CommandEnv(cluster.master_url)
+    e.acquire_lock()
+    return e
+
+
+@pytest.fixture()
+def sealed_volume(cluster):
+    """Upload objects into a fresh collection; return (vid, {fid: data})."""
+    import secrets
+
+    col = "seal" + secrets.token_hex(3)
+    rng = np.random.default_rng(0)
+    payloads = {}
+    a0 = verbs.assign(cluster.master_url, collection=col)
+    vid = int(a0.fid.split(",")[0])
+    verbs.upload(a0, rng.bytes(1000))
+    payloads[a0.fid] = None  # replaced below
+    payloads = {}
+    for i in range(30):
+        a = verbs.assign(cluster.master_url, collection=col)
+        if int(a.fid.split(",")[0]) != vid:
+            continue
+        data = rng.bytes(int(rng.integers(100, 50000)))
+        verbs.upload(a, data)
+        payloads[a.fid] = data
+    return vid, payloads
+
+
+class TestEcEncode:
+    def test_encode_spread_read(self, cluster, env, sealed_volume):
+        vid, payloads = sealed_volume
+        placement = commands_ec.ec_encode(env, vid)
+        assert len(placement) == geo.TOTAL_SHARDS
+        # original volume is gone from all stores
+        assert all(not s.has_volume(vid) for s in cluster.stores)
+        # shards spread across all 3 servers
+        servers = set(placement.values())
+        assert len(servers) == 3
+        # every object readable through the EC read path
+        for fid, data in payloads.items():
+            holders = env.ec_shard_locations(vid)
+            any_holder = holders[0][0]
+            resp = requests.get(f"http://{any_holder}/{fid}")
+            assert resp.status_code == 200, fid
+            assert resp.content == data
+
+    def test_degraded_read_after_losing_parity_and_data(
+            self, cluster, env, sealed_volume):
+        vid, payloads = sealed_volume
+        commands_ec.ec_encode(env, vid)
+        locs = env.ec_shard_locations(vid)
+        # delete 2 data shards + 2 parity shards (max tolerable)
+        for sid in (1, 4, 10, 13):
+            for url in locs.get(sid, []):
+                env.vs_post(url, "/admin/ec/delete",
+                            {"volume": vid, "shard_ids": [sid]})
+        locs2 = env.ec_shard_locations(vid)
+        remaining = {sid for sid, urls in locs2.items() if urls}
+        assert len(remaining) == 10
+        fid, data = next(iter(payloads.items()))
+        holder = locs2[sorted(remaining)[0]][0]
+        resp = requests.get(f"http://{holder}/{fid}")
+        assert resp.status_code == 200
+        assert resp.content == data
+
+    def test_rebuild_restores_full_set(self, cluster, env, sealed_volume):
+        vid, payloads = sealed_volume
+        commands_ec.ec_encode(env, vid)
+        locs = env.ec_shard_locations(vid)
+        for sid in (0, 7, 12):
+            for url in locs.get(sid, []):
+                env.vs_post(url, "/admin/ec/delete",
+                            {"volume": vid, "shard_ids": [sid]})
+        result = commands_ec.ec_rebuild(env, vid)
+        assert sorted(result["rebuilt"]) == [0, 7, 12]
+        locs2 = env.ec_shard_locations(vid)
+        assert sum(1 for urls in locs2.values() if urls) == 14
+        # reads still fine
+        for fid, data in list(payloads.items())[:3]:
+            holder = locs2[0][0]
+            resp = requests.get(f"http://{holder}/{fid}")
+            assert resp.content == data
+
+    def test_decode_back_to_volume(self, cluster, env, sealed_volume):
+        vid, payloads = sealed_volume
+        commands_ec.ec_encode(env, vid)
+        out = commands_ec.ec_decode(env, vid)
+        server = out["server"]
+        # normal volume reads again
+        for fid, data in list(payloads.items())[:3]:
+            resp = requests.get(f"http://{server}/{fid}")
+            assert resp.status_code == 200
+            assert resp.content == data
+
+    def test_encode_requires_lock(self, cluster, sealed_volume):
+        vid, _ = sealed_volume
+        env2 = CommandEnv(cluster.master_url)
+        with pytest.raises(ShellError, match="lock"):
+            commands_ec.ec_encode(env2, vid)
+
+    def test_encode_missing_volume(self, env):
+        with pytest.raises(ShellError, match="not found"):
+            commands_ec.ec_encode(env, 424242)
+
+
+class TestEcBalance:
+    def test_balance_evens_counts(self, cluster, env, sealed_volume):
+        vid, _ = sealed_volume
+        commands_ec.ec_encode(env, vid)
+        moves = commands_ec.ec_balance(env)
+        counts = []
+        for n in env.data_nodes():
+            counts.append(sum(bin(b).count("1")
+                              for b in n["ec_volumes"].values()))
+        assert max(counts) - min(counts) <= geo.TOTAL_SHARDS // 3 + 2
+
+
+class TestVolumeMaintenance:
+    def test_volume_list_and_cluster_check(self, cluster, env):
+        check = commands_volume.cluster_check(env)
+        assert check["nodes"] == 3
+
+    def test_fix_replication(self, cluster, env):
+        a = verbs.assign(cluster.master_url, collection="fixrep",
+                         replication="001")
+        verbs.upload(a, b"fix me")
+        vid = int(a.fid.split(",")[0])
+        # drop one replica
+        locs = env.volume_locations(vid)
+        assert len(locs) == 2
+        env.vs_post(locs[1], "/admin/delete_volume", {"volume": vid})
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                len(env.volume_locations(vid)) != 1:
+            time.sleep(0.1)
+        fixes = commands_volume.volume_fix_replication(env)
+        assert any(f["volume"] == vid for f in fixes)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                len(env.volume_locations(vid)) != 2:
+            time.sleep(0.1)
+        locs2 = env.volume_locations(vid)
+        assert len(locs2) == 2
+        for url in locs2:
+            assert requests.get(
+                f"http://{url}/{a.fid}").content == b"fix me"
