@@ -1,0 +1,29 @@
+"""Filer: directory namespace + chunked files over volume storage.
+
+TPU-native re-expression of /root/reference/weed/filer/ — see
+entry.py (Entry/FileChunk), filechunks.py (visible-interval algebra),
+filerstore.py (pluggable metadata stores), event_log.py (metadata
+subscription log), filer.py (the Filer), stream.py (chunked reads).
+"""
+from .entry import DIR_MODE_FLAG, Entry, FileChunk, total_size
+from .event_log import MetaEventLog, event_kind
+from .filechunks import (ChunkView, VisibleInterval, compact_file_chunks,
+                         etag_chunks, maybe_manifestize,
+                         non_overlapping_visible_intervals,
+                         resolve_chunk_manifest, view_from_chunks)
+from .filer import Filer, norm_path
+from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,
+                         make_store, register_store)
+from .stream import ChunkStreamReader, read_fid, stream_content
+
+__all__ = [
+    "DIR_MODE_FLAG", "Entry", "FileChunk", "total_size",
+    "MetaEventLog", "event_kind",
+    "ChunkView", "VisibleInterval", "compact_file_chunks", "etag_chunks",
+    "maybe_manifestize", "non_overlapping_visible_intervals",
+    "resolve_chunk_manifest", "view_from_chunks",
+    "Filer", "norm_path",
+    "STORES", "FilerStore", "MemoryStore", "SqliteStore", "make_store",
+    "register_store",
+    "ChunkStreamReader", "read_fid", "stream_content",
+]

@@ -1,0 +1,189 @@
+"""The Filer: a directory namespace over a pluggable metadata store.
+
+Equivalent of /root/reference/weed/filer/filer.go:36 (Filer) —
+path -> Entry CRUD with parent-directory auto-creation (CreateEntry
+filer.go:197), TTL expiry on read/list, recursive delete that hands the
+dead chunks back for volume-server deletion
+(filer_delete_entry.go), and rename via move.  Every mutation is
+appended to the metadata event log (filer_notify.go).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from .entry import DIR_MODE_FLAG, Entry, FileChunk
+from .event_log import MetaEventLog
+from .filerstore import FilerStore, make_store
+
+LIST_BATCH = 1024
+
+
+class DirectoryNotEmptyError(OSError):
+    pass
+
+
+def norm_path(path: str) -> str:
+    out = "/" + "/".join(p for p in path.split("/") if p and p != ".")
+    return out
+
+
+class Filer:
+    def __init__(self, store: FilerStore | str = "memory",
+                 on_delete_chunks: Callable[[list[FileChunk]], None]
+                 | None = None, signature: int = 0, **store_kwargs):
+        self.store = (store if isinstance(store, FilerStore)
+                      else make_store(store, **store_kwargs))
+        self.meta_log = MetaEventLog(signature=signature)
+        self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
+
+    # -- reads ----------------------------------------------------------
+    def find_entry(self, path: str) -> Entry | None:
+        path = norm_path(path)
+        if path == "/":
+            return Entry(full_path="/", mode=0o775 | DIR_MODE_FLAG)
+        e = self.store.find_entry(path)
+        if e is not None and e.is_expired():
+            self.store.delete_entry(path)
+            return None
+        return e
+
+    def list_entries(self, dirpath: str, start_from: str = "",
+                     inclusive: bool = False, limit: int = LIST_BATCH,
+                     prefix: str = "") -> list[Entry]:
+        dirpath = norm_path(dirpath)
+        out, now = [], time.time()
+        batch = self.store.list_directory_entries(
+            dirpath, start_from, inclusive, limit, prefix)
+        for e in batch:
+            if e.is_expired(now):
+                self.store.delete_entry(e.full_path)
+                continue
+            out.append(e)
+        return out
+
+    def iter_tree(self, dirpath: str):
+        """Depth-first generator over a subtree, expired entries
+        skipped. Pagination is driven by the RAW store batch size —
+        list_entries filters expired entries post-page, so its result
+        length cannot signal end-of-directory."""
+        dirpath = norm_path(dirpath)
+        start, now = "", time.time()
+        while True:
+            batch = self.store.list_directory_entries(
+                dirpath, start_from=start, limit=LIST_BATCH)
+            for e in batch:
+                if e.is_expired(now):
+                    continue
+                yield e
+                if e.is_directory:
+                    yield from self.iter_tree(e.full_path)
+            if len(batch) < LIST_BATCH:
+                return
+            start = batch[-1].name
+
+    # -- writes ---------------------------------------------------------
+    def create_entry(self, entry: Entry,
+                     signatures: list[int] | None = None) -> Entry:
+        entry.full_path = norm_path(entry.full_path)
+        if entry.full_path == "/":
+            return entry
+        self._ensure_parents(entry.full_path)
+        old = self.store.find_entry(entry.full_path)
+        if old is not None and old.is_directory and not entry.is_directory:
+            raise IsADirectoryError(entry.full_path)
+        self.store.insert_entry(entry)
+        d, _ = entry.dir_and_name
+        self.meta_log.append(d, old, entry, signatures)
+        return entry
+
+    def update_entry(self, entry: Entry,
+                     signatures: list[int] | None = None) -> Entry:
+        return self.create_entry(entry, signatures)
+
+    def mkdir(self, path: str, mode: int = 0o775) -> Entry:
+        path = norm_path(path)
+        e = self.find_entry(path)
+        if e is not None:
+            if not e.is_directory:
+                raise NotADirectoryError(path)
+            return e
+        return self.create_entry(
+            Entry(full_path=path, mode=mode | DIR_MODE_FLAG))
+
+    def _ensure_parents(self, path: str) -> None:
+        parts = path.strip("/").split("/")[:-1]
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            if self.store.find_entry(cur) is None:
+                ent = Entry(full_path=cur, mode=0o775 | DIR_MODE_FLAG)
+                self.store.insert_entry(ent)
+                d, _ = ent.dir_and_name
+                self.meta_log.append(d, None, ent)
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     signatures: list[int] | None = None) -> None:
+        path = norm_path(path)
+        e = self.find_entry(path)
+        if e is None:
+            return
+        dead_chunks: list[FileChunk] = []
+        if e.is_directory:
+            children = self.list_entries(path, limit=1)
+            if children and not recursive:
+                raise DirectoryNotEmptyError(
+                    f"directory not empty: {path}")
+            for sub in self.iter_tree(path):
+                if not sub.is_directory and not sub.hard_link_id:
+                    dead_chunks.extend(sub.chunks)
+                d, _ = sub.dir_and_name
+                self.meta_log.append(d, sub, None, signatures)
+            self.store.delete_folder_children(path)
+        elif not e.hard_link_id:
+            dead_chunks.extend(e.chunks)
+        self.store.delete_entry(path)
+        d, _ = e.dir_and_name
+        self.meta_log.append(d, e, None, signatures)
+        if dead_chunks:
+            self.on_delete_chunks(dead_chunks)
+
+    def rename(self, old_path: str, new_path: str,
+               signatures: list[int] | None = None) -> None:
+        """Move an entry (recursively for directories) — the metadata-
+        only streaming rename of filer_grpc_server_rename.go; chunks
+        stay where they are."""
+        old_path, new_path = norm_path(old_path), norm_path(new_path)
+        e = self.find_entry(old_path)
+        if e is None:
+            raise FileNotFoundError(old_path)
+        if self.find_entry(new_path) is not None:
+            raise FileExistsError(new_path)
+        self._move(e, new_path, signatures)
+
+    def _move(self, e: Entry, new_path: str,
+              signatures: list[int] | None) -> None:
+        old_path = e.full_path
+        children = []
+        if e.is_directory:
+            children = list(self.iter_tree(old_path))
+        moved = Entry.from_dict(e.to_dict())
+        moved.full_path = new_path
+        self.create_entry(moved, signatures)
+        for sub in children:
+            rel = sub.full_path[len(old_path):]
+            sub_new = Entry.from_dict(sub.to_dict())
+            sub_new.full_path = new_path + rel
+            self.create_entry(sub_new, signatures)
+        # delete old names only (not data)
+        if e.is_directory:
+            for sub in children:
+                d, _ = sub.dir_and_name
+                self.meta_log.append(d, sub, None, signatures)
+            self.store.delete_folder_children(old_path)
+        self.store.delete_entry(old_path)
+        d, _ = e.dir_and_name
+        self.meta_log.append(d, e, None, signatures)
+
+    def close(self) -> None:
+        self.store.close()

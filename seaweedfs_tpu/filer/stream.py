@@ -1,0 +1,89 @@
+"""Assemble file bytes from chunk views via volume-server reads.
+
+Equivalent of /root/reference/weed/filer/stream.go:69-144 — turn an
+entry's chunk list into ranged HTTP reads against volume servers,
+with manifest resolution and a small per-reader chunk cache
+(reader_cache.go's role).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import requests
+
+from .entry import FileChunk
+from .filechunks import resolve_chunk_manifest, view_from_chunks
+
+LookupFn = Callable[[str], str]  # fid -> full http url
+
+
+def read_fid(lookup: LookupFn, fid: str, offset: int = 0,
+             size: int | None = None) -> bytes:
+    url = lookup(fid)
+    headers = {}
+    if size is not None:
+        headers["Range"] = f"bytes={offset}-{offset + size - 1}"
+    elif offset:
+        headers["Range"] = f"bytes={offset}-"
+    resp = requests.get(url, headers=headers, timeout=60)
+    if resp.status_code not in (200, 206):
+        raise IOError(f"read {fid}: http {resp.status_code}")
+    return resp.content
+
+
+class ChunkStreamReader:
+    """Random-access reads over an entry's chunks, caching whole chunks
+    (weed/filer/reader_cache.go keeps recently-read chunks in memory for
+    sequential readers)."""
+
+    def __init__(self, lookup: LookupFn, chunks: list[FileChunk],
+                 cache_chunks: int = 8):
+        self.lookup = lookup
+        self.chunks = resolve_chunk_manifest(
+            lambda fid: read_fid(lookup, fid), chunks)
+        self._cache: dict[str, bytes] = {}
+        self._cache_order: list[str] = []
+        self._cache_chunks = cache_chunks
+
+    @property
+    def size(self) -> int:
+        return max((c.offset + c.size for c in self.chunks), default=0)
+
+    def _chunk_bytes(self, fid: str) -> bytes:
+        if fid in self._cache:
+            return self._cache[fid]
+        data = read_fid(self.lookup, fid)
+        self._cache[fid] = data
+        self._cache_order.append(fid)
+        if len(self._cache_order) > self._cache_chunks:
+            evict = self._cache_order.pop(0)
+            self._cache.pop(evict, None)
+        return data
+
+    def read(self, offset: int = 0, size: int | None = None) -> bytes:
+        if size is None:
+            size = self.size - offset
+        size = max(0, min(size, self.size - offset))
+        if size == 0:
+            return b""
+        chunk_sizes = {c.fid: c.size for c in self.chunks}
+        out = bytearray(size)  # sparse gaps read as zeros
+        for v in view_from_chunks(self.chunks, offset, size):
+            if v.fid in self._cache or \
+                    v.view_size >= chunk_sizes.get(v.fid, 0):
+                data = self._chunk_bytes(v.fid)
+                piece = data[v.offset_in_chunk:
+                             v.offset_in_chunk + v.view_size]
+            else:
+                # partial view of an uncached chunk: ranged read, no
+                # whole-chunk amplification
+                piece = read_fid(self.lookup, v.fid, v.offset_in_chunk,
+                                 v.view_size)
+            at = v.view_offset - offset
+            out[at:at + len(piece)] = piece
+        return bytes(out)
+
+
+def stream_content(lookup: LookupFn, chunks: list[FileChunk],
+                   offset: int = 0, size: int | None = None) -> bytes:
+    return ChunkStreamReader(lookup, chunks).read(offset, size)

@@ -15,6 +15,7 @@ import requests
 
 from ..rpc.http import ServerThread
 from ..storage.store import Store
+from .filer_server import FilerServer
 from .master_server import MasterServer
 from .volume_server import VolumeServer
 
@@ -27,7 +28,9 @@ class Cluster:
                  pulse_seconds: float = 0.4,
                  ec_backend: str = "numpy",
                  jwt_secret: str = "",
-                 topology: list[tuple[str, str]] | None = None):
+                 topology: list[tuple[str, str]] | None = None,
+                 with_filer: bool = False,
+                 filer_store: str = "memory"):
         """topology: optional per-server (data_center, rack) labels."""
         self.base_dir = base_dir
         self.master = MasterServer(
@@ -59,11 +62,25 @@ class Cluster:
             self.volume_servers.append(vs)
             self.volume_threads.append(thread)
             self.stores.append(store)
+        self.filer: FilerServer | None = None
+        self.filer_thread: ServerThread | None = None
+        if with_filer:
+            store_path = os.path.join(base_dir, "filer.db") \
+                if filer_store == "sqlite" else ":memory:"
+            self.filer = FilerServer(self.master_url, store=filer_store,
+                                     store_path=store_path)
+            self.filer_thread = ServerThread(self.filer.app).start()
         self.wait_for_nodes(n_volume_servers)
 
     @property
     def master_url(self) -> str:
         return self.master_thread.url
+
+    @property
+    def filer_url(self) -> str:
+        if self.filer_thread is None:
+            raise RuntimeError("cluster started without a filer")
+        return self.filer_thread.url
 
     def volume_url(self, i: int) -> str:
         return self.volume_threads[i].url
@@ -97,6 +114,8 @@ class Cluster:
         return out
 
     def stop(self) -> None:
+        if self.filer_thread is not None:
+            self.filer_thread.stop()
         for t in self.volume_threads:
             t.stop()
         self.master_thread.stop()

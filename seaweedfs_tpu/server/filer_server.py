@@ -1,0 +1,334 @@
+"""Filer server: namespace HTTP API + metadata subscription stream.
+
+Equivalents: /root/reference/weed/server/filer_server_handlers_write_autochunk.go:25-130
+(upload auto-chunking), filer_server_handlers_read.go (ranged streaming
+reads), _read_dir.go (listing), filer_grpc_server_sub_meta.go (metadata
+subscription — here a WebSocket), filer_grpc_server_kv.go (KV), and the
+rename rpc (filer_grpc_server_rename.go) via `mv.from`.
+
+Uploads split the body into chunks: each chunk is assigned a fid at the
+master and posted directly to a volume server, exactly the reference's
+assign+upload fan-out (§3.4 of SURVEY.md); the filer never stores file
+bytes itself.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import mimetypes
+import time
+
+import aiohttp
+from aiohttp import web
+
+from ..filer import (Entry, FileChunk, Filer, etag_chunks,
+                     maybe_manifestize, norm_path, read_fid,
+                     resolve_chunk_manifest, stream_content)
+from ..filer.filer import DirectoryNotEmptyError
+from ..operation import verbs
+from ..utils import metrics
+from ..wdclient.client import MasterClient
+
+DEFAULT_CHUNK_SIZE = 8 << 20  # autochunk default (`-maxMB=8` upstream)
+
+
+class FilerServer:
+    def __init__(self, master_url: str, store: str = "memory",
+                 store_path: str = ":memory:",
+                 collection: str = "", replication: str = "",
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 signature: int = 0):
+        self.master_url = master_url.rstrip("/")
+        self.masters = MasterClient(self.master_url)
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        self.filer = Filer(store, on_delete_chunks=self._delete_chunks,
+                           signature=signature, path=store_path)
+        self.app = self._build_app()
+
+    # -- plumbing -------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        @web.middleware
+        async def error_mw(request, handler):
+            try:
+                return await handler(request)
+            except web.HTTPException:
+                raise
+            except FileNotFoundError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except (FileExistsError, IsADirectoryError,
+                    NotADirectoryError, DirectoryNotEmptyError) as e:
+                return web.json_response({"error": str(e)}, status=409)
+            except OSError as e:  # failed volume reads etc. are 5xx
+                return web.json_response({"error": str(e)}, status=502)
+            except (json.JSONDecodeError, KeyError, ValueError,
+                    TypeError) as e:
+                return web.json_response(
+                    {"error": f"bad request: {e}"}, status=400)
+
+        app = web.Application(client_max_size=1 << 40,
+                              middlewares=[error_mw])
+        app.add_routes([
+            web.get("/status", self.handle_status),
+            web.get("/metrics", self.handle_metrics),
+            web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
+            web.get("/kv/{key:.*}", self.handle_kv_get),
+            web.put("/kv/{key:.*}", self.handle_kv_put),
+            web.delete("/kv/{key:.*}", self.handle_kv_delete),
+            web.get("/{path:.*}", self.handle_get),  # also serves HEAD
+            web.post("/{path:.*}", self.handle_put),
+            web.put("/{path:.*}", self.handle_put),
+            web.delete("/{path:.*}", self.handle_delete),
+        ])
+        return app
+
+    def _lookup_fid(self, fid: str) -> str:
+        return self.masters.lookup_file_id(fid)
+
+    def _delete_chunks(self, chunks: list[FileChunk]) -> None:
+        # manifest chunks must be expanded first or the data chunks
+        # they reference would be orphaned forever
+        try:
+            data_chunks = resolve_chunk_manifest(
+                lambda fid: read_fid(self._lookup_fid, fid), chunks)
+        except Exception:
+            data_chunks = []
+        manifests = [c for c in chunks if c.is_chunk_manifest]
+        for c in data_chunks + manifests:
+            try:
+                verbs.delete(self.masters.lookup_file_id(c.fid))
+            except Exception:
+                pass  # orphans are reclaimed by volume.fsck / vacuum
+
+    # -- read path ------------------------------------------------------
+    async def handle_get(self, req: web.Request) -> web.StreamResponse:
+        path = norm_path("/" + req.match_info["path"])
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return web.json_response(
+                {"error": f"not found: {path}"}, status=404)
+        if entry.is_directory:
+            return await self._list_dir(req, path)
+        if "meta" in req.query:
+            return web.json_response(entry.to_dict())
+        size = entry.file_size
+        etag = entry.md5 or etag_chunks(entry.chunks)
+        mime = (entry.mime or mimetypes.guess_type(path)[0]
+                or "application/octet-stream")
+        headers = {"ETag": f'"{etag}"', "Accept-Ranges": "bytes",
+                   "Last-Modified": time.strftime(
+                       "%a, %d %b %Y %H:%M:%S GMT",
+                       time.gmtime(entry.mtime))}
+        if req.headers.get("If-None-Match") == f'"{etag}"':
+            return web.Response(status=304, headers=headers)
+        offset, length, status = 0, size, 200
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            start_s, _, end_s = rng[6:].partition("-")
+            if start_s:
+                offset = int(start_s)
+                end = int(end_s) if end_s else size - 1
+            else:  # suffix range: last N bytes
+                offset = max(0, size - int(end_s))
+                end = size - 1
+            end = min(end, size - 1)
+            if offset > end:
+                return web.Response(
+                    status=416, headers={"Content-Range": f"bytes */{size}"})
+            length = end - offset + 1
+            status = 206
+            headers["Content-Range"] = f"bytes {offset}-{end}/{size}"
+        if req.method == "HEAD":
+            headers["Content-Length"] = str(length)
+            return web.Response(status=status, headers=headers,
+                                content_type=mime)
+        data = await asyncio.to_thread(
+            stream_content, self._lookup_fid, entry.chunks, offset, length)
+        metrics.counter_add("filer_read_bytes", len(data))
+        return web.Response(body=data, status=status, headers=headers,
+                            content_type=mime)
+
+    async def _list_dir(self, req: web.Request, path: str) -> web.Response:
+        limit = int(req.query.get("limit", "1024"))
+        last = req.query.get("lastFileName", "")
+        prefix = req.query.get("prefix", "")
+        entries = self.filer.list_entries(
+            path, start_from=last, limit=limit, prefix=prefix)
+        return web.json_response({
+            "path": path,
+            "entries": [e.to_dict() for e in entries],
+            "lastFileName": entries[-1].name if entries else "",
+            "shouldDisplayLoadMore": len(entries) == limit,
+        })
+
+    # -- write path -----------------------------------------------------
+    async def handle_put(self, req: web.Request) -> web.Response:
+        raw_path = "/" + req.match_info["path"]
+        path = norm_path(raw_path)
+        if "mv.from" in req.query:  # rename verb, reference-compatible
+            self.filer.rename(req.query["mv.from"], path)
+            return web.json_response({"path": path})
+        if "mkdir" in req.query or (raw_path.endswith("/")
+                                    and req.content_length in (None, 0)):
+            e = self.filer.mkdir(path)
+            return web.json_response(e.to_dict(), status=201)
+
+        collection = req.query.get("collection", self.collection)
+        replication = req.query.get("replication", self.replication)
+        ttl = req.query.get("ttl", "")
+        chunk_size = int(req.query.get("maxMB", "0")) << 20 or \
+            self.chunk_size
+
+        content_type = req.content_type or ""
+        reader = None
+        filename = path.rsplit("/", 1)[-1]
+        mime = ""
+        if content_type.startswith("multipart/"):
+            mp = await req.multipart()
+            part = await mp.next()
+            while part is not None and part.name != "file":
+                part = await mp.next()
+            if part is None:
+                raise ValueError("multipart body without a 'file' part")
+            filename = part.filename or filename
+            mime = part.headers.get("Content-Type", "")
+            reader = part
+        else:
+            mime = content_type
+            reader = req.content
+
+        chunks, md5_all, total = [], hashlib.md5(), 0
+        offset = 0
+        while True:
+            piece = await _read_exactly(reader, chunk_size)
+            if not piece:
+                break
+            fid, etag = await asyncio.to_thread(
+                self._upload_chunk, piece, filename, collection,
+                replication, ttl)
+            md5_all.update(piece)
+            chunks.append(FileChunk(fid=fid, offset=offset,
+                                    size=len(piece),
+                                    mtime_ns=time.time_ns(), etag=etag))
+            offset += len(piece)
+            total += len(piece)
+            if len(piece) < chunk_size:
+                break
+
+        chunks = await asyncio.to_thread(
+            maybe_manifestize, lambda b: self._upload_chunk(
+                b, filename, collection, replication, ttl)[0], chunks)
+
+        old = self.filer.find_entry(path)
+        entry = Entry(full_path=path, mime=mime,
+                      ttl_sec=_ttl_seconds(ttl),
+                      md5=md5_all.hexdigest(), collection=collection,
+                      replication=replication, chunks=chunks)
+        self.filer.create_entry(entry)
+        if old is not None and not old.is_directory:
+            dead = [c for c in old.chunks
+                    if c.fid not in {n.fid for n in chunks}]
+            await asyncio.to_thread(self._delete_chunks, dead)
+        metrics.counter_add("filer_write_bytes", total)
+        return web.json_response(
+            {"name": filename, "size": total,
+             "etag": entry.md5}, status=201)
+
+    def _upload_chunk(self, data: bytes, name: str, collection: str,
+                      replication: str, ttl: str) -> tuple[str, str]:
+        a = verbs.assign(self.master_url, collection=collection,
+                         replication=replication, ttl=ttl)
+        verbs.upload(a, data, name=name)
+        return a.fid, hashlib.md5(data).hexdigest()
+
+    async def handle_delete(self, req: web.Request) -> web.Response:
+        path = norm_path("/" + req.match_info["path"])
+        recursive = req.query.get("recursive", "") in ("true", "1")
+        self.filer.delete_entry(path, recursive=recursive)
+        return web.json_response({}, status=204)
+
+    # -- KV -------------------------------------------------------------
+    async def handle_kv_get(self, req: web.Request) -> web.Response:
+        v = self.filer.store.kv_get(req.match_info["key"])
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=v)
+
+    async def handle_kv_put(self, req: web.Request) -> web.Response:
+        self.filer.store.kv_put(req.match_info["key"], await req.read())
+        return web.json_response({})
+
+    async def handle_kv_delete(self, req: web.Request) -> web.Response:
+        self.filer.store.kv_delete(req.match_info["key"])
+        return web.json_response({}, status=204)
+
+    # -- metadata subscription ------------------------------------------
+    async def handle_meta_subscribe(self, req: web.Request) \
+            -> web.WebSocketResponse:
+        """Push metadata events (filer.proto:57-60 SubscribeMetadata).
+        Query: path_prefix, since_ns, client_id(signature)."""
+        prefix = req.query.get("path_prefix", "/")
+        since = int(req.query.get("since_ns", "0"))
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(req)
+        sid, q = self.filer.meta_log.subscribe(since_ts_ns=since)
+        try:
+            while not ws.closed:
+                ev = await asyncio.to_thread(_q_get, q, 0.25)
+                if ev is None:
+                    continue
+                if not (ev["directory"] + "/").startswith(
+                        prefix.rstrip("/") + "/"):
+                    continue
+                await ws.send_json(ev)
+        except (ConnectionResetError, asyncio.CancelledError,
+                RuntimeError):  # RuntimeError: executor gone at shutdown
+            pass
+        finally:
+            self.filer.meta_log.unsubscribe(sid)
+        return ws
+
+    # -- misc -----------------------------------------------------------
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "master": self.master_url, "store": self.filer.store.name,
+            "signature": self.filer.meta_log.signature})
+
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")
+
+
+def _q_get(q, timeout):
+    import queue
+    try:
+        return q.get(timeout=timeout)
+    except queue.Empty:
+        return None
+
+
+async def _read_exactly(reader, n: int) -> bytes:
+    """Read up to n bytes from an aiohttp StreamReader/BodyPartReader,
+    only returning short on EOF."""
+    buf = bytearray()
+    while len(buf) < n:
+        piece = await reader.read_chunk(n - len(buf)) \
+            if hasattr(reader, "read_chunk") else \
+            await reader.read(n - len(buf))
+        if not piece:
+            break
+        buf.extend(piece)
+    return bytes(buf)
+
+
+def _ttl_seconds(ttl: str) -> int:
+    """'3m'/'4h'/'5d'... -> seconds (storage/needle/volume_ttl.go)."""
+    if not ttl:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800,
+             "M": 2592000, "y": 31536000}
+    if ttl[-1] in units:
+        return int(ttl[:-1]) * units[ttl[-1]]
+    return int(ttl)

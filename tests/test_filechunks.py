@@ -1,0 +1,130 @@
+"""Chunk-interval algebra unit tests.
+
+Mirrors the reference's filer/filechunks_test.go scenarios: overlap
+resolution by mtime, remnant splitting, views over ranges, garbage
+separation, manifest round-trip.
+"""
+import hashlib
+
+from seaweedfs_tpu.filer import (Entry, FileChunk, compact_file_chunks,
+                                 etag_chunks, maybe_manifestize,
+                                 non_overlapping_visible_intervals,
+                                 resolve_chunk_manifest, total_size,
+                                 view_from_chunks)
+
+
+def C(fid, offset, size, ts):
+    return FileChunk(fid=fid, offset=offset, size=size, mtime_ns=ts)
+
+
+class TestVisibleIntervals:
+    def test_single_chunk(self):
+        v = non_overlapping_visible_intervals([C("1,a", 0, 100, 1)])
+        assert [(x.start, x.stop, x.fid) for x in v] == [(0, 100, "1,a")]
+
+    def test_non_overlapping(self):
+        v = non_overlapping_visible_intervals(
+            [C("1,a", 0, 100, 1), C("2,b", 100, 50, 2)])
+        assert [(x.start, x.stop) for x in v] == [(0, 100), (100, 150)]
+
+    def test_later_fully_covers(self):
+        v = non_overlapping_visible_intervals(
+            [C("1,a", 0, 100, 1), C("2,b", 0, 100, 2)])
+        assert [(x.start, x.stop, x.fid) for x in v] == [(0, 100, "2,b")]
+
+    def test_earlier_write_does_not_shadow(self):
+        v = non_overlapping_visible_intervals(
+            [C("2,b", 0, 100, 2), C("1,a", 0, 100, 1)])
+        assert [x.fid for x in v] == ["2,b"]
+
+    def test_middle_overwrite_splits(self):
+        v = non_overlapping_visible_intervals(
+            [C("1,a", 0, 100, 1), C("2,b", 30, 20, 2)])
+        assert [(x.start, x.stop, x.fid, x.offset_in_chunk) for x in v] \
+            == [(0, 30, "1,a", 0), (30, 50, "2,b", 0),
+                (50, 100, "1,a", 50)]
+
+    def test_staircase(self):
+        v = non_overlapping_visible_intervals(
+            [C("1,a", 0, 70, 1), C("2,b", 50, 70, 2), C("3,c", 100, 70, 3)])
+        assert [(x.start, x.stop, x.fid) for x in v] == \
+            [(0, 50, "1,a"), (50, 100, "2,b"), (100, 170, "3,c")]
+
+    def test_total_size_and_sparse(self):
+        chunks = [C("1,a", 100, 50, 1)]
+        assert total_size(chunks) == 150
+
+
+class TestChunkViews:
+    def test_view_subrange(self):
+        views = view_from_chunks(
+            [C("1,a", 0, 100, 1), C("2,b", 100, 100, 2)], 50, 100)
+        assert [(v.fid, v.offset_in_chunk, v.view_size, v.view_offset)
+                for v in views] == [("1,a", 50, 50, 50), ("2,b", 0, 50, 100)]
+
+    def test_view_with_overwrite_offsets(self):
+        views = view_from_chunks(
+            [C("1,a", 0, 100, 1), C("2,b", 30, 20, 2)], 40, 30)
+        assert [(v.fid, v.offset_in_chunk, v.view_size) for v in views] \
+            == [("2,b", 10, 10), ("1,a", 50, 20)]
+
+
+class TestGarbage:
+    def test_fully_shadowed_is_garbage(self):
+        live, garbage = compact_file_chunks(
+            [C("1,a", 0, 100, 1), C("2,b", 0, 100, 2)])
+        assert [c.fid for c in live] == ["2,b"]
+        assert [c.fid for c in garbage] == ["1,a"]
+
+    def test_partial_overlap_not_garbage(self):
+        live, garbage = compact_file_chunks(
+            [C("1,a", 0, 100, 1), C("2,b", 50, 100, 2)])
+        assert {c.fid for c in live} == {"1,a", "2,b"}
+        assert garbage == []
+
+
+class TestEtag:
+    def test_single_chunk_etag(self):
+        c = C("1,a", 0, 3, 1)
+        c.etag = hashlib.md5(b"abc").hexdigest()
+        assert etag_chunks([c]) == c.etag
+
+    def test_multi_chunk_etag_has_count_suffix(self):
+        cs = [C("1,a", 0, 3, 1), C("2,b", 3, 3, 2)]
+        for c in cs:
+            c.etag = hashlib.md5(c.fid.encode()).hexdigest()
+        assert etag_chunks(cs).endswith("-2")
+
+
+class TestManifest:
+    def test_round_trip(self):
+        blobs = {}
+
+        def save(data: bytes) -> str:
+            fid = f"9,{len(blobs):x}"
+            blobs[fid] = data
+            return fid
+
+        chunks = [C(f"1,{i:x}", i * 10, 10, i) for i in range(25)]
+        folded = maybe_manifestize(save, chunks, batch=10)
+        manifests = [c for c in folded if c.is_chunk_manifest]
+        assert len(manifests) == 2  # 25 = 10 + 10 + 5 plain
+        assert len(folded) == 2 + 5
+        back = resolve_chunk_manifest(lambda fid: blobs[fid], folded)
+        assert sorted(c.fid for c in back) == sorted(c.fid for c in chunks)
+
+    def test_below_batch_untouched(self):
+        chunks = [C("1,a", 0, 10, 1)]
+        assert maybe_manifestize(lambda b: "x", chunks, batch=10) == chunks
+
+
+class TestEntryModel:
+    def test_round_trip(self):
+        e = Entry(full_path="/a/b/c.txt", mime="text/plain", ttl_sec=60,
+                  chunks=[C("1,a", 0, 10, 1)], extended={"k": "v"})
+        e2 = Entry.from_dict(e.to_dict())
+        assert e2.full_path == "/a/b/c.txt"
+        assert e2.chunks[0].fid == "1,a"
+        assert e2.extended == {"k": "v"}
+        assert not e2.is_directory
+        assert e2.dir_and_name == ("/a/b", "c.txt")

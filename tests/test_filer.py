@@ -1,0 +1,181 @@
+"""Filer namespace unit tests: CRUD, stores, TTL, rename, event log.
+
+Model: reference filer tests (weed/filer/filer_test.go is thin; most
+behavior is exercised via the store suites) — here both embedded stores
+run the same scenarios via parametrization.
+"""
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import (DIR_MODE_FLAG, Entry, FileChunk, Filer,
+                                 event_kind)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def filer(request, tmp_path):
+    kwargs = {}
+    if request.param == "sqlite":
+        kwargs["path"] = str(tmp_path / "filer.db")
+    f = Filer(request.param, **kwargs)
+    yield f
+    f.close()
+
+
+def touch(filer, path, size=0, fid="1,ab"):
+    chunks = [FileChunk(fid=fid, offset=0, size=size,
+                        mtime_ns=time.time_ns())] if size else []
+    return filer.create_entry(Entry(full_path=path, chunks=chunks))
+
+
+class TestCrud:
+    def test_create_find(self, filer):
+        touch(filer, "/dir/file.txt", size=10)
+        e = filer.find_entry("/dir/file.txt")
+        assert e is not None and e.file_size == 10
+
+    def test_parent_dirs_auto_created(self, filer):
+        touch(filer, "/a/b/c/d.txt")
+        for p in ("/a", "/a/b", "/a/b/c"):
+            e = filer.find_entry(p)
+            assert e is not None and e.is_directory, p
+
+    def test_list_sorted_and_paged(self, filer):
+        for n in ("c", "a", "b", "d"):
+            touch(filer, f"/docs/{n}")
+        names = [e.name for e in filer.list_entries("/docs")]
+        assert names == ["a", "b", "c", "d"]
+        page = filer.list_entries("/docs", start_from="b", limit=2)
+        assert [e.name for e in page] == ["c", "d"]
+        pfx = filer.list_entries("/docs", prefix="b")
+        assert [e.name for e in pfx] == ["b"]
+
+    def test_delete_file_reports_chunks(self, tmp_path):
+        dead = []
+        f = Filer("memory", on_delete_chunks=dead.extend)
+        touch(f, "/x.bin", size=5, fid="7,aa")
+        f.delete_entry("/x.bin")
+        assert [c.fid for c in dead] == ["7,aa"]
+        assert f.find_entry("/x.bin") is None
+
+    def test_delete_dir_requires_recursive(self, filer):
+        touch(filer, "/d/leaf")
+        with pytest.raises(OSError):
+            filer.delete_entry("/d")
+        filer.delete_entry("/d", recursive=True)
+        assert filer.find_entry("/d") is None
+        assert filer.find_entry("/d/leaf") is None
+
+    def test_overwrite_file_with_dir_conflicts(self, filer):
+        filer.mkdir("/conflict")
+        with pytest.raises(IsADirectoryError):
+            touch(filer, "/conflict")
+
+    def test_root_always_exists(self, filer):
+        root = filer.find_entry("/")
+        assert root is not None and root.is_directory
+
+
+class TestTtl:
+    def test_expired_entry_hidden(self, filer):
+        e = Entry(full_path="/tmp/x", ttl_sec=1)
+        e.crtime = time.time() - 10
+        filer.create_entry(e)
+        assert filer.find_entry("/tmp/x") is None
+        assert filer.list_entries("/tmp") == []
+
+    def test_live_ttl_entry_visible(self, filer):
+        filer.create_entry(Entry(full_path="/tmp/y", ttl_sec=3600))
+        assert filer.find_entry("/tmp/y") is not None
+
+
+class TestRename:
+    def test_rename_file(self, filer):
+        touch(filer, "/a/src.txt", size=3)
+        filer.rename("/a/src.txt", "/b/dst.txt")
+        assert filer.find_entry("/a/src.txt") is None
+        moved = filer.find_entry("/b/dst.txt")
+        assert moved is not None and moved.file_size == 3
+
+    def test_rename_dir_moves_subtree(self, filer):
+        touch(filer, "/olddir/sub/f1", size=1)
+        touch(filer, "/olddir/f2", size=2)
+        filer.rename("/olddir", "/newdir")
+        assert filer.find_entry("/newdir/sub/f1") is not None
+        assert filer.find_entry("/newdir/f2") is not None
+        assert filer.find_entry("/olddir") is None
+
+    def test_rename_to_existing_fails(self, filer):
+        touch(filer, "/p/a")
+        touch(filer, "/p/b")
+        with pytest.raises(FileExistsError):
+            filer.rename("/p/a", "/p/b")
+
+
+class TestRegressions:
+    def test_rename_dir_with_expired_entry_keeps_all_children(self, filer):
+        """A full store page containing one expired entry must not
+        truncate iter_tree (would silently drop children on rename)."""
+        import seaweedfs_tpu.filer.filer as filer_mod
+        old_batch = filer_mod.LIST_BATCH
+        filer_mod.LIST_BATCH = 4
+        try:
+            for i in range(8):
+                touch(filer, f"/pg/f{i}", size=1)
+            expired = Entry(full_path="/pg/f1", ttl_sec=1)
+            expired.crtime = time.time() - 10
+            filer.store.insert_entry(expired)
+            filer.rename("/pg", "/pg2")
+            names = sorted(e.name for e in filer.iter_tree("/pg2"))
+            assert names == [f"f{i}" for i in range(8) if i != 1]
+        finally:
+            filer_mod.LIST_BATCH = old_batch
+
+    def test_sqlite_like_wildcards_literal(self, tmp_path):
+        from seaweedfs_tpu.filer import SqliteStore
+        s = SqliteStore(str(tmp_path / "w.db"))
+        f = Filer(s)
+        touch(f, "/a_b/keep1")
+        touch(f, "/axb/keep2")
+        touch(f, "/pre/50%off")
+        touch(f, "/pre/500")
+        f.delete_entry("/a_b", recursive=True)
+        assert f.find_entry("/axb/keep2") is not None  # '_' not a wildcard
+        got = [e.name for e in f.list_entries("/pre", prefix="50%")]
+        assert got == ["50%off"]
+
+
+class TestEventLog:
+    def test_mutations_produce_events(self):
+        f = Filer("memory")
+        touch(f, "/e/one", size=1)
+        f.delete_entry("/e/one")
+        evs = f.meta_log.replay()
+        kinds = [event_kind(ev) for ev in evs]
+        # mkdir /e, create one, delete one
+        assert kinds == ["create", "create", "delete"]
+        assert all(f.meta_log.signature in ev["signatures"] for ev in evs)
+
+    def test_subscribe_replays_then_streams(self):
+        f = Filer("memory")
+        touch(f, "/s/a")
+        sid, q = f.meta_log.subscribe()
+        backlog = [q.get_nowait() for _ in range(q.qsize())]
+        assert any(ev["new_entry"] and
+                   ev["new_entry"]["full_path"] == "/s/a"
+                   for ev in backlog)
+        touch(f, "/s/b")
+        live = q.get(timeout=2)
+        assert live["new_entry"]["full_path"] == "/s/b"
+        f.meta_log.unsubscribe(sid)
+
+    def test_replay_since_and_prefix(self):
+        f = Filer("memory")
+        touch(f, "/p1/a")
+        ts = f.meta_log.replay()[-1]["ts_ns"]
+        touch(f, "/p2/b")
+        later = f.meta_log.replay(since_ts_ns=ts)
+        assert all(ev["ts_ns"] > ts for ev in later)
+        only_p2 = f.meta_log.replay(prefix="/p2")
+        assert {ev["directory"] for ev in only_p2} <= {"/", "/p2"}
+        assert any(ev["directory"] == "/p2" for ev in only_p2)

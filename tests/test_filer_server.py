@@ -1,0 +1,168 @@
+"""Filer server integration: autochunk upload, ranged reads, listing,
+rename, delete, KV, metadata subscription — against a real in-process
+master + volume servers + filer (SURVEY.md section 3.4 call stack).
+"""
+import json
+import queue
+import threading
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("filer_cluster")),
+                n_volume_servers=2, volume_size_limit=16 << 20,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+class TestFilerReadWrite:
+    def test_small_file_round_trip(self, cluster):
+        url = f"{cluster.filer_url}/docs/hello.txt"
+        r = requests.post(url, data=b"hello filer",
+                          headers={"Content-Type": "text/plain"})
+        assert r.status_code == 201, r.text
+        got = requests.get(url)
+        assert got.status_code == 200
+        assert got.content == b"hello filer"
+        assert got.headers["Content-Type"].startswith("text/plain")
+        assert got.headers["ETag"]
+
+    def test_multipart_form_upload(self, cluster):
+        url = f"{cluster.filer_url}/docs/form.bin"
+        r = requests.post(url, files={"file": ("form.bin", b"\x00\x01ab")})
+        assert r.status_code == 201
+        assert requests.get(url).content == b"\x00\x01ab"
+
+    def test_multi_chunk_file(self, cluster):
+        # 1MB chunks -> 3 chunks + tail
+        data = bytes(range(256)) * 4096 * 3 + b"tail"
+        url = f"{cluster.filer_url}/big/blob.bin?maxMB=1"
+        r = requests.post(url, data=data)
+        assert r.status_code == 201
+        meta = requests.get(f"{cluster.filer_url}/big/blob.bin",
+                            params={"meta": "1"}).json()
+        assert len(meta["chunks"]) == 4
+        got = requests.get(f"{cluster.filer_url}/big/blob.bin")
+        assert got.content == data
+
+    def test_range_read_spanning_chunks(self, cluster):
+        data = b"A" * (1 << 20) + b"B" * (1 << 20)
+        url = f"{cluster.filer_url}/big/span.bin"
+        requests.post(url + "?maxMB=1", data=data)
+        r = requests.get(url, headers={
+            "Range": f"bytes={(1 << 20) - 5}-{(1 << 20) + 4}"})
+        assert r.status_code == 206
+        assert r.content == b"A" * 5 + b"B" * 5
+        assert r.headers["Content-Range"].startswith(
+            f"bytes {(1 << 20) - 5}-")
+        # suffix range
+        r2 = requests.get(url, headers={"Range": "bytes=-3"})
+        assert r2.content == b"BBB"
+
+    def test_head_and_conditional(self, cluster):
+        url = f"{cluster.filer_url}/docs/etag.txt"
+        requests.post(url, data=b"etag me")
+        h = requests.head(url)
+        assert h.status_code == 200
+        assert int(h.headers["Content-Length"]) == 7
+        etag = h.headers["ETag"]
+        cached = requests.get(url, headers={"If-None-Match": etag})
+        assert cached.status_code == 304
+
+    def test_overwrite_replaces_content(self, cluster):
+        url = f"{cluster.filer_url}/docs/over.txt"
+        requests.post(url, data=b"version one")
+        requests.post(url, data=b"v2")
+        assert requests.get(url).content == b"v2"
+
+    def test_404(self, cluster):
+        assert requests.get(
+            f"{cluster.filer_url}/nope/missing").status_code == 404
+
+
+class TestFilerNamespace:
+    def test_listing_and_pagination(self, cluster):
+        for n in ("a.txt", "b.txt", "c.txt"):
+            requests.post(f"{cluster.filer_url}/listdir/{n}", data=b"x")
+        ls = requests.get(f"{cluster.filer_url}/listdir/").json()
+        assert [e["full_path"] for e in ls["entries"]] == \
+            ["/listdir/a.txt", "/listdir/b.txt", "/listdir/c.txt"]
+        page = requests.get(f"{cluster.filer_url}/listdir/",
+                            params={"limit": "2"}).json()
+        assert len(page["entries"]) == 2
+        assert page["lastFileName"] == "b.txt"
+
+    def test_mkdir_and_rename(self, cluster):
+        requests.post(f"{cluster.filer_url}/mv/src.txt", data=b"move me")
+        r = requests.post(f"{cluster.filer_url}/mv2/dst.txt",
+                          params={"mv.from": "/mv/src.txt"})
+        assert r.status_code == 200, r.text
+        assert requests.get(
+            f"{cluster.filer_url}/mv/src.txt").status_code == 404
+        assert requests.get(
+            f"{cluster.filer_url}/mv2/dst.txt").content == b"move me"
+
+    def test_delete_cleans_volume_data(self, cluster):
+        url = f"{cluster.filer_url}/del/gone.bin"
+        requests.post(url, data=b"bye" * 1000)
+        meta = requests.get(url, params={"meta": "1"}).json()
+        fid = meta["chunks"][0]["fid"]
+        assert requests.delete(url).status_code == 204
+        assert requests.get(url).status_code == 404
+        # chunk deleted on the volume server too
+        locs = requests.get(f"{cluster.master_url}/dir/lookup",
+                            params={"volumeId": fid.split(",")[0]}).json()
+        vol_url = f"http://{locs['locations'][0]['url']}/{fid}"
+        assert requests.get(vol_url).status_code == 404
+
+    def test_recursive_delete(self, cluster):
+        requests.post(f"{cluster.filer_url}/tree/a/b/c.txt", data=b"x")
+        r = requests.delete(f"{cluster.filer_url}/tree",
+                            params={"recursive": "true"})
+        assert r.status_code == 204
+        assert requests.get(
+            f"{cluster.filer_url}/tree/a/b/c.txt").status_code == 404
+
+
+class TestFilerKv:
+    def test_kv_round_trip(self, cluster):
+        url = f"{cluster.filer_url}/kv/offsets/sync1"
+        assert requests.get(url).status_code == 404
+        requests.put(url, data=b"\x00\x01\x02")
+        assert requests.get(url).content == b"\x00\x01\x02"
+        requests.delete(url)
+        assert requests.get(url).status_code == 404
+
+
+class TestMetaSubscription:
+    def test_ws_stream_receives_events(self, cluster):
+        import aiohttp
+        import asyncio
+
+        got: queue.Queue = queue.Queue()
+
+        async def subscribe():
+            async with aiohttp.ClientSession() as sess:
+                ws_url = cluster.filer_url.replace("http", "ws", 1) + \
+                    "/ws/meta_subscribe?path_prefix=/watched"
+                async with sess.ws_connect(ws_url) as ws:
+                    async for msg in ws:
+                        got.put(json.loads(msg.data))
+                        return
+
+        t = threading.Thread(target=lambda: asyncio.run(subscribe()),
+                             daemon=True)
+        t.start()
+        import time
+        time.sleep(0.3)
+        requests.post(f"{cluster.filer_url}/watched/new.txt", data=b"x")
+        requests.post(f"{cluster.filer_url}/unwatched/skip.txt", data=b"y")
+        ev = got.get(timeout=5)
+        assert ev["directory"].startswith("/watched")
+        assert ev["new_entry"]["full_path"] == "/watched/new.txt"
