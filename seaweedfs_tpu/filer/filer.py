@@ -123,7 +123,12 @@ class Filer:
                 self.meta_log.append(d, None, ent)
 
     def delete_entry(self, path: str, recursive: bool = False,
+                     delete_chunks: bool = True,
                      signatures: list[int] | None = None) -> None:
+        """delete_chunks=False removes names only, leaving volume data
+        alive (the reference's isDeleteData=false — used when another
+        entry still references the same chunks, e.g. multipart
+        completion)."""
         path = norm_path(path)
         e = self.find_entry(path)
         if e is None:
@@ -145,7 +150,7 @@ class Filer:
         self.store.delete_entry(path)
         d, _ = e.dir_and_name
         self.meta_log.append(d, e, None, signatures)
-        if dead_chunks:
+        if dead_chunks and delete_chunks:
             self.on_delete_chunks(dead_chunks)
 
     def rename(self, old_path: str, new_path: str,

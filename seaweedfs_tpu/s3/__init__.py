@@ -1,0 +1,11 @@
+"""S3 gateway: AWS-S3-compatible REST API over the filer.
+
+TPU-native re-expression of /root/reference/weed/s3api/ — see server.py
+(routing + handlers) and auth.py (SigV4 + identity model).
+"""
+from .auth import (IdentityAccessManagement, S3AuthError, presign_url,
+                   sign_request)
+from .server import S3ApiServer, S3Error
+
+__all__ = ["IdentityAccessManagement", "S3AuthError", "presign_url",
+           "sign_request", "S3ApiServer", "S3Error"]

@@ -1,0 +1,253 @@
+"""S3 request authentication: AWS Signature V4 + identity/action model.
+
+Equivalent of /root/reference/weed/s3api/auth_signature_v4.go (header
+and presigned-query SigV4 verification) and auth_credentials.go (the
+`IdentityAccessManagement` identity -> credentials -> actions model,
+hot-reloadable config). SigV2 is legacy and intentionally omitted.
+
+Identities config (JSON, same shape idea as s3.configure):
+  {"identities": [{"name": "admin",
+                   "credentials": [{"accessKey": "K", "secretKey": "S"}],
+                   "actions": ["Admin"]}]}
+Actions: Admin, Read, Write, List, Tagging — optionally scoped
+":bucket" (e.g. "Read:public-bucket"). No identities -> open access.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import urllib.parse
+from datetime import datetime, timedelta, timezone
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+MAX_CLOCK_SKEW_SECONDS = 15 * 60
+
+ACTION_ADMIN = "Admin"
+ACTION_READ = "Read"
+ACTION_WRITE = "Write"
+ACTION_LIST = "List"
+ACTION_TAGGING = "Tagging"
+
+
+class S3AuthError(Exception):
+    def __init__(self, code: str, message: str, status: int = 403):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+class Identity:
+    def __init__(self, name: str, credentials: list[dict],
+                 actions: list[str]):
+        self.name = name
+        self.credentials = credentials
+        self.actions = set(actions)
+
+    def allows(self, action: str, bucket: str) -> bool:
+        if ACTION_ADMIN in self.actions:
+            return True
+        return action in self.actions or \
+            f"{action}:{bucket}" in self.actions
+
+
+class IdentityAccessManagement:
+    def __init__(self, config: dict | None = None):
+        self._lock = threading.Lock()
+        self._identities: list[Identity] = []
+        self._by_access_key: dict[str, tuple[Identity, str]] = {}
+        if config:
+            self.load_config(config)
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return not self._identities
+
+    def load_config(self, config: dict) -> None:
+        """Replace all identities (hot reload — the reference reloads on
+        s3.configure metadata events, auth_credentials_subscribe.go)."""
+        identities, by_key = [], {}
+        for id_cfg in config.get("identities", []):
+            ident = Identity(id_cfg.get("name", ""),
+                             id_cfg.get("credentials", []),
+                             id_cfg.get("actions", []))
+            identities.append(ident)
+            for cred in ident.credentials:
+                by_key[cred["accessKey"]] = (ident, cred["secretKey"])
+        with self._lock:
+            self._identities = identities
+            self._by_access_key = by_key
+
+    def lookup(self, access_key: str) -> tuple[Identity, str]:
+        with self._lock:
+            found = self._by_access_key.get(access_key)
+        if found is None:
+            raise S3AuthError("InvalidAccessKeyId",
+                              f"access key {access_key!r} not found")
+        return found
+
+    # -- request verification -------------------------------------------
+    def authenticate(self, method: str, path: str, query: dict[str, str],
+                     headers: dict[str, str],
+                     payload_hash: str) -> Identity | None:
+        """Verify a request; returns the Identity (None if open mode).
+        Raises S3AuthError on bad signatures."""
+        if "X-Amz-Signature" in query or "X-Amz-Algorithm" in query:
+            return self._verify_presigned(method, path, query, headers)
+        auth = headers.get("Authorization", "")
+        if auth.startswith(ALGORITHM):
+            return self._verify_header(method, path, query, headers,
+                                       payload_hash, auth)
+        if self.is_open:
+            return None
+        raise S3AuthError("AccessDenied", "no credentials provided")
+
+    def _verify_header(self, method, path, query, headers, payload_hash,
+                       auth) -> Identity:
+        fields = {}
+        for part in auth[len(ALGORITHM):].strip().split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        cred_parts = fields.get("Credential", "").split("/")
+        if len(cred_parts) != 5:
+            raise S3AuthError("AuthorizationHeaderMalformed",
+                              "bad Credential")
+        access_key, datestamp, region, service, _ = cred_parts
+        identity, secret = self.lookup(access_key)
+        signed_headers = fields.get("SignedHeaders", "").split(";")
+        amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date", "")
+        # SigV4 requires rejecting stale requests or any captured
+        # signed request replays forever
+        try:
+            t = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=timezone.utc)
+        except ValueError:
+            raise S3AuthError("AccessDenied", "missing/bad x-amz-date")
+        skew = abs((datetime.now(timezone.utc) - t).total_seconds())
+        if skew > MAX_CLOCK_SKEW_SECONDS:
+            raise S3AuthError("RequestTimeTooSkewed",
+                              f"request time skewed by {skew:.0f}s")
+        payload_hash = headers.get(
+            "x-amz-content-sha256",
+            headers.get("X-Amz-Content-Sha256", payload_hash))
+        creq = _canonical_request(method, path, query, headers,
+                                  signed_headers, payload_hash)
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        expect = _signature(secret, amz_date, scope, creq)
+        if not hmac.compare_digest(expect, fields.get("Signature", "")):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "signature mismatch")
+        return identity
+
+    def _verify_presigned(self, method, path, query, headers) -> Identity:
+        q = dict(query)
+        sig = q.pop("X-Amz-Signature", "")
+        cred_parts = q.get("X-Amz-Credential", "").split("/")
+        if len(cred_parts) != 5:
+            raise S3AuthError("AuthorizationQueryParametersError",
+                              "bad X-Amz-Credential")
+        access_key, datestamp, region, service, _ = cred_parts
+        identity, secret = self.lookup(access_key)
+        amz_date = q.get("X-Amz-Date", "")
+        try:
+            t = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=timezone.utc)
+            expires = int(q.get("X-Amz-Expires", "900"))
+        except ValueError as e:
+            raise S3AuthError("AuthorizationQueryParametersError", str(e))
+        if datetime.now(timezone.utc) > t + timedelta(seconds=expires):
+            raise S3AuthError("AccessDenied", "request has expired")
+        signed_headers = q.get("X-Amz-SignedHeaders", "host").split(";")
+        creq = _canonical_request(method, path, q, headers,
+                                  signed_headers, "UNSIGNED-PAYLOAD")
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        expect = _signature(secret, amz_date, scope, creq)
+        if not hmac.compare_digest(expect, sig):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "signature mismatch")
+        return identity
+
+
+def _canonical_request(method: str, path: str, query: dict[str, str],
+                       headers: dict[str, str],
+                       signed_headers: list[str],
+                       payload_hash: str) -> str:
+    canonical_uri = urllib.parse.quote(path, safe="/-_.~")
+    q_items = sorted((urllib.parse.quote(k, safe="-_.~"),
+                      urllib.parse.quote(str(v), safe="-_.~"))
+                     for k, v in query.items())
+    canonical_query = "&".join(f"{k}={v}" for k, v in q_items)
+    lower = {k.lower(): " ".join(str(v).split())
+             for k, v in headers.items()}
+    signed_headers = sorted(h.lower() for h in signed_headers)
+    canonical_headers = "".join(
+        f"{h}:{lower.get(h, '')}\n" for h in signed_headers)
+    return "\n".join([method.upper(), canonical_uri, canonical_query,
+                      canonical_headers, ";".join(signed_headers),
+                      payload_hash])
+
+
+def _signature(secret: str, amz_date: str, scope: str, creq: str) -> str:
+    sts = "\n".join([ALGORITHM, amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    datestamp, region, service, _ = scope.split("/")
+    k = hmac.new(("AWS4" + secret).encode(), datestamp.encode(),
+                 hashlib.sha256).digest()
+    for msg in (region, service, "aws4_request"):
+        k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
+    return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def sign_request(method: str, url: str, access_key: str, secret: str,
+                 region: str = "us-east-1",
+                 payload: bytes = b"",
+                 extra_headers: dict | None = None) -> dict[str, str]:
+    """Client-side SigV4 header signing (for tests and the shell's s3
+    commands). Returns headers to attach."""
+    parsed = urllib.parse.urlsplit(url)
+    query = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
+    now = datetime.now(timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    headers = {"host": parsed.netloc, "x-amz-date": amz_date,
+               "x-amz-content-sha256": payload_hash}
+    if extra_headers:
+        headers.update({k.lower(): v for k, v in extra_headers.items()})
+    signed = sorted(headers)
+    creq = _canonical_request(method, parsed.path or "/", query, headers,
+                              signed, payload_hash)
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    sig = _signature(secret, amz_date, scope, creq)
+    headers["Authorization"] = (
+        f"{ALGORITHM} Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return headers
+
+
+def presign_url(method: str, url: str, access_key: str, secret: str,
+                region: str = "us-east-1", expires: int = 900) -> str:
+    """Generate a presigned URL (client side)."""
+    parsed = urllib.parse.urlsplit(url)
+    now = datetime.now(timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+    q.update({
+        "X-Amz-Algorithm": ALGORITHM,
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    })
+    headers = {"host": parsed.netloc}
+    creq = _canonical_request(method, parsed.path or "/", q, headers,
+                              ["host"], "UNSIGNED-PAYLOAD")
+    sig = _signature(secret, amz_date, scope, creq)
+    q["X-Amz-Signature"] = sig
+    return urllib.parse.urlunsplit(
+        (parsed.scheme, parsed.netloc, parsed.path,
+         urllib.parse.urlencode(q), ""))

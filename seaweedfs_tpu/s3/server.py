@@ -1,0 +1,703 @@
+"""S3 REST gateway over the filer.
+
+Equivalent of /root/reference/weed/s3api/s3api_server.go:47-150 (router)
+and its handler files: bucket CRUD (s3api_bucket_handlers.go), object
+CRUD + copy (s3api_object_handlers*.go), ListObjects V1/V2
+(s3api_objects_list_handlers.go), multipart (filer_multipart.go,
+s3api_object_multipart_handlers.go), tagging (s3api_object_tagging_
+handlers.go), batch delete, SigV4 auth (auth_signature_v4.go).
+
+Buckets live at /buckets/<name> in the filer namespace and map to a
+storage collection of the same name, exactly like the reference.
+Multipart parts are staged under /buckets/<bucket>/.uploads/<id>/ and
+stitched into the final object by a metadata-only entry create — the
+bytes never move.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+import requests
+from aiohttp import web
+
+from ..filer.entry import Entry as FilerEntry
+from ..utils import metrics
+from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+                   ACTION_WRITE, IdentityAccessManagement, S3AuthError)
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"
+IDENTITIES_KV_KEY = "s3/identities"  # filer KV key holding the config
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str, status: int):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+ERR_NO_SUCH_BUCKET = ("NoSuchBucket", "bucket does not exist", 404)
+ERR_NO_SUCH_KEY = ("NoSuchKey", "key does not exist", 404)
+ERR_BUCKET_NOT_EMPTY = ("BucketNotEmpty", "bucket is not empty", 409)
+ERR_BUCKET_EXISTS = ("BucketAlreadyExists", "bucket already exists", 409)
+ERR_NO_SUCH_UPLOAD = ("NoSuchUpload", "upload id not found", 404)
+
+
+def _xml(tag: str, *children, text: str | None = None,
+         ns: bool = True) -> ET.Element:
+    el = ET.Element(tag)
+    if ns:
+        el.set("xmlns", XMLNS)
+    if text is not None:
+        el.text = text
+    for c in children:
+        el.append(c)
+    return el
+
+
+def _leaf(tag: str, text) -> ET.Element:
+    el = ET.Element(tag)
+    el.text = str(text)
+    return el
+
+
+def _find(el: ET.Element, tag: str) -> ET.Element | None:
+    """Find a child with or without the S3 namespace. (`find(a) or
+    find(b)` is wrong — childless Elements are falsy.)"""
+    found = el.find(tag)
+    if found is None:
+        found = el.find(f"{{{XMLNS}}}{tag}")
+    return found
+
+
+def _xml_response(root: ET.Element, status: int = 200) -> web.Response:
+    body = b'<?xml version="1.0" encoding="UTF-8"?>\n' + \
+        ET.tostring(root)
+    return web.Response(body=body, status=status,
+                        content_type="application/xml")
+
+
+def _error_response(code: str, message: str, status: int,
+                    resource: str = "") -> web.Response:
+    root = _xml("Error", ns=False)
+    root.append(_leaf("Code", code))
+    root.append(_leaf("Message", message))
+    root.append(_leaf("Resource", resource))
+    return _xml_response(root, status)
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+class S3ApiServer:
+    def __init__(self, filer_url: str, iam_config: dict | None = None,
+                 region: str = "us-east-1"):
+        self.filer_url = filer_url.rstrip("/")
+        self.region = region
+        self.iam = IdentityAccessManagement(iam_config)
+        self._load_identities_from_filer()
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        @web.middleware
+        async def error_mw(request, handler):
+            try:
+                return await handler(request)
+            except S3Error as e:
+                return _error_response(e.code, str(e), e.status,
+                                       request.path)
+            except S3AuthError as e:
+                return _error_response(e.code, str(e), e.status,
+                                       request.path)
+            except (KeyError, ValueError, ET.ParseError) as e:
+                return _error_response("InvalidRequest", str(e), 400,
+                                       request.path)
+
+        # bodies are buffered for SigV4 payload hashing; 1GB caps the
+        # blowup — larger objects go through multipart parts
+        app = web.Application(client_max_size=1 << 30,
+                              middlewares=[error_mw])
+        app.add_routes([
+            web.get("/status", self.handle_status),
+            web.route("*", "/{tail:.*}", self.dispatch),
+        ])
+        return app
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response({"filer": self.filer_url,
+                                  "open": self.iam.is_open})
+
+    # -- auth + dispatch ------------------------------------------------
+    def _load_identities_from_filer(self) -> None:
+        """Pick up s3.configure-style identities stored in the filer
+        (auth_credentials_subscribe.go's role)."""
+        try:
+            resp = requests.get(
+                f"{self.filer_url}/kv/{IDENTITIES_KV_KEY}", timeout=5)
+            if resp.status_code == 200:
+                import json
+                self.iam.load_config(json.loads(resp.content))
+        except requests.RequestException:
+            pass
+
+    async def dispatch(self, req: web.Request) -> web.Response:
+        tail = req.match_info["tail"]
+        bucket, _, key = tail.partition("/")
+        payload = await req.read()
+        identity = self.iam.authenticate(
+            req.method, req.path,
+            {k: v for k, v in req.query.items()},
+            {k: v for k, v in req.headers.items()},
+            hashlib.sha256(payload).hexdigest())
+
+        def check(action: str):
+            if identity is not None and not identity.allows(action,
+                                                            bucket):
+                raise S3Error("AccessDenied",
+                              f"{action} denied on {bucket}", 403)
+
+        q = req.query
+        if not bucket:
+            check(ACTION_LIST)
+            return await self._list_buckets()
+        if not key:
+            return await self._bucket_op(req, bucket, q, payload, check)
+        return await self._object_op(req, bucket, key, q, payload, check)
+
+    async def _bucket_op(self, req, bucket, q, payload, check):
+        m = req.method
+        if m == "PUT":
+            check(ACTION_ADMIN)
+            return await self._put_bucket(bucket)
+        if m == "DELETE":
+            check(ACTION_ADMIN)
+            return await self._delete_bucket(bucket)
+        if m == "HEAD":
+            check(ACTION_READ)
+            await self._require_bucket(bucket)
+            return web.Response(status=200)
+        if m == "POST" and "delete" in q:
+            check(ACTION_WRITE)
+            return await self._delete_objects(bucket, payload)
+        if m == "GET":
+            check(ACTION_LIST)
+            await self._require_bucket(bucket)
+            if "uploads" in q:
+                return await self._list_multipart_uploads(bucket)
+            if "location" in q:
+                root = _xml("LocationConstraint", text=self.region)
+                return _xml_response(root)
+            return await self._list_objects(bucket, q)
+        raise S3Error("MethodNotAllowed", f"{m} on bucket", 405)
+
+    async def _object_op(self, req, bucket, key, q, payload, check):
+        m = req.method
+        if m == "POST" and "uploads" in q:
+            check(ACTION_WRITE)
+            return await self._initiate_multipart(bucket, key, req)
+        if m == "POST" and "uploadId" in q:
+            check(ACTION_WRITE)
+            return await self._complete_multipart(bucket, key,
+                                                  q["uploadId"], payload)
+        if m == "DELETE" and "uploadId" in q:
+            check(ACTION_WRITE)
+            return await self._abort_multipart(bucket, q["uploadId"])
+        if m == "PUT" and "partNumber" in q:
+            check(ACTION_WRITE)
+            return await self._upload_part(bucket, q["uploadId"],
+                                           int(q["partNumber"]), payload)
+        if m == "GET" and "uploadId" in q:
+            check(ACTION_READ)
+            return await self._list_parts(bucket, key, q["uploadId"])
+        if "tagging" in q:
+            check(ACTION_TAGGING)
+            return await self._tagging_op(m, bucket, key, payload)
+        if m == "PUT":
+            check(ACTION_WRITE)
+            src = req.headers.get("x-amz-copy-source", "")
+            if src:
+                return await self._copy_object(bucket, key, src)
+            return await self._put_object(bucket, key, payload, req)
+        if m in ("GET", "HEAD"):
+            check(ACTION_READ)
+            return await self._get_object(bucket, key, req)
+        if m == "DELETE":
+            check(ACTION_WRITE)
+            return await self._delete_object(bucket, key)
+        raise S3Error("MethodNotAllowed", f"{m} on object", 405)
+
+    # -- filer helpers --------------------------------------------------
+    def _fpath(self, bucket: str, key: str = "") -> str:
+        p = f"{self.filer_url}{BUCKETS_DIR}/{bucket}"
+        if key:
+            p += "/" + urllib.parse.quote(key)
+        return p
+
+    async def _filer(self, method: str, url: str, **kw):
+        return await asyncio.to_thread(
+            requests.request, method, url, timeout=120, **kw)
+
+    async def _require_bucket(self, bucket: str) -> dict:
+        resp = await self._filer("GET", self._fpath(bucket),
+                                 params={"meta": "1"})
+        if resp.status_code != 200:
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        return resp.json()
+
+    async def _entry_meta(self, bucket: str, key: str) -> dict:
+        resp = await self._filer("GET", self._fpath(bucket, key),
+                                 params={"meta": "1"})
+        if resp.status_code != 200:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        return resp.json()
+
+    # -- service / bucket -----------------------------------------------
+    async def _list_buckets(self) -> web.Response:
+        resp = await self._filer("GET", self.filer_url + BUCKETS_DIR + "/")
+        entries = resp.json().get("entries", []) \
+            if resp.status_code == 200 else []
+        buckets = ET.Element("Buckets")
+        for e in entries:
+            if not (e["mode"] & 0o40000):
+                continue
+            b = ET.Element("Bucket")
+            b.append(_leaf("Name", e["full_path"].rsplit("/", 1)[-1]))
+            b.append(_leaf("CreationDate", _iso(e.get("crtime", 0))))
+            buckets.append(b)
+        owner = ET.Element("Owner")
+        owner.append(_leaf("ID", "seaweedfs_tpu"))
+        root = _xml("ListAllMyBucketsResult", owner, buckets)
+        return _xml_response(root)
+
+    async def _put_bucket(self, bucket: str) -> web.Response:
+        resp = await self._filer("GET", self._fpath(bucket),
+                                 params={"meta": "1"})
+        if resp.status_code == 200:
+            raise S3Error(*ERR_BUCKET_EXISTS)
+        await self._filer("POST", self._fpath(bucket) + "/",
+                          params={"mkdir": "1"})
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    async def _delete_bucket(self, bucket: str) -> web.Response:
+        await self._require_bucket(bucket)
+        # .uploads sorts first, so one extra slot is needed to see a
+        # real object behind an in-progress multipart upload
+        listing = await self._filer("GET", self._fpath(bucket) + "/",
+                                    params={"limit": "2"})
+        entries = listing.json().get("entries", [])
+        if any(e["full_path"].rsplit("/", 1)[-1] != UPLOADS_DIR
+               for e in entries):
+            raise S3Error(*ERR_BUCKET_NOT_EMPTY)
+        await self._filer("DELETE", self._fpath(bucket),
+                          params={"recursive": "true"})
+        return web.Response(status=204)
+
+    async def _delete_objects(self, bucket: str,
+                              payload: bytes) -> web.Response:
+        root = ET.fromstring(payload)
+        deleted, errors = [], []
+        for obj in root.iter():
+            if not obj.tag.endswith("Object"):
+                continue
+            key_el = _find(obj, "Key")
+            if key_el is None or not key_el.text:
+                continue
+            key = key_el.text
+            resp = await self._filer("DELETE", self._fpath(bucket, key))
+            if resp.status_code in (204, 404):
+                deleted.append(key)
+            else:
+                errors.append(key)
+        out = _xml("DeleteResult")
+        for k in deleted:
+            d = ET.Element("Deleted")
+            d.append(_leaf("Key", k))
+            out.append(d)
+        for k in errors:
+            e = ET.Element("Error")
+            e.append(_leaf("Key", k))
+            e.append(_leaf("Code", "InternalError"))
+            out.append(e)
+        return _xml_response(out)
+
+    # -- object ---------------------------------------------------------
+    async def _put_object(self, bucket: str, key: str, payload: bytes,
+                          req: web.Request) -> web.Response:
+        await self._require_bucket(bucket)
+        if key.endswith("/") and not payload:
+            await self._filer("POST", self._fpath(bucket, key),
+                              params={"mkdir": "1"})
+            return web.Response(status=200)
+        params = {"collection": bucket}
+        mime = req.headers.get("Content-Type", "")
+        headers = {"Content-Type": mime} if mime else {}
+        resp = await self._filer("POST", self._fpath(bucket, key),
+                                 params=params, data=payload,
+                                 headers=headers)
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
+        etag = resp.json().get("etag", "")
+        metrics.counter_add("s3_put_bytes", len(payload))
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _get_object(self, bucket: str, key: str,
+                          req: web.Request) -> web.Response:
+        # a key that exists only as a directory/prefix is NoSuchKey in
+        # S3 — without this, the filer's JSON dir listing would leak
+        # out as the object body
+        meta = await self._entry_meta(bucket, key)
+        if meta.get("mode", 0) & 0o40000:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        headers = {}
+        if "Range" in req.headers:
+            headers["Range"] = req.headers["Range"]
+        resp = await self._filer(
+            "GET" if req.method == "GET" else "HEAD",
+            self._fpath(bucket, key), headers=headers)
+        if resp.status_code == 404:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        if resp.status_code >= 400:
+            raise S3Error("InternalError", resp.text, 500)
+        out_headers = {"ETag": resp.headers.get("ETag", "")}
+        for h in ("Content-Range", "Accept-Ranges", "Last-Modified",
+                  "Content-Length"):
+            if h in resp.headers:
+                out_headers[h] = resp.headers[h]
+        body = resp.content if req.method == "GET" else b""
+        if req.method == "HEAD":
+            return web.Response(
+                status=resp.status_code, headers=out_headers,
+                content_type=resp.headers.get("Content-Type"))
+        return web.Response(
+            body=body, status=resp.status_code, headers=out_headers,
+            content_type=resp.headers.get("Content-Type"))
+
+    async def _delete_object(self, bucket: str, key: str) -> web.Response:
+        """Deleting a key that is really a directory (a 'folder
+        marker') must NOT wipe nested objects — AWS deletes exactly one
+        key. Non-recursive delete; a non-empty dir is left alone."""
+        await self._filer("DELETE", self._fpath(bucket, key))
+        return web.Response(status=204)
+
+    async def _copy_object(self, bucket: str, key: str,
+                           src: str) -> web.Response:
+        src = urllib.parse.unquote(src.lstrip("/"))
+        src_bucket, _, src_key = src.partition("/")
+        meta = await self._entry_meta(src_bucket, src_key)
+        data = await self._filer("GET", self._fpath(src_bucket, src_key))
+        if data.status_code != 200:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        resp = await self._filer(
+            "POST", self._fpath(bucket, key),
+            params={"collection": bucket}, data=data.content,
+            headers={"Content-Type": meta.get(
+                "mime", "application/octet-stream")})
+        etag = resp.json().get("etag", "")
+        root = _xml("CopyObjectResult")
+        root.append(_leaf("ETag", f'"{etag}"'))
+        root.append(_leaf("LastModified", _iso(time.time())))
+        return _xml_response(root)
+
+    # -- listing --------------------------------------------------------
+    async def _list_objects(self, bucket: str, q) -> web.Response:
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        v2 = q.get("list-type") == "2"
+        start_after = q.get("start-after", "") if v2 else \
+            q.get("marker", "")
+        token = q.get("continuation-token", "")
+        if token:
+            start_after = urllib.parse.unquote(token)
+
+        keys, prefixes, truncated = await asyncio.to_thread(
+            self._walk_keys, bucket, prefix, delimiter, start_after,
+            max_keys)
+
+        root = _xml("ListBucketResult")
+        root.append(_leaf("Name", bucket))
+        root.append(_leaf("Prefix", prefix))
+        root.append(_leaf("MaxKeys", max_keys))
+        root.append(_leaf("IsTruncated", "true" if truncated else "false"))
+        if delimiter:
+            root.append(_leaf("Delimiter", delimiter))
+        for k, meta in keys:
+            c = ET.Element("Contents")
+            c.append(_leaf("Key", k))
+            c.append(_leaf("LastModified", _iso(meta.get("mtime", 0))))
+            etag = meta.get("md5", "")
+            c.append(_leaf("ETag", f'"{etag}"'))
+            c.append(_leaf("Size", sum(
+                ch["size"] for ch in meta.get("chunks", []))))
+            c.append(_leaf("StorageClass", "STANDARD"))
+            root.append(c)
+        for p in sorted(prefixes):
+            cp = ET.Element("CommonPrefixes")
+            cp.append(_leaf("Prefix", p))
+            root.append(cp)
+        if v2:
+            root.append(_leaf("KeyCount", len(keys) + len(prefixes)))
+            if truncated and keys:
+                root.append(_leaf("NextContinuationToken",
+                                  urllib.parse.quote(keys[-1][0])))
+        elif truncated and keys:
+            root.append(_leaf("NextMarker", keys[-1][0]))
+        return _xml_response(root)
+
+    def _walk_keys(self, bucket: str, prefix: str, delimiter: str,
+                   start_after: str, max_keys: int):
+        """Walk the bucket subtree in lexical key order, grouping by
+        delimiter. Returns (keys, common_prefixes, truncated)."""
+        base = f"{BUCKETS_DIR}/{bucket}"
+        keys: list[tuple[str, dict]] = []
+        prefixes: set[str] = set()
+        truncated = False
+
+        def list_dir(dirpath: str, last: str = ""):
+            out = []
+            while True:
+                r = requests.get(
+                    f"{self.filer_url}{urllib.parse.quote(dirpath)}/",
+                    params={"limit": "1024", "lastFileName": last},
+                    timeout=60)
+                if r.status_code != 200:
+                    return out
+                body = r.json()
+                out.extend(body.get("entries", []))
+                if not body.get("shouldDisplayLoadMore"):
+                    return out
+                last = body.get("lastFileName", "")
+
+        def walk(dirpath: str) -> bool:
+            nonlocal truncated
+            for e in list_dir(dirpath):
+                name = e["full_path"].rsplit("/", 1)[-1]
+                rel = e["full_path"][len(base) + 1:]
+                is_dir = bool(e["mode"] & 0o40000)
+                if rel.split("/")[0] == UPLOADS_DIR:
+                    continue
+                probe = rel + ("/" if is_dir else "")
+                if prefix and not (probe.startswith(prefix)
+                                   or prefix.startswith(probe)):
+                    continue
+                if is_dir:
+                    sub = rel + "/"
+                    # group only dirs strictly below the prefix; a dir
+                    # equal to the prefix must be recursed into
+                    # (prefix=dir1/ delimiter=/ lists dir1/'s files)
+                    if delimiter == "/" and sub != prefix and \
+                            sub.startswith(prefix):
+                        grouped = prefix + \
+                            sub[len(prefix):].split("/")[0] + "/"
+                        if grouped > (start_after or ""):
+                            prefixes.add(grouped)
+                        continue
+                    if not walk(e["full_path"]):
+                        return False
+                else:
+                    if not rel.startswith(prefix):
+                        continue
+                    if start_after and rel <= start_after:
+                        continue
+                    if delimiter == "/" and \
+                            "/" in rel[len(prefix):]:
+                        prefixes.add(
+                            prefix + rel[len(prefix):].split("/")[0]
+                            + "/")
+                        continue
+                    if len(keys) >= max_keys:
+                        truncated = True
+                        return False
+                    keys.append((rel, e))
+            return True
+
+        walk(base)
+        return keys, prefixes, truncated
+
+    # -- multipart ------------------------------------------------------
+    def _upload_dir(self, bucket: str, upload_id: str) -> str:
+        return f"{UPLOADS_DIR}/{upload_id}"
+
+    async def _initiate_multipart(self, bucket: str, key: str,
+                                  req: web.Request) -> web.Response:
+        await self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        marker = {"full_path": "", "mime": "application/json",
+                  "extended": {"s3_key": key, "mime": req.headers.get(
+                      "Content-Type", "")}, "mode": 0o40775}
+        await self._filer(
+            "PUT", self._fpath(bucket, self._upload_dir(
+                bucket, upload_id)) + "?meta=1",
+            json=marker)
+        root = _xml("InitiateMultipartUploadResult")
+        root.append(_leaf("Bucket", bucket))
+        root.append(_leaf("Key", key))
+        root.append(_leaf("UploadId", upload_id))
+        return _xml_response(root)
+
+    async def _upload_marker(self, bucket: str, upload_id: str) -> dict:
+        resp = await self._filer(
+            "GET", self._fpath(bucket, self._upload_dir(bucket,
+                                                        upload_id)),
+            params={"meta": "1"})
+        if resp.status_code != 200:
+            raise S3Error(*ERR_NO_SUCH_UPLOAD)
+        return resp.json()
+
+    async def _upload_part(self, bucket: str, upload_id: str,
+                           part_number: int,
+                           payload: bytes) -> web.Response:
+        await self._upload_marker(bucket, upload_id)
+        part_path = f"{self._upload_dir(bucket, upload_id)}/" \
+            f"{part_number:05d}.part"
+        resp = await self._filer("POST", self._fpath(bucket, part_path),
+                                 params={"collection": bucket},
+                                 data=payload)
+        etag = resp.json().get("etag", "")
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _complete_multipart(self, bucket: str, key: str,
+                                  upload_id: str,
+                                  payload: bytes) -> web.Response:
+        marker = await self._upload_marker(bucket, upload_id)
+        want_parts = []
+        if payload:
+            root = ET.fromstring(payload)
+            for p in root.iter():
+                if p.tag.endswith("Part"):
+                    num = _find(p, "PartNumber")
+                    if num is not None:
+                        want_parts.append(int(num.text))
+        updir = self._upload_dir(bucket, upload_id)
+        listing = await self._filer("GET", self._fpath(bucket, updir)
+                                    + "/")
+        parts = sorted(
+            (e for e in listing.json().get("entries", [])
+             if e["full_path"].endswith(".part")),
+            key=lambda e: e["full_path"])
+        if want_parts:
+            by_num = {int(e["full_path"].rsplit("/", 1)[-1][:5]): e
+                      for e in parts}
+            try:
+                parts = [by_num[n] for n in sorted(want_parts)]
+            except KeyError:
+                raise S3Error("InvalidPart", "listed part missing", 400)
+        offset, chunks, etags = 0, [], []
+        for e in parts:
+            for ch in e.get("chunks", []):
+                chunks.append({"fid": ch["fid"],
+                               "offset": offset + ch["offset"],
+                               "size": ch["size"],
+                               "mtime_ns": ch["mtime_ns"],
+                               "etag": ch.get("etag", "")})
+            psize = sum(ch["size"] for ch in e.get("chunks", []))
+            offset += psize
+            if e.get("md5"):
+                etags.append(e["md5"])
+        final_etag = hashlib.md5(
+            b"".join(bytes.fromhex(t) for t in etags)).hexdigest() + \
+            f"-{len(parts)}"
+        entry = {"mime": marker.get("extended", {}).get("mime", "") or
+                 "application/octet-stream",
+                 "md5": "", "collection": bucket, "chunks": chunks,
+                 "extended": {"s3_etag": final_etag}}
+        resp = await self._filer("PUT",
+                                 self._fpath(bucket, key) + "?meta=1",
+                                 json=entry)
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
+        # drop part entries without touching the shared chunks
+        await self._filer("DELETE", self._fpath(bucket, updir),
+                          params={"recursive": "true",
+                                  "skipChunkDeletion": "true"})
+        root = _xml("CompleteMultipartUploadResult")
+        root.append(_leaf("Bucket", bucket))
+        root.append(_leaf("Key", key))
+        root.append(_leaf("ETag", f'"{final_etag}"'))
+        return _xml_response(root)
+
+    async def _abort_multipart(self, bucket: str,
+                               upload_id: str) -> web.Response:
+        await self._filer(
+            "DELETE",
+            self._fpath(bucket, self._upload_dir(bucket, upload_id)),
+            params={"recursive": "true"})
+        return web.Response(status=204)
+
+    async def _list_multipart_uploads(self, bucket: str) -> web.Response:
+        listing = await self._filer(
+            "GET", self._fpath(bucket, UPLOADS_DIR) + "/")
+        root = _xml("ListMultipartUploadsResult")
+        root.append(_leaf("Bucket", bucket))
+        if listing.status_code == 200:
+            for e in listing.json().get("entries", []):
+                up = ET.Element("Upload")
+                up.append(_leaf("UploadId",
+                                e["full_path"].rsplit("/", 1)[-1]))
+                up.append(_leaf("Key", e.get("extended", {}).get(
+                    "s3_key", "")))
+                up.append(_leaf("Initiated", _iso(e.get("crtime", 0))))
+                root.append(up)
+        return _xml_response(root)
+
+    async def _list_parts(self, bucket: str, key: str,
+                          upload_id: str) -> web.Response:
+        await self._upload_marker(bucket, upload_id)
+        updir = self._upload_dir(bucket, upload_id)
+        listing = await self._filer("GET",
+                                    self._fpath(bucket, updir) + "/")
+        root = _xml("ListPartsResult")
+        root.append(_leaf("Bucket", bucket))
+        root.append(_leaf("Key", key))
+        root.append(_leaf("UploadId", upload_id))
+        for e in listing.json().get("entries", []):
+            if not e["full_path"].endswith(".part"):
+                continue
+            p = ET.Element("Part")
+            p.append(_leaf("PartNumber",
+                           int(e["full_path"].rsplit("/", 1)[-1][:5])))
+            p.append(_leaf("ETag", f'"{e.get("md5", "")}"'))
+            p.append(_leaf("Size", sum(ch["size"]
+                                       for ch in e.get("chunks", []))))
+            root.append(p)
+        return _xml_response(root)
+
+    # -- tagging --------------------------------------------------------
+    async def _tagging_op(self, method: str, bucket: str, key: str,
+                          payload: bytes) -> web.Response:
+        meta = await self._entry_meta(bucket, key)
+        ext = meta.get("extended", {})
+        if method == "GET":
+            root = _xml("Tagging")
+            tagset = ET.Element("TagSet")
+            for k, v in ext.items():
+                if k.startswith("s3_tag_"):
+                    t = ET.Element("Tag")
+                    t.append(_leaf("Key", k[len("s3_tag_"):]))
+                    t.append(_leaf("Value", v))
+                    tagset.append(t)
+            root.append(tagset)
+            return _xml_response(root)
+        ext = {k: v for k, v in ext.items()
+               if not k.startswith("s3_tag_")}
+        if method == "PUT":
+            root = ET.fromstring(payload)
+            for t in root.iter():
+                if t.tag.endswith("Tag"):
+                    k_el = _find(t, "Key")
+                    v_el = _find(t, "Value")
+                    if k_el is not None and v_el is not None:
+                        ext[f"s3_tag_{k_el.text}"] = v_el.text or ""
+        meta["extended"] = ext
+        meta.pop("full_path", None)
+        await self._filer("PUT", self._fpath(bucket, key) + "?meta=1",
+                          json=meta)
+        return web.Response(status=200 if method == "PUT" else 204)

@@ -30,7 +30,9 @@ class Cluster:
                  jwt_secret: str = "",
                  topology: list[tuple[str, str]] | None = None,
                  with_filer: bool = False,
-                 filer_store: str = "memory"):
+                 filer_store: str = "memory",
+                 with_s3: bool = False,
+                 s3_config: dict | None = None):
         """topology: optional per-server (data_center, rack) labels."""
         self.base_dir = base_dir
         self.master = MasterServer(
@@ -64,12 +66,18 @@ class Cluster:
             self.stores.append(store)
         self.filer: FilerServer | None = None
         self.filer_thread: ServerThread | None = None
-        if with_filer:
+        if with_filer or with_s3:
             store_path = os.path.join(base_dir, "filer.db") \
                 if filer_store == "sqlite" else ":memory:"
             self.filer = FilerServer(self.master_url, store=filer_store,
                                      store_path=store_path)
             self.filer_thread = ServerThread(self.filer.app).start()
+        self.s3 = None
+        self.s3_thread: ServerThread | None = None
+        if with_s3:
+            from ..s3.server import S3ApiServer
+            self.s3 = S3ApiServer(self.filer_url, iam_config=s3_config)
+            self.s3_thread = ServerThread(self.s3.app).start()
         self.wait_for_nodes(n_volume_servers)
 
     @property
@@ -113,7 +121,15 @@ class Cluster:
             raise RuntimeError(f"{path}: {out}")
         return out
 
+    @property
+    def s3_url(self) -> str:
+        if self.s3_thread is None:
+            raise RuntimeError("cluster started without s3")
+        return self.s3_thread.url
+
     def stop(self) -> None:
+        if self.s3_thread is not None:
+            self.s3_thread.stop()
         if self.filer_thread is not None:
             self.filer_thread.stop()
         for t in self.volume_threads:

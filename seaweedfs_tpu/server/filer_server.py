@@ -109,10 +109,10 @@ class FilerServer:
         if entry is None:
             return web.json_response(
                 {"error": f"not found: {path}"}, status=404)
-        if entry.is_directory:
+        if "meta" in req.query:  # before the dir branch: directory
+            return web.json_response(entry.to_dict())  # entries have
+        if entry.is_directory:                         # metadata too
             return await self._list_dir(req, path)
-        if "meta" in req.query:
-            return web.json_response(entry.to_dict())
         size = entry.file_size
         etag = entry.md5 or etag_chunks(entry.chunks)
         mime = (entry.mime or mimetypes.guess_type(path)[0]
@@ -170,6 +170,21 @@ class FilerServer:
         if "mv.from" in req.query:  # rename verb, reference-compatible
             self.filer.rename(req.query["mv.from"], path)
             return web.json_response({"path": path})
+        if "meta" in req.query:
+            # raw entry create: body is an Entry dict whose chunks point
+            # at already-uploaded fids (filer_pb CreateEntry — how the
+            # S3 gateway stitches multipart uploads and fast-copies)
+            d = json.loads(await req.text())
+            d["full_path"] = path
+            entry = Entry.from_dict(d)
+            old = self.filer.find_entry(path)
+            self.filer.create_entry(entry)
+            if old is not None and not old.is_directory:
+                keep = {c.fid for c in entry.chunks}
+                await asyncio.to_thread(
+                    self._delete_chunks,
+                    [c for c in old.chunks if c.fid not in keep])
+            return web.json_response(entry.to_dict(), status=201)
         if "mkdir" in req.query or (raw_path.endswith("/")
                                     and req.content_length in (None, 0)):
             e = self.filer.mkdir(path)
@@ -246,7 +261,10 @@ class FilerServer:
     async def handle_delete(self, req: web.Request) -> web.Response:
         path = norm_path("/" + req.match_info["path"])
         recursive = req.query.get("recursive", "") in ("true", "1")
-        self.filer.delete_entry(path, recursive=recursive)
+        delete_chunks = req.query.get("skipChunkDeletion", "") \
+            not in ("true", "1")
+        self.filer.delete_entry(path, recursive=recursive,
+                                delete_chunks=delete_chunks)
         return web.json_response({}, status=204)
 
     # -- KV -------------------------------------------------------------

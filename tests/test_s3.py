@@ -1,0 +1,321 @@
+"""S3 gateway integration tests against a live master+volume+filer+s3
+stack — the in-process analogue of the reference's ceph/s3-tests +
+test/s3/ suites (SURVEY.md section 4).
+"""
+import xml.etree.ElementTree as ET
+
+import pytest
+import requests
+
+from seaweedfs_tpu.s3.auth import presign_url, sign_request
+from seaweedfs_tpu.server.cluster import Cluster
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("s3_cluster")),
+                n_volume_servers=2, volume_size_limit=16 << 20,
+                with_s3=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(cluster):
+    return cluster.s3_url
+
+
+def put_bucket(s3, name):
+    return requests.put(f"{s3}/{name}")
+
+
+class TestBuckets:
+    def test_create_head_list_delete(self, s3):
+        assert put_bucket(s3, "b1").status_code == 200
+        assert requests.head(f"{s3}/b1").status_code == 200
+        body = requests.get(f"{s3}/").text
+        root = ET.fromstring(body)
+        names = [b.find(f"{NS}Name").text
+                 for b in root.iter(f"{NS}Bucket")]
+        assert "b1" in names
+        assert requests.delete(f"{s3}/b1").status_code == 204
+        assert requests.head(f"{s3}/b1").status_code == 404
+
+    def test_duplicate_create_conflicts(self, s3):
+        put_bucket(s3, "dup")
+        r = put_bucket(s3, "dup")
+        assert r.status_code == 409
+        assert "BucketAlreadyExists" in r.text
+
+    def test_delete_nonempty_conflicts(self, s3):
+        put_bucket(s3, "full")
+        requests.put(f"{s3}/full/x.txt", data=b"x")
+        r = requests.delete(f"{s3}/full")
+        assert r.status_code == 409
+        assert "BucketNotEmpty" in r.text
+
+
+class TestObjects:
+    def test_put_get_round_trip(self, s3):
+        put_bucket(s3, "obj")
+        r = requests.put(f"{s3}/obj/hello.txt", data=b"hello s3",
+                         headers={"Content-Type": "text/plain"})
+        assert r.status_code == 200
+        assert r.headers["ETag"]
+        got = requests.get(f"{s3}/obj/hello.txt")
+        assert got.content == b"hello s3"
+        head = requests.head(f"{s3}/obj/hello.txt")
+        assert head.status_code == 200
+        assert int(head.headers["Content-Length"]) == 8
+
+    def test_nested_key_and_range(self, s3):
+        put_bucket(s3, "obj2")
+        requests.put(f"{s3}/obj2/a/b/c/deep.bin", data=bytes(range(100)))
+        r = requests.get(f"{s3}/obj2/a/b/c/deep.bin",
+                         headers={"Range": "bytes=10-19"})
+        assert r.status_code == 206
+        assert r.content == bytes(range(10, 20))
+
+    def test_missing_key_xml_error(self, s3):
+        put_bucket(s3, "obj3")
+        r = requests.get(f"{s3}/obj3/ghost")
+        assert r.status_code == 404
+        assert "NoSuchKey" in r.text
+
+    def test_delete_object(self, s3):
+        put_bucket(s3, "obj4")
+        requests.put(f"{s3}/obj4/gone", data=b"bye")
+        assert requests.delete(f"{s3}/obj4/gone").status_code == 204
+        assert requests.get(f"{s3}/obj4/gone").status_code == 404
+
+    def test_copy_object(self, s3):
+        put_bucket(s3, "src")
+        put_bucket(s3, "dst")
+        requests.put(f"{s3}/src/orig.bin", data=b"copy me")
+        r = requests.put(f"{s3}/dst/copied.bin",
+                         headers={"x-amz-copy-source": "/src/orig.bin"})
+        assert r.status_code == 200
+        assert "CopyObjectResult" in r.text
+        assert requests.get(f"{s3}/dst/copied.bin").content == b"copy me"
+
+    def test_batch_delete(self, s3):
+        put_bucket(s3, "batch")
+        for k in ("one", "two", "three"):
+            requests.put(f"{s3}/batch/{k}", data=b"x")
+        body = (b"<Delete><Object><Key>one</Key></Object>"
+                b"<Object><Key>two</Key></Object></Delete>")
+        r = requests.post(f"{s3}/batch?delete", data=body)
+        assert r.status_code == 200
+        assert r.text.count("<Deleted>") == 2
+        assert requests.get(f"{s3}/batch/one").status_code == 404
+        assert requests.get(f"{s3}/batch/three").status_code == 200
+
+
+class TestListing:
+    @pytest.fixture(scope="class", autouse=True)
+    def keys(self, s3):
+        put_bucket(s3, "ls")
+        for k in ("a.txt", "b.txt", "dir1/x.txt", "dir1/y.txt",
+                  "dir2/z.txt"):
+            requests.put(f"{s3}/ls/{k}", data=b"1")
+
+    def parse(self, text):
+        root = ET.fromstring(text)
+        keys = [c.find(f"{NS}Key").text
+                for c in root.iter(f"{NS}Contents")]
+        prefixes = [p.find(f"{NS}Prefix").text
+                    for p in root.iter(f"{NS}CommonPrefixes")]
+        return root, keys, prefixes
+
+    def test_flat_list_v2(self, s3):
+        _, keys, _ = self.parse(requests.get(
+            f"{s3}/ls", params={"list-type": "2"}).text)
+        assert keys == ["a.txt", "b.txt", "dir1/x.txt", "dir1/y.txt",
+                        "dir2/z.txt"]
+
+    def test_delimiter_groups(self, s3):
+        _, keys, prefixes = self.parse(requests.get(
+            f"{s3}/ls", params={"list-type": "2", "delimiter": "/"}
+        ).text)
+        assert keys == ["a.txt", "b.txt"]
+        assert prefixes == ["dir1/", "dir2/"]
+
+    def test_prefix_within_dir(self, s3):
+        _, keys, _ = self.parse(requests.get(
+            f"{s3}/ls", params={"list-type": "2", "prefix": "dir1/"}
+        ).text)
+        assert keys == ["dir1/x.txt", "dir1/y.txt"]
+
+    def test_pagination(self, s3):
+        root, keys, _ = self.parse(requests.get(
+            f"{s3}/ls", params={"list-type": "2", "max-keys": "2"}).text)
+        assert keys == ["a.txt", "b.txt"]
+        assert root.find(f"{NS}IsTruncated").text == "true"
+        token = root.find(f"{NS}NextContinuationToken").text
+        _, keys2, _ = self.parse(requests.get(
+            f"{s3}/ls", params={"list-type": "2", "max-keys": "10",
+                                "continuation-token": token}).text)
+        assert keys2 == ["dir1/x.txt", "dir1/y.txt", "dir2/z.txt"]
+
+
+class TestListingEdgeCases:
+    def test_prefix_with_delimiter_navigates_folder(self, s3):
+        """aws s3 ls s3://b/dir1/ — prefix ending in '/' + delimiter."""
+        put_bucket(s3, "nav")
+        for k in ("dir1/x.txt", "dir1/sub/deep.txt", "top.txt"):
+            requests.put(f"{s3}/nav/{k}", data=b"1")
+        root = ET.fromstring(requests.get(
+            f"{s3}/nav", params={"list-type": "2", "prefix": "dir1/",
+                                 "delimiter": "/"}).text)
+        keys = [c.find(f"{NS}Key").text
+                for c in root.iter(f"{NS}Contents")]
+        prefixes = [p.find(f"{NS}Prefix").text
+                    for p in root.iter(f"{NS}CommonPrefixes")]
+        assert keys == ["dir1/x.txt"]
+        assert prefixes == ["dir1/sub/"]
+
+    def test_get_prefix_key_is_404(self, s3):
+        put_bucket(s3, "pfx")
+        requests.put(f"{s3}/pfx/d/inner.txt", data=b"1")
+        r = requests.get(f"{s3}/pfx/d")
+        assert r.status_code == 404
+        assert "NoSuchKey" in r.text
+
+    def test_delete_prefix_key_keeps_children(self, s3):
+        put_bucket(s3, "safe")
+        requests.put(f"{s3}/safe/d/keep.txt", data=b"1")
+        assert requests.delete(f"{s3}/safe/d").status_code == 204
+        assert requests.get(f"{s3}/safe/d/keep.txt").status_code == 200
+
+    def test_delete_bucket_with_upload_and_object(self, s3):
+        put_bucket(s3, "mixed")
+        requests.post(f"{s3}/mixed/f.bin?uploads")  # creates .uploads
+        requests.put(f"{s3}/mixed/real.txt", data=b"1")
+        r = requests.delete(f"{s3}/mixed")
+        assert r.status_code == 409
+        assert requests.get(f"{s3}/mixed/real.txt").status_code == 200
+
+
+class TestMultipart:
+    def test_full_flow(self, s3):
+        put_bucket(s3, "mp")
+        r = requests.post(f"{s3}/mp/large.bin?uploads")
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        part1 = b"A" * (1 << 20)
+        part2 = b"B" * 100
+        for i, part in ((1, part1), (2, part2)):
+            pr = requests.put(
+                f"{s3}/mp/large.bin",
+                params={"partNumber": str(i), "uploadId": upload_id},
+                data=part)
+            assert pr.status_code == 200, pr.text
+        lp = requests.get(f"{s3}/mp/large.bin",
+                          params={"uploadId": upload_id})
+        assert lp.text.count("<Part>") == 2
+        body = ("<CompleteMultipartUpload>"
+                "<Part><PartNumber>1</PartNumber></Part>"
+                "<Part><PartNumber>2</PartNumber></Part>"
+                "</CompleteMultipartUpload>").encode()
+        cr = requests.post(f"{s3}/mp/large.bin",
+                           params={"uploadId": upload_id}, data=body)
+        assert cr.status_code == 200, cr.text
+        etag = ET.fromstring(cr.text).find(f"{NS}ETag").text
+        assert etag.endswith('-2"') or etag.endswith("-2")
+        got = requests.get(f"{s3}/mp/large.bin")
+        assert got.content == part1 + part2
+        # ranged read across the part boundary
+        rng = requests.get(
+            f"{s3}/mp/large.bin",
+            headers={"Range": f"bytes={(1 << 20) - 2}-{(1 << 20) + 1}"})
+        assert rng.content == b"AABB"
+
+    def test_abort(self, s3):
+        put_bucket(s3, "mp2")
+        r = requests.post(f"{s3}/mp2/x.bin?uploads")
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        requests.put(f"{s3}/mp2/x.bin",
+                     params={"partNumber": "1", "uploadId": upload_id},
+                     data=b"junk")
+        assert requests.delete(
+            f"{s3}/mp2/x.bin",
+            params={"uploadId": upload_id}).status_code == 204
+        cr = requests.post(f"{s3}/mp2/x.bin",
+                           params={"uploadId": upload_id})
+        assert cr.status_code == 404
+
+
+class TestTagging:
+    def test_put_get_delete(self, s3):
+        put_bucket(s3, "tags")
+        requests.put(f"{s3}/tags/t.txt", data=b"x")
+        body = (b"<Tagging><TagSet><Tag><Key>env</Key>"
+                b"<Value>prod</Value></Tag></TagSet></Tagging>")
+        assert requests.put(f"{s3}/tags/t.txt?tagging",
+                            data=body).status_code == 200
+        got = requests.get(f"{s3}/tags/t.txt?tagging").text
+        assert "env" in got and "prod" in got
+        assert requests.delete(
+            f"{s3}/tags/t.txt?tagging").status_code == 204
+        got2 = requests.get(f"{s3}/tags/t.txt?tagging").text
+        assert "env" not in got2
+
+
+class TestSigV4:
+    @pytest.fixture(scope="class")
+    def auth_cluster(self, tmp_path_factory):
+        cfg = {"identities": [
+            {"name": "admin",
+             "credentials": [{"accessKey": "AKID", "secretKey": "SK"}],
+             "actions": ["Admin"]},
+            {"name": "reader",
+             "credentials": [{"accessKey": "RKID", "secretKey": "RS"}],
+             "actions": ["Read", "List"]},
+        ]}
+        c = Cluster(str(tmp_path_factory.mktemp("s3_auth")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_s3=True, s3_config=cfg)
+        yield c
+        c.stop()
+
+    def test_anonymous_denied(self, auth_cluster):
+        r = requests.put(f"{auth_cluster.s3_url}/priv")
+        assert r.status_code == 403
+        assert "AccessDenied" in r.text
+
+    def test_signed_round_trip(self, auth_cluster):
+        s3 = auth_cluster.s3_url
+        h = sign_request("PUT", f"{s3}/priv", "AKID", "SK")
+        assert requests.put(f"{s3}/priv", headers=h).status_code == 200
+        h = sign_request("PUT", f"{s3}/priv/f.txt", "AKID", "SK",
+                         payload=b"secret")
+        assert requests.put(f"{s3}/priv/f.txt", data=b"secret",
+                            headers=h).status_code == 200
+        h = sign_request("GET", f"{s3}/priv/f.txt", "AKID", "SK")
+        assert requests.get(f"{s3}/priv/f.txt",
+                            headers=h).content == b"secret"
+
+    def test_bad_signature_rejected(self, auth_cluster):
+        s3 = auth_cluster.s3_url
+        h = sign_request("GET", f"{s3}/priv/f.txt", "AKID", "WRONG")
+        r = requests.get(f"{s3}/priv/f.txt", headers=h)
+        assert r.status_code == 403
+        assert "SignatureDoesNotMatch" in r.text
+
+    def test_reader_cannot_write(self, auth_cluster):
+        s3 = auth_cluster.s3_url
+        h = sign_request("PUT", f"{s3}/priv/no.txt", "RKID", "RS",
+                         payload=b"nope")
+        r = requests.put(f"{s3}/priv/no.txt", data=b"nope", headers=h)
+        assert r.status_code == 403
+        h = sign_request("GET", f"{s3}/priv/f.txt", "RKID", "RS")
+        assert requests.get(f"{s3}/priv/f.txt",
+                            headers=h).status_code == 200
+
+    def test_presigned_url(self, auth_cluster):
+        s3 = auth_cluster.s3_url
+        url = presign_url("GET", f"{s3}/priv/f.txt", "AKID", "SK")
+        assert requests.get(url).content == b"secret"
+        bad = url.replace("X-Amz-Signature=", "X-Amz-Signature=0")
+        assert requests.get(bad).status_code == 403
