@@ -1,0 +1,37 @@
+"""Build the native library: g++ -> libseaweed_native.so.
+
+Run directly (`python seaweedfs_tpu/native/build.py`) or let
+seaweedfs_tpu.native build lazily on first import. No pybind11 — the
+ABI is a C `extern "C"` surface consumed via ctypes.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "gf256_codec.cc")
+LIB = os.path.join(HERE, "libseaweed_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    """Compile if missing or stale; returns the .so path."""
+    if os.path.exists(LIB) and \
+            os.path.getmtime(LIB) >= os.path.getmtime(SRC):
+        return LIB
+    # compile to a temp name + rename so a concurrent process never
+    # dlopens a half-written library
+    tmp = LIB + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-std=c++17", "-o", tmp, SRC]
+    if verbose:
+        print("+", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    os.replace(tmp, LIB)
+    return LIB
+
+
+if __name__ == "__main__":
+    build()
+    print(LIB)

@@ -1,0 +1,214 @@
+// Native GF(256) Reed-Solomon kernels + CRC32C.
+//
+// The reference gets these from vendored native code:
+// klauspost/reedsolomon's AVX2/SSSE3 assembly (used at
+// /root/reference/weed/storage/erasure_coding/ec_encoder.go:202) and the
+// hardware Castagnoli CRC in hash/crc32 (weed/storage/needle/crc.go:12).
+// This file re-implements both for the host-side CPU path of the TPU
+// framework: the same split-nibble PSHUFB trick for GF(256) multiply
+// (16-entry low/high tables per coefficient, 16 bytes per instruction)
+// with a portable table fallback, and CRC32C via SSE4.2 crc32
+// instructions with a slicing-by-8 software fallback.
+//
+// Field: poly 0x11d, generator 2 — matches seaweedfs_tpu/ops/gf256.py
+// and klauspost, so shard bytes interoperate.
+//
+// Build: seaweedfs_tpu/native/build.py -> libseaweed_native.so (ctypes).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#define HAVE_AVX2 1
+#endif
+#if defined(__SSSE3__)
+#include <tmmintrin.h>
+#define HAVE_SSSE3 1
+#endif
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#define HAVE_SSE42 1
+#endif
+
+namespace {
+
+constexpr unsigned kPoly = 0x11d;
+
+uint8_t MUL[256][256];
+// Per-coefficient split-nibble tables: product of c with (low nibble)
+// and with (high nibble << 4). c*b = LOW[c][b&15] ^ HIGH[c][b>>4].
+alignas(16) uint8_t LOW[256][16];
+alignas(16) uint8_t HIGH[256][16];
+
+uint8_t gf_mul_slow(unsigned a, unsigned b) {
+  unsigned r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    a <<= 1;
+    if (a & 0x100) a ^= kPoly;
+    b >>= 1;
+  }
+  return static_cast<uint8_t>(r);
+}
+
+struct TableInit {
+  TableInit() {
+    for (unsigned a = 0; a < 256; ++a)
+      for (unsigned b = 0; b < 256; ++b) MUL[a][b] = gf_mul_slow(a, b);
+    for (unsigned c = 0; c < 256; ++c)
+      for (unsigned n = 0; n < 16; ++n) {
+        LOW[c][n] = MUL[c][n];
+        HIGH[c][n] = MUL[c][n << 4];
+      }
+  }
+} table_init;
+
+// dst ^= c * src over n bytes.
+void mul_xor_row(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+  if (c == 0) return;
+  size_t i = 0;
+  if (c == 1) {
+    for (; i + 8 <= n; i += 8) {
+      uint64_t a, b;
+      std::memcpy(&a, dst + i, 8);
+      std::memcpy(&b, src + i, 8);
+      a ^= b;
+      std::memcpy(dst + i, &a, 8);
+    }
+    for (; i < n; ++i) dst[i] ^= src[i];
+    return;
+  }
+#if HAVE_AVX2
+  {
+    const __m256i lo_tbl = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(LOW[c])));
+    const __m256i hi_tbl = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(HIGH[c])));
+    const __m256i nib = _mm256_set1_epi8(0x0f);
+    for (; i + 32 <= n; i += 32) {
+      __m256i s =
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+      __m256i d =
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+      __m256i lo = _mm256_and_si256(s, nib);
+      __m256i hi = _mm256_and_si256(_mm256_srli_epi64(s, 4), nib);
+      __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(lo_tbl, lo),
+                                      _mm256_shuffle_epi8(hi_tbl, hi));
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                          _mm256_xor_si256(d, prod));
+    }
+  }
+#endif
+#if HAVE_SSSE3
+  const __m128i lo_tbl =
+      _mm_load_si128(reinterpret_cast<const __m128i*>(LOW[c]));
+  const __m128i hi_tbl =
+      _mm_load_si128(reinterpret_cast<const __m128i*>(HIGH[c]));
+  const __m128i nib = _mm_set1_epi8(0x0f);
+  for (; i + 16 <= n; i += 16) {
+    __m128i s =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m128i d =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(dst + i));
+    __m128i lo = _mm_and_si128(s, nib);
+    __m128i hi = _mm_and_si128(_mm_srli_epi64(s, 4), nib);
+    __m128i prod = _mm_xor_si128(_mm_shuffle_epi8(lo_tbl, lo),
+                                 _mm_shuffle_epi8(hi_tbl, hi));
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm_xor_si128(d, prod));
+  }
+#endif
+  const uint8_t* row = MUL[c];
+  for (; i < n; ++i) dst[i] ^= row[src[i]];
+}
+
+// ---- CRC32C (Castagnoli, reflected poly 0x82f63b78) ------------------
+uint32_t CRC_TBL[8][256];
+
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0x82f63b78u ^ (c >> 1) : c >> 1;
+      CRC_TBL[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int t = 1; t < 8; ++t)
+        CRC_TBL[t][i] =
+            CRC_TBL[t - 1][i] >> 8 ^ CRC_TBL[0][CRC_TBL[t - 1][i] & 0xff];
+  }
+} crc_init;
+
+}  // namespace
+
+extern "C" {
+
+// out[i,:] = XOR_j coef[i,j] * shards[j,:]  over GF(256).
+// coef: m*k row-major; shards: k*n row-major; out: m*n row-major
+// (zeroed here).
+void gf256_coded_matmul(const uint8_t* coef, int m, int k,
+                        const uint8_t* shards, int64_t n, uint8_t* out) {
+  std::memset(out, 0, static_cast<size_t>(m) * n);
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j)
+      mul_xor_row(coef[i * k + j], shards + static_cast<size_t>(j) * n,
+                  out + static_cast<size_t>(i) * n, n);
+}
+
+// dst ^= c * src (exposed for incremental/streaming encode).
+void gf256_mul_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                   int64_t n) {
+  mul_xor_row(c, src, dst, static_cast<size_t>(n));
+}
+
+uint32_t crc32c_update(uint32_t crc, const uint8_t* data, int64_t len) {
+  crc = ~crc;
+  size_t n = static_cast<size_t>(len);
+  size_t i = 0;
+#if HAVE_SSE42
+  for (; i + 8 <= n; i += 8) {
+    uint64_t v;
+    std::memcpy(&v, data + i, 8);
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
+  }
+  for (; i < n; ++i) crc = _mm_crc32_u8(crc, data[i]);
+#else
+  for (; i + 8 <= n; i += 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, data + i, 4);
+    std::memcpy(&hi, data + i + 4, 4);
+    lo ^= crc;
+    crc = CRC_TBL[7][lo & 0xff] ^ CRC_TBL[6][(lo >> 8) & 0xff] ^
+          CRC_TBL[5][(lo >> 16) & 0xff] ^ CRC_TBL[4][lo >> 24] ^
+          CRC_TBL[3][hi & 0xff] ^ CRC_TBL[2][(hi >> 8) & 0xff] ^
+          CRC_TBL[1][(hi >> 16) & 0xff] ^ CRC_TBL[0][hi >> 24];
+  }
+  for (; i < n; ++i)
+    crc = crc >> 8 ^ CRC_TBL[0][(crc ^ data[i]) & 0xff];
+#endif
+  return ~crc;
+}
+
+// Batched CRC32C: m rows of n bytes each -> m crcs (the TPU scrub
+// pipeline's host-side check, BASELINE.json batched-scrub config).
+void crc32c_batch(const uint8_t* rows, int m, int64_t n, uint32_t* out) {
+  for (int i = 0; i < m; ++i)
+    out[i] = crc32c_update(0, rows + static_cast<size_t>(i) * n, n);
+}
+
+int native_simd_level() {
+#if HAVE_AVX2
+  return 3;
+#elif HAVE_SSE42 && HAVE_SSSE3
+  return 2;
+#elif HAVE_SSSE3
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,22 @@
+"""`native` codec backend: the in-tree C++ SIMD kernel via ctypes.
+
+The klauspost-equivalent CPU path (SURVEY.md section 2.1) — split-nibble
+PSHUFB GF(256) multiply — wrapped in the CodecBackend protocol so
+`-ec.backend=native` selects it through the registry (ec/backend.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import native
+
+
+class NativeCodec:
+    name = "native"
+
+    def __init__(self):
+        native.load()  # build + bind eagerly so failures surface here
+
+    def coded_matmul(self, coef: np.ndarray,
+                     shards: np.ndarray) -> np.ndarray:
+        return native.coded_matmul(coef, shards)

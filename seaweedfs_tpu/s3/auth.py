@@ -117,6 +117,14 @@ class IdentityAccessManagement:
         identity, secret = self.lookup(access_key)
         signed_headers = fields.get("SignedHeaders", "").split(";")
         amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date", "")
+        # the declared payload hash must match the actual body, or a
+        # captured signature authorizes arbitrary substituted bodies
+        declared = headers.get(
+            "x-amz-content-sha256",
+            headers.get("X-Amz-Content-Sha256", payload_hash))
+        if declared != "UNSIGNED-PAYLOAD" and declared != payload_hash:
+            raise S3AuthError("XAmzContentSHA256Mismatch",
+                              "payload hash does not match body", 400)
         # SigV4 requires rejecting stale requests or any captured
         # signed request replays forever
         try:

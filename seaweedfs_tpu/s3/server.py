@@ -389,6 +389,7 @@ class S3ApiServer:
 
     async def _copy_object(self, bucket: str, key: str,
                            src: str) -> web.Response:
+        await self._require_bucket(bucket)
         src = urllib.parse.unquote(src.lstrip("/"))
         src_bucket, _, src_key = src.partition("/")
         meta = await self._entry_meta(src_bucket, src_key)
@@ -400,6 +401,8 @@ class S3ApiServer:
             params={"collection": bucket}, data=data.content,
             headers={"Content-Type": meta.get(
                 "mime", "application/octet-stream")})
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
         etag = resp.json().get("etag", "")
         root = _xml("CopyObjectResult")
         root.append(_leaf("ETag", f'"{etag}"'))
@@ -418,7 +421,7 @@ class S3ApiServer:
         if token:
             start_after = urllib.parse.unquote(token)
 
-        keys, prefixes, truncated = await asyncio.to_thread(
+        items, truncated = await asyncio.to_thread(
             self._walk_keys, bucket, prefix, delimiter, start_after,
             max_keys)
 
@@ -429,9 +432,11 @@ class S3ApiServer:
         root.append(_leaf("IsTruncated", "true" if truncated else "false"))
         if delimiter:
             root.append(_leaf("Delimiter", delimiter))
-        for k, meta in keys:
+        for kind, name, meta in items:
+            if kind != "key":
+                continue
             c = ET.Element("Contents")
-            c.append(_leaf("Key", k))
+            c.append(_leaf("Key", name))
             c.append(_leaf("LastModified", _iso(meta.get("mtime", 0))))
             etag = meta.get("md5", "")
             c.append(_leaf("ETag", f'"{etag}"'))
@@ -439,26 +444,30 @@ class S3ApiServer:
                 ch["size"] for ch in meta.get("chunks", []))))
             c.append(_leaf("StorageClass", "STANDARD"))
             root.append(c)
-        for p in sorted(prefixes):
-            cp = ET.Element("CommonPrefixes")
-            cp.append(_leaf("Prefix", p))
-            root.append(cp)
+        for kind, name, _ in items:
+            if kind == "prefix":
+                cp = ET.Element("CommonPrefixes")
+                cp.append(_leaf("Prefix", name))
+                root.append(cp)
         if v2:
-            root.append(_leaf("KeyCount", len(keys) + len(prefixes)))
-            if truncated and keys:
+            root.append(_leaf("KeyCount", len(items)))
+            if truncated and items:
                 root.append(_leaf("NextContinuationToken",
-                                  urllib.parse.quote(keys[-1][0])))
-        elif truncated and keys:
-            root.append(_leaf("NextMarker", keys[-1][0]))
+                                  urllib.parse.quote(items[-1][1])))
+        elif truncated and items:
+            root.append(_leaf("NextMarker", items[-1][1]))
         return _xml_response(root)
 
     def _walk_keys(self, bucket: str, prefix: str, delimiter: str,
                    start_after: str, max_keys: int):
-        """Walk the bucket subtree in lexical key order, grouping by
-        delimiter. Returns (keys, common_prefixes, truncated)."""
+        """Walk the bucket subtree in lexical order, grouping by
+        delimiter. Returns (items, truncated) where items is an ordered
+        list of ("key", name, meta) / ("prefix", name, {}) — prefixes
+        count toward max_keys and pagination resumes after the LAST
+        item of either kind, matching S3 semantics."""
         base = f"{BUCKETS_DIR}/{bucket}"
-        keys: list[tuple[str, dict]] = []
-        prefixes: set[str] = set()
+        items: list[tuple[str, str, dict]] = []
+        seen_prefixes: set[str] = set()
         truncated = False
 
         def list_dir(dirpath: str, last: str = ""):
@@ -497,8 +506,13 @@ class S3ApiServer:
                             sub.startswith(prefix):
                         grouped = prefix + \
                             sub[len(prefix):].split("/")[0] + "/"
-                        if grouped > (start_after or ""):
-                            prefixes.add(grouped)
+                        if grouped > (start_after or "") and \
+                                grouped not in seen_prefixes:
+                            if len(items) >= max_keys:
+                                truncated = True
+                                return False
+                            seen_prefixes.add(grouped)
+                            items.append(("prefix", grouped, {}))
                         continue
                     if not walk(e["full_path"]):
                         return False
@@ -509,18 +523,24 @@ class S3ApiServer:
                         continue
                     if delimiter == "/" and \
                             "/" in rel[len(prefix):]:
-                        prefixes.add(
-                            prefix + rel[len(prefix):].split("/")[0]
-                            + "/")
+                        grouped = prefix + \
+                            rel[len(prefix):].split("/")[0] + "/"
+                        if grouped > (start_after or "") and \
+                                grouped not in seen_prefixes:
+                            if len(items) >= max_keys:
+                                truncated = True
+                                return False
+                            seen_prefixes.add(grouped)
+                            items.append(("prefix", grouped, {}))
                         continue
-                    if len(keys) >= max_keys:
+                    if len(items) >= max_keys:
                         truncated = True
                         return False
-                    keys.append((rel, e))
+                    items.append(("key", rel, e))
             return True
 
         walk(base)
-        return keys, prefixes, truncated
+        return items, truncated
 
     # -- multipart ------------------------------------------------------
     def _upload_dir(self, bucket: str, upload_id: str) -> str:
@@ -561,6 +581,8 @@ class S3ApiServer:
         resp = await self._filer("POST", self._fpath(bucket, part_path),
                                  params={"collection": bucket},
                                  data=payload)
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
         etag = resp.json().get("etag", "")
         return web.Response(status=200, headers={"ETag": f'"{etag}"'})
 

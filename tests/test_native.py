@@ -1,0 +1,91 @@
+"""Native C++ kernel tests: GF(256) SIMD codec + CRC32C, bit-exact
+against the numpy reference (the same golden contract every backend
+must satisfy — SURVEY.md section 4 golden test).
+"""
+import numpy as np
+import pytest
+
+pytest.importorskip("ctypes")
+nat = pytest.importorskip("seaweedfs_tpu.native")
+
+if not nat.available():
+    pytest.skip("no g++ and no prebuilt .so", allow_module_level=True)
+
+from seaweedfs_tpu.ec.backend import ReedSolomon, get_backend
+from seaweedfs_tpu.ops import codec_numpy
+
+
+class TestGf256Kernel:
+    @pytest.mark.parametrize("m,k,n", [
+        (4, 10, 1), (4, 10, 15), (4, 10, 1024), (4, 10, 100_003),
+        (14, 14, 4096), (1, 1, 33), (28, 4, 257),
+    ])
+    def test_matches_numpy(self, m, k, n):
+        rng = np.random.default_rng(m * 1000 + n)
+        coef = rng.integers(0, 256, (m, k)).astype(np.uint8)
+        shards = rng.integers(0, 256, (k, n)).astype(np.uint8)
+        assert np.array_equal(nat.coded_matmul(coef, shards),
+                              codec_numpy.coded_matmul(coef, shards))
+
+    def test_zero_and_identity_coefficients(self):
+        shards = np.arange(30, dtype=np.uint8).reshape(3, 10)
+        coef = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 1]], dtype=np.uint8)
+        out = nat.coded_matmul(coef, shards)
+        assert np.array_equal(out[0], np.zeros(10, dtype=np.uint8))
+        assert np.array_equal(out[1], shards[0])
+        assert np.array_equal(out[2], shards[1] ^ shards[2])
+
+    def test_simd_level_reported(self):
+        assert nat.simd_level() in (0, 1, 2, 3)
+
+
+class TestNativeBackendRegistry:
+    def test_reed_solomon_round_trip(self):
+        rs = ReedSolomon(10, 4, backend="native")
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, (10, 2048)).astype(np.uint8)
+        parity = rs.encode(data)
+        full = np.concatenate([data, parity])
+        assert rs.verify(full)
+        # lose 4 shards, rebuild
+        present = {i: full[i] for i in range(14) if i not in (0, 3, 9, 12)}
+        rec = rs.reconstruct(present)
+        for sid in (0, 3, 9, 12):
+            assert np.array_equal(rec[sid], full[sid]), sid
+
+    def test_backend_matches_numpy_backend(self):
+        rs_nat = ReedSolomon(10, 4, backend="native")
+        rs_np = ReedSolomon(10, 4, backend="numpy")
+        rng = np.random.default_rng(8)
+        data = rng.integers(0, 256, (10, 999)).astype(np.uint8)
+        assert np.array_equal(rs_nat.encode(data), rs_np.encode(data))
+
+    def test_get_backend(self):
+        assert get_backend("native").name == "native"
+
+
+class TestCrc32c:
+    def test_known_vector(self):
+        assert nat.crc32c(b"123456789") == 0xE3069283
+
+    def test_matches_google_crc32c(self):
+        google_crc32c = pytest.importorskip("google_crc32c")
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 256, 100_001).astype(np.uint8).tobytes()
+        assert nat.crc32c(data) == google_crc32c.value(data)
+
+    def test_incremental(self):
+        data = b"seaweedfs-tpu" * 1000
+        whole = nat.crc32c(data)
+        part = nat.crc32c(data[7000:], nat.crc32c(data[:7000]))
+        assert part == whole
+
+    def test_batch(self):
+        rng = np.random.default_rng(10)
+        rows = rng.integers(0, 256, (8, 513)).astype(np.uint8)
+        crcs = nat.crc32c_batch(rows)
+        for i in range(8):
+            assert int(crcs[i]) == nat.crc32c(rows[i].tobytes())
+
+    def test_empty(self):
+        assert nat.crc32c(b"") == 0

@@ -198,6 +198,54 @@ class TestListingEdgeCases:
         assert requests.get(f"{s3}/mixed/real.txt").status_code == 200
 
 
+class TestPaginationWithPrefixes:
+    def test_no_duplicate_prefixes_across_pages(self, s3):
+        """Prefixes count toward max-keys; concatenated pages must not
+        repeat a CommonPrefix."""
+        put_bucket(s3, "pgx")
+        for k in ("a1", "a2", "a3", "zdir/f.txt"):
+            requests.put(f"{s3}/pgx/{k}", data=b"1")
+        seen_keys, seen_prefixes, token = [], [], ""
+        for _ in range(10):
+            params = {"list-type": "2", "max-keys": "2",
+                      "delimiter": "/"}
+            if token:
+                params["continuation-token"] = token
+            root = ET.fromstring(requests.get(f"{s3}/pgx",
+                                              params=params).text)
+            seen_keys += [c.find(f"{NS}Key").text
+                          for c in root.iter(f"{NS}Contents")]
+            seen_prefixes += [p.find(f"{NS}Prefix").text
+                              for p in root.iter(f"{NS}CommonPrefixes")]
+            if root.find(f"{NS}IsTruncated").text != "true":
+                break
+            token = root.find(f"{NS}NextContinuationToken").text
+        assert seen_keys == ["a1", "a2", "a3"]
+        assert seen_prefixes == ["zdir/"]
+
+
+class TestContentIntegrity:
+    def test_tampered_body_rejected(self, tmp_path_factory):
+        cfg = {"identities": [{"name": "w", "credentials": [
+            {"accessKey": "WK", "secretKey": "WS"}],
+            "actions": ["Admin"]}]}
+        c = Cluster(str(tmp_path_factory.mktemp("s3_integrity")),
+                    n_volume_servers=1, with_s3=True, s3_config=cfg)
+        try:
+            s3 = c.s3_url
+            h = sign_request("PUT", f"{s3}/ib", "WK", "WS")
+            assert requests.put(f"{s3}/ib",
+                                headers=h).status_code == 200
+            h = sign_request("PUT", f"{s3}/ib/f", "WK", "WS",
+                             payload=b"original")
+            # replay the captured signature with a substituted body
+            r = requests.put(f"{s3}/ib/f", data=b"TAMPERED", headers=h)
+            assert r.status_code == 400
+            assert "XAmzContentSHA256Mismatch" in r.text
+        finally:
+            c.stop()
+
+
 class TestMultipart:
     def test_full_flow(self, s3):
         put_bucket(s3, "mp")
