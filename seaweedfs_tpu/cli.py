@@ -26,6 +26,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-jwt.secret", dest="jwt_secret", default="")
+    p.add_argument("-peers", default="",
+                   help="comma-separated ip:port of all masters (HA mode)")
+    p.add_argument("-raftDir", dest="raft_dir", default="",
+                   help="raft log/term persistence dir")
 
     p = sub.add_parser("volume", help="start a volume server")
     p.add_argument("-port", type=int, default=8080)
@@ -126,9 +130,22 @@ def _run_master(args) -> int:
     from .rpc.http import ServerThread, run_apps_forever
     from .server.master_server import MasterServer
 
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+    raft_dir = args.raft_dir
+    if peers and not raft_dir:
+        # raft safety requires durable term/vote/log: a master that
+        # restarts without them could vote twice in one term and elect
+        # two leaders
+        raft_dir = os.path.join(
+            os.path.expanduser("~"), ".seaweedfs_tpu", "raft")
+        print(f"-raftDir not set; persisting raft state to {raft_dir}")
+    if raft_dir:
+        os.makedirs(raft_dir, exist_ok=True)
     ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20,
                       default_replication=args.defaultReplication,
-                      jwt_secret=args.jwt_secret)
+                      jwt_secret=args.jwt_secret,
+                      me=f"{args.ip}:{args.port}", peers=peers,
+                      raft_state_dir=raft_dir or None)
     t = ServerThread(ms.app, host=args.ip, port=args.port).start()
     print(f"master listening on {t.url}")
     run_apps_forever([t])
@@ -145,9 +162,8 @@ def _run_volume(args) -> int:
                   ec_backend=args.ec_backend)
     for loc in store.locations:
         loc.max_volumes = args.max
-    master = args.mserver if args.mserver.startswith("http") else \
-        f"http://{args.mserver}"
-    vs = VolumeServer(store, master, data_center=args.dataCenter,
+    # scheme normalization for each master happens inside VolumeServer
+    vs = VolumeServer(store, args.mserver, data_center=args.dataCenter,
                       rack=args.rack)
     t = ServerThread(vs.app, host=args.ip, port=args.port).start()
     store.port = t.port

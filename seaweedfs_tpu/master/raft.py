@@ -1,0 +1,518 @@
+"""Raft consensus for master HA.
+
+Equivalent of the reference's hashicorp-raft integration
+(/root/reference/weed/server/raft_hashicorp.go:99 NewHashicorpRaftServer,
+raft_server.go:72 StateMachine.Apply): leader election + replicated log
+whose state machine is just the cluster's MaxVolumeId — the only fact
+masters must agree on before handing out volume ids.
+
+Design: asyncio single-threaded per node; a pluggable `Transport` lets
+tests run a 3-node cluster deterministically in-process (the reference's
+strategy of testing cluster logic without a cluster, SURVEY.md section 4)
+while `HTTPTransport` carries the same two RPCs (/raft/request_vote,
+/raft/append_entries) between real master processes over DCN. Log +
+term/vote are persisted to a JSON sidecar (the boltdb-store analog);
+snapshots are implicit because the FSM is a single integer carried in
+every AppendEntries commit.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict  # {"op": "max_volume_id", "value": N}
+
+    def to_json(self) -> dict:
+        return {"term": self.term, "command": self.command}
+
+    @staticmethod
+    def from_json(d: dict) -> "LogEntry":
+        return LogEntry(d["term"], d["command"])
+
+
+class MaxVolumeIdFSM:
+    """The replicated state machine: a monotonic volume-id high-water mark
+    (reference raft_server.go:53-99 — its FSM is exactly this)."""
+
+    def __init__(self) -> None:
+        self.max_volume_id = 0
+
+    def apply(self, command: dict) -> None:
+        if command.get("op") == "max_volume_id":
+            self.max_volume_id = max(self.max_volume_id,
+                                     int(command["value"]))
+
+
+class Transport:
+    """RPC carrier between raft peers."""
+
+    async def request_vote(self, peer: str, args: dict) -> dict | None:
+        raise NotImplementedError
+
+    async def append_entries(self, peer: str, args: dict) -> dict | None:
+        raise NotImplementedError
+
+
+class MemoryTransport(Transport):
+    """In-process transport for deterministic cluster tests; supports
+    partitioning nodes to exercise elections."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, "RaftNode"] = {}
+        self.partitioned: set[str] = set()
+
+    def register(self, node: "RaftNode") -> None:
+        self.nodes[node.me] = node
+
+    def _reachable(self, a: str, b: str) -> bool:
+        return a not in self.partitioned and b not in self.partitioned
+
+    async def request_vote(self, peer: str, args: dict) -> dict | None:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["candidate"], peer):
+            return None
+        return node.on_request_vote(args)
+
+    async def append_entries(self, peer: str, args: dict) -> dict | None:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["leader"], peer):
+            return None
+        return node.on_append_entries(args)
+
+
+class HTTPTransport(Transport):
+    """aiohttp carrier for real multi-process masters."""
+
+    def __init__(self, timeout: float = 2.0) -> None:
+        self._timeout = timeout
+        self._session = None
+
+    async def _sess(self):
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout))
+        return self._session
+
+    async def _post(self, peer: str, path: str, args: dict) -> dict | None:
+        try:
+            sess = await self._sess()
+            async with sess.post(f"http://{peer}{path}", json=args) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+        except Exception:
+            return None
+
+    async def request_vote(self, peer: str, args: dict) -> dict | None:
+        return await self._post(peer, "/raft/request_vote", args)
+
+    async def append_entries(self, peer: str, args: dict) -> dict | None:
+        return await self._post(peer, "/raft/append_entries", args)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+class RaftNode:
+    """One raft participant. Election + log replication + commit.
+
+    Timing is scaled by `tick` so tests can run elections in
+    milliseconds; production masters use the default ~150-300ms
+    election window over DCN.
+    """
+
+    def __init__(self, me: str, peers: list[str], transport: Transport,
+                 state_dir: str | None = None, tick: float = 1.0,
+                 on_apply=None):
+        self.me = me
+        self.peers = [p for p in peers if p != me]
+        self.transport = transport
+        self.state_dir = state_dir
+        self.tick = tick
+        self.fsm = MaxVolumeIdFSM()
+        self.on_apply = on_apply
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0   # 1-based index of highest committed entry
+        self.last_applied = 0
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._last_heartbeat = time.monotonic()
+        self._stop = False
+        self._tasks: list[asyncio.Task] = []
+        self._hb_task: asyncio.Task | None = None
+        self._term_start_index = 0
+        # (index, expected term, future): a waiter succeeds only if the
+        # entry committed at `index` is the one appended under
+        # `expected term` — a deposed leader's overwritten entry must
+        # resolve False, not success
+        self._commit_waiters: list[tuple[int, int, asyncio.Future]] = []
+        if self.me not in peers and peers:
+            print(f"raft: warning: own address {self.me!r} not found in "
+                  f"peers {peers} — check -ip/-port vs -peers spelling; "
+                  "a self-alias under another name breaks elections")
+
+        self._load()
+
+    # ------------------------------------------------------------------
+    # persistence (boltdb-store analog)
+    # ------------------------------------------------------------------
+    def _state_path(self) -> str | None:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir,
+                            f"raft_{self.me.replace(':', '_')}.json")
+
+    def _persist(self) -> None:
+        path = self._state_path()
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term, "voted_for": self.voted_for,
+                       "log": [e.to_json() for e in self.log]}, f)
+        os.replace(tmp, path)
+
+    def _load(self) -> None:
+        path = self._state_path()
+        if not path or not os.path.exists(path):
+            return
+        with open(path) as f:
+            d = json.load(f)
+        self.current_term = d["term"]
+        self.voted_for = d.get("voted_for")
+        self.log = [LogEntry.from_json(e) for e in d.get("log", [])]
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._stop = False
+        self._tasks.append(asyncio.create_task(self._election_loop()))
+
+    async def stop(self) -> None:
+        self._stop = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+
+    def _election_timeout(self) -> float:
+        return random.uniform(0.15, 0.3) * self.tick
+
+    async def _election_loop(self) -> None:
+        while not self._stop:
+            timeout = self._election_timeout()
+            await asyncio.sleep(timeout / 3)
+            if self.state == LEADER:
+                continue
+            if time.monotonic() - self._last_heartbeat > timeout:
+                await self._run_election()
+
+    async def _run_election(self) -> None:
+        self.state = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.me
+        self.leader_id = None
+        self._persist()
+        term = self.current_term
+        last_idx = len(self.log)
+        last_term = self.log[-1].term if self.log else 0
+        args = {"term": term, "candidate": self.me,
+                "last_log_index": last_idx, "last_log_term": last_term}
+        votes, needed = 1, (len(self.peers) + 1) // 2 + 1
+        if votes >= needed:
+            self._become_leader()
+            return
+        # count votes as they arrive: a dead peer's RPC timeout must not
+        # stall the election once a majority has already answered
+        tasks = [asyncio.create_task(self.transport.request_vote(p, args))
+                 for p in self.peers]
+        pending = set(tasks)
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                if self.state != CANDIDATE or self.current_term != term:
+                    return
+                for fut in done:
+                    r = fut.result()
+                    if r is None:
+                        continue
+                    if r["term"] > self.current_term:
+                        self._step_down(r["term"])
+                        return
+                    if r.get("granted"):
+                        votes += 1
+                if votes >= needed:
+                    self._become_leader()
+                    return
+        finally:
+            for fut in pending:
+                fut.cancel()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.me
+        self.next_index = {p: len(self.log) + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        # no-op entry of the new term: commits (and therefore applies)
+        # any surviving prior-term entries without waiting for a client
+        # proposal — the standard raft leader-completeness step.
+        self.log.append(LogEntry(self.current_term, {"op": "noop"}))
+        self._persist()
+        self._term_start_index = len(self.log)
+        if self._hb_task is not None and not self._hb_task.done():
+            self._hb_task.cancel()
+        self._hb_task = asyncio.create_task(
+            self._heartbeat_loop(self.current_term))
+        self._tasks = [t for t in self._tasks if not t.done()]
+        self._tasks.append(self._hb_task)
+
+    def _step_down(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist()
+        self.state = FOLLOWER
+        # forget who led the old term; the next AppendEntries names the
+        # new leader (avoids redirect loops at a deposed leader).
+        # Deliberately NOT resetting the election timer here: per the
+        # raft paper, timers reset only on granting a vote or on
+        # AppendEntries from the leader — resetting on every higher-term
+        # sighting lets a rejoining partitioned node with an inflated
+        # term livelock the cluster with unwinnable candidacies.
+        self.leader_id = None
+
+    async def _heartbeat_loop(self, term: int) -> None:
+        """Per-peer replication loops: a dead peer's RPC timeout must
+        not delay heartbeats to live followers (whose election timers
+        are much shorter than the transport timeout)."""
+        async def one_peer(peer: str) -> None:
+            while not self._stop and self.state == LEADER and \
+                    self.current_term == term:
+                await self._replicate_one(peer)
+                self._advance_commit()
+                await asyncio.sleep(0.05 * self.tick)
+
+        if not self.peers:
+            while not self._stop and self.state == LEADER and \
+                    self.current_term == term:
+                self._advance_commit()
+                await asyncio.sleep(0.05 * self.tick)
+            return
+        loops = [asyncio.create_task(one_peer(p)) for p in self.peers]
+        try:
+            await asyncio.gather(*loops)
+        except asyncio.CancelledError:
+            for t in loops:
+                t.cancel()
+            raise
+
+    async def barrier(self, timeout: float = 5.0) -> bool:
+        """Wait until this leader has applied everything committed in
+        prior terms (its own term-start no-op included): the guarantee a
+        caller needs before reading FSM-derived state like the
+        volume-id high-water mark."""
+        if self.state != LEADER:
+            return False
+        idx, term = self._term_start_index, self.current_term
+        if self.last_applied >= idx:
+            return True
+        fut = asyncio.get_event_loop().create_future()
+        self._commit_waiters.append((idx, term, fut))
+        try:
+            ok = await asyncio.wait_for(fut, timeout * self.tick)
+            return ok and self.state == LEADER
+        except asyncio.TimeoutError:
+            return False
+
+    async def _replicate_one(self, peer: str) -> None:
+        ni = self.next_index.get(peer, len(self.log) + 1)
+        prev_idx = ni - 1
+        prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and \
+            prev_idx <= len(self.log) else 0
+        entries = [e.to_json() for e in self.log[ni - 1:]]
+        args = {"term": self.current_term, "leader": self.me,
+                "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                "entries": entries, "leader_commit": self.commit_index}
+        r = await self.transport.append_entries(peer, args)
+        if r is None or self.state != LEADER:
+            return
+        if r["term"] > self.current_term:
+            self._step_down(r["term"])
+            return
+        if r.get("success"):
+            self.match_index[peer] = prev_idx + len(entries)
+            self.next_index[peer] = self.match_index[peer] + 1
+        else:
+            self.next_index[peer] = max(1, ni - 1)
+
+    def _advance_commit(self) -> None:
+        n = len(self.log)
+        while n > self.commit_index:
+            if self.log[n - 1].term == self.current_term:
+                votes = 1 + sum(1 for p in self.peers
+                                if self.match_index.get(p, 0) >= n)
+                if votes * 2 > len(self.peers) + 1:
+                    self.commit_index = n
+                    break
+            n -= 1
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            cmd = self.log[self.last_applied - 1].command
+            self.fsm.apply(cmd)
+            if self.on_apply is not None:
+                self.on_apply(cmd)
+        still = []
+        for idx, term, fut in self._commit_waiters:
+            if idx <= self.commit_index:
+                if not fut.done():
+                    committed_term = self.log[idx - 1].term \
+                        if idx <= len(self.log) else -1
+                    fut.set_result(committed_term == term)
+            elif idx <= len(self.log) and self.log[idx - 1].term != term:
+                # overwritten by a newer leader before committing
+                if not fut.done():
+                    fut.set_result(False)
+            else:
+                still.append((idx, term, fut))
+        self._commit_waiters = still
+
+    # ------------------------------------------------------------------
+    # RPC handlers (called by transport)
+    # ------------------------------------------------------------------
+    def on_request_vote(self, args: dict) -> dict:
+        term = args["term"]
+        if term > self.current_term:
+            self._step_down(term)
+        granted = False
+        if term == self.current_term and \
+                self.voted_for in (None, args["candidate"]):
+            my_last_term = self.log[-1].term if self.log else 0
+            my_last_idx = len(self.log)
+            up_to_date = (args["last_log_term"], args["last_log_index"]) >= \
+                (my_last_term, my_last_idx)
+            if up_to_date:
+                granted = True
+                self.voted_for = args["candidate"]
+                self._last_heartbeat = time.monotonic()
+                self._persist()
+        return {"term": self.current_term, "granted": granted}
+
+    def on_append_entries(self, args: dict) -> dict:
+        term = args["term"]
+        if args.get("leader") == self.me:
+            # a misconfigured peer list can route our own heartbeat back
+            # to us; deposing ourselves over it would livelock elections
+            return {"term": self.current_term, "success": False}
+        if term < self.current_term:
+            return {"term": self.current_term, "success": False}
+        if term > self.current_term or self.state != FOLLOWER:
+            self._step_down(term)
+        self._last_heartbeat = time.monotonic()
+        self.leader_id = args["leader"]
+
+        prev_idx = args["prev_log_index"]
+        if prev_idx > len(self.log):
+            return {"term": self.current_term, "success": False}
+        if prev_idx >= 1 and self.log[prev_idx - 1].term != \
+                args["prev_log_term"]:
+            del self.log[prev_idx - 1:]
+            self._persist()
+            return {"term": self.current_term, "success": False}
+
+        entries = [LogEntry.from_json(e) for e in args["entries"]]
+        idx = prev_idx
+        changed = False
+        for e in entries:
+            idx += 1
+            if idx <= len(self.log):
+                if self.log[idx - 1].term != e.term:
+                    del self.log[idx - 1:]
+                    self.log.append(e)
+                    changed = True
+            else:
+                self.log.append(e)
+                changed = True
+        if changed:
+            self._persist()
+        if args["leader_commit"] > self.commit_index:
+            self.commit_index = min(args["leader_commit"], len(self.log))
+            self._apply_committed()
+        return {"term": self.current_term, "success": True}
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def leader(self) -> str | None:
+        return self.leader_id
+
+    async def propose(self, command: dict, timeout: float = 5.0) -> bool:
+        """Append a command; resolves once committed on a majority.
+        Returns False if this node is not the leader."""
+        if self.state != LEADER:
+            return False
+        term = self.current_term
+        self.log.append(LogEntry(term, command))
+        self._persist()
+        idx = len(self.log)
+        fut = asyncio.get_event_loop().create_future()
+        self._commit_waiters.append((idx, term, fut))
+        if not self.peers:
+            self._advance_commit()
+        try:
+            return await asyncio.wait_for(fut, timeout * self.tick)
+        except asyncio.TimeoutError:
+            return False
+
+    # aiohttp handlers for HTTPTransport peers -------------------------
+    def http_routes(self):
+        from aiohttp import web
+
+        async def rv(req):
+            return web.json_response(self.on_request_vote(await req.json()))
+
+        async def ae(req):
+            return web.json_response(self.on_append_entries(await req.json()))
+
+        async def status(req):
+            return web.json_response({
+                "me": self.me, "state": self.state,
+                "term": self.current_term, "leader": self.leader_id,
+                "commit_index": self.commit_index,
+                "max_volume_id": self.fsm.max_volume_id,
+                "peers": self.peers})
+
+        return [web.post("/raft/request_vote", rv),
+                web.post("/raft/append_entries", ae),
+                web.get("/raft/status", status)]

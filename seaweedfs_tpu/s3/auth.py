@@ -136,9 +136,7 @@ class IdentityAccessManagement:
         if skew > MAX_CLOCK_SKEW_SECONDS:
             raise S3AuthError("RequestTimeTooSkewed",
                               f"request time skewed by {skew:.0f}s")
-        payload_hash = headers.get(
-            "x-amz-content-sha256",
-            headers.get("X-Amz-Content-Sha256", payload_hash))
+        payload_hash = declared
         creq = _canonical_request(method, path, query, headers,
                                   signed_headers, payload_hash)
         scope = f"{datestamp}/{region}/{service}/aws4_request"

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+import zlib
 
 import aiohttp
 from aiohttp import web
@@ -34,17 +35,58 @@ class MasterServer:
                  pulse_seconds: float = 5.0,
                  sequencer: str = "memory",
                  jwt_secret: str = "",
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 me: str = "",
+                 peers: list[str] | None = None,
+                 raft_state_dir: str | None = None,
+                 raft_tick: float = 1.0):
         self.topo = Topology(volume_size_limit, pulse_seconds)
         self.default_replication = default_replication
-        self.seq = (SnowflakeSequencer() if sequencer == "snowflake"
-                    else MemorySequencer())
+        if sequencer == "memory" and peers:
+            # HA masters must not mint needle keys from a per-process
+            # counter: after failover the new leader would re-issue keys
+            # already written under the old leader, silently shadowing
+            # existing needles. Snowflake ids (timestamp + node id) are
+            # unique across restarts/failovers without replication —
+            # the reference's recommendation for multi-master.
+            sequencer = "snowflake"
+        self.seq = (SnowflakeSequencer(node_id=zlib.crc32(me.encode()))
+                    if sequencer == "snowflake" else MemorySequencer())
         self.guard = Guard(jwt_secret)
         self.garbage_threshold = garbage_threshold
         self.pulse_seconds = pulse_seconds
         self._clients: set[web.WebSocketResponse] = set()
         self._grow_lock = asyncio.Lock()
+        self.raft = None
+        if peers:
+            from ..master.raft import HTTPTransport, RaftNode
+
+            self.raft = RaftNode(me, peers, HTTPTransport(),
+                                 state_dir=raft_state_dir, tick=raft_tick,
+                                 on_apply=self._on_raft_apply)
         self.app = self._build_app()
+
+    def _on_raft_apply(self, cmd: dict) -> None:
+        """Committed raft entries drive the topology's volume-id
+        high-water mark on every master (raft_server.go:72)."""
+        if cmd.get("op") == "max_volume_id":
+            with self.topo.lock:
+                self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                              int(cmd["value"]))
+
+    def _leader_redirect(self, req: web.Request) -> web.Response | None:
+        """Leader proxy for control verbs (master_server.go:219): a
+        follower 307s mutating requests to the current raft leader."""
+        if self.raft is None or self.raft.is_leader():
+            return None
+        leader = self.raft.leader()
+        if leader is None or leader == self.raft.me:
+            return json_error("no raft leader elected yet", status=503)
+        url = f"http://{leader}{req.path}"
+        if req.query_string:
+            url += f"?{req.query_string}"
+        # plain 307 (aiohttp deprecates returning HTTPException objects)
+        return web.Response(status=307, headers={"Location": url})
 
     def _build_app(self) -> web.Application:
         app = web.Application(client_max_size=1 << 20)
@@ -57,18 +99,34 @@ class MasterServer:
             web.get("/vol/status", self.handle_vol_status),
             web.get("/dir/status", self.handle_dir_status),
             web.get("/cluster/status", self.handle_cluster_status),
+            web.get("/cluster/leader", self.handle_cluster_leader),
             web.get("/cluster/ec_shards", self.handle_ec_shards),
             web.get("/ws/heartbeat", self.handle_heartbeat_ws),
             web.get("/ws/keepconnected", self.handle_keepconnected_ws),
             web.get("/metrics", self.handle_metrics),
             web.get("/", self.handle_ui),
         ])
+        if self.raft is not None:
+            app.add_routes(self.raft.http_routes())
+
+            async def _start_raft(app):
+                self.raft.start()
+
+            async def _stop_raft(app):
+                await self.raft.stop()
+                await self.raft.transport.close()
+
+            app.on_startup.append(_start_raft)
+            app.on_cleanup.append(_stop_raft)
         return app
 
     # ------------------------------------------------------------------
     # assignment
     # ------------------------------------------------------------------
     async def handle_assign(self, req: web.Request) -> web.Response:
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
         q = req.query
         count = int(q.get("count", 1))
         collection = q.get("collection", "")
@@ -101,6 +159,10 @@ class MasterServer:
         })
 
     async def handle_lookup(self, req: web.Request) -> web.Response:
+        # topology state lives on the raft leader; followers redirect
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
         vid_s = req.query.get("volumeId", "")
         vid = int(vid_s.split(",")[0]) if vid_s else 0
         nodes = self.topo.lookup(vid)
@@ -113,6 +175,9 @@ class MasterServer:
         })
 
     async def handle_grow(self, req: web.Request) -> web.Response:
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
         q = req.query
         count = int(q.get("count", 1))
         collection = q.get("collection", "")
@@ -143,7 +208,20 @@ class MasterServer:
                 except NoWritableVolume:
                     pass
             nodes = self.topo.find_empty_slots(replication, dc)
+            if self.raft is not None:
+                # a fresh leader must apply prior terms' committed
+                # high-water marks before minting a new volume id, or a
+                # restarted cluster could re-issue an existing id
+                if not await self.raft.barrier():
+                    raise NoFreeSlots("raft leader not ready")
             vid = self.topo.next_volume_id()
+            if self.raft is not None:
+                # the new high-water mark must commit on a majority
+                # before the id is handed out (raft_server.go:72)
+                ok = await self.raft.propose(
+                    {"op": "max_volume_id", "value": vid})
+                if not ok:
+                    raise NoFreeSlots("lost raft leadership mid-grow")
             ttl_b = bytes(ttl)
             async with aiohttp.ClientSession() as sess:
                 for node in nodes:
@@ -179,6 +257,10 @@ class MasterServer:
             async for msg in ws:
                 if msg.type != aiohttp.WSMsgType.TEXT:
                     continue
+                if self.raft is not None and not self.raft.is_leader():
+                    # only the leader owns topology; dropping the stream
+                    # sends the volume server back to _find_leader
+                    break
                 hb = json.loads(msg.data)
                 node_id = f"{hb['ip']}:{hb['port']}"
                 node = self.topo.register_node(
@@ -221,6 +303,10 @@ class MasterServer:
         after."""
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(req)
+        if self.raft is not None and not self.raft.is_leader():
+            await ws.send_json({"leader": self.raft.leader() or ""})
+            await ws.close()
+            return ws
         self._clients.add(ws)
         try:
             await ws.send_json({"snapshot": self._location_snapshot()})
@@ -282,8 +368,18 @@ class MasterServer:
     # ------------------------------------------------------------------
     async def handle_cluster_status(self, req: web.Request) -> web.Response:
         return json_ok({
-            "IsLeader": True,
+            "IsLeader": self.raft.is_leader() if self.raft else True,
+            "Leader": (self.raft.leader() or "") if self.raft else "",
+            "Peers": self.raft.peers if self.raft else [],
             "Topology": self.topo.to_dict(),
+        })
+
+    async def handle_cluster_leader(self, req: web.Request) -> web.Response:
+        """Leadership probe without serializing the topology (cheap
+        enough for every volume-server reconnect to hit)."""
+        return json_ok({
+            "IsLeader": self.raft.is_leader() if self.raft else True,
+            "Leader": (self.raft.leader() or "") if self.raft else "",
         })
 
     async def handle_dir_status(self, req: web.Request) -> web.Response:

@@ -38,7 +38,13 @@ class VolumeServer:
                  pulse_seconds: float = 5.0,
                  max_concurrent_writes: int = 64):
         self.store = store
-        self.master_url = master_url.rstrip("/")
+        # comma-separated list in HA mode; heartbeats follow the raft
+        # leader (volume_grpc_client_to_master.go:50 tries all masters)
+        self.masters = [
+            m if m.startswith("http") else f"http://{m}"
+            for m in (s.strip().rstrip("/") for s in master_url.split(","))
+            if m]
+        self.master_url = self.masters[0]
         self.data_center = data_center
         self.rack = rack
         self.guard = Guard(jwt_secret)
@@ -106,8 +112,24 @@ class VolumeServer:
     # ------------------------------------------------------------------
     # heartbeat (volume_grpc_client_to_master.go:50 doHeartbeat)
     # ------------------------------------------------------------------
+    async def _find_leader(self, sess: aiohttp.ClientSession) -> str:
+        """Locate the current master leader among self.masters
+        (wdclient masterclient.go:160 tryAllMasters analogue)."""
+        for m in self.masters:
+            try:
+                async with sess.get(f"{m}/cluster/leader",
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=3)) as resp:
+                    d = await resp.json()
+                    if d.get("IsLeader"):
+                        return m
+                    if d.get("Leader"):
+                        return f"http://{d['Leader']}"
+            except Exception:
+                continue
+        return self.masters[0]
+
     async def _heartbeat_loop(self) -> None:
-        ws_url = self.master_url.replace("http", "ws", 1) + "/ws/heartbeat"
         while self.store.port == 0:
             # ephemeral listen port not resolved yet (set by the runner
             # right after the site binds) — don't register as :0
@@ -115,6 +137,9 @@ class VolumeServer:
         while True:
             try:
                 async with aiohttp.ClientSession() as sess:
+                    self.master_url = await self._find_leader(sess)
+                    ws_url = self.master_url.replace(
+                        "http", "ws", 1) + "/ws/heartbeat"
                     async with sess.ws_connect(ws_url) as ws:
                         while True:
                             hb = self.store.collect_heartbeat()
@@ -132,6 +157,9 @@ class VolumeServer:
                                 self._hb_wake.clear()
                             except asyncio.TimeoutError:
                                 pass
+                # graceful close (e.g. a follower refusing our stream
+                # while no leader exists): back off before re-probing
+                await asyncio.sleep(min(1.0, self.pulse_seconds))
             except asyncio.CancelledError:
                 return
             except Exception:

@@ -95,17 +95,41 @@ class MasterClient:
             url = self.master_url.replace("http", "ws", 1) + \
                 "/ws/keepconnected"
             try:
+                got_data = redirected = False
                 async with aiohttp.ClientSession() as sess:
                     async with sess.ws_connect(url, heartbeat=30) as ws:
                         async for msg in ws:
                             if msg.type != aiohttp.WSMsgType.TEXT:
                                 break
-                            self._apply(json.loads(msg.data))
+                            d = json.loads(msg.data)
+                            if "leader" in d:
+                                # follower refusing the stream; follow
+                                # its leader hint (masterclient.go:172)
+                                self._follow_leader(d["leader"])
+                                redirected = True
+                                break
+                            got_data = True
+                            self._apply(d)
                             if self._stop.is_set():
                                 break
+                # graceful close: rotate masters unless this stream
+                # served us or named the leader, and never hot-spin
+                if not got_data and not redirected:
+                    self._failover()
+                await asyncio.sleep(0.2 if (got_data or redirected) else 1)
             except Exception:
                 self._failover()
                 await asyncio.sleep(1)
+
+    def _follow_leader(self, leader: str) -> None:
+        if not leader:
+            return
+        url = leader if leader.startswith("http") else f"http://{leader}"
+        if url in self.masters:
+            self._current = self.masters.index(url)
+        else:
+            self.masters.append(url)
+            self._current = len(self.masters) - 1
 
     def _apply(self, msg: dict) -> None:
         now = time.monotonic()

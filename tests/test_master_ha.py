@@ -1,0 +1,133 @@
+"""Multi-master HA over real HTTP: raft election, leader proxying of
+control verbs, and volume-id agreement across masters.
+
+Mirrors the reference's multi-master mode (weed master -peers=...,
+/root/reference/weed/server/raft_hashicorp.go + leader proxy
+master_server.go:219).
+"""
+import os
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.rpc.http import ServerThread
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.store import Store
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def ha(tmp_path_factory):
+    base = tmp_path_factory.mktemp("ha")
+    ports = free_ports(3)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters, threads = [], []
+    for me, port in zip(peers, ports):
+        m = MasterServer(pulse_seconds=0.4, me=me, peers=peers,
+                         raft_state_dir=str(base), raft_tick=0.6)
+        masters.append(m)
+        threads.append(ServerThread(m.app, port=port).start())
+
+    # wait for a stable leader
+    leader_addr = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        states = []
+        for p in peers:
+            try:
+                states.append(requests.get(
+                    f"http://{p}/raft/status", timeout=2).json())
+            except Exception:
+                states.append(None)
+        leaders = [s["me"] for s in states if s and s["state"] == "leader"]
+        agreed = {s["leader"] for s in states if s and s["leader"]}
+        if len(leaders) == 1 and agreed == {leaders[0]}:
+            leader_addr = leaders[0]
+            break
+        time.sleep(0.1)
+    assert leader_addr, "no stable leader"
+
+    # one volume server heartbeating at the leader
+    vol_dir = os.path.join(str(base), "vol0")
+    os.makedirs(vol_dir, exist_ok=True)
+    store = Store([vol_dir], ip="127.0.0.1", port=0, ec_backend="numpy")
+    vs = VolumeServer(store, f"http://{leader_addr}", pulse_seconds=0.3)
+    vt = ServerThread(vs.app).start()
+    store.port = vt.port
+    store.public_url = vt.address
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        topo = requests.get(f"http://{leader_addr}/dir/status",
+                            timeout=2).json()["Topology"]
+        n = sum(len(r["nodes"]) for dc in topo["datacenters"]
+                for r in dc["racks"])
+        if n >= 1:
+            break
+        time.sleep(0.1)
+
+    yield {"peers": peers, "leader": leader_addr, "masters": masters}
+    for t in threads:
+        t.stop()
+    vt.stop()
+
+
+def test_one_leader_elected(ha):
+    flags = [requests.get(f"http://{p}/cluster/status", timeout=2).json()
+             for p in ha["peers"]]
+    assert sum(1 for f in flags if f["IsLeader"]) == 1
+    assert all(f["Leader"] == ha["leader"] for f in flags if not f["IsLeader"])
+
+
+def test_follower_redirects_assign_to_leader(ha):
+    followers = [p for p in ha["peers"] if p != ha["leader"]]
+    r = requests.get(f"http://{followers[0]}/dir/assign", timeout=10)
+    assert r.history, "expected a 307 leader redirect"
+    assert ha["leader"] in r.url
+    assert r.status_code == 200 and "fid" in r.json()
+
+
+def test_follower_redirects_lookup_to_leader(ha):
+    # grow happened via assign; looking up that volume on a follower
+    # must redirect to the leader (topology lives on the leader)
+    r = requests.get(f"http://{ha['leader']}/dir/assign", timeout=10)
+    vid = r.json()["fid"].split(",")[0]
+    follower = [p for p in ha["peers"] if p != ha["leader"]][0]
+    r = requests.get(f"http://{follower}/dir/lookup",
+                     params={"volumeId": vid}, timeout=10)
+    assert r.history and ha["leader"] in r.url
+    assert r.json()["locations"]
+
+
+def test_max_volume_id_replicated_to_followers(ha):
+    # assign (possibly growing a volume) through the leader...
+    r = requests.get(f"http://{ha['leader']}/dir/assign", timeout=10)
+    assert r.status_code == 200
+    lead_max = requests.get(f"http://{ha['leader']}/raft/status",
+                            timeout=2).json()["max_volume_id"]
+    assert lead_max >= 1
+    # ...and every follower's raft FSM converges to the same mark
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        marks = [requests.get(f"http://{p}/raft/status", timeout=2)
+                 .json()["max_volume_id"] for p in ha["peers"]]
+        if all(m == lead_max for m in marks):
+            break
+        time.sleep(0.1)
+    assert all(m == lead_max for m in marks)
+    # and into each master's topology high-water mark
+    for m in ha["masters"]:
+        assert m.topo.max_volume_id >= lead_max

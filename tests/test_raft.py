@@ -1,0 +1,158 @@
+"""Raft election + replication tests on the in-memory transport.
+
+Mirrors the reference's approach of testing cluster logic without a
+cluster (SURVEY.md section 4); FSM semantics follow
+/root/reference/weed/server/raft_server.go:72 (MaxVolumeId only).
+"""
+import asyncio
+
+from seaweedfs_tpu.master.raft import (LEADER, MemoryTransport, RaftNode)
+
+TICK = 0.08  # scale raft timeouts down for test speed
+
+
+def make_cluster(n, tmp_path=None, tick=TICK):
+    transport = MemoryTransport()
+    names = [f"m{i}" for i in range(n)]
+    nodes = []
+    for name in names:
+        node = RaftNode(name, names, transport,
+                        state_dir=str(tmp_path) if tmp_path else None,
+                        tick=tick)
+        transport.register(node)
+        nodes.append(node)
+    return transport, nodes
+
+
+async def wait_for_leader(nodes, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [n for n in nodes if n.state == LEADER]
+        if len(leaders) == 1:
+            followers_agree = all(
+                n.leader() == leaders[0].me for n in nodes
+                if n is not leaders[0] and n.leader() is not None)
+            if followers_agree:
+                return leaders[0]
+        await asyncio.sleep(0.01)
+    raise AssertionError("no stable leader elected")
+
+
+async def _impl_test_single_node_self_elects():
+    transport, nodes = make_cluster(1)
+    nodes[0].start()
+    leader = await wait_for_leader(nodes)
+    assert leader is nodes[0]
+    assert await leader.propose({"op": "max_volume_id", "value": 7})
+    assert leader.fsm.max_volume_id == 7
+    await nodes[0].stop()
+
+
+async def _impl_test_three_node_election_and_commit():
+    transport, nodes = make_cluster(3)
+    for n in nodes:
+        n.start()
+    leader = await wait_for_leader(nodes)
+    assert await leader.propose({"op": "max_volume_id", "value": 42})
+    # committed entry reaches every follower FSM via heartbeats
+    deadline = asyncio.get_event_loop().time() + 3
+    while asyncio.get_event_loop().time() < deadline:
+        if all(n.fsm.max_volume_id == 42 for n in nodes):
+            break
+        await asyncio.sleep(0.01)
+    assert all(n.fsm.max_volume_id == 42 for n in nodes)
+    for n in nodes:
+        await n.stop()
+
+
+async def _impl_test_leader_failure_reelection_preserves_state():
+    transport, nodes = make_cluster(3)
+    for n in nodes:
+        n.start()
+    leader = await wait_for_leader(nodes)
+    assert await leader.propose({"op": "max_volume_id", "value": 10})
+
+    # partition the leader away: remaining two elect a new one
+    transport.partitioned.add(leader.me)
+    await leader.stop()
+    rest = [n for n in nodes if n is not leader]
+    new_leader = await wait_for_leader(rest)
+    assert new_leader is not leader
+    # committed state survived the failover (applied once the new
+    # leader's no-op entry commits)
+    deadline = asyncio.get_event_loop().time() + 3
+    while asyncio.get_event_loop().time() < deadline:
+        if new_leader.fsm.max_volume_id == 10:
+            break
+        await asyncio.sleep(0.01)
+    assert new_leader.fsm.max_volume_id == 10
+    assert await new_leader.propose({"op": "max_volume_id", "value": 11})
+    for n in rest:
+        await n.stop()
+
+
+async def _impl_test_lagging_follower_catches_up():
+    transport, nodes = make_cluster(3)
+    for n in nodes:
+        n.start()
+    leader = await wait_for_leader(nodes)
+    lagger = [n for n in nodes if n is not leader][0]
+    transport.partitioned.add(lagger.me)
+    for v in (1, 2, 3):
+        assert await leader.propose({"op": "max_volume_id", "value": v})
+    transport.partitioned.discard(lagger.me)
+    deadline = asyncio.get_event_loop().time() + 3
+    while asyncio.get_event_loop().time() < deadline:
+        if lagger.fsm.max_volume_id == 3:
+            break
+        await asyncio.sleep(0.01)
+    assert lagger.fsm.max_volume_id == 3
+    for n in nodes:
+        await n.stop()
+
+
+async def _impl_test_persistence_across_restart(tmp_path):
+    transport, nodes = make_cluster(1, tmp_path=tmp_path)
+    nodes[0].start()
+    leader = await wait_for_leader(nodes)
+    assert await leader.propose({"op": "max_volume_id", "value": 99})
+    await nodes[0].stop()
+
+    # new process: same state dir, log replays into the FSM on commit
+    transport2 = MemoryTransport()
+    node2 = RaftNode("m0", ["m0"], transport2, state_dir=str(tmp_path),
+                     tick=TICK)
+    transport2.register(node2)
+    assert {"op": "max_volume_id", "value": 99} in \
+        [e.command for e in node2.log]
+    node2.start()
+    leader2 = await wait_for_leader([node2])
+    deadline = asyncio.get_event_loop().time() + 3
+    while asyncio.get_event_loop().time() < deadline:
+        if leader2.fsm.max_volume_id == 99:
+            break
+        await asyncio.sleep(0.01)
+    assert leader2.fsm.max_volume_id == 99
+    await node2.stop()
+
+
+# -- sync wrappers (no pytest-asyncio in the image) --------------------
+
+def test_single_node_self_elects():
+    asyncio.run(_impl_test_single_node_self_elects())
+
+
+def test_three_node_election_and_commit():
+    asyncio.run(_impl_test_three_node_election_and_commit())
+
+
+def test_leader_failure_reelection_preserves_state():
+    asyncio.run(_impl_test_leader_failure_reelection_preserves_state())
+
+
+def test_lagging_follower_catches_up():
+    asyncio.run(_impl_test_lagging_follower_catches_up())
+
+
+def test_persistence_across_restart(tmp_path):
+    asyncio.run(_impl_test_persistence_across_restart(tmp_path))
