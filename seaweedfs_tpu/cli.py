@@ -55,8 +55,27 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
 
+    p = sub.add_parser("filer", help="start a filer server")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-store", default="memory",
+                   help="metadata store: memory | sqlite")
+    p.add_argument("-store.path", dest="store_path", default=":memory:")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+
+    p = sub.add_parser("s3", help="start an S3 gateway")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-config", default="",
+                   help="json file with s3 identities")
+
     p = sub.add_parser("shell", help="interactive admin shell")
     p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-filer", default="",
+                   help="filer address for the cluster-wide admin lock")
 
     p = sub.add_parser("upload", help="upload files")
     p.add_argument("-master", default="http://127.0.0.1:9333")
@@ -94,10 +113,14 @@ def _dispatch(args) -> int:
         return _run_volume(args)
     if args.cmd == "server":
         return _run_server(args)
+    if args.cmd == "filer":
+        return _run_filer(args)
+    if args.cmd == "s3":
+        return _run_s3(args)
     if args.cmd == "shell":
         from .shell.repl import run_shell
 
-        return run_shell(args.master)
+        return run_shell(args.master, filer_url=args.filer)
     if args.cmd == "upload":
         from .operation import verbs
 
@@ -169,6 +192,39 @@ def _run_volume(args) -> int:
     store.port = t.port
     store.public_url = t.address
     print(f"volume server listening on {t.url}, dirs={dirs}")
+    run_apps_forever([t])
+    return 0
+
+
+def _run_filer(args) -> int:
+    from .rpc.http import ServerThread, run_apps_forever
+    from .server.filer_server import FilerServer
+
+    master = args.master if args.master.startswith("http") else \
+        f"http://{args.master}"
+    fs = FilerServer(master, store=args.store, store_path=args.store_path,
+                     collection=args.collection,
+                     replication=args.replication)
+    t = ServerThread(fs.app, host=args.ip, port=args.port).start()
+    fs.address = t.address
+    print(f"filer listening on {t.url} (store={args.store})")
+    run_apps_forever([t])
+    return 0
+
+
+def _run_s3(args) -> int:
+    from .rpc.http import ServerThread, run_apps_forever
+    from .s3.server import S3ApiServer
+
+    filer = args.filer if args.filer.startswith("http") else \
+        f"http://{args.filer}"
+    config = None
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    s3 = S3ApiServer(filer, iam_config=config)
+    t = ServerThread(s3.app, host=args.ip, port=args.port).start()
+    print(f"s3 gateway listening on {t.url}")
     run_apps_forever([t])
     return 0
 

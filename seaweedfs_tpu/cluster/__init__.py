@@ -1,0 +1,6 @@
+from .membership import ClusterMembership
+from .lock_manager import (DistributedLockManager, DlmClient, LockRing,
+                           LockMoved, LockNotOwned)
+
+__all__ = ["ClusterMembership", "DistributedLockManager", "DlmClient",
+           "LockRing", "LockMoved", "LockNotOwned"]

@@ -1,0 +1,238 @@
+"""Distributed lock manager, consistent-hashed over the live filers.
+
+Equivalent of /root/reference/weed/cluster/lock_manager/
+distributed_lock_manager.go:13-93 + lock_ring.go: every named lock has
+one home filer chosen by hashing the name onto the sorted ring of live
+filers; a request landing on the wrong filer is answered with a
+"moved" hint naming the right one, which clients follow (the
+reference's filer_grpc_server_dlm.go does the same over gRPC). Locks
+are exclusive, owned by a renewal token, and expire by TTL so a dead
+holder cannot wedge the cluster.
+"""
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+import zlib
+
+
+class LockMoved(Exception):
+    """Raised (server-side) / signalled (wire) when a lock's home is a
+    different filer; carries the correct address."""
+
+    def __init__(self, host: str):
+        super().__init__(f"lock moved to {host}")
+        self.host = host
+
+
+class LockNotOwned(Exception):
+    pass
+
+
+class LockRing:
+    """Sorted list of live filer addresses; a lock name hashes to one
+    of them (lock_ring.go keeps snapshots for stability — TTL'd lock
+    expiry plus client retry gives the same safety more simply)."""
+
+    def __init__(self) -> None:
+        self._servers: list[str] = []
+        self._lock = threading.Lock()
+
+    def set_servers(self, servers: list[str]) -> None:
+        with self._lock:
+            self._servers = sorted(set(servers))
+
+    def servers(self) -> list[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def owner_of(self, name: str) -> str | None:
+        with self._lock:
+            if not self._servers:
+                return None
+            idx = zlib.crc32(name.encode()) % len(self._servers)
+            return self._servers[idx]
+
+
+class _Lock:
+    __slots__ = ("token", "owner", "expires_at")
+
+    def __init__(self, token: str, owner: str, expires_at: float):
+        self.token = token
+        self.owner = owner
+        self.expires_at = expires_at
+
+
+class DistributedLockManager:
+    """One filer's share of the lock space."""
+
+    def __init__(self, me: str, ring: LockRing | None = None):
+        self.me = me
+        self.ring = ring or LockRing()
+        self._locks: dict[str, _Lock] = {}
+        self._mu = threading.Lock()
+
+    def _home(self, name: str) -> str | None:
+        return self.ring.owner_of(name)
+
+    def lock(self, name: str, owner: str, ttl: float = 10.0,
+             token: str = "") -> str:
+        """Acquire or renew. Returns the renewal token.
+        Raises LockMoved if this filer is not the lock's home, or
+        PermissionError if held by someone else."""
+        home = self._home(name)
+        if home is not None and home != self.me:
+            raise LockMoved(home)
+        now = time.monotonic()
+        with self._mu:
+            cur = self._locks.get(name)
+            if cur is not None and cur.expires_at > now:
+                if token and cur.token == token:
+                    cur.expires_at = now + ttl  # renewal
+                    return cur.token
+                if cur.owner == owner and not token:
+                    # same logical owner re-acquiring (e.g. after a
+                    # client restart) is refused: the token is the
+                    # proof of ownership
+                    raise PermissionError(
+                        f"lock {name} already held by {cur.owner}")
+                raise PermissionError(
+                    f"lock {name} held by {cur.owner}")
+            new = _Lock(secrets.token_hex(8), owner, now + ttl)
+            self._locks[name] = new
+            return new.token
+
+    def unlock(self, name: str, token: str) -> None:
+        with self._mu:
+            cur = self._locks.get(name)
+            if cur is None:
+                return
+            if cur.token != token:
+                raise LockNotOwned(f"wrong token for lock {name}")
+            del self._locks[name]
+
+    def find_owner(self, name: str) -> str | None:
+        home = self._home(name)
+        if home is not None and home != self.me:
+            raise LockMoved(home)
+        now = time.monotonic()
+        with self._mu:
+            cur = self._locks.get(name)
+            if cur is None or cur.expires_at <= now:
+                return None
+            return cur.owner
+
+
+class DlmClient:
+    """Client side: tries a seed filer, follows moved hints, renews in
+    the background while held (shell/commands.go:78 confirmIsLocked
+    rides on this)."""
+
+    def __init__(self, filers: list[str] | str, owner: str = "",
+                 ttl: float = 10.0):
+        if isinstance(filers, str):
+            filers = [filers]
+        self.filers = [f.rstrip("/") if f.startswith("http")
+                       else f"http://{f}" for f in filers]
+        self.owner = owner or f"client-{secrets.token_hex(4)}"
+        self.ttl = ttl
+        self._held: dict[str, tuple[str, str]] = {}  # name -> (filer, token)
+        self._renewer: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # one lock request against one filer; returns (ok, moved_to, err)
+    def _try(self, filer: str, path: str, body: dict):
+        import requests
+
+        resp = requests.post(f"{filer}{path}", json=body, timeout=10)
+        d = resp.json()
+        if resp.status_code == 200:
+            return d, None, None
+        if resp.status_code == 409 and d.get("moved"):
+            host = d["moved"]
+            return None, host if host.startswith("http") \
+                else f"http://{host}", None
+        return None, None, d.get("error", f"http {resp.status_code}")
+
+    def _request(self, path: str, body: dict, start: str | None = None):
+        tried = set()
+        candidates = ([start] if start else []) + self.filers
+        last_err = None
+        for _ in range(8):
+            target = next((c for c in candidates if c not in tried), None)
+            if target is None:
+                break
+            tried.add(target)
+            try:
+                d, moved, err = self._try(target, path, body)
+            except Exception as e:
+                last_err = str(e)
+                continue
+            if d is not None:
+                return target, d
+            if moved is not None:
+                candidates.insert(0, moved)
+                tried.discard(moved)
+                continue
+            last_err = err
+            if err and "held by" in err:
+                break  # contention is definitive, not routable
+        raise RuntimeError(last_err or "no filer reachable for lock rpc")
+
+    def lock(self, name: str) -> None:
+        body = {"name": name, "owner": self.owner, "ttl": self.ttl}
+        held = self._held.get(name)
+        if held is not None:
+            # already ours: renew instead of contending with ourselves
+            body["token"] = held[1]
+        filer, d = self._request("/dlm/lock", body,
+                                 start=held[0] if held else None)
+        self._held[name] = (filer, d["token"])
+        self._ensure_renewer()
+
+    def unlock(self, name: str) -> None:
+        held = self._held.pop(name, None)
+        if held is None:
+            return
+        filer, token = held
+        self._request("/dlm/unlock", {"name": name, "token": token},
+                      start=filer)
+
+    def find_owner(self, name: str) -> str | None:
+        _, d = self._request("/dlm/find", {"name": name})
+        return d.get("owner")
+
+    def close(self) -> None:
+        self._stop.set()
+        for name in list(self._held):
+            try:
+                self.unlock(name)
+            except Exception:
+                pass
+
+    # -- background renewal --------------------------------------------
+    def _ensure_renewer(self) -> None:
+        if self._renewer is not None and self._renewer.is_alive():
+            return
+        self._stop.clear()
+        self._renewer = threading.Thread(target=self._renew_loop,
+                                         daemon=True)
+        self._renewer.start()
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.ttl / 3):
+            for name, (filer, token) in list(self._held.items()):
+                try:
+                    new_filer, d = self._request(
+                        "/dlm/lock",
+                        {"name": name, "owner": self.owner,
+                         "ttl": self.ttl, "token": token}, start=filer)
+                    self._held[name] = (new_filer, d["token"])
+                except Exception:
+                    # lost the lock (ring moved + expiry); drop it so
+                    # confirm() can tell the caller
+                    self._held.pop(name, None)
+
+    def is_held(self, name: str) -> bool:
+        return name in self._held

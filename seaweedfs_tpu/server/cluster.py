@@ -72,6 +72,7 @@ class Cluster:
             self.filer = FilerServer(self.master_url, store=filer_store,
                                      store_path=store_path)
             self.filer_thread = ServerThread(self.filer.app).start()
+            self.filer.address = self.filer_thread.address
         self.s3 = None
         self.s3_thread: ServerThread | None = None
         if with_s3:

@@ -38,7 +38,8 @@ class FilerServer:
                  store_path: str = ":memory:",
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
-                 signature: int = 0):
+                 signature: int = 0,
+                 announce_pulse: float = 3.0):
         self.master_url = master_url.rstrip("/")
         self.masters = MasterClient(self.master_url)
         self.collection = collection
@@ -46,7 +47,94 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.filer = Filer(store, on_delete_chunks=self._delete_chunks,
                            signature=signature, path=store_path)
+        # cluster membership + distributed lock manager: this filer's
+        # address is resolved after the listen socket binds (the runner
+        # sets .address, like volume servers' store.port)
+        from ..cluster.lock_manager import DistributedLockManager
+
+        self.address = ""
+        self.filer_group = ""
+        self.announce_pulse = announce_pulse
+        self.dlm = DistributedLockManager(me="")
+        self._member_task = None
         self.app = self._build_app()
+        self.app.on_startup.append(self._start_membership)
+        self.app.on_cleanup.append(self._stop_membership)
+
+    async def _start_membership(self, app) -> None:
+        import asyncio
+
+        self._member_task = asyncio.create_task(self._membership_loop())
+
+    async def _stop_membership(self, app) -> None:
+        import asyncio
+
+        if self._member_task is not None:
+            self._member_task.cancel()
+            try:
+                await self._member_task
+            except (asyncio.CancelledError, Exception):
+                # CancelledError is a BaseException: letting it escape
+                # an on_cleanup hook would abort the loop shutdown
+                pass
+
+    async def _membership_loop(self) -> None:
+        """Announce to the master and refresh the DLM lock ring from
+        the live filer list (cluster.go + lock_ring.go)."""
+        import asyncio
+
+        import aiohttp
+
+        waited = 0.0
+        while not self.address:
+            await asyncio.sleep(0.02)
+            waited += 0.02
+            if abs(waited - 10.0) < 0.01:
+                print("filer: membership idle — runner never set "
+                      ".address after binding the listen socket")
+        self.dlm.me = self.address
+        shrink_streak = 0
+        sess = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=5))
+        try:
+            while True:
+                try:
+                    async with sess.post(
+                            f"{self.master_url}/cluster/announce",
+                            json={"address": self.address, "type": "filer",
+                                  "filerGroup": self.filer_group},
+                            allow_redirects=True) as resp:
+                        await resp.read()
+                    async with sess.get(
+                            f"{self.master_url}/cluster/nodes",
+                            params={"type": "filer"},
+                            allow_redirects=True) as resp:
+                        nodes = (await resp.json())["nodes"]
+                    servers = {n["address"] for n in nodes}
+                    servers.add(self.address)
+                    current = set(self.dlm.ring.servers())
+                    if servers >= current:
+                        # growth or steady state applies immediately
+                        self.dlm.ring.set_servers(sorted(servers))
+                        shrink_streak = 0
+                    else:
+                        # a shrunken list right after a master failover
+                        # is usually the new leader's empty membership,
+                        # not dead filers: collapsing the ring early
+                        # would let two filers both claim lock homes.
+                        # Adopt a smaller ring only once it is stable.
+                        shrink_streak += 1
+                        if shrink_streak >= 3:
+                            self.dlm.ring.set_servers(sorted(servers))
+                            shrink_streak = 0
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    # master unreachable: keep serving with last ring
+                    pass
+                await asyncio.sleep(self.announce_pulse)
+        finally:
+            await sess.close()
 
     # -- plumbing -------------------------------------------------------
     def _build_app(self) -> web.Application:
@@ -74,6 +162,9 @@ class FilerServer:
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
+            web.post("/dlm/lock", self.handle_dlm_lock),
+            web.post("/dlm/unlock", self.handle_dlm_unlock),
+            web.post("/dlm/find", self.handle_dlm_find),
             web.get("/kv/{key:.*}", self.handle_kv_get),
             web.put("/kv/{key:.*}", self.handle_kv_put),
             web.delete("/kv/{key:.*}", self.handle_kv_delete),
@@ -83,6 +174,41 @@ class FilerServer:
             web.delete("/{path:.*}", self.handle_delete),
         ])
         return app
+
+    # -- distributed lock manager (filer_grpc_server_dlm.go) -----------
+    async def handle_dlm_lock(self, req: web.Request) -> web.Response:
+        from ..cluster.lock_manager import LockMoved
+
+        d = await req.json()
+        try:
+            token = self.dlm.lock(d["name"], d.get("owner", ""),
+                                  float(d.get("ttl", 10.0)),
+                                  d.get("token", ""))
+        except LockMoved as e:
+            return web.json_response({"moved": e.host}, status=409)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({"token": token})
+
+    async def handle_dlm_unlock(self, req: web.Request) -> web.Response:
+        from ..cluster.lock_manager import LockNotOwned
+
+        d = await req.json()
+        try:
+            self.dlm.unlock(d["name"], d.get("token", ""))
+        except LockNotOwned as e:
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({"ok": True})
+
+    async def handle_dlm_find(self, req: web.Request) -> web.Response:
+        from ..cluster.lock_manager import LockMoved
+
+        d = await req.json()
+        try:
+            owner = self.dlm.find_owner(d["name"])
+        except LockMoved as e:
+            return web.json_response({"moved": e.host}, status=409)
+        return web.json_response({"owner": owner})
 
     def _lookup_fid(self, fid: str) -> str:
         return self.masters.lookup_file_id(fid)

@@ -57,6 +57,9 @@ class MasterServer:
         self.pulse_seconds = pulse_seconds
         self._clients: set[web.WebSocketResponse] = set()
         self._grow_lock = asyncio.Lock()
+        from ..cluster.membership import ClusterMembership
+
+        self.membership = ClusterMembership(ttl_seconds=pulse_seconds * 3)
         self.raft = None
         if peers:
             from ..master.raft import HTTPTransport, RaftNode
@@ -100,6 +103,8 @@ class MasterServer:
             web.get("/dir/status", self.handle_dir_status),
             web.get("/cluster/status", self.handle_cluster_status),
             web.get("/cluster/leader", self.handle_cluster_leader),
+            web.post("/cluster/announce", self.handle_cluster_announce),
+            web.get("/cluster/nodes", self.handle_cluster_nodes),
             web.get("/cluster/ec_shards", self.handle_ec_shards),
             web.get("/ws/heartbeat", self.handle_heartbeat_ws),
             web.get("/ws/keepconnected", self.handle_keepconnected_ws),
@@ -381,6 +386,32 @@ class MasterServer:
             "IsLeader": self.raft.is_leader() if self.raft else True,
             "Leader": (self.raft.leader() or "") if self.raft else "",
         })
+
+    async def handle_cluster_announce(self, req: web.Request) -> web.Response:
+        """Filer/broker liveness beat (cluster.go membership; carried
+        by KeepConnected in the reference)."""
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
+        d = await req.json()
+        address, node_type = d.get("address"), d.get("type")
+        if not address or not node_type:
+            return json_error("announce requires address and type",
+                              status=400)
+        if d.get("leave"):
+            self.membership.leave(address, node_type)
+        else:
+            self.membership.announce(address, node_type,
+                                     d.get("filerGroup", ""),
+                                     d.get("version", ""))
+        return json_ok({"ok": True})
+
+    async def handle_cluster_nodes(self, req: web.Request) -> web.Response:
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
+        node_type = req.query.get("type", "")
+        return json_ok({"nodes": self.membership.to_dict(node_type)})
 
     async def handle_dir_status(self, req: web.Request) -> web.Response:
         return json_ok({"Topology": self.topo.to_dict()})

@@ -71,26 +71,31 @@ def run_command(env: CommandEnv, line: str) -> object:
     raise ShellError(f"unknown command {cmd!r} (try `help`)")
 
 
-def run_shell(master_url: str) -> int:
-    env = CommandEnv(master_url)
+def run_shell(master_url: str, filer_url: str = "") -> int:
+    env = CommandEnv(master_url, filer_url=filer_url)
     print(f"seaweedfs-tpu shell connected to {master_url}")
     print("type `help` for commands, `exit` to quit")
-    while True:
-        try:
-            line = input("> ").strip()
-        except (EOFError, KeyboardInterrupt):
-            print()
-            return 0
-        if line in ("exit", "quit"):
-            return 0
-        if not line:
-            continue
-        try:
-            out = run_command(env, line)
-            if out is not None:
-                print(out if isinstance(out, str)
-                      else json.dumps(out, indent=2, default=str))
-        except ShellError as e:
-            print(f"error: {e}")
-        except Exception as e:
-            print(f"error: {type(e).__name__}: {e}")
+    try:
+        while True:
+            try:
+                line = input("> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                print()
+                return 0
+            if line in ("exit", "quit"):
+                return 0
+            if not line:
+                continue
+            try:
+                out = run_command(env, line)
+                if out is not None:
+                    print(out if isinstance(out, str)
+                          else json.dumps(out, indent=2, default=str))
+            except ShellError as e:
+                print(f"error: {e}")
+            except Exception as e:
+                print(f"error: {type(e).__name__}: {e}")
+    finally:
+        # exiting with the cluster-wide admin lock held would wedge
+        # other operators until the lock TTL expires
+        env.close()

@@ -1,0 +1,173 @@
+"""Cluster membership + distributed lock manager tests.
+
+Covers the reference's weed/cluster/cluster.go membership semantics and
+lock_manager/distributed_lock_manager.go:13-93 (consistent-hash home
+filer, moved hints, TTL expiry, renewal tokens), plus the shell's
+cluster-wide admin lock riding on the DLM.
+"""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.cluster.lock_manager import (DistributedLockManager,
+                                                DlmClient, LockMoved,
+                                                LockNotOwned, LockRing)
+from seaweedfs_tpu.cluster.membership import ClusterMembership
+
+
+class TestMembership:
+    def test_announce_list_expire(self):
+        m = ClusterMembership(ttl_seconds=0.2)
+        m.announce("f1:8888", "filer")
+        m.announce("f2:8888", "filer")
+        m.announce("b1:9999", "broker")
+        assert [n.address for n in m.list_nodes("filer")] == \
+            ["f1:8888", "f2:8888"]
+        assert [n.address for n in m.list_nodes("broker")] == ["b1:9999"]
+        time.sleep(0.25)
+        m.announce("f1:8888", "filer")  # refresh just one
+        assert [n.address for n in m.list_nodes("filer")] == ["f1:8888"]
+
+    def test_leave(self):
+        m = ClusterMembership()
+        m.announce("f1:8888", "filer")
+        m.leave("f1:8888", "filer")
+        assert m.list_nodes("filer") == []
+
+    def test_filer_group_filter(self):
+        m = ClusterMembership()
+        m.announce("f1:8888", "filer", filer_group="g1")
+        m.announce("f2:8888", "filer", filer_group="g2")
+        assert [n.address for n in m.list_nodes("filer", "g1")] == \
+            ["f1:8888"]
+
+
+class TestLockManagerUnit:
+    def test_lock_unlock_roundtrip(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        token = dlm.lock("job1", owner="alice", ttl=5)
+        assert dlm.find_owner("job1") == "alice"
+        dlm.unlock("job1", token)
+        assert dlm.find_owner("job1") is None
+
+    def test_contention_rejected(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        dlm.lock("job1", owner="alice", ttl=5)
+        with pytest.raises(PermissionError):
+            dlm.lock("job1", owner="bob", ttl=5)
+        # same owner without token is still refused: token is the proof
+        with pytest.raises(PermissionError):
+            dlm.lock("job1", owner="alice", ttl=5)
+
+    def test_renewal_extends(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        token = dlm.lock("job1", owner="alice", ttl=0.15)
+        time.sleep(0.1)
+        token2 = dlm.lock("job1", owner="alice", ttl=0.15, token=token)
+        assert token2 == token
+        time.sleep(0.1)
+        assert dlm.find_owner("job1") == "alice"  # renewed past first ttl
+
+    def test_ttl_expiry_allows_takeover(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        dlm.lock("job1", owner="alice", ttl=0.1)
+        time.sleep(0.15)
+        dlm.lock("job1", owner="bob", ttl=5)  # expired -> takeover ok
+        assert dlm.find_owner("job1") == "bob"
+
+    def test_wrong_token_unlock(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        dlm.lock("job1", owner="alice", ttl=5)
+        with pytest.raises(LockNotOwned):
+            dlm.unlock("job1", "bogus")
+
+    def test_moved_when_not_home(self):
+        ring = LockRing()
+        ring.set_servers(["a:1", "b:2"])
+        a = DistributedLockManager("a:1", ring)
+        b = DistributedLockManager("b:2", ring)
+        # find a name homed on b, then ask a for it
+        name = next(n for n in (f"lk{i}" for i in range(64))
+                    if ring.owner_of(n) == "b:2")
+        with pytest.raises(LockMoved) as ei:
+            a.lock(name, owner="x")
+        assert ei.value.host == "b:2"
+        b.lock(name, owner="x")  # home filer accepts
+
+    def test_ring_consistency(self):
+        ring = LockRing()
+        ring.set_servers(["c:3", "a:1", "b:2"])
+        homes = {ring.owner_of(f"lock{i}") for i in range(100)}
+        assert homes <= {"a:1", "b:2", "c:3"}
+        assert len(homes) > 1  # names spread across the ring
+
+
+@pytest.fixture(scope="module")
+def dlm_cluster(tmp_path_factory):
+    """Master + 2 filers announcing membership; DLM over both."""
+    from seaweedfs_tpu.rpc.http import ServerThread
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+
+    master = MasterServer(pulse_seconds=0.4)
+    mt = ServerThread(master.app).start()
+    filers, threads = [], [mt]
+    for _ in range(2):
+        f = FilerServer(mt.url, announce_pulse=0.3)
+        t = ServerThread(f.app).start()
+        f.address = t.address
+        filers.append(f)
+        threads.append(t)
+    # membership loop pulses every 3s; force a fast first ring by
+    # waiting for both filers to appear in /cluster/nodes
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        nodes = requests.get(f"{mt.url}/cluster/nodes",
+                             params={"type": "filer"}, timeout=3).json()
+        if len(nodes["nodes"]) == 2 and \
+                all(len(f.dlm.ring.servers()) == 2 for f in filers):
+            break
+        time.sleep(0.1)
+    assert all(len(f.dlm.ring.servers()) == 2 for f in filers)
+    yield {"master": mt, "filers": filers,
+           "filer_urls": [t.address for t in threads[1:]]}
+    for t in threads:
+        t.stop()
+
+
+class TestDlmOverHttp:
+    def test_lock_routes_by_ring_and_follows_moved(self, dlm_cluster):
+        c = DlmClient(dlm_cluster["filer_urls"], owner="worker-1")
+        c.lock("migrate-vol-7")
+        assert c.is_held("migrate-vol-7")
+        # a second client contends and is refused
+        c2 = DlmClient(dlm_cluster["filer_urls"], owner="worker-2")
+        with pytest.raises(RuntimeError, match="held by"):
+            c2.lock("migrate-vol-7")
+        assert c2.find_owner("migrate-vol-7") == "worker-1"
+        c.unlock("migrate-vol-7")
+        c2.lock("migrate-vol-7")  # now free
+        c2.close()
+        c.close()
+
+    def test_admin_lock_via_shell_env(self, dlm_cluster):
+        from seaweedfs_tpu.shell.env import CommandEnv, ShellError
+
+        env = CommandEnv(dlm_cluster["master"].url,
+                         filer_url=dlm_cluster["filer_urls"][0])
+        env.acquire_lock()
+        env.confirm_locked()
+        # second operator cannot take the admin lock concurrently
+        env2 = CommandEnv(dlm_cluster["master"].url,
+                          filer_url=dlm_cluster["filer_urls"][1])
+        with pytest.raises(ShellError):
+            env2.acquire_lock()
+        env.release_lock()
+        env2.acquire_lock()
+        env2.release_lock()
