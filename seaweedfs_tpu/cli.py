@@ -72,6 +72,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-config", default="",
                    help="json file with s3 identities")
 
+    p = sub.add_parser("mount", help="FUSE-mount a filer directory")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-filer.path", dest="filer_path", default="/")
+    p.add_argument("-dir", required=True, help="local mountpoint")
+    p.add_argument("-cacheDir", dest="cache_dir", default="")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+
     p = sub.add_parser("shell", help="interactive admin shell")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-filer", default="",
@@ -117,6 +125,13 @@ def _dispatch(args) -> int:
         return _run_filer(args)
     if args.cmd == "s3":
         return _run_s3(args)
+    if args.cmd == "mount":
+        from .mount.fuse_adapter import mount
+
+        mount(args.filer, args.dir, root=args.filer_path,
+              cache_dir=args.cache_dir or None,
+              collection=args.collection, replication=args.replication)
+        return 0
     if args.cmd == "shell":
         from .shell.repl import run_shell
 
@@ -253,20 +268,20 @@ def _run_server(args) -> int:
     print(f"volume server listening on {vt.url}")
 
     if args.filer or args.s3:
-        from .filer.filer import Filer
         from .server.filer_server import FilerServer
 
         filer_dir = os.path.join(args.dir, "filer")
         os.makedirs(filer_dir, exist_ok=True)
-        filer = Filer(filer_dir, mt.url)
-        fs = FilerServer(filer)
+        fs = FilerServer(mt.url, store="sqlite",
+                         store_path=os.path.join(filer_dir, "filer.db"))
         ft = ServerThread(fs.app, host=args.ip, port=args.filer_port).start()
+        fs.address = ft.address
         threads.append(ft)
         print(f"filer listening on {ft.url}")
         if args.s3:
-            from .s3.server import S3Server
+            from .s3.server import S3ApiServer
 
-            s3 = S3Server(ft.url)
+            s3 = S3ApiServer(ft.url)
             st = ServerThread(s3.app, host=args.ip,
                               port=args.s3_port).start()
             threads.append(st)

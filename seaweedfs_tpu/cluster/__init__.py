@@ -1,6 +1,6 @@
 from .membership import ClusterMembership
 from .lock_manager import (DistributedLockManager, DlmClient, LockRing,
-                           LockMoved, LockNotOwned)
+                           LockMoved, LockNotOwned, RingEmpty)
 
 __all__ = ["ClusterMembership", "DistributedLockManager", "DlmClient",
-           "LockRing", "LockMoved", "LockNotOwned"]
+           "LockRing", "LockMoved", "LockNotOwned", "RingEmpty"]

@@ -30,29 +30,54 @@ class LockNotOwned(Exception):
     pass
 
 
+class RingEmpty(Exception):
+    """The lock ring has no servers yet (membership not pulsed):
+    grants must be refused or two filers could each think they own
+    every lock."""
+
+
 class LockRing:
-    """Sorted list of live filer addresses; a lock name hashes to one
-    of them (lock_ring.go keeps snapshots for stability — TTL'd lock
-    expiry plus client retry gives the same safety more simply)."""
+    """Consistent-hash ring of live filer addresses; a lock name maps
+    to the first virtual node at or after its hash (lock_ring.go).
+    Consistent hashing (vs mod-N) keeps most lock homes stable when a
+    filer joins or leaves — membership changes move only ~1/N of the
+    names, shrinking the pulse-skew window in which two filers can
+    disagree about a lock's home (that window is bounded by the
+    announce pulse; disagreement resolves via moved hints + renewal
+    rejection at the new home)."""
+
+    VNODES = 32
 
     def __init__(self) -> None:
         self._servers: list[str] = []
+        self._points: list[tuple[int, str]] = []
         self._lock = threading.Lock()
 
     def set_servers(self, servers: list[str]) -> None:
+        pts = []
+        for s in set(servers):
+            for i in range(self.VNODES):
+                pts.append((zlib.crc32(f"{s}#{i}".encode()), s))
+        pts.sort()
         with self._lock:
             self._servers = sorted(set(servers))
+            self._points = pts
 
     def servers(self) -> list[str]:
         with self._lock:
             return list(self._servers)
 
     def owner_of(self, name: str) -> str | None:
+        h = zlib.crc32(name.encode())
         with self._lock:
-            if not self._servers:
+            if not self._points:
                 return None
-            idx = zlib.crc32(name.encode()) % len(self._servers)
-            return self._servers[idx]
+            import bisect
+
+            idx = bisect.bisect_left(self._points, (h, ""))
+            if idx == len(self._points):
+                idx = 0
+            return self._points[idx][1]
 
 
 class _Lock:
@@ -82,7 +107,9 @@ class DistributedLockManager:
         Raises LockMoved if this filer is not the lock's home, or
         PermissionError if held by someone else."""
         home = self._home(name)
-        if home is not None and home != self.me:
+        if home is None:
+            raise RingEmpty("lock ring empty: membership not yet known")
+        if home != self.me:
             raise LockMoved(home)
         now = time.monotonic()
         with self._mu:
@@ -91,14 +118,17 @@ class DistributedLockManager:
                 if token and cur.token == token:
                     cur.expires_at = now + ttl  # renewal
                     return cur.token
-                if cur.owner == owner and not token:
-                    # same logical owner re-acquiring (e.g. after a
-                    # client restart) is refused: the token is the
-                    # proof of ownership
-                    raise PermissionError(
-                        f"lock {name} already held by {cur.owner}")
+                if token:
+                    raise LockNotOwned(
+                        f"stale renewal token for lock {name}")
                 raise PermissionError(
                     f"lock {name} held by {cur.owner}")
+            if token:
+                # a renewal must never resurrect a lock that was
+                # released or expired out from under its holder —
+                # the holder has to learn it lost the lock
+                raise LockNotOwned(
+                    f"lock {name} no longer held (expired/released)")
             new = _Lock(secrets.token_hex(8), owner, now + ttl)
             self._locks[name] = new
             return new.token
@@ -114,7 +144,9 @@ class DistributedLockManager:
 
     def find_owner(self, name: str) -> str | None:
         home = self._home(name)
-        if home is not None and home != self.me:
+        if home is None:
+            raise RingEmpty("lock ring empty: membership not yet known")
+        if home != self.me:
             raise LockMoved(home)
         now = time.monotonic()
         with self._mu:
@@ -138,6 +170,7 @@ class DlmClient:
         self.owner = owner or f"client-{secrets.token_hex(4)}"
         self.ttl = ttl
         self._held: dict[str, tuple[str, str]] = {}  # name -> (filer, token)
+        self._mu = threading.Lock()  # guards _held vs the renewer
         self._renewer: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -181,18 +214,21 @@ class DlmClient:
         raise RuntimeError(last_err or "no filer reachable for lock rpc")
 
     def lock(self, name: str) -> None:
+        with self._mu:
+            held = self._held.get(name)
         body = {"name": name, "owner": self.owner, "ttl": self.ttl}
-        held = self._held.get(name)
         if held is not None:
             # already ours: renew instead of contending with ourselves
             body["token"] = held[1]
         filer, d = self._request("/dlm/lock", body,
                                  start=held[0] if held else None)
-        self._held[name] = (filer, d["token"])
+        with self._mu:
+            self._held[name] = (filer, d["token"])
         self._ensure_renewer()
 
     def unlock(self, name: str) -> None:
-        held = self._held.pop(name, None)
+        with self._mu:
+            held = self._held.pop(name, None)
         if held is None:
             return
         filer, token = held
@@ -222,17 +258,25 @@ class DlmClient:
 
     def _renew_loop(self) -> None:
         while not self._stop.wait(self.ttl / 3):
-            for name, (filer, token) in list(self._held.items()):
+            with self._mu:
+                snapshot = list(self._held.items())
+            for name, (filer, token) in snapshot:
                 try:
                     new_filer, d = self._request(
                         "/dlm/lock",
                         {"name": name, "owner": self.owner,
                          "ttl": self.ttl, "token": token}, start=filer)
-                    self._held[name] = (new_filer, d["token"])
+                    with self._mu:
+                        # unlock() may have raced this renewal: only
+                        # record it if the lock is still held
+                        if name in self._held:
+                            self._held[name] = (new_filer, d["token"])
                 except Exception:
                     # lost the lock (ring moved + expiry); drop it so
                     # confirm() can tell the caller
-                    self._held.pop(name, None)
+                    with self._mu:
+                        self._held.pop(name, None)
 
     def is_held(self, name: str) -> bool:
-        return name in self._held
+        with self._mu:
+            return name in self._held

@@ -1,0 +1,3 @@
+from .weedfs import WeedFS
+
+__all__ = ["WeedFS"]

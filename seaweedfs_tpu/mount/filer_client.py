@@ -1,0 +1,142 @@
+"""Filer-facing client for the mount: entry CRUD over the filer meta
+HTTP API, chunk upload via master assign, chunk read via volume lookup,
+plus the metadata subscription that keeps the local meta cache fresh.
+
+Equivalent of the mount's filer gRPC usage in
+/root/reference/weed/mount/weedfs.go + meta_cache/meta_cache_subscribe.go,
+carried over this build's HTTP surface (filer `?meta=1` entry API,
+`mv.from` rename, /ws/meta_subscribe).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import requests
+
+from ..filer.entry import Entry
+from ..operation import verbs
+from ..wdclient.client import MasterClient
+
+
+class FilerClient:
+    def __init__(self, filer_url: str, master_url: str | None = None,
+                 collection: str = "", replication: str = ""):
+        self.filer_url = filer_url.rstrip("/") \
+            if filer_url.startswith("http") else f"http://{filer_url}"
+        self.collection = collection
+        self.replication = replication
+        # master for chunk assign/lookup; discovered from the filer's
+        # status if not given
+        if master_url is None:
+            st = requests.get(f"{self.filer_url}/status",
+                              timeout=10).json()
+            master_url = st.get("master", "")
+        self.master_url = master_url
+        self.masters = MasterClient(master_url)
+        self._sub_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- entries --------------------------------------------------------
+    def lookup_entry(self, path: str) -> Entry | None:
+        r = requests.get(f"{self.filer_url}{path}", params={"meta": "1"},
+                         timeout=30)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return Entry.from_dict(r.json())
+
+    def list_dir(self, path: str, limit: int = 1 << 20) -> list[Entry]:
+        out: list[Entry] = []
+        last = ""
+        while True:
+            r = requests.get(f"{self.filer_url}{path or '/'}",
+                             params={"limit": str(min(limit, 1024)),
+                                     "lastFileName": last},
+                             headers={"Accept": "application/json"},
+                             timeout=30)
+            if r.status_code == 404:
+                return out
+            r.raise_for_status()
+            d = r.json()
+            batch = [Entry.from_dict(e) for e in d.get("entries", [])]
+            out.extend(batch)
+            if not d.get("shouldDisplayLoadMore") or not batch or \
+                    len(out) >= limit:
+                return out[:limit]
+            last = d.get("lastFileName", "")
+
+    def save_entry(self, entry: Entry) -> None:
+        r = requests.put(f"{self.filer_url}{entry.full_path}",
+                         params={"meta": "1"},
+                         data=json.dumps(entry.to_dict()), timeout=60)
+        r.raise_for_status()
+
+    def mkdir(self, path: str) -> None:
+        r = requests.put(f"{self.filer_url}{path}", params={"mkdir": "1"},
+                         timeout=30)
+        r.raise_for_status()
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        r = requests.delete(f"{self.filer_url}{path}",
+                            params={"recursive": "true"} if recursive
+                            else {}, timeout=60)
+        if r.status_code not in (200, 204, 404):
+            r.raise_for_status()
+
+    def rename(self, old: str, new: str) -> None:
+        r = requests.put(f"{self.filer_url}{new}",
+                         params={"mv.from": old}, timeout=60)
+        r.raise_for_status()
+
+    # -- chunks ---------------------------------------------------------
+    def upload_chunk(self, data: bytes, name: str = "") -> tuple[str, str]:
+        """-> (fid, etag): assign a fid at the master and upload the
+        chunk bytes to its volume server."""
+        a = verbs.assign(self.master_url, collection=self.collection,
+                         replication=self.replication)
+        body = verbs.upload(a, data, name=name)
+        return a.fid, body.get("eTag", "")
+
+    def read_chunk(self, fid: str) -> bytes:
+        return verbs.download(self.masters.lookup_file_id(fid))
+
+    # -- metadata subscription (meta_cache_subscribe.go) ----------------
+    def subscribe_meta(self, prefix: str, on_event) -> None:
+        """Start a background thread feeding filer metadata events
+        (create/update/delete/rename) for paths under `prefix` to
+        on_event(event_dict). Used to invalidate the meta cache when
+        other clients change the namespace."""
+        self._stop.clear()
+        self._sub_thread = threading.Thread(
+            target=self._sub_loop, args=(prefix, on_event), daemon=True)
+        self._sub_thread.start()
+
+    def stop_subscription(self) -> None:
+        self._stop.set()
+
+    def _sub_loop(self, prefix: str, on_event) -> None:
+        import asyncio
+
+        async def run():
+            import aiohttp
+
+            url = self.filer_url.replace("http", "ws", 1) + \
+                "/ws/meta_subscribe"
+            while not self._stop.is_set():
+                try:
+                    async with aiohttp.ClientSession() as sess:
+                        async with sess.ws_connect(
+                                url, params={"path_prefix": prefix},
+                                heartbeat=30) as ws:
+                            async for msg in ws:
+                                if self._stop.is_set():
+                                    return
+                                if msg.type != aiohttp.WSMsgType.TEXT:
+                                    break
+                                on_event(json.loads(msg.data))
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+
+        asyncio.run(run())

@@ -1,0 +1,116 @@
+"""Optional FUSE kernel binding for WeedFS.
+
+Equivalent of the go-fuse binding in /root/reference/weed/mount/
+weedfs.go — host-side glue only (SURVEY.md section 2.1): all filesystem
+logic lives in weedfs.py; this file adapts it to the `fusepy`
+Operations interface when the `fuse` module is importable. The image
+used for CI has no FUSE, so everything here is import-gated and the
+core is exercised library-level by tests/test_mount.py.
+"""
+from __future__ import annotations
+
+import errno
+
+from .weedfs import FuseError, WeedFS
+
+try:
+    from fuse import FUSE, FuseOSError, LoggingMixIn, Operations
+    HAVE_FUSE = True
+except ImportError:  # pragma: no cover - no fuse in CI image
+    HAVE_FUSE = False
+    Operations = object
+
+    class FuseOSError(OSError):
+        def __init__(self, errno_):
+            super().__init__(errno_)
+
+
+class WeedFuseOps(Operations):  # pragma: no cover - needs kernel fuse
+    def __init__(self, fs: WeedFS):
+        self.fs = fs
+
+    def _wrap(self, fn, *args):
+        try:
+            return fn(*args)
+        except FuseError as e:
+            raise FuseOSError(e.errno or errno.EIO)
+
+    # metadata
+    def getattr(self, path, fh=None):
+        return self._wrap(self.fs.getattr, path)
+
+    def readdir(self, path, fh):
+        return self._wrap(self.fs.readdir, path)
+
+    def mkdir(self, path, mode):
+        self._wrap(self.fs.mkdir, path, mode)
+
+    def rmdir(self, path):
+        self._wrap(self.fs.rmdir, path)
+
+    def unlink(self, path):
+        self._wrap(self.fs.unlink, path)
+
+    def rename(self, old, new):
+        self._wrap(self.fs.rename, old, new)
+
+    def symlink(self, target, source):
+        self._wrap(self.fs.symlink, source, target)
+
+    def readlink(self, path):
+        return self._wrap(self.fs.readlink, path)
+
+    def chmod(self, path, mode):
+        self._wrap(self.fs.chmod, path, mode)
+
+    def chown(self, path, uid, gid):
+        self._wrap(self.fs.chown, path, uid, gid)
+
+    def utimens(self, path, times=None):
+        import time as _t
+
+        self._wrap(self.fs.utimens, path,
+                   times[1] if times else _t.time())
+
+    def truncate(self, path, length, fh=None):
+        self._wrap(self.fs.truncate, path, length, fh)
+
+    # files
+    def create(self, path, mode, fi=None):
+        return self._wrap(self.fs.create, path, mode)
+
+    def open(self, path, flags):
+        import os as _os
+
+        return self._wrap(self.fs.open, path,
+                          bool(flags & _os.O_TRUNC))
+
+    def read(self, path, size, offset, fh):
+        return self._wrap(self.fs.read, fh, offset, size)
+
+    def write(self, path, data, offset, fh):
+        return self._wrap(self.fs.write, fh, offset, data)
+
+    def flush(self, path, fh):
+        self._wrap(self.fs.flush, fh)
+
+    def release(self, path, fh):
+        self._wrap(self.fs.release, fh)
+
+    def statfs(self, path):
+        return self.fs.statfs()
+
+    def destroy(self, path):
+        self.fs.destroy()
+
+
+def mount(filer_url: str, mountpoint: str, root: str = "/",
+          **weedfs_kwargs) -> None:  # pragma: no cover
+    """Block serving `filer_url`'s `root` directory at `mountpoint`."""
+    if not HAVE_FUSE:
+        raise RuntimeError(
+            "fusepy is not installed in this environment; the mount "
+            "core is still usable as a library via mount.WeedFS")
+    fs = WeedFS(filer_url, root=root, **weedfs_kwargs)
+    FUSE(WeedFuseOps(fs), mountpoint, foreground=True, nothreads=False,
+         big_writes=True)

@@ -1,0 +1,78 @@
+"""Local metadata cache for the mount, kept fresh by the filer's
+metadata subscription.
+
+Equivalent of /root/reference/weed/mount/meta_cache/ (local leveldb of
+entries + meta_cache_subscribe.go invalidation): getattr/lookup/readdir
+hit this cache; create/update/delete events from OTHER clients
+invalidate or refresh it so a shared mount converges without
+re-listing on every access.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..filer.entry import Entry
+
+
+class MetaCache:
+    def __init__(self, ttl: float = 60.0):
+        self.ttl = ttl
+        self._entries: dict[str, tuple[Entry | None, float]] = {}
+        self._listed_dirs: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # -- reads ----------------------------------------------------------
+    def get(self, path: str) -> tuple[bool, Entry | None]:
+        """-> (hit, entry). entry None with hit=True caches negatives."""
+        with self._lock:
+            rec = self._entries.get(path)
+            if rec is None:
+                return False, None
+            entry, ts = rec
+            if time.monotonic() - ts > self.ttl:
+                del self._entries[path]
+                return False, None
+            return True, entry
+
+    def dir_listed(self, path: str) -> bool:
+        with self._lock:
+            ts = self._listed_dirs.get(path)
+            return ts is not None and time.monotonic() - ts <= self.ttl
+
+    # -- writes ---------------------------------------------------------
+    def put(self, path: str, entry: Entry | None) -> None:
+        with self._lock:
+            self._entries[path] = (entry, time.monotonic())
+
+    def mark_dir_listed(self, path: str) -> None:
+        with self._lock:
+            self._listed_dirs[path] = time.monotonic()
+
+    def invalidate(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            parent = path.rsplit("/", 1)[0] or "/"
+            self._listed_dirs.pop(parent, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._listed_dirs.clear()
+
+    # -- subscription hook (meta_cache_subscribe.go) --------------------
+    def on_meta_event(self, ev: dict) -> None:
+        """Apply one filer metadata event (event_log.py schema:
+        old/new entry dicts with full_path): refresh on create/update,
+        invalidate on delete/rename."""
+        old = ev.get("old_entry")
+        new = ev.get("new_entry")
+        if old and old.get("full_path"):
+            self.invalidate(old["full_path"])
+        if new:
+            try:
+                entry = Entry.from_dict(new)
+                self.invalidate(entry.full_path)  # drop parent listing
+                self.put(entry.full_path, entry)
+            except Exception:
+                pass

@@ -1,0 +1,174 @@
+"""Dirty-page writer: buffers writes per open file in fixed-size chunk
+slots, seals completed slots, and uploads sealed chunks through a
+bounded concurrent pipeline while writes continue.
+
+Equivalent of /root/reference/weed/mount/page_writer/ +
+dirty_pages_chunked.go: "moving" chunks accept writes; a chunk is
+sealed (and queued for upload) when the write cursor moves past it or
+on flush; the upload pipeline bounds in-flight chunk uploads
+(upload_pipeline.go) so a big sequential write streams at pipeline
+depth instead of buffering the whole file. Random writes inside a
+not-yet-sealed chunk mutate the buffer in place; writes into an
+already-sealed slot start a fresh version whose later mtime wins
+overlap resolution (filer/filechunks.py) — the same last-writer-wins
+the reference gets from chunk mtimes.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..filer.entry import FileChunk
+
+
+class _Slot:
+    """One chunk-sized window of the file being written."""
+
+    __slots__ = ("index", "buf", "spans")
+
+    def __init__(self, index: int, chunk_size: int):
+        self.index = index
+        self.buf = bytearray(chunk_size)
+        self.spans: list[tuple[int, int]] = []  # merged [start, end)
+
+    def write(self, off: int, data: bytes) -> None:
+        self.buf[off:off + len(data)] = data
+        self.spans = _merge(self.spans + [(off, off + len(data))])
+
+    def read_into(self, out: bytearray, slot_off: int, out_off: int,
+                  n: int) -> list[tuple[int, int]]:
+        """Copy the written parts of [slot_off, slot_off+n) into out;
+        returns the covered (absolute-in-slot) ranges."""
+        covered = []
+        for s, e in self.spans:
+            lo, hi = max(s, slot_off), min(e, slot_off + n)
+            if lo < hi:
+                out[out_off + lo - slot_off:out_off + hi - slot_off] = \
+                    self.buf[lo:hi]
+                covered.append((lo, hi))
+        return covered
+
+    @property
+    def extent(self) -> int:
+        return self.spans[-1][1] if self.spans else 0
+
+
+def _merge(spans: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    spans = sorted(spans)
+    out: list[tuple[int, int]] = []
+    for s, e in spans:
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+class DirtyPages:
+    """Per-filehandle dirty state + upload pipeline."""
+
+    def __init__(self, upload_fn, chunk_size: int = 8 << 20,
+                 pipeline: ThreadPoolExecutor | None = None):
+        """upload_fn(bytes) -> fid; pipeline is shared across handles
+        (the mount's bounded concurrent-upload budget)."""
+        self.upload_fn = upload_fn
+        self.chunk_size = chunk_size
+        self._slots: dict[int, _Slot] = {}
+        # sealed-but-unflushed uploads keep their payload so overlay
+        # reads between seal and flush still see the bytes
+        self._uploads: list[tuple[Future, int, int, int, bytes]] = []
+        self._pipeline = pipeline or ThreadPoolExecutor(max_workers=4)
+        self._owns_pipeline = pipeline is None
+        self._lock = threading.Lock()
+        self._mtime_ns = 0
+
+    def write(self, offset: int, data: bytes) -> None:
+        with self._lock:
+            pos = 0
+            while pos < len(data):
+                idx = (offset + pos) // self.chunk_size
+                slot_off = (offset + pos) % self.chunk_size
+                n = min(self.chunk_size - slot_off, len(data) - pos)
+                slot = self._slots.get(idx)
+                if slot is None:
+                    slot = _Slot(idx, self.chunk_size)
+                    self._slots[idx] = slot
+                slot.write(slot_off, data[pos:pos + n])
+                pos += n
+            # seal every full slot strictly before the write cursor:
+            # sequential writers stream instead of accumulating
+            last_idx = (offset + len(data) - 1) // self.chunk_size
+            for idx in sorted(self._slots):
+                s = self._slots[idx]
+                if idx < last_idx and \
+                        s.spans == [(0, self.chunk_size)]:
+                    self._seal_and_upload(idx, pop=True)
+
+    def _seal_and_upload(self, idx: int, pop: bool) -> None:
+        """Queue one slot's written spans for upload (lock held)."""
+        slot = self._slots[idx]
+        if pop:
+            del self._slots[idx]
+        base = idx * self.chunk_size
+        for s, e in slot.spans:
+            payload = bytes(slot.buf[s:e])
+            fut = self._pipeline.submit(self.upload_fn, payload)
+            self._uploads.append((fut, base + s, e - s,
+                                  self._next_mtime_ns(), payload))
+
+    def _next_mtime_ns(self) -> int:
+        import time as _t
+
+        self._mtime_ns = max(self._mtime_ns + 1, _t.time_ns())
+        return self._mtime_ns
+
+    def read_overlay(self, offset: int, size: int,
+                     out: bytearray) -> list[tuple[int, int]]:
+        """Copy dirty bytes overlapping [offset, offset+size) into out
+        (same indexing); returns the absolute file ranges covered — the
+        read path lays these over the committed chunk data. Sealed
+        uploads apply first (oldest writes), then moving slots (newest)
+        so later writes win just as their mtimes will after flush."""
+        covered = []
+        with self._lock:
+            for _, file_off, size_u, _, payload in self._uploads:
+                lo = max(offset, file_off)
+                hi = min(offset + size, file_off + size_u)
+                if lo < hi:
+                    out[lo - offset:hi - offset] = \
+                        payload[lo - file_off:hi - file_off]
+                    covered.append((lo, hi))
+            for idx, slot in self._slots.items():
+                base = idx * self.chunk_size
+                lo = max(offset, base)
+                hi = min(offset + size, base + self.chunk_size)
+                if lo >= hi:
+                    continue
+                for s, e in slot.read_into(out, lo - base, lo - offset,
+                                           hi - lo):
+                    covered.append((base + s, base + e))
+        return sorted(covered)
+
+    def flush(self) -> list[FileChunk]:
+        """Seal everything, wait for the pipeline, and return the new
+        FileChunks in upload order (mtimes strictly increasing so
+        overlap resolution prefers later writes)."""
+        with self._lock:
+            for idx in sorted(self._slots):
+                self._seal_and_upload(idx, pop=False)
+            self._slots.clear()
+            uploads, self._uploads = self._uploads, []
+        chunks = []
+        for fut, file_off, size, mtime_ns, _ in uploads:
+            fid = fut.result()
+            chunks.append(FileChunk(fid=fid, offset=file_off, size=size,
+                                    mtime_ns=mtime_ns))
+        return chunks
+
+    def has_dirty(self) -> bool:
+        with self._lock:
+            return bool(self._slots) or bool(self._uploads)
+
+    def close(self) -> None:
+        if self._owns_pipeline:
+            self._pipeline.shutdown(wait=False)

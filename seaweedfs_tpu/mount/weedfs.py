@@ -1,0 +1,390 @@
+"""WeedFS: the mount's filesystem core — POSIX-shaped operations over
+the filer, with local meta cache, tiered chunk read cache, and the
+dirty-page upload pipeline for writes.
+
+Equivalent of /root/reference/weed/mount/weedfs.go:29-60 and its op
+files (weedfs_file_read.go, weedfs_file_write.go, weedfs_dir_*.go,
+weedfs_attr.go, filehandle.go): the kernel-facing FUSE layer is a thin
+adapter (fuse_adapter.py, optional); everything stateful lives here so
+the same core drives tests, tools, and FUSE alike.
+
+Concurrency model: one DirtyPages per open filehandle, all handles
+sharing one bounded upload pipeline (page_writer/upload_pipeline.go);
+reads overlay unflushed dirty bytes on committed chunk content so a
+writer observes its own writes before flush.
+"""
+from __future__ import annotations
+
+import os
+import stat
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..filer.entry import DIR_MODE_FLAG, Entry, FileChunk, total_size
+from ..filer.filechunks import compact_file_chunks, view_from_chunks
+from .chunk_cache import TieredChunkCache
+from .filer_client import FilerClient
+from .inode_registry import InodeRegistry
+from .meta_cache import MetaCache
+from .page_writer import DirtyPages
+
+
+class FuseError(OSError):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(errno_, msg or os.strerror(errno_))
+
+
+class FileHandle:
+    def __init__(self, fh: int, path: str, entry: Entry,
+                 dirty: DirtyPages):
+        self.fh = fh
+        self.path = path
+        self.entry = entry
+        self.dirty = dirty
+        self.refs = 1
+        self.lock = threading.Lock()
+
+
+class WeedFS:
+    def __init__(self, filer_url: str, master_url: str | None = None,
+                 root: str = "/", chunk_size: int = 8 << 20,
+                 cache_dir: str | None = None,
+                 cache_mem_bytes: int = 64 << 20,
+                 cache_disk_bytes: int = 1 << 30,
+                 upload_workers: int = 8,
+                 collection: str = "", replication: str = "",
+                 subscribe: bool = True,
+                 meta_ttl: float = 60.0):
+        """root: the filer directory this mount exposes as '/'."""
+        self.client = FilerClient(filer_url, master_url,
+                                  collection=collection,
+                                  replication=replication)
+        self.root = root.rstrip("/") or ""
+        self.chunk_size = chunk_size
+        self.inodes = InodeRegistry()
+        self.meta = MetaCache(ttl=meta_ttl)
+        self.chunks = TieredChunkCache(cache_mem_bytes, cache_dir,
+                                       cache_disk_bytes)
+        self.pipeline = ThreadPoolExecutor(max_workers=upload_workers)
+        self._handles: dict[int, FileHandle] = {}
+        self._next_fh = 1
+        self._lock = threading.Lock()
+        if self.root:
+            # ensure the mounted directory exists
+            try:
+                self.client.mkdir(self.root)
+            except Exception:
+                pass
+        if subscribe:
+            self.client.subscribe_meta(self.root or "/",
+                                       self._on_meta_event)
+
+    # ------------------------------------------------------------------
+    # path plumbing
+    # ------------------------------------------------------------------
+    def _abs(self, path: str) -> str:
+        path = "/" + path.strip("/")
+        return (self.root + path).rstrip("/") or "/"
+
+    def _rel(self, full: str) -> str:
+        if self.root and full.startswith(self.root):
+            full = full[len(self.root):]
+        return full or "/"
+
+    def _on_meta_event(self, ev: dict) -> None:
+        self.meta.on_meta_event(ev)
+
+    # ------------------------------------------------------------------
+    # metadata ops
+    # ------------------------------------------------------------------
+    def _entry(self, path: str) -> Entry | None:
+        full = self._abs(path)
+        hit, entry = self.meta.get(full)
+        if hit:
+            return entry
+        entry = self.client.lookup_entry(full)
+        self.meta.put(full, entry)
+        return entry
+
+    def getattr(self, path: str) -> dict:
+        if path in ("/", ""):
+            return {"st_mode": stat.S_IFDIR | 0o755, "st_ino": 1,
+                    "st_nlink": 2, "st_size": 0, "st_mtime": 0,
+                    "st_ctime": 0, "st_uid": 0, "st_gid": 0}
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)  # ENOENT
+        return self._attr_of(entry)
+
+    def _attr_of(self, entry: Entry) -> dict:
+        is_dir = entry.is_directory
+        mode = (stat.S_IFDIR if is_dir else
+                stat.S_IFLNK if entry.symlink_target else stat.S_IFREG)
+        size = entry.file_size
+        # open handles know about unflushed extents
+        with self._lock:
+            for h in self._handles.values():
+                if h.path == self._rel(entry.full_path):
+                    size = max(size, self._dirty_extent(h))
+        return {"st_mode": mode | (entry.mode & 0o7777),
+                "st_ino": self.inodes.lookup(entry.full_path),
+                "st_nlink": 2 if is_dir else 1,
+                "st_size": size, "st_mtime": entry.mtime,
+                "st_ctime": entry.crtime, "st_uid": entry.uid,
+                "st_gid": entry.gid}
+
+    def _dirty_extent(self, h: FileHandle) -> int:
+        d = h.dirty
+        with d._lock:
+            hi = 0
+            for _, off, size, _, _ in d._uploads:
+                hi = max(hi, off + size)
+            for idx, slot in d._slots.items():
+                hi = max(hi, idx * d.chunk_size + slot.extent)
+            return hi
+
+    def readdir(self, path: str) -> list[str]:
+        full = self._abs(path)
+        entry = self._entry(path)
+        if path not in ("/", "") and (entry is None or
+                                      not entry.is_directory):
+            raise FuseError(20 if entry is not None else 2)  # ENOTDIR
+        names = [".", ".."]
+        for e in self.client.list_dir(full):
+            self.meta.put(e.full_path, e)
+            names.append(e.name)
+        self.meta.mark_dir_listed(full)
+        return names
+
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        full = self._abs(path)
+        if self._entry(path) is not None:
+            raise FuseError(17)  # EEXIST
+        self.client.mkdir(full)
+        self.meta.invalidate(full)
+
+    def rmdir(self, path: str) -> None:
+        full = self._abs(path)
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        if not entry.is_directory:
+            raise FuseError(20)
+        if self.client.list_dir(full, limit=1):
+            raise FuseError(39)  # ENOTEMPTY
+        self.client.delete(full)
+        self.meta.invalidate(full)
+        self.inodes.forget(full)
+
+    def unlink(self, path: str) -> None:
+        full = self._abs(path)
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        self.client.delete(full)
+        self.meta.invalidate(full)
+        self.inodes.forget(full)
+
+    def rename(self, old: str, new: str) -> None:
+        full_old, full_new = self._abs(old), self._abs(new)
+        if self._entry(old) is None:
+            raise FuseError(2)
+        self.client.rename(full_old, full_new)
+        self.inodes.replace_path(full_old, full_new)
+        self.meta.invalidate(full_old)
+        self.meta.invalidate(full_new)
+        with self._lock:  # open handles follow the rename
+            for h in self._handles.values():
+                if h.path == old:
+                    h.path = new
+                elif h.path.startswith(old + "/"):
+                    h.path = new + h.path[len(old):]
+
+    def symlink(self, target: str, linkpath: str) -> None:
+        full = self._abs(linkpath)
+        entry = Entry(full_path=full, mode=0o777,
+                      symlink_target=target)
+        self.client.save_entry(entry)
+        self.meta.invalidate(full)
+
+    def readlink(self, path: str) -> str:
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        if not entry.symlink_target:
+            raise FuseError(22)  # EINVAL
+        return entry.symlink_target
+
+    def chmod(self, path: str, mode: int) -> None:
+        self._update_attr(path, mode=mode & 0o7777)
+
+    def chown(self, path: str, uid: int, gid: int) -> None:
+        self._update_attr(path, uid=uid, gid=gid)
+
+    def utimens(self, path: str, mtime: float) -> None:
+        self._update_attr(path, mtime=mtime)
+
+    def _update_attr(self, path: str, **fields) -> None:
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        dir_bit = entry.mode & DIR_MODE_FLAG
+        for k, v in fields.items():
+            setattr(entry, k, v)
+        entry.mode |= dir_bit
+        self.client.save_entry(entry)
+        self.meta.put(entry.full_path, entry)
+
+    # ------------------------------------------------------------------
+    # file handles
+    # ------------------------------------------------------------------
+    def create(self, path: str, mode: int = 0o644) -> int:
+        full = self._abs(path)
+        entry = Entry(full_path=full, mode=mode & 0o7777, chunks=[])
+        self.client.save_entry(entry)
+        self.meta.put(full, entry)
+        return self._open_handle(path, entry)
+
+    def open(self, path: str, truncate: bool = False) -> int:
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        if entry.is_directory:
+            raise FuseError(21)  # EISDIR
+        if truncate and entry.chunks:
+            entry.chunks = []
+            entry.mtime = time.time()
+            self.client.save_entry(entry)
+            self.meta.put(entry.full_path, entry)
+        return self._open_handle(path, entry)
+
+    def _open_handle(self, path: str, entry: Entry) -> int:
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            dirty = DirtyPages(self._uploader(), self.chunk_size,
+                               pipeline=self.pipeline)
+            self._handles[fh] = FileHandle(fh, path, entry, dirty)
+            return fh
+
+    def _uploader(self):
+        def up(data: bytes) -> str:
+            fid, _ = self.client.upload_chunk(data)
+            return fid
+        return up
+
+    def _handle(self, fh: int) -> FileHandle:
+        with self._lock:
+            h = self._handles.get(fh)
+        if h is None:
+            raise FuseError(9)  # EBADF
+        return h
+
+    # ------------------------------------------------------------------
+    # io
+    # ------------------------------------------------------------------
+    def write(self, fh: int, offset: int, data: bytes) -> int:
+        h = self._handle(fh)
+        h.dirty.write(offset, data)
+        return len(data)
+
+    def read(self, fh: int, offset: int, size: int) -> bytes:
+        h = self._handle(fh)
+        committed_size = total_size(h.entry.chunks)
+        out = bytearray(size)
+        # committed chunks first
+        n_committed = 0
+        if offset < committed_size:
+            want = min(size, committed_size - offset)
+            data = self._read_chunks(h.entry.chunks, offset, want)
+            out[:len(data)] = data
+            n_committed = len(data)
+        # dirty overlay wins over committed bytes
+        covered = h.dirty.read_overlay(offset, size, out)
+        max_extent = max(
+            [offset + n_committed] + [e for _, e in covered]) - offset
+        return bytes(out[:min(size, max_extent)])
+
+    def _read_chunks(self, chunks: list[FileChunk], offset: int,
+                     size: int) -> bytes:
+        """Assemble [offset, offset+size) from visible chunk views,
+        whole chunks riding the tiered cache (reader_cache.go)."""
+        views = view_from_chunks(chunks, offset, size)
+        out = bytearray(size)
+        for v in views:
+            data = self.chunks.get(v.fid)
+            if data is None:
+                data = self.client.read_chunk(v.fid)
+                self.chunks.put(v.fid, data)
+            piece = data[v.offset_in_chunk:v.offset_in_chunk + v.view_size]
+            pos = v.view_offset - offset
+            out[pos:pos + len(piece)] = piece
+        return bytes(out)
+
+    def flush(self, fh: int) -> None:
+        """Commit dirty pages: upload remainders, merge new chunks into
+        the entry, save (weedfs_file_sync.go doFlush)."""
+        h = self._handle(fh)
+        with h.lock:
+            new_chunks = h.dirty.flush()
+            if not new_chunks:
+                return
+            entry = h.entry
+            # garbage = fully-shadowed chunks; the filer's meta save
+            # deletes committed ones it no longer sees, and never-
+            # committed ones are reclaimed by volume.fsck
+            entry.chunks, _garbage = compact_file_chunks(
+                entry.chunks + new_chunks)
+            entry.mtime = time.time()
+            self.client.save_entry(entry)
+            self.meta.put(entry.full_path, entry)
+
+    def release(self, fh: int) -> None:
+        h = self._handle(fh)
+        self.flush(fh)
+        with self._lock:
+            h.refs -= 1
+            if h.refs <= 0:
+                self._handles.pop(fh, None)
+
+    def truncate(self, path: str, length: int, fh: int | None = None) -> None:
+        if fh is not None:
+            self.flush(fh)
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(2)
+        if length == 0:
+            entry.chunks = []
+        else:
+            kept = []
+            for c in entry.chunks:
+                if c.offset >= length:
+                    continue
+                if c.offset + c.size > length:
+                    c = FileChunk(fid=c.fid, offset=c.offset,
+                                  size=length - c.offset,
+                                  mtime_ns=c.mtime_ns, etag=c.etag)
+                kept.append(c)
+            entry.chunks = kept
+        entry.mtime = time.time()
+        self.client.save_entry(entry)
+        self.meta.put(entry.full_path, entry)
+        with self._lock:
+            for h in self._handles.values():
+                if h.path == path:
+                    h.entry = entry
+
+    # ------------------------------------------------------------------
+    def statfs(self) -> dict:
+        return {"f_bsize": self.chunk_size, "f_blocks": 1 << 30,
+                "f_bfree": 1 << 30, "f_bavail": 1 << 30}
+
+    def destroy(self) -> None:
+        for fh in list(self._handles):
+            try:
+                self.release(fh)
+            except Exception:
+                pass
+        self.client.stop_subscription()
+        self.pipeline.shutdown(wait=True)

@@ -177,7 +177,8 @@ class FilerServer:
 
     # -- distributed lock manager (filer_grpc_server_dlm.go) -----------
     async def handle_dlm_lock(self, req: web.Request) -> web.Response:
-        from ..cluster.lock_manager import LockMoved
+        from ..cluster.lock_manager import (LockMoved, LockNotOwned,
+                                            RingEmpty)
 
         d = await req.json()
         try:
@@ -186,7 +187,9 @@ class FilerServer:
                                   d.get("token", ""))
         except LockMoved as e:
             return web.json_response({"moved": e.host}, status=409)
-        except PermissionError as e:
+        except RingEmpty as e:
+            return web.json_response({"error": str(e)}, status=503)
+        except (PermissionError, LockNotOwned) as e:
             return web.json_response({"error": str(e)}, status=403)
         return web.json_response({"token": token})
 
@@ -201,13 +204,15 @@ class FilerServer:
         return web.json_response({"ok": True})
 
     async def handle_dlm_find(self, req: web.Request) -> web.Response:
-        from ..cluster.lock_manager import LockMoved
+        from ..cluster.lock_manager import LockMoved, RingEmpty
 
         d = await req.json()
         try:
             owner = self.dlm.find_owner(d["name"])
         except LockMoved as e:
             return web.json_response({"moved": e.host}, status=409)
+        except RingEmpty as e:
+            return web.json_response({"error": str(e)}, status=503)
         return web.json_response({"owner": owner})
 
     def _lookup_fid(self, fid: str) -> str:

@@ -100,6 +100,38 @@ class TestLockManagerUnit:
         assert ei.value.host == "b:2"
         b.lock(name, owner="x")  # home filer accepts
 
+    def test_stale_renewal_cannot_resurrect(self):
+        dlm = DistributedLockManager("me")
+        dlm.ring.set_servers(["me"])
+        token = dlm.lock("job1", owner="alice", ttl=5)
+        dlm.unlock("job1", token)
+        with pytest.raises(LockNotOwned):
+            dlm.lock("job1", owner="alice", ttl=5, token=token)
+        # expired lock: renewal is rejected too
+        t2 = dlm.lock("job2", owner="bob", ttl=0.05)
+        time.sleep(0.1)
+        with pytest.raises(LockNotOwned):
+            dlm.lock("job2", owner="bob", ttl=5, token=t2)
+
+    def test_empty_ring_refuses_grants(self):
+        from seaweedfs_tpu.cluster.lock_manager import RingEmpty
+
+        dlm = DistributedLockManager("me")  # ring never populated
+        with pytest.raises(RingEmpty):
+            dlm.lock("job1", owner="alice")
+        with pytest.raises(RingEmpty):
+            dlm.find_owner("job1")
+
+    def test_consistent_hash_stability_on_growth(self):
+        ring = LockRing()
+        ring.set_servers(["a:1", "b:2", "c:3"])
+        before = {f"lk{i}": ring.owner_of(f"lk{i}") for i in range(200)}
+        ring.set_servers(["a:1", "b:2", "c:3", "d:4"])
+        moved = sum(1 for k, v in before.items()
+                    if ring.owner_of(k) != v)
+        # consistent hashing moves ~1/N of names, not ~all like mod-N
+        assert moved < 120, f"{moved}/200 moved"
+
     def test_ring_consistency(self):
         ring = LockRing()
         ring.set_servers(["c:3", "a:1", "b:2"])
