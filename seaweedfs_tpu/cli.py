@@ -72,6 +72,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-config", default="",
                    help="json file with s3 identities")
 
+    p = sub.add_parser("webdav", help="start a WebDAV gateway")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-filer.path", dest="filer_path", default="/")
+
+    p = sub.add_parser("iam", help="start an IAM API server")
+    p.add_argument("-port", type=int, default=8111)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+
     p = sub.add_parser("mount", help="FUSE-mount a filer directory")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-filer.path", dest="filer_path", default="/")
@@ -125,6 +136,24 @@ def _dispatch(args) -> int:
         return _run_filer(args)
     if args.cmd == "s3":
         return _run_s3(args)
+    if args.cmd == "webdav":
+        from .rpc.http import ServerThread, run_apps_forever
+        from .webdav.server import WebDavServer
+
+        w = WebDavServer(args.filer, root=args.filer_path)
+        t = ServerThread(w.app, host=args.ip, port=args.port).start()
+        print(f"webdav listening on {t.url}")
+        run_apps_forever([t])
+        return 0
+    if args.cmd == "iam":
+        from .iam.server import IamApiServer
+        from .rpc.http import ServerThread, run_apps_forever
+
+        i = IamApiServer(args.filer)
+        t = ServerThread(i.app, host=args.ip, port=args.port).start()
+        print(f"iam api listening on {t.url}")
+        run_apps_forever([t])
+        return 0
     if args.cmd == "mount":
         from .mount.fuse_adapter import mount
 

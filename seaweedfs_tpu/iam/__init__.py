@@ -1,0 +1,3 @@
+from .server import IamApiServer
+
+__all__ = ["IamApiServer"]

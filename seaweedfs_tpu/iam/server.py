@@ -1,0 +1,276 @@
+"""Minimal AWS IAM REST API managing the S3 gateway's identities.
+
+Equivalent of /root/reference/weed/iamapi/ (iamapi_server.go,
+iamapi_management_handlers.go): form-encoded Action= requests with XML
+responses — CreateUser / GetUser / DeleteUser / ListUsers,
+CreateAccessKey / DeleteAccessKey / ListAccessKeys, PutUserPolicy /
+GetUserPolicy / DeleteUserPolicy. State is the same s3.configure
+identities document the S3 gateway hot-reloads, persisted in the filer
+KV (s3/identities — s3/server.py IDENTITIES_KV_KEY).
+
+Policy documents are mapped onto the gateway's action strings the same
+way the reference maps them (iamapi_management_handlers.go
+GetActions): s3:* -> Admin, s3:GetObject -> Read, s3:PutObject ->
+Write, s3:List* -> List, s3:Tagging -> Tagging, with per-bucket
+resource narrowing "Action:bucket".
+"""
+from __future__ import annotations
+
+import json
+import secrets
+import uuid
+from xml.sax.saxutils import escape
+
+import aiohttp
+from aiohttp import web
+
+IDENTITIES_KV_KEY = "s3/identities"
+
+
+def _xml(action: str, inner: str) -> str:
+    rid = uuid.uuid4()
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<{action}Response xmlns='
+            f'"https://iam.amazonaws.com/doc/2010-05-08/">'
+            f"{inner}"
+            f"<ResponseMetadata><RequestId>{rid}</RequestId>"
+            f"</ResponseMetadata></{action}Response>")
+
+
+def _error(code: str, message: str, status: int = 400) -> web.Response:
+    body = ('<?xml version="1.0" encoding="UTF-8"?>'
+            "<ErrorResponse><Error>"
+            f"<Code>{escape(code)}</Code>"
+            f"<Message>{escape(message)}</Message>"
+            "</Error></ErrorResponse>")
+    return web.Response(status=status, text=body,
+                        content_type="application/xml")
+
+
+def policy_to_actions(policy: dict) -> list[str]:
+    """AWS policy document -> gateway action strings
+    (iamapi_management_handlers.go GetActions)."""
+    out: list[str] = []
+    for st in policy.get("Statement", []):
+        if st.get("Effect") != "Allow":
+            continue
+        actions = st.get("Action", [])
+        if isinstance(actions, str):
+            actions = [actions]
+        resources = st.get("Resource", [])
+        if isinstance(resources, str):
+            resources = [resources]
+        buckets = []
+        for res in resources:
+            # arn:aws:s3:::bucket/*, arn:aws:s3:::bucket, arn:aws:s3:::*
+            tail = res.rsplit(":::", 1)[-1]
+            bucket = tail.split("/", 1)[0]
+            buckets.append("" if bucket in ("*", "") else bucket)
+        for a in actions:
+            verb = a.split(":", 1)[-1]
+            if verb == "*":
+                mapped = ["Admin"]
+            elif "Tagging" in verb:
+                # before the prefix arms: every tagging verb starts
+                # with Get/Put/Delete and must NOT grant body access
+                mapped = ["Tagging"]
+            elif verb.startswith("Get"):
+                mapped = ["Read"]
+            elif verb.startswith("Put") or verb.startswith("Delete"):
+                mapped = ["Write"]
+            elif verb.startswith("List"):
+                mapped = ["List"]
+            else:
+                mapped = []
+            for m in mapped:
+                for b in buckets or [""]:
+                    out.append(f"{m}:{b}" if b else m)
+    seen, uniq = set(), []
+    for a in out:
+        if a not in seen:
+            seen.add(a)
+            uniq.append(a)
+    return uniq
+
+
+class IamApiServer:
+    def __init__(self, filer_url: str):
+        import asyncio
+
+        self.filer_url = filer_url.rstrip("/") \
+            if filer_url.startswith("http") else f"http://{filer_url}"
+        # serializes load-mutate-save so concurrent requests cannot
+        # lose each other's identity updates
+        self._config_lock = asyncio.Lock()
+        self.app = web.Application()
+        self.app.add_routes([web.post("/", self.dispatch),
+                             web.get("/status", self.handle_status)])
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response({"filer": self.filer_url})
+
+    # -- config persistence (filer KV, shared with the S3 gateway) -----
+    async def _load(self, sess: aiohttp.ClientSession) -> dict:
+        async with sess.get(
+                f"{self.filer_url}/kv/{IDENTITIES_KV_KEY}") as r:
+            if r.status != 200:
+                return {"identities": []}
+            try:
+                return json.loads(await r.read())
+            except json.JSONDecodeError:
+                return {"identities": []}
+
+    async def _save(self, sess: aiohttp.ClientSession,
+                    config: dict) -> None:
+        async with sess.put(f"{self.filer_url}/kv/{IDENTITIES_KV_KEY}",
+                            data=json.dumps(config).encode()) as r:
+            r.raise_for_status()
+
+    @staticmethod
+    def _user(config: dict, name: str) -> dict | None:
+        for ident in config.get("identities", []):
+            if ident.get("name") == name:
+                return ident
+        return None
+
+    # -- dispatch -------------------------------------------------------
+    async def dispatch(self, req: web.Request) -> web.Response:
+        form = await req.post()
+        action = form.get("Action", "")
+        handler = getattr(self, f"do_{action}", None)
+        if handler is None:
+            return _error("InvalidAction", f"unsupported: {action}")
+        async with self._config_lock:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=10)) as sess:
+                config = await self._load(sess)
+                try:
+                    inner, changed = await handler(form, config)
+                except KeyError as e:
+                    return _error("MissingParameter", str(e))
+                if changed:
+                    await self._save(sess, config)
+        if isinstance(inner, web.Response):
+            return inner
+        return web.Response(text=_xml(action, inner),
+                            content_type="application/xml")
+
+    # -- users ----------------------------------------------------------
+    async def do_CreateUser(self, form, config):
+        name = form["UserName"]
+        if self._user(config, name) is not None:
+            return _error("EntityAlreadyExists",
+                          f"user {name} exists", 409), False
+        config.setdefault("identities", []).append(
+            {"name": name, "credentials": [], "actions": []})
+        return (f"<CreateUserResult><User>"
+                f"<UserName>{escape(name)}</UserName>"
+                f"<UserId>{uuid.uuid4()}</UserId>"
+                f"<Arn>arn:aws:iam:::user/{escape(name)}</Arn>"
+                f"</User></CreateUserResult>"), True
+
+    async def do_GetUser(self, form, config):
+        name = form["UserName"]
+        if self._user(config, name) is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        return (f"<GetUserResult><User>"
+                f"<UserName>{escape(name)}</UserName>"
+                f"<Arn>arn:aws:iam:::user/{escape(name)}</Arn>"
+                f"</User></GetUserResult>"), False
+
+    async def do_DeleteUser(self, form, config):
+        name = form["UserName"]
+        ids = config.get("identities", [])
+        if self._user(config, name) is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        config["identities"] = [i for i in ids if i.get("name") != name]
+        return "", True
+
+    async def do_ListUsers(self, form, config):
+        users = "".join(
+            f"<member><UserName>{escape(i['name'])}</UserName>"
+            f"<Arn>arn:aws:iam:::user/{escape(i['name'])}</Arn></member>"
+            for i in config.get("identities", []))
+        return (f"<ListUsersResult><Users>{users}</Users>"
+                f"<IsTruncated>false</IsTruncated></ListUsersResult>"), \
+            False
+
+    # -- access keys ----------------------------------------------------
+    async def do_CreateAccessKey(self, form, config):
+        name = form["UserName"]
+        user = self._user(config, name)
+        if user is None:  # reference auto-creates on key request
+            user = {"name": name, "credentials": [], "actions": []}
+            config.setdefault("identities", []).append(user)
+        access_key = "AKI" + secrets.token_hex(8).upper()
+        secret_key = secrets.token_urlsafe(30)
+        user.setdefault("credentials", []).append(
+            {"accessKey": access_key, "secretKey": secret_key})
+        return (f"<CreateAccessKeyResult><AccessKey>"
+                f"<UserName>{escape(name)}</UserName>"
+                f"<AccessKeyId>{access_key}</AccessKeyId>"
+                f"<Status>Active</Status>"
+                f"<SecretAccessKey>{secret_key}</SecretAccessKey>"
+                f"</AccessKey></CreateAccessKeyResult>"), True
+
+    async def do_DeleteAccessKey(self, form, config):
+        name, key_id = form["UserName"], form["AccessKeyId"]
+        user = self._user(config, name)
+        if user is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        before = len(user.get("credentials", []))
+        user["credentials"] = [c for c in user.get("credentials", [])
+                               if c.get("accessKey") != key_id]
+        if len(user["credentials"]) == before:
+            return _error("NoSuchEntity", f"no key {key_id}", 404), False
+        return "", True
+
+    async def do_ListAccessKeys(self, form, config):
+        name = form["UserName"]
+        user = self._user(config, name)
+        if user is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        members = "".join(
+            f"<member><UserName>{escape(name)}</UserName>"
+            f"<AccessKeyId>{c['accessKey']}</AccessKeyId>"
+            f"<Status>Active</Status></member>"
+            for c in user.get("credentials", []))
+        return (f"<ListAccessKeysResult><AccessKeyMetadata>{members}"
+                f"</AccessKeyMetadata><IsTruncated>false</IsTruncated>"
+                f"</ListAccessKeysResult>"), False
+
+    # -- user policies ---------------------------------------------------
+    async def do_PutUserPolicy(self, form, config):
+        name = form["UserName"]
+        doc = json.loads(form["PolicyDocument"])
+        user = self._user(config, name)
+        if user is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        user["actions"] = policy_to_actions(doc)
+        user["policy_name"] = form.get("PolicyName", "")
+        user["policy_document"] = form["PolicyDocument"]
+        return "", True
+
+    async def do_GetUserPolicy(self, form, config):
+        name = form["UserName"]
+        user = self._user(config, name)
+        if user is None or not user.get("policy_document"):
+            return _error("NoSuchEntity", f"no policy for {name}",
+                          404), False
+        return (f"<GetUserPolicyResult>"
+                f"<UserName>{escape(name)}</UserName>"
+                f"<PolicyName>{escape(user.get('policy_name', ''))}"
+                f"</PolicyName>"
+                f"<PolicyDocument>"
+                f"{escape(user['policy_document'])}"
+                f"</PolicyDocument></GetUserPolicyResult>"), False
+
+    async def do_DeleteUserPolicy(self, form, config):
+        name = form["UserName"]
+        user = self._user(config, name)
+        if user is None:
+            return _error("NoSuchEntity", f"no user {name}", 404), False
+        user["actions"] = []
+        user.pop("policy_document", None)
+        user.pop("policy_name", None)
+        return "", True

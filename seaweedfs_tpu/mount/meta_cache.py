@@ -19,7 +19,9 @@ class MetaCache:
     def __init__(self, ttl: float = 60.0):
         self.ttl = ttl
         self._entries: dict[str, tuple[Entry | None, float]] = {}
-        self._listed_dirs: dict[str, float] = {}
+        # dir path -> (child names, ts): serves repeat readdirs without
+        # a filer round-trip until invalidated or TTL-expired
+        self._listed_dirs: dict[str, tuple[list[str], float]] = {}
         self._lock = threading.Lock()
 
     # -- reads ----------------------------------------------------------
@@ -35,19 +37,25 @@ class MetaCache:
                 return False, None
             return True, entry
 
-    def dir_listed(self, path: str) -> bool:
+    def dir_listing(self, path: str) -> list[str] | None:
         with self._lock:
-            ts = self._listed_dirs.get(path)
-            return ts is not None and time.monotonic() - ts <= self.ttl
+            rec = self._listed_dirs.get(path)
+            if rec is None:
+                return None
+            names, ts = rec
+            if time.monotonic() - ts > self.ttl:
+                del self._listed_dirs[path]
+                return None
+            return list(names)
 
     # -- writes ---------------------------------------------------------
     def put(self, path: str, entry: Entry | None) -> None:
         with self._lock:
             self._entries[path] = (entry, time.monotonic())
 
-    def mark_dir_listed(self, path: str) -> None:
+    def mark_dir_listed(self, path: str, names: list[str]) -> None:
         with self._lock:
-            self._listed_dirs[path] = time.monotonic()
+            self._listed_dirs[path] = (list(names), time.monotonic())
 
     def invalidate(self, path: str) -> None:
         with self._lock:

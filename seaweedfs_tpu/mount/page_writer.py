@@ -159,10 +159,19 @@ class DirtyPages:
             self._slots.clear()
             uploads, self._uploads = self._uploads, []
         chunks = []
-        for fut, file_off, size, mtime_ns, _ in uploads:
-            fid = fut.result()
-            chunks.append(FileChunk(fid=fid, offset=file_off, size=size,
-                                    mtime_ns=mtime_ns))
+        try:
+            for fut, file_off, size, mtime_ns, _ in uploads:
+                fid = fut.result()
+                chunks.append(FileChunk(fid=fid, offset=file_off,
+                                        size=size, mtime_ns=mtime_ns))
+        except Exception:
+            # an upload failed: restore everything (completed futures
+            # keep their results) so a retried flush can still commit —
+            # dropping the payloads here would lose the written bytes
+            # while the retry reports success
+            with self._lock:
+                self._uploads = uploads + self._uploads
+            raise
         return chunks
 
     def has_dirty(self) -> bool:

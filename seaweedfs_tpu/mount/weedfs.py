@@ -150,12 +150,15 @@ class WeedFS:
         if path not in ("/", "") and (entry is None or
                                       not entry.is_directory):
             raise FuseError(20 if entry is not None else 2)  # ENOTDIR
-        names = [".", ".."]
+        cached = self.meta.dir_listing(full)
+        if cached is not None:
+            return [".", ".."] + cached
+        children = []
         for e in self.client.list_dir(full):
             self.meta.put(e.full_path, e)
-            names.append(e.name)
-        self.meta.mark_dir_listed(full)
-        return names
+            children.append(e.name)
+        self.meta.mark_dir_listed(full, children)
+        return [".", ".."] + children
 
     def mkdir(self, path: str, mode: int = 0o755) -> None:
         full = self._abs(path)
@@ -243,6 +246,7 @@ class WeedFS:
         full = self._abs(path)
         entry = Entry(full_path=full, mode=mode & 0o7777, chunks=[])
         self.client.save_entry(entry)
+        self.meta.invalidate(full)  # parent's cached listing is stale
         self.meta.put(full, entry)
         return self._open_handle(path, entry)
 
@@ -349,8 +353,14 @@ class WeedFS:
                 self._handles.pop(fh, None)
 
     def truncate(self, path: str, length: int, fh: int | None = None) -> None:
-        if fh is not None:
-            self.flush(fh)
+        # flush EVERY handle on this path (the path-based syscall has
+        # no fh): dirty spans surviving a truncate would resurrect the
+        # truncated bytes at the next flush
+        with self._lock:
+            open_fhs = [h.fh for h in self._handles.values()
+                        if h.path == path]
+        for open_fh in open_fhs:
+            self.flush(open_fh)
         entry = self._entry(path)
         if entry is None:
             raise FuseError(2)

@@ -99,12 +99,50 @@ def _iso(ts: float) -> str:
 
 class S3ApiServer:
     def __init__(self, filer_url: str, iam_config: dict | None = None,
-                 region: str = "us-east-1"):
+                 region: str = "us-east-1",
+                 identity_refresh_seconds: float = 5.0):
         self.filer_url = filer_url.rstrip("/")
         self.region = region
         self.iam = IdentityAccessManagement(iam_config)
+        self.identity_refresh_seconds = identity_refresh_seconds
         self._load_identities_from_filer()
         self.app = self._build_app()
+        # hot reload of filer-stored identities (the reference reloads
+        # via metadata subscription, auth_credentials_subscribe.go; the
+        # IAM gateway mutates the same config)
+        self._reload_task = None
+
+        async def _start(app):
+            import asyncio
+
+            async def loop():
+                while True:
+                    await asyncio.sleep(self.identity_refresh_seconds)
+                    try:
+                        await asyncio.to_thread(
+                            self._load_identities_from_filer)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        # malformed KV content must not kill the
+                        # reload loop — revocations have to keep
+                        # propagating once the config is fixed
+                        pass
+
+            self._reload_task = asyncio.create_task(loop())
+
+        async def _stop(app):
+            import asyncio
+
+            if self._reload_task is not None:
+                self._reload_task.cancel()
+                try:
+                    await self._reload_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        self.app.on_startup.append(_start)
+        self.app.on_cleanup.append(_stop)
 
     def _build_app(self) -> web.Application:
         @web.middleware

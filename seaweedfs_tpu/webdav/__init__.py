@@ -1,0 +1,3 @@
+from .server import WebDavServer
+
+__all__ = ["WebDavServer"]

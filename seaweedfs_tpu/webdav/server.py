@@ -1,0 +1,338 @@
+"""WebDAV gateway over the filer.
+
+Equivalent of /root/reference/weed/server/webdav_server.go (golang.org/
+x/net/webdav over the filer): RFC4918 subset — OPTIONS, PROPFIND
+(Depth 0/1), PROPPATCH (no-op accept), MKCOL, GET/HEAD, PUT, DELETE,
+MOVE, COPY, and class-2 LOCK/UNLOCK with in-memory advisory tokens
+(Windows/macOS clients refuse to write without them). Data and
+namespace both ride the filer HTTP API.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from xml.sax.saxutils import escape
+
+import aiohttp
+from aiohttp import web
+
+DAV_NS = "DAV:"
+
+
+def _prop_xml(href: str, is_dir: bool, size: int, mtime: float,
+              name: str) -> str:
+    rtype = "<D:resourcetype><D:collection/></D:resourcetype>" if is_dir \
+        else "<D:resourcetype/>"
+    modified = time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                             time.gmtime(mtime))
+    return (
+        f"<D:response><D:href>{escape(href)}</D:href>"
+        "<D:propstat><D:prop>"
+        f"{rtype}"
+        f"<D:displayname>{escape(name)}</D:displayname>"
+        f"<D:getcontentlength>{size}</D:getcontentlength>"
+        f"<D:getlastmodified>{modified}</D:getlastmodified>"
+        "<D:supportedlock><D:lockentry><D:lockscope><D:exclusive/>"
+        "</D:lockscope><D:locktype><D:write/></D:locktype>"
+        "</D:lockentry></D:supportedlock>"
+        "</D:prop><D:status>HTTP/1.1 200 OK</D:status></D:propstat>"
+        "</D:response>")
+
+
+class WebDavServer:
+    def __init__(self, filer_url: str, root: str = "/",
+                 collection: str = "", replication: str = ""):
+        self.filer_url = filer_url.rstrip("/") \
+            if filer_url.startswith("http") else f"http://{filer_url}"
+        self.root = root.rstrip("/")
+        self.collection = collection
+        self.replication = replication
+        self._locks: dict[str, tuple[str, float]] = {}  # path -> (token, expiry)
+        self.app = self._build_app()
+
+    LOCK_TTL = 3600.0
+
+    def _lock_conflict(self, req: web.Request, path: str) -> bool:
+        """True when `path` is exclusively locked by a token the
+        request does not present (in If or Lock-Token headers)."""
+        rec = self._locks.get(path)
+        if rec is None:
+            return False
+        token, expiry = rec
+        if time.monotonic() > expiry:
+            del self._locks[path]
+            return False
+        presented = (req.headers.get("If", "") +
+                     req.headers.get("Lock-Token", ""))
+        return token not in presented
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 40)
+        app.add_routes([
+            web.route("*", "/{path:.*}", self.dispatch),
+        ])
+        return app
+
+    def _abs(self, path: str) -> str:
+        return (self.root + "/" + path.strip("/")).rstrip("/") or "/"
+
+    async def dispatch(self, req: web.Request) -> web.StreamResponse:
+        method = req.method.upper()
+        handler = {
+            "OPTIONS": self.do_options, "PROPFIND": self.do_propfind,
+            "PROPPATCH": self.do_proppatch, "MKCOL": self.do_mkcol,
+            "GET": self.do_get, "HEAD": self.do_get,
+            "PUT": self.do_put, "DELETE": self.do_delete,
+            "MOVE": self.do_move, "COPY": self.do_copy,
+            "LOCK": self.do_lock, "UNLOCK": self.do_unlock,
+        }.get(method)
+        if handler is None:
+            return web.Response(status=405)
+        return await handler(req)
+
+    # -- plumbing to the filer -----------------------------------------
+    async def _entry(self, sess: aiohttp.ClientSession,
+                     full: str) -> dict | None:
+        async with sess.get(f"{self.filer_url}{full}",
+                            params={"meta": "1"}) as r:
+            if r.status == 404:
+                return None
+            return await r.json()
+
+    async def _listing(self, sess: aiohttp.ClientSession,
+                       full: str) -> list[dict]:
+        out, last = [], ""
+        while True:
+            async with sess.get(f"{self.filer_url}{full or '/'}",
+                                params={"limit": "1024",
+                                        "lastFileName": last}) as r:
+                if r.status != 200:
+                    return out
+                d = await r.json()
+            batch = d.get("entries", [])
+            out.extend(batch)
+            if not d.get("shouldDisplayLoadMore") or not batch:
+                return out
+            last = d.get("lastFileName", "")
+
+    # -- methods --------------------------------------------------------
+    async def do_options(self, req: web.Request) -> web.Response:
+        return web.Response(status=200, headers={
+            "DAV": "1, 2",
+            "Allow": "OPTIONS, PROPFIND, PROPPATCH, MKCOL, GET, HEAD, "
+                     "PUT, DELETE, MOVE, COPY, LOCK, UNLOCK",
+            "MS-Author-Via": "DAV",
+        })
+
+    async def do_propfind(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        full = self._abs(path)
+        depth = req.headers.get("Depth", "1")
+        async with aiohttp.ClientSession() as sess:
+            entry = await self._entry(sess, full)
+            if entry is None and full != "/":
+                return web.Response(status=404)
+            parts = []
+            is_dir = full == "/" or bool(
+                entry and entry.get("mode", 0) & 0o40000)
+            size = sum(c["size"] for c in (entry or {}).get("chunks", [])) \
+                if entry else 0
+            href = path if path.startswith("/") else "/" + path
+            parts.append(_prop_xml(
+                href + ("/" if is_dir and not href.endswith("/") else ""),
+                is_dir, 0 if is_dir else size,
+                (entry or {}).get("mtime", 0),
+                href.rstrip("/").rsplit("/", 1)[-1] or "/"))
+            if is_dir and depth != "0":
+                for e in await self._listing(sess, full):
+                    child_dir = bool(e.get("mode", 0) & 0o40000)
+                    name = e["full_path"].rsplit("/", 1)[-1]
+                    child_href = (href.rstrip("/") + "/" + name +
+                                  ("/" if child_dir else ""))
+                    child_size = sum(c["size"]
+                                     for c in e.get("chunks", []))
+                    parts.append(_prop_xml(child_href, child_dir,
+                                           child_size,
+                                           e.get("mtime", 0), name))
+        body = ('<?xml version="1.0" encoding="utf-8"?>'
+                '<D:multistatus xmlns:D="DAV:">' + "".join(parts) +
+                "</D:multistatus>")
+        return web.Response(status=207, text=body,
+                            content_type="application/xml")
+
+    async def do_proppatch(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        body = ('<?xml version="1.0" encoding="utf-8"?>'
+                '<D:multistatus xmlns:D="DAV:">'
+                f"<D:response><D:href>{escape(path)}</D:href>"
+                "<D:propstat><D:status>HTTP/1.1 200 OK</D:status>"
+                "</D:propstat></D:response></D:multistatus>")
+        return web.Response(status=207, text=body,
+                            content_type="application/xml")
+
+    async def do_mkcol(self, req: web.Request) -> web.Response:
+        full = self._abs("/" + req.match_info["path"])
+        async with aiohttp.ClientSession() as sess:
+            if await self._entry(sess, full) is not None:
+                return web.Response(status=405)  # exists
+            async with sess.put(f"{self.filer_url}{full}",
+                                params={"mkdir": "1"}) as r:
+                return web.Response(status=201 if r.status < 300
+                                    else r.status)
+
+    async def do_get(self, req: web.Request) -> web.StreamResponse:
+        full = self._abs("/" + req.match_info["path"])
+        headers = {}
+        if "Range" in req.headers:
+            headers["Range"] = req.headers["Range"]
+        async with aiohttp.ClientSession() as sess:
+            entry = await self._entry(sess, full)
+            if entry is None:
+                return web.Response(status=404)
+            if entry.get("mode", 0) & 0o40000:
+                return web.Response(status=405)  # collection GET
+            async with sess.get(f"{self.filer_url}{full}",
+                                headers=headers) as r:
+                body = await r.read() if req.method == "GET" else b""
+                resp_headers = {k: v for k, v in r.headers.items()
+                                if k in ("ETag", "Content-Range",
+                                         "Last-Modified",
+                                         "Accept-Ranges")}
+                if req.method == "HEAD":
+                    resp_headers["Content-Length"] = \
+                        r.headers.get("Content-Length", "0")
+                return web.Response(status=r.status, body=body,
+                                    headers=resp_headers)
+
+    async def do_put(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        if self._lock_conflict(req, path):
+            return web.Response(status=423)
+        full = self._abs(path)
+        data = await req.read()
+        params = {}
+        if self.collection:
+            params["collection"] = self.collection
+        if self.replication:
+            params["replication"] = self.replication
+        async with aiohttp.ClientSession() as sess:
+            async with sess.put(f"{self.filer_url}{full}", data=data,
+                                params=params,
+                                headers={"Content-Type":
+                                         req.content_type or
+                                         "application/octet-stream"}) as r:
+                return web.Response(status=201 if r.status < 300
+                                    else r.status)
+
+    async def do_delete(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        if self._lock_conflict(req, path):
+            return web.Response(status=423)
+        full = self._abs(path)
+        async with aiohttp.ClientSession() as sess:
+            if await self._entry(sess, full) is None:
+                return web.Response(status=404)
+            async with sess.delete(f"{self.filer_url}{full}",
+                                   params={"recursive": "true"}) as r:
+                return web.Response(status=204 if r.status < 300
+                                    else r.status)
+
+    def _dest_path(self, req: web.Request) -> str | None:
+        dest = req.headers.get("Destination", "")
+        if not dest:
+            return None
+        # strip scheme://host
+        if "://" in dest:
+            dest = dest.split("://", 1)[1]
+            dest = dest[dest.find("/"):]
+        from urllib.parse import unquote
+
+        return unquote(dest)
+
+    async def do_move(self, req: web.Request) -> web.Response:
+        src_rel = "/" + req.match_info["path"]
+        src = self._abs(src_rel)
+        dest_rel = self._dest_path(req)
+        if dest_rel is None:
+            return web.Response(status=400)
+        if self._lock_conflict(req, src_rel) or \
+                self._lock_conflict(req, dest_rel):
+            return web.Response(status=423)
+        dest = self._abs(dest_rel)
+        overwrite = req.headers.get("Overwrite", "T") != "F"
+        async with aiohttp.ClientSession() as sess:
+            if await self._entry(sess, src) is None:
+                return web.Response(status=404)
+            existed = await self._entry(sess, dest) is not None
+            if existed and not overwrite:
+                return web.Response(status=412)
+            if existed:
+                async with sess.delete(f"{self.filer_url}{dest}",
+                                       params={"recursive": "true"}):
+                    pass
+            async with sess.put(f"{self.filer_url}{dest}",
+                                params={"mv.from": src}) as r:
+                if r.status >= 300:
+                    return web.Response(status=r.status)
+        return web.Response(status=204 if existed else 201)
+
+    async def do_copy(self, req: web.Request) -> web.Response:
+        src = self._abs("/" + req.match_info["path"])
+        dest_rel = self._dest_path(req)
+        if dest_rel is None:
+            return web.Response(status=400)
+        if self._lock_conflict(req, dest_rel):
+            return web.Response(status=423)
+        dest = self._abs(dest_rel)
+        overwrite = req.headers.get("Overwrite", "T") != "F"
+        async with aiohttp.ClientSession() as sess:
+            entry = await self._entry(sess, src)
+            if entry is None:
+                return web.Response(status=404)
+            existed = await self._entry(sess, dest) is not None
+            if existed and not overwrite:
+                return web.Response(status=412)
+            await self._copy_tree(sess, src, dest,
+                                  bool(entry.get("mode", 0) & 0o40000))
+        return web.Response(status=204 if existed else 201)
+
+    async def _copy_tree(self, sess: aiohttp.ClientSession, src: str,
+                         dest: str, is_dir: bool) -> None:
+        if is_dir:
+            async with sess.put(f"{self.filer_url}{dest}",
+                                params={"mkdir": "1"}):
+                pass
+            for e in await self._listing(sess, src):
+                name = e["full_path"].rsplit("/", 1)[-1]
+                await self._copy_tree(sess, f"{src}/{name}",
+                                      f"{dest}/{name}",
+                                      bool(e.get("mode", 0) & 0o40000))
+            return
+        async with sess.get(f"{self.filer_url}{src}") as r:
+            data = await r.read()
+        async with sess.put(f"{self.filer_url}{dest}", data=data):
+            pass
+
+    # -- class-2 advisory locks ----------------------------------------
+    async def do_lock(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        if self._lock_conflict(req, path):
+            return web.Response(status=423)  # someone else holds it
+        token = f"opaquelocktoken:{uuid.uuid4()}"
+        self._locks[path] = (token, time.monotonic() + self.LOCK_TTL)
+        body = ('<?xml version="1.0" encoding="utf-8"?>'
+                '<D:prop xmlns:D="DAV:"><D:lockdiscovery><D:activelock>'
+                "<D:locktype><D:write/></D:locktype>"
+                "<D:lockscope><D:exclusive/></D:lockscope>"
+                "<D:depth>infinity</D:depth>"
+                f"<D:locktoken><D:href>{token}</D:href></D:locktoken>"
+                "<D:timeout>Second-3600</D:timeout>"
+                "</D:activelock></D:lockdiscovery></D:prop>")
+        return web.Response(status=200, text=body,
+                            content_type="application/xml",
+                            headers={"Lock-Token": f"<{token}>"})
+
+    async def do_unlock(self, req: web.Request) -> web.Response:
+        path = "/" + req.match_info["path"]
+        self._locks.pop(path, None)
+        return web.Response(status=204)

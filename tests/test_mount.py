@@ -288,6 +288,25 @@ class TestWeedFS:
         fs.release(fh)
         assert fs.getattr("/growing")["st_size"] == 700
 
+    def test_readdir_cache_fresh_after_create(self, mount_fs):
+        fs = mount_fs
+        fs.mkdir("/cachedir")
+        assert fs.readdir("/cachedir") == [".", ".."]  # caches listing
+        fh = fs.create("/cachedir/newfile")
+        fs.release(fh)
+        assert "newfile" in fs.readdir("/cachedir")
+        fs.unlink("/cachedir/newfile")
+        assert "newfile" not in fs.readdir("/cachedir")
+        fs.rmdir("/cachedir")
+
+    def test_truncate_discards_dirty_pages(self, mount_fs):
+        fs = mount_fs
+        fh = fs.create("/trunc-dirty")
+        fs.write(fh, 0, b"x" * 100)
+        fs.truncate("/trunc-dirty", 10)  # path-based, no fh
+        fs.release(fh)
+        assert fs.getattr("/trunc-dirty")["st_size"] == 10
+
     def test_fio_style_verified_randwrite(self, mount_fs):
         """Random-offset writes then full verify — the library-level
         equivalent of the reference's fio randwrite + crc32c gate."""
