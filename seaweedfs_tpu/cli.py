@@ -72,6 +72,27 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-config", default="",
                    help="json file with s3 identities")
 
+    p = sub.add_parser("filer.replicate",
+                       help="mirror filer changes into a sink")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-path", default="/", help="source path prefix")
+    p.add_argument("-sink", required=True,
+                   help="local:<dir> | filer:<url>[,<destPath>] | "
+                        "s3:<endpoint>,<bucket>[,<prefix>]")
+
+    p = sub.add_parser("filer.sync",
+                       help="active-active sync between two filers")
+    p.add_argument("-a", required=True, help="filer A url")
+    p.add_argument("-b", required=True, help="filer B url")
+    p.add_argument("-path", default="/")
+    p.add_argument("-oneWay", dest="one_way", action="store_true")
+
+    p = sub.add_parser("filer.meta.backup",
+                       help="continuous metadata backup to sqlite")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-path", default="/")
+    p.add_argument("-o", dest="output", default="filer_meta_backup.db")
+
     p = sub.add_parser("webdav", help="start a WebDAV gateway")
     p.add_argument("-port", type=int, default=7333)
     p.add_argument("-ip", default="127.0.0.1")
@@ -136,6 +157,39 @@ def _dispatch(args) -> int:
         return _run_filer(args)
     if args.cmd == "s3":
         return _run_s3(args)
+    if args.cmd == "filer.replicate":
+        return _run_replicate(args)
+    if args.cmd == "filer.sync":
+        import time as _t
+
+        from .replication.filer_sync import FilerSync
+
+        sync = FilerSync(args.a, args.b, path_prefix=args.path,
+                         both_ways=not args.one_way)
+        sync.start()
+        print(f"syncing {args.a} <-> {args.b} under {args.path}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            sync.stop()
+        return 0
+    if args.cmd == "filer.meta.backup":
+        import time as _t
+
+        from .replication.meta_backup import FilerMetaBackup
+
+        b = FilerMetaBackup(args.filer, args.output,
+                            path_prefix=args.path)
+        b.start()
+        print(f"backing up {args.filer}{args.path} metadata "
+              f"to {args.output}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            b.stop()
+        return 0
     if args.cmd == "webdav":
         from .rpc.http import ServerThread, run_apps_forever
         from .webdav.server import WebDavServer
@@ -237,6 +291,35 @@ def _run_volume(args) -> int:
     store.public_url = t.address
     print(f"volume server listening on {t.url}, dirs={dirs}")
     run_apps_forever([t])
+    return 0
+
+
+def _run_replicate(args) -> int:
+    import time as _t
+
+    from .replication import Replicator, make_sink
+
+    kind, _, rest = args.sink.partition(":")
+    parts = rest.split(",")
+    if kind == "local":
+        sink = make_sink("local", directory=parts[0])
+    elif kind == "filer":
+        sink = make_sink("filer", filer_url=parts[0],
+                         dest_path=parts[1] if len(parts) > 1 else "/")
+    elif kind == "s3":
+        sink = make_sink("s3", endpoint=parts[0], bucket=parts[1],
+                         prefix=parts[2] if len(parts) > 2 else "")
+    else:
+        print(f"unknown sink kind {kind!r}")
+        return 1
+    r = Replicator(args.filer, sink, path_prefix=args.path)
+    r.start()
+    print(f"replicating {args.filer}{args.path} -> {args.sink}")
+    try:
+        while True:
+            _t.sleep(3600)
+    except KeyboardInterrupt:
+        r.stop()
     return 0
 
 

@@ -35,6 +35,8 @@ class FilerClient:
         self.master_url = master_url
         self.masters = MasterClient(master_url)
         self._sub_thread: threading.Thread | None = None
+        self._sub_loop_obj = None
+        self._sub_task = None
         self._stop = threading.Event()
 
     # -- entries --------------------------------------------------------
@@ -108,12 +110,21 @@ class FilerClient:
         on_event(event_dict). Used to invalidate the meta cache when
         other clients change the namespace."""
         self._stop.clear()
+        self._sub_loop_obj = None
+        self._sub_task = None
         self._sub_thread = threading.Thread(
             target=self._sub_loop, args=(prefix, on_event), daemon=True)
         self._sub_thread.start()
 
     def stop_subscription(self) -> None:
         self._stop.set()
+        # wake the ws receive or the thread would linger until the
+        # next heartbeat
+        loop, task = self._sub_loop_obj, self._sub_task
+        if loop is not None and task is not None and loop.is_running():
+            loop.call_soon_threadsafe(task.cancel)
+        if self._sub_thread is not None:
+            self._sub_thread.join(timeout=5)
 
     def _sub_loop(self, prefix: str, on_event) -> None:
         import asyncio
@@ -135,8 +146,19 @@ class FilerClient:
                                 if msg.type != aiohttp.WSMsgType.TEXT:
                                     break
                                 on_event(json.loads(msg.data))
+                except asyncio.CancelledError:
+                    return
                 except Exception:
                     pass
                 await asyncio.sleep(0.5)
 
-        asyncio.run(run())
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._sub_loop_obj = loop
+        self._sub_task = loop.create_task(run())
+        try:
+            loop.run_until_complete(self._sub_task)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            loop.close()

@@ -1,0 +1,5 @@
+from .queues import (LogFileQueue, MemoryQueue, NotificationQueue,
+                     attach_notifier, make_queue)
+
+__all__ = ["NotificationQueue", "MemoryQueue", "LogFileQueue",
+           "make_queue", "attach_notifier"]

@@ -1,0 +1,113 @@
+"""Metadata-event notification publishing.
+
+Equivalent of /root/reference/weed/notification/ (configuration.go +
+kafka/aws_sqs/google_pub_sub/gocdk adapters, consumed by
+weed/command/filer_notify read side): every filer metadata mutation can
+be published to an external queue. The cloud/kafka SDKs are absent in
+this environment, so the queue registry carries the interface plus the
+two backends that work anywhere — in-memory (tests, in-process
+consumers) and append-only JSONL log files (tailable by any external
+consumer) — and names the unavailable ones explicitly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+
+
+class NotificationQueue:
+    name = "base"
+
+    def send(self, key: str, message: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryQueue(NotificationQueue):
+    name = "memory"
+
+    def __init__(self, maxsize: int = 10000, **_):
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def send(self, key: str, message: dict) -> None:
+        try:
+            self.q.put_nowait((key, message))
+        except queue.Full:
+            self.q.get_nowait()  # drop oldest
+            self.q.put_nowait((key, message))
+
+    def drain(self) -> list[tuple[str, dict]]:
+        out = []
+        while True:
+            try:
+                out.append(self.q.get_nowait())
+            except queue.Empty:
+                return out
+
+
+class LogFileQueue(NotificationQueue):
+    """Append-only JSONL file, one line per event — the `log` notifier
+    plus a tail-able integration point for external consumers."""
+
+    name = "log"
+
+    def __init__(self, path: str, **_):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def send(self, key: str, message: dict) -> None:
+        line = json.dumps({"key": key, "message": message}) \
+            .encode() + b"\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def make_queue(kind: str, **kwargs) -> NotificationQueue:
+    queues = {"memory": MemoryQueue, "log": LogFileQueue}
+    if kind not in queues:
+        raise KeyError(
+            f"unknown notification queue {kind!r}; have "
+            f"{sorted(queues)} (kafka/sqs/pubsub need SDKs absent "
+            "in this environment)")
+    return queues[kind](**kwargs)
+
+
+def attach_notifier(filer, q: NotificationQueue,
+                    path_prefix: str = "/") -> threading.Thread:
+    """Subscribe to a Filer's in-process metadata log and publish every
+    event under path_prefix to the queue (filer_notify.go
+    EventNotify's publish side). Returns the daemon pump thread."""
+    sid, sub_q = filer.meta_log.subscribe()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                ev = sub_q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            d = ev["directory"]
+            if not (d + "/").startswith(path_prefix.rstrip("/") + "/"):
+                continue
+            key = ((ev.get("new_entry") or ev.get("old_entry") or
+                    {}).get("full_path", d))
+            try:
+                q.send(key, ev)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.stop_event = stop  # cooperative stop handle
+    t.start()
+    return t

@@ -1,0 +1,5 @@
+from .replicator import Replicator
+from .sink import FilerSink, LocalSink, S3Sink, make_sink
+
+__all__ = ["Replicator", "FilerSink", "LocalSink", "S3Sink",
+           "make_sink"]

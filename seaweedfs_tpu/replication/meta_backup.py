@@ -1,0 +1,110 @@
+"""Continuous filer metadata backup into a local store.
+
+Equivalent of /root/reference/weed/command/filer_meta_backup.go: apply
+the metadata event stream to a FilerStore (sqlite here), checkpointing
+the last-applied event so restarts resume. The result is a queryable
+point-in-time copy of the namespace (not the file bytes — that is the
+data replication sinks' job).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import requests
+
+from ..filer.entry import Entry
+from ..filer.filerstore import make_store
+
+
+class FilerMetaBackup:
+    def __init__(self, source_filer: str, backup_path: str,
+                 path_prefix: str = "/"):
+        self.source = source_filer.rstrip("/") \
+            if source_filer.startswith("http") else \
+            f"http://{source_filer}"
+        self.prefix = path_prefix
+        self.store = make_store("sqlite", path=backup_path)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.applied = 0
+
+    def _offset(self) -> int:
+        v = self.store.kv_get("meta_backup/offset")
+        return int(v) if v else 0
+
+    def _save_offset(self, ts_ns: int) -> None:
+        self.store.kv_put("meta_backup/offset", str(ts_ns).encode())
+
+    def apply(self, ev: dict) -> None:
+        old, new = ev.get("old_entry"), ev.get("new_entry")
+        if new is None and old is not None:
+            self.store.delete_entry(old["full_path"])
+        elif new is not None:
+            if old is not None and old["full_path"] != new["full_path"]:
+                self.store.delete_entry(old["full_path"])
+            self.store.insert_entry(Entry.from_dict(new))
+        self.applied += 1
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._loop = None
+        self._task = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        loop, task = self._loop, self._task
+        if loop is not None and task is not None and loop.is_running():
+            loop.call_soon_threadsafe(task.cancel)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._task = self._loop.create_task(self._pump())
+        try:
+            self._loop.run_until_complete(self._task)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            try:
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens())
+            finally:
+                self._loop.close()
+
+    async def _pump(self) -> None:
+        import aiohttp
+
+        while not self._stop.is_set():
+            url = self.source.replace("http", "ws", 1) + \
+                "/ws/meta_subscribe"
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.ws_connect(
+                            url,
+                            params={"path_prefix": self.prefix,
+                                    "since_ns": str(self._offset())},
+                            heartbeat=30) as ws:
+                        async for msg in ws:
+                            if self._stop.is_set():
+                                return
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                break
+                            ev = json.loads(msg.data)
+                            self.apply(ev)
+                            self._save_offset(ev["ts_ns"])
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    # -- restore/query ---------------------------------------------------
+    def find_entry(self, path: str) -> Entry | None:
+        return self.store.find_entry(path)
+
+    def list_entries(self, dirpath: str) -> list[Entry]:
+        return self.store.list_directory_entries(dirpath)

@@ -1,0 +1,160 @@
+"""Replicator: consume a filer's metadata event stream and mirror the
+namespace into a sink, with resumable offsets.
+
+Equivalent of /root/reference/weed/replication/replicator.go driven the
+way command/filer_replicate.go drives it: subscribe to metadata events
+under a path prefix, translate each event into sink calls, checkpoint
+the last-applied ts_ns so restarts resume rather than recopy
+(remote_storage/track_sync_offset.go's role, stored in the source
+filer's KV).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Callable
+
+import requests
+
+from ..filer.entry import Entry
+from .sink import ReplicationSink
+
+
+class Replicator:
+    def __init__(self, source_filer: str, sink: ReplicationSink,
+                 path_prefix: str = "/", offset_key: str = "",
+                 exclude_signature: int = 0):
+        """exclude_signature: skip events already signed by this id —
+        the active-active loop guard (filer_sync.go)."""
+        self.source = source_filer.rstrip("/") \
+            if source_filer.startswith("http") else \
+            f"http://{source_filer}"
+        self.sink = sink
+        self.prefix = path_prefix.rstrip("/") or "/"
+        self.offset_key = offset_key or \
+            f"replication/{sink.name}/offset"
+        self.exclude_signature = exclude_signature
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.applied = 0
+        self.skipped = 0
+
+    # -- offsets --------------------------------------------------------
+    def _load_offset(self) -> int:
+        try:
+            r = requests.get(f"{self.source}/kv/{self.offset_key}",
+                             timeout=5)
+            if r.status_code == 200:
+                return int(r.content)
+        except (requests.RequestException, ValueError):
+            pass
+        return 0
+
+    def _save_offset(self, ts_ns: int) -> None:
+        try:
+            requests.put(f"{self.source}/kv/{self.offset_key}",
+                         data=str(ts_ns).encode(), timeout=5)
+        except requests.RequestException:
+            pass
+
+    # -- the event pump -------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        self._loop = None
+        self._task = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # the pump blocks inside ws receive; cancel it from its loop or
+        # the join would always ride out the full timeout
+        loop, task = self._loop, self._task
+        if loop is not None and task is not None and loop.is_running():
+            loop.call_soon_threadsafe(task.cancel)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._task = self._loop.create_task(self._pump())
+        try:
+            self._loop.run_until_complete(self._task)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            try:
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens())
+            finally:
+                self._loop.close()
+
+    async def _pump(self) -> None:
+        import aiohttp
+
+        while not self._stop.is_set():
+            since = self._load_offset()
+            url = self.source.replace("http", "ws", 1) + \
+                "/ws/meta_subscribe"
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.ws_connect(
+                            url, params={"path_prefix": self.prefix,
+                                         "since_ns": str(since)},
+                            heartbeat=30) as ws:
+                        async for msg in ws:
+                            if self._stop.is_set():
+                                return
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                break
+                            ev = json.loads(msg.data)
+                            await asyncio.to_thread(self.apply, ev)
+                            self._save_offset(ev["ts_ns"])
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    # -- event -> sink ---------------------------------------------------
+    def _rel(self, full_path: str) -> str:
+        if self.prefix != "/" and full_path.startswith(self.prefix):
+            return full_path[len(self.prefix):] or "/"
+        return full_path
+
+    def _reader(self, full_path: str) -> Callable[[], bytes]:
+        src = self.source
+
+        def read() -> bytes:
+            r = requests.get(f"{src}{full_path}", timeout=300)
+            r.raise_for_status()
+            return r.content
+
+        return read
+
+    def apply(self, ev: dict) -> None:
+        """Route one metadata event to the sink
+        (replicator.go Replicate)."""
+        if self.exclude_signature and \
+                self.exclude_signature in ev.get("signatures", []):
+            self.skipped += 1
+            return
+        old, new = ev.get("old_entry"), ev.get("new_entry")
+        if old is None and new is None:
+            return
+        if new is None:  # delete
+            e = Entry.from_dict(old)
+            self.sink.delete_entry(self._rel(e.full_path),
+                                   e.is_directory)
+        elif old is None:  # create
+            e = Entry.from_dict(new)
+            self.sink.create_entry(self._rel(e.full_path), e,
+                                   self._reader(e.full_path))
+        else:  # update / rename
+            oe, ne = Entry.from_dict(old), Entry.from_dict(new)
+            if oe.full_path != ne.full_path:
+                self.sink.delete_entry(self._rel(oe.full_path),
+                                       oe.is_directory)
+            self.sink.update_entry(self._rel(ne.full_path), ne,
+                                   self._reader(ne.full_path))
+        self.applied += 1

@@ -1,0 +1,167 @@
+"""Replication sinks: where filer metadata events get mirrored.
+
+Equivalent of /root/reference/weed/replication/sink/ (filersink,
+localsink, s3sink — the gcs/azure/b2 sinks are the same interface over
+cloud SDKs not present in this environment, so they register as
+unavailable rather than silently half-working). A sink receives entry
+lifecycle callbacks; file content is provided by a reader callable so
+sinks don't need to know the source's chunk layout.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import requests
+
+from ..filer.entry import Entry
+
+DataReader = Callable[[], bytes]
+
+
+class ReplicationSink:
+    name = "base"
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        self.create_entry(path, entry, read_data)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        raise NotImplementedError
+
+
+class FilerSink(ReplicationSink):
+    """Mirror into another filer over its HTTP API
+    (replication/sink/filersink/)."""
+
+    name = "filer"
+
+    def __init__(self, filer_url: str, dest_path: str = "/",
+                 signature: int = 0):
+        self.filer_url = filer_url.rstrip("/") \
+            if filer_url.startswith("http") else f"http://{filer_url}"
+        self.dest = dest_path.rstrip("/")
+        # signature of the SOURCE filer: carried on writes so the
+        # target's events name the origin (active-active loop guard)
+        self.signature = signature
+
+    def _url(self, path: str) -> str:
+        return f"{self.filer_url}{self.dest}{path}"
+
+    def _params(self) -> dict:
+        return {"signatures": str(self.signature)} if self.signature \
+            else {}
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        if entry.is_directory:
+            requests.put(self._url(path), params={"mkdir": "1"},
+                         timeout=30).raise_for_status()
+            return
+        params = self._params()
+        r = requests.put(self._url(path), data=read_data(),
+                         params=params,
+                         headers={"Content-Type": entry.mime or
+                                  "application/octet-stream"},
+                         timeout=300)
+        r.raise_for_status()
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        params = {"recursive": "true", **self._params()}
+        requests.delete(self._url(path), params=params, timeout=60)
+
+
+class LocalSink(ReplicationSink):
+    """Mirror into a local directory (replication/sink/localsink/)."""
+
+    name = "local"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, path: str) -> str:
+        return os.path.join(self.dir, path.lstrip("/"))
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        target = self._path(path)
+        if entry.is_directory:
+            os.makedirs(target, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(read_data())
+        os.replace(tmp, target)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        target = self._path(path)
+        try:
+            if is_directory:
+                import shutil
+
+                shutil.rmtree(target, ignore_errors=True)
+            else:
+                os.remove(target)
+        except FileNotFoundError:
+            pass
+
+
+class S3Sink(ReplicationSink):
+    """Mirror into an S3-compatible endpoint (replication/sink/s3sink/).
+    Targets this build's own gateway or any endpoint that accepts
+    anonymous/open PUTs; SigV4 credentials optional."""
+
+    name = "s3"
+
+    def __init__(self, endpoint: str, bucket: str, prefix: str = "",
+                 access_key: str = "", secret_key: str = ""):
+        self.endpoint = endpoint.rstrip("/") \
+            if endpoint.startswith("http") else f"http://{endpoint}"
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+
+    def _key(self, path: str) -> str:
+        key = path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _headers(self, method: str, url: str, payload: bytes) -> dict:
+        if not self.access_key:
+            return {}
+        from ..s3.sigv4_client import sign_headers
+
+        return sign_headers(method, url, self.access_key,
+                            self.secret_key, payload)
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        if entry.is_directory:
+            return  # keys are flat
+        url = f"{self.endpoint}/{self.bucket}/{self._key(path)}"
+        data = read_data()
+        r = requests.put(url, data=data,
+                         headers=self._headers("PUT", url, data),
+                         timeout=300)
+        r.raise_for_status()
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        url = f"{self.endpoint}/{self.bucket}/{self._key(path)}"
+        requests.delete(url, headers=self._headers("DELETE", url, b""),
+                        timeout=60)
+
+
+def make_sink(kind: str, **kwargs) -> ReplicationSink:
+    sinks = {"filer": FilerSink, "local": LocalSink, "s3": S3Sink}
+    if kind not in sinks:
+        raise KeyError(f"unknown sink {kind!r}; have {sorted(sinks)} "
+                       "(gcs/azure/b2 need cloud SDKs absent here)")
+    return sinks[kind](**kwargs)

@@ -1,0 +1,99 @@
+"""Shared filer metadata-subscription pump.
+
+One implementation of the reconnecting WebSocket consumer that the
+replicator, the meta backup, and the mount's cache invalidation all
+need (the reference's filer_pb.SubscribeMetadata client loop): a
+daemon thread running its own event loop, resumable via a since-offset
+callback, with clean cross-thread cancellation. Handlers run in a
+worker thread so blocking IO in them can never starve the WebSocket
+heartbeat.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Callable
+
+
+class MetaSubscriber:
+    def __init__(self, source_url: str, path_prefix: str,
+                 handler: Callable[[dict], None],
+                 since_fn: Callable[[], int] | None = None,
+                 reconnect_delay: float = 0.5):
+        """handler(event) is called for every event, in order, from a
+        worker thread; since_fn() (also off-loop) supplies the resume
+        offset at each (re)connect."""
+        self.source = source_url.rstrip("/") \
+            if source_url.startswith("http") else f"http://{source_url}"
+        self.prefix = path_prefix.rstrip("/") or "/"
+        self.handler = handler
+        self.since_fn = since_fn or (lambda: 0)
+        self.reconnect_delay = reconnect_delay
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._loop = None
+        self._task = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        loop, task = self._loop, self._task
+        if loop is not None and task is not None:
+            try:
+                loop.call_soon_threadsafe(task.cancel)
+            except RuntimeError:
+                pass  # loop already closed: thread is exiting anyway
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._task = self._loop.create_task(self._pump())
+        try:
+            self._loop.run_until_complete(self._task)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            try:
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens())
+            except Exception:
+                pass
+            self._loop.close()
+
+    async def _pump(self) -> None:
+        import aiohttp
+
+        ws_url = self.source.replace("http", "ws", 1) + \
+            "/ws/meta_subscribe"
+        while not self._stop.is_set():
+            try:
+                since = await asyncio.to_thread(self.since_fn)
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.ws_connect(
+                            ws_url,
+                            params={"path_prefix": self.prefix,
+                                    "since_ns": str(since)},
+                            heartbeat=30) as ws:
+                        async for msg in ws:
+                            if self._stop.is_set():
+                                return
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                break
+                            ev = json.loads(msg.data)
+                            # handlers may do blocking HTTP: keep them
+                            # off the loop so pings stay serviced
+                            await asyncio.to_thread(self.handler, ev)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            await asyncio.sleep(self.reconnect_delay)

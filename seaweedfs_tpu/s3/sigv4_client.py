@@ -1,0 +1,60 @@
+"""Client-side AWS SigV4 signer for talking TO S3-compatible endpoints
+(the server-side verifier lives in s3/auth.py). Used by the S3
+replication sink and remote-storage client; compatible with the
+gateway's verifier and with AWS.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+from datetime import datetime, timezone
+from urllib.parse import quote, urlsplit
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_headers(method: str, url: str, access_key: str,
+                 secret_key: str, payload: bytes = b"",
+                 region: str = "us-east-1",
+                 service: str = "s3") -> dict:
+    """-> headers dict carrying a SigV4 Authorization for `url`."""
+    parts = urlsplit(url)
+    host = parts.netloc
+    path = quote(parts.path or "/", safe="/~._-")
+    now = datetime.now(timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+
+    # canonical query: sorted key=value with rfc3986 escaping
+    q = []
+    if parts.query:
+        for kv in parts.query.split("&"):
+            k, _, v = kv.partition("=")
+            q.append((quote(k, safe="~._-"), quote(v, safe="~._-")))
+    q.sort()
+    canonical_query = "&".join(f"{k}={v}" for k, v in q)
+
+    headers = {"host": host, "x-amz-date": amz_date,
+               "x-amz-content-sha256": payload_hash}
+    signed = ";".join(sorted(headers))
+    canonical_headers = "".join(f"{k}:{headers[k]}\n"
+                                for k in sorted(headers))
+    creq = "\n".join([method.upper(), path, canonical_query,
+                      canonical_headers, signed, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    key = _hmac(_hmac(_hmac(_hmac(
+        ("AWS4" + secret_key).encode(), datestamp), region), service),
+        "aws4_request")
+    signature = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={signature}"),
+    }

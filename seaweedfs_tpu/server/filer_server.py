@@ -298,8 +298,13 @@ class FilerServer:
     async def handle_put(self, req: web.Request) -> web.Response:
         raw_path = "/" + req.match_info["path"]
         path = norm_path(raw_path)
+        # replication/sync peers tag writes with the signatures of
+        # filers that already saw the event (loop prevention,
+        # command/filer_sync.go)
+        signatures = _parse_signatures(req.query.get("signatures", ""))
         if "mv.from" in req.query:  # rename verb, reference-compatible
-            self.filer.rename(req.query["mv.from"], path)
+            self.filer.rename(req.query["mv.from"], path,
+                              signatures=signatures)
             return web.json_response({"path": path})
         if "meta" in req.query:
             # raw entry create: body is an Entry dict whose chunks point
@@ -309,7 +314,7 @@ class FilerServer:
             d["full_path"] = path
             entry = Entry.from_dict(d)
             old = self.filer.find_entry(path)
-            self.filer.create_entry(entry)
+            self.filer.create_entry(entry, signatures=signatures)
             if old is not None and not old.is_directory:
                 keep = {c.fid for c in entry.chunks}
                 await asyncio.to_thread(
@@ -372,7 +377,7 @@ class FilerServer:
                       ttl_sec=_ttl_seconds(ttl),
                       md5=md5_all.hexdigest(), collection=collection,
                       replication=replication, chunks=chunks)
-        self.filer.create_entry(entry)
+        self.filer.create_entry(entry, signatures=signatures)
         if old is not None and not old.is_directory:
             dead = [c for c in old.chunks
                     if c.fid not in {n.fid for n in chunks}]
@@ -394,8 +399,10 @@ class FilerServer:
         recursive = req.query.get("recursive", "") in ("true", "1")
         delete_chunks = req.query.get("skipChunkDeletion", "") \
             not in ("true", "1")
-        self.filer.delete_entry(path, recursive=recursive,
-                                delete_chunks=delete_chunks)
+        self.filer.delete_entry(
+            path, recursive=recursive, delete_chunks=delete_chunks,
+            signatures=_parse_signatures(
+                req.query.get("signatures", "")))
         return web.json_response({}, status=204)
 
     # -- KV -------------------------------------------------------------
@@ -470,6 +477,15 @@ async def _read_exactly(reader, n: int) -> bytes:
             break
         buf.extend(piece)
     return bytes(buf)
+
+
+def _parse_signatures(raw: str) -> list[int] | None:
+    if not raw:
+        return None
+    try:
+        return [int(s) for s in raw.split(",") if s]
+    except ValueError:
+        return None
 
 
 def _ttl_seconds(ttl: str) -> int:

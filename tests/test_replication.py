@@ -1,0 +1,204 @@
+"""Async-tier tests: replication sinks, active-active filer sync with
+loop prevention, continuous meta backup, notification queues.
+
+Mirrors /root/reference/weed/replication/ (sink fan-out from the
+metadata event stream), command/filer_sync.go (signature-based
+loop prevention), command/filer_meta_backup.go, and notification/.
+"""
+import json
+import os
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.notification import MemoryQueue, attach_notifier
+from seaweedfs_tpu.replication import LocalSink, Replicator
+from seaweedfs_tpu.replication.meta_backup import FilerMetaBackup
+from seaweedfs_tpu.rpc.http import ServerThread
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def repl_cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("repl")
+    cluster = Cluster(str(base), n_volume_servers=1, with_filer=True,
+                      with_s3=True)
+    cluster.wait_for_nodes(1)
+    yield {"cluster": cluster, "base": str(base)}
+    cluster.stop()
+
+
+def wait_until(pred, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestLocalSinkReplication:
+    def test_create_update_delete_mirrored(self, repl_cluster, tmp_path):
+        c = repl_cluster["cluster"]
+        mirror = str(tmp_path / "mirror")
+        r = Replicator(c.filer_url, LocalSink(mirror),
+                       path_prefix="/repl")
+        r.start()
+        try:
+            requests.put(f"{c.filer_url}/repl/a.txt", data=b"v1",
+                         timeout=10)
+            assert wait_until(lambda: os.path.exists(
+                f"{mirror}/a.txt"))
+            assert open(f"{mirror}/a.txt", "rb").read() == b"v1"
+
+            requests.put(f"{c.filer_url}/repl/a.txt", data=b"v2-longer",
+                         timeout=10)
+            assert wait_until(lambda: os.path.exists(f"{mirror}/a.txt")
+                              and open(f"{mirror}/a.txt", "rb").read()
+                              == b"v2-longer")
+
+            requests.delete(f"{c.filer_url}/repl/a.txt", timeout=10)
+            assert wait_until(
+                lambda: not os.path.exists(f"{mirror}/a.txt"))
+        finally:
+            r.stop()
+
+    def test_offset_resume_skips_replayed(self, repl_cluster, tmp_path):
+        c = repl_cluster["cluster"]
+        mirror = str(tmp_path / "mirror2")
+        r = Replicator(c.filer_url, LocalSink(mirror),
+                       path_prefix="/resume",
+                       offset_key="test/resume/offset")
+        r.start()
+        requests.put(f"{c.filer_url}/resume/one.txt", data=b"1",
+                     timeout=10)
+        assert wait_until(lambda: os.path.exists(f"{mirror}/one.txt"))
+        r.stop()
+        # write while the replicator is down...
+        requests.put(f"{c.filer_url}/resume/two.txt", data=b"2",
+                     timeout=10)
+        # ...then resume from the saved offset: only the new event runs
+        os.remove(f"{mirror}/one.txt")
+        r2 = Replicator(c.filer_url, LocalSink(mirror),
+                        path_prefix="/resume",
+                        offset_key="test/resume/offset")
+        r2.start()
+        assert wait_until(lambda: os.path.exists(f"{mirror}/two.txt"))
+        time.sleep(0.5)  # give any (wrong) replay a chance
+        assert not os.path.exists(f"{mirror}/one.txt")
+        r2.stop()
+
+
+class TestS3SinkReplication:
+    def test_mirror_into_s3_bucket(self, repl_cluster):
+        from seaweedfs_tpu.replication import S3Sink
+
+        c = repl_cluster["cluster"]
+        requests.put(f"{c.s3_url}/mirror-bucket", timeout=10)
+        r = Replicator(c.filer_url,
+                       S3Sink(c.s3_url, "mirror-bucket"),
+                       path_prefix="/tos3")
+        r.start()
+        try:
+            requests.put(f"{c.filer_url}/tos3/obj.bin", data=b"s3data",
+                         timeout=10)
+            assert wait_until(lambda: requests.get(
+                f"{c.s3_url}/mirror-bucket/obj.bin",
+                timeout=5).status_code == 200)
+            assert requests.get(f"{c.s3_url}/mirror-bucket/obj.bin",
+                                timeout=5).content == b"s3data"
+        finally:
+            r.stop()
+
+
+class TestFilerSync:
+    def test_active_active_no_loop(self, repl_cluster, tmp_path_factory):
+        from seaweedfs_tpu.replication.filer_sync import FilerSync
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        c = repl_cluster["cluster"]
+        # second filer on the same cluster
+        f2 = FilerServer(c.master_url, announce_pulse=0.5)
+        f2_t = ServerThread(f2.app).start()
+        f2.address = f2_t.address
+        sync = FilerSync(c.filer_url, f2_t.url, path_prefix="/aa")
+        sync.start()
+        try:
+            # A -> B
+            requests.put(f"{c.filer_url}/aa/from-a.txt", data=b"A!",
+                         timeout=10)
+            assert wait_until(lambda: requests.get(
+                f"{f2_t.url}/aa/from-a.txt", timeout=5).status_code
+                == 200)
+            # B -> A
+            requests.put(f"{f2_t.url}/aa/from-b.txt", data=b"B!",
+                         timeout=10)
+            assert wait_until(lambda: requests.get(
+                f"{c.filer_url}/aa/from-b.txt", timeout=5).status_code
+                == 200)
+            # loop prevention: wait for quiescence; applied counters
+            # must settle (each entry crossed the wire exactly once)
+            time.sleep(2.0)
+            a2b, b2a = sync.a_to_b.applied, sync.b_to_a.applied
+            time.sleep(1.5)
+            assert (sync.a_to_b.applied, sync.b_to_a.applied) == \
+                (a2b, b2a), "sync is still ping-ponging events"
+            assert requests.get(f"{c.filer_url}/aa/from-a.txt",
+                                timeout=5).content == b"A!"
+            assert requests.get(f"{f2_t.url}/aa/from-b.txt",
+                                timeout=5).content == b"B!"
+        finally:
+            sync.stop()
+            f2_t.stop()
+
+
+class TestMetaBackup:
+    def test_backup_applies_and_resumes(self, repl_cluster, tmp_path):
+        c = repl_cluster["cluster"]
+        backup_db = str(tmp_path / "meta.db")
+        b = FilerMetaBackup(c.filer_url, backup_db,
+                            path_prefix="/backedup")
+        b.start()
+        try:
+            requests.put(f"{c.filer_url}/backedup/doc.txt",
+                         data=b"hello", timeout=10)
+            assert wait_until(
+                lambda: b.find_entry("/backedup/doc.txt") is not None)
+            e = b.find_entry("/backedup/doc.txt")
+            assert e.chunks and e.file_size == 5
+            requests.delete(f"{c.filer_url}/backedup/doc.txt",
+                            timeout=10)
+            assert wait_until(
+                lambda: b.find_entry("/backedup/doc.txt") is None)
+        finally:
+            b.stop()
+
+
+class TestNotifications:
+    def test_memory_queue_receives_events(self, repl_cluster):
+        c = repl_cluster["cluster"]
+        q = MemoryQueue()
+        pump = attach_notifier(c.filer.filer, q, path_prefix="/notif")
+        try:
+            requests.put(f"{c.filer_url}/notif/x.txt", data=b"n",
+                         timeout=10)
+            assert wait_until(lambda: not q.q.empty())
+            drained = q.drain()
+            keys = [k for k, _ in drained]
+            assert any(k == "/notif/x.txt" for k in keys)
+            ev = dict(drained)["/notif/x.txt"]
+            assert ev["new_entry"]["full_path"] == "/notif/x.txt"
+        finally:
+            pump.stop_event.set()
+
+    def test_log_queue_appends_jsonl(self, tmp_path):
+        from seaweedfs_tpu.notification import LogFileQueue
+
+        path = str(tmp_path / "events.jsonl")
+        q = LogFileQueue(path)
+        q.send("/k1", {"a": 1})
+        q.send("/k2", {"b": 2})
+        q.close()
+        lines = [json.loads(l) for l in open(path)]
+        assert [l["key"] for l in lines] == ["/k1", "/k2"]
