@@ -157,6 +157,17 @@ class EcVolume:
         disk = ndl.disk_size(size)
         return geo.locate(self.derived_dat_size(), offset, disk), size
 
+    def live_needle_ids(self) -> list[tuple[int, int]]:
+        """Live (needle_id, size) pairs from the .ecx minus .ecj
+        tombstones — the EC side of volume.fsck's id census."""
+        out = []
+        for i in range(len(self._keys)):
+            key = int(self._keys[i])
+            size = t.u32_to_size(int(self._ecx["size"][i]))
+            if t.size_is_valid(size) and key not in self.deleted:
+                out.append((key, size))
+        return out
+
     # -- reads ----------------------------------------------------------
     def read_interval_local(self, interval: geo.Interval) -> bytes | None:
         """Bytes for one interval if its shard is local, else None."""

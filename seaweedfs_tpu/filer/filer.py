@@ -101,7 +101,8 @@ class Filer:
                      signatures: list[int] | None = None) -> Entry:
         return self.create_entry(entry, signatures)
 
-    def mkdir(self, path: str, mode: int = 0o775) -> Entry:
+    def mkdir(self, path: str, mode: int = 0o775,
+              signatures: list[int] | None = None) -> Entry:
         path = norm_path(path)
         e = self.find_entry(path)
         if e is not None:
@@ -109,7 +110,8 @@ class Filer:
                 raise NotADirectoryError(path)
             return e
         return self.create_entry(
-            Entry(full_path=path, mode=mode | DIR_MODE_FLAG))
+            Entry(full_path=path, mode=mode | DIR_MODE_FLAG),
+            signatures=signatures)
 
     def _ensure_parents(self, path: str) -> None:
         parts = path.strip("/").split("/")[:-1]

@@ -8,14 +8,9 @@ data replication sinks' job).
 """
 from __future__ import annotations
 
-import asyncio
-import json
-import threading
-
-import requests
-
 from ..filer.entry import Entry
 from ..filer.filerstore import make_store
+from ..rpc.meta_subscriber import MetaSubscriber
 
 
 class FilerMetaBackup:
@@ -26,8 +21,7 @@ class FilerMetaBackup:
             f"http://{source_filer}"
         self.prefix = path_prefix
         self.store = make_store("sqlite", path=backup_path)
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._sub: MetaSubscriber | None = None
         self.applied = 0
 
     def _offset(self) -> int:
@@ -47,60 +41,19 @@ class FilerMetaBackup:
             self.store.insert_entry(Entry.from_dict(new))
         self.applied += 1
 
+    def _handle(self, ev: dict) -> None:
+        self.apply(ev)
+        self._save_offset(ev["ts_ns"])
+
     def start(self) -> None:
-        self._stop.clear()
-        self._loop = None
-        self._task = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._sub = MetaSubscriber(self.source, self.prefix,
+                                   self._handle, since_fn=self._offset)
+        self._sub.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        loop, task = self._loop, self._task
-        if loop is not None and task is not None and loop.is_running():
-            loop.call_soon_threadsafe(task.cancel)
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-
-    def _run(self) -> None:
-        self._loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self._loop)
-        self._task = self._loop.create_task(self._pump())
-        try:
-            self._loop.run_until_complete(self._task)
-        except asyncio.CancelledError:
-            pass
-        finally:
-            try:
-                self._loop.run_until_complete(
-                    self._loop.shutdown_asyncgens())
-            finally:
-                self._loop.close()
-
-    async def _pump(self) -> None:
-        import aiohttp
-
-        while not self._stop.is_set():
-            url = self.source.replace("http", "ws", 1) + \
-                "/ws/meta_subscribe"
-            try:
-                async with aiohttp.ClientSession() as sess:
-                    async with sess.ws_connect(
-                            url,
-                            params={"path_prefix": self.prefix,
-                                    "since_ns": str(self._offset())},
-                            heartbeat=30) as ws:
-                        async for msg in ws:
-                            if self._stop.is_set():
-                                return
-                            if msg.type != aiohttp.WSMsgType.TEXT:
-                                break
-                            ev = json.loads(msg.data)
-                            self.apply(ev)
-                            self._save_offset(ev["ts_ns"])
-            except Exception:
-                pass
-            await asyncio.sleep(0.5)
+        if self._sub is not None:
+            self._sub.stop()
+            self._sub = None
 
     # -- restore/query ---------------------------------------------------
     def find_entry(self, path: str) -> Entry | None:

@@ -10,14 +10,12 @@ filer's KV).
 """
 from __future__ import annotations
 
-import asyncio
-import json
-import threading
 from typing import Callable
 
 import requests
 
 from ..filer.entry import Entry
+from ..rpc.meta_subscriber import MetaSubscriber
 from .sink import ReplicationSink
 
 
@@ -35,10 +33,10 @@ class Replicator:
         self.offset_key = offset_key or \
             f"replication/{sink.name}/offset"
         self.exclude_signature = exclude_signature
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._sub: MetaSubscriber | None = None
         self.applied = 0
         self.skipped = 0
+        self.failed = 0  # poison events skipped after a failed apply
 
     # -- offsets --------------------------------------------------------
     def _load_offset(self) -> int:
@@ -60,61 +58,27 @@ class Replicator:
 
     # -- the event pump -------------------------------------------------
     def start(self) -> None:
-        self._stop.clear()
-        self._loop = None
-        self._task = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._sub = MetaSubscriber(self.source, self.prefix,
+                                   self._handle,
+                                   since_fn=self._load_offset)
+        self._sub.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        # the pump blocks inside ws receive; cancel it from its loop or
-        # the join would always ride out the full timeout
-        loop, task = self._loop, self._task
-        if loop is not None and task is not None and loop.is_running():
-            loop.call_soon_threadsafe(task.cancel)
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        if self._sub is not None:
+            self._sub.stop()
+            self._sub = None
 
-    def _run(self) -> None:
-        self._loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self._loop)
-        self._task = self._loop.create_task(self._pump())
+    def _handle(self, ev: dict) -> None:
+        """One event, called off-loop by the subscriber pump."""
         try:
-            self._loop.run_until_complete(self._task)
-        except asyncio.CancelledError:
-            pass
-        finally:
-            try:
-                self._loop.run_until_complete(
-                    self._loop.shutdown_asyncgens())
-            finally:
-                self._loop.close()
-
-    async def _pump(self) -> None:
-        import aiohttp
-
-        while not self._stop.is_set():
-            since = self._load_offset()
-            url = self.source.replace("http", "ws", 1) + \
-                "/ws/meta_subscribe"
-            try:
-                async with aiohttp.ClientSession() as sess:
-                    async with sess.ws_connect(
-                            url, params={"path_prefix": self.prefix,
-                                         "since_ns": str(since)},
-                            heartbeat=30) as ws:
-                        async for msg in ws:
-                            if self._stop.is_set():
-                                return
-                            if msg.type != aiohttp.WSMsgType.TEXT:
-                                break
-                            ev = json.loads(msg.data)
-                            await asyncio.to_thread(self.apply, ev)
-                            self._save_offset(ev["ts_ns"])
-            except Exception:
-                pass
-            await asyncio.sleep(0.5)
+            self.apply(ev)
+        except Exception:
+            # poison event (e.g. create whose content is already deleted
+            # at the source): count it and move on — replaying it forever
+            # would wedge the stream behind it (a later event supersedes
+            # it anyway)
+            self.failed += 1
+        self._save_offset(ev["ts_ns"])
 
     # -- event -> sink ---------------------------------------------------
     def _rel(self, full_path: str) -> str:

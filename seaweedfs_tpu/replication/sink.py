@@ -59,7 +59,8 @@ class FilerSink(ReplicationSink):
     def create_entry(self, path: str, entry: Entry,
                      read_data: DataReader) -> None:
         if entry.is_directory:
-            requests.put(self._url(path), params={"mkdir": "1"},
+            requests.put(self._url(path),
+                         params={"mkdir": "1", **self._params()},
                          timeout=30).raise_for_status()
             return
         params = self._params()

@@ -323,7 +323,7 @@ class FilerServer:
             return web.json_response(entry.to_dict(), status=201)
         if "mkdir" in req.query or (raw_path.endswith("/")
                                     and req.content_length in (None, 0)):
-            e = self.filer.mkdir(path)
+            e = self.filer.mkdir(path, signatures=signatures)
             return web.json_response(e.to_dict(), status=201)
 
         collection = req.query.get("collection", self.collection)

@@ -79,6 +79,12 @@ class VolumeServer:
             web.post("/admin/mark_readonly", self.handle_mark_readonly),
             web.post("/admin/mark_writable", self.handle_mark_writable),
             web.post("/admin/volume_copy", self.handle_volume_copy),
+            web.post("/admin/volume_mount", self.handle_volume_mount),
+            web.post("/admin/volume_unmount", self.handle_volume_unmount),
+            web.get("/admin/needle_ids", self.handle_needle_ids),
+            web.get("/admin/needle_read", self.handle_needle_read),
+            web.post("/admin/needle_write", self.handle_needle_write),
+            web.post("/admin/needle_delete", self.handle_needle_delete),
             web.post("/admin/volume_replication",
                      self.handle_volume_replication),
             web.post("/admin/vacuum_check", self.handle_vacuum_check),
@@ -423,6 +429,81 @@ class VolumeServer:
             Volume, loc.dir, collection, vid)
         self.poke_heartbeat()
         return web.json_response({"volume": vid})
+
+    async def handle_volume_unmount(self, req: web.Request) -> web.Response:
+        """VolumeUnmount (volume_grpc_admin.go): close + forget a volume,
+        keeping its files — the offline half of volume.move."""
+        body = await req.json()
+        try:
+            await asyncio.to_thread(
+                self.store.unmount_volume, int(body["volume"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_volume_mount(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            await asyncio.to_thread(
+                self.store.mount_volume, int(body["volume"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        self.poke_heartbeat()
+        return web.json_response({})
+
+    async def handle_needle_read(self, req: web.Request) -> web.Response:
+        """Raw needle record for replica sync (volume.check.disk)."""
+        try:
+            blob = await asyncio.to_thread(
+                self.store.read_raw_needle, int(req.query["volume"]),
+                int(req.query["key"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream")
+
+    async def handle_needle_write(self, req: web.Request) -> web.Response:
+        """Append a raw needle record pulled from a peer replica.
+        ?force=1 overwrites an existing live needle (content-divergence
+        repair where the newer record wins)."""
+        try:
+            key = await asyncio.to_thread(
+                self.store.append_raw_needle, int(req.query["volume"]),
+                await req.read(), req.query.get("force") == "1")
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (ValueError, PermissionError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"key": key})
+
+    async def handle_needle_delete(self, req: web.Request) -> web.Response:
+        """Tombstone a needle by key without cookie/replication fan-out
+        — tombstone propagation for volume.check.disk."""
+        body = await req.json()
+        try:
+            await asyncio.to_thread(
+                self.store.delete_needle, int(body["volume"]),
+                int(body["key"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({})
+
+    async def handle_needle_ids(self, req: web.Request) -> web.Response:
+        """Live needle-id census of one volume — the server side of
+        volume.fsck / volume.check.disk (volume_grpc_admin.go
+        VolumeNeedleStatus + fsck's idx walk)."""
+        vid = int(req.query["volume"])
+        try:
+            live, deleted = await asyncio.to_thread(
+                self.store.needle_ids, vid)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response(
+            {"volume": vid, "needles": [[k, s] for k, s in live],
+             "deleted": deleted})
 
     async def handle_volume_replication(self, req: web.Request) -> web.Response:
         body = await req.json()

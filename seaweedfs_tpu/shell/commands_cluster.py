@@ -1,0 +1,53 @@
+"""Cluster inspection shell commands.
+
+Equivalents of /root/reference/weed/shell/command_cluster_ps.go (list
+every node type known to the cluster) and command_cluster_raft_ps.go
+(raft peer status on the master quorum).
+"""
+from __future__ import annotations
+
+import requests
+
+from .env import CommandEnv, ShellError
+
+
+def cluster_ps(env: CommandEnv) -> dict:
+    """Processes in the cluster: masters (raft peers), volume servers
+    (from topology), filers/brokers (from membership announcements)."""
+    status = env.master_get("/cluster/status")
+    masters = status.get("Peers") or [env.master_url.split("//", 1)[-1]]
+    out = {"masters": masters,
+           "leader": status.get("Leader", ""),
+           "volume_servers": [n["url"] for n in env.data_nodes()],
+           "filers": [], "brokers": []}
+    try:
+        nodes = env.master_get("/cluster/nodes")
+        for n in nodes.get("nodes", []):
+            kind = n.get("type", "")
+            if kind == "filer":
+                out["filers"].append(n.get("address", ""))
+            elif kind == "broker":
+                out["brokers"].append(n.get("address", ""))
+    except ShellError:
+        pass
+    return out
+
+
+def cluster_raft_ps(env: CommandEnv) -> dict:
+    """Raft status of each master peer (command_cluster_raft_ps.go)."""
+    status = env.master_get("/cluster/status")
+    peers = status.get("Peers") or []
+    if not peers:
+        return {"peers": [{"address": env.master_url, "leader": True,
+                           "reachable": True}]}
+    out = []
+    for p in peers:
+        url = p if p.startswith("http") else f"http://{p}"
+        try:
+            d = requests.get(f"{url}/cluster/leader", timeout=3).json()
+            out.append({"address": p, "leader": d.get("IsLeader", False),
+                        "reachable": True})
+        except requests.RequestException:
+            out.append({"address": p, "leader": False,
+                        "reachable": False})
+    return {"peers": out}

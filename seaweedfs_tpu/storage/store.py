@@ -83,6 +83,66 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         v.read_only = read_only
 
+    def unmount_volume(self, vid: int) -> None:
+        """Close a volume and drop it from memory, keeping its files on
+        disk (volume_grpc_admin.go VolumeUnmount). It disappears from the
+        next heartbeat; `mount_volume` brings it back."""
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                v.close()
+                del loc.volumes[vid]
+                return
+        raise KeyError(f"volume {vid} not found")
+
+    def mount_volume(self, vid: int) -> None:
+        """Reload an unmounted volume from its on-disk .dat/.idx
+        (volume_grpc_admin.go VolumeMount)."""
+        if self.find_volume(vid) is not None:
+            return
+        for loc in self.locations:
+            if loc.try_load_volume(vid):
+                return
+        raise KeyError(f"volume {vid} has no files on disk")
+
+    def read_raw_needle(self, vid: int, key: int) -> bytes:
+        """Serialized on-disk record of one live needle — the transfer
+        unit of volume.check.disk's needle-level replica sync."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        n = v.read_needle(key)
+        return n.to_bytes(v.version)
+
+    def append_raw_needle(self, vid: int, blob: bytes,
+                          force: bool = False) -> int:
+        """Append a record produced by `read_raw_needle` on a peer
+        replica. Skips keys that are already live unless `force` (the
+        content-divergence repair, where the newer record must win)."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        n = Needle.from_bytes(blob, v.version)
+        if not force and v.nm.get(n.id) is not None:
+            return n.id
+        v.append_needle(n)
+        return n.id
+
+    def needle_ids(self, vid: int) -> tuple[list[tuple[int, int]],
+                                            list[int]]:
+        """(live (needle_id, size) pairs, deleted needle_ids) of a local
+        volume or EC volume — feeds volume.fsck / volume.check.disk
+        (command_volume_fsck.go). Deleted ids matter: replica sync must
+        propagate tombstones, never resurrect from a stale live copy."""
+        v = self.find_volume(vid)
+        if v is not None:
+            return ([(key, size) for key, _, size in v.nm.live_items()],
+                    sorted(v.nm.deleted_keys()))
+        ecv = self.ec_volumes.get(vid)
+        if ecv is not None:
+            return ecv.live_needle_ids(), sorted(ecv.deleted)
+        raise KeyError(f"volume {vid} not found")
+
     # -- needle IO ------------------------------------------------------
     def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
         v = self.find_volume(vid)

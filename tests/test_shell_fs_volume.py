@@ -1,0 +1,232 @@
+"""Shell command families added on top of the EC set: fs.*, volume
+move/copy/mount/fsck/check.disk, collection.*, cluster.ps — the
+reference's weed/shell registry (SURVEY.md section 2.9)."""
+import os
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell import (commands_cluster, commands_fs,
+                                 commands_volume, repl)
+from seaweedfs_tpu.shell.env import CommandEnv, ShellError
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("shell_cluster")),
+                n_volume_servers=3, volume_size_limit=4 << 20,
+                max_volumes=40, with_filer=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    e = CommandEnv(cluster.master_url, filer_url=cluster.filer_url)
+    e.acquire_lock()
+    yield e
+    e.close()
+
+
+def put(cluster, path: str, data: bytes) -> None:
+    r = requests.post(f"{cluster.filer_url}{path}", data=data)
+    assert r.status_code < 300, (path, r.status_code)
+
+
+class TestFsCommands:
+    def test_ls_cat_du_tree(self, cluster, env):
+        put(cluster, "/shop/a.txt", b"alpha")
+        put(cluster, "/shop/b.txt", b"bravo!")
+        put(cluster, "/shop/sub/c.txt", b"charlie12")
+        names = commands_fs.fs_ls(env, "/shop")
+        assert set(names) == {"a.txt", "b.txt", "sub/"}
+        long = {e["name"]: e for e in commands_fs.fs_ls(env, "/shop",
+                                                        long=True)}
+        assert long["a.txt"]["size"] == 5
+        assert long["sub"]["is_directory"]
+        assert commands_fs.fs_cat(env, "/shop/b.txt") == b"bravo!"
+        du = commands_fs.fs_du(env, "/shop")
+        assert du["files"] == 3 and du["dirs"] == 1
+        assert du["bytes"] == 5 + 6 + 9
+        tree = commands_fs.fs_tree(env, "/shop")
+        assert "sub/" in tree and "  c.txt" in tree
+
+    def test_mkdir_mv_rm(self, cluster, env):
+        commands_fs.fs_mkdir(env, "/mv_zone")
+        put(cluster, "/mv_zone/orig.txt", b"move me")
+        commands_fs.fs_mv(env, "/mv_zone/orig.txt", "/mv_zone/dest.txt")
+        assert commands_fs.fs_cat(env, "/mv_zone/dest.txt") == b"move me"
+        with pytest.raises(ShellError):
+            commands_fs.fs_cat(env, "/mv_zone/orig.txt")
+        commands_fs.fs_rm(env, "/mv_zone", recursive=True)
+        with pytest.raises(ShellError):
+            commands_fs.fs_ls(env, "/mv_zone")
+
+    def test_meta_save_load_roundtrip(self, cluster, env, tmp_path):
+        put(cluster, "/meta_zone/keep.txt", b"snapshot me")
+        out = str(tmp_path / "meta.jsonl")
+        n = commands_fs.fs_meta_save(env, "/meta_zone", out)
+        assert n == 1 and os.path.exists(out)
+        # metadata-only delete keeps chunks alive for the restore
+        requests.delete(f"{cluster.filer_url}/meta_zone/keep.txt"
+                        "?skipChunkDeletion=true")
+        assert commands_fs.fs_meta_load(env, out) == 1
+        assert commands_fs.fs_cat(env, "/meta_zone/keep.txt") == \
+            b"snapshot me"
+
+    def test_verify_clean_and_broken(self, cluster, env):
+        put(cluster, "/verify_zone/ok.txt", b"fine")
+        assert commands_fs.fs_verify(env, "/verify_zone") == []
+
+
+class TestVolumeCommands:
+    def _fill_volume(self, cluster, col):
+        a = verbs.assign(cluster.master_url, collection=col)
+        verbs.upload(a, b"payload-" + col.encode())
+        return int(a.fid.split(",")[0]), a.fid
+
+    def test_copy_and_move(self, cluster, env):
+        vid, fid = self._fill_volume(cluster, "mvcol")
+        src = env.volume_locations(vid)[0]
+        others = [n["url"] for n in env.data_nodes() if n["url"] != src]
+        target = others[0]
+        commands_volume.volume_copy(env, vid, src, target)
+        # both copies serve the blob
+        for url in (src, target):
+            assert requests.get(f"http://{url}/{fid}").status_code == 200
+        commands_volume.volume_delete(env, vid, server=target)
+        commands_volume.volume_move(env, vid, src, others[1])
+        r = requests.get(f"http://{others[1]}/{fid}",
+                         allow_redirects=False)
+        assert r.status_code == 200
+
+    def test_mount_unmount(self, cluster, env):
+        vid, fid = self._fill_volume(cluster, "mntcol")
+        server = env.volume_locations(vid)[0]
+        commands_volume.volume_unmount(env, vid, server)
+        r = requests.get(f"http://{server}/{fid}", allow_redirects=False)
+        assert r.status_code in (301, 404)
+        commands_volume.volume_mount(env, vid, server)
+        assert requests.get(f"http://{server}/{fid}").status_code == 200
+
+    def test_mark_readonly_blocks_writes(self, cluster, env):
+        vid, _ = self._fill_volume(cluster, "markcol")
+        commands_volume.volume_mark(env, vid, writable=False)
+        url = env.volume_locations(vid)[0]
+        r = requests.post(f"http://{url}/{vid},00000001deadbeef",
+                          data=b"x")
+        assert r.status_code in (403, 409, 500)
+        commands_volume.volume_mark(env, vid, writable=True)
+
+    def test_check_disk_repairs_divergence(self, cluster, env):
+        vid, fid = self._fill_volume(cluster, "divcol")
+        src = env.volume_locations(vid)[0]
+        target = next(n["url"] for n in env.data_nodes()
+                      if n["url"] != src)
+        commands_volume.volume_copy(env, vid, src, target)
+        # two-way divergence: one needle only on src, one only on target
+        only_src = only_target = None
+        for _ in range(8):
+            a = verbs.assign(cluster.master_url, collection="divcol")
+            if int(a.fid.split(",")[0]) != vid:
+                continue
+            if only_src is None:
+                only_src = a.fid
+                requests.post(
+                    f"http://{src}/{only_src}?type=replicate",
+                    data=b"only-on-src")
+            else:
+                only_target = a.fid
+                requests.post(
+                    f"http://{target}/{only_target}?type=replicate",
+                    data=b"only-on-target")
+                break
+        assert only_src and only_target
+        out = commands_volume.volume_check_disk(env, vid)
+        assert out["diverged"] and out["repaired"]
+        # both unique needles survived and are now on both replicas
+        for f, data in ((only_src, b"only-on-src"),
+                        (only_target, b"only-on-target")):
+            for url in (src, target):
+                r = requests.get(f"http://{url}/{f}",
+                                 allow_redirects=False)
+                assert r.status_code == 200 and r.content == data, \
+                    (f, url)
+        out2 = commands_volume.volume_check_disk(env, vid)
+        assert not out2["diverged"]
+
+    def test_check_disk_propagates_tombstone(self, cluster, env):
+        """A delete applied on one replica must not be undone by sync —
+        the tombstone wins over the stale live copy."""
+        vid, fid = self._fill_volume(cluster, "tombcol")
+        src = env.volume_locations(vid)[0]
+        target = next(n["url"] for n in env.data_nodes()
+                      if n["url"] != src)
+        commands_volume.volume_copy(env, vid, src, target)
+        # delete only on src (replicate-tagged: no fan-out)
+        r = requests.delete(f"http://{src}/{fid}?type=replicate")
+        assert r.status_code < 300
+        out = commands_volume.volume_check_disk(env, vid)
+        assert any("deleted_on" in rep for rep in out["repaired"])
+        # gone from both replicas, not resurrected on src
+        for url in (src, target):
+            r = requests.get(f"http://{url}/{fid}",
+                             allow_redirects=False)
+            assert r.status_code == 404, url
+        assert not commands_volume.volume_check_disk(env, vid)["diverged"]
+
+    def test_fsck_clean_then_orphan(self, cluster, env):
+        put(cluster, "/fsck_zone/file.bin", b"y" * 100)
+        out = commands_volume.volume_fsck(env)
+        assert out["volumes_checked"] >= 1
+        # orphan: delete the entry without deleting chunks
+        requests.delete(f"{cluster.filer_url}/fsck_zone/file.bin"
+                        "?skipChunkDeletion=true")
+        out = commands_volume.volume_fsck(env)
+        assert any(out["orphans"].values())
+
+    def test_evacuate(self, cluster, env):
+        vid, fid = self._fill_volume(cluster, "evaccol")
+        server = env.volume_locations(vid)[0]
+        moves = commands_volume.volume_evacuate(env, server)
+        assert any(m.get("volume") == vid for m in moves)
+        # data still readable somewhere
+        locs = env.volume_locations(vid)
+        assert locs and server not in locs
+        assert requests.get(f"http://{locs[0]}/{fid}").status_code == 200
+
+    def test_grow_and_collections(self, cluster, env):
+        commands_volume.volume_grow(env, count=1, collection="growcol")
+        cols = commands_volume.collection_list(env)
+        assert "growcol" in cols
+        deleted = commands_volume.collection_delete(env, "growcol")
+        assert deleted
+        assert "growcol" not in commands_volume.collection_list(env)
+
+
+class TestClusterCommands:
+    def test_cluster_ps(self, cluster, env):
+        ps = commands_cluster.cluster_ps(env)
+        assert len(ps["volume_servers"]) == 3
+        assert ps["filers"], "filer should announce itself"
+
+    def test_raft_ps_single(self, cluster, env):
+        out = commands_cluster.cluster_raft_ps(env)
+        assert out["peers"]
+
+
+class TestReplDispatch:
+    def test_dispatch_fs_and_volume(self, cluster, env):
+        put(cluster, "/repl_zone/x.txt", b"via repl")
+        out = repl.run_command(env, "fs.cat /repl_zone/x.txt")
+        assert out == "via repl"
+        out = repl.run_command(env, "fs.ls /repl_zone")
+        assert out == ["x.txt"]
+        out = repl.run_command(env, "cluster.ps")
+        assert "masters" in out
+        out = repl.run_command(env, "collection.list")
+        assert isinstance(out, list)
+        with pytest.raises(ShellError):
+            repl.run_command(env, "no.such.command")
