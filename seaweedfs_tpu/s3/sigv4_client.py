@@ -18,15 +18,22 @@ def _hmac(key: bytes, msg: str) -> bytes:
 def sign_headers(method: str, url: str, access_key: str,
                  secret_key: str, payload: bytes = b"",
                  region: str = "us-east-1",
-                 service: str = "s3") -> dict:
-    """-> headers dict carrying a SigV4 Authorization for `url`."""
+                 service: str = "s3",
+                 unsigned_payload: bool = False) -> dict:
+    """-> headers dict carrying a SigV4 Authorization for `url`.
+
+    `unsigned_payload=True` signs with x-amz-content-sha256 =
+    UNSIGNED-PAYLOAD (the standard escape hatch for streamed bodies
+    whose hash isn't known up front, e.g. tier uploads of multi-GB
+    .dat files)."""
     parts = urlsplit(url)
     host = parts.netloc
     path = quote(parts.path or "/", safe="/~._-")
     now = datetime.now(timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    payload_hash = ("UNSIGNED-PAYLOAD" if unsigned_payload
+                    else hashlib.sha256(payload).hexdigest())
 
     # canonical query: sorted key=value with rfc3986 escaping
     q = []

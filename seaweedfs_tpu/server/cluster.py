@@ -32,7 +32,8 @@ class Cluster:
                  with_filer: bool = False,
                  filer_store: str = "memory",
                  with_s3: bool = False,
-                 s3_config: dict | None = None):
+                 s3_config: dict | None = None,
+                 tier_backends: dict[str, dict] | None = None):
         """topology: optional per-server (data_center, rack) labels."""
         self.base_dir = base_dir
         self.master = MasterServer(
@@ -57,7 +58,8 @@ class Cluster:
                         ("DefaultDataCenter", "DefaultRack"))
             vs = VolumeServer(store, self.master_url, data_center=dc,
                               rack=rack, jwt_secret=jwt_secret,
-                              pulse_seconds=pulse_seconds)
+                              pulse_seconds=pulse_seconds,
+                              tier_backends=tier_backends)
             thread = ServerThread(vs.app).start()
             store.port = thread.port
             store.public_url = thread.address

@@ -23,6 +23,7 @@ from aiohttp import web
 
 from ..ec import geometry as geo
 from ..ec.decoder import find_dat_size, write_dat_file, write_idx_from_ecx
+from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
@@ -36,7 +37,8 @@ class VolumeServer:
                  rack: str = "DefaultRack",
                  jwt_secret: str = "",
                  pulse_seconds: float = 5.0,
-                 max_concurrent_writes: int = 64):
+                 max_concurrent_writes: int = 64,
+                 tier_backends: dict[str, dict] | None = None):
         self.store = store
         # comma-separated list in HA mode; heartbeats follow the raft
         # leader (volume_grpc_client_to_master.go:50 tries all masters)
@@ -53,6 +55,11 @@ class VolumeServer:
         self._hb_task: asyncio.Task | None = None
         self._hb_wake = asyncio.Event()
         self.store.remote_shard_reader = self._remote_shard_read_sync
+        # tier destinations, e.g. {"s3.default": {"endpoint":..,"bucket":..}}
+        # (the reference receives these from master.toml [storage.backend]
+        # via the heartbeat response, volume_grpc_client_to_master.go)
+        for name, conf in (tier_backends or {}).items():
+            backend.configure_storage(name, **conf)
         self.app = self._build_app()
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
@@ -89,6 +96,8 @@ class VolumeServer:
                      self.handle_volume_replication),
             web.post("/admin/vacuum_check", self.handle_vacuum_check),
             web.post("/admin/vacuum_compact", self.handle_vacuum_compact),
+            web.post("/admin/tier_upload", self.handle_tier_upload),
+            web.post("/admin/tier_download", self.handle_tier_download),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
             web.post("/admin/ec/copy", self.handle_ec_copy),
@@ -534,13 +543,60 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
+        remote = v.volume_info.remote_file() if v.volume_info else None
         return web.json_response({
             "volume": vid, "size": v.content_size(),
             "file_count": v.nm.file_count,
             "deleted_bytes": v.nm.deleted_bytes,
             "garbage_ratio": v.garbage_ratio(),
             "read_only": v.read_only,
+            "remote": ({"backend": remote.backend_name, "key": remote.key,
+                        "file_size": remote.file_size}
+                       if remote else None),
         })
+
+    # ------------------------------------------------------------------
+    # admin: tiering (volume_grpc_tier_upload.go / _download.go)
+    # ------------------------------------------------------------------
+    async def handle_tier_upload(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.find_volume(int(body["volume"]))
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        keep = bool(body.get("keepLocalDatFile", False))
+        try:
+            adopt = body.get("adopt")
+            if adopt:
+                # another replica already uploaded the object: just
+                # record it and drop the local copy
+                from ..storage import volume_info as vinfo
+                rf = vinfo.RemoteFile(**adopt)
+                await asyncio.to_thread(v.tier_adopt, rf, keep)
+            else:
+                storage = backend.get_storage(
+                    body.get("dest", "s3.default"))
+                rf = await asyncio.to_thread(v.tier_upload, storage, keep)
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        self.poke_heartbeat()
+        return web.json_response({
+            "volume": v.vid, "backend": rf.backend_name, "key": rf.key,
+            "backend_type": rf.backend_type, "backend_id": rf.backend_id,
+            "file_size": rf.file_size, "modified_time": rf.modified_time})
+
+    async def handle_tier_download(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.find_volume(int(body["volume"]))
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        try:
+            await asyncio.to_thread(
+                v.tier_download, bool(body.get("deleteRemote", True)))
+        except (ValueError, KeyError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        self.poke_heartbeat()
+        return web.json_response({"volume": v.vid,
+                                  "size": v.content_size()})
 
     # ------------------------------------------------------------------
     # admin: erasure coding (volume_grpc_erasure_coding.go)

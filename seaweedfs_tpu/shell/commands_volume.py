@@ -144,10 +144,17 @@ def volume_copy(env: CommandEnv, vid: int, source: str,
 def volume_move(env: CommandEnv, vid: int, source: str,
                 target: str) -> dict:
     """Copy to target, then delete from source (command_volume_move.go).
-    Reads keep working throughout: the copy is mounted before the source
-    is dropped."""
+    The source is marked read-only for the duration of the copy so no
+    write accepted after the .dat snapshot can be lost with the source;
+    reads keep working throughout, and the target comes up writable."""
     env.confirm_locked()
-    out = volume_copy(env, vid, source, target)
+    env.vs_post(source, "/admin/mark_readonly", {"volume": vid})
+    try:
+        out = volume_copy(env, vid, source, target)
+    except Exception:
+        env.vs_post(source, "/admin/mark_writable", {"volume": vid})
+        raise
+    env.vs_post(target, "/admin/mark_writable", {"volume": vid})
     env.vs_post(source, "/admin/delete_volume", {"volume": vid})
     return out
 
@@ -302,9 +309,13 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
             # tombstone wins: delete wherever it is still live
             for url in urls:
                 if key in live[url]:
-                    requests.post(f"http://{url}/admin/needle_delete",
-                                  json={"volume": vid, "key": key},
-                                  timeout=120)
+                    r = requests.post(
+                        f"http://{url}/admin/needle_delete",
+                        json={"volume": vid, "key": key}, timeout=120)
+                    if r.status_code != 200:
+                        raise ShellError(
+                            f"propagate tombstone for needle {key} to "
+                            f"{url}: {r.status_code} {r.text}")
                     repaired.append({"needle": key, "deleted_on": url})
             continue
         holders = [u for u in urls if key in live[u]]
@@ -371,6 +382,48 @@ def volume_fsck(env: CommandEnv) -> dict:
                if referenced[vid] - on_disk.get(vid, set())}
     return {"orphans": orphans, "missing": missing,
             "volumes_checked": len(on_disk)}
+
+
+def volume_tier_upload(env: CommandEnv, vid: int,
+                       dest: str = "s3.default",
+                       keep_local: bool = False) -> list[dict]:
+    """Move a volume's .dat to a backend storage (s3) on every replica
+    (command_volume_tier_upload.go doVolumeTierUpload): mark readonly
+    first, then upload + write .vif."""
+    env.confirm_locked()
+    urls = env.volume_locations(vid)
+    if not urls:
+        raise ShellError(f"volume {vid} not found")
+    for url in urls:
+        env.vs_post(url, "/admin/mark_readonly", {"volume": vid})
+    # upload the bytes once, from the first replica; the others just
+    # adopt the uploaded object into their .vif
+    first = env.vs_post(urls[0], "/admin/tier_upload", {
+        "volume": vid, "dest": dest, "keepLocalDatFile": keep_local})
+    out = [first]
+    adopt = {"backend_type": first["backend_type"],
+             "backend_id": first["backend_id"], "key": first["key"],
+             "file_size": first["file_size"],
+             "modified_time": first["modified_time"]}
+    for url in urls[1:]:
+        out.append(env.vs_post(url, "/admin/tier_upload", {
+            "volume": vid, "adopt": adopt,
+            "keepLocalDatFile": keep_local}))
+    return out
+
+
+def volume_tier_download(env: CommandEnv, vid: int) -> list[dict]:
+    """Bring a tiered volume's .dat back to local disk on every replica
+    (command_volume_tier_download.go). All replicas share one remote
+    object, so it is deleted only with the LAST replica's restore."""
+    env.confirm_locked()
+    urls = env.volume_locations(vid)
+    if not urls:
+        raise ShellError(f"volume {vid} not found")
+    return [env.vs_post(url, "/admin/tier_download",
+                        {"volume": vid,
+                         "deleteRemote": i == len(urls) - 1})
+            for i, url in enumerate(urls)]
 
 
 def collection_list(env: CommandEnv) -> list[str]:

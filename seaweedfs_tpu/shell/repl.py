@@ -34,6 +34,8 @@ HELP = """commands:
   volume.evacuate -server=H         move everything off a server
   volume.check.disk -volumeId=N     compare + repair replica divergence
   volume.fsck                       filer chunks vs volume needles
+  volume.tier.upload -volumeId=N [-dest=s3.default] [-keepLocalDatFile]
+  volume.tier.download -volumeId=N  bring a tiered .dat back to disk
   ec.encode -volumeId=N             erasure-code a volume
   ec.rebuild -volumeId=N            rebuild missing shards
   ec.balance                        even out shard counts
@@ -132,6 +134,13 @@ def run_command(env: CommandEnv, line: str) -> object:
             env, int(opts["volumeId"]))
     if cmd == "volume.fsck":
         return commands_volume.volume_fsck(env)
+    if cmd == "volume.tier.upload":
+        return commands_volume.volume_tier_upload(
+            env, int(opts["volumeId"]), opts.get("dest", "s3.default"),
+            keep_local="keepLocalDatFile" in opts)
+    if cmd == "volume.tier.download":
+        return commands_volume.volume_tier_download(
+            env, int(opts["volumeId"]))
     # -- erasure coding -------------------------------------------------
     if cmd == "ec.encode":
         return commands_ec.ec_encode(env, int(opts["volumeId"]),

@@ -116,10 +116,176 @@ class MemoryFile:
         pass
 
 
+class S3RangeFile:
+    """Read-only view of a tiered volume's .dat living in an
+    S3-compatible bucket (backend/s3_backend/s3_backend.go
+    S3BackendStorageFile): reads become ranged GETs; writes are
+    forbidden — tiered volumes are read-only by construction
+    (shell/command_volume_tier_upload.go marks them so first)."""
+
+    def __init__(self, storage: "S3BackendStorage", key: str, size: int):
+        self._storage = storage
+        self._key = key
+        self._size = size
+
+    @property
+    def name(self) -> str:
+        return f"s3://{self._storage.bucket}/{self._key}"
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        if offset >= self._size or size <= 0:
+            return b""
+        end = min(offset + size, self._size) - 1
+        return self._storage.get_range(self._key, offset, end)
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        raise PermissionError("tiered volume is read-only")
+
+    def append(self, data: bytes) -> int:
+        raise PermissionError("tiered volume is read-only")
+
+    def truncate(self, size: int) -> None:
+        raise PermissionError("tiered volume is read-only")
+
+    def size(self) -> int:
+        return self._size
+
+    def flush(self) -> None:
+        pass
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class S3BackendStorage:
+    """One configured S3-compatible tier destination
+    (backend/s3_backend/s3_backend.go S3BackendStorage): uploads a
+    volume's .dat as one object, serves ranged reads back, deletes on
+    un-tier. With empty access_key requests go unsigned (anonymous),
+    which is how the in-process gateway is used in tests."""
+
+    def __init__(self, id: str = "default", endpoint: str = "",
+                 bucket: str = "", access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 prefix: str = "", **_):
+        if not endpoint or not bucket:
+            raise ValueError("s3 backend needs endpoint and bucket")
+        self.id = id
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.prefix = prefix.strip("/")
+
+    @property
+    def name(self) -> str:
+        return f"s3.{self.id}"
+
+    def object_key(self, filename: str) -> str:
+        base = os.path.basename(filename)
+        return f"{self.prefix}/{base}" if self.prefix else base
+
+    def _url(self, key: str) -> str:
+        return f"{self.endpoint}/{self.bucket}/{key}"
+
+    def _headers(self, method: str, key: str, payload: bytes = b"",
+                 extra: dict | None = None,
+                 unsigned_payload: bool = False) -> dict:
+        headers = dict(extra or {})
+        if self.access_key:
+            from ..s3.sigv4_client import sign_headers
+            headers.update(sign_headers(
+                method, self._url(key), self.access_key, self.secret_key,
+                payload=payload, region=self.region,
+                unsigned_payload=unsigned_payload))
+        return headers
+
+    def upload_file(self, f: StorageFile, key: str,
+                    chunk: int = 64 << 20) -> int:
+        """Stream the .dat into the bucket; returns bytes uploaded.
+        (The reference multipart-uploads via s3manager; one streamed
+        PUT with a known Content-Length keeps the dependency surface to
+        the HTTP client we already have.) Large bodies are signed with
+        UNSIGNED-PAYLOAD so the stream doesn't have to be hashed (or
+        buffered) up front."""
+        import requests
+        total = f.size()
+        if total <= chunk:
+            payload = f.read_at(total, 0)
+            r = requests.put(self._url(key), data=payload,
+                             headers=self._headers("PUT", key, payload),
+                             timeout=600)
+            r.raise_for_status()
+            return total
+
+        class _Reader:
+            """File-like with __len__ so requests sends Content-Length
+            (S3 rejects chunked transfer-encoding without the
+            STREAMING-* signing scheme)."""
+
+            def __init__(self):
+                self.off = 0
+
+            def __len__(self):
+                return total - self.off
+
+            def read(self, n: int = -1) -> bytes:
+                if self.off >= total:
+                    return b""
+                want = total - self.off if n is None or n < 0 \
+                    else min(n, total - self.off, chunk)
+                blob = f.read_at(want, self.off)
+                self.off += len(blob)
+                return blob
+
+        r = requests.put(
+            self._url(key), data=_Reader(),
+            headers=self._headers("PUT", key, unsigned_payload=True),
+            timeout=3600)
+        r.raise_for_status()
+        return total
+
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        import requests
+        h = self._headers("GET", key)
+        h["Range"] = f"bytes={start}-{end}"
+        r = requests.get(self._url(key), headers=h, timeout=300)
+        r.raise_for_status()
+        return r.content
+
+    def download_to(self, key: str, dest_path: str) -> int:
+        import requests
+        r = requests.get(self._url(key), headers=self._headers("GET", key),
+                         stream=True, timeout=3600)
+        r.raise_for_status()
+        n = 0
+        with open(dest_path, "wb") as out:
+            for blob in r.iter_content(4 << 20):
+                out.write(blob)
+                n += len(blob)
+        return n
+
+    def delete(self, key: str) -> None:
+        import requests
+        requests.delete(self._url(key),
+                        headers=self._headers("DELETE", key), timeout=300)
+
+    def open_file(self, key: str, size: int) -> S3RangeFile:
+        return S3RangeFile(self, key, size)
+
+
 _factories: dict[str, Callable[..., StorageFile]] = {
     "disk": DiskFile,
     "memory": MemoryFile,
 }
+
+# configured tier destinations keyed "type.id" ("s3.default"), the
+# BackendStorages registry of backend.go:44
+_storages: dict[str, S3BackendStorage] = {}
 
 
 def register(name: str, factory: Callable[..., StorageFile]) -> None:
@@ -132,3 +298,26 @@ def create(kind: str, *args, **kwargs) -> StorageFile:
     except KeyError:
         raise KeyError(f"unknown storage backend {kind!r}; "
                        f"known: {sorted(_factories)}") from None
+
+
+def configure_storage(name: str, **conf) -> S3BackendStorage:
+    """Configure a tier destination; `name` is "s3.<id>"
+    (LoadConfiguration, backend.go:50-70)."""
+    btype, _, bid = name.partition(".")
+    if btype != "s3":
+        raise KeyError(f"unknown backend storage type {btype!r}")
+    s = S3BackendStorage(id=bid or "default", **conf)
+    _storages[s.name] = s
+    return s
+
+
+def get_storage(name: str) -> S3BackendStorage:
+    try:
+        return _storages[name]
+    except KeyError:
+        raise KeyError(f"backend storage {name!r} not configured; "
+                       f"known: {sorted(_storages)}") from None
+
+
+def storage_names() -> list[str]:
+    return sorted(_storages)

@@ -14,11 +14,14 @@ from dataclasses import dataclass, field
 from ..ec import geometry as geo
 from .volume import Volume
 
-_VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.(?:dat|vif)$")
 _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
 
 
 def parse_volume_filename(name: str) -> tuple[str, int] | None:
+    """Recognise a volume by its .dat — or by a .vif sidecar alone,
+    which marks a tiered volume whose .dat lives on a backend storage
+    (disk_location.go loadVolumeInfo)."""
     m = _VOL_RE.match(name)
     if not m:
         return None
