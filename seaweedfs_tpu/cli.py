@@ -87,6 +87,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-path", default="/")
     p.add_argument("-oneWay", dest="one_way", action="store_true")
 
+    p = sub.add_parser("filer.remote.sync",
+                       help="push local writes under a remote mount "
+                            "back to the cloud storage")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-dir", required=True, help="mounted directory")
+
     p = sub.add_parser("filer.meta.backup",
                        help="continuous metadata backup to sqlite")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
@@ -173,6 +179,21 @@ def _dispatch(args) -> int:
                 _t.sleep(3600)
         except KeyboardInterrupt:
             sync.stop()
+        return 0
+    if args.cmd == "filer.remote.sync":
+        import time as _t
+
+        from .remote_storage.sync import RemoteSyncWorker
+
+        w = RemoteSyncWorker(args.filer, args.dir)
+        w.start()
+        print(f"pushing {args.filer}{args.dir} writes to "
+              f"storage {w.mount.storage!r}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            w.stop()
         return 0
     if args.cmd == "filer.meta.backup":
         import time as _t

@@ -1,0 +1,19 @@
+"""Remote-storage tiering: map filer directories onto cloud buckets.
+
+Equivalent of /root/reference/weed/remote_storage/ (the
+RemoteStorageClient interface, remote_storage.go:71-87, and its
+s3/gcs/azure/... implementations) plus the mount bookkeeping the shell
+remote.* commands and `filer.remote.sync` use
+(weed/shell/command_remote_*.go, weed/command/filer_remote_sync*.go).
+"""
+from .client import (LocalRemoteClient, RemoteEntry, RemoteStorageClient,
+                     S3RemoteClient, make_client, register_remote)
+from .mount import (RemoteConf, RemoteMount, find_mount, load_conf,
+                    remote_key_for, save_conf)
+
+__all__ = [
+    "RemoteEntry", "RemoteStorageClient", "LocalRemoteClient",
+    "S3RemoteClient", "make_client", "register_remote",
+    "RemoteConf", "RemoteMount", "load_conf", "save_conf",
+    "find_mount", "remote_key_for",
+]

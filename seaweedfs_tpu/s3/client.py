@@ -1,0 +1,193 @@
+"""Low-level S3-compatible HTTP client shared by every outbound S3 use
+(tier backend storage, remote-storage mounts, replication sink).
+
+One place for the SigV4-vs-anonymous convention, URL building/quoting,
+ranged GETs, streamed PUTs with known Content-Length, and ListObjectsV2
+paging — so fixes to any of those apply to all S3 consumers at once.
+Server-side verification lives in s3/auth.py; the reference's
+equivalents are the aws-sdk-go wrappers under
+weed/storage/backend/s3_backend and weed/remote_storage/s3.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Iterator
+from urllib.parse import quote
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@dataclass
+class ObjectInfo:
+    key: str
+    size: int = 0
+    mtime: float = 0.0
+    etag: str = ""
+
+
+def _parse_iso(s: str) -> float:
+    from datetime import datetime
+    try:
+        return datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class S3Client:
+    """Bucket-scoped S3 HTTP verbs. Empty access_key => anonymous
+    (unsigned) requests, which is how the in-process gateway is used in
+    tests."""
+
+    def __init__(self, endpoint: str = "", bucket: str = "",
+                 access_key: str = "", secret_key: str = "",
+                 region: str = "us-east-1", **_):
+        if not endpoint or not bucket:
+            raise ValueError("s3 client needs endpoint and bucket")
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def url(self, key: str = "", query: str = "") -> str:
+        u = f"{self.endpoint}/{self.bucket}"
+        if key:
+            u += "/" + quote(key.lstrip("/"), safe="/~._-")
+        if query:
+            u += "?" + query
+        return u
+
+    def headers(self, method: str, url: str, payload: bytes = b"",
+                unsigned_payload: bool = False) -> dict:
+        if not self.access_key:
+            return {}
+        from .sigv4_client import sign_headers
+        return sign_headers(method, url, self.access_key,
+                            self.secret_key, payload=payload,
+                            region=self.region,
+                            unsigned_payload=unsigned_payload)
+
+    # -- objects --------------------------------------------------------
+    def get_object(self, key: str, offset: int = 0,
+                   size: int = -1) -> bytes:
+        import requests
+        if size == 0:
+            return b""
+        url = self.url(key)
+        h = self.headers("GET", url)
+        if offset or size > 0:
+            end = "" if size < 0 else str(offset + size - 1)
+            h["Range"] = f"bytes={offset}-{end}"
+        r = requests.get(url, headers=h, timeout=600)
+        r.raise_for_status()
+        return r.content
+
+    def put_object(self, key: str, data: bytes) -> ObjectInfo:
+        import requests
+        url = self.url(key)
+        r = requests.put(url, data=data,
+                         headers=self.headers("PUT", url, payload=data),
+                         timeout=600)
+        r.raise_for_status()
+        return ObjectInfo(
+            key=key.lstrip("/"), size=len(data), mtime=time.time(),
+            etag=r.headers.get(
+                "ETag", hashlib.md5(data).hexdigest()).strip('"'))
+
+    def put_stream(self, key: str, reader, total: int) -> int:
+        """Streamed PUT of `total` bytes from a file-like `reader`
+        (exposing read(n)); signs with UNSIGNED-PAYLOAD so the body
+        isn't hashed/buffered up front. A __len__ wrapper gives
+        requests a Content-Length (S3 rejects chunked encoding without
+        the STREAMING-* signing scheme)."""
+        import requests
+
+        class _Body:
+            def __init__(self):
+                self.left = total
+
+            def __len__(self):
+                return self.left
+
+            def read(self, n: int = -1) -> bytes:
+                if self.left <= 0:
+                    return b""
+                want = self.left if n is None or n < 0 \
+                    else min(n, self.left)
+                blob = reader.read(want)
+                self.left -= len(blob)
+                return blob
+
+        url = self.url(key)
+        r = requests.put(
+            url, data=_Body(),
+            headers=self.headers("PUT", url, unsigned_payload=True),
+            timeout=3600)
+        r.raise_for_status()
+        return total
+
+    def head_object(self, key: str) -> ObjectInfo | None:
+        import requests
+        url = self.url(key)
+        r = requests.head(url, headers=self.headers("HEAD", url),
+                          timeout=60)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return ObjectInfo(
+            key=key.lstrip("/"),
+            size=int(r.headers.get("Content-Length", 0)),
+            etag=r.headers.get("ETag", "").strip('"'))
+
+    def delete_object(self, key: str) -> None:
+        import requests
+        url = self.url(key)
+        requests.delete(url, headers=self.headers("DELETE", url),
+                        timeout=300)
+
+    def download_to(self, key: str, dest_path: str) -> int:
+        import requests
+        url = self.url(key)
+        r = requests.get(url, headers=self.headers("GET", url),
+                         stream=True, timeout=3600)
+        r.raise_for_status()
+        n = 0
+        with open(dest_path, "wb") as out:
+            for blob in r.iter_content(4 << 20):
+                out.write(blob)
+                n += len(blob)
+        return n
+
+    # -- listing --------------------------------------------------------
+    def list_objects(self, prefix: str = "") -> Iterator[ObjectInfo]:
+        """ListObjectsV2 with continuation-token paging."""
+        import requests
+        token = ""
+        while True:
+            q = "list-type=2&max-keys=1000"
+            if prefix:
+                q += f"&prefix={quote(prefix.lstrip('/'), safe='~._-')}"
+            if token:
+                q += "&continuation-token=" + \
+                    quote(token, safe="~._-")
+            url = self.url(query=q)
+            r = requests.get(url, headers=self.headers("GET", url),
+                             timeout=300)
+            r.raise_for_status()
+            root = ET.fromstring(r.text)
+            for c in root.iter(f"{_NS}Contents"):
+                yield ObjectInfo(
+                    key=c.find(f"{_NS}Key").text,
+                    size=int(c.find(f"{_NS}Size").text or 0),
+                    mtime=_parse_iso(
+                        c.findtext(f"{_NS}LastModified") or ""),
+                    etag=(c.findtext(f"{_NS}ETag") or "").strip('"'))
+            if (root.findtext(f"{_NS}IsTruncated") or "") != "true":
+                return
+            token = root.findtext(f"{_NS}NextContinuationToken") or ""
+            if not token:
+                return

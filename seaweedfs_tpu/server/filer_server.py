@@ -234,6 +234,51 @@ class FilerServer:
                 pass  # orphans are reclaimed by volume.fsck / vacuum
 
     # -- read path ------------------------------------------------------
+    # -- remote storage (weed/filer/remote_storage.go) ------------------
+    _REMOTE_CONF_TTL = 2.0  # backstop for conf edits via another filer
+
+    def _remote_conf(self):
+        """Cached remote conf: invalidated on local KV writes of the
+        conf key, TTL-refreshed otherwise — read-through GETs must not
+        pay a store read + JSON parse per request."""
+        from ..remote_storage import RemoteConf
+        from ..remote_storage.mount import CONF_KEY
+        cached = getattr(self, "_remote_conf_cache", None)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < self._REMOTE_CONF_TTL:
+            return cached[0]
+        raw = self.filer.store.kv_get(CONF_KEY)
+        conf = RemoteConf.from_json(raw) if raw else RemoteConf()
+        self._remote_conf_cache = (conf, now)
+        return conf
+
+    def _invalidate_remote_conf(self, key: str) -> None:
+        from ..remote_storage.mount import CONF_KEY
+        if key == CONF_KEY:
+            self._remote_conf_cache = None
+            self._remote_clients = {}
+
+    def _remote_client_for(self, path: str):
+        """-> (client, object key) for a path under a remote mount, or
+        None when the path isn't mounted. Clients are memoized per
+        storage name."""
+        from ..remote_storage import (find_mount, make_client,
+                                      remote_key_for)
+        conf = self._remote_conf()
+        mount = find_mount(conf, path)
+        if mount is None:
+            return None
+        storage = conf.storages.get(mount.storage)
+        if storage is None:
+            return None
+        clients = getattr(self, "_remote_clients", None)
+        if clients is None:
+            clients = self._remote_clients = {}
+        ck = (mount.storage, json.dumps(storage, sort_keys=True))
+        if ck not in clients:
+            clients[ck] = make_client(storage)
+        return clients[ck], remote_key_for(mount, path)
+
     async def handle_get(self, req: web.Request) -> web.StreamResponse:
         path = norm_path("/" + req.match_info["path"])
         entry = self.filer.find_entry(path)
@@ -244,8 +289,16 @@ class FilerServer:
             return web.json_response(entry.to_dict())  # entries have
         if entry.is_directory:                         # metadata too
             return await self._list_dir(req, path)
-        size = entry.file_size
-        etag = entry.md5 or etag_chunks(entry.chunks)
+        # uncached remote entry: metadata only, bytes still in the
+        # cloud — read through (filer_server_handlers_read.go remote
+        # read; cache explicitly via remote.cache)
+        remote_meta = None
+        if not entry.chunks and entry.extended.get("remote"):
+            remote_meta = json.loads(entry.extended["remote"])
+        size = int(remote_meta["size"]) if remote_meta \
+            else entry.file_size
+        etag = entry.md5 or (remote_meta or {}).get("etag") \
+            or etag_chunks(entry.chunks)
         mime = (entry.mime or mimetypes.guess_type(path)[0]
                 or "application/octet-stream")
         headers = {"ETag": f'"{etag}"', "Accept-Ranges": "bytes",
@@ -275,6 +328,17 @@ class FilerServer:
             headers["Content-Length"] = str(length)
             return web.Response(status=status, headers=headers,
                                 content_type=mime)
+        if remote_meta is not None:
+            found = self._remote_client_for(path)
+            if found is None:
+                return web.json_response(
+                    {"error": f"{path} is remote but its mount/storage "
+                              "is no longer configured"}, status=502)
+            client, _ = found
+            data = await asyncio.to_thread(
+                client.read_file, remote_meta["key"], offset, length)
+            return web.Response(body=data, status=status,
+                                headers=headers, content_type=mime)
         data = await asyncio.to_thread(
             stream_content, self._lookup_fid, entry.chunks, offset, length)
         metrics.counter_add("filer_read_bytes", len(data))
@@ -306,6 +370,10 @@ class FilerServer:
             self.filer.rename(req.query["mv.from"], path,
                               signatures=signatures)
             return web.json_response({"path": path})
+        if "cacheRemote" in req.query:
+            return await self._cache_remote(path, signatures)
+        if "uncacheRemote" in req.query:
+            return await self._uncache_remote(path, signatures)
         if "meta" in req.query:
             # raw entry create: body is an Entry dict whose chunks point
             # at already-uploaded fids (filer_pb CreateEntry — how the
@@ -387,6 +455,69 @@ class FilerServer:
             {"name": filename, "size": total,
              "etag": entry.md5}, status=201)
 
+    async def _cache_remote(self, path: str,
+                            signatures: list[int]) -> web.Response:
+        """Pull a remote entry's bytes into cluster chunks
+        (CacheRemoteObjectToLocalCluster,
+        filer_grpc_server_remote.go): afterwards reads are local; the
+        remote metadata stays so uncache can drop the copy again."""
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return web.json_response({"error": f"no file at {path}"},
+                                     status=404)
+        if not entry.extended.get("remote"):
+            return web.json_response(
+                {"error": f"{path} is not a remote entry"}, status=400)
+        if entry.chunks:  # already cached
+            return web.json_response(entry.to_dict())
+        meta = json.loads(entry.extended["remote"])
+        found = self._remote_client_for(path)
+        if found is None:
+            return web.json_response(
+                {"error": "mount/storage no longer configured"},
+                status=502)
+        client, _ = found
+        name = path.rsplit("/", 1)[-1]
+        chunks, offset = [], 0
+        size = int(meta["size"])
+        while offset < size:  # empty files need no chunks
+            want = min(self.chunk_size, size - offset)
+            piece = await asyncio.to_thread(
+                client.read_file, meta["key"], offset, want)
+            if not piece:
+                return web.json_response(
+                    {"error": f"remote object {meta['key']} ended at "
+                              f"{offset}, expected {size} bytes"},
+                    status=502)
+            fid, etag = await asyncio.to_thread(
+                self._upload_chunk, piece, name, entry.collection,
+                entry.replication, "")
+            chunks.append(FileChunk(fid=fid, offset=offset,
+                                    size=len(piece),
+                                    mtime_ns=time.time_ns(), etag=etag))
+            offset += len(piece)
+        entry.chunks = chunks
+        self.filer.create_entry(entry, signatures=signatures)
+        return web.json_response(entry.to_dict())
+
+    async def _uncache_remote(self, path: str,
+                              signatures: list[int]) -> web.Response:
+        """Drop the local chunk copy of a cached remote entry, leaving
+        metadata that reads through to the cloud again
+        (shell command_remote_uncache.go)."""
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return web.json_response({"error": f"no file at {path}"},
+                                     status=404)
+        if not entry.extended.get("remote"):
+            return web.json_response(
+                {"error": f"{path} is not a remote entry"}, status=400)
+        dead = entry.chunks
+        entry.chunks = []
+        self.filer.create_entry(entry, signatures=signatures)
+        await asyncio.to_thread(self._delete_chunks, dead)
+        return web.json_response(entry.to_dict())
+
     def _upload_chunk(self, data: bytes, name: str, collection: str,
                       replication: str, ttl: str) -> tuple[str, str]:
         a = verbs.assign(self.master_url, collection=collection,
@@ -413,7 +544,9 @@ class FilerServer:
         return web.Response(body=v)
 
     async def handle_kv_put(self, req: web.Request) -> web.Response:
-        self.filer.store.kv_put(req.match_info["key"], await req.read())
+        key = req.match_info["key"]
+        self.filer.store.kv_put(key, await req.read())
+        self._invalidate_remote_conf(key)
         return web.json_response({})
 
     async def handle_kv_delete(self, req: web.Request) -> web.Response:

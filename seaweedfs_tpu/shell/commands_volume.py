@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from collections import defaultdict
 
+import requests
+
 from ..storage.super_block import ReplicaPlacement
 from .env import CommandEnv, ShellError
 
@@ -394,12 +396,25 @@ def volume_tier_upload(env: CommandEnv, vid: int,
     urls = env.volume_locations(vid)
     if not urls:
         raise ShellError(f"volume {vid} not found")
+    # remember which replicas were writable so a failed upload can
+    # restore them instead of leaving the volume wedged read-only
+    was_writable = []
+    for url in urls:
+        info = requests.get(f"http://{url}/admin/volume_info",
+                            params={"volume": vid}, timeout=60).json()
+        if not info.get("read_only"):
+            was_writable.append(url)
     for url in urls:
         env.vs_post(url, "/admin/mark_readonly", {"volume": vid})
     # upload the bytes once, from the first replica; the others just
     # adopt the uploaded object into their .vif
-    first = env.vs_post(urls[0], "/admin/tier_upload", {
-        "volume": vid, "dest": dest, "keepLocalDatFile": keep_local})
+    try:
+        first = env.vs_post(urls[0], "/admin/tier_upload", {
+            "volume": vid, "dest": dest, "keepLocalDatFile": keep_local})
+    except Exception:
+        for url in was_writable:
+            env.vs_post(url, "/admin/mark_writable", {"volume": vid})
+        raise
     out = [first]
     adopt = {"backend_type": first["backend_type"],
              "backend_id": first["backend_id"], "key": first["key"],

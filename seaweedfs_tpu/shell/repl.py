@@ -11,7 +11,8 @@ from __future__ import annotations
 import json
 import shlex
 
-from . import commands_cluster, commands_ec, commands_fs, commands_volume
+from . import (commands_cluster, commands_ec, commands_fs,
+               commands_remote, commands_volume)
 from .env import CommandEnv, ShellError
 
 HELP = """commands:
@@ -50,6 +51,12 @@ HELP = """commands:
   fs.meta.save <dir> <out.jsonl>    snapshot metadata
   fs.meta.load <in.jsonl>           restore metadata
   fs.verify <dir>                   check chunks are readable
+  remote.configure [-name=X -type=s3|local ...] [-delete]
+  remote.mount [-dir=/d -remote=storage/prefix]
+  remote.unmount -dir=/d
+  remote.meta.sync -dir=/d          pull remote listing into metadata
+  remote.cache -dir=/d              materialise remote files locally
+  remote.uncache -dir=/d            drop local copies, keep metadata
   help / exit
 """
 
@@ -178,6 +185,23 @@ def run_command(env: CommandEnv, line: str) -> object:
         return f"loaded {n} entries"
     if cmd == "fs.verify":
         return commands_fs.fs_verify(env, arg(0, "/"))
+    # -- remote storage -------------------------------------------------
+    if cmd == "remote.configure":
+        conf = {k: v for k, v in opts.items()
+                if k not in ("name", "delete")}
+        return commands_remote.remote_configure(
+            env, opts.get("name", ""), delete="delete" in opts, **conf)
+    if cmd == "remote.mount":
+        return commands_remote.remote_mount(
+            env, opts.get("dir", ""), opts.get("remote", ""))
+    if cmd == "remote.unmount":
+        return commands_remote.remote_unmount(env, opts["dir"])
+    if cmd == "remote.meta.sync":
+        return commands_remote.remote_meta_sync(env, opts["dir"])
+    if cmd == "remote.cache":
+        return commands_remote.remote_cache(env, opts["dir"])
+    if cmd == "remote.uncache":
+        return commands_remote.remote_uncache(env, opts["dir"])
     if cmd == "help":
         return HELP
     raise ShellError(f"unknown command {cmd!r} (try `help`)")

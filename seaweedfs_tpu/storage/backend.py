@@ -164,22 +164,14 @@ class S3BackendStorage:
     """One configured S3-compatible tier destination
     (backend/s3_backend/s3_backend.go S3BackendStorage): uploads a
     volume's .dat as one object, serves ranged reads back, deletes on
-    un-tier. With empty access_key requests go unsigned (anonymous),
-    which is how the in-process gateway is used in tests."""
+    un-tier. HTTP mechanics live in the shared s3.client.S3Client."""
 
-    def __init__(self, id: str = "default", endpoint: str = "",
-                 bucket: str = "", access_key: str = "",
-                 secret_key: str = "", region: str = "us-east-1",
-                 prefix: str = "", **_):
-        if not endpoint or not bucket:
-            raise ValueError("s3 backend needs endpoint and bucket")
+    def __init__(self, id: str = "default", prefix: str = "", **conf):
+        from ..s3.client import S3Client
         self.id = id
-        self.endpoint = endpoint.rstrip("/")
-        self.bucket = bucket
-        self.access_key = access_key
-        self.secret_key = secret_key
-        self.region = region
         self.prefix = prefix.strip("/")
+        self._c = S3Client(**conf)
+        self.bucket = self._c.bucket
 
     @property
     def name(self) -> str:
@@ -189,90 +181,35 @@ class S3BackendStorage:
         base = os.path.basename(filename)
         return f"{self.prefix}/{base}" if self.prefix else base
 
-    def _url(self, key: str) -> str:
-        return f"{self.endpoint}/{self.bucket}/{key}"
-
-    def _headers(self, method: str, key: str, payload: bytes = b"",
-                 extra: dict | None = None,
-                 unsigned_payload: bool = False) -> dict:
-        headers = dict(extra or {})
-        if self.access_key:
-            from ..s3.sigv4_client import sign_headers
-            headers.update(sign_headers(
-                method, self._url(key), self.access_key, self.secret_key,
-                payload=payload, region=self.region,
-                unsigned_payload=unsigned_payload))
-        return headers
-
     def upload_file(self, f: StorageFile, key: str,
                     chunk: int = 64 << 20) -> int:
-        """Stream the .dat into the bucket; returns bytes uploaded.
-        (The reference multipart-uploads via s3manager; one streamed
-        PUT with a known Content-Length keeps the dependency surface to
-        the HTTP client we already have.) Large bodies are signed with
-        UNSIGNED-PAYLOAD so the stream doesn't have to be hashed (or
-        buffered) up front."""
-        import requests
+        """Move the .dat into the bucket; small files in one signed
+        PUT, larger ones as a streamed PUT (the reference
+        multipart-uploads via s3manager)."""
         total = f.size()
         if total <= chunk:
-            payload = f.read_at(total, 0)
-            r = requests.put(self._url(key), data=payload,
-                             headers=self._headers("PUT", key, payload),
-                             timeout=600)
-            r.raise_for_status()
+            self._c.put_object(key, f.read_at(total, 0))
             return total
 
-        class _Reader:
-            """File-like with __len__ so requests sends Content-Length
-            (S3 rejects chunked transfer-encoding without the
-            STREAMING-* signing scheme)."""
+        class _R:
+            off = 0
 
-            def __init__(self):
-                self.off = 0
-
-            def __len__(self):
-                return total - self.off
-
-            def read(self, n: int = -1) -> bytes:
-                if self.off >= total:
-                    return b""
-                want = total - self.off if n is None or n < 0 \
-                    else min(n, total - self.off, chunk)
-                blob = f.read_at(want, self.off)
+            def read(self, n: int) -> bytes:
+                blob = f.read_at(min(n, chunk), self.off)
                 self.off += len(blob)
                 return blob
 
-        r = requests.put(
-            self._url(key), data=_Reader(),
-            headers=self._headers("PUT", key, unsigned_payload=True),
-            timeout=3600)
-        r.raise_for_status()
-        return total
+        return self._c.put_stream(key, _R(), total)
 
     def get_range(self, key: str, start: int, end: int) -> bytes:
-        import requests
-        h = self._headers("GET", key)
-        h["Range"] = f"bytes={start}-{end}"
-        r = requests.get(self._url(key), headers=h, timeout=300)
-        r.raise_for_status()
-        return r.content
+        return self._c.get_object(key, offset=start,
+                                  size=end - start + 1)
 
     def download_to(self, key: str, dest_path: str) -> int:
-        import requests
-        r = requests.get(self._url(key), headers=self._headers("GET", key),
-                         stream=True, timeout=3600)
-        r.raise_for_status()
-        n = 0
-        with open(dest_path, "wb") as out:
-            for blob in r.iter_content(4 << 20):
-                out.write(blob)
-                n += len(blob)
-        return n
+        return self._c.download_to(key, dest_path)
 
     def delete(self, key: str) -> None:
-        import requests
-        requests.delete(self._url(key),
-                        headers=self._headers("DELETE", key), timeout=300)
+        self._c.delete_object(key)
 
     def open_file(self, key: str, size: int) -> S3RangeFile:
         return S3RangeFile(self, key, size)

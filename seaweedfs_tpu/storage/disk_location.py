@@ -57,15 +57,25 @@ class DiskLocation:
         self.disk_type = disk_type
         self.volumes: dict[int, Volume] = {}
         self.ec_shards: dict[int, EcShardSet] = {}
+        self.load_errors: list[tuple[int, str]] = []
         os.makedirs(dirname, exist_ok=True)
 
     def load_existing(self) -> None:
+        """Scan the dir; one unloadable volume (e.g. a tiered .vif whose
+        backend storage isn't configured on this process yet) must not
+        abort the whole location — it is recorded in `load_errors` and
+        skipped, like the reference logging and continuing per volume
+        (disk_location.go concurrentLoadingVolumes)."""
+        self.load_errors: list[tuple[int, str]] = []
         for name in sorted(os.listdir(self.dir)):
             v = parse_volume_filename(name)
             if v is not None:
                 col, vid = v
                 if vid not in self.volumes:
-                    self.volumes[vid] = Volume(self.dir, col, vid)
+                    try:
+                        self.volumes[vid] = Volume(self.dir, col, vid)
+                    except Exception as e:
+                        self.load_errors.append((vid, f"{type(e).__name__}: {e}"))
                 continue
             e = parse_ec_filename(name)
             if e is not None:
