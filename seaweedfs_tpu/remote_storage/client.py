@@ -68,7 +68,7 @@ class LocalRemoteClient(RemoteStorageClient):
 
     def _abs(self, key: str) -> str:
         p = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
-        if not p.startswith(self.root):
+        if p != self.root and not p.startswith(self.root + os.sep):
             raise PermissionError(f"key escapes storage root: {key}")
         return p
 

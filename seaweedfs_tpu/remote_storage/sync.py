@@ -175,8 +175,21 @@ class RemoteSyncWorker:
 
     def _refresh_remote_meta(self, entry: Entry, re_) -> None:
         """Write the entry's remote metadata back (sets etag == md5 so
-        the resulting event is recognised as ours and skipped)."""
-        ent = entry.to_dict()
+        the resulting event is recognised as ours and skipped).
+
+        The event's entry snapshot may be stale by the time we run —
+        posting it back verbatim would revert a concurrent newer write
+        (and delete its chunks). Re-fetch the live entry and only attach
+        the remote metadata if it is still the version we pushed."""
+        r = requests.get(f"{self.filer}{entry.full_path}",
+                         params={"meta": "1"}, timeout=60)
+        if r.status_code == 404:
+            return  # deleted meanwhile; the delete event will mirror it
+        r.raise_for_status()
+        live = r.json()
+        if entry.md5 and live.get("md5") and live["md5"] != entry.md5:
+            return  # newer write in flight; its own event handles it
+        ent = live
         ent.setdefault("extended", {})["remote"] = json.dumps(
             {"key": re_.key, "size": re_.size, "mtime": re_.mtime,
              "etag": entry.md5 or re_.etag})

@@ -146,8 +146,10 @@ class S3Client:
     def delete_object(self, key: str) -> None:
         import requests
         url = self.url(key)
-        requests.delete(url, headers=self.headers("DELETE", url),
-                        timeout=300)
+        r = requests.delete(url, headers=self.headers("DELETE", url),
+                            timeout=300)
+        if r.status_code >= 300 and r.status_code != 404:
+            r.raise_for_status()
 
     def download_to(self, key: str, dest_path: str) -> int:
         import requests

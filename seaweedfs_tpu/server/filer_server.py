@@ -233,6 +233,20 @@ class FilerServer:
             except Exception:
                 pass  # orphans are reclaimed by volume.fsck / vacuum
 
+    # -- per-path storage rules (weed/filer/filer_conf.go) --------------
+    _FILER_CONF_TTL = 2.0  # backstop for edits via another filer
+
+    def _filer_conf(self):
+        from ..filer.filer_conf import CONF_KEY, FilerConf
+        cached = getattr(self, "_filer_conf_cache", None)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < self._FILER_CONF_TTL:
+            return cached[0]
+        raw = self.filer.store.kv_get(CONF_KEY)
+        conf = FilerConf.from_json(raw) if raw else FilerConf()
+        self._filer_conf_cache = (conf, now)
+        return conf
+
     # -- read path ------------------------------------------------------
     # -- remote storage (weed/filer/remote_storage.go) ------------------
     _REMOTE_CONF_TTL = 2.0  # backstop for conf edits via another filer
@@ -257,6 +271,9 @@ class FilerServer:
         if key == CONF_KEY:
             self._remote_conf_cache = None
             self._remote_clients = {}
+        from ..filer.filer_conf import CONF_KEY as FILER_CONF_KEY
+        if key == FILER_CONF_KEY:
+            self._filer_conf_cache = None
 
     def _remote_client_for(self, path: str):
         """-> (client, object key) for a path under a remote mount, or
@@ -366,6 +383,23 @@ class FilerServer:
         # filers that already saw the event (loop prevention,
         # command/filer_sync.go)
         signatures = _parse_signatures(req.query.get("signatures", ""))
+        # per-path rules: checked before every mutating verb so raw-meta
+        # creates (S3 stitching), renames and mkdir can't bypass them;
+        # remote cache/uncache are exempt — they move bytes, not content
+        # (detectStorageOption, filer_server_handlers_write.go:219)
+        rule = self._filer_conf().match(path)
+        if rule.read_only and "cacheRemote" not in req.query \
+                and "uncacheRemote" not in req.query:
+            return web.json_response(
+                {"error": f"{rule.location_prefix or path} is read-only "
+                          "by filer.conf rule"}, status=403)
+        name_len = len(path.rsplit("/", 1)[-1])
+        if rule.max_file_name_length and name_len > \
+                rule.max_file_name_length:
+            return web.json_response(
+                {"error": f"file name longer than the "
+                          f"{rule.max_file_name_length}-byte limit set "
+                          "by filer.conf"}, status=400)
         if "mv.from" in req.query:  # rename verb, reference-compatible
             self.filer.rename(req.query["mv.from"], path,
                               signatures=signatures)
@@ -394,9 +428,11 @@ class FilerServer:
             e = self.filer.mkdir(path, signatures=signatures)
             return web.json_response(e.to_dict(), status=201)
 
-        collection = req.query.get("collection", self.collection)
-        replication = req.query.get("replication", self.replication)
-        ttl = req.query.get("ttl", "")
+        collection = req.query.get("collection", "") or rule.collection \
+            or self.collection
+        replication = req.query.get("replication", "") \
+            or rule.replication or self.replication
+        ttl = req.query.get("ttl", "") or rule.ttl
         chunk_size = int(req.query.get("maxMB", "0")) << 20 or \
             self.chunk_size
 
@@ -527,6 +563,10 @@ class FilerServer:
 
     async def handle_delete(self, req: web.Request) -> web.Response:
         path = norm_path("/" + req.match_info["path"])
+        if self._filer_conf().match(path).read_only:
+            return web.json_response(
+                {"error": f"{path} is read-only by filer.conf rule"},
+                status=403)
         recursive = req.query.get("recursive", "") in ("true", "1")
         delete_chunks = req.query.get("skipChunkDeletion", "") \
             not in ("true", "1")

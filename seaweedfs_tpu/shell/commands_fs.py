@@ -198,3 +198,42 @@ def fs_verify(env: CommandEnv, path: str = "/") -> list[dict]:
                 broken.append({"path": e["full_path"], "fid": fid})
                 break
     return broken
+
+
+def fs_configure(env: CommandEnv, location_prefix: str = "",
+                 delete: bool = False, apply: bool = False,
+                 **fields) -> dict:
+    """Show or edit the per-path storage rules in `filer.conf`
+    (command_fs_configure.go). With no -locationPrefix just prints the
+    current rules; with one, stages a rule change and only persists it
+    when -apply is given (the reference's dry-run-by-default semantics).
+    """
+    from ..filer.filer_conf import CONF_KEY, FilerConf, PathConf
+
+    resp = requests.get(f"{_filer(env)}/kv/{CONF_KEY}", timeout=60)
+    conf = FilerConf.from_json(resp.content) \
+        if resp.status_code == 200 else FilerConf()
+    if not location_prefix:
+        return json.loads(conf.to_json())
+    if delete:
+        if not conf.delete_rule(location_prefix):
+            raise ShellError(f"no rule for {location_prefix}")
+    else:
+        rule = PathConf(location_prefix=location_prefix,
+                        collection=fields.get("collection", ""),
+                        replication=fields.get("replication", ""),
+                        ttl=fields.get("ttl", ""),
+                        disk_type=fields.get("diskType", ""),
+                        fsync=fields.get("fsync", "") == "true",
+                        read_only=fields.get("readOnly", "") == "true",
+                        max_file_name_length=int(
+                            fields.get("maxFileNameLength", "0")))
+        conf.set_rule(rule)
+    if apply:
+        r = requests.put(f"{_filer(env)}/kv/{CONF_KEY}",
+                         data=conf.to_json().encode(), timeout=60)
+        if r.status_code >= 300:
+            raise ShellError(f"fs.configure: {r.text}")
+    out = json.loads(conf.to_json())
+    out["applied"] = apply
+    return out

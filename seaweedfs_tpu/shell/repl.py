@@ -51,6 +51,8 @@ HELP = """commands:
   fs.meta.save <dir> <out.jsonl>    snapshot metadata
   fs.meta.load <in.jsonl>           restore metadata
   fs.verify <dir>                   check chunks are readable
+  fs.configure [-locationPrefix=/p -collection=C -ttl=1d -readOnly=true
+                -replication=001 -maxFileNameLength=N -delete -apply]
   remote.configure [-name=X -type=s3|local ...] [-delete]
   remote.mount [-dir=/d -remote=storage/prefix]
   remote.unmount -dir=/d
@@ -185,6 +187,11 @@ def run_command(env: CommandEnv, line: str) -> object:
         return f"loaded {n} entries"
     if cmd == "fs.verify":
         return commands_fs.fs_verify(env, arg(0, "/"))
+    if cmd == "fs.configure":
+        return commands_fs.fs_configure(
+            env, opts.pop("locationPrefix", ""),
+            delete=opts.pop("delete", "") == "true",
+            apply=opts.pop("apply", "") == "true", **opts)
     # -- remote storage -------------------------------------------------
     if cmd == "remote.configure":
         conf = {k: v for k, v in opts.items()
