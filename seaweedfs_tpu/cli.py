@@ -134,6 +134,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", dest="output", default="")
     p.add_argument("fid")
 
+    p = sub.add_parser("backup", help="incrementally back up a volume "
+                                      "to a local directory")
+    p.add_argument("-server", "-master", dest="master",
+                   default="http://127.0.0.1:9333")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-dir", default=".")
+    p.add_argument("-collection", default="")
+
     p = sub.add_parser("benchmark", help="write/read load generator")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-n", type=int, default=1000)
@@ -152,6 +160,14 @@ def _dispatch(args) -> int:
         from . import __version__
 
         print(f"seaweedfs-tpu {__version__}")
+        return 0
+    if args.cmd == "backup":
+        import json as _json
+
+        from .operation.backup import backup_volume
+        out = backup_volume(args.master, args.volume_id, args.dir,
+                            collection=args.collection)
+        print(_json.dumps(out))
         return 0
     if args.cmd == "master":
         return _run_master(args)

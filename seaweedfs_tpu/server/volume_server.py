@@ -107,6 +107,13 @@ class VolumeServer:
             web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
             web.get("/admin/copy_file", self.handle_copy_file),
+            web.get("/admin/volume_sync_status",
+                    self.handle_volume_sync_status),
+            web.get("/admin/volume_incremental_copy",
+                    self.handle_volume_incremental_copy),
+            web.get("/admin/volume_tail", self.handle_volume_tail),
+            web.post("/admin/volume_tail_receive",
+                     self.handle_volume_tail_receive),
             web.get("/admin/volume_info", self.handle_volume_info),
             web.route("*", "/{fid:[0-9]+,[0-9a-fA-F]+}", self.handle_fid),
         ])
@@ -717,6 +724,122 @@ class VolumeServer:
         return web.Response(body=data,
                             content_type="application/octet-stream")
 
+    # -- incremental sync / tail (volume_backup.go, volume_grpc_tail.go)
+    async def handle_volume_sync_status(self, req: web.Request) \
+            -> web.Response:
+        """VolumeSyncStatus rpc: tail offset + compact revision +
+        last append stamp, the negotiation for incremental copy."""
+        v = self.store.find_volume(int(req.query["volume"]))
+        if v is None:
+            return web.Response(status=404, text="volume not found")
+        await asyncio.to_thread(v.sync)
+        return web.json_response(v.sync_status())
+
+    async def handle_volume_incremental_copy(self, req: web.Request) \
+            -> web.StreamResponse:
+        """VolumeIncrementalCopy rpc: stream raw .dat records appended
+        strictly after since_ns."""
+        v = self.store.find_volume(int(req.query["volume"]))
+        if v is None:
+            return web.Response(status=404, text="volume not found")
+        since_ns = int(req.query.get("since_ns", "0"))
+        await asyncio.to_thread(v.sync)
+        offset = await asyncio.to_thread(
+            v.offset_for_append_at_ns, since_ns)
+        end = v.dat.size()
+        resp = web.StreamResponse()
+        resp.content_length = end - offset
+        await resp.prepare(req)
+        while offset < end:
+            # cap at the captured end: concurrent appends must not
+            # push the body past the declared content length, and a
+            # concurrent compact (file swap) must abort, not mis-frame
+            chunk = await asyncio.to_thread(
+                v.read_segment, offset, min(1 << 20, end - offset))
+            if not chunk:
+                raise ConnectionResetError(
+                    f"volume {v.vid} changed under incremental copy")
+            await resp.write(chunk)
+            offset += len(chunk)
+        await resp.write_eof()
+        return resp
+
+    async def handle_volume_tail(self, req: web.Request) \
+            -> web.StreamResponse:
+        """VolumeTailSender rpc: stream records after since_ns and keep
+        following new appends until idle for idle_timeout seconds."""
+        v = self.store.find_volume(int(req.query["volume"]))
+        if v is None:
+            return web.Response(status=404, text="volume not found")
+        since_ns = int(req.query.get("since_ns", "0"))
+        idle_timeout = float(req.query.get("idle_timeout", "3"))
+        offset = await asyncio.to_thread(
+            v.offset_for_append_at_ns, since_ns)
+        resp = web.StreamResponse()
+        await resp.prepare(req)
+        idle = 0.0
+        while idle < idle_timeout:
+            await asyncio.to_thread(v.sync)
+            end = v.dat.size()
+            if end < offset:
+                break  # compact/truncate rewrote history: end the tail
+            if offset < end:
+                idle = 0.0
+                while offset < end:
+                    chunk = await asyncio.to_thread(
+                        v.read_segment, offset,
+                        min(1 << 20, end - offset))
+                    if not chunk:
+                        return resp  # volume swapped mid-read
+                    await resp.write(chunk)
+                    offset += len(chunk)
+            else:
+                await asyncio.sleep(0.1)
+                idle += 0.1
+        await resp.write_eof()
+        return resp
+
+    async def handle_volume_tail_receive(self, req: web.Request) \
+            -> web.Response:
+        """VolumeTailReceiver rpc: follow another server's tail stream
+        and append its records into the local replica."""
+        body = await req.json()
+        vid = int(body["volume"])
+        source = body["source"]
+        v = self.store.find_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        since_ns = int(body.get("since_ns", v.last_append_at_ns))
+        idle_timeout = float(body.get("idle_timeout", 3))
+        applied = 0
+        buf = bytearray()
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                    f"http://{source}/admin/volume_tail",
+                    params={"volume": vid, "since_ns": since_ns,
+                            "idle_timeout": idle_timeout},
+                    timeout=aiohttp.ClientTimeout(total=None)) as resp:
+                if resp.status != 200:
+                    return web.json_response(
+                        {"error": f"tail from {source}: {resp.status}"},
+                        status=502)
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    buf.extend(chunk)
+                    whole = _whole_records_prefix(buf, v.version)
+                    if whole:
+                        applied += await asyncio.to_thread(
+                            v.append_raw_segment,
+                            bytes(memoryview(buf)[:whole]))
+                        del buf[:whole]
+        if buf:
+            return web.json_response(
+                {"error": f"tail stream ended mid-record "
+                          f"({len(buf)} trailing bytes)",
+                 "applied": applied}, status=502)
+        self.poke_heartbeat()
+        return web.json_response({"applied": applied})
+
     async def handle_copy_file(self, req: web.Request) -> web.StreamResponse:
         """CopyFile rpc (volume_grpc_copy.go): stream any volume/shard
         file by extension."""
@@ -788,3 +911,19 @@ class VolumeServer:
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
+
+
+def _whole_records_prefix(data, version: int) -> int:
+    """Length of the longest prefix of `data` that is whole needle
+    records (a tail stream has no framing; records self-describe)."""
+    import struct
+
+    off = 0
+    while off + t.NEEDLE_HEADER_SIZE <= len(data):
+        _, _, size_u32 = struct.unpack_from(">IQI", data, off)
+        nsize = max(t.u32_to_size(size_u32), 0)
+        disk = ndl.disk_size(nsize, version)
+        if off + disk > len(data):
+            break
+        off += disk
+    return off

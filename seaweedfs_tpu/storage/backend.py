@@ -9,6 +9,7 @@ engine.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Protocol
 
 
@@ -33,45 +34,60 @@ class DiskFile:
             raise FileNotFoundError(path)
         self._f = open(path, mode)
         self._path = path
+        # one lock per file: streaming readers (tail/incremental copy/
+        # plain GETs) run in worker threads concurrently with appends;
+        # an unguarded seek+write pair could land a record at a reader's
+        # offset and destroy live data. Reads use pread so they never
+        # move the shared file position.
+        self._lock = threading.RLock()
 
     @property
     def name(self) -> str:
         return self._path
 
     def read_at(self, size: int, offset: int) -> bytes:
-        self._f.seek(offset)
-        return self._f.read(size)
+        with self._lock:
+            self._f.flush()
+            return os.pread(self._f.fileno(), size, offset)
 
     def write_at(self, data: bytes, offset: int) -> int:
-        self._f.seek(offset)
-        return self._f.write(data)
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.write(data)
 
     def append(self, data: bytes) -> int:
-        self._f.seek(0, os.SEEK_END)
-        offset = self._f.tell()
-        self._f.write(data)
-        return offset
+        with self._lock:
+            self._f.seek(0, os.SEEK_END)
+            offset = self._f.tell()
+            self._f.write(data)
+            return offset
 
     def truncate(self, size: int) -> None:
-        self._f.truncate(size)
+        with self._lock:
+            self._f.flush()
+            self._f.truncate(size)
 
     def size(self) -> int:
-        self._f.flush()
-        return os.fstat(self._f.fileno()).st_size
+        with self._lock:
+            self._f.flush()
+            return os.fstat(self._f.fileno()).st_size
 
     def flush(self) -> None:
         """Userspace buffer -> OS (no fsync)."""
-        self._f.flush()
+        with self._lock:
+            self._f.flush()
 
     def sync(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def close(self) -> None:
-        try:
-            self._f.flush()
-        finally:
-            self._f.close()
+        with self._lock:
+            try:
+                self._f.flush()
+            finally:
+                self._f.close()
 
 
 class MemoryFile:

@@ -68,6 +68,7 @@ class Volume:
         self.last_append_at_ns = 0
         if exists:
             self.check_integrity()
+            self.last_append_at_ns = self._recover_last_append_at_ns()
 
     # -- naming --------------------------------------------------------
     def file_name(self) -> str:
@@ -87,7 +88,10 @@ class Volume:
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
         if not n.append_at_ns:
-            n.append_at_ns = max(time.monotonic_ns(),
+            # wall clock, not monotonic: append_at_ns orders records
+            # ACROSS restarts for incremental sync (volume_backup.go);
+            # the max() guard keeps it strictly increasing regardless
+            n.append_at_ns = max(time.time_ns(),
                                  self.last_append_at_ns + 1)
         self.last_append_at_ns = n.append_at_ns
         blob = n.to_bytes(self.version)
@@ -115,7 +119,7 @@ class Volume:
         if existing is None:
             return 0
         tomb = ndl.Needle(id=needle_id)
-        tomb.append_at_ns = max(time.monotonic_ns(),
+        tomb.append_at_ns = max(time.time_ns(),
                                 self.last_append_at_ns + 1)
         self.last_append_at_ns = tomb.append_at_ns
         self.dat.append(tomb.to_bytes(self.version))
@@ -222,6 +226,132 @@ class Volume:
                     idxmod.append_entry(idxf, nid, 0, t.TOMBSTONE_SIZE)
                 offset += disk
         self._idx_f = open(base + ".idx", "ab")
+
+    # -- incremental sync (volume_backup.go, volume_grpc_copy_incremental.go)
+    def _walk_records(self, start: int):
+        """Yield (offset, needle_id, size, disk_size) for every record
+        (live or tombstone) from byte offset `start` to EOF, stopping at
+        a torn tail."""
+        offset, end = start, self.dat.size()
+        while offset + t.NEEDLE_HEADER_SIZE <= end:
+            head = self.dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
+            _, nid, size_u32 = struct.unpack(">IQI", head)
+            nsize = max(t.u32_to_size(size_u32), 0)
+            disk = ndl.disk_size(nsize, self.version)
+            if offset + disk > end:
+                return
+            yield offset, nid, nsize, disk
+            offset += disk
+
+    def _append_at_ns_at(self, offset: int, nsize: int) -> int:
+        """Read a record's append_at_ns stamp (v3 tail field)."""
+        if self.version != ndl.VERSION3:
+            return 0
+        pos = offset + t.NEEDLE_HEADER_SIZE + nsize + ndl.CHECKSUM_SIZE
+        raw = self.dat.read_at(8, pos)
+        return struct.unpack(">Q", raw)[0] if len(raw) == 8 else 0
+
+    def _recover_last_append_at_ns(self) -> int:
+        """Stamp of the last record on disk. Starts the scan at the
+        newest live offset the index knows (one vectorized idx read)
+        so only trailing tombstones are walked record-by-record."""
+        base = self.file_name()
+        start = self.super_block.block_size
+        try:
+            entries = idxmod.read_index(base + ".idx")
+            live = entries[entries["offset"] != 0]  # tombstones store 0
+            if len(live):
+                start = max(start,
+                            int(live["offset"].max()) * t.NEEDLE_PADDING)
+        except (OSError, ValueError):
+            pass
+        last = (0, 0)
+        for offset, _nid, nsize, _disk in self._walk_records(start):
+            last = (offset, nsize)
+        return self._append_at_ns_at(*last) if last != (0, 0) else 0
+
+    def offset_for_append_at_ns(self, since_ns: int) -> int:
+        """Byte offset of the first record appended strictly after
+        `since_ns` (EOF when none) — the reference's
+        BinarySearchByAppendAtNs. Stamps are strictly increasing and
+        the .idx file is in append order, so a binary search over the
+        live index entries lands next to the answer; a short forward
+        scan from there covers interleaved tombstone records (which
+        have no index offset to probe)."""
+        start = self.super_block.block_size
+        if since_ns <= 0:
+            return start
+        if self.version == ndl.VERSION3:
+            try:
+                entries = idxmod.read_index(self.file_name() + ".idx")
+                live = entries[entries["offset"] != 0]
+            except (OSError, ValueError):
+                live = ()
+            if len(live):
+                offsets = live["offset"].astype("int64") * t.NEEDLE_PADDING
+                sizes = live["size"].astype("int64")
+                lo, hi, best = 0, len(live) - 1, -1
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    stamp = self._append_at_ns_at(
+                        int(offsets[mid]), int(sizes[mid]))
+                    if stamp <= since_ns:
+                        best, lo = mid, mid + 1
+                    else:
+                        hi = mid - 1
+                if best >= 0:
+                    start = int(offsets[best]) + ndl.disk_size(
+                        int(sizes[best]), self.version)
+        for offset, _nid, nsize, _disk in self._walk_records(start):
+            if self._append_at_ns_at(offset, nsize) > since_ns:
+                return offset
+        return self.dat.size()
+
+    def read_segment(self, offset: int, limit: int = 1 << 20) -> bytes:
+        return self.dat.read_at(min(limit, self.dat.size() - offset),
+                                offset)
+
+    def append_raw_segment(self, data: bytes) -> int:
+        """Append already-encoded records (an incremental-copy stream)
+        and index them; returns the number of records applied. Only
+        whole records are appended — a trailing partial record is an
+        error, the transport must frame on record boundaries."""
+        if self.read_only:
+            raise PermissionError(f"volume {self.vid} is read only")
+        start = self.dat.append(data)
+        self.dat.flush()
+        applied = 0
+        end = start
+        for offset, nid, nsize, disk in self._walk_records(start):
+            stored = t.actual_to_offset(offset)
+            if nsize > 0:
+                self.nm.put(nid, stored, nsize)
+                idxmod.append_entry(self._idx_f, nid, stored, nsize)
+            else:
+                self.nm.delete(nid)
+                idxmod.append_entry(self._idx_f, nid, 0,
+                                    t.TOMBSTONE_SIZE)
+            self.last_append_at_ns = max(
+                self.last_append_at_ns,
+                self._append_at_ns_at(offset, nsize))
+            applied += 1
+            end = offset + disk
+        self._idx_f.flush()
+        if end != start + len(data):
+            self.dat.truncate(end)
+            raise IOError(
+                f"incremental segment ends mid-record at {end}; "
+                f"{start + len(data) - end} trailing bytes dropped")
+        return applied
+
+    def sync_status(self) -> dict:
+        """Volume state for sync negotiation (VolumeSyncStatusResponse,
+        volume_server.proto)."""
+        return {"volume": self.vid,
+                "tail_offset": self.dat.size(),
+                "compact_revision": self.super_block.compaction_revision,
+                "last_append_at_ns": self.last_append_at_ns,
+                "read_only": self.read_only}
 
     # -- tiering -------------------------------------------------------
     @property
