@@ -134,6 +134,38 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", dest="output", default="")
     p.add_argument("fid")
 
+    p = sub.add_parser("fix", help="offline: rebuild a volume's .idx "
+                                   "by scanning its .dat")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+
+    p = sub.add_parser("compact", help="offline: vacuum a volume's "
+                                       "deleted records")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+
+    p = sub.add_parser("export", help="offline: dump live needles to tar")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", dest="output", default="")
+    p.add_argument("-newerThanNs", dest="newer_than_ns", type=int,
+                   default=0)
+
+    p = sub.add_parser("filer.cat", help="print a filer file to stdout")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("path")
+
+    p = sub.add_parser("filer.copy", help="upload local files/dirs to a "
+                                          "filer directory")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-collection", default="")
+    p.add_argument("-maxMB", dest="max_mb", type=int, default=0)
+    p.add_argument("sources", nargs="+")
+    p.add_argument("dest")
+
     p = sub.add_parser("backup", help="incrementally back up a volume "
                                       "to a local directory")
     p.add_argument("-server", "-master", dest="master",
@@ -161,6 +193,37 @@ def _dispatch(args) -> int:
 
         print(f"seaweedfs-tpu {__version__}")
         return 0
+    if args.cmd in ("fix", "compact", "export"):
+        import json as _json
+
+        from .operation import tools
+        if args.cmd == "fix":
+            out = tools.fix_volume(args.dir, args.volume_id,
+                                   args.collection)
+        elif args.cmd == "compact":
+            out = tools.compact_volume(args.dir, args.volume_id,
+                                       args.collection)
+        else:
+            dest = args.output or f"vol{args.volume_id}.tar"
+            out = tools.export_volume(args.dir, args.volume_id, dest,
+                                      args.collection,
+                                      args.newer_than_ns)
+        print(_json.dumps(out))
+        return 0
+    if args.cmd == "filer.cat":
+        import sys as _sys
+
+        import requests as _rq
+        r = _rq.get(f"{args.filer.rstrip('/')}{args.path}", stream=True,
+                    timeout=600)
+        if r.status_code >= 300:
+            print(r.text, file=_sys.stderr)
+            return 1
+        for chunk in r.iter_content(1 << 20):
+            _sys.stdout.buffer.write(chunk)
+        return 0
+    if args.cmd == "filer.copy":
+        return _run_filer_copy(args)
     if args.cmd == "backup":
         import json as _json
 
@@ -523,3 +586,51 @@ def _run_benchmark(args) -> int:
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def _run_filer_copy(args) -> int:
+    """Upload local files/directories into a filer directory
+    (command/filer_copy.go). Directories recurse; the destination is
+    always treated as a directory."""
+    import os
+
+    import requests
+
+    filer = args.filer.rstrip("/")
+    dest = "/" + args.dest.strip("/")
+    params = {}
+    if args.collection:
+        params["collection"] = args.collection
+    if args.max_mb:
+        params["maxMB"] = str(args.max_mb)
+    uploaded = 0
+    for src in args.sources:
+        if os.path.isdir(src):
+            base = os.path.basename(os.path.abspath(src))
+            for dirpath, _, files in os.walk(src):
+                rel = os.path.relpath(dirpath, src)
+                for f in sorted(files):
+                    target = "/".join(
+                        p for p in (dest, base,
+                                    "" if rel == "." else rel, f) if p)
+                    with open(os.path.join(dirpath, f), "rb") as fh:
+                        r = requests.post(f"{filer}/{target.lstrip('/')}",
+                                          params=params, data=fh,
+                                          timeout=600)
+                    if r.status_code >= 300:
+                        print(f"{target}: {r.text}")
+                        return 1
+                    uploaded += 1
+                    print(f"{os.path.join(dirpath, f)} -> /{target.lstrip('/')}")
+        else:
+            target = f"{dest}/{os.path.basename(src)}"
+            with open(src, "rb") as fh:
+                r = requests.post(f"{filer}{target}", params=params,
+                                  data=fh, timeout=600)
+            if r.status_code >= 300:
+                print(f"{target}: {r.text}")
+                return 1
+            uploaded += 1
+            print(f"{src} -> {target}")
+    print(f"copied {uploaded} files")
+    return 0

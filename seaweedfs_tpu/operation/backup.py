@@ -23,7 +23,12 @@ class BackupError(Exception):
 def _locate(master_url: str, vid: int) -> str:
     r = requests.get(f"{master_url}/dir/lookup",
                      params={"volumeId": vid}, timeout=30)
-    body = r.json()
+    try:
+        body = r.json()
+    except ValueError:  # proxy/html error bodies
+        raise BackupError(
+            f"volume {vid}: lookup returned {r.status_code}: "
+            f"{r.text[:200]}")
     locs = body.get("locations", [])
     if r.status_code >= 300 or not locs:
         raise BackupError(
@@ -75,7 +80,7 @@ def backup_volume(master_url: str, vid: int, dest_dir: str,
         _full_copy(source, vid, collection, dest_dir, name)
         local = Volume(dest_dir, collection, vid)
         mode = mode if mode.startswith("full") else "full (new)"
-        applied = len(local.nm)
+        applied = local.nm.file_count
     else:
         applied = _incremental_copy(source, vid, local)
     out = {"volume": vid, "mode": mode, "records_applied": applied,
@@ -101,6 +106,13 @@ def _full_copy(source: str, vid: int, collection: str, dest_dir: str,
 
 
 def _incremental_copy(source: str, vid: int, local: Volume) -> int:
+    """Stream the delta and append whole-record prefixes as they
+    arrive — the delta after a long gap can be many GB and must not be
+    buffered wholesale."""
+    from ..storage import needle as ndl
+
+    applied = 0
+    buf = bytearray()
     with requests.get(f"http://{source}/admin/volume_incremental_copy",
                       params={"volume": vid,
                               "since_ns": local.last_append_at_ns},
@@ -108,7 +120,15 @@ def _incremental_copy(source: str, vid: int, local: Volume) -> int:
         if r.status_code >= 300:
             raise BackupError(f"incremental copy from {source}: "
                               f"{r.status_code}")
-        data = r.content
-    if not data:
-        return 0
-    return local.append_raw_segment(data)
+        for chunk in r.iter_content(1 << 20):
+            buf.extend(chunk)
+            whole = ndl.whole_records_prefix(buf, local.version)
+            if whole:
+                applied += local.append_raw_segment(
+                    bytes(memoryview(buf)[:whole]))
+                del buf[:whole]
+    if buf:
+        raise BackupError(
+            f"incremental stream from {source} ended mid-record "
+            f"({len(buf)} trailing bytes); re-run to retry")
+    return applied

@@ -1,0 +1,88 @@
+"""Offline volume file tools: fix, compact, export.
+
+Equivalents of /root/reference/weed/command/fix.go (offline .idx
+reconstruction by scanning the .dat), command/compact.go (offline
+vacuum) and command/export.go (dump live needles out of a volume into a
+tar archive). These operate directly on volume files with the server
+stopped — the recovery toolbox when an index is corrupt or a server
+won't start.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import time
+
+from ..storage import types as t
+from ..storage.volume import Volume
+
+
+def _require_dat(dirname: str, vid: int, collection: str) -> None:
+    """Opening a Volume auto-creates missing files; an offline tool
+    pointed at a wrong id must error, not fabricate an empty volume."""
+    name = f"{collection}_{vid}" if collection else str(vid)
+    dat = os.path.join(dirname, name + ".dat")
+    if not os.path.exists(dat):
+        raise FileNotFoundError(f"no volume file {dat}")
+
+
+def fix_volume(dirname: str, vid: int, collection: str = "") -> dict:
+    """Rebuild <vid>.idx from the .dat (command/fix.go:24-40)."""
+    _require_dat(dirname, vid, collection)
+    v = Volume(dirname, collection, vid)
+    try:
+        v.rebuild_index()
+        return {"volume": vid, "records": v.nm.file_count,
+                "idx": v.file_name() + ".idx"}
+    finally:
+        v.close()
+
+
+def compact_volume(dirname: str, vid: int, collection: str = "") -> dict:
+    """Offline vacuum: drop deleted/overwritten records
+    (command/compact.go)."""
+    _require_dat(dirname, vid, collection)
+    v = Volume(dirname, collection, vid)
+    try:
+        before = v.dat.size()
+        v.compact()
+        return {"volume": vid, "before_bytes": before,
+                "after_bytes": v.dat.size(), "records": v.nm.file_count}
+    finally:
+        v.close()
+
+
+def export_volume(dirname: str, vid: int, out_tar: str,
+                  collection: str = "", newer_than_ns: int = 0) -> dict:
+    """Write every live needle to a tar archive, named by its stored
+    file name when present else its hex id (command/export.go). Deleted
+    records are skipped; `newer_than_ns` filters by append stamp."""
+    _require_dat(dirname, vid, collection)
+    v = Volume(dirname, collection, vid)
+    count, total = 0, 0
+    try:
+        with tarfile.open(out_tar, "w") as tar:
+            for offset, nid, nsize, _disk in v._walk_records(
+                    v.super_block.block_size):
+                if nsize <= 0:
+                    continue
+                loc = v.nm.get(nid)
+                if loc is None or t.offset_to_actual(loc[0]) != offset:
+                    continue  # overwritten or deleted later
+                if newer_than_ns and v._append_at_ns_at(
+                        offset, nsize) <= newer_than_ns:
+                    continue
+                n = v.read_needle(nid)
+                name = n.name.decode("utf-8", "replace") if n.name \
+                    else f"{nid:x}"
+                info = tarfile.TarInfo(name=f"vol{vid}/{name}")
+                info.size = len(n.data)
+                info.mtime = n.last_modified or int(time.time())
+                tar.addfile(info, io.BytesIO(n.data))
+                count += 1
+                total += len(n.data)
+        return {"volume": vid, "files": count, "bytes": total,
+                "tar": os.path.abspath(out_tar)}
+    finally:
+        v.close()

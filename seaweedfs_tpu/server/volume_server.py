@@ -779,8 +779,9 @@ class VolumeServer:
         await resp.prepare(req)
         idle = 0.0
         while idle < idle_timeout:
-            await asyncio.to_thread(v.sync)
-            end = v.dat.size()
+            # size() flushes the write buffer — enough for read
+            # visibility; fsync per poll would hammer the write path
+            end = await asyncio.to_thread(v.dat.size)
             if end < offset:
                 break  # compact/truncate rewrote history: end the tail
             if offset < end:
@@ -826,7 +827,7 @@ class VolumeServer:
                         status=502)
                 async for chunk in resp.content.iter_chunked(1 << 20):
                     buf.extend(chunk)
-                    whole = _whole_records_prefix(buf, v.version)
+                    whole = ndl.whole_records_prefix(buf, v.version)
                     if whole:
                         applied += await asyncio.to_thread(
                             v.append_raw_segment,
@@ -912,18 +913,3 @@ class VolumeServer:
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 
-
-def _whole_records_prefix(data, version: int) -> int:
-    """Length of the longest prefix of `data` that is whole needle
-    records (a tail stream has no framing; records self-describe)."""
-    import struct
-
-    off = 0
-    while off + t.NEEDLE_HEADER_SIZE <= len(data):
-        _, _, size_u32 = struct.unpack_from(">IQI", data, off)
-        nsize = max(t.u32_to_size(size_u32), 0)
-        disk = ndl.disk_size(nsize, version)
-        if off + disk > len(data):
-            break
-        off += disk
-    return off

@@ -46,9 +46,13 @@ class DiskFile:
         return self._path
 
     def read_at(self, size: int, offset: int) -> bytes:
+        # flush needs the lock (it touches the buffered writer); the
+        # pread itself doesn't move the shared position, so the actual
+        # disk read runs unlocked and GETs stay concurrent
         with self._lock:
             self._f.flush()
-            return os.pread(self._f.fileno(), size, offset)
+            fd = self._f.fileno()
+        return os.pread(fd, size, offset)
 
     def write_at(self, data: bytes, offset: int) -> int:
         with self._lock:

@@ -235,3 +235,19 @@ class Needle:
 
     def etag(self) -> str:
         return f"{self.checksum:08x}"
+
+
+def whole_records_prefix(data, version: int = CURRENT_VERSION) -> int:
+    """Length of the longest prefix of `data` (bytes or bytearray) that
+    is whole needle records — the framing rule for record streams
+    (incremental copy / tail), which carry no explicit framing because
+    records self-describe via their headers."""
+    off = 0
+    while off + t.NEEDLE_HEADER_SIZE <= len(data):
+        _, _, size_u32 = struct.unpack_from(">IQI", data, off)
+        nsize = max(t.u32_to_size(size_u32), 0)
+        disk = disk_size(nsize, version)
+        if off + disk > len(data):
+            break
+        off += disk
+    return off

@@ -228,11 +228,13 @@ class Volume:
         self._idx_f = open(base + ".idx", "ab")
 
     # -- incremental sync (volume_backup.go, volume_grpc_copy_incremental.go)
-    def _walk_records(self, start: int):
+    def _walk_records(self, start: int, end: int | None = None):
         """Yield (offset, needle_id, size, disk_size) for every record
-        (live or tombstone) from byte offset `start` to EOF, stopping at
-        a torn tail."""
-        offset, end = start, self.dat.size()
+        (live or tombstone) from byte offset `start` to `end` (EOF by
+        default), stopping at a torn tail."""
+        offset = start
+        if end is None:
+            end = self.dat.size()
         while offset + t.NEEDLE_HEADER_SIZE <= end:
             head = self.dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
             _, nid, size_u32 = struct.unpack(">IQI", head)
@@ -322,7 +324,10 @@ class Volume:
         self.dat.flush()
         applied = 0
         end = start
-        for offset, nid, nsize, disk in self._walk_records(start):
+        # bound the walk at our own bytes: a concurrent client write can
+        # land right after this segment and must not be double-indexed
+        for offset, nid, nsize, disk in self._walk_records(
+                start, start + len(data)):
             stored = t.actual_to_offset(offset)
             if nsize > 0:
                 self.nm.put(nid, stored, nsize)

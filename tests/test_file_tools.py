@@ -1,0 +1,142 @@
+"""Offline volume tools (fix/compact/export) and filer.cat/filer.copy
+CLI verbs (reference weed/command/fix.go, compact.go, export.go,
+filer_cat.go, filer_copy.go).
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import tools
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def mkvol(d, vid=3):
+    os.makedirs(str(d), exist_ok=True)
+    v = Volume(str(d), "", vid, create=True)
+    v.append_needle(ndl.Needle(id=1, cookie=5, data=b"alpha" * 20,
+                               name=b"a.txt",
+                               flags=ndl.FLAG_HAS_NAME))
+    v.append_needle(ndl.Needle(id=2, cookie=5, data=b"beta" * 20,
+                               name=b"b.txt",
+                               flags=ndl.FLAG_HAS_NAME))
+    v.append_needle(ndl.Needle(id=3, cookie=5, data=b"gamma"))
+    v.delete_needle(2)
+    return v
+
+
+class TestOfflineTools:
+    def test_fix_rebuilds_idx(self, tmp_path):
+        v = mkvol(tmp_path)
+        v.close()
+        idx = tmp_path / "3.idx"
+        os.remove(idx)
+        open(idx, "wb").close()  # empty (as after corruption wipe)
+        out = tools.fix_volume(str(tmp_path), 3)
+        assert out["records"] == 2  # needle 2 deleted
+        again = Volume(str(tmp_path), "", 3)
+        assert again.read_needle(1).data == b"alpha" * 20
+        with pytest.raises(KeyError):
+            again.read_needle(2)
+        again.close()
+
+    def test_compact_drops_garbage(self, tmp_path):
+        v = mkvol(tmp_path)
+        v.close()
+        out = tools.compact_volume(str(tmp_path), 3)
+        assert out["after_bytes"] < out["before_bytes"]
+        assert out["records"] == 2
+        again = Volume(str(tmp_path), "", 3)
+        assert again.read_needle(3).data == b"gamma"
+        again.close()
+
+    def test_export_to_tar(self, tmp_path):
+        v = mkvol(tmp_path)
+        v.close()
+        out_tar = str(tmp_path / "dump.tar")
+        out = tools.export_volume(str(tmp_path), 3, out_tar)
+        assert out["files"] == 2
+        with tarfile.open(out_tar) as tar:
+            names = sorted(tar.getnames())
+            assert names == ["vol3/3", "vol3/a.txt"]
+            data = tar.extractfile("vol3/a.txt").read()
+            assert data == b"alpha" * 20
+
+    def test_export_skips_overwritten(self, tmp_path):
+        v = mkvol(tmp_path)
+        v.append_needle(ndl.Needle(id=1, cookie=5, data=b"alpha-v2",
+                                   name=b"a.txt",
+                                   flags=ndl.FLAG_HAS_NAME))
+        v.close()
+        out_tar = str(tmp_path / "dump2.tar")
+        tools.export_volume(str(tmp_path), 3, out_tar)
+        with tarfile.open(out_tar) as tar:
+            assert tar.extractfile("vol3/a.txt").read() == b"alpha-v2"
+            assert len([n for n in tar.getnames()
+                        if n == "vol3/a.txt"]) == 1
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("tools_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+def run_cli(*argv, timeout=90):
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return subprocess.run([sys.executable, "-m", "seaweedfs_tpu",
+                           *argv], capture_output=True, text=True,
+                          env=env, timeout=timeout)
+
+
+class TestFilerCliVerbs:
+    def test_filer_copy_and_cat(self, cluster, tmp_path):
+        tree = tmp_path / "tree"
+        (tree / "sub").mkdir(parents=True)
+        (tree / "top.txt").write_text("top content")
+        (tree / "sub" / "leaf.txt").write_text("leaf content")
+        out = run_cli("filer.copy", "-filer", cluster.filer_url,
+                      str(tree), "dropzone")
+        assert out.returncode == 0, out.stderr
+        assert "copied 2 files" in out.stdout
+
+        r = requests.get(f"{cluster.filer_url}/dropzone/tree/top.txt")
+        assert r.content == b"top content"
+        r = requests.get(
+            f"{cluster.filer_url}/dropzone/tree/sub/leaf.txt")
+        assert r.content == b"leaf content"
+
+        out = run_cli("filer.cat", "-filer", cluster.filer_url,
+                      "/dropzone/tree/sub/leaf.txt")
+        assert out.returncode == 0
+        assert out.stdout == "leaf content"
+
+    def test_filer_copy_single_file(self, cluster, tmp_path):
+        f = tmp_path / "single.bin"
+        f.write_bytes(b"\x00\x01\x02")
+        out = run_cli("filer.copy", "-filer", cluster.filer_url,
+                      str(f), "/files")
+        assert out.returncode == 0, out.stderr
+        r = requests.get(f"{cluster.filer_url}/files/single.bin")
+        assert r.content == b"\x00\x01\x02"
+
+    def test_tools_refuse_missing_volume(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            tools.fix_volume(str(tmp_path), 99)
+        with pytest.raises(FileNotFoundError):
+            tools.compact_volume(str(tmp_path), 99)
+        with pytest.raises(FileNotFoundError):
+            tools.export_volume(str(tmp_path), 99,
+                                str(tmp_path / "x.tar"))
+        assert not os.path.exists(tmp_path / "99.dat")
