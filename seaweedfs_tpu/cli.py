@@ -214,7 +214,8 @@ def _dispatch(args) -> int:
         import sys as _sys
 
         import requests as _rq
-        r = _rq.get(f"{args.filer.rstrip('/')}{args.path}", stream=True,
+        r = _rq.get(f"{args.filer.rstrip('/')}/"
+                    f"{args.path.lstrip('/')}", stream=True,
                     timeout=600)
         if r.status_code >= 300:
             print(r.text, file=_sys.stderr)

@@ -1,0 +1,68 @@
+"""On-read image transforms.
+
+Equivalent of /root/reference/weed/images/resizing.go (+ orientation
+fix, orientation.go), hooked into the volume read path exactly where
+the reference does it (volume_server_handlers_read.go:294-353): a GET
+for an image fid may carry ?width=&height=&mode= and receives a resized
+rendition; the stored bytes are untouched.
+
+Modes (resizing.go Resized):
+    ""     exact resize to width x height (single dimension keeps the
+           aspect ratio)
+    fit    largest resize that fits inside the box, ratio preserved
+    fill   cover the box then center-crop to exactly width x height
+"""
+from __future__ import annotations
+
+import io
+
+_FORMATS = {"image/jpeg": "JPEG", "image/png": "PNG",
+            "image/gif": "GIF", "image/webp": "WEBP",
+            "image/bmp": "BMP"}
+
+
+def is_image_mime(mime: str) -> bool:
+    return mime.split(";")[0].strip().lower() in _FORMATS
+
+
+def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
+            mode: str = "") -> bytes:
+    """Return a resized rendition of `data`, or the original bytes when
+    no resize applies (no dims, undecodable, or already smaller the way
+    the reference short-circuits NewImage errors)."""
+    if width <= 0 and height <= 0:
+        return data
+    fmt = _FORMATS.get(mime.split(";")[0].strip().lower())
+    if fmt is None:
+        return data
+    try:
+        from PIL import Image, ImageOps
+    except ImportError:  # stripped-down runtime: serve original bytes
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        img.load()
+    except Exception:
+        return data  # resizing.go: undecodable -> original bytes
+    # camera EXIF orientation is honored before any geometry math
+    # (images/orientation.go FixJpgOrientation)
+    img = ImageOps.exif_transpose(img)
+    w, h = img.size
+    if width <= 0:
+        width = max(1, round(w * height / h))
+    if height <= 0:
+        height = max(1, round(h * width / w))
+    if mode == "fit":
+        out = ImageOps.contain(img, (width, height))
+    elif mode == "fill":
+        out = ImageOps.fit(img, (width, height))
+    else:
+        out = img.resize((width, height))
+    buf = io.BytesIO()
+    save_kw = {}
+    if fmt == "JPEG" and out.mode not in ("RGB", "L"):
+        out = out.convert("RGB")
+    if fmt == "GIF":
+        save_kw["save_all"] = False
+    out.save(buf, format=fmt, **save_kw)
+    return buf.getvalue()

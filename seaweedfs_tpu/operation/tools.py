@@ -76,6 +76,8 @@ def export_volume(dirname: str, vid: int, out_tar: str,
                 n = v.read_needle(nid)
                 name = n.name.decode("utf-8", "replace") if n.name \
                     else f"{nid:x}"
+                if n.is_compressed and not name.endswith(".gz"):
+                    name += ".gz"  # export.go:248 marks gzipped bodies
                 info = tarfile.TarInfo(name=f"vol{vid}/{name}")
                 info.size = len(n.data)
                 info.mtime = n.last_modified or int(time.time())

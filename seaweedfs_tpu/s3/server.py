@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import json
 import time
 import urllib.parse
 import uuid
@@ -258,6 +259,10 @@ class S3ApiServer:
         if "tagging" in q:
             check(ACTION_TAGGING)
             return await self._tagging_op(m, bucket, key, payload)
+        if m == "POST" and "select" in q:
+            check(ACTION_READ)
+            return await self._select_object_content(bucket, key,
+                                                     payload)
         if m == "PUT":
             check(ACTION_WRITE)
             src = req.headers.get("x-amz-copy-source", "")
@@ -731,6 +736,54 @@ class S3ApiServer:
         return _xml_response(root)
 
     # -- tagging --------------------------------------------------------
+    # select scans are buffered in gateway memory; bound the blast
+    # radius of one query (streaming NDJSON would lift this)
+    SELECT_MAX_OBJECT_BYTES = 256 << 20
+
+    async def _select_object_content(self, bucket: str, key: str,
+                                     payload: bytes) -> web.Response:
+        """SelectObjectContent subset: SQL over JSON objects
+        (POST /{key}?select&select-type=2). The projection/filter engine
+        is the same one behind the volume server's Query rpc
+        (weed/query/json); records come back as NDJSON rather than the
+        AWS binary event-stream framing."""
+        from ..query import parse_select, query_json_bytes
+
+        try:
+            root = ET.fromstring(payload)
+        except ET.ParseError:
+            raise S3Error("MalformedXML", "bad select request", 400)
+        expr_el = _find(root, "Expression")
+        if expr_el is None or not (expr_el.text or "").strip():
+            raise S3Error("MissingRequiredParameter",
+                          "Expression is required", 400)
+        try:
+            selections, filt = parse_select(expr_el.text)
+        except ValueError as e:
+            raise S3Error("InvalidTextEncoding", str(e), 400)
+        meta = await self._entry_meta(bucket, key)
+        if meta.get("mode", 0) & 0o40000:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        size = max((c["offset"] + c["size"]
+                    for c in meta.get("chunks", [])), default=0)
+        if size > self.SELECT_MAX_OBJECT_BYTES:
+            raise S3Error("OverMaxRecordSize",
+                          f"select is limited to objects under "
+                          f"{self.SELECT_MAX_OBJECT_BYTES} bytes", 400)
+        resp = await self._filer("GET", self._fpath(bucket, key))
+        if resp.status_code != 200:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        try:
+            lines = [json.dumps(doc, separators=(",", ":"))
+                     for doc in query_json_bytes(resp.content,
+                                                 selections, filt)]
+        except (json.JSONDecodeError, ValueError) as e:
+            raise S3Error("InvalidTextEncoding",
+                          f"object is not valid JSON: {e}", 400)
+        body = ("\n".join(lines) + "\n").encode() if lines else b""
+        return web.Response(body=body,
+                            content_type="application/octet-stream")
+
     async def _tagging_op(self, method: str, bucket: str, key: str,
                           payload: bytes) -> web.Response:
         meta = await self._entry_meta(bucket, key)

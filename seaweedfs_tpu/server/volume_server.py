@@ -115,6 +115,7 @@ class VolumeServer:
             web.post("/admin/volume_tail_receive",
                      self.handle_volume_tail_receive),
             web.get("/admin/volume_info", self.handle_volume_info),
+            web.post("/admin/query", self.handle_query),
             web.route("*", "/{fid:[0-9]+,[0-9a-fA-F]+}", self.handle_fid),
         ])
         return app
@@ -233,14 +234,31 @@ class VolumeServer:
             headers["Last-Modified"] = time.strftime(
                 "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
         body = n.data
-        if n.is_compressed and "gzip" not in \
+        is_gzip = n.is_compressed
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        # image renditions (volume_server_handlers_read.go:294-353);
+        # a compressed image must be inflated before PIL sees it
+        if ("width" in req.query or "height" in req.query):
+            from .. import images
+
+            if images.is_image_mime(ct):
+                if is_gzip:
+                    import gzip
+
+                    body = gzip.decompress(body)
+                    is_gzip = False
+                body = await asyncio.to_thread(
+                    images.resized, body, ct,
+                    int(req.query.get("width", "0") or 0),
+                    int(req.query.get("height", "0") or 0),
+                    req.query.get("mode", ""))
+        if is_gzip and "gzip" not in \
                 req.headers.get("Accept-Encoding", ""):
             import gzip
 
             body = gzip.decompress(body)
-        elif n.is_compressed:
+        elif is_gzip:
             headers["Content-Encoding"] = "gzip"
-        ct = n.mime.decode() if n.mime else "application/octet-stream"
         if req.method == "HEAD":
             headers["Content-Length"] = str(len(body))
             return web.Response(status=200, headers=headers)
@@ -723,6 +741,53 @@ class VolumeServer:
         data = await asyncio.to_thread(shard.read_at, offset, size)
         return web.Response(body=data,
                             content_type="application/octet-stream")
+
+    # -- server-side query (volume_grpc_query.go, query/json) ----------
+    async def handle_query(self, req: web.Request) -> web.StreamResponse:
+        """VolumeServer.Query rpc: scan JSON object bodies held locally
+        and stream back only the projected/filtered records (NDJSON)."""
+        from ..query import Filter, query_json_bytes
+
+        body = await req.json()
+        fids = body.get("from", {}).get("file_ids") or body.get("fids")
+        if not fids:
+            return web.json_response(
+                {"error": "query needs fids"}, status=400)
+        selections = body.get("selections", [])
+        fd = body.get("filter", {})
+        filt = Filter(field=fd.get("field", ""),
+                      op=fd.get("operand", fd.get("op", "=")),
+                      value=str(fd.get("value", "")))
+        # validate everything that can raise BEFORE streaming starts:
+        # after prepare() the 200 is on the wire and errors can only
+        # truncate the stream
+        from ..query.json_query import OPS
+
+        if filt.op not in OPS:
+            return web.json_response(
+                {"error": f"bad operand {filt.op!r}"}, status=400)
+        try:
+            parsed = [t.parse_file_id(fid) for fid in fids]
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(req)
+        for vid, key, cookie in parsed:
+            v = self.store.find_volume(vid)
+            if v is None:
+                continue  # reference queries only local volumes
+            try:
+                n = await asyncio.to_thread(v.read_needle, key, cookie)
+            except (KeyError, PermissionError, ValueError):
+                continue
+            out = []
+            for doc in query_json_bytes(n.data, selections, filt):
+                out.append(json.dumps(doc, separators=(",", ":")))
+            if out:
+                await resp.write(("\n".join(out) + "\n").encode())
+        await resp.write_eof()
+        return resp
 
     # -- incremental sync / tail (volume_backup.go, volume_grpc_tail.go)
     async def handle_volume_sync_status(self, req: web.Request) \

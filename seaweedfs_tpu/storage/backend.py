@@ -48,11 +48,18 @@ class DiskFile:
     def read_at(self, size: int, offset: int) -> bytes:
         # flush needs the lock (it touches the buffered writer); the
         # pread itself doesn't move the shared position, so the actual
-        # disk read runs unlocked and GETs stay concurrent
+        # disk read runs unlocked and GETs stay concurrent. The fd is
+        # dup'ed under the lock: a bare cached fd number could be
+        # closed by a concurrent compact commit and REUSED for the new
+        # file, silently serving wrong bytes — the dup stays pinned to
+        # the old file until we close it.
         with self._lock:
             self._f.flush()
-            fd = self._f.fileno()
-        return os.pread(fd, size, offset)
+            fd = os.dup(self._f.fileno())
+        try:
+            return os.pread(fd, size, offset)
+        finally:
+            os.close(fd)
 
     def write_at(self, data: bytes, offset: int) -> int:
         with self._lock:

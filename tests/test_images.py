@@ -1,0 +1,75 @@
+"""On-read image resizing (reference weed/images/resizing.go + the
+volume read hook at volume_server_handlers_read.go:294).
+"""
+import io
+
+import pytest
+import requests
+
+from seaweedfs_tpu import images
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def png_bytes(w, h, color=(200, 30, 30)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestResized:
+    def test_exact_resize(self):
+        out = images.resized(png_bytes(100, 50), "image/png", 40, 40)
+        assert Image.open(io.BytesIO(out)).size == (40, 40)
+
+    def test_single_dim_keeps_ratio(self):
+        out = images.resized(png_bytes(100, 50), "image/png", width=50)
+        assert Image.open(io.BytesIO(out)).size == (50, 25)
+
+    def test_fit_mode(self):
+        out = images.resized(png_bytes(100, 50), "image/png", 40, 40,
+                             "fit")
+        assert Image.open(io.BytesIO(out)).size == (40, 20)
+
+    def test_fill_mode_crops(self):
+        out = images.resized(png_bytes(100, 50), "image/png", 40, 40,
+                             "fill")
+        assert Image.open(io.BytesIO(out)).size == (40, 40)
+
+    def test_non_image_mime_passthrough(self):
+        data = b"plain text"
+        assert images.resized(data, "text/plain", 10, 10) is data
+
+    def test_undecodable_passthrough(self):
+        data = b"not a png"
+        assert images.resized(data, "image/png", 10, 10) is data
+
+    def test_no_dims_passthrough(self):
+        data = png_bytes(10, 10)
+        assert images.resized(data, "image/png") is data
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("img_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20)
+    yield c
+    c.stop()
+
+
+class TestReadHook:
+    def test_resized_on_get(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, png_bytes(120, 80), name="pic.png",
+                     mime="image/png")
+        r = requests.get(f"http://{a.url}/{a.fid}",
+                         params={"width": 30, "height": 30,
+                                 "mode": "fill"})
+        assert r.status_code == 200
+        assert Image.open(io.BytesIO(r.content)).size == (30, 30)
+        # original untouched
+        r2 = requests.get(f"http://{a.url}/{a.fid}")
+        assert Image.open(io.BytesIO(r2.content)).size == (120, 80)

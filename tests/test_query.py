@@ -1,0 +1,142 @@
+"""Server-side query subsystem: JSON projection/filter engine, the tiny
+SELECT parser, the volume server Query endpoint, and S3
+SelectObjectContent (reference weed/query/json/query_json.go,
+volume_grpc_query.go, s3 select shape).
+"""
+import json
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.query import Filter, parse_select, query_json_bytes
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+class TestJsonQuery:
+    DOCS = b"""\
+{"name": "alice", "age": 30, "addr": {"city": "nyc"}}
+{"name": "bob", "age": 25, "addr": {"city": "sf"}}
+{"name": "carol", "age": 35}
+not json at all
+"""
+
+    def q(self, sel, filt=None):
+        return list(query_json_bytes(self.DOCS, sel, filt))
+
+    def test_project_all(self):
+        assert len(self.q([])) == 3  # bad line skipped
+
+    def test_project_fields(self):
+        out = self.q(["name"])
+        assert out[0] == {"name": "alice"}
+
+    def test_dotted_path(self):
+        out = self.q(["addr.city"], Filter("name", "=", "alice"))
+        assert out == [{"addr.city": "nyc"}]
+
+    def test_numeric_compare(self):
+        out = self.q(["name"], Filter("age", ">=", "30"))
+        assert [d["name"] for d in out] == ["alice", "carol"]
+
+    def test_missing_field_no_match(self):
+        out = self.q(["name"], Filter("addr.city", "=", "sf"))
+        assert [d["name"] for d in out] == ["bob"]
+
+    def test_single_doc_and_array(self):
+        single = b'{"a": 1}'
+        assert list(query_json_bytes(single, [])) == [{"a": 1}]
+        arr = b'[{"a": 1}, {"a": 2}]'
+        assert list(query_json_bytes(arr, [], Filter("a", ">", "1"))) \
+            == [{"a": 2}]
+
+
+class TestSqlParser:
+    def test_select_star(self):
+        sel, filt = parse_select("SELECT * FROM S3Object")
+        assert sel == [] and filt.field == ""
+
+    def test_select_fields_with_alias(self):
+        sel, filt = parse_select(
+            "SELECT s.name, s.addr.city FROM S3Object s "
+            "WHERE s.age > 29")
+        assert sel == ["name", "addr.city"]
+        assert (filt.field, filt.op, filt.value) == ("age", ">", "29")
+
+    def test_bracket_alias_and_quotes(self):
+        sel, filt = parse_select(
+            "select s.name from s3object[s] where s.name = 'alice'")
+        assert sel == ["name"]
+        assert filt.value == "alice"
+
+    def test_unsupported_sql_raises(self):
+        with pytest.raises(ValueError):
+            parse_select("SELECT count(*) FROM S3Object")
+        with pytest.raises(ValueError):
+            parse_select("DELETE FROM S3Object")
+        with pytest.raises(ValueError):
+            parse_select("SELECT * FROM S3Object WHERE a = 1 AND b = 2")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("query_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True, with_s3=True)
+    yield c
+    c.stop()
+
+
+class TestVolumeQuery:
+    def test_query_endpoint(self, cluster):
+        docs = (b'{"level": "error", "msg": "boom"}\n'
+                b'{"level": "info", "msg": "fine"}\n')
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, docs)
+        url = f"http://{a.url}/admin/query"
+        r = requests.post(url, json={
+            "fids": [a.fid],
+            "selections": ["msg"],
+            "filter": {"field": "level", "operand": "=",
+                       "value": "error"}})
+        assert r.status_code == 200
+        rows = [json.loads(line) for line in r.text.splitlines()]
+        assert rows == [{"msg": "boom"}]
+
+    def test_query_needs_fids(self, cluster):
+        url = f"{cluster.volume_url(0)}/admin/query"
+        r = requests.post(url, json={"selections": []})
+        assert r.status_code == 400
+
+
+class TestS3Select:
+    SELECT_XML = """<SelectObjectContentRequest>
+  <Expression>{expr}</Expression>
+  <ExpressionType>SQL</ExpressionType>
+  <InputSerialization><JSON><Type>LINES</Type></JSON></InputSerialization>
+  <OutputSerialization><JSON/></OutputSerialization>
+</SelectObjectContentRequest>"""
+
+    def test_select_object_content(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/logs")
+        body = (b'{"svc": "api", "ms": 12}\n'
+                b'{"svc": "db", "ms": 80}\n'
+                b'{"svc": "api", "ms": 33}\n')
+        requests.put(f"{s3}/logs/day1.ndjson", data=body)
+        xml = self.SELECT_XML.format(
+            expr="SELECT s.ms FROM S3Object s WHERE s.svc = 'api'")
+        r = requests.post(f"{s3}/logs/day1.ndjson?select&select-type=2",
+                          data=xml.encode())
+        assert r.status_code == 200, r.text
+        rows = [json.loads(line) for line in r.text.splitlines()]
+        assert rows == [{"ms": 12}, {"ms": 33}]
+
+    def test_select_bad_sql(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/logs")
+        requests.put(f"{s3}/logs/x.json", data=b'{"a":1}')
+        xml = self.SELECT_XML.format(expr="SELECT sum(a) FROM S3Object")
+        r = requests.post(f"{s3}/logs/x.json?select&select-type=2",
+                          data=xml.encode())
+        assert r.status_code == 400
