@@ -99,6 +99,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-path", default="/")
     p.add_argument("-o", dest="output", default="filer_meta_backup.db")
 
+    p = sub.add_parser("mq.broker", help="start a message-queue broker")
+    p.add_argument("-port", type=int, default=17777)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+
     p = sub.add_parser("webdav", help="start a WebDAV gateway")
     p.add_argument("-port", type=int, default=7333)
     p.add_argument("-ip", default="127.0.0.1")
@@ -290,6 +296,16 @@ def _dispatch(args) -> int:
                 _t.sleep(3600)
         except KeyboardInterrupt:
             b.stop()
+        return 0
+    if args.cmd == "mq.broker":
+        from .mq.broker import BrokerServer
+        from .rpc.http import ServerThread, run_apps_forever
+
+        b = BrokerServer(args.filer, args.master)
+        t = ServerThread(b.app, host=args.ip, port=args.port).start()
+        b.address = t.address
+        print(f"mq broker listening on {t.url}")
+        run_apps_forever([t])
         return 0
     if args.cmd == "webdav":
         from .rpc.http import ServerThread, run_apps_forever

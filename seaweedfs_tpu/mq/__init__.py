@@ -1,0 +1,14 @@
+"""Message queue: brokers, partitioned topics, pub/sub.
+
+Equivalent of /root/reference/weed/mq/ (broker_server.go:32-45,
+broker_grpc_pub.go, broker_grpc_sub.go, mq.proto): brokers register in
+cluster membership under their own node type, topic configuration and
+segment data live in the filer (so brokers are stateless and
+restartable), publishers hash keys onto partitions, subscribers replay
+from any offset then follow the live tail. The reference marks the
+subsystem WIP; the shape here mirrors its architecture with an HTTP
+transport.
+"""
+from .broker import BrokerServer, Topic
+
+__all__ = ["BrokerServer", "Topic"]

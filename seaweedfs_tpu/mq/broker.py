@@ -1,0 +1,456 @@
+"""Message-queue broker server.
+
+Equivalent of /root/reference/weed/mq/broker/ (broker_server.go,
+broker_grpc_pub.go, broker_grpc_sub.go, broker_grpc_configure.go):
+
+- topics are `namespace/name` with a fixed partition count; their
+  config is a JSON file in the filer at /topics/<ns>/<name>/topic.conf
+  (the reference stores topic.conf via filer too, broker_grpc_configure)
+- publish hashes the record key onto a partition (sticky round-robin
+  for empty keys) and appends to that partition's log
+- partition logs live in the filer as segment files
+  /topics/<ns>/<name>/p<k>/seg-<firstOffset> (flushed by size/age, the
+  reference's log_buffer flush), with the unflushed tail in broker
+  memory — a broker restart replays offsets from the filer
+- subscribe streams records from `offset` onward: flushed segments
+  first, then the live in-memory tail (long-poll)
+
+Records are JSON: {"o": offset, "ts": ns, "k": key, "v": value}; values
+are base64 when not valid UTF-8.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import time
+
+import aiohttp
+from aiohttp import web
+
+TOPICS_DIR = "/topics"
+SEG_FLUSH_RECORDS = 256
+SEG_FLUSH_BYTES = 1 << 20
+SEG_FLUSH_AGE = 1.0  # seconds
+
+
+def _enc_value(v: bytes) -> dict:
+    try:
+        return {"v": v.decode("utf-8")}
+    except UnicodeDecodeError:
+        return {"v64": base64.b64encode(v).decode()}
+
+
+def _dec_value(d: dict) -> bytes:
+    if "v64" in d:
+        return base64.b64decode(d["v64"])
+    return d.get("v", "").encode()
+
+
+class Partition:
+    """One partition's log: flushed segments in the filer + memory tail."""
+
+    def __init__(self, dirpath: str, idx: int):
+        self.dir = f"{dirpath}/p{idx}"
+        self.idx = idx
+        self.tail: list[dict] = []      # unflushed records
+        self.tail_base = 0              # offset of tail[0]
+        self.next_offset = 0
+        self.tail_bytes = 0
+        self.last_flush = time.monotonic()
+        self.lock = asyncio.Lock()
+        self.new_data = asyncio.Event()
+
+
+class Topic:
+    def __init__(self, namespace: str, name: str, partitions: int = 4):
+        self.namespace = namespace
+        self.name = name
+        self.partitions = partitions
+
+    @property
+    def dir(self) -> str:
+        return f"{TOPICS_DIR}/{self.namespace}/{self.name}"
+
+    def conf(self) -> dict:
+        return {"namespace": self.namespace, "name": self.name,
+                "partitions": self.partitions}
+
+
+class BrokerServer:
+    def __init__(self, filer_url: str, master_url: str = "",
+                 announce_pulse: float = 3.0):
+        self.filer_url = filer_url.rstrip("/")
+        self.master_url = master_url.rstrip("/")
+        self.announce_pulse = announce_pulse
+        self.address = ""  # set by the runner after the socket binds
+        self.topics: dict[tuple[str, str], Topic] = {}
+        self.parts: dict[tuple[str, str, int], Partition] = {}
+        self._rr = 0
+        self._member_task = None
+        self._flush_task = None
+        self.app = self._build_app()
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+
+    # -- plumbing -------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        @web.middleware
+        async def error_mw(request, handler):
+            try:
+                return await handler(request)
+            except web.HTTPException:
+                raise
+            except (json.JSONDecodeError, KeyError, ValueError) as e:
+                return web.json_response(
+                    {"error": f"bad request: {e}"}, status=400)
+
+        app = web.Application(middlewares=[error_mw])
+        app.add_routes([
+            web.get("/status", self.handle_status),
+            web.get("/topics", self.handle_list_topics),
+            web.post("/topics/{ns}/{topic}", self.handle_configure),
+            web.get("/topics/{ns}/{topic}", self.handle_describe),
+            web.delete("/topics/{ns}/{topic}", self.handle_delete),
+            web.post("/topics/{ns}/{topic}/publish",
+                     self.handle_publish),
+            web.get("/topics/{ns}/{topic}/subscribe",
+                    self.handle_subscribe),
+        ])
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        await self._load_topics()
+        self._flush_task = asyncio.create_task(self._flush_loop())
+        if self.master_url:
+            self._member_task = asyncio.create_task(
+                self._membership_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        for task in (self._flush_task, self._member_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        # flush every dirty partition so a clean shutdown loses nothing
+        for key, part in list(self.parts.items()):
+            topic = self.topics.get(key[:2])
+            if topic and part.tail:
+                try:
+                    await self._flush_partition(part)
+                except Exception:
+                    pass
+        await self._session.close()
+
+    async def _membership_loop(self) -> None:
+        """Register as a broker in cluster membership
+        (broker_server.go:32 keepConnectedToMaster)."""
+        while not self.address:
+            await asyncio.sleep(0.02)
+        while True:
+            try:
+                async with self._session.post(
+                        f"{self.master_url}/cluster/announce",
+                        json={"address": self.address,
+                              "type": "broker"},
+                        allow_redirects=True) as resp:
+                    await resp.read()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            await asyncio.sleep(self.announce_pulse)
+
+    # -- filer IO -------------------------------------------------------
+    async def _filer(self, method: str, path: str, **kw):
+        return await self._session.request(
+            method, f"{self.filer_url}{path}", **kw)
+
+    async def _load_topics(self) -> None:
+        """Rehydrate topic registry + partition offsets from the filer
+        (stateless broker restart)."""
+        for ns in await self._list_dir(TOPICS_DIR):
+            if not ns["is_dir"]:
+                continue
+            ns_name = ns["name"]
+            for tp in await self._list_dir(f"{TOPICS_DIR}/{ns_name}"):
+                if not tp["is_dir"]:
+                    continue
+                resp = await self._filer(
+                    "GET", f"{TOPICS_DIR}/{ns_name}/{tp['name']}"
+                           f"/topic.conf")
+                if resp.status != 200:
+                    continue
+                conf = json.loads(await resp.read())
+                topic = Topic(conf["namespace"], conf["name"],
+                              conf.get("partitions", 4))
+                self.topics[(topic.namespace, topic.name)] = topic
+                for i in range(topic.partitions):
+                    part = await self._open_partition(topic, i)
+                    self.parts[(topic.namespace, topic.name, i)] = part
+
+    async def _list_dir(self, path: str) -> list[dict]:
+        resp = await self._filer("GET", path,
+                                 headers={"Accept": "application/json"})
+        if resp.status != 200:
+            return []
+        body = await resp.json()
+        out = []
+        for e in body.get("entries", []):
+            name = e["full_path"].rstrip("/").rsplit("/", 1)[-1]
+            out.append({"name": name,
+                        "is_dir": bool(e.get("mode", 0) & 0o40000)})
+        return out
+
+    async def _open_partition(self, topic: Topic, idx: int) -> Partition:
+        part = Partition(topic.dir, idx)
+        segs = await self._segments(part)
+        if segs:
+            # next offset = last segment's first offset + its records.
+            # A failed read here must NOT fall through to offset 0 —
+            # the broker would re-ack duplicate offsets and overwrite
+            # the first flushed segment on the next flush.
+            resp = await self._filer("GET",
+                                     f"{part.dir}/seg-{segs[-1]:020d}")
+            if resp.status != 200:
+                raise IOError(
+                    f"cannot recover offsets for {part.dir}: segment "
+                    f"seg-{segs[-1]:020d} read failed "
+                    f"({resp.status})")
+            n = sum(1 for line in (await resp.read()).splitlines()
+                    if line.strip())
+            part.next_offset = segs[-1] + n
+        part.tail_base = part.next_offset
+        return part
+
+    async def _segments(self, part: Partition) -> list[int]:
+        """Sorted first-offsets of flushed segment files."""
+        segs = []
+        for e in await self._list_dir(part.dir):
+            if e["name"].startswith("seg-"):
+                try:
+                    segs.append(int(e["name"][4:]))
+                except ValueError:
+                    continue
+        return sorted(segs)
+
+    async def _flush_partition(self, part: Partition) -> None:
+        async with part.lock:
+            if not part.tail:
+                return
+            records, base = part.tail, part.tail_base
+            part.tail = []
+            part.tail_base = part.next_offset
+            part.tail_bytes = 0
+            part.last_flush = time.monotonic()
+        body = "\n".join(json.dumps(r, separators=(",", ":"))
+                         for r in records) + "\n"
+        resp = await self._filer("POST", f"{part.dir}/seg-{base:020d}",
+                                 data=body.encode())
+        if resp.status >= 300:
+            # put the records back; publishers already got their
+            # offsets so order must be preserved
+            async with part.lock:
+                part.tail = records + part.tail
+                part.tail_base = base
+                part.tail_bytes += len(body)
+            raise IOError(f"segment flush failed: {resp.status}")
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(SEG_FLUSH_AGE / 2)
+            now = time.monotonic()
+            for part in list(self.parts.values()):
+                try:
+                    if part.tail and (
+                            now - part.last_flush >= SEG_FLUSH_AGE
+                            or len(part.tail) >= SEG_FLUSH_RECORDS
+                            or part.tail_bytes >= SEG_FLUSH_BYTES):
+                        await self._flush_partition(part)
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    continue  # filer hiccup: retry next tick
+
+    # -- handlers -------------------------------------------------------
+    def _topic(self, req: web.Request) -> Topic:
+        key = (req.match_info["ns"], req.match_info["topic"])
+        topic = self.topics.get(key)
+        if topic is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"no topic {key[0]}/{key[1]}"}),
+                content_type="application/json")
+        return topic
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response(
+            {"filer": self.filer_url, "topics": len(self.topics)})
+
+    async def handle_list_topics(self, req: web.Request) -> web.Response:
+        return web.json_response(
+            {"topics": [t.conf() for t in self.topics.values()]})
+
+    async def handle_configure(self, req: web.Request) -> web.Response:
+        """ConfigureTopic (broker_grpc_configure.go): create or resize."""
+        ns, name = req.match_info["ns"], req.match_info["topic"]
+        body = await req.json() if req.can_read_body else {}
+        partitions = int(body.get("partitions", 4))
+        if partitions < 1:
+            raise ValueError("partitions must be >= 1")
+        existing = self.topics.get((ns, name))
+        if existing is not None and existing.partitions > partitions:
+            return web.json_response(
+                {"error": "cannot shrink partitions"}, status=409)
+        topic = Topic(ns, name, partitions)
+        resp = await self._filer(
+            "POST", f"{topic.dir}/topic.conf",
+            data=json.dumps(topic.conf()).encode())
+        if resp.status >= 300:
+            return web.json_response(
+                {"error": f"filer: {resp.status}"}, status=502)
+        self.topics[(ns, name)] = topic
+        for i in range(partitions):
+            if (ns, name, i) not in self.parts:
+                self.parts[(ns, name, i)] = await self._open_partition(
+                    topic, i)
+        return web.json_response(topic.conf(), status=201)
+
+    async def handle_describe(self, req: web.Request) -> web.Response:
+        topic = self._topic(req)
+        parts = []
+        for i in range(topic.partitions):
+            part = self.parts[(topic.namespace, topic.name, i)]
+            parts.append({"partition": i,
+                          "next_offset": part.next_offset})
+        return web.json_response({**topic.conf(), "state": parts})
+
+    async def handle_delete(self, req: web.Request) -> web.Response:
+        topic = self._topic(req)
+        await self._filer("DELETE", topic.dir,
+                          params={"recursive": "true"})
+        del self.topics[(topic.namespace, topic.name)]
+        for i in range(topic.partitions):
+            self.parts.pop((topic.namespace, topic.name, i), None)
+        return web.json_response({}, status=204)
+
+    async def handle_publish(self, req: web.Request) -> web.Response:
+        """Publish one record or a batch (broker_grpc_pub.go). Body:
+        {"key": ..., "value": ...} or {"records": [...]}."""
+        topic = self._topic(req)
+        body = await req.json()
+        records = body.get("records") or [body]
+        out = []
+        for rec in records:
+            key = rec.get("key", "")
+            if "value64" in rec:
+                value = base64.b64decode(rec["value64"])
+            else:
+                value = rec.get("value", "")
+                if isinstance(value, str):
+                    value = value.encode()
+            if key:
+                pidx = int(hashlib.md5(key.encode()).hexdigest(),
+                           16) % topic.partitions
+            else:
+                self._rr += 1
+                pidx = self._rr % topic.partitions
+            part = self.parts[(topic.namespace, topic.name, pidx)]
+            async with part.lock:
+                record = {"o": part.next_offset, "ts": time.time_ns(),
+                          "k": key, **_enc_value(value)}
+                part.tail.append(record)
+                part.tail_bytes += len(value) + len(key) + 32
+                part.next_offset += 1
+                part.new_data.set()
+                part.new_data = asyncio.Event()
+            out.append({"partition": pidx, "offset": record["o"]})
+        return web.json_response({"acks": out})
+
+    async def handle_subscribe(self, req: web.Request) \
+            -> web.StreamResponse:
+        """Stream records from `offset` on one partition; replays
+        flushed segments then follows the live tail until idle for
+        `idle_timeout` seconds (broker_grpc_sub.go)."""
+        topic = self._topic(req)
+        pidx = int(req.query.get("partition", "0"))
+        if not 0 <= pidx < topic.partitions:
+            raise ValueError(f"partition {pidx} out of range")
+        offset = int(req.query.get("offset", "0"))
+        idle_timeout = float(req.query.get("idle_timeout", "5"))
+        limit = int(req.query.get("limit", "0"))
+        part = self.parts[(topic.namespace, topic.name, pidx)]
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(req)
+        sent = 0
+
+        async def send(rec: dict) -> bool:
+            nonlocal offset, sent
+            if rec["o"] < offset:
+                return True
+            await resp.write(
+                (json.dumps(rec, separators=(",", ":")) + "\n").encode())
+            offset = rec["o"] + 1
+            sent += 1
+            return not limit or sent < limit
+
+        # 1. replay flushed segments that may contain >= offset
+        for first in await self._segments(part):
+            async with part.lock:
+                tail_base = part.tail_base
+            if first >= tail_base:
+                break  # re-flushed after we read; tail covers it
+            r = await self._filer("GET", f"{part.dir}/seg-{first:020d}")
+            if r.status != 200:
+                continue
+            for line in (await r.read()).splitlines():
+                if not line.strip():
+                    continue
+                if not await send(json.loads(line)):
+                    await resp.write_eof()
+                    return resp
+        # 2. live tail + follow
+        while True:
+            async with part.lock:
+                pending = [r for r in part.tail if r["o"] >= offset]
+                waiter = part.new_data
+                # records between segment replay and the tail may have
+                # been flushed while we replayed: fetch those segments
+                gap = offset < part.tail_base and not pending
+            if gap:
+                import bisect
+
+                segs = await self._segments(part)
+                # the segment holding `offset` is the last one starting
+                # at or before it (segments have no fixed record count)
+                idx = max(0, bisect.bisect_right(segs, offset) - 1)
+                for first in segs[idx:]:
+                    r = await self._filer("GET",
+                                          f"{part.dir}/seg-{first:020d}")
+                    if r.status != 200:
+                        continue
+                    for line in (await r.read()).splitlines():
+                        if line.strip() and \
+                                not await send(json.loads(line)):
+                            await resp.write_eof()
+                            return resp
+                if offset < part.tail_base:
+                    # nothing more on disk either: records were lost or
+                    # compacted away; skip forward rather than spin
+                    offset = part.tail_base
+                continue
+            for rec in pending:
+                if not await send(rec):
+                    await resp.write_eof()
+                    return resp
+            try:
+                await asyncio.wait_for(waiter.wait(), idle_timeout)
+            except asyncio.TimeoutError:
+                break
+        await resp.write_eof()
+        return resp

@@ -31,8 +31,10 @@ class Filter:
         if isinstance(got, bool):
             want = self.value.lower() in ("true", "1")
         elif isinstance(got, (int, float)):
+            # compare numerically without truncating the constant:
+            # int(29.5) would make `age >= 29.5` match age=29
             try:
-                want = type(got)(float(self.value))
+                want = float(self.value)
             except ValueError:
                 return False
         if self.op == "=":
@@ -93,9 +95,18 @@ def query_json_bytes(data: bytes, selections: list[str],
     text = data.decode("utf-8", "replace").strip()
     if not text:
         return
-    if text.startswith(("[", "{")) and "\n" not in text.rstrip():
-        yield from query_json_doc(json.loads(text), selections, filt)
-        return
+    if text.startswith(("[", "{")):
+        # try the whole body as one document first: a pretty-printed
+        # (multi-line) object must not fall through to line mode where
+        # every line would fail to parse and be silently skipped.
+        # NDJSON can't parse as a single document, so this is exact.
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            pass
+        else:
+            yield from query_json_doc(doc, selections, filt)
+            return
     for line in text.splitlines():
         line = line.strip()
         if not line:

@@ -81,7 +81,17 @@ class Cluster:
             from ..s3.server import S3ApiServer
             self.s3 = S3ApiServer(self.filer_url, iam_config=s3_config)
             self.s3_thread = ServerThread(self.s3.app).start()
+        self.broker = None
+        self.broker_thread: ServerThread | None = None
         self.wait_for_nodes(n_volume_servers)
+
+    def start_broker(self) -> str:
+        """Start an in-process mq broker against this cluster's filer."""
+        from ..mq.broker import BrokerServer
+        self.broker = BrokerServer(self.filer_url, self.master_url)
+        self.broker_thread = ServerThread(self.broker.app).start()
+        self.broker.address = self.broker_thread.address
+        return self.broker_thread.url
 
     @property
     def master_url(self) -> str:
@@ -131,6 +141,8 @@ class Cluster:
         return self.s3_thread.url
 
     def stop(self) -> None:
+        if self.broker_thread is not None:
+            self.broker_thread.stop()
         if self.s3_thread is not None:
             self.s3_thread.stop()
         if self.filer_thread is not None:

@@ -241,16 +241,19 @@ class VolumeServer:
         if ("width" in req.query or "height" in req.query):
             from .. import images
 
-            if images.is_image_mime(ct):
+            try:
+                want_w = int(req.query.get("width", "0") or 0)
+                want_h = int(req.query.get("height", "0") or 0)
+            except ValueError:
+                want_w = want_h = 0  # reference ignores bad dims
+            if images.is_image_mime(ct) and (want_w or want_h):
                 if is_gzip:
                     import gzip
 
                     body = gzip.decompress(body)
                     is_gzip = False
                 body = await asyncio.to_thread(
-                    images.resized, body, ct,
-                    int(req.query.get("width", "0") or 0),
-                    int(req.query.get("height", "0") or 0),
+                    images.resized, body, ct, want_w, want_h,
                     req.query.get("mode", ""))
         if is_gzip and "gzip" not in \
                 req.headers.get("Accept-Encoding", ""):

@@ -1,0 +1,53 @@
+"""mq.topic.* shell commands.
+
+Equivalents of /root/reference/weed/shell/command_mq_topic_list.go and
+friends: discover a live broker through cluster membership, then manage
+topics over its API.
+"""
+from __future__ import annotations
+
+import requests
+
+from .env import CommandEnv, ShellError
+
+
+def _broker(env: CommandEnv) -> str:
+    body = env.master_get("/cluster/nodes", type="broker")
+    nodes = body.get("nodes", [])
+    if not nodes:
+        raise ShellError("no mq broker registered in the cluster "
+                         "(start one with `mq.broker`)")
+    return f"http://{nodes[0]['address']}"
+
+
+def mq_topic_list(env: CommandEnv) -> dict:
+    r = requests.get(f"{_broker(env)}/topics", timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"mq.topic.list: {r.text}")
+    return r.json()
+
+
+def mq_topic_create(env: CommandEnv, namespace: str, name: str,
+                    partitions: int = 4) -> dict:
+    r = requests.post(f"{_broker(env)}/topics/{namespace}/{name}",
+                      json={"partitions": partitions}, timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"mq.topic.create: {r.text}")
+    return r.json()
+
+
+def mq_topic_describe(env: CommandEnv, namespace: str,
+                      name: str) -> dict:
+    r = requests.get(f"{_broker(env)}/topics/{namespace}/{name}",
+                     timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"mq.topic.describe: {r.text}")
+    return r.json()
+
+
+def mq_topic_delete(env: CommandEnv, namespace: str, name: str) -> str:
+    r = requests.delete(f"{_broker(env)}/topics/{namespace}/{name}",
+                        timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"mq.topic.delete: {r.text}")
+    return f"deleted {namespace}/{name}"

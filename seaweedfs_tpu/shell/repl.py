@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import shlex
 
-from . import (commands_cluster, commands_ec, commands_fs,
+from . import (commands_cluster, commands_ec, commands_fs, commands_mq,
                commands_remote, commands_volume)
 from .env import CommandEnv, ShellError
 
@@ -59,6 +59,10 @@ HELP = """commands:
   remote.meta.sync -dir=/d          pull remote listing into metadata
   remote.cache -dir=/d              materialise remote files locally
   remote.uncache -dir=/d            drop local copies, keep metadata
+  mq.topic.list                     list message-queue topics
+  mq.topic.create [-namespace=ns] -topic=T [-partitions=4]
+  mq.topic.describe [-namespace=ns] -topic=T
+  mq.topic.delete [-namespace=ns] -topic=T
   help / exit
 """
 
@@ -209,6 +213,26 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_remote.remote_cache(env, opts["dir"])
     if cmd == "remote.uncache":
         return commands_remote.remote_uncache(env, opts["dir"])
+    # -- message queue --------------------------------------------------
+    if cmd == "mq.topic.list":
+        return commands_mq.mq_topic_list(env)
+    if cmd == "mq.topic.create":
+        ns = opts.get("namespace", "default")
+        name = opts.get("topic", "")
+        if not name:  # positional `ns/topic` or bare `topic`
+            p = arg(0)
+            if "/" in p:
+                ns, _, name = p.partition("/")
+            else:
+                name = p
+        return commands_mq.mq_topic_create(
+            env, ns, name, int(opts.get("partitions", "4")))
+    if cmd == "mq.topic.describe":
+        return commands_mq.mq_topic_describe(
+            env, opts.get("namespace", "default"), opts["topic"])
+    if cmd == "mq.topic.delete":
+        return commands_mq.mq_topic_delete(
+            env, opts.get("namespace", "default"), opts["topic"])
     if cmd == "help":
         return HELP
     raise ShellError(f"unknown command {cmd!r} (try `help`)")

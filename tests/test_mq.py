@@ -1,0 +1,171 @@
+"""Message-queue broker: topic lifecycle, partitioned publish,
+subscribe/replay, durability across broker restarts, shell commands
+(reference weed/mq/broker, mq.proto).
+"""
+import json
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell.env import CommandEnv
+from seaweedfs_tpu.shell.repl import run_command
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("mq_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True)
+    c.start_broker()
+    yield c
+    c.stop()
+
+
+def broker(cluster):
+    return cluster.broker_thread.url
+
+
+def publish(cluster, topic, records, ns="default"):
+    r = requests.post(f"{broker(cluster)}/topics/{ns}/{topic}/publish",
+                      json={"records": records}, timeout=30)
+    assert r.status_code == 200, r.text
+    return r.json()["acks"]
+
+
+def subscribe(cluster, topic, partition, offset=0, ns="default",
+              idle=0.3, limit=0):
+    r = requests.get(
+        f"{broker(cluster)}/topics/{ns}/{topic}/subscribe",
+        params={"partition": partition, "offset": offset,
+                "idle_timeout": idle, "limit": limit}, timeout=60)
+    assert r.status_code == 200, r.text
+    return [json.loads(x) for x in r.text.splitlines() if x.strip()]
+
+
+class TestTopicLifecycle:
+    def test_create_list_describe_delete(self, cluster):
+        b = broker(cluster)
+        r = requests.post(f"{b}/topics/default/events",
+                          json={"partitions": 3})
+        assert r.status_code == 201
+        assert r.json()["partitions"] == 3
+        topics = requests.get(f"{b}/topics").json()["topics"]
+        assert {"namespace": "default", "name": "events",
+                "partitions": 3} in topics
+        d = requests.get(f"{b}/topics/default/events").json()
+        assert len(d["state"]) == 3
+        assert requests.delete(
+            f"{b}/topics/default/events").status_code == 204
+        assert requests.get(
+            f"{b}/topics/default/events").status_code == 404
+
+    def test_cannot_shrink(self, cluster):
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/wide",
+                      json={"partitions": 4})
+        r = requests.post(f"{b}/topics/default/wide",
+                          json={"partitions": 2})
+        assert r.status_code == 409
+
+    def test_publish_unknown_topic_404(self, cluster):
+        r = requests.post(
+            f"{broker(cluster)}/topics/default/nope/publish",
+            json={"key": "k", "value": "v"})
+        assert r.status_code == 404
+
+
+class TestPubSub:
+    def test_same_key_same_partition(self, cluster):
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/orders",
+                      json={"partitions": 4})
+        acks = publish(cluster, "orders",
+                       [{"key": "user-1", "value": f"o{i}"}
+                        for i in range(5)])
+        parts = {a["partition"] for a in acks}
+        assert len(parts) == 1
+        assert [a["offset"] for a in acks] == list(range(5))
+
+    def test_subscribe_replay_and_follow(self, cluster):
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/logs",
+                      json={"partitions": 1})
+        publish(cluster, "logs",
+                [{"key": "a", "value": f"line-{i}"} for i in range(10)])
+        got = subscribe(cluster, "logs", 0)
+        assert [r["v"] for r in got] == [f"line-{i}" for i in range(10)]
+        assert [r["o"] for r in got] == list(range(10))
+        # resume from an offset
+        got = subscribe(cluster, "logs", 0, offset=7)
+        assert [r["v"] for r in got] == ["line-7", "line-8", "line-9"]
+
+    def test_subscribe_after_flush(self, cluster):
+        """Records must survive the memory->filer segment flush."""
+        import time
+
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/flushy",
+                      json={"partitions": 1})
+        publish(cluster, "flushy",
+                [{"key": "k", "value": f"v{i}"} for i in range(20)])
+        time.sleep(1.5)  # > SEG_FLUSH_AGE: records now in the filer
+        got = subscribe(cluster, "flushy", 0)
+        assert len(got) == 20
+        # and new records continue after the flushed ones
+        publish(cluster, "flushy", [{"key": "k", "value": "after"}])
+        got = subscribe(cluster, "flushy", 0, offset=20)
+        assert [r["v"] for r in got] == ["after"]
+
+    def test_binary_value_round_trip(self, cluster):
+        import base64
+
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/bin", json={"partitions": 1})
+        blob = bytes(range(256))
+        r = requests.post(
+            f"{b}/topics/default/bin/publish",
+            json={"key": "k",
+                  "value64": base64.b64encode(blob).decode()})
+        assert r.status_code == 200
+        got = subscribe(cluster, "bin", 0)
+        assert base64.b64decode(got[0]["v64"]) == blob
+
+
+class TestDurability:
+    def test_broker_restart_preserves_offsets(self, cluster):
+        import time
+
+        b = broker(cluster)
+        requests.post(f"{b}/topics/default/durable",
+                      json={"partitions": 2})
+        publish(cluster, "durable",
+                [{"key": f"k{i}", "value": f"v{i}"} for i in range(12)])
+        time.sleep(1.5)  # let segments flush
+        before = requests.get(f"{b}/topics/default/durable").json()
+        # restart the broker
+        cluster.broker_thread.stop()
+        new_url = cluster.start_broker()
+        after = requests.get(
+            f"{new_url}/topics/default/durable").json()
+        assert sorted(p["next_offset"] for p in after["state"]) == \
+            sorted(p["next_offset"] for p in before["state"])
+        # replay still works through the new broker
+        total = sum(len(subscribe(cluster, "durable", p))
+                    for p in range(2))
+        assert total == 12
+
+
+class TestShell:
+    def test_mq_topic_commands(self, cluster):
+        env = CommandEnv(cluster.master_url,
+                         filer_url=cluster.filer_url)
+        out = run_command(
+            env, "mq.topic.create -topic=shelltest -partitions=2")
+        assert out["partitions"] == 2
+        topics = run_command(env, "mq.topic.list")["topics"]
+        assert any(t["name"] == "shelltest" for t in topics)
+        d = run_command(env, "mq.topic.describe -topic=shelltest")
+        assert len(d["state"]) == 2
+        assert "deleted" in run_command(
+            env, "mq.topic.delete -topic=shelltest")

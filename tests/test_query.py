@@ -43,6 +43,20 @@ not json at all
         out = self.q(["name"], Filter("addr.city", "=", "sf"))
         assert [d["name"] for d in out] == ["bob"]
 
+    def test_pretty_printed_doc(self):
+        import json as _json
+        pretty = _json.dumps({"name": "zed", "age": 41},
+                             indent=2).encode()
+        out = list(query_json_bytes(pretty, ["name"]))
+        assert out == [{"name": "zed"}]
+
+    def test_float_constant_not_truncated(self):
+        doc = b'{"age": 29}'
+        assert list(query_json_bytes(doc, [],
+                                     Filter("age", ">=", "29.5"))) == []
+        assert list(query_json_bytes(doc, [],
+                                     Filter("age", "<", "29.5")))
+
     def test_single_doc_and_array(self):
         single = b'{"a": 1}'
         assert list(query_json_bytes(single, [])) == [{"a": 1}]
