@@ -30,6 +30,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="comma-separated ip:port of all masters (HA mode)")
     p.add_argument("-raftDir", dest="raft_dir", default="",
                    help="raft log/term persistence dir")
+    p.add_argument("-admin.scripts", dest="admin_scripts",
+                   default="",
+                   help="semicolon-separated shell maintenance commands "
+                        "run periodically by the leader, e.g. "
+                        "'volume.vacuum; volume.fix.replication'")
+    p.add_argument("-admin.scriptInterval",
+                   dest="admin_script_interval", type=float,
+                   default=60.0)
 
     p = sub.add_parser("volume", help="start a volume server")
     p.add_argument("-port", type=int, default=8080)
@@ -379,12 +387,17 @@ def _run_master(args) -> int:
         print(f"-raftDir not set; persisting raft state to {raft_dir}")
     if raft_dir:
         os.makedirs(raft_dir, exist_ok=True)
+    scripts = [s.strip() for s in args.admin_scripts.split(";")
+               if s.strip()]
     ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20,
                       default_replication=args.defaultReplication,
                       jwt_secret=args.jwt_secret,
                       me=f"{args.ip}:{args.port}", peers=peers,
-                      raft_state_dir=raft_dir or None)
+                      raft_state_dir=raft_dir or None,
+                      admin_scripts=scripts,
+                      admin_script_interval=args.admin_script_interval)
     t = ServerThread(ms.app, host=args.ip, port=args.port).start()
+    ms.admin_scripts_url = t.url
     print(f"master listening on {t.url}")
     run_apps_forever([t])
     return 0

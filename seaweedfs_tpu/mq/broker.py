@@ -152,19 +152,31 @@ class BrokerServer:
         (broker_server.go:32 keepConnectedToMaster)."""
         while not self.address:
             await asyncio.sleep(0.02)
-        while True:
+        try:
+            while True:
+                try:
+                    async with self._session.post(
+                            f"{self.master_url}/cluster/announce",
+                            json={"address": self.address,
+                                  "type": "broker"},
+                            allow_redirects=True) as resp:
+                        await resp.read()
+                except Exception:
+                    pass
+                await asyncio.sleep(self.announce_pulse)
+        except asyncio.CancelledError:
+            # deregister so shell commands don't route to a dead broker
+            # for the membership TTL window
             try:
                 async with self._session.post(
                         f"{self.master_url}/cluster/announce",
-                        json={"address": self.address,
-                              "type": "broker"},
+                        json={"address": self.address, "type": "broker",
+                              "leave": True},
                         allow_redirects=True) as resp:
                     await resp.read()
-            except asyncio.CancelledError:
-                return
             except Exception:
                 pass
-            await asyncio.sleep(self.announce_pulse)
+            raise
 
     # -- filer IO -------------------------------------------------------
     async def _filer(self, method: str, path: str, **kw):
@@ -240,26 +252,30 @@ class BrokerServer:
         return sorted(segs)
 
     async def _flush_partition(self, part: Partition) -> None:
+        # records stay in the tail until the segment write is durable:
+        # removing them first would open a window where a subscriber
+        # sees neither the tail copy nor the (in-flight) segment and
+        # silently skips offsets. Duplicates across tail+segment are
+        # harmless — subscribe filters by offset.
         async with part.lock:
             if not part.tail:
                 return
-            records, base = part.tail, part.tail_base
-            part.tail = []
-            part.tail_base = part.next_offset
-            part.tail_bytes = 0
-            part.last_flush = time.monotonic()
+            records = list(part.tail)
+            base = part.tail_base
         body = "\n".join(json.dumps(r, separators=(",", ":"))
                          for r in records) + "\n"
         resp = await self._filer("POST", f"{part.dir}/seg-{base:020d}",
                                  data=body.encode())
+        await resp.release()
         if resp.status >= 300:
-            # put the records back; publishers already got their
-            # offsets so order must be preserved
-            async with part.lock:
-                part.tail = records + part.tail
-                part.tail_base = base
-                part.tail_bytes += len(body)
             raise IOError(f"segment flush failed: {resp.status}")
+        async with part.lock:
+            del part.tail[:len(records)]
+            part.tail_base = base + len(records)
+            part.tail_bytes = sum(
+                len(r.get("v", r.get("v64", ""))) + len(r["k"]) + 32
+                for r in part.tail)
+            part.last_flush = time.monotonic()
 
     async def _flush_loop(self) -> None:
         while True:
@@ -310,6 +326,7 @@ class BrokerServer:
         resp = await self._filer(
             "POST", f"{topic.dir}/topic.conf",
             data=json.dumps(topic.conf()).encode())
+        await resp.release()
         if resp.status >= 300:
             return web.json_response(
                 {"error": f"filer: {resp.status}"}, status=502)
@@ -331,8 +348,9 @@ class BrokerServer:
 
     async def handle_delete(self, req: web.Request) -> web.Response:
         topic = self._topic(req)
-        await self._filer("DELETE", topic.dir,
-                          params={"recursive": "true"})
+        resp = await self._filer("DELETE", topic.dir,
+                                 params={"recursive": "true"})
+        await resp.release()
         del self.topics[(topic.namespace, topic.name)]
         for i in range(topic.partitions):
             self.parts.pop((topic.namespace, topic.name, i), None)

@@ -35,6 +35,7 @@ XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 BUCKETS_DIR = "/buckets"
 UPLOADS_DIR = ".uploads"
 IDENTITIES_KV_KEY = "s3/identities"  # filer KV key holding the config
+CIRCUIT_BREAKER_KV_KEY = "s3/circuit_breaker"  # limits, hot-reloaded
 
 
 class S3Error(Exception):
@@ -101,11 +102,15 @@ def _iso(ts: float) -> str:
 class S3ApiServer:
     def __init__(self, filer_url: str, iam_config: dict | None = None,
                  region: str = "us-east-1",
-                 identity_refresh_seconds: float = 5.0):
+                 identity_refresh_seconds: float = 5.0,
+                 circuit_breaker_config: dict | None = None):
+        from .circuit_breaker import CircuitBreaker
+
         self.filer_url = filer_url.rstrip("/")
         self.region = region
         self.iam = IdentityAccessManagement(iam_config)
         self.identity_refresh_seconds = identity_refresh_seconds
+        self.circuit_breaker = CircuitBreaker(circuit_breaker_config)
         self._load_identities_from_filer()
         self.app = self._build_app()
         # hot reload of filer-stored identities (the reference reloads
@@ -177,20 +182,50 @@ class S3ApiServer:
     # -- auth + dispatch ------------------------------------------------
     def _load_identities_from_filer(self) -> None:
         """Pick up s3.configure-style identities stored in the filer
-        (auth_credentials_subscribe.go's role)."""
+        (auth_credentials_subscribe.go's role), and the circuit-breaker
+        limits (the reference keeps them at
+        /etc/s3/circuit_breaker.json, hot-reloaded the same way)."""
         try:
             resp = requests.get(
                 f"{self.filer_url}/kv/{IDENTITIES_KV_KEY}", timeout=5)
             if resp.status_code == 200:
-                import json
                 self.iam.load_config(json.loads(resp.content))
+        except requests.RequestException:
+            pass
+        try:
+            resp = requests.get(
+                f"{self.filer_url}/kv/{CIRCUIT_BREAKER_KV_KEY}",
+                timeout=5)
+            if resp.status_code == 200:
+                self.circuit_breaker.load_config(
+                    json.loads(resp.content))
         except requests.RequestException:
             pass
 
     async def dispatch(self, req: web.Request) -> web.Response:
+        from .circuit_breaker import CircuitOpen
+
         tail = req.match_info["tail"]
         bucket, _, key = tail.partition("/")
         payload = await req.read()
+        cb_action = "write" if req.method in ("PUT", "POST", "DELETE") \
+            else "read"
+        try:
+            with self.circuit_breaker.acquire(cb_action, bucket,
+                                              len(payload)):
+                return await self._dispatch_authed(req, bucket, key,
+                                                   payload)
+        except CircuitOpen as e:
+            # s3api_circuit_breaker.go rejects with TooManyRequests
+            raise S3Error("TooManyRequests", str(e), 503)
+
+    async def _dispatch_authed(self, req: web.Request, bucket: str,
+                               key: str, payload: bytes) -> web.Response:
+        if req.method == "POST" and bucket and not key \
+                and req.content_type.startswith("multipart/form-data"):
+            # browser form upload (POST policy) authenticates via the
+            # signed policy document, not headers
+            return await self._post_policy_upload(req, bucket, payload)
         identity = self.iam.authenticate(
             req.method, req.path,
             {k: v for k, v in req.query.items()},
@@ -372,6 +407,68 @@ class S3ApiServer:
         return _xml_response(out)
 
     # -- object ---------------------------------------------------------
+    async def _post_policy_upload(self, req: web.Request, bucket: str,
+                                  payload: bytes) -> web.Response:
+        """Browser form upload with a signed POST policy
+        (s3api_object_handlers_postpolicy.go + policy/post-policy.go):
+        the form carries key/policy/credential/signature fields plus the
+        file; authentication is the SigV4 signature over the base64
+        policy document, and the decoded policy's expiration and key /
+        content-length conditions are enforced."""
+        import base64
+
+        from .sigv4_client import verify_policy_signature
+
+        fields, file_data, file_name = _parse_form(
+            payload, req.headers.get("Content-Type", ""))
+        key = fields.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument",
+                          "form upload needs a key field", 400)
+        key = key.replace("${filename}", file_name or "file")
+        if not self.iam.is_open:
+            for f in ("policy", "x-amz-credential", "x-amz-signature"):
+                if f not in fields:
+                    raise S3Error("AccessDenied",
+                                  f"form upload missing {f}", 403)
+            access_key = fields["x-amz-credential"].split("/")[0]
+            identity, secret = self.iam.lookup(access_key)
+            if not identity.allows(ACTION_WRITE, bucket):
+                raise S3Error("AccessDenied",
+                              f"write denied on {bucket}", 403)
+            if not verify_policy_signature(
+                    fields["policy"], fields["x-amz-credential"],
+                    fields.get("x-amz-date", ""),
+                    fields["x-amz-signature"], secret):
+                raise S3Error("SignatureDoesNotMatch",
+                              "policy signature mismatch", 403)
+            try:
+                policy = json.loads(base64.b64decode(fields["policy"]))
+            except (ValueError, json.JSONDecodeError):
+                raise S3Error("InvalidPolicyDocument",
+                              "policy is not base64 JSON", 400)
+            _check_policy(policy, bucket, key, len(file_data))
+        await self._require_bucket(bucket)
+        mime = fields.get("Content-Type", fields.get("content-type", ""))
+        headers = {"Content-Type": mime} if mime else {}
+        resp = await self._filer("POST", self._fpath(bucket, key),
+                                 params={"collection": bucket},
+                                 data=file_data, headers=headers)
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
+        etag = resp.json().get("etag", "")
+        status = int(fields.get("success_action_status", "204"))
+        if status not in (200, 201, 204):
+            status = 204
+        if status == 201:
+            root = _xml("PostResponse")
+            root.append(_leaf("Bucket", bucket))
+            root.append(_leaf("Key", key))
+            root.append(_leaf("ETag", f'"{etag}"'))
+            return _xml_response(root, status=201)
+        return web.Response(status=status,
+                            headers={"ETag": f'"{etag}"'})
+
     async def _put_object(self, bucket: str, key: str, payload: bytes,
                           req: web.Request) -> web.Response:
         await self._require_bucket(bucket)
@@ -814,3 +911,78 @@ class S3ApiServer:
         await self._filer("PUT", self._fpath(bucket, key) + "?meta=1",
                           json=meta)
         return web.Response(status=200 if method == "PUT" else 204)
+
+
+def _parse_form(payload: bytes, content_type: str) \
+        -> tuple[dict, bytes, str]:
+    """multipart/form-data body -> (fields, file bytes, file name)."""
+    import email
+    import email.policy
+
+    msg = email.message_from_bytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n"
+        + payload, policy=email.policy.HTTP)
+    fields: dict[str, str] = {}
+    file_data, file_name = b"", ""
+    for part in msg.iter_parts():
+        name = part.get_param("name", header="content-disposition")
+        if not name:
+            continue
+        body = part.get_payload(decode=True) or b""
+        if name == "file":
+            file_data = body
+            file_name = part.get_filename("") or ""
+        else:
+            fields[name] = body.decode("utf-8", "replace")
+    return fields, file_data, file_name
+
+
+def _check_policy(policy: dict, bucket: str, key: str,
+                  size: int) -> None:
+    """Enforce a decoded POST policy's expiration + conditions
+    (policy/post-policy.go). A signed policy is a bearer credential:
+    expiration is mandatory and bucket conditions must be honored, or a
+    leaked form could be replayed forever / against other buckets."""
+    import calendar
+
+    exp = policy.get("expiration", "")
+    if not exp:
+        raise S3Error("InvalidPolicyDocument",
+                      "policy must carry an expiration", 400)
+    try:
+        dead = calendar.timegm(time.strptime(
+            exp.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
+    except ValueError:
+        raise S3Error("InvalidPolicyDocument",
+                      f"bad expiration {exp!r}", 400)
+    if time.time() > dead:
+        raise S3Error("AccessDenied", "policy expired", 403)
+
+    values = {"key": key, "bucket": bucket}
+
+    def enforce(op: str, field: str, val) -> None:
+        got = values.get(field)
+        if got is None:
+            return  # fields we don't model (acl, content-type, ...)
+        if op == "eq" and got != val:
+            raise S3Error("AccessDenied",
+                          f"{field} must equal {val!r}", 403)
+        if op == "starts-with" and not got.startswith(val):
+            raise S3Error("AccessDenied",
+                          f"{field} must start with {val!r}", 403)
+
+    for cond in policy.get("conditions", []):
+        if isinstance(cond, dict):
+            for ck, cv in cond.items():
+                enforce("eq", ck, cv)
+        elif isinstance(cond, list) and len(cond) == 3:
+            op, field = cond[0], str(cond[1]).lstrip("$")
+            if op == "content-length-range":
+                lo, hi = int(cond[1]), int(cond[2])
+                if not lo <= size <= hi:
+                    raise S3Error(
+                        "EntityTooLarge" if size > hi
+                        else "EntityTooSmall",
+                        f"size {size} outside [{lo}, {hi}]", 400)
+            else:
+                enforce(op, field, cond[2])

@@ -33,14 +33,19 @@ class Cluster:
                  filer_store: str = "memory",
                  with_s3: bool = False,
                  s3_config: dict | None = None,
-                 tier_backends: dict[str, dict] | None = None):
+                 tier_backends: dict[str, dict] | None = None,
+                 admin_scripts: list[str] | None = None,
+                 admin_script_interval: float = 60.0):
         """topology: optional per-server (data_center, rack) labels."""
         self.base_dir = base_dir
         self.master = MasterServer(
             volume_size_limit=volume_size_limit,
             default_replication=default_replication,
-            pulse_seconds=pulse_seconds, jwt_secret=jwt_secret)
+            pulse_seconds=pulse_seconds, jwt_secret=jwt_secret,
+            admin_scripts=admin_scripts,
+            admin_script_interval=admin_script_interval)
         self.master_thread = ServerThread(self.master.app).start()
+        self.master.admin_scripts_url = self.master_thread.url
         self.volume_servers: list[VolumeServer] = []
         self.volume_threads: list[ServerThread] = []
         self.stores: list[Store] = []

@@ -39,7 +39,9 @@ class MasterServer:
                  me: str = "",
                  peers: list[str] | None = None,
                  raft_state_dir: str | None = None,
-                 raft_tick: float = 1.0):
+                 raft_tick: float = 1.0,
+                 admin_scripts: list[str] | None = None,
+                 admin_script_interval: float = 60.0):
         self.topo = Topology(volume_size_limit, pulse_seconds)
         self.default_replication = default_replication
         if sequencer == "memory" and peers:
@@ -67,7 +69,72 @@ class MasterServer:
             self.raft = RaftNode(me, peers, HTTPTransport(),
                                  state_dir=raft_state_dir, tick=raft_tick,
                                  on_apply=self._on_raft_apply)
+        # periodic maintenance scripts (master_server.go:259-308
+        # startAdminScripts): shell command lines run by the leader on a
+        # timer, e.g. ["volume.vacuum", "volume.fix.replication",
+        # "ec.rebuild"]. admin_scripts_url is this master's own HTTP
+        # address, set by the runner once the listen socket binds.
+        self.admin_scripts = admin_scripts or []
+        self.admin_script_interval = admin_script_interval
+        self.admin_scripts_url = ""
+        self.admin_script_runs: list[dict] = []
+        self._admin_task: asyncio.Task | None = None
         self.app = self._build_app()
+
+    async def _start_admin_scripts(self, app) -> None:
+        self._admin_task = asyncio.create_task(
+            self._admin_scripts_loop())
+
+    async def _stop_admin_scripts(self, app) -> None:
+        if self._admin_task is not None:
+            self._admin_task.cancel()
+            try:
+                await self._admin_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _admin_scripts_loop(self) -> None:
+        from ..shell.env import CommandEnv, ShellError
+        from ..shell.repl import run_command
+
+        while not self.admin_scripts_url:
+            await asyncio.sleep(0.05)
+        while True:
+            await asyncio.sleep(self.admin_script_interval)
+            if self.raft is not None and not self.raft.is_leader():
+                continue  # only the leader runs maintenance
+
+            # the cluster-wide admin lock lives in the filer DLM: find
+            # a live filer so maintenance serializes against operator
+            # shells (commands.go:78 confirmIsLocked)
+            filers = self.membership.list_nodes("filer")
+            filer_url = f"http://{filers[0].address}" if filers else ""
+
+            def run_all() -> list[dict]:
+                env = CommandEnv(self.admin_scripts_url,
+                                 filer_url=filer_url)
+                out = []
+                try:
+                    env.acquire_lock()
+                    for line in self.admin_scripts:
+                        try:
+                            run_command(env, line)
+                            out.append({"script": line, "ok": True})
+                        except (ShellError, Exception) as e:
+                            out.append({"script": line, "ok": False,
+                                        "error": str(e)})
+                finally:
+                    env.close()
+                return out
+
+            try:
+                runs = await asyncio.to_thread(run_all)
+                self.admin_script_runs.extend(runs)
+                del self.admin_script_runs[:-100]
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # lock contention etc: retry next tick
 
     def _on_raft_apply(self, cmd: dict) -> None:
         """Committed raft entries drive the topology's volume-id
@@ -111,6 +178,9 @@ class MasterServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/", self.handle_ui),
         ])
+        if self.admin_scripts:
+            app.on_startup.append(self._start_admin_scripts)
+            app.on_cleanup.append(self._stop_admin_scripts)
         if self.raft is not None:
             app.add_routes(self.raft.http_routes())
 

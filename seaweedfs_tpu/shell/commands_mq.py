@@ -20,34 +20,38 @@ def _broker(env: CommandEnv) -> str:
     return f"http://{nodes[0]['address']}"
 
 
-def mq_topic_list(env: CommandEnv) -> dict:
-    r = requests.get(f"{_broker(env)}/topics", timeout=30)
+def _call(method: str, url: str, what: str, **kw):
+    """Broker HTTP with shell-shaped errors: a broker that died inside
+    its membership-TTL window must read as a ShellError, not a
+    traceback."""
+    try:
+        r = requests.request(method, url, timeout=30, **kw)
+    except requests.RequestException as e:
+        raise ShellError(f"{what}: broker unreachable: {e}")
     if r.status_code >= 300:
-        raise ShellError(f"mq.topic.list: {r.text}")
-    return r.json()
+        raise ShellError(f"{what}: {r.text}")
+    return r
+
+
+def mq_topic_list(env: CommandEnv) -> dict:
+    return _call("GET", f"{_broker(env)}/topics",
+                 "mq.topic.list").json()
 
 
 def mq_topic_create(env: CommandEnv, namespace: str, name: str,
                     partitions: int = 4) -> dict:
-    r = requests.post(f"{_broker(env)}/topics/{namespace}/{name}",
-                      json={"partitions": partitions}, timeout=30)
-    if r.status_code >= 300:
-        raise ShellError(f"mq.topic.create: {r.text}")
-    return r.json()
+    return _call("POST", f"{_broker(env)}/topics/{namespace}/{name}",
+                 "mq.topic.create",
+                 json={"partitions": partitions}).json()
 
 
 def mq_topic_describe(env: CommandEnv, namespace: str,
                       name: str) -> dict:
-    r = requests.get(f"{_broker(env)}/topics/{namespace}/{name}",
-                     timeout=30)
-    if r.status_code >= 300:
-        raise ShellError(f"mq.topic.describe: {r.text}")
-    return r.json()
+    return _call("GET", f"{_broker(env)}/topics/{namespace}/{name}",
+                 "mq.topic.describe").json()
 
 
 def mq_topic_delete(env: CommandEnv, namespace: str, name: str) -> str:
-    r = requests.delete(f"{_broker(env)}/topics/{namespace}/{name}",
-                        timeout=30)
-    if r.status_code >= 300:
-        raise ShellError(f"mq.topic.delete: {r.text}")
+    _call("DELETE", f"{_broker(env)}/topics/{namespace}/{name}",
+          "mq.topic.delete")
     return f"deleted {namespace}/{name}"

@@ -1,0 +1,39 @@
+"""Master maintenance cron: periodic shell scripts run by the leader
+(reference weed/server/master_server.go:259-308 startAdminScripts).
+"""
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("cron_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                admin_scripts=["volume.grow -count=1 -collection=cron",
+                               "volume.vacuum -threshold=0.99"],
+                admin_script_interval=0.4)
+    yield c
+    c.stop()
+
+
+def test_scripts_run_and_take_effect(cluster):
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        runs = cluster.master.admin_script_runs
+        if len(runs) >= 2:
+            break
+        time.sleep(0.2)
+    runs = cluster.master.admin_script_runs
+    assert runs, "admin scripts never ran"
+    assert all(r["ok"] for r in runs), runs
+    # the grow script really created a volume in the 'cron' collection
+    vols = [v for n in cluster.master.topo.nodes.values()
+            for v in n.volumes.values() if v.collection == "cron"]
+    assert vols
+
+
+def test_scripts_bounded_history(cluster):
+    assert len(cluster.master.admin_script_runs) <= 100

@@ -1,0 +1,262 @@
+"""S3 circuit breaker + POST-policy form uploads
+(reference weed/s3api/s3api_circuit_breaker.go,
+s3api_object_handlers_postpolicy.go, policy/post-policy.go).
+"""
+import base64
+import json
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker, CircuitOpen
+from seaweedfs_tpu.s3.sigv4_client import sign_policy
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+class TestCircuitBreakerUnit:
+    def test_disabled_never_trips(self):
+        cb = CircuitBreaker()
+        with cb.acquire("read", "b", 1 << 40):
+            with cb.acquire("write", "b", 1 << 40):
+                pass
+
+    def test_global_count_limit(self):
+        cb = CircuitBreaker({"global": {"readCount": 2}})
+        with cb.acquire("read", "a"):
+            with cb.acquire("read", "b"):
+                with pytest.raises(CircuitOpen):
+                    with cb.acquire("read", "c"):
+                        pass
+        # released: can acquire again
+        with cb.acquire("read", "d"):
+            pass
+
+    def test_per_bucket_tighter_than_global(self):
+        cb = CircuitBreaker({"global": {"writeCount": 10},
+                             "buckets": {"hot": {"writeCount": 1}}})
+        with cb.acquire("write", "hot"):
+            with pytest.raises(CircuitOpen):
+                with cb.acquire("write", "hot"):
+                    pass
+            with cb.acquire("write", "cold"):
+                pass
+
+    def test_bytes_limit(self):
+        cb = CircuitBreaker({"global": {"writeBytes": 100}})
+        with pytest.raises(CircuitOpen):
+            with cb.acquire("write", "b", 101):
+                pass
+        with cb.acquire("write", "b", 60):
+            with pytest.raises(CircuitOpen):
+                with cb.acquire("write", "b", 60):
+                    pass
+        with cb.acquire("write", "b", 100):
+            pass
+
+    def test_reads_not_charged_to_write_limits(self):
+        cb = CircuitBreaker({"global": {"writeCount": 1}})
+        with cb.acquire("read", "b"):
+            with cb.acquire("write", "b"):
+                pass
+
+    def test_failed_acquire_releases_nothing(self):
+        cb = CircuitBreaker({"global": {"writeCount": 1,
+                                        "writeBytes": 10}})
+        with pytest.raises(CircuitOpen):
+            with cb.acquire("write", "b", 11):
+                pass
+        with cb.acquire("write", "b", 10):  # counters not leaked
+            pass
+
+
+CFG = {"identities": [{"name": "w", "credentials": [
+    {"accessKey": "AK", "secretKey": "SK"}],
+    "actions": ["Admin", "Read", "Write", "List"]}]}
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("s3_pp")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True, with_s3=True)
+    yield c
+    c.stop()
+
+
+def make_policy_fields(key_prefix, expire_in=300, max_size=1 << 20):
+    policy = {
+        "expiration": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + expire_in)),
+        "conditions": [["starts-with", "$key", key_prefix],
+                       ["content-length-range", 1, max_size]],
+    }
+    b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    return sign_policy(b64, "AK", "SK")
+
+
+class TestPostPolicyOpen:
+    def test_anonymous_form_upload_when_open(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/forms")
+        r = requests.post(
+            f"{s3}/forms",
+            files={"file": ("report.txt", b"form body")},
+            data={"key": "uploads/${filename}"})
+        assert r.status_code == 204, r.text
+        got = requests.get(f"{s3}/forms/uploads/report.txt")
+        assert got.content == b"form body"
+
+    def test_success_action_status_201(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/forms")
+        r = requests.post(
+            f"{s3}/forms",
+            files={"file": ("x.bin", b"abc")},
+            data={"key": "x.bin", "success_action_status": "201"})
+        assert r.status_code == 201
+        assert "<Key>x.bin</Key>" in r.text
+
+
+class TestPostPolicySigned:
+    @pytest.fixture(scope="class")
+    def secured(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("s3_pp_sec")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True, s3_config=CFG)
+        from seaweedfs_tpu.s3.sigv4_client import sign_headers
+        s3 = c.s3_url
+        h = sign_headers("PUT", f"{s3}/secure", "AK", "SK")
+        assert requests.put(f"{s3}/secure", headers=h).status_code == 200
+        yield c
+        c.stop()
+
+    def test_signed_policy_upload(self, secured):
+        s3 = secured.s3_url
+        fields = make_policy_fields("inbox/")
+        fields["key"] = "inbox/doc.txt"
+        r = requests.post(f"{s3}/secure", data=fields,
+                          files={"file": ("doc.txt", b"signed!")})
+        assert r.status_code == 204, r.text
+
+    def test_bad_signature_rejected(self, secured):
+        s3 = secured.s3_url
+        fields = make_policy_fields("inbox/")
+        fields["key"] = "inbox/doc2.txt"
+        fields["x-amz-signature"] = "0" * 64
+        r = requests.post(f"{s3}/secure", data=fields,
+                          files={"file": ("doc2.txt", b"nope")})
+        assert r.status_code == 403
+
+    def test_key_outside_policy_rejected(self, secured):
+        s3 = secured.s3_url
+        fields = make_policy_fields("inbox/")
+        fields["key"] = "outbox/escape.txt"
+        r = requests.post(f"{s3}/secure", data=fields,
+                          files={"file": ("e.txt", b"x")})
+        assert r.status_code == 403
+
+    def test_expired_policy_rejected(self, secured):
+        s3 = secured.s3_url
+        fields = make_policy_fields("inbox/", expire_in=-10)
+        fields["key"] = "inbox/late.txt"
+        r = requests.post(f"{s3}/secure", data=fields,
+                          files={"file": ("l.txt", b"x")})
+        assert r.status_code == 403
+
+    def test_oversize_rejected(self, secured):
+        s3 = secured.s3_url
+        fields = make_policy_fields("inbox/", max_size=4)
+        fields["key"] = "inbox/big.txt"
+        r = requests.post(f"{s3}/secure", data=fields,
+                          files={"file": ("b.txt", b"too big")})
+        assert r.status_code == 400
+
+    def test_missing_policy_rejected_when_secured(self, secured):
+        s3 = secured.s3_url
+        r = requests.post(f"{s3}/secure", data={"key": "inbox/x"},
+                          files={"file": ("x", b"x")})
+        assert r.status_code == 403
+
+
+class TestBreakerIntegration:
+    def test_write_bytes_limit_rejects_large_put(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("s3_cb")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True)
+        c.s3.circuit_breaker.load_config(
+            {"global": {"writeBytes": 1024}})
+        try:
+            s3 = c.s3_url
+            assert requests.put(f"{s3}/cb").status_code == 200
+            ok = requests.put(f"{s3}/cb/small", data=b"x" * 512)
+            assert ok.status_code == 200
+            big = requests.put(f"{s3}/cb/big", data=b"x" * 2048)
+            assert big.status_code == 503
+            assert "TooManyRequests" in big.text
+        finally:
+            c.stop()
+
+
+class TestBreakerKvReload:
+    def test_limits_hot_loaded_from_filer_kv(self, tmp_path_factory):
+        from seaweedfs_tpu.s3.server import CIRCUIT_BREAKER_KV_KEY
+        c = Cluster(str(tmp_path_factory.mktemp("s3_cb_kv")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True)
+        try:
+            r = requests.put(
+                f"{c.filer_url}/kv/{CIRCUIT_BREAKER_KV_KEY}",
+                data=json.dumps({"global": {"writeBytes": 256}}))
+            assert r.status_code < 300
+            deadline = time.time() + 15
+            while time.time() < deadline and \
+                    not c.s3.circuit_breaker.enabled:
+                time.sleep(0.3)
+            assert c.s3.circuit_breaker.enabled
+            s3 = c.s3_url
+            requests.put(f"{s3}/kvcb")
+            big = requests.put(f"{s3}/kvcb/big", data=b"x" * 1024)
+            assert big.status_code == 503
+        finally:
+            c.stop()
+
+
+class TestPolicyBucketScope:
+    def test_bucket_condition_blocks_replay(self, tmp_path_factory):
+        from seaweedfs_tpu.s3.sigv4_client import sign_headers
+        c = Cluster(str(tmp_path_factory.mktemp("s3_pp_bkt")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True, s3_config=CFG)
+        try:
+            s3 = c.s3_url
+            for b in ("scoped-a", "scoped-b"):
+                h = sign_headers("PUT", f"{s3}/{b}", "AK", "SK")
+                assert requests.put(f"{s3}/{b}",
+                                    headers=h).status_code == 200
+            policy = {
+                "expiration": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ",
+                    time.gmtime(time.time() + 300)),
+                "conditions": [{"bucket": "scoped-a"},
+                               ["starts-with", "$key", ""]],
+            }
+            b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+            fields = sign_policy(b64, "AK", "SK")
+            fields["key"] = "f.txt"
+            ok = requests.post(f"{s3}/scoped-a", data=fields,
+                               files={"file": ("f.txt", b"x")})
+            assert ok.status_code == 204, ok.text
+            replay = requests.post(f"{s3}/scoped-b", data=fields,
+                                   files={"file": ("f.txt", b"x")})
+            assert replay.status_code == 403
+            # and a policy without expiration is rejected outright
+            p2 = {"conditions": [["starts-with", "$key", ""]]}
+            b642 = base64.b64encode(json.dumps(p2).encode()).decode()
+            f2 = sign_policy(b642, "AK", "SK")
+            f2["key"] = "g.txt"
+            r = requests.post(f"{s3}/scoped-a", data=f2,
+                              files={"file": ("g.txt", b"x")})
+            assert r.status_code == 400
+        finally:
+            c.stop()
