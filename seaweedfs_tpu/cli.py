@@ -68,7 +68,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-store", default="memory",
-                   help="metadata store: memory | sqlite")
+                   help="metadata store: memory | sqlite | leveldb")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")

@@ -5,10 +5,13 @@ Equivalent of /root/reference/weed/filer/filerstore.go:21-44
 register themselves in `STORES` by type string, like the reference's
 `init()` -> `filer.Stores` (weed/filer/leveldb/leveldb_store.go:29-31).
 
-Two embedded stores ship in-tree:
+Three embedded stores ship in-tree:
 - `memory`: dict-backed, for tests and ephemeral filers.
 - `sqlite`: stdlib sqlite3, the durable single-file embedded store
-  (the reference's leveldb/sqlite class, weed/filer/sqlite/).
+  (weed/filer/sqlite/).
+- `leveldb`: the weedkv LSM engine (WAL + memtable + sorted segments),
+  the counterpart of the reference's default goleveldb store
+  (weed/filer/leveldb/).
 External-DB plugins (redis/mysql/...) would register the same way.
 """
 from __future__ import annotations
@@ -262,3 +265,100 @@ class SqliteStore(FilerStore):
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+@register_store("leveldb")
+class WeedKvStore(FilerStore):
+    """Filer store over the embedded weedkv sorted-KV engine — the
+    counterpart of the reference's default leveldb store
+    (weed/filer/leveldb/leveldb_store.go, including its
+    dir + 0x00 + name key layout, genDirectoryKeyPrefix)."""
+
+    SEP = b"\x00"
+    KV_PREFIX = b"kv\x01"
+    ENTRY_PREFIX = b"e\x01"
+
+    def __init__(self, path: str = "filerdb", **_):
+        from .weedkv import WeedKV
+
+        if path in ("", ":memory:"):
+            raise ValueError("leveldb store needs a directory path")
+        self.db = WeedKV(path)
+
+    def _ekey(self, d: str, n: str) -> bytes:
+        return self.ENTRY_PREFIX + d.encode() + self.SEP + n.encode()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = entry.dir_and_name
+        self.db.put(self._ekey(d, n),
+                    json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry | None:
+        d, n = _split(path)
+        if not n:
+            return None
+        raw = self.db.get(self._ekey(d, n))
+        return Entry.from_dict(json.loads(raw)) if raw else None
+
+    def delete_entry(self, path: str) -> None:
+        d, n = _split(path)
+        self.db.delete(self._ekey(d, n))
+
+    def delete_folder_children(self, path: str) -> None:
+        path = _norm(path)
+        # every directory at or under `path` is a contiguous key range
+        # per-directory; enumerate them via the entry scan
+        prefix = self.ENTRY_PREFIX + path.encode()
+        for k, _v in self.db.scan(prefix, _range_end(prefix)):
+            rest = k[len(self.ENTRY_PREFIX):]
+            d = rest.split(self.SEP, 1)[0].decode()
+            if d == path or d.startswith(
+                    path if path.endswith("/") else path + "/"):
+                self.db.delete(k)
+
+    def list_directory_entries(self, dirpath: str, start_from: str = "",
+                               inclusive: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dirpath = _norm(dirpath)
+        base = self.ENTRY_PREFIX + dirpath.encode() + self.SEP
+        lo = base + max(prefix, start_from).encode() \
+            if (prefix or start_from) else base
+        out: list[Entry] = []
+        # +1 covers the possibly-skipped exclusive start_from row
+        for k, v in self.db.scan(lo, _range_end(base),
+                                 limit=limit + 1 if limit else 0):
+            name = k[len(base):].decode()
+            if prefix and not name.startswith(prefix):
+                break  # sorted scan: past the prefix range
+            if start_from and name == start_from and not inclusive:
+                continue
+            out.append(Entry.from_dict(json.loads(v)))
+            if len(out) >= limit:
+                break
+        return out
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self.db.put(self.KV_PREFIX + key.encode(), value)
+
+    def kv_get(self, key: str) -> bytes | None:
+        return self.db.get(self.KV_PREFIX + key.encode())
+
+    def kv_delete(self, key: str) -> None:
+        self.db.delete(self.KV_PREFIX + key.encode())
+
+    def close(self) -> None:
+        self.db.close()
+
+
+def _range_end(prefix: bytes) -> bytes:
+    """Smallest byte string greater than every string with `prefix`."""
+    out = bytearray(prefix)
+    while out:
+        if out[-1] != 0xFF:
+            out[-1] += 1
+            return bytes(out)
+        out.pop()
+    return b"\xff" * 16

@@ -1,0 +1,245 @@
+"""Embedded sorted-KV engine ("weedkv") — the leveldb-class store.
+
+Equivalent of the role vendored goleveldb plays for the reference's
+default filer store (/root/reference/weed/filer/leveldb/
+leveldb_store.go): an embedded, ordered, durable key-value log —
+re-designed small instead of ported:
+
+- writes go to a write-ahead log, then a memtable (dict)
+- the memtable flushes to immutable sorted segment files (.sst, JSON
+  lines sorted by key) when it grows past a threshold
+- reads check memtable then segments newest-to-oldest; deletes are
+  tombstones until compaction
+- when segments pile up they are merge-compacted into one (tombstones
+  dropped)
+- reopen = load segment indexes + replay the WAL
+
+Keys are bytes and sort lexicographically (the property the filer
+store's directory scans rely on). Values are bytes.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+
+TOMBSTONE = None  # in-memory marker
+
+MEMTABLE_FLUSH_ENTRIES = 4096
+MEMTABLE_FLUSH_BYTES = 4 << 20
+COMPACT_SEGMENT_COUNT = 8
+
+
+def _enc(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _dec(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class _Segment:
+    """One immutable sorted file with its key index in memory."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.keys: list[bytes] = []
+        self.values: list[bytes | None] = []
+        with open(path, "r") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                d = json.loads(line)
+                self.keys.append(_dec(d["k"]))
+                self.values.append(None if d.get("t")
+                                   else _dec(d.get("v", "")))
+
+    def get(self, key: bytes) -> tuple[bool, bytes | None]:
+        """-> (found, value-or-tombstone)."""
+        import bisect
+
+        i = bisect.bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return True, self.values[i]
+        return False, None
+
+    @staticmethod
+    def write(path: str, items: list[tuple[bytes, bytes | None]]) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for k, v in items:
+                rec = {"k": _enc(k)}
+                if v is None:
+                    rec["t"] = 1
+                else:
+                    rec["v"] = _enc(v)
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+
+class WeedKV:
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self._lock = threading.RLock()
+        self._mem: dict[bytes, bytes | None] = {}
+        self._mem_bytes = 0
+        self._segments: list[_Segment] = []  # oldest .. newest
+        self._next_seg = 0
+        for name in sorted(os.listdir(dirpath)):
+            if name.endswith(".sst"):
+                self._segments.append(
+                    _Segment(os.path.join(dirpath, name)))
+                self._next_seg = max(self._next_seg,
+                                     int(name[:-4]) + 1)
+        self._wal_path = os.path.join(dirpath, "wal.log")
+        self._replay_wal()
+        self._wal = open(self._wal_path, "a")
+
+    # -- WAL ------------------------------------------------------------
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        good = 0
+        with open(self._wal_path, "rb") as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    break  # torn tail from a crash mid-append
+                k = _dec(d["k"])
+                v = None if d.get("t") else _dec(d.get("v", ""))
+                self._mem[k] = v
+                self._mem_bytes += len(k) + len(v or b"")
+                good += len(line)
+        if good < os.path.getsize(self._wal_path):
+            # drop the torn tail NOW: appending new records after the
+            # garbage would make every later replay stop at the same
+            # spot and silently lose those acknowledged writes
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(good)
+
+    def _wal_append(self, key: bytes, value: bytes | None) -> None:
+        rec = {"k": _enc(key)}
+        if value is None:
+            rec["t"] = 1
+        else:
+            rec["v"] = _enc(value)
+        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.flush()
+
+    # -- core ops -------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._wal_append(key, value)
+            self._mem[key] = value
+            self._mem_bytes += len(key) + len(value)
+            self._maybe_flush()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._wal_append(key, None)
+            self._mem[key] = TOMBSTONE
+            self._mem_bytes += len(key)
+            self._maybe_flush()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            if key in self._mem:
+                return self._mem[key]
+            for seg in reversed(self._segments):
+                found, v = seg.get(key)
+                if found:
+                    return v
+            return None
+
+    def scan(self, start: bytes, end: bytes,
+             limit: int = 0) -> list[tuple[bytes, bytes]]:
+        """Live (key, value) with start <= key < end, sorted; at most
+        `limit` rows when given. Lazily k-way-merges the sorted sources
+        so a paged directory listing doesn't materialize the whole
+        range (the role of leveldb's iterator)."""
+        import bisect
+        import heapq
+
+        with self._lock:
+            def seg_rows(seg: _Segment, rank: int):
+                lo = bisect.bisect_left(seg.keys, start)
+                hi = bisect.bisect_left(seg.keys, end)
+                for i in range(lo, hi):
+                    yield seg.keys[i], rank, seg.values[i]
+
+            sources = [seg_rows(seg, rank)
+                       for rank, seg in enumerate(self._segments)]
+            sources.append(iter(sorted(
+                (k, len(self._segments), v)
+                for k, v in self._mem.items() if start <= k < end)))
+            out: list[tuple[bytes, bytes]] = []
+            cur_key: bytes | None = None
+            cur_rank, cur_val = -1, None
+            for k, rank, v in heapq.merge(*sources):
+                if k != cur_key:
+                    if cur_key is not None and cur_val is not None:
+                        out.append((cur_key, cur_val))
+                        if limit and len(out) >= limit:
+                            return out
+                    cur_key, cur_rank, cur_val = k, rank, v
+                elif rank > cur_rank:  # newer source shadows older
+                    cur_rank, cur_val = rank, v
+            if cur_key is not None and cur_val is not None:
+                out.append((cur_key, cur_val))
+            return out[:limit] if limit else out
+
+    # -- flush / compact ------------------------------------------------
+    def _maybe_flush(self) -> None:
+        if len(self._mem) >= MEMTABLE_FLUSH_ENTRIES or \
+                self._mem_bytes >= MEMTABLE_FLUSH_BYTES:
+            self.flush()
+
+    def flush(self) -> None:
+        """Memtable -> a new sorted segment; truncate the WAL."""
+        with self._lock:
+            if not self._mem:
+                return
+            items = sorted(self._mem.items())
+            path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
+            _Segment.write(path, items)
+            self._segments.append(_Segment(path))
+            self._next_seg += 1
+            self._mem = {}
+            self._mem_bytes = 0
+            self._wal.close()
+            self._wal = open(self._wal_path, "w")
+            if len(self._segments) >= COMPACT_SEGMENT_COUNT:
+                self.compact()
+
+    def compact(self) -> None:
+        """Merge all segments into one, dropping tombstones and
+        shadowed versions."""
+        with self._lock:
+            if len(self._segments) <= 1:
+                return
+            merged: dict[bytes, bytes | None] = {}
+            for seg in self._segments:  # oldest first
+                for k, v in zip(seg.keys, seg.values):
+                    merged[k] = v
+            live = sorted((k, v) for k, v in merged.items()
+                          if v is not None)
+            path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
+            _Segment.write(path, live)
+            old = self._segments
+            self._segments = [_Segment(path)]
+            self._next_seg += 1
+            for seg in old:
+                try:
+                    os.remove(seg.path)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self.flush()
+            self._wal.close()

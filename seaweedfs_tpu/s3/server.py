@@ -207,12 +207,15 @@ class S3ApiServer:
 
         tail = req.match_info["tail"]
         bucket, _, key = tail.partition("/")
-        payload = await req.read()
         cb_action = "write" if req.method in ("PUT", "POST", "DELETE") \
             else "read"
+        # acquire BEFORE buffering the body (by declared length): the
+        # writeBytes limit exists to stop concurrent uploads from
+        # ballooning gateway memory, so it must gate the read itself
         try:
-            with self.circuit_breaker.acquire(cb_action, bucket,
-                                              len(payload)):
+            with self.circuit_breaker.acquire(
+                    cb_action, bucket, req.content_length or 0):
+                payload = await req.read()
                 return await self._dispatch_authed(req, bucket, key,
                                                    payload)
         except CircuitOpen as e:
@@ -433,15 +436,17 @@ class S3ApiServer:
                                   f"form upload missing {f}", 403)
             access_key = fields["x-amz-credential"].split("/")[0]
             identity, secret = self.iam.lookup(access_key)
-            if not identity.allows(ACTION_WRITE, bucket):
-                raise S3Error("AccessDenied",
-                              f"write denied on {bucket}", 403)
+            # signature first: answering permission questions before
+            # proving possession of the secret would let anyone probe
+            # which access keys can write where
             if not verify_policy_signature(
                     fields["policy"], fields["x-amz-credential"],
-                    fields.get("x-amz-date", ""),
                     fields["x-amz-signature"], secret):
                 raise S3Error("SignatureDoesNotMatch",
                               "policy signature mismatch", 403)
+            if not identity.allows(ACTION_WRITE, bucket):
+                raise S3Error("AccessDenied",
+                              f"write denied on {bucket}", 403)
             try:
                 policy = json.loads(base64.b64decode(fields["policy"]))
             except (ValueError, json.JSONDecodeError):

@@ -68,8 +68,7 @@ def sign_headers(method: str, url: str, access_key: str,
 
 
 def verify_policy_signature(policy_b64: str, credential: str,
-                            amz_date: str, signature: str,
-                            secret: str) -> bool:
+                            signature: str, secret: str) -> bool:
     """Verify a POST-policy SigV4 signature: the string-to-sign is the
     base64 policy itself, signed with the standard derived key
     (post-policy-fanout of auth_signature_v4.go)."""

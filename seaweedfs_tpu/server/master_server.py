@@ -94,7 +94,7 @@ class MasterServer:
                 pass
 
     async def _admin_scripts_loop(self) -> None:
-        from ..shell.env import CommandEnv, ShellError
+        from ..shell.env import CommandEnv
         from ..shell.repl import run_command
 
         while not self.admin_scripts_url:
@@ -120,7 +120,7 @@ class MasterServer:
                         try:
                             run_command(env, line)
                             out.append({"script": line, "ok": True})
-                        except (ShellError, Exception) as e:
+                        except Exception as e:
                             out.append({"script": line, "ok": False,
                                         "error": str(e)})
                 finally:

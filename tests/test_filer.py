@@ -12,11 +12,13 @@ from seaweedfs_tpu.filer import (DIR_MODE_FLAG, Entry, FileChunk, Filer,
                                  event_kind)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb"])
 def filer(request, tmp_path):
     kwargs = {}
     if request.param == "sqlite":
         kwargs["path"] = str(tmp_path / "filer.db")
+    elif request.param == "leveldb":
+        kwargs["path"] = str(tmp_path / "filerdb")
     f = Filer(request.param, **kwargs)
     yield f
     f.close()
