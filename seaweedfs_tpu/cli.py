@@ -195,6 +195,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-c", dest="concurrency", type=int, default=16)
     p.add_argument("-collection", default="benchmark")
 
+    p = sub.add_parser("scaffold", help="print a starter config "
+                                        "template")
+    p.add_argument("-config", default="filer",
+                   help="filer | master | security | replication | "
+                        "notification | s3 | shell")
+    p.add_argument("-output", default="",
+                   help="write to a file instead of stdout")
+
     p = sub.add_parser("version")
 
     args = parser.parse_args(argv)
@@ -206,6 +214,16 @@ def _dispatch(args) -> int:
         from . import __version__
 
         print(f"seaweedfs-tpu {__version__}")
+        return 0
+    if args.cmd == "scaffold":
+        from .scaffold import scaffold
+        text = scaffold(args.config)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            print(f"wrote {args.output}")
+        else:
+            print(text, end="")
         return 0
     if args.cmd in ("fix", "compact", "export"):
         import json as _json

@@ -39,21 +39,43 @@ def _dec(s: str) -> bytes:
     return base64.b64decode(s)
 
 
+def _encode_record(key: bytes, value: bytes | None) -> str:
+    """One WAL/segment line: {"k": ..} + either "t" (tombstone) or
+    "v". The single place the on-disk record format lives."""
+    rec = {"k": _enc(key)}
+    if value is None:
+        rec["t"] = 1
+    else:
+        rec["v"] = _enc(value)
+    return json.dumps(rec, separators=(",", ":")) + "\n"
+
+
+def _decode_record(d: dict) -> tuple[bytes, bytes | None]:
+    return _dec(d["k"]), (None if d.get("t")
+                          else _dec(d.get("v", "")))
+
+
 class _Segment:
     """One immutable sorted file with its key index in memory."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 items: list[tuple[bytes, bytes | None]] | None = None):
+        """Load from `path`, or adopt already-sorted `items` without
+        re-reading the file just written from them."""
         self.path = path
         self.keys: list[bytes] = []
         self.values: list[bytes | None] = []
+        if items is not None:
+            self.keys = [k for k, _ in items]
+            self.values = [v for _, v in items]
+            return
         with open(path, "r") as f:
             for line in f:
                 if not line.strip():
                     continue
-                d = json.loads(line)
-                self.keys.append(_dec(d["k"]))
-                self.values.append(None if d.get("t")
-                                   else _dec(d.get("v", "")))
+                k, v = _decode_record(json.loads(line))
+                self.keys.append(k)
+                self.values.append(v)
 
     def get(self, key: bytes) -> tuple[bool, bytes | None]:
         """-> (found, value-or-tombstone)."""
@@ -69,12 +91,7 @@ class _Segment:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             for k, v in items:
-                rec = {"k": _enc(k)}
-                if v is None:
-                    rec["t"] = 1
-                else:
-                    rec["v"] = _enc(v)
-                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.write(_encode_record(k, v))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -107,11 +124,10 @@ class WeedKV:
         with open(self._wal_path, "rb") as f:
             for line in f:
                 try:
-                    d = json.loads(line)
-                except (json.JSONDecodeError, UnicodeDecodeError):
+                    k, v = _decode_record(json.loads(line))
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        KeyError, ValueError):
                     break  # torn tail from a crash mid-append
-                k = _dec(d["k"])
-                v = None if d.get("t") else _dec(d.get("v", ""))
                 self._mem[k] = v
                 self._mem_bytes += len(k) + len(v or b"")
                 good += len(line)
@@ -123,12 +139,7 @@ class WeedKV:
                 f.truncate(good)
 
     def _wal_append(self, key: bytes, value: bytes | None) -> None:
-        rec = {"k": _enc(key)}
-        if value is None:
-            rec["t"] = 1
-        else:
-            rec["v"] = _enc(value)
-        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.write(_encode_record(key, value))
         self._wal.flush()
 
     # -- core ops -------------------------------------------------------
@@ -207,7 +218,7 @@ class WeedKV:
             items = sorted(self._mem.items())
             path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
             _Segment.write(path, items)
-            self._segments.append(_Segment(path))
+            self._segments.append(_Segment(path, items=items))
             self._next_seg += 1
             self._mem = {}
             self._mem_bytes = 0
@@ -231,7 +242,7 @@ class WeedKV:
             path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
             _Segment.write(path, live)
             old = self._segments
-            self._segments = [_Segment(path)]
+            self._segments = [_Segment(path, items=live)]
             self._next_seg += 1
             for seg in old:
                 try:

@@ -1,0 +1,22 @@
+"""FTP gateway — experimental stub, matching the reference's status.
+
+The reference ships an 81-line experimental stub
+(/root/reference/weed/ftpd/ftp_server.go) that wires an FTP library to
+filer-backed file operations but is not production-wired into `weed
+server`. This package holds the same slot: the option surface exists so
+configs/scaffolds mention it, and `start()` explains the status instead
+of half-working.
+"""
+from __future__ import annotations
+
+
+class FtpServer:
+    def __init__(self, filer_url: str, port: int = 8021):
+        self.filer_url = filer_url.rstrip("/")
+        self.port = port
+
+    def start(self) -> None:
+        raise NotImplementedError(
+            "the FTP gateway is experimental and not yet implemented "
+            "(the reference ships it as a stub too, weed/ftpd/"
+            "ftp_server.go); use the S3, WebDAV or mount gateways")

@@ -153,17 +153,31 @@ class S3ApiServer:
     def _build_app(self) -> web.Application:
         @web.middleware
         async def error_mw(request, handler):
+            start = time.perf_counter()
+            code = "500"  # unexpected exceptions escape to aiohttp
             try:
-                return await handler(request)
-            except S3Error as e:
-                return _error_response(e.code, str(e), e.status,
-                                       request.path)
-            except S3AuthError as e:
-                return _error_response(e.code, str(e), e.status,
-                                       request.path)
-            except (KeyError, ValueError, ET.ParseError) as e:
-                return _error_response("InvalidRequest", str(e), 400,
-                                       request.path)
+                try:
+                    resp = await handler(request)
+                except S3Error as e:
+                    resp = _error_response(e.code, str(e), e.status,
+                                           request.path)
+                except S3AuthError as e:
+                    resp = _error_response(e.code, str(e), e.status,
+                                           request.path)
+                except (KeyError, ValueError, ET.ParseError) as e:
+                    resp = _error_response("InvalidRequest", str(e),
+                                           400, request.path)
+                code = str(resp.status)
+                return resp
+            finally:
+                # recorded in finally: an outage (filer down raising
+                # ConnectionError) is exactly when metrics must exist
+                metrics.histogram_observe(
+                    "s3_request_seconds", time.perf_counter() - start,
+                    labels={"method": request.method})
+                metrics.counter_add(
+                    "s3_requests_total", labels={
+                        "method": request.method, "code": code})
 
         # bodies are buffered for SigV4 payload hashing; 1GB caps the
         # blowup — larger objects go through multipart parts
@@ -171,6 +185,7 @@ class S3ApiServer:
                               middlewares=[error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
+            web.get("/metrics", self.handle_metrics),
             web.route("*", "/{tail:.*}", self.dispatch),
         ])
         return app
@@ -178,6 +193,10 @@ class S3ApiServer:
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({"filer": self.filer_url,
                                   "open": self.iam.is_open})
+
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")
 
     # -- auth + dispatch ------------------------------------------------
     def _load_identities_from_filer(self) -> None:
@@ -211,10 +230,22 @@ class S3ApiServer:
             else "read"
         # acquire BEFORE buffering the body (by declared length): the
         # writeBytes limit exists to stop concurrent uploads from
-        # ballooning gateway memory, so it must gate the read itself
+        # ballooning gateway memory, so it must gate the read itself.
+        # A write with no declarable length (plain chunked) could evade
+        # a configured byte limit entirely — demand a length, as AWS
+        # does (411) for PUTs.
+        declared = req.content_length
+        if declared is None:
+            decoded = req.headers.get("x-amz-decoded-content-length")
+            if decoded and decoded.isdigit():
+                declared = int(decoded)  # streaming-signed uploads
+        if declared is None and cb_action == "write" and \
+                self.circuit_breaker.enabled:
+            raise S3Error("MissingContentLength",
+                          "writes must declare a content length", 411)
         try:
             with self.circuit_breaker.acquire(
-                    cb_action, bucket, req.content_length or 0):
+                    cb_action, bucket, declared or 0):
                 payload = await req.read()
                 return await self._dispatch_authed(req, bucket, key,
                                                    payload)

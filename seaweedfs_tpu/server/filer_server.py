@@ -140,6 +140,7 @@ class FilerServer:
     def _build_app(self) -> web.Application:
         @web.middleware
         async def error_mw(request, handler):
+            start = time.perf_counter()
             try:
                 return await handler(request)
             except web.HTTPException:
@@ -155,6 +156,11 @@ class FilerServer:
                     TypeError) as e:
                 return web.json_response(
                     {"error": f"bad request: {e}"}, status=400)
+            finally:
+                metrics.histogram_observe(
+                    "filer_request_seconds",
+                    time.perf_counter() - start,
+                    labels={"method": request.method})
 
         app = web.Application(client_max_size=1 << 40,
                               middlewares=[error_mw])

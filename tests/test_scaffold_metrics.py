@@ -1,0 +1,70 @@
+"""scaffold templates, ftp stub status, and gateway latency metrics
+(reference weed/command/scaffold.go, weed/ftpd/, weed/stats/metrics.go).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+import requests
+
+from seaweedfs_tpu.ftpd import FtpServer
+from seaweedfs_tpu.scaffold import TEMPLATES, scaffold
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+class TestScaffold:
+    def test_all_templates_render(self):
+        for name in TEMPLATES:
+            out = scaffold(name)
+            assert out.strip(), name
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            scaffold("nope")
+
+    def test_cli_prints_and_writes(self, tmp_path):
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu", "scaffold",
+             "-config", "s3"], capture_output=True, text=True, env=env,
+            timeout=60)
+        assert out.returncode == 0
+        assert "identities" in out.stdout
+        dest = str(tmp_path / "master.json")
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu", "scaffold",
+             "-config", "master", "-output", dest],
+            capture_output=True, text=True, env=env, timeout=60)
+        assert out.returncode == 0
+        assert "admin.scripts" in open(dest).read()
+
+
+class TestFtpStub:
+    def test_start_explains_status(self):
+        with pytest.raises(NotImplementedError):
+            FtpServer("http://filer:8888").start()
+
+
+class TestGatewayMetrics:
+    def test_s3_and_filer_latency_histograms(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("metrics")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True)
+        try:
+            requests.put(f"{c.s3_url}/mb")
+            requests.put(f"{c.s3_url}/mb/k", data=b"x")
+            requests.get(f"{c.s3_url}/mb/k")
+            m = requests.get(f"{c.s3_url}/metrics").text
+            assert "s3_request_seconds_count" in m
+            assert 's3_requests_total{code="200",method="PUT"}' in m
+            fm = requests.get(f"{c.filer_url}/metrics").text
+            assert "filer_request_seconds_count" in fm
+        finally:
+            c.stop()
+
+    def test_templates_are_valid_json(self):
+        import json as _json
+        for name in TEMPLATES:
+            _json.loads(scaffold(name))
