@@ -48,6 +48,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-dataCenter", default="DefaultDataCenter")
     p.add_argument("-rack", default="DefaultRack")
     p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+    p.add_argument("-index", default="memory",
+                   help="needle map kind: memory | compact")
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -428,7 +430,8 @@ def _run_volume(args) -> int:
 
     dirs = args.dir.split(",")
     store = Store(dirs, ip=args.ip, port=args.port,
-                  ec_backend=args.ec_backend)
+                  ec_backend=args.ec_backend,
+                  needle_map_kind=args.index)
     for loc in store.locations:
         loc.max_volumes = args.max
     # scheme normalization for each master happens inside VolumeServer

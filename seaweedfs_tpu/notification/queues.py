@@ -73,13 +73,42 @@ class LogFileQueue(NotificationQueue):
             self._f.close()
 
 
+class _GatedQueue(NotificationQueue):
+    """Placeholder for queue backends whose SDK isn't installed
+    (notification/kafka, aws_sqs, google_pub_sub in the reference).
+    Registered so configs name them uniformly; constructing one
+    explains what to install instead of failing deep in a publish."""
+
+    KIND = ""
+    NEEDS = ""
+
+    def __init__(self, **_):
+        raise ImportError(
+            f"notification queue {self.KIND!r} needs the "
+            f"{self.NEEDS} package, which is not installed; "
+            "use 'memory' or 'log', or install the SDK")
+
+
+class KafkaQueue(_GatedQueue):
+    KIND, NEEDS = "kafka", "kafka-python (or confluent-kafka)"
+
+
+class AwsSqsQueue(_GatedQueue):
+    KIND, NEEDS = "aws_sqs", "boto3"
+
+
+class GooglePubSubQueue(_GatedQueue):
+    KIND, NEEDS = "google_pub_sub", "google-cloud-pubsub"
+
+
 def make_queue(kind: str, **kwargs) -> NotificationQueue:
-    queues = {"memory": MemoryQueue, "log": LogFileQueue}
+    queues = {"memory": MemoryQueue, "log": LogFileQueue,
+              "kafka": KafkaQueue, "aws_sqs": AwsSqsQueue,
+              "google_pub_sub": GooglePubSubQueue}
     if kind not in queues:
         raise KeyError(
             f"unknown notification queue {kind!r}; have "
-            f"{sorted(queues)} (kafka/sqs/pubsub need SDKs absent "
-            "in this environment)")
+            f"{sorted(queues)}")
     return queues[kind](**kwargs)
 
 

@@ -239,10 +239,12 @@ class S3ApiServer:
             decoded = req.headers.get("x-amz-decoded-content-length")
             if decoded and decoded.isdigit():
                 declared = int(decoded)  # streaming-signed uploads
-        if declared is None and cb_action == "write" and \
+        if declared is None and req.method in ("PUT", "POST") and \
                 self.circuit_breaker.enabled:
+            # body-carrying verbs only: DELETE legitimately has no
+            # Content-Length and must keep working under limits
             raise S3Error("MissingContentLength",
-                          "writes must declare a content length", 411)
+                          "uploads must declare a content length", 411)
         try:
             with self.circuit_breaker.acquire(
                     cb_action, bucket, declared or 0):

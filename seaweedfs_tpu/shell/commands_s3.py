@@ -1,0 +1,137 @@
+"""s3.* shell commands.
+
+Equivalents of /root/reference/weed/shell/command_s3_configure.go,
+command_s3_bucket_list.go, command_s3_bucket_create.go,
+command_s3_bucket_delete.go, command_s3_circuitbreaker.go: manage the
+S3 gateway's identities, buckets, and circuit-breaker limits. All of it
+is filer state (/buckets/* entries + the s3/identities and
+s3/circuit_breaker KV keys the gateways hot-reload), so these commands
+talk to the filer, not to a gateway instance.
+"""
+from __future__ import annotations
+
+import json
+
+import requests
+
+from .env import CommandEnv, ShellError
+
+IDENTITIES_KEY = "s3/identities"
+CIRCUIT_BREAKER_KEY = "s3/circuit_breaker"
+BUCKETS_DIR = "/buckets"
+
+
+def _filer(env: CommandEnv) -> str:
+    if not env.filer_url:
+        raise ShellError("s3.* commands need a filer: start the shell "
+                         "with -filer")
+    return env.filer_url
+
+
+def _kv_get(env: CommandEnv, key: str) -> dict:
+    r = requests.get(f"{_filer(env)}/kv/{key}", timeout=30)
+    if r.status_code == 404:
+        return {}
+    if r.status_code >= 300:
+        raise ShellError(f"read {key}: {r.text}")
+    return json.loads(r.content)
+
+
+def _kv_put(env: CommandEnv, key: str, value: dict) -> None:
+    r = requests.put(f"{_filer(env)}/kv/{key}",
+                     data=json.dumps(value, indent=1).encode(),
+                     timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"write {key}: {r.text}")
+
+
+def s3_configure(env: CommandEnv, user: str = "",
+                 access_key: str = "", secret_key: str = "",
+                 actions: str = "", delete: bool = False,
+                 apply: bool = False) -> dict:
+    """Show/edit S3 identities (command_s3_configure.go). Without
+    -user just prints the config; edits are dry-run unless -apply."""
+    conf = _kv_get(env, IDENTITIES_KEY)
+    conf.setdefault("identities", [])
+    if user:
+        conf["identities"] = [i for i in conf["identities"]
+                              if i.get("name") != user]
+        if not delete:
+            ident = {"name": user, "credentials": [], "actions":
+                     [a.strip() for a in actions.split(",") if a.strip()]
+                     or ["Read", "Write", "List"]}
+            if access_key:
+                ident["credentials"].append(
+                    {"accessKey": access_key,
+                     "secretKey": secret_key})
+            conf["identities"].append(ident)
+        if apply:
+            _kv_put(env, IDENTITIES_KEY, conf)
+    out = dict(conf)
+    out["applied"] = apply or not user
+    return out
+
+
+def s3_bucket_list(env: CommandEnv) -> list[dict]:
+    r = requests.get(f"{_filer(env)}{BUCKETS_DIR}",
+                     params={"limit": "4096"},
+                     headers={"Accept": "application/json"},
+                     timeout=30)
+    if r.status_code == 404:
+        return []
+    entries = r.json().get("entries", [])
+    return [{"name": e["full_path"].rstrip("/").rsplit("/", 1)[-1],
+             "ctime": e.get("mtime", 0)}
+            for e in entries if e.get("mode", 0) & 0o40000]
+
+
+def s3_bucket_create(env: CommandEnv, name: str) -> dict:
+    if not name:
+        raise ShellError("s3.bucket.create needs -name")
+    r = requests.post(f"{_filer(env)}{BUCKETS_DIR}/{name}/",
+                      params={"mkdir": "1"}, timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"s3.bucket.create: {r.text}")
+    return {"created": name}
+
+
+def s3_bucket_delete(env: CommandEnv, name: str,
+                     include_objects: bool = False) -> dict:
+    if not name:
+        raise ShellError("s3.bucket.delete needs -name")
+    params = {"recursive": "true"} if include_objects else {}
+    r = requests.delete(f"{_filer(env)}{BUCKETS_DIR}/{name}",
+                        params=params, timeout=60)
+    if r.status_code == 409:
+        raise ShellError(f"bucket {name} is not empty "
+                         "(use -includeObjects)")
+    if r.status_code >= 300 and r.status_code != 404:
+        raise ShellError(f"s3.bucket.delete: {r.text}")
+    return {"deleted": name}
+
+
+def s3_circuit_breaker(env: CommandEnv, global_conf: str = "",
+                       bucket: str = "", bucket_conf: str = "",
+                       delete: bool = False,
+                       apply: bool = False) -> dict:
+    """Show/edit circuit-breaker limits (command_s3_circuitbreaker.go).
+    -global/-bucketConf take JSON like '{"writeCount": 32}'."""
+    conf = _kv_get(env, CIRCUIT_BREAKER_KEY)
+    changed = False
+    if delete and bucket:
+        conf.get("buckets", {}).pop(bucket, None)
+        changed = True
+    elif delete:
+        conf.pop("global", None)
+        changed = True
+    if global_conf:
+        conf["global"] = json.loads(global_conf)
+        changed = True
+    if bucket and bucket_conf:
+        conf.setdefault("buckets", {})[bucket] = json.loads(bucket_conf)
+        changed = True
+    if changed and apply:
+        _kv_put(env, CIRCUIT_BREAKER_KEY, conf)
+    out = dict(conf)
+    out["applied"] = apply or not changed
+    return out

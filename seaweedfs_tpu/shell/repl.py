@@ -12,7 +12,7 @@ import json
 import shlex
 
 from . import (commands_cluster, commands_ec, commands_fs, commands_mq,
-               commands_remote, commands_volume)
+               commands_remote, commands_s3, commands_volume)
 from .env import CommandEnv, ShellError
 
 HELP = """commands:
@@ -59,6 +59,12 @@ HELP = """commands:
   remote.meta.sync -dir=/d          pull remote listing into metadata
   remote.cache -dir=/d              materialise remote files locally
   remote.uncache -dir=/d            drop local copies, keep metadata
+  s3.configure [-user=U -access_key=AK -secret_key=SK
+                -actions=Read,Write -delete -apply]
+  s3.bucket.list / s3.bucket.create -name=B
+  s3.bucket.delete -name=B [-includeObjects]
+  s3.circuit.breaker [-global='{"writeCount":32}'
+                      -bucket=B -bucketConf='{...}' -delete -apply]
   mq.topic.list                     list message-queue topics
   mq.topic.create [-namespace=ns] -topic=T [-partitions=4]
   mq.topic.describe [-namespace=ns] -topic=T
@@ -213,6 +219,31 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_remote.remote_cache(env, opts["dir"])
     if cmd == "remote.uncache":
         return commands_remote.remote_uncache(env, opts["dir"])
+    # -- s3 gateway state -----------------------------------------------
+    if cmd == "s3.configure":
+        return commands_s3.s3_configure(
+            env, user=opts.get("user", ""),
+            access_key=opts.get("access_key", ""),
+            secret_key=opts.get("secret_key", ""),
+            actions=opts.get("actions", ""),
+            delete=opts.get("delete", "") == "true",
+            apply=opts.get("apply", "") == "true")
+    if cmd == "s3.bucket.list":
+        return commands_s3.s3_bucket_list(env)
+    if cmd == "s3.bucket.create":
+        return commands_s3.s3_bucket_create(
+            env, opts.get("name") or arg(0, ""))
+    if cmd == "s3.bucket.delete":
+        return commands_s3.s3_bucket_delete(
+            env, opts.get("name") or arg(0, ""),
+            include_objects=opts.get("includeObjects", "") == "true")
+    if cmd == "s3.circuit.breaker":
+        return commands_s3.s3_circuit_breaker(
+            env, global_conf=opts.get("global", ""),
+            bucket=opts.get("bucket", ""),
+            bucket_conf=opts.get("bucketConf", ""),
+            delete=opts.get("delete", "") == "true",
+            apply=opts.get("apply", "") == "true")
     # -- message queue --------------------------------------------------
     if cmd == "mq.topic.list":
         return commands_mq.mq_topic_list(env)

@@ -51,8 +51,10 @@ class EcShardSet:
 
 class DiskLocation:
     def __init__(self, dirname: str, max_volumes: int = 8,
-                 disk_type: str = "hdd"):
+                 disk_type: str = "hdd",
+                 needle_map_kind: str = "memory"):
         self.dir = dirname
+        self.needle_map_kind = needle_map_kind
         self.max_volumes = max_volumes
         self.disk_type = disk_type
         self.volumes: dict[int, Volume] = {}
@@ -73,7 +75,9 @@ class DiskLocation:
                 col, vid = v
                 if vid not in self.volumes:
                     try:
-                        self.volumes[vid] = Volume(self.dir, col, vid)
+                        self.volumes[vid] = Volume(
+                            self.dir, col, vid,
+                            needle_map_kind=self.needle_map_kind)
                     except Exception as e:
                         self.load_errors.append((vid, f"{type(e).__name__}: {e}"))
                 continue
@@ -90,13 +94,16 @@ class DiskLocation:
         for name in os.listdir(self.dir):
             v = parse_volume_filename(name)
             if v is not None and v[1] == vid:
-                self.volumes[vid] = Volume(self.dir, v[0], vid)
+                self.volumes[vid] = Volume(
+                    self.dir, v[0], vid,
+                    needle_map_kind=self.needle_map_kind)
                 return True
         return False
 
     def new_volume(self, collection: str, vid: int, **kw) -> Volume:
         if vid in self.volumes:
             raise FileExistsError(f"volume {vid} already exists")
+        kw.setdefault('needle_map_kind', self.needle_map_kind)
         v = Volume(self.dir, collection, vid, create=True, **kw)
         self.volumes[vid] = v
         return v

@@ -87,11 +87,28 @@ class NeedleMap:
                 yield k
 
 
-def load_needle_map(idx_path: str) -> NeedleMap:
+def new_needle_map(kind: str = "memory"):
+    """Fresh, empty map of the configured strategy — rebuild paths must
+    honor the kind too, or a compact-configured node falls back to the
+    dict map's ~6x memory after crash recovery."""
+    if kind == "compact":
+        return CompactNeedleMap()
+    if kind != "memory":
+        raise ValueError(f"unknown needle map kind {kind!r}")
+    return NeedleMap()
+
+
+def load_needle_map(idx_path: str, kind: str = "memory"):
     """Replay an .idx log into a live map (needle_map_memory.go
     LoadCompactNeedleMap equivalent): later entries win; tombstones
-    (size<0 or offset==0&&size==0 per reference semantics) delete."""
-    nm = NeedleMap()
+    (size<0 or offset==0&&size==0 per reference semantics) delete.
+    kind selects the strategy: "memory" (dict) or "compact" (sorted
+    numpy array, needle_map_kind in store.go:57)."""
+    if kind == "compact":
+        return load_compact_needle_map(idx_path)
+    if kind != "memory":
+        raise ValueError(f"unknown needle map kind {kind!r}")
+    nm = new_needle_map(kind)
     if not os.path.exists(idx_path):
         return nm
     arr = idxmod.read_index(idx_path)
@@ -149,3 +166,169 @@ class MemDb:
             off, size = self._m[k]
             arr[i] = (k, off, t.size_to_u32(size))
         idxmod.write_index(idx_path, arr)
+
+
+class CompactNeedleMap:
+    """Memory-frugal needle map: the loaded index is a sorted numpy
+    structured array (16 bytes/needle, the compact_map.go:28 goal —
+    a python dict burns ~100 bytes/needle) probed by binary search,
+    with a small dict overlay for writes since load. The overlay is
+    merged into the array when it grows past OVERLAY_LIMIT, keeping
+    lookups O(log n) and memory O(n * 16B).
+
+    Same surface and metric fields as NeedleMap; selected per volume
+    with needle_map_kind="compact" (needle_map_kind, store.go:57).
+    """
+
+    OVERLAY_LIMIT = 8192
+
+    def __init__(self) -> None:
+        self._keys = np.empty(0, dtype=np.uint64)
+        self._offsets = np.empty(0, dtype=np.uint32)
+        self._sizes = np.empty(0, dtype=np.int64)  # -1 = tombstone
+        self._overlay: dict[int, tuple[int, int]] = {}
+        self.file_count = 0
+        self.deleted_count = 0
+        self.file_bytes = 0
+        self.deleted_bytes = 0
+        self.max_key = 0
+
+    def __len__(self) -> int:
+        base = len(self._keys)
+        novel = sum(1 for k in self._overlay
+                    if not self._base_has(k))
+        return base + novel
+
+    def _base_has(self, key: int) -> bool:
+        i = int(np.searchsorted(self._keys, np.uint64(key)))
+        return i < len(self._keys) and int(self._keys[i]) == key
+
+    def _base_get(self, key: int) -> tuple[int, int] | None:
+        i = int(np.searchsorted(self._keys, np.uint64(key)))
+        if i < len(self._keys) and int(self._keys[i]) == key:
+            return int(self._offsets[i]), int(self._sizes[i])
+        return None
+
+    def _lookup(self, key: int) -> tuple[int, int] | None:
+        if key in self._overlay:
+            return self._overlay[key]
+        return self._base_get(key)
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        v = self._lookup(key)
+        if v is None or t.size_is_deleted(v[1]):
+            return None
+        return v
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        old = self._lookup(key)
+        if old is not None and t.size_is_valid(old[1]):
+            self.deleted_count += 1
+            self.deleted_bytes += old[1]
+            self.file_count -= 1
+            self.file_bytes -= old[1]
+        self._overlay[key] = (offset, size)
+        if t.size_is_valid(size):
+            self.file_count += 1
+            self.file_bytes += size
+        self.max_key = max(self.max_key, key)
+        self._maybe_merge()
+
+    def delete(self, key: int) -> int:
+        old = self._lookup(key)
+        if old is None or not t.size_is_valid(old[1]):
+            return 0
+        self._overlay[key] = (old[0], t.TOMBSTONE_SIZE)
+        self.deleted_count += 1
+        self.deleted_bytes += old[1]
+        self.file_count -= 1
+        self.file_bytes -= old[1]
+        self._maybe_merge()
+        return old[1]
+
+    def _maybe_merge(self) -> None:
+        if len(self._overlay) >= self.OVERLAY_LIMIT:
+            self.merge_overlay()
+
+    def merge_overlay(self) -> None:
+        if not self._overlay:
+            return
+        ok = np.fromiter(self._overlay.keys(), dtype=np.uint64,
+                         count=len(self._overlay))
+        ov = np.array([v for v in self._overlay.values()],
+                      dtype=np.int64).reshape(-1, 2)
+        keys = np.concatenate([self._keys, ok])
+        offsets = np.concatenate([self._offsets,
+                                  ov[:, 0].astype(np.uint32)])
+        sizes = np.concatenate([self._sizes, ov[:, 1]])
+        # stable sort + keep the LAST occurrence of each key (overlay
+        # entries were appended after the base, so they win)
+        order = np.argsort(keys, kind="stable")
+        keys, offsets, sizes = keys[order], offsets[order], sizes[order]
+        keep = np.ones(len(keys), dtype=bool)
+        keep[:-1] = keys[:-1] != keys[1:]
+        self._keys = keys[keep]
+        self._offsets = offsets[keep]
+        self._sizes = sizes[keep]
+        self._overlay = {}
+
+    def items(self) -> Iterator[tuple[int, int, int]]:
+        self.merge_overlay()
+        for i in range(len(self._keys)):
+            yield (int(self._keys[i]), int(self._offsets[i]),
+                   int(self._sizes[i]))
+
+    def live_items(self) -> Iterator[tuple[int, int, int]]:
+        for k, off, size in self.items():
+            if t.size_is_valid(size):
+                yield k, off, size
+
+    def deleted_keys(self) -> Iterator[int]:
+        for k, _off, size in self.items():
+            if t.size_is_deleted(size):
+                yield k
+
+
+def load_compact_needle_map(idx_path: str) -> CompactNeedleMap:
+    """Vectorized .idx replay into a CompactNeedleMap: one structured
+    read, later-entries-win dedupe and metric computation all as numpy
+    column ops (the TPU-idiomatic version of
+    needle_map_memory.go LoadCompactNeedleMap)."""
+    nm = CompactNeedleMap()
+    if not os.path.exists(idx_path):
+        return nm
+    arr = idxmod.read_index(idx_path)
+    if len(arr) == 0:
+        return nm
+    keys = arr["key"].astype(np.uint64)
+    offsets = arr["offset"].astype(np.uint32)
+    sizes = arr["size"].astype(np.int64)
+    sizes = np.where(sizes >= 0x80000000, sizes - (1 << 32), sizes)
+    # tombstone rows delete; size-0 rows count as deletes too, exactly
+    # like the memory loader's `off > 0 and size_is_valid(size)` test —
+    # the two kinds must produce identical live-sets from one .idx
+    dead = (offsets == 0) | (sizes <= 0)
+    sizes = np.where(dead, np.int64(t.TOMBSTONE_SIZE), sizes)
+    # later entries win: stable sort by key keeps append order within
+    # a key; take each key's last row
+    order = np.argsort(keys, kind="stable")
+    keys, offsets, sizes = keys[order], offsets[order], sizes[order]
+    keep = np.ones(len(keys), dtype=bool)
+    keep[:-1] = keys[:-1] != keys[1:]
+    # count a key as "deleted" only if its final row is a tombstone;
+    # overwritten intermediate rows add to deleted_bytes like the
+    # incremental path does
+    shadowed_sizes = sizes[~keep]
+    nm._keys = keys[keep]
+    nm._offsets = offsets[keep]
+    nm._sizes = sizes[keep]
+    live = nm._sizes >= 0
+    nm.file_count = int(np.count_nonzero(live))
+    nm.file_bytes = int(nm._sizes[live].sum())
+    # every shadowed live row was ended by exactly one overwrite or
+    # tombstone — the same events the incremental path counts
+    shadowed_live = shadowed_sizes[shadowed_sizes >= 0]
+    nm.deleted_count = int(len(shadowed_live))
+    nm.deleted_bytes = int(shadowed_live.sum())
+    nm.max_key = int(nm._keys[-1]) if len(nm._keys) else 0
+    return nm

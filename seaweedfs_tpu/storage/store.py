@@ -32,8 +32,11 @@ RemoteShardReader = Callable[[int, int, int, int], "bytes | None"]
 class Store:
     def __init__(self, dirnames: Iterable[str], ip: str = "localhost",
                  port: int = 8080, public_url: str = "",
-                 ec_backend: str = "numpy"):
-        self.locations = [DiskLocation(d) for d in dirnames]
+                 ec_backend: str = "numpy",
+                 needle_map_kind: str = "memory"):
+        self.locations = [
+            DiskLocation(d, needle_map_kind=needle_map_kind)
+            for d in dirnames]
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"

@@ -29,10 +29,12 @@ class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: bytes = b"\x00\x00", create: bool = False,
-                 backend_kind: str = "disk"):
+                 backend_kind: str = "disk",
+                 needle_map_kind: str = "memory"):
         self.dir = dirname
         self.collection = collection
         self.vid = vid
+        self.needle_map_kind = needle_map_kind
         self.read_only = False
         self._backend_kind = backend_kind
         base = self.file_name()
@@ -63,7 +65,8 @@ class Volume:
                 ttl=ttl)
             self.dat.write_at(self.super_block.to_bytes(), 0)
             self.dat.sync()
-        self.nm = nmap.load_needle_map(base + ".idx")
+        self.nm = nmap.load_needle_map(base + ".idx",
+                                       kind=needle_map_kind)
         self._idx_f = open(base + ".idx", "ab")
         self.last_append_at_ns = 0
         if exists:
@@ -203,7 +206,7 @@ class Volume:
         the recovery path for a torn compact commit."""
         base = self.file_name()
         self._idx_f.close()
-        self.nm = nmap.NeedleMap()
+        self.nm = nmap.new_needle_map(self.needle_map_kind)
         with open(base + ".idx", "wb") as idxf:
             offset = self.super_block.block_size
             size = self.dat.size()
@@ -473,7 +476,8 @@ class Volume:
         os.replace(cpx, base + ".idx")
         self.dat = bk.DiskFile(base + ".dat")
         self.super_block = self._read_super_block()
-        self.nm = nmap.load_needle_map(base + ".idx")
+        self.nm = nmap.load_needle_map(base + ".idx",
+                                       kind=self.needle_map_kind)
         self._idx_f = open(base + ".idx", "ab")
 
     def sync(self) -> None:

@@ -202,3 +202,15 @@ class TestNotifications:
         q.close()
         lines = [json.loads(l) for l in open(path)]
         assert [l["key"] for l in lines] == ["/k1", "/k2"]
+
+
+class TestGatedQueues:
+    def test_gated_backends_explain_missing_sdk(self):
+        import pytest as _pytest
+
+        from seaweedfs_tpu.notification.queues import make_queue
+        for kind in ("kafka", "aws_sqs", "google_pub_sub"):
+            with _pytest.raises(ImportError):
+                make_queue(kind)
+        with _pytest.raises(KeyError):
+            make_queue("rabbitmq")

@@ -1,0 +1,101 @@
+"""s3.* shell command family (reference weed/shell/command_s3_*.go):
+identity management, bucket admin, circuit-breaker limits — all filer
+state picked up live by the gateway.
+"""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell.env import CommandEnv
+from seaweedfs_tpu.shell.repl import run_command
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("shell_s3")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True, with_s3=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def env(cluster):
+    return CommandEnv(cluster.master_url, filer_url=cluster.filer_url)
+
+
+class TestConfigure:
+    def test_add_identity_dry_run_then_apply(self, cluster, env):
+        out = run_command(
+            env, "s3.configure -user=alice -access_key=AKIA1 "
+                 "-secret_key=sec -actions=Read,Write")
+        assert out["applied"] is False
+        out = run_command(
+            env, "s3.configure -user=alice -access_key=AKIA1 "
+                 "-secret_key=sec -actions=Read,Write -apply")
+        assert out["applied"] is True
+        conf = run_command(env, "s3.configure")
+        names = [i["name"] for i in conf["identities"]]
+        assert "alice" in names
+        # the gateway hot-reloads and starts enforcing auth
+        deadline = time.time() + 15
+        while time.time() < deadline and cluster.s3.iam.is_open:
+            time.sleep(0.3)
+        assert not cluster.s3.iam.is_open
+        r = requests.put(f"{cluster.s3_url}/unauthorized-bucket")
+        assert r.status_code == 403
+        # clean up so later tests see an open gateway
+        run_command(env, "s3.configure -user=alice -delete -apply")
+        deadline = time.time() + 15
+        while time.time() < deadline and not cluster.s3.iam.is_open:
+            time.sleep(0.3)
+        assert cluster.s3.iam.is_open
+
+
+class TestBuckets:
+    def test_create_list_delete(self, cluster, env):
+        run_command(env, "s3.bucket.create -name=shellmade")
+        names = [b["name"] for b in run_command(env, "s3.bucket.list")]
+        assert "shellmade" in names
+        # visible to the S3 gateway too
+        r = requests.get(f"{cluster.s3_url}/")
+        assert "shellmade" in r.text
+        run_command(env, "s3.bucket.delete -name=shellmade")
+        names = [b["name"] for b in run_command(env, "s3.bucket.list")]
+        assert "shellmade" not in names
+
+    def test_delete_nonempty_needs_flag(self, cluster, env):
+        run_command(env, "s3.bucket.create -name=full")
+        requests.put(f"{cluster.s3_url}/full/obj", data=b"x")
+        from seaweedfs_tpu.shell.env import ShellError
+        with pytest.raises(ShellError):
+            run_command(env, "s3.bucket.delete -name=full")
+        run_command(env,
+                    "s3.bucket.delete -name=full -includeObjects")
+        names = [b["name"] for b in run_command(env, "s3.bucket.list")]
+        assert "full" not in names
+
+
+class TestCircuitBreaker:
+    def test_set_limits_and_gateway_enforces(self, cluster, env):
+        out = run_command(
+            env, "s3.circuit.breaker "
+                 "-global='{\"writeBytes\":128}' -apply")
+        assert out["global"] == {"writeBytes": 128}
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                not cluster.s3.circuit_breaker.enabled:
+            time.sleep(0.3)
+        requests.put(f"{cluster.s3_url}/cbb")
+        r = requests.put(f"{cluster.s3_url}/cbb/big", data=b"x" * 512)
+        assert r.status_code == 503
+        # remove the limit again
+        run_command(env, "s3.circuit.breaker -delete -apply")
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                cluster.s3.circuit_breaker.enabled:
+            time.sleep(0.3)
+        r = requests.put(f"{cluster.s3_url}/cbb/big", data=b"x" * 512)
+        assert r.status_code == 200
