@@ -64,6 +64,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+    p.add_argument("-index", default="memory",
+                   help="needle map kind: memory | compact")
 
     p = sub.add_parser("filer", help="start a filer server")
     p.add_argument("-port", type=int, default=8888)
@@ -522,7 +524,8 @@ def _run_server(args) -> int:
     vol_dir = os.path.join(args.dir, "volume")
     os.makedirs(vol_dir, exist_ok=True)
     store = Store([vol_dir], ip=args.ip, port=args.volume_port,
-                  ec_backend=args.ec_backend)
+                  ec_backend=args.ec_backend,
+                  needle_map_kind=args.index)
     vs = VolumeServer(store, mt.url)
     vt = ServerThread(vs.app, host=args.ip, port=args.volume_port).start()
     store.port = vt.port

@@ -23,8 +23,8 @@ DIR_MODE_FLAG = 0o40000
 
 def _filer(env: CommandEnv) -> str:
     if not env.filer_url:
-        raise ShellError("fs.* commands need a filer: start the shell "
-                         "with -filer")
+        raise ShellError("this command needs a filer: start the "
+                         "shell with -filer")
     return env.filer_url
 
 

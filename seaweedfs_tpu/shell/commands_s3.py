@@ -14,18 +14,12 @@ import json
 
 import requests
 
+from .commands_fs import _filer, _is_dir, _list, _name
 from .env import CommandEnv, ShellError
 
 IDENTITIES_KEY = "s3/identities"
 CIRCUIT_BREAKER_KEY = "s3/circuit_breaker"
 BUCKETS_DIR = "/buckets"
-
-
-def _filer(env: CommandEnv) -> str:
-    if not env.filer_url:
-        raise ShellError("s3.* commands need a filer: start the shell "
-                         "with -filer")
-    return env.filer_url
 
 
 def _kv_get(env: CommandEnv, key: str) -> dict:
@@ -54,13 +48,26 @@ def s3_configure(env: CommandEnv, user: str = "",
     conf = _kv_get(env, IDENTITIES_KEY)
     conf.setdefault("identities", [])
     if user:
+        existing = next((i for i in conf["identities"]
+                         if i.get("name") == user), None)
         conf["identities"] = [i for i in conf["identities"]
                               if i.get("name") != user]
         if not delete:
-            ident = {"name": user, "credentials": [], "actions":
-                     [a.strip() for a in actions.split(",") if a.strip()]
-                     or ["Read", "Write", "List"]}
+            # MERGE into the existing identity: an edit that only
+            # broadens -actions must not wipe credentials the admin
+            # didn't re-type (command_s3_configure.go:119-152)
+            ident = existing or {"name": user, "credentials": [],
+                                 "actions": []}
+            if actions:
+                ident["actions"] = [a.strip()
+                                    for a in actions.split(",")
+                                    if a.strip()]
+            elif not ident["actions"]:
+                ident["actions"] = ["Read", "Write", "List"]
             if access_key:
+                ident["credentials"] = [
+                    c for c in ident.get("credentials", [])
+                    if c.get("accessKey") != access_key]
                 ident["credentials"].append(
                     {"accessKey": access_key,
                      "secretKey": secret_key})
@@ -73,16 +80,12 @@ def s3_configure(env: CommandEnv, user: str = "",
 
 
 def s3_bucket_list(env: CommandEnv) -> list[dict]:
-    r = requests.get(f"{_filer(env)}{BUCKETS_DIR}",
-                     params={"limit": "4096"},
-                     headers={"Accept": "application/json"},
-                     timeout=30)
-    if r.status_code == 404:
-        return []
-    entries = r.json().get("entries", [])
-    return [{"name": e["full_path"].rstrip("/").rsplit("/", 1)[-1],
-             "ctime": e.get("mtime", 0)}
-            for e in entries if e.get("mode", 0) & 0o40000]
+    try:
+        entries = _list(env, BUCKETS_DIR)  # paginates past 1024
+    except ShellError:
+        return []  # no /buckets dir yet: no buckets
+    return [{"name": _name(e), "ctime": e.get("mtime", 0)}
+            for e in entries if _is_dir(e)]
 
 
 def s3_bucket_create(env: CommandEnv, name: str) -> dict:

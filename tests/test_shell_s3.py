@@ -99,3 +99,26 @@ class TestCircuitBreaker:
             time.sleep(0.3)
         r = requests.put(f"{cluster.s3_url}/cbb/big", data=b"x" * 512)
         assert r.status_code == 200
+
+
+class TestConfigureMerge:
+    def test_actions_edit_preserves_credentials(self, cluster, env):
+        run_command(env, "s3.configure -user=merge1 -access_key=MK1 "
+                         "-secret_key=MS1 -actions=Read -apply")
+        out = run_command(
+            env, "s3.configure -user=merge1 -actions=Read,Write -apply")
+        ident = next(i for i in out["identities"]
+                     if i["name"] == "merge1")
+        assert ident["actions"] == ["Read", "Write"]
+        assert ident["credentials"] == [
+            {"accessKey": "MK1", "secretKey": "MS1"}]
+        # adding a second key keeps the first
+        out = run_command(
+            env, "s3.configure -user=merge1 -access_key=MK2 "
+                 "-secret_key=MS2 -apply")
+        ident = next(i for i in out["identities"]
+                     if i["name"] == "merge1")
+        assert {c["accessKey"] for c in ident["credentials"]} == \
+            {"MK1", "MK2"}
+        assert ident["actions"] == ["Read", "Write"]  # untouched
+        run_command(env, "s3.configure -user=merge1 -delete -apply")
