@@ -118,45 +118,56 @@ def coded_matmul_pallas(a_bits: jax.Array, shards: jax.Array,
                                   interpret=interpret)
 
 
-class PallasCodec:
-    """Codec backend running the fused Pallas kernel (-ec.backend=
-    pallas). Same host-side contract as codec_jax.JaxCodec; column
-    counts are padded to COL_TILE multiples per dispatch."""
+def _make_pallas_codec_class():
+    """Deferred so importing this module never pulls codec_jax/jax
+    machinery at module import time (mirrors the lazy backend
+    factories in ec/backend.py)."""
+    from collections import OrderedDict
 
-    name = "pallas"
+    from .codec_jax import JaxCodec
 
-    def __init__(self, slab: int = 8 << 20):
-        from .codec_jax import JaxCodec
+    class PallasCodec(JaxCodec):
+        """Codec backend running the fused Pallas kernel
+        (-ec.backend=pallas). Reuses JaxCodec's slabbing + shape
+        bucketing; only the per-coefficient matrices and the dispatch
+        differ. Column counts are padded to COL_TILE multiples per
+        dispatch."""
 
-        # delegate slabbing/caching to the JaxCodec machinery with our
-        # _run + matrix preparation plugged in
-        self._inner = JaxCodec(slab=slab)
-        self._inner._coef_bits = self._coef_mats  # type: ignore
-        self._inner._run = self._run              # type: ignore
-        self._mats: dict[bytes, tuple[jax.Array, jax.Array]] = {}
+        name = "pallas"
 
-    def _coef_mats(self, coef: np.ndarray):
-        key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
-        mats = self._mats.get(key)
-        if mats is None:
-            from . import gf256
+        def __init__(self, slab: int = 8 << 20):
+            super().__init__(slab=slab)
+            self._mats: "OrderedDict[bytes, tuple]" = OrderedDict()
 
-            bits = gf256.expand_to_bits(coef)
-            mats = (plane_major_bit_matrix(bits),
-                    packing_matrix(coef.shape[0]))
-            self._mats[key] = mats
-            if len(self._mats) > 256:
-                self._mats.pop(next(iter(self._mats)))
-        return mats
+        def _coef_bits(self, coef: np.ndarray):
+            key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
+            mats = self._mats.get(key)
+            if mats is None:
+                from . import gf256
 
-    def _run(self, mats, shards: np.ndarray) -> jax.Array:
-        a_pm, pack = mats
-        n = shards.shape[1]
-        pad = (-n) % COL_TILE
-        if pad:
-            shards = np.pad(shards, ((0, 0), (0, pad)))
-        out = coded_matmul_pallas_pm(a_pm, pack, jnp.asarray(shards))
-        return out[:, :n] if pad else out
+                bits = gf256.expand_to_bits(coef)
+                mats = (plane_major_bit_matrix(bits),
+                        packing_matrix(coef.shape[0]))
+                self._mats[key] = mats
+                if len(self._mats) > self.BITMAT_CACHE_MAX:
+                    self._mats.popitem(last=False)
+            else:
+                self._mats.move_to_end(key)
+            return mats
 
-    def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
-        return self._inner.coded_matmul(coef, shards)
+        def _run(self, mats, shards: np.ndarray) -> jax.Array:
+            a_pm, pack = mats
+            n = shards.shape[1]
+            pad = (-n) % COL_TILE
+            if pad:
+                shards = np.pad(shards, ((0, 0), (0, pad)))
+            out = coded_matmul_pallas_pm(a_pm, pack,
+                                         jnp.asarray(shards))
+            return out[:, :n] if pad else out
+
+    return PallasCodec
+
+
+def PallasCodec(slab: int = 8 << 20):
+    """Factory kept under the class's name for the backend registry."""
+    return _make_pallas_codec_class()(slab=slab)

@@ -57,13 +57,13 @@ def s3_configure(env: CommandEnv, user: str = "",
             # broadens -actions must not wipe credentials the admin
             # didn't re-type (command_s3_configure.go:119-152)
             ident = existing or {"name": user, "credentials": [],
-                                 "actions": []}
+                                 "actions": ["Read", "Write", "List"]}
             if actions:
                 ident["actions"] = [a.strip()
                                     for a in actions.split(",")
                                     if a.strip()]
-            elif not ident["actions"]:
-                ident["actions"] = ["Read", "Write", "List"]
+            # note: an EXISTING identity with actions=[] stays deny-all
+            # — key-only edits must not escalate privileges
             if access_key:
                 ident["credentials"] = [
                     c for c in ident.get("credentials", [])
@@ -80,10 +80,13 @@ def s3_configure(env: CommandEnv, user: str = "",
 
 
 def s3_bucket_list(env: CommandEnv) -> list[dict]:
+    _filer(env)  # a missing -filer must error, not read as "no buckets"
     try:
         entries = _list(env, BUCKETS_DIR)  # paginates past 1024
-    except ShellError:
-        return []  # no /buckets dir yet: no buckets
+    except ShellError as e:
+        if "not found" in str(e):
+            return []  # no /buckets dir yet: no buckets
+        raise
     return [{"name": _name(e), "ctime": e.get("mtime", 0)}
             for e in entries if _is_dir(e)]
 
