@@ -362,3 +362,35 @@ def _range_end(prefix: bytes) -> bytes:
             return bytes(out)
         out.pop()
     return b"\xff" * 16
+
+
+class _GatedStore(FilerStore):
+    """Placeholder for store plugins whose client SDK isn't installed
+    (the reference's 20+ external-DB stores: redis, mysql, postgres,
+    mongodb, cassandra, etcd, ...). Registered so `-store=<name>`
+    errors with guidance instead of an unknown-store KeyError."""
+
+    KIND = ""
+    NEEDS = ""
+
+    def __init__(self, **_):
+        raise ImportError(
+            f"filer store {self.KIND!r} needs the {self.NEEDS} "
+            "package, which is not installed; embedded stores "
+            "available everywhere: memory, sqlite, leveldb")
+
+
+@register_store("redis")
+class RedisStore(_GatedStore):
+    KIND, NEEDS = "redis", "redis"
+
+
+@register_store("mysql")
+class MysqlStore(_GatedStore):
+    KIND, NEEDS = "mysql", "pymysql (layout: abstract_sql, like the "\
+                           "sqlite store's table scheme)"
+
+
+@register_store("postgres")
+class PostgresStore(_GatedStore):
+    KIND, NEEDS = "postgres", "psycopg2"

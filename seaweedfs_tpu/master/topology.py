@@ -31,6 +31,7 @@ class VolumeInfo:
     replica_placement: str = "000"
     ttl: tuple[int, int] = (0, 0)
     version: int = 3
+    modified_at: int = 0  # unix seconds of the last write
 
 
 class DataNode:
@@ -375,6 +376,12 @@ class Topology:
                             "volumes": sorted(n.volumes),
                             "collections": {
                                 str(v): info.collection
+                                for v, info in n.volumes.items()},
+                            "volume_meta": {
+                                str(v): {"ttl": list(info.ttl),
+                                         "modified_at":
+                                             info.modified_at,
+                                         "size": info.size}
                                 for v, info in n.volumes.items()},
                             "ec_volumes": {str(v): b for v, b in
                                            n.ec_shards.items()},

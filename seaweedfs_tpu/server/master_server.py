@@ -356,6 +356,7 @@ class MasterServer:
                             replica_placement=v.get(
                                 "replica_placement", "000"),
                             ttl=tuple(v.get("ttl", (0, 0))),
+                            modified_at=v.get("modified_at", 0),
                         ) for v in hb["volumes"]])
                 if "ec_shards" in hb:
                     self.topo.sync_node_ec_shards(

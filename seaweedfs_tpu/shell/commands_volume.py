@@ -27,12 +27,62 @@ def volume_list(env: CommandEnv) -> list[dict]:
     return out
 
 
+TTL_UNIT_SECONDS = {1: 60, 2: 3600, 3: 86400, 4: 604800,
+                    5: 2592000, 6: 31536000}
+TTL_GRACE_SECONDS = 60  # reference waits a beat past expiry
+
+
+def ttl_pair_seconds(ttl) -> int:
+    count, unit = (list(ttl) + [0, 0])[:2]
+    return int(count) * TTL_UNIT_SECONDS.get(int(unit), 0)
+
+
 def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]:
-    """Scan all volumes' garbage ratios; compact those above threshold
-    (topology_vacuum.go:216 Vacuum)."""
+    """Scan all volumes' garbage ratios; compact those above threshold,
+    and destroy TTL volumes whose last write has expired
+    (topology_vacuum.go:216 Vacuum + volume TTL expiry)."""
+    import time as _time
+
     done = []
-    seen: set[int] = set()
-    for n in env.data_nodes():
+    now = _time.time()
+    nodes = env.data_nodes()  # one topology snapshot for both passes
+    expired_vids: set[int] = set()
+    for n in nodes:
+        for vid_s, meta in n.get("volume_meta", {}).items():
+            vid = int(vid_s)
+            ttl_sec = ttl_pair_seconds(meta.get("ttl", (0, 0)))
+            if not ttl_sec or vid in expired_vids:
+                continue
+            modified = meta.get("modified_at", 0)
+            if modified and now > modified + ttl_sec + \
+                    TTL_GRACE_SECONDS:
+                expired_vids.add(vid)
+    if expired_vids and not env.locked:
+        # destroying volumes is a cluster mutation: do it only under
+        # the admin lock (the maintenance cron always holds it); plain
+        # unlocked vacuums still compact
+        done.append({"skipped_ttl_expiry": sorted(expired_vids),
+                     "reason": "acquire the admin lock (`lock`) to "
+                               "destroy expired TTL volumes"})
+        expired_vids = set()
+    for vid in sorted(expired_vids):
+        deleted_on = []
+        for url in env.volume_locations(vid):
+            try:
+                env.vs_post(url, "/admin/delete_volume",
+                            {"volume": vid})
+                deleted_on.append(url)
+            except ShellError:
+                continue
+        if deleted_on:  # only report what actually happened
+            done.append({"volume": vid, "expired_ttl": True,
+                         "deleted_on": deleted_on})
+        else:
+            done.append({"volume": vid, "expired_ttl": True,
+                         "error": "no replica reachable; will retry "
+                                  "next vacuum"})
+    seen: set[int] = set(expired_vids)
+    for n in nodes:
         for vid in n["volumes"]:
             if vid in seen:
                 continue

@@ -311,6 +311,9 @@ class Store:
                         str(v.super_block.replica_placement),
                     "ttl": list(v.super_block.ttl),
                     "version": v.version,
+                    # volume-TTL expiry decisions need the last write
+                    # time (volume ttl, needle/volume_ttl.go)
+                    "modified_at": v.modified_at_second(),
                 })
         ec_shards = [
             {"id": vid, "collection": ecv.collection,

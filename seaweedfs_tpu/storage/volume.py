@@ -352,6 +352,18 @@ class Volume:
                 f"{start + len(data) - end} trailing bytes dropped")
         return applied
 
+    def modified_at_second(self) -> int:
+        """Unix seconds of the last write, falling back to the .dat
+        file mtime when no stamped record exists yet — a TTL volume
+        that was assigned but never written must still age out
+        (reference initializes lastModifiedTsSeconds from file mtime)."""
+        if self.last_append_at_ns:
+            return self.last_append_at_ns // 1_000_000_000
+        try:
+            return int(os.path.getmtime(self.file_name() + ".dat"))
+        except OSError:
+            return 0
+
     def sync_status(self) -> dict:
         """Volume state for sync negotiation (VolumeSyncStatusResponse,
         volume_server.proto)."""

@@ -181,3 +181,14 @@ class TestEventLog:
         only_p2 = f.meta_log.replay(prefix="/p2")
         assert {ev["directory"] for ev in only_p2} <= {"/", "/p2"}
         assert any(ev["directory"] == "/p2" for ev in only_p2)
+
+
+class TestGatedStores:
+    def test_external_stores_registered_but_gated(self):
+        import pytest as _pytest
+
+        from seaweedfs_tpu.filer.filerstore import STORES, make_store
+        for kind in ("redis", "mysql", "postgres"):
+            assert kind in STORES
+            with _pytest.raises(ImportError):
+                make_store(kind)

@@ -230,3 +230,50 @@ class TestReplDispatch:
         assert isinstance(out, list)
         with pytest.raises(ShellError):
             repl.run_command(env, "no.such.command")
+
+
+class TestTtlVolumeExpiry:
+    def test_vacuum_destroys_expired_ttl_volume(self, tmp_path_factory):
+        import time
+
+        from seaweedfs_tpu.operation import verbs
+        from seaweedfs_tpu.server.cluster import Cluster
+        from seaweedfs_tpu.shell import commands_volume
+        from seaweedfs_tpu.shell.env import CommandEnv
+        from seaweedfs_tpu.shell.repl import run_command
+
+        c = Cluster(str(tmp_path_factory.mktemp("ttlvac")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    pulse_seconds=0.2)
+        try:
+            a = verbs.assign(c.master_url, ttl="1m")
+            verbs.upload(a, b"short-lived")
+            vid = int(a.fid.split(",")[0])
+            env = CommandEnv(c.master_url)
+            env.acquire_lock()  # destruction requires the admin lock
+            # not yet expired: vacuum leaves it alone
+            out = run_command(env, "volume.vacuum")
+            assert not any(d.get("volume") == vid and "expired_ttl" in d
+                           for d in out)
+            # age the volume by rewinding its reported write time
+            store = c.stores[0]
+            v = store.find_volume(vid)
+            v.last_append_at_ns -= int(120e9)  # 2 minutes ago
+            c.volume_servers[0].poke_heartbeat()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                meta = next((n.get("volume_meta", {}).get(str(vid))
+                             for n in env.data_nodes()
+                             if str(vid) in n.get("volume_meta", {})),
+                            None)
+                if meta and time.time() > meta["modified_at"] + 60 + \
+                        commands_volume.TTL_GRACE_SECONDS:
+                    break
+                time.sleep(0.2)
+            out = run_command(env, "volume.vacuum")
+            assert any(d.get("volume") == vid and "expired_ttl" in d
+                       for d in out), out
+            # gone from the server
+            assert store.find_volume(vid) is None
+        finally:
+            c.stop()
