@@ -255,8 +255,11 @@ class VolumeServer:
                 body = await asyncio.to_thread(
                     images.resized, body, ct, want_w, want_h,
                     req.query.get("mode", ""))
-        if is_gzip and "gzip" not in \
-                req.headers.get("Accept-Encoding", ""):
+        rng_header = req.headers.get("Range")
+        if is_gzip and (rng_header or "gzip" not in
+                        req.headers.get("Accept-Encoding", "")):
+            # ranges address ORIGINAL bytes: slicing the gzip stream
+            # would serve garbage, so partial reads always inflate
             import gzip
 
             body = gzip.decompress(body)
@@ -308,8 +311,30 @@ class VolumeServer:
             n.data = await req.read()
             if ctype and ctype != "application/octet-stream":
                 n.mime = ctype.encode()
+        if req.query.get("name"):  # replicate fan-out carries identity
+            n.name = req.query["name"].encode()
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
+        # transparent compression (needle_parse_upload.go): a client's
+        # pre-gzipped body normally arrives already inflated (aiohttp
+        # decodes Content-Encoding) and re-compresses below; if it
+        # somehow arrives still gzipped, keep it and flag it
+        from ..utils import compression
+
+        if req.query.get("compressed") == "1":
+            # replica fan-out ships the primary's stored bytes verbatim
+            n.flags |= ndl.FLAG_IS_COMPRESSED
+        elif "gzip" in req.headers.get("Content-Encoding", "") and \
+                compression.is_gzipped(n.data):
+            n.flags |= ndl.FLAG_IS_COMPRESSED
+        elif compression.is_compressible(
+                n.mime.decode("utf-8", "replace"),
+                n.name.decode("utf-8", "replace")):
+            body, did = await asyncio.to_thread(
+                compression.maybe_gzip, n.data)
+            if did:
+                n.data = body
+                n.flags |= ndl.FLAG_IS_COMPRESSED
         async with self._write_sem:
             try:
                 _, size = await asyncio.to_thread(
@@ -321,7 +346,8 @@ class VolumeServer:
         # replica fan-out (store_replicate.go:24): skip when this IS the
         # replicated copy (type=replicate marks secondary writes)
         if req.query.get("type") != "replicate":
-            err = await self._replicate(req, fid, n.data, "POST")
+            err = await self._replicate(req, fid, n.data, "POST",
+                                        needle=n)
             if err:
                 return web.Response(status=500, text=err)
         self.poke_heartbeat()
@@ -348,21 +374,44 @@ class VolumeServer:
         return web.json_response({"size": size}, status=202)
 
     async def _replicate(self, req, fid: str, data: bytes,
-                         method: str) -> str | None:
+                         method: str,
+                         needle: "ndl.Needle | None" = None) -> str | None:
         """Fan out to replica peers from master lookup, excluding self
-        (DistributedOperation, store_replicate.go:171)."""
+        (DistributedOperation, store_replicate.go:171). The secondary
+        write must carry the needle's full identity — name, mime,
+        mtime, compression — or replicas silently diverge from the
+        primary (and a gzipped body would be re-compressed)."""
         vid = int(fid.split(",")[0])
         locations = await self._lookup_volume_all(vid)
         me = f"{self.store.ip}:{self.store.port}"
         peers = [u for u in locations if u != me]
         if not peers:
             return None
+        params = {"type": "replicate"}
+        headers = {}
+        if needle is not None:
+            if needle.name:
+                params["name"] = needle.name.decode("utf-8", "replace")
+            if needle.last_modified:
+                params["ts"] = str(needle.last_modified)
+            if needle.mime:
+                headers["Content-Type"] = needle.mime.decode(
+                    "utf-8", "replace")
+            if needle.is_compressed:
+                # marker param, NOT Content-Encoding: the receiving
+                # server must append these bytes verbatim (inflate +
+                # re-gzip would waste CPU and could diverge byte-wise)
+                params["compressed"] = "1"
+        import urllib.parse
+
+        qs = urllib.parse.urlencode(params)
         async with aiohttp.ClientSession() as sess:
             for peer in peers:
-                url = f"http://{peer}/{fid}?type=replicate"
+                url = f"http://{peer}/{fid}?{qs}"
                 try:
                     if method == "POST":
-                        async with sess.post(url, data=data) as resp:
+                        async with sess.post(url, data=data,
+                                             headers=headers) as resp:
                             if resp.status >= 300:
                                 return (f"replicate to {peer}: "
                                         f"{resp.status}")
@@ -784,8 +833,16 @@ class VolumeServer:
                 n = await asyncio.to_thread(v.read_needle, key, cookie)
             except (KeyError, PermissionError, ValueError):
                 continue
+            payload = n.data
+            if n.is_compressed:
+                import gzip
+
+                try:
+                    payload = gzip.decompress(payload)
+                except OSError:
+                    continue
             out = []
-            for doc in query_json_bytes(n.data, selections, filt):
+            for doc in query_json_bytes(payload, selections, filt):
                 out.append(json.dumps(doc, separators=(",", ":")))
             if out:
                 await resp.write(("\n".join(out) + "\n").encode())

@@ -1,0 +1,148 @@
+"""Transparent upload compression (reference weed/util/compression.go +
+needle_parse_upload.go): compressible payloads stored gzipped with
+FLAG_IS_COMPRESSED, reads inflate transparently, replicas stay
+byte-identical to the primary.
+"""
+import gzip
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.storage.types import parse_file_id
+from seaweedfs_tpu.utils import compression
+
+
+class TestPolicy:
+    def test_compressible_by_mime_and_ext(self):
+        assert compression.is_compressible("text/plain")
+        assert compression.is_compressible("application/json")
+        assert compression.is_compressible("", "app.log")
+        assert not compression.is_compressible("image/jpeg", "a.jpg")
+        assert not compression.is_compressible("video/mp4")
+
+    def test_maybe_gzip_only_when_it_pays(self):
+        text = b"the quick brown fox " * 200
+        out, did = compression.maybe_gzip(text)
+        assert did and len(out) < len(text)
+        import os
+        noise = os.urandom(4096)
+        out, did = compression.maybe_gzip(noise)
+        assert not did and out is noise
+
+    def test_tiny_payload_untouched(self):
+        out, did = compression.maybe_gzip(b"small")
+        assert not did
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("gz_cluster")),
+                n_volume_servers=2, volume_size_limit=16 << 20)
+    yield c
+    c.stop()
+
+
+class TestWritePath:
+    def test_compressible_upload_stored_gzipped(self, cluster):
+        body = b"log line with repetition\n" * 500
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, body, name="app.log", mime="text/plain")
+        vid, key, _ = parse_file_id(a.fid)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        n = store.find_volume(vid).read_needle(key)
+        assert n.is_compressed
+        assert len(n.data) < len(body)
+        assert gzip.decompress(n.data) == body
+        # transparent read returns the original bytes
+        r = requests.get(f"http://{a.url}/{a.fid}")
+        assert r.content == body
+
+    def test_incompressible_upload_stored_raw(self, cluster):
+        import os
+        body = os.urandom(8192)
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, body, name="blob.bin",
+                     mime="application/octet-stream")
+        vid, key, _ = parse_file_id(a.fid)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        n = store.find_volume(vid).read_needle(key)
+        assert not n.is_compressed
+        assert n.data == body
+
+    def test_pre_gzipped_upload_round_trips(self, cluster):
+        """A client sending Content-Encoding: gzip must end with a
+        correctly-flagged compressed needle that reads back as the
+        original bytes (aiohttp transparently inflates the request
+        body, so the server re-compresses — state is identical)."""
+        body = b"already compressed by the client " * 100
+        gz = gzip.compress(body)
+        a = verbs.assign(cluster.master_url)
+        r = requests.post(
+            f"http://{a.url}/{a.fid}", data=gz,
+            headers={"Content-Type": "text/plain",
+                     "Content-Encoding": "gzip",
+                     **({"Authorization": f"Bearer {a.auth}"}
+                        if a.auth else {})})
+        assert r.status_code == 201, r.text
+        vid, key, _ = parse_file_id(a.fid)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        n = store.find_volume(vid).read_needle(key)
+        assert n.is_compressed
+        assert gzip.decompress(n.data) == body
+        assert requests.get(f"http://{a.url}/{a.fid}").content == body
+
+
+class TestReplicationFidelity:
+    def test_replicas_byte_identical(self, cluster):
+        body = b"replicate me faithfully\n" * 400
+        a = verbs.assign(cluster.master_url, replication="001")
+        verbs.upload(a, body, name="r.log", mime="text/plain")
+        vid, key, _ = parse_file_id(a.fid)
+        needles = []
+        for s in cluster.stores:
+            v = s.find_volume(vid)
+            if v is not None:
+                needles.append(v.read_needle(key))
+        assert len(needles) == 2, "replica missing"
+        a_n, b_n = needles
+        assert a_n.data == b_n.data
+        assert a_n.is_compressed and b_n.is_compressed
+        assert a_n.name == b_n.name == b"r.log"
+        assert a_n.mime == b_n.mime
+
+
+class TestCompressedReads:
+    def test_range_read_addresses_original_bytes(self, cluster):
+        body = bytes(range(256)) * 40 + b"tail-of-file" * 50
+        # force compressibility via mime
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, b"A" * 1000 + b"B" * 1000 + b"C" * 1000,
+                     name="rng.txt", mime="text/plain")
+        url = f"http://{a.url}/{a.fid}"
+        r = requests.get(url, headers={"Range": "bytes=995-1004"})
+        assert r.status_code == 206
+        assert r.content == b"A" * 5 + b"B" * 5
+        r = requests.get(url, headers={"Range": "bytes=2990-2999"})
+        assert r.content == b"C" * 10
+
+    def test_query_over_compressed_json(self, cluster):
+        docs = (b'{"svc": "api", "ms": 11}\n' * 50
+                + b'{"svc": "db", "ms": 99}\n')
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, docs, name="m.ndjson",
+                     mime="application/x-ndjson")
+        vid, key, _ = parse_file_id(a.fid)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        assert store.find_volume(vid).read_needle(key).is_compressed
+        r = requests.post(f"http://{a.url}/admin/query", json={
+            "fids": [a.fid], "selections": ["ms"],
+            "filter": {"field": "svc", "operand": "=", "value": "db"}})
+        import json as _json
+        rows = [_json.loads(x) for x in r.text.splitlines()]
+        assert rows == [{"ms": 99}]
