@@ -374,6 +374,37 @@ class FilerServer:
         prefix = req.query.get("prefix", "")
         entries = self.filer.list_entries(
             path, start_from=last, limit=limit, prefix=prefix)
+        accept = req.headers.get("Accept", "")
+        if "text/html" in accept and "application/json" not in accept:
+            # browser view (server/filer_ui/ equivalent); API clients
+            # send Accept: application/json (or nothing) and get JSON.
+            # Names are client-chosen: escape text and percent-encode
+            # hrefs or an uploaded filename becomes stored XSS.
+            import html as _html
+            import urllib.parse as _up
+
+            rows = []
+            for e in entries:
+                label = _html.escape(
+                    e.name + ("/" if e.is_directory else ""))
+                href = (_up.quote(path.rstrip("/"), safe="/") + "/"
+                        + _up.quote(e.name, safe=""))
+                size = "-" if e.is_directory else f"{e.file_size:,}"
+                mtime = time.strftime("%Y-%m-%d %H:%M:%S",
+                                      time.localtime(e.mtime))
+                rows.append(
+                    f'<tr><td><a href="{href}">{label}</a></td>'
+                    f"<td>{size}</td><td>{mtime}</td></tr>")
+            up = path.rstrip("/").rsplit("/", 1)[0] or "/"
+            return web.Response(
+                text=f"<html><body><h1>seaweedfs-tpu filer</h1>"
+                     f"<p>{_html.escape(path)}</p>"
+                     f'<p><a href="{_up.quote(up, safe="/")}">..</a>'
+                     f"</p>"
+                     f"<table border=1 cellpadding=4><tr><th>name</th>"
+                     f"<th>size</th><th>modified</th></tr>"
+                     f"{''.join(rows)}</table></body></html>",
+                content_type="text/html")
         return web.json_response({
             "path": path,
             "entries": [e.to_dict() for e in entries],

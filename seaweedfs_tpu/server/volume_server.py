@@ -79,6 +79,8 @@ class VolumeServer:
         app = web.Application(client_max_size=256 << 20,
                               middlewares=[error_mw])
         app.add_routes([
+            web.get("/", self.handle_ui),
+            web.get("/ui/index.html", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.post("/admin/assign_volume", self.handle_assign_volume),
@@ -248,33 +250,40 @@ class VolumeServer:
                 want_w = want_h = 0  # reference ignores bad dims
             if images.is_image_mime(ct) and (want_w or want_h):
                 if is_gzip:
-                    import gzip
+                    from ..utils import compression
 
-                    body = gzip.decompress(body)
+                    body = await asyncio.to_thread(
+                        compression.ungzip, body)
                     is_gzip = False
                 body = await asyncio.to_thread(
                     images.resized, body, ct, want_w, want_h,
                     req.query.get("mode", ""))
-        rng_header = req.headers.get("Range")
-        if is_gzip and (rng_header or "gzip" not in
+        rng = req.headers.get("Range")
+        if is_gzip and (rng or "gzip" not in
                         req.headers.get("Accept-Encoding", "")):
             # ranges address ORIGINAL bytes: slicing the gzip stream
             # would serve garbage, so partial reads always inflate
-            import gzip
+            # (in a worker thread: a large inflate must not stall the
+            # event loop)
+            from ..utils import compression
 
-            body = gzip.decompress(body)
+            body = await asyncio.to_thread(compression.ungzip, body)
         elif is_gzip:
             headers["Content-Encoding"] = "gzip"
         if req.method == "HEAD":
             headers["Content-Length"] = str(len(body))
             return web.Response(status=200, headers=headers)
         # range support (handlers_read.go writeResponseContent)
-        rng = req.headers.get("Range")
         if rng and rng.startswith("bytes="):
             try:
                 s, _, e = rng[len("bytes="):].partition("-")
-                start_i = int(s) if s else 0
-                end_i = int(e) if e else len(body) - 1
+                if not s:  # suffix form bytes=-N: the LAST N bytes
+                    start_i = max(0, len(body) - int(e))
+                    end_i = len(body) - 1
+                else:
+                    start_i = int(s)
+                    end_i = int(e) if e else len(body) - 1
+                end_i = min(end_i, len(body) - 1)
                 if start_i > end_i or start_i >= len(body):
                     raise ValueError
                 part = body[start_i:end_i + 1]
@@ -311,18 +320,22 @@ class VolumeServer:
             n.data = await req.read()
             if ctype and ctype != "application/octet-stream":
                 n.mime = ctype.encode()
+        from ..utils import compression
+
         if req.query.get("name"):  # replicate fan-out carries identity
-            n.name = req.query["name"].encode()
+            # latin-1 round-trips arbitrary name bytes losslessly
+            n.name = req.query["name"].encode("latin-1", "replace")
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
         # transparent compression (needle_parse_upload.go): a client's
         # pre-gzipped body normally arrives already inflated (aiohttp
         # decodes Content-Encoding) and re-compresses below; if it
         # somehow arrives still gzipped, keep it and flag it
-        from ..utils import compression
-
-        if req.query.get("compressed") == "1":
+        if req.query.get("compressed") == "1" and \
+                compression.is_gzipped(n.data):
             # replica fan-out ships the primary's stored bytes verbatim
+            # (gzip magic required: the param is client-forgeable and a
+            # false flag would make the needle unreadable forever)
             n.flags |= ndl.FLAG_IS_COMPRESSED
         elif "gzip" in req.headers.get("Content-Encoding", "") and \
                 compression.is_gzipped(n.data):
@@ -391,12 +404,13 @@ class VolumeServer:
         headers = {}
         if needle is not None:
             if needle.name:
-                params["name"] = needle.name.decode("utf-8", "replace")
+                # latin-1 maps bytes 1:1 so non-UTF-8 names survive
+                params["name"] = needle.name.decode("latin-1")
             if needle.last_modified:
                 params["ts"] = str(needle.last_modified)
             if needle.mime:
                 headers["Content-Type"] = needle.mime.decode(
-                    "utf-8", "replace")
+                    "latin-1")
             if needle.is_compressed:
                 # marker param, NOT Content-Encoding: the receiving
                 # server must append these bytes verbatim (inflate +
@@ -835,10 +849,11 @@ class VolumeServer:
                 continue
             payload = n.data
             if n.is_compressed:
-                import gzip
+                from ..utils import compression
 
                 try:
-                    payload = gzip.decompress(payload)
+                    payload = await asyncio.to_thread(
+                        compression.ungzip, payload)
                 except OSError:
                     continue
             out = []
@@ -1037,4 +1052,40 @@ class VolumeServer:
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
+
+    async def handle_ui(self, req: web.Request) -> web.Response:
+        """Status page (server/volume_server_ui/ equivalent)."""
+        import html as _html
+
+        hb = self.store.collect_heartbeat()
+        rows = "".join(
+            f"<tr><td>{v['id']}</td>"
+            f"<td>{_html.escape(v['collection']) or '-'}</td>"
+            f"<td>{v['size']:,}</td><td>{v['file_count']}</td>"
+            f"<td>{v['delete_count']}</td>"
+            f"<td>{'ro' if v['read_only'] else 'rw'}</td>"
+            f"<td>{v['replica_placement']}</td></tr>"
+            for v in hb["volumes"])
+        ec_rows = "".join(
+            f"<tr><td>{e['id']}</td>"
+            f"<td>{_html.escape(e['collection']) or '-'}</td>"
+            f"<td>{e['shard_bits']:014b}</td></tr>"
+            for e in hb["ec_shards"])
+        return web.Response(
+            text=f"<html><body><h1>seaweedfs-tpu volume server</h1>"
+                 f"<p>{_html.escape(hb['public_url'])} &middot; master "
+                 f"{self.master_url} &middot; "
+                 f"{len(hb['volumes'])} volumes, "
+                 f"{len(hb['ec_shards'])} ec volumes</p>"
+                 f"<table border=1 cellpadding=4><tr><th>id</th>"
+                 f"<th>collection</th><th>size</th><th>files</th>"
+                 f"<th>deleted</th><th>mode</th><th>rp</th></tr>"
+                 f"{rows}</table>"
+                 f"<h2>ec shards</h2>"
+                 f"<table border=1 cellpadding=4><tr><th>id</th>"
+                 f"<th>collection</th><th>shard bits</th></tr>"
+                 f"{ec_rows}</table>"
+                 f"<p><a href='/metrics'>metrics</a> &middot; "
+                 f"<a href='/status'>status</a></p></body></html>",
+            content_type="text/html")
 

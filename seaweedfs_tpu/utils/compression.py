@@ -55,3 +55,8 @@ def maybe_gzip(data: bytes) -> tuple[bytes, bool]:
 
 def is_gzipped(data: bytes) -> bool:
     return data[:2] == b"\x1f\x8b"
+
+
+def ungzip(data: bytes) -> bytes:
+    """Inflate stored needle bytes (single home for the codec policy)."""
+    return gzip.decompress(data)

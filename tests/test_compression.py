@@ -146,3 +146,26 @@ class TestCompressedReads:
         import json as _json
         rows = [_json.loads(x) for x in r.text.splitlines()]
         assert rows == [{"ms": 99}]
+
+
+class TestRangeAndForgery:
+    def test_suffix_range_returns_tail(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, b"H" * 990 + b"TAIL-BYTES", name="sfx.txt",
+                     mime="text/plain")
+        r = requests.get(f"http://{a.url}/{a.fid}",
+                         headers={"Range": "bytes=-10"})
+        assert r.status_code == 206
+        assert r.content == b"TAIL-BYTES"
+
+    def test_forged_compressed_param_ignored(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        body = b"\x00\x01plain-not-gzip" * 50
+        r = requests.post(
+            f"http://{a.url}/{a.fid}?compressed=1", data=body,
+            headers={**({"Authorization": f"Bearer {a.auth}"}
+                        if a.auth else {})})
+        assert r.status_code == 201
+        got = requests.get(f"http://{a.url}/{a.fid}")
+        assert got.status_code == 200
+        assert got.content == body  # readable, flag not forged

@@ -68,3 +68,49 @@ class TestGatewayMetrics:
         import json as _json
         for name in TEMPLATES:
             _json.loads(scaffold(name))
+
+
+class TestStatusUis:
+    def test_volume_and_filer_ui_pages(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("ui")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True)
+        try:
+            from seaweedfs_tpu.operation import verbs
+            a = verbs.assign(c.master_url)
+            verbs.upload(a, b"ui-test")
+            r = requests.get(c.volume_url(0) + "/")
+            assert r.status_code == 200
+            assert "volume server" in r.text and "<table" in r.text
+            requests.post(f"{c.filer_url}/docs/page.txt", data=b"x")
+            # browser gets HTML listing...
+            r = requests.get(f"{c.filer_url}/docs",
+                             headers={"Accept": "text/html"})
+            assert "page.txt" in r.text and "<table" in r.text
+            # ...API clients still get JSON
+            r = requests.get(f"{c.filer_url}/docs",
+                             headers={"Accept": "application/json"})
+            assert r.json()["entries"]
+            # master UI too
+            r = requests.get(c.master_url + "/")
+            assert "master" in r.text
+        finally:
+            c.stop()
+
+    def test_filer_listing_escapes_names(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("xss")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True)
+        try:
+            evil = "<img src=x onerror=alert(1)>.txt"
+            import urllib.parse
+            r = requests.post(
+                f"{c.filer_url}/xss/{urllib.parse.quote(evil, safe='')}",
+                data=b"x")
+            assert r.status_code == 201
+            page = requests.get(f"{c.filer_url}/xss",
+                                headers={"Accept": "text/html"}).text
+            assert "<img src=x" not in page
+            assert "&lt;img" in page
+        finally:
+            c.stop()
