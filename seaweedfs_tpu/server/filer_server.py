@@ -396,6 +396,11 @@ class FilerServer:
                     f'<tr><td><a href="{href}">{label}</a></td>'
                     f"<td>{size}</td><td>{mtime}</td></tr>")
             up = path.rstrip("/").rsplit("/", 1)[0] or "/"
+            more = ""
+            if len(entries) == limit:  # browser pagination
+                nxt = _up.quote(entries[-1].name, safe="")
+                more = (f'<p><a href="?lastFileName={nxt}">'
+                        f"next page &raquo;</a></p>")
             return web.Response(
                 text=f"<html><body><h1>seaweedfs-tpu filer</h1>"
                      f"<p>{_html.escape(path)}</p>"
@@ -403,7 +408,7 @@ class FilerServer:
                      f"</p>"
                      f"<table border=1 cellpadding=4><tr><th>name</th>"
                      f"<th>size</th><th>modified</th></tr>"
-                     f"{''.join(rows)}</table></body></html>",
+                     f"{''.join(rows)}</table>{more}</body></html>",
                 content_type="text/html")
         return web.json_response({
             "path": path,

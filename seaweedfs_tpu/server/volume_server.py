@@ -322,9 +322,16 @@ class VolumeServer:
                 n.mime = ctype.encode()
         from ..utils import compression
 
-        if req.query.get("name"):  # replicate fan-out carries identity
-            # latin-1 round-trips arbitrary name bytes losslessly
-            n.name = req.query["name"].encode("latin-1", "replace")
+        is_replicate = req.query.get("type") == "replicate"
+        if req.query.get("name"):
+            if is_replicate:
+                # server-to-server: latin-1 maps bytes 1:1 so the
+                # primary's exact name bytes survive the query string
+                n.name = req.query["name"].encode("latin-1", "replace")
+            else:
+                n.name = req.query["name"].encode()  # client text
+        if is_replicate and req.query.get("mime"):
+            n.mime = req.query["mime"].encode("latin-1", "replace")
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
         # transparent compression (needle_parse_upload.go): a client's
@@ -367,7 +374,8 @@ class VolumeServer:
         metrics.histogram_observe("volume_server_write_seconds",
                                   time.perf_counter() - start)
         return web.json_response(
-            {"name": n.name.decode() if n.name else "",
+            {"name": n.name.decode("utf-8", "replace") if n.name
+             else "",
              "size": len(n.data), "eTag": n.etag()}, status=201)
 
     async def _delete_fid(self, req, fid, vid, key) -> web.Response:
@@ -409,8 +417,10 @@ class VolumeServer:
             if needle.last_modified:
                 params["ts"] = str(needle.last_modified)
             if needle.mime:
-                headers["Content-Type"] = needle.mime.decode(
-                    "latin-1")
+                # query param, not Content-Type: the header would be
+                # re-encoded as UTF-8 on the other side and non-ASCII
+                # mime bytes would diverge from the primary
+                params["mime"] = needle.mime.decode("latin-1")
             if needle.is_compressed:
                 # marker param, NOT Content-Encoding: the receiving
                 # server must append these bytes verbatim (inflate +

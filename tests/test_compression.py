@@ -169,3 +169,43 @@ class TestRangeAndForgery:
         got = requests.get(f"http://{a.url}/{a.fid}")
         assert got.status_code == 200
         assert got.content == body  # readable, flag not forged
+
+
+class TestNameFidelity:
+    def test_utf8_client_names_preserved(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        r = requests.post(f"http://{a.url}/{a.fid}",
+                          params={"name": "日本語.txt"},
+                          data=b"unicode name" * 20,
+                          headers={"Content-Type": "text/plain",
+                                   **({"Authorization":
+                                       f"Bearer {a.auth}"}
+                                      if a.auth else {})})
+        assert r.status_code == 201, r.text
+        assert r.json()["name"] == "日本語.txt"
+        vid, key, _ = parse_file_id(a.fid)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        assert store.find_volume(vid).read_needle(key).name == \
+            "日本語.txt".encode()
+
+    def test_replicated_utf8_name_and_mime_identical(self, cluster):
+        a = verbs.assign(cluster.master_url, replication="001")
+        r = requests.post(f"http://{a.url}/{a.fid}",
+                          params={"name": "résumé 日本.txt"},
+                          data=b"replicate unicode " * 40,
+                          headers={"Content-Type":
+                                   "text/plain; charset=utf-8",
+                                   **({"Authorization":
+                                       f"Bearer {a.auth}"}
+                                      if a.auth else {})})
+        assert r.status_code == 201, r.text
+        vid, key, _ = parse_file_id(a.fid)
+        needles = [s.find_volume(vid).read_needle(key)
+                   for s in cluster.stores
+                   if s.find_volume(vid) is not None]
+        assert len(needles) == 2
+        assert needles[0].name == needles[1].name == \
+            "résumé 日本.txt".encode()
+        assert needles[0].mime == needles[1].mime
+        assert needles[0].data == needles[1].data

@@ -1,0 +1,159 @@
+"""Multi-process end-to-end: real OS processes via the CLI — the
+single-host analogue of the reference's docker-compose cluster tests
+(docker/compose/local-cluster-compose.yml, e2e.yml): master + two
+volume servers + filer + s3 as separate processes, exercised through
+their public interfaces only, then torn down.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_http(url, timeout=30):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            requests.get(url, timeout=1)
+            return
+        except requests.RequestException as e:
+            last = e
+            time.sleep(0.15)
+    raise TimeoutError(f"{url} never came up: {last}")
+
+
+class Procs:
+    def __init__(self):
+        self.procs: list[subprocess.Popen] = []
+        self.env = dict(os.environ, PYTHONPATH=REPO)
+
+    def spawn(self, *argv) -> subprocess.Popen:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *argv],
+            env=self.env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self.procs.append(p)
+        return p
+
+    def stop_all(self):
+        for p in reversed(self.procs):
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in reversed(self.procs):
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("mp")
+    procs = Procs()
+    mport, f_port, s_port = free_port(), free_port(), free_port()
+    vports = [free_port(), free_port()]
+    master = f"http://127.0.0.1:{mport}"
+    filer = f"http://127.0.0.1:{f_port}"
+    s3 = f"http://127.0.0.1:{s_port}"
+    procs.spawn("master", "-port", str(mport),
+                "-volumeSizeLimitMB", "64")
+    wait_http(f"{master}/cluster/status")
+    for i, vp in enumerate(vports):
+        d = base / f"vol{i}"
+        d.mkdir()
+        procs.spawn("volume", "-port", str(vp), "-dir", str(d),
+                    "-mserver", f"127.0.0.1:{mport}",
+                    "-index", "compact" if i else "memory")
+        wait_http(f"http://127.0.0.1:{vp}/status")
+    procs.spawn("filer", "-port", str(f_port), "-master", master,
+                "-store", "leveldb",
+                "-store.path", str(base / "filerdb"))
+    wait_http(f"{filer}/status")
+    procs.spawn("s3", "-port", str(s_port), "-filer", filer)
+    wait_http(f"{s3}/status")
+    # volume servers registered?
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        topo = requests.get(f"{master}/cluster/status").json()["Topology"]
+        n = sum(len(r["nodes"]) for dc in topo["datacenters"]
+                for r in dc["racks"])
+        if n >= 2:
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("volume servers never registered")
+    yield {"master": master, "filer": filer, "s3": s3, "procs": procs}
+    procs.stop_all()
+
+
+def test_object_write_read_delete(cluster):
+    m = cluster["master"]
+    a = requests.get(f"{m}/dir/assign").json()
+    url = f"http://{a['url']}/{a['fid']}"
+    assert requests.post(url, data=b"cross-process bytes",
+                         ).status_code == 201
+    assert requests.get(url).content == b"cross-process bytes"
+    assert requests.delete(url).status_code in (200, 202, 204)
+    assert requests.get(url).status_code == 404
+
+
+def test_filer_and_s3_roundtrip(cluster):
+    f, s3 = cluster["filer"], cluster["s3"]
+    body = b"filer through real processes\n" * 100
+    assert requests.post(f"{f}/proj/readme.txt", data=body,
+                         headers={"Content-Type": "text/plain"},
+                         ).status_code == 201
+    assert requests.get(f"{f}/proj/readme.txt").content == body
+    requests.put(f"{s3}/artifacts")
+    requests.put(f"{s3}/artifacts/build.log", data=b"ok\n" * 500)
+    got = requests.get(f"{s3}/artifacts/build.log")
+    assert got.content == b"ok\n" * 500
+    listing = requests.get(f"{s3}/artifacts").text
+    assert "build.log" in listing
+
+
+def test_shell_against_real_cluster(cluster):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from seaweedfs_tpu.shell.env import CommandEnv\n"
+        "from seaweedfs_tpu.shell.repl import run_command\n"
+        "env = CommandEnv(%r, filer_url=%r)\n"
+        "print(len(run_command(env, 'volume.list')))\n"
+        "print(run_command(env, 'cluster.check')['nodes'] >= 2)\n"
+    ) % (REPO, cluster["master"], cluster["filer"])
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, env=env,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert int(lines[0]) >= 1
+    assert lines[1] == "True"
+
+
+def test_benchmark_cli(cluster):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", "benchmark",
+         "-master", cluster["master"], "-n", "50", "-size", "512",
+         "-c", "4"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "req/s" in out.stdout or "write" in out.stdout.lower()
