@@ -9,7 +9,11 @@ appended to the metadata event log (filer_notify.go).
 """
 from __future__ import annotations
 
+import json
+import threading
 import time
+import uuid
+from dataclasses import replace
 from typing import Callable
 
 from .entry import DIR_MODE_FLAG, Entry, FileChunk
@@ -36,6 +40,91 @@ class Filer:
                       else make_store(store, **store_kwargs))
         self.meta_log = MetaEventLog(signature=signature)
         self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
+        self._hardlink_lock = threading.Lock()
+
+    # -- hard links (filerstore_hardlink.go) ----------------------------
+    # Linked entries share one content record in the store's KV space:
+    # {"count": refs, "chunks": [...]}. Entries carry hard_link_id and
+    # no chunks of their own; reads resolve through the record, so a
+    # write via any name is visible through all names, and the chunks
+    # are reclaimed only when the last name goes away.
+    HARDLINK_KV_PREFIX = "hardlink/"
+
+    def _hardlink_record(self, hid: str) -> dict | None:
+        raw = self.store.kv_get(self.HARDLINK_KV_PREFIX + hid)
+        return json.loads(raw) if raw else None
+
+    def _put_hardlink_record(self, hid: str, rec: dict) -> None:
+        self.store.kv_put(self.HARDLINK_KV_PREFIX + hid,
+                          json.dumps(rec).encode())
+
+    def _resolve_hardlink(self, e: Entry) -> Entry:
+        if e.hard_link_id and not e.is_directory:
+            rec = self._hardlink_record(e.hard_link_id)
+            if rec is not None:
+                e.chunks = [FileChunk.from_dict(c)
+                            for c in rec.get("chunks", [])]
+        return e
+
+    def link(self, src_path: str, dst_path: str,
+             signatures: list[int] | None = None) -> Entry:
+        """Create a hard link: dst becomes another name for src's
+        content (mount link(), filer_pb AppendToEntry-style sharing)."""
+        src_path, dst_path = norm_path(src_path), norm_path(dst_path)
+        with self._hardlink_lock:
+            # src is (re)read under the lock: two concurrent first-links
+            # must not each mint their own record for the same file
+            src = self.find_entry(src_path)
+            if src is None:
+                raise FileNotFoundError(src_path)
+            if src.is_directory:
+                raise IsADirectoryError(f"cannot hard-link a "
+                                        f"directory: {src_path}")
+            if self.find_entry(dst_path) is not None:
+                raise FileExistsError(dst_path)
+            if not src.hard_link_id:
+                hid = uuid.uuid4().hex
+                self._put_hardlink_record(
+                    hid, {"count": 1,
+                          "chunks": [c.to_dict() for c in src.chunks]})
+                old_src = replace(src)
+                src.hard_link_id = hid
+                self.store.insert_entry(replace(src, chunks=[]))
+                # src changed shape: event consumers (meta backups,
+                # other mounts) must learn its hard_link_id
+                d, _ = src.dir_and_name
+                self.meta_log.append(d, old_src, src, signatures)
+            rec = self._hardlink_record(src.hard_link_id)
+            rec["count"] = int(rec.get("count", 1)) + 1
+            self._put_hardlink_record(src.hard_link_id, rec)
+        dst = Entry(full_path=dst_path, mode=src.mode, uid=src.uid,
+                    gid=src.gid, mime=src.mime, md5=src.md5,
+                    collection=src.collection,
+                    replication=src.replication,
+                    hard_link_id=src.hard_link_id)
+        self._ensure_parents(dst_path)
+        self.store.insert_entry(replace(dst, chunks=[]))
+        dst = self._resolve_hardlink(dst)
+        d, _ = dst.dir_and_name
+        # log the RESOLVED entry: subscribers must see real chunks
+        self.meta_log.append(d, None, dst, signatures)
+        return dst
+
+    def _hardlink_unref(self, e: Entry) -> list[FileChunk]:
+        """Drop one reference; returns the chunks to reclaim when this
+        was the last name."""
+        with self._hardlink_lock:
+            rec = self._hardlink_record(e.hard_link_id)
+            if rec is None:
+                return []
+            rec["count"] = int(rec.get("count", 1)) - 1
+            if rec["count"] <= 0:
+                self.store.kv_delete(
+                    self.HARDLINK_KV_PREFIX + e.hard_link_id)
+                return [FileChunk.from_dict(c)
+                        for c in rec.get("chunks", [])]
+            self._put_hardlink_record(e.hard_link_id, rec)
+            return []
 
     # -- reads ----------------------------------------------------------
     def find_entry(self, path: str) -> Entry | None:
@@ -46,7 +135,7 @@ class Filer:
         if e is not None and e.is_expired():
             self.store.delete_entry(path)
             return None
-        return e
+        return self._resolve_hardlink(e) if e is not None else None
 
     def list_entries(self, dirpath: str, start_from: str = "",
                      inclusive: bool = False, limit: int = LIST_BATCH,
@@ -59,7 +148,7 @@ class Filer:
             if e.is_expired(now):
                 self.store.delete_entry(e.full_path)
                 continue
-            out.append(e)
+            out.append(self._resolve_hardlink(e))
         return out
 
     def iter_tree(self, dirpath: str):
@@ -75,7 +164,7 @@ class Filer:
             for e in batch:
                 if e.is_expired(now):
                     continue
-                yield e
+                yield self._resolve_hardlink(e)
                 if e.is_directory:
                     yield from self.iter_tree(e.full_path)
             if len(batch) < LIST_BATCH:
@@ -92,10 +181,38 @@ class Filer:
         old = self.store.find_entry(entry.full_path)
         if old is not None and old.is_directory and not entry.is_directory:
             raise IsADirectoryError(entry.full_path)
+        if old is not None and old.hard_link_id and \
+                not entry.hard_link_id:
+            # a plain overwrite replaces this NAME only: drop one link
+            # reference; shared chunks are freed only at the last name
+            freed = self._hardlink_unref(old)
+            if freed:
+                self.on_delete_chunks(freed)
+        logged = entry
+        if entry.hard_link_id and not entry.is_directory:
+            # content lives in the shared record: a write through any
+            # name must be visible through every name — and the chunks
+            # it replaces must be reclaimed (every other overwrite path
+            # skips GC for hardlinked entries, so this is the one spot)
+            with self._hardlink_lock:
+                rec = self._hardlink_record(entry.hard_link_id) or \
+                    {"count": 1}
+                keep = {c.fid for c in entry.chunks}
+                replaced = [FileChunk.from_dict(c)
+                            for c in rec.get("chunks", [])
+                            if c.get("fid") not in keep]
+                rec["chunks"] = [c.to_dict() for c in entry.chunks]
+                self._put_hardlink_record(entry.hard_link_id, rec)
+            entry = replace(entry, chunks=[])
+            if replaced:
+                self.on_delete_chunks(replaced)
         self.store.insert_entry(entry)
         d, _ = entry.dir_and_name
-        self.meta_log.append(d, old, entry, signatures)
-        return entry
+        # the event carries the RESOLVED shape (real chunks): metadata
+        # subscribers (other mounts, backups, replication) must not see
+        # hardlinked files as empty
+        self.meta_log.append(d, old, logged, signatures)
+        return self._resolve_hardlink(entry)
 
     def update_entry(self, entry: Entry,
                      signatures: list[int] | None = None) -> Entry:
@@ -142,12 +259,17 @@ class Filer:
                 raise DirectoryNotEmptyError(
                     f"directory not empty: {path}")
             for sub in self.iter_tree(path):
-                if not sub.is_directory and not sub.hard_link_id:
-                    dead_chunks.extend(sub.chunks)
+                if not sub.is_directory:
+                    if sub.hard_link_id:
+                        dead_chunks.extend(self._hardlink_unref(sub))
+                    else:
+                        dead_chunks.extend(sub.chunks)
                 d, _ = sub.dir_and_name
                 self.meta_log.append(d, sub, None, signatures)
             self.store.delete_folder_children(path)
-        elif not e.hard_link_id:
+        elif e.hard_link_id:
+            dead_chunks.extend(self._hardlink_unref(e))
+        else:
             dead_chunks.extend(e.chunks)
         self.store.delete_entry(path)
         d, _ = e.dir_and_name

@@ -92,6 +92,12 @@ class FilerClient:
         r.raise_for_status()
 
     # -- chunks ---------------------------------------------------------
+    def link(self, src: str, dst: str) -> None:
+        r = requests.post(f"{self.filer_url}{dst}",
+                          params={"link.from": src}, timeout=60)
+        if r.status_code >= 300:
+            raise OSError(r.status_code, r.text)
+
     def upload_chunk(self, data: bytes, name: str = "") -> tuple[str, str]:
         """-> (fid, etag): assign a fid at the master and upload the
         chunk bytes to its volume server."""

@@ -204,6 +204,27 @@ class WeedFS:
                 elif h.path.startswith(old + "/"):
                     h.path = new + h.path[len(old):]
 
+    def link(self, src: str, dst: str) -> None:
+        """Hard link (weedfs_link.go): another name for src's chunks,
+        shared through the filer's hardlink record."""
+        if self._entry(src) is None:
+            raise FuseError(2)  # ENOENT
+        if self._entry(dst) is not None:
+            raise FuseError(17)  # EEXIST
+        try:
+            self.client.link(self._abs(src), self._abs(dst))
+        except OSError as e:
+            # local pre-checks ran against a possibly-stale meta cache;
+            # the server's verdict wins and must keep POSIX semantics
+            status = e.errno
+            if status == 404:
+                raise FuseError(2, str(e))   # ENOENT
+            if status == 409:
+                raise FuseError(17, str(e))  # EEXIST
+            raise FuseError(5, str(e))       # EIO
+        self.meta.invalidate(self._abs(src))
+        self.meta.invalidate(self._abs(dst))
+
     def symlink(self, target: str, linkpath: str) -> None:
         full = self._abs(linkpath)
         entry = Entry(full_path=full, mode=0o777,

@@ -446,6 +446,10 @@ class FilerServer:
             self.filer.rename(req.query["mv.from"], path,
                               signatures=signatures)
             return web.json_response({"path": path})
+        if "link.from" in req.query:  # hard link verb
+            e = self.filer.link(req.query["link.from"], path,
+                                signatures=signatures)
+            return web.json_response(e.to_dict(), status=201)
         if "cacheRemote" in req.query:
             return await self._cache_remote(path, signatures)
         if "uncacheRemote" in req.query:
@@ -459,7 +463,8 @@ class FilerServer:
             entry = Entry.from_dict(d)
             old = self.filer.find_entry(path)
             self.filer.create_entry(entry, signatures=signatures)
-            if old is not None and not old.is_directory:
+            if old is not None and not old.is_directory \
+                and not old.hard_link_id:
                 keep = {c.fid for c in entry.chunks}
                 await asyncio.to_thread(
                     self._delete_chunks,
@@ -524,7 +529,8 @@ class FilerServer:
                       md5=md5_all.hexdigest(), collection=collection,
                       replication=replication, chunks=chunks)
         self.filer.create_entry(entry, signatures=signatures)
-        if old is not None and not old.is_directory:
+        if old is not None and not old.is_directory \
+                and not old.hard_link_id:
             dead = [c for c in old.chunks
                     if c.fid not in {n.fid for n in chunks}]
             await asyncio.to_thread(self._delete_chunks, dead)

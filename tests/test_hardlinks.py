@@ -1,0 +1,137 @@
+"""Hard links: shared content record, refcounted chunk reclamation,
+write-through-any-name visibility (reference
+weed/filer/filerstore_hardlink.go, weed/mount/weedfs_link.go).
+"""
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Entry, FileChunk, Filer
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+def touch(f, path, fid="1,ab", size=4):
+    import time
+    return f.create_entry(Entry(
+        full_path=path,
+        chunks=[FileChunk(fid=fid, offset=0, size=size,
+                          mtime_ns=time.time_ns())]))
+
+
+@pytest.fixture(params=["memory", "leveldb"])
+def filer(request, tmp_path):
+    kwargs = {"path": str(tmp_path / "db")} \
+        if request.param == "leveldb" else {}
+    f = Filer(request.param, **kwargs)
+    yield f
+    f.close()
+
+
+class TestFilerCore:
+    def test_link_shares_content(self, filer):
+        touch(filer, "/a/orig", fid="3,aa")
+        filer.link("/a/orig", "/b/alias")
+        alias = filer.find_entry("/b/alias")
+        assert alias is not None
+        assert [c.fid for c in alias.chunks] == ["3,aa"]
+        orig = filer.find_entry("/a/orig")
+        assert orig.hard_link_id == alias.hard_link_id != ""
+
+    def test_write_through_one_name_visible_via_other(self, filer):
+        import time
+        touch(filer, "/a/f1", fid="3,aa")
+        filer.link("/a/f1", "/a/f2")
+        e = filer.find_entry("/a/f2")
+        e.chunks = [FileChunk(fid="9,ff", offset=0, size=8,
+                              mtime_ns=time.time_ns())]
+        filer.update_entry(e)
+        assert [c.fid for c in filer.find_entry("/a/f1").chunks] == \
+            ["9,ff"]
+
+    def test_chunks_freed_only_at_last_name(self, filer):
+        dead = []
+        filer.on_delete_chunks = dead.extend
+        touch(filer, "/h/x", fid="5,cc")
+        filer.link("/h/x", "/h/y")
+        filer.link("/h/y", "/h/z")
+        filer.delete_entry("/h/x")
+        filer.delete_entry("/h/z")
+        assert dead == []  # /h/y still references the record
+        assert [c.fid for c in filer.find_entry("/h/y").chunks] == \
+            ["5,cc"]
+        filer.delete_entry("/h/y")
+        assert [c.fid for c in dead] == ["5,cc"]
+
+    def test_recursive_delete_unrefs(self, filer):
+        dead = []
+        filer.on_delete_chunks = dead.extend
+        touch(filer, "/d1/f", fid="6,dd")
+        filer.link("/d1/f", "/d2/alias")
+        filer.delete_entry("/d1", recursive=True)
+        assert dead == []
+        assert filer.find_entry("/d2/alias") is not None
+        filer.delete_entry("/d2", recursive=True)
+        assert [c.fid for c in dead] == ["6,dd"]
+
+    def test_overwrite_linked_name_unrefs(self, filer):
+        dead = []
+        filer.on_delete_chunks = dead.extend
+        touch(filer, "/o/a", fid="7,ee")
+        filer.link("/o/a", "/o/b")
+        touch(filer, "/o/a", fid="8,11")  # plain overwrite of one name
+        assert dead == []  # shared chunks NOT freed: /o/b lives on
+        assert [c.fid for c in filer.find_entry("/o/b").chunks] == \
+            ["7,ee"]
+        filer.delete_entry("/o/b")
+        assert [c.fid for c in dead] == ["7,ee"]
+
+    def test_link_errors(self, filer):
+        with pytest.raises(FileNotFoundError):
+            filer.link("/nope", "/x")
+        filer.mkdir("/adir")
+        with pytest.raises(IsADirectoryError):
+            filer.link("/adir", "/x")
+        touch(filer, "/e/a")
+        touch(filer, "/e/b")
+        with pytest.raises(FileExistsError):
+            filer.link("/e/a", "/e/b")
+
+
+class TestOverHttp:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("hl")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True)
+        yield c
+        c.stop()
+
+    def test_link_verb_and_mount(self, cluster):
+        f = cluster.filer_url
+        requests.post(f"{f}/files/data.bin", data=b"linked bytes")
+        r = requests.post(f"{f}/files/alias.bin",
+                          params={"link.from": "/files/data.bin"})
+        assert r.status_code == 201, r.text
+        assert requests.get(f"{f}/files/alias.bin").content == \
+            b"linked bytes"
+        # delete the original; alias still serves the bytes
+        requests.delete(f"{f}/files/data.bin")
+        assert requests.get(f"{f}/files/alias.bin").content == \
+            b"linked bytes"
+
+    def test_mount_link_op(self, cluster):
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+        fs = WeedFS(cluster.filer_url, cluster.master_url)
+        try:
+            fh = fs.create("/m/one.txt")
+            fs.write(fh, 0, b"mounted hardlink")
+            fs.release(fh)
+            fs.link("/m/one.txt", "/m/two.txt")
+            fh = fs.open("/m/two.txt")
+            assert fs.read(fh, 0, 100) == b"mounted hardlink"
+            fs.release(fh)
+            fs.unlink("/m/one.txt")
+            fh = fs.open("/m/two.txt")
+            assert fs.read(fh, 0, 100) == b"mounted hardlink"
+            fs.release(fh)
+        finally:
+            fs.destroy()
