@@ -18,6 +18,11 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="seaweedfs-tpu",
         description="TPU-native distributed object store")
+    parser.add_argument(
+        "-cpuprofile", default="",
+        help="write a cProfile dump here on exit (the reference's "
+             "grace.SetupProfiling, util/grace/pprof.go:11); place "
+             "BEFORE the subcommand")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("master", help="start a master server")
@@ -210,6 +215,17 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("version")
 
     args = parser.parse_args(argv)
+    if args.cpuprofile:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            return _dispatch(args)
+        finally:
+            prof.disable()
+            prof.dump_stats(args.cpuprofile)
+            print(f"cpu profile written to {args.cpuprofile}")
     return _dispatch(args)
 
 

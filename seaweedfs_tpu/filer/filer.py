@@ -64,6 +64,10 @@ class Filer:
             if rec is not None:
                 e.chunks = [FileChunk.from_dict(c)
                             for c in rec.get("chunks", [])]
+                # version stamp: a later save of this entry proves it
+                # saw THIS content (guards metadata-only saves built
+                # from a stale read from clobbering newer writes)
+                e.extended["hardlink_ver"] = str(rec.get("ver", 0))
         return e
 
     def link(self, src_path: str, dst_path: str,
@@ -97,13 +101,16 @@ class Filer:
             rec = self._hardlink_record(src.hard_link_id)
             rec["count"] = int(rec.get("count", 1)) + 1
             self._put_hardlink_record(src.hard_link_id, rec)
-        dst = Entry(full_path=dst_path, mode=src.mode, uid=src.uid,
-                    gid=src.gid, mime=src.mime, md5=src.md5,
-                    collection=src.collection,
-                    replication=src.replication,
-                    hard_link_id=src.hard_link_id)
-        self._ensure_parents(dst_path)
-        self.store.insert_entry(replace(dst, chunks=[]))
+            # dst insert stays under the lock: a racing link() to the
+            # same dst must hit FileExistsError, not clobber-and-leak
+            dst = Entry(full_path=dst_path, mode=src.mode, uid=src.uid,
+                        gid=src.gid, mime=src.mime, md5=src.md5,
+                        collection=src.collection,
+                        replication=src.replication,
+                        ttl_sec=src.ttl_sec,
+                        hard_link_id=src.hard_link_id)
+            self._ensure_parents(dst_path)
+            self.store.insert_entry(replace(dst, chunks=[]))
         dst = self._resolve_hardlink(dst)
         d, _ = dst.dir_and_name
         # log the RESOLVED entry: subscribers must see real chunks
@@ -126,6 +133,15 @@ class Filer:
             self._put_hardlink_record(e.hard_link_id, rec)
             return []
 
+    def _expire(self, e: Entry) -> None:
+        """Drop a TTL-expired name; a hardlinked name must release its
+        record reference or the shared chunks leak forever."""
+        self.store.delete_entry(e.full_path)
+        if e.hard_link_id and not e.is_directory:
+            freed = self._hardlink_unref(e)
+            if freed:
+                self.on_delete_chunks(freed)
+
     # -- reads ----------------------------------------------------------
     def find_entry(self, path: str) -> Entry | None:
         path = norm_path(path)
@@ -133,7 +149,7 @@ class Filer:
             return Entry(full_path="/", mode=0o775 | DIR_MODE_FLAG)
         e = self.store.find_entry(path)
         if e is not None and e.is_expired():
-            self.store.delete_entry(path)
+            self._expire(e)
             return None
         return self._resolve_hardlink(e) if e is not None else None
 
@@ -146,7 +162,7 @@ class Filer:
             dirpath, start_from, inclusive, limit, prefix)
         for e in batch:
             if e.is_expired(now):
-                self.store.delete_entry(e.full_path)
+                self._expire(e)
                 continue
             out.append(self._resolve_hardlink(e))
         return out
@@ -182,9 +198,10 @@ class Filer:
         if old is not None and old.is_directory and not entry.is_directory:
             raise IsADirectoryError(entry.full_path)
         if old is not None and old.hard_link_id and \
-                not entry.hard_link_id:
-            # a plain overwrite replaces this NAME only: drop one link
-            # reference; shared chunks are freed only at the last name
+                entry.hard_link_id != old.hard_link_id:
+            # this NAME now points elsewhere (plain overwrite or a
+            # different link id): drop one reference on the old record;
+            # shared chunks are freed only at the last name
             freed = self._hardlink_unref(old)
             if freed:
                 self.on_delete_chunks(freed)
@@ -193,16 +210,29 @@ class Filer:
             # content lives in the shared record: a write through any
             # name must be visible through every name — and the chunks
             # it replaces must be reclaimed (every other overwrite path
-            # skips GC for hardlinked entries, so this is the one spot)
+            # skips GC for hardlinked entries, so this is the one spot).
+            # A save whose hardlink_ver doesn't match saw STALE content
+            # (e.g. chmod built from an old read racing a writer): its
+            # metadata is stored but its chunk list is ignored — it
+            # must not resurrect old chunks or delete newer ones.
+            caller_ver = entry.extended.pop("hardlink_ver", None)
+            replaced: list[FileChunk] = []
             with self._hardlink_lock:
                 rec = self._hardlink_record(entry.hard_link_id) or \
-                    {"count": 1}
-                keep = {c.fid for c in entry.chunks}
-                replaced = [FileChunk.from_dict(c)
-                            for c in rec.get("chunks", [])
-                            if c.get("fid") not in keep]
-                rec["chunks"] = [c.to_dict() for c in entry.chunks]
-                self._put_hardlink_record(entry.hard_link_id, rec)
+                    {"count": 1, "ver": 0, "chunks": []}
+                current = int(rec.get("ver", 0))
+                accept = (caller_ver is not None
+                          and int(caller_ver) == current) or \
+                    not rec.get("chunks")
+                if accept:
+                    keep = {c.fid for c in entry.chunks}
+                    replaced = [FileChunk.from_dict(c)
+                                for c in rec.get("chunks", [])
+                                if c.get("fid") not in keep]
+                    rec["chunks"] = [c.to_dict()
+                                     for c in entry.chunks]
+                    rec["ver"] = current + 1
+                    self._put_hardlink_record(entry.hard_link_id, rec)
             entry = replace(entry, chunks=[])
             if replaced:
                 self.on_delete_chunks(replaced)

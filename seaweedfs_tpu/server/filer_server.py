@@ -443,12 +443,14 @@ class FilerServer:
                           f"{rule.max_file_name_length}-byte limit set "
                           "by filer.conf"}, status=400)
         if "mv.from" in req.query:  # rename verb, reference-compatible
-            self.filer.rename(req.query["mv.from"], path,
-                              signatures=signatures)
+            await asyncio.to_thread(
+                self.filer.rename, req.query["mv.from"], path,
+                signatures=signatures)
             return web.json_response({"path": path})
         if "link.from" in req.query:  # hard link verb
-            e = self.filer.link(req.query["link.from"], path,
-                                signatures=signatures)
+            e = await asyncio.to_thread(
+                self.filer.link, req.query["link.from"], path,
+                signatures=signatures)
             return web.json_response(e.to_dict(), status=201)
         if "cacheRemote" in req.query:
             return await self._cache_remote(path, signatures)
@@ -462,7 +464,8 @@ class FilerServer:
             d["full_path"] = path
             entry = Entry.from_dict(d)
             old = self.filer.find_entry(path)
-            self.filer.create_entry(entry, signatures=signatures)
+            await asyncio.to_thread(
+                self.filer.create_entry, entry, signatures=signatures)
             if old is not None and not old.is_directory \
                 and not old.hard_link_id:
                 keep = {c.fid for c in entry.chunks}
@@ -472,7 +475,8 @@ class FilerServer:
             return web.json_response(entry.to_dict(), status=201)
         if "mkdir" in req.query or (raw_path.endswith("/")
                                     and req.content_length in (None, 0)):
-            e = self.filer.mkdir(path, signatures=signatures)
+            e = await asyncio.to_thread(
+                self.filer.mkdir, path, signatures=signatures)
             return web.json_response(e.to_dict(), status=201)
 
         collection = req.query.get("collection", "") or rule.collection \
@@ -528,7 +532,8 @@ class FilerServer:
                       ttl_sec=_ttl_seconds(ttl),
                       md5=md5_all.hexdigest(), collection=collection,
                       replication=replication, chunks=chunks)
-        self.filer.create_entry(entry, signatures=signatures)
+        await asyncio.to_thread(
+            self.filer.create_entry, entry, signatures=signatures)
         if old is not None and not old.is_directory \
                 and not old.hard_link_id:
             dead = [c for c in old.chunks
@@ -581,7 +586,8 @@ class FilerServer:
                                     mtime_ns=time.time_ns(), etag=etag))
             offset += len(piece)
         entry.chunks = chunks
-        self.filer.create_entry(entry, signatures=signatures)
+        await asyncio.to_thread(
+            self.filer.create_entry, entry, signatures=signatures)
         return web.json_response(entry.to_dict())
 
     async def _uncache_remote(self, path: str,
@@ -598,7 +604,8 @@ class FilerServer:
                 {"error": f"{path} is not a remote entry"}, status=400)
         dead = entry.chunks
         entry.chunks = []
-        self.filer.create_entry(entry, signatures=signatures)
+        await asyncio.to_thread(
+            self.filer.create_entry, entry, signatures=signatures)
         await asyncio.to_thread(self._delete_chunks, dead)
         return web.json_response(entry.to_dict())
 
@@ -618,7 +625,8 @@ class FilerServer:
         recursive = req.query.get("recursive", "") in ("true", "1")
         delete_chunks = req.query.get("skipChunkDeletion", "") \
             not in ("true", "1")
-        self.filer.delete_entry(
+        await asyncio.to_thread(
+            self.filer.delete_entry,
             path, recursive=recursive, delete_chunks=delete_chunks,
             signatures=_parse_signatures(
                 req.query.get("signatures", "")))

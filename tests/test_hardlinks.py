@@ -135,3 +135,52 @@ class TestOverHttp:
             fs.release(fh)
         finally:
             fs.destroy()
+
+
+class TestRobustness:
+    def test_stale_metadata_save_cannot_clobber_newer_write(self, filer):
+        import time
+        dead = []
+        filer.on_delete_chunks = dead.extend
+        touch(filer, "/r/a", fid="1,aa")
+        filer.link("/r/a", "/r/b")
+        stale = filer.find_entry("/r/a")  # resolved at ver N
+        # a newer write lands through the other name
+        fresh = filer.find_entry("/r/b")
+        fresh.chunks = [FileChunk(fid="2,bb", offset=0, size=4,
+                                  mtime_ns=time.time_ns())]
+        filer.update_entry(fresh)
+        dead.clear()
+        # the stale entry is saved back (chmod-style metadata update)
+        stale.mode = 0o600
+        filer.update_entry(stale)
+        assert dead == []  # newer chunks NOT deleted
+        assert [c.fid for c in filer.find_entry("/r/a").chunks] == \
+            ["2,bb"]
+        assert filer.find_entry("/r/a").mode == 0o600
+
+    def test_ttl_expiry_unrefs_link(self, filer):
+        import time
+        dead = []
+        filer.on_delete_chunks = dead.extend
+        e = Entry(full_path="/t/src", ttl_sec=1,
+                  chunks=[FileChunk(fid="4,cc", offset=0, size=4,
+                                    mtime_ns=time.time_ns())])
+        filer.create_entry(e)
+        filer.link("/t/src", "/t/alias")
+        # expire the src name only
+        stored = filer.store.find_entry("/t/src")
+        stored.crtime = time.time() - 100
+        filer.store.insert_entry(stored)
+        assert filer.find_entry("/t/src") is None  # expired + unref'd
+        assert dead == []  # alias still holds a reference
+        assert [c.fid for c in filer.find_entry("/t/alias").chunks] \
+            == ["4,cc"]
+        filer.delete_entry("/t/alias")
+        assert [c.fid for c in dead] == ["4,cc"]
+
+    def test_link_copies_ttl(self, filer):
+        e = Entry(full_path="/t2/src", ttl_sec=3600)
+        filer.create_entry(e)
+        filer.link("/t2/src", "/t2/alias")
+        assert filer.find_entry("/t2/alias").ttl_sec == 3600
