@@ -35,6 +35,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="comma-separated ip:port of all masters (HA mode)")
     p.add_argument("-raftDir", dest="raft_dir", default="",
                    help="raft log/term persistence dir")
+    p.add_argument("-sequencer", default="memory",
+                   help="file-id sequencer: memory | snowflake "
+                        "(HA masters force snowflake)")
     p.add_argument("-admin.scripts", dest="admin_scripts",
                    default="",
                    help="semicolon-separated shell maintenance commands "
@@ -430,6 +433,7 @@ def _run_master(args) -> int:
     ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20,
                       default_replication=args.defaultReplication,
                       jwt_secret=args.jwt_secret,
+                      sequencer=args.sequencer,
                       me=f"{args.ip}:{args.port}", peers=peers,
                       raft_state_dir=raft_dir or None,
                       admin_scripts=scripts,

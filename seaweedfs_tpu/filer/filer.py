@@ -40,7 +40,12 @@ class Filer:
                       else make_store(store, **store_kwargs))
         self.meta_log = MetaEventLog(signature=signature)
         self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
-        self._hardlink_lock = threading.Lock()
+        # lock order: _mutation_lock (all metadata writes) outer,
+        # _hardlink_lock (shared-record read-modify-write) inner. Both
+        # reentrant: the TTL-expiry path runs inside readers that a
+        # mutation may invoke on its own thread.
+        self._mutation_lock = threading.RLock()
+        self._hardlink_lock = threading.RLock()
 
     # -- hard links (filerstore_hardlink.go) ----------------------------
     # Linked entries share one content record in the store's KV space:
@@ -75,7 +80,7 @@ class Filer:
         """Create a hard link: dst becomes another name for src's
         content (mount link(), filer_pb AppendToEntry-style sharing)."""
         src_path, dst_path = norm_path(src_path), norm_path(dst_path)
-        with self._hardlink_lock:
+        with self._mutation_lock, self._hardlink_lock:
             # src is (re)read under the lock: two concurrent first-links
             # must not each mint their own record for the same file
             src = self.find_entry(src_path)
@@ -189,59 +194,88 @@ class Filer:
 
     # -- writes ---------------------------------------------------------
     def create_entry(self, entry: Entry,
-                     signatures: list[int] | None = None) -> Entry:
+                     signatures: list[int] | None = None,
+                     gc_old_chunks: bool = False) -> Entry:
+        """Insert/overwrite one entry. gc_old_chunks=True also reclaims
+        the replaced entry's chunks that the new entry dropped —
+        computed inside the mutation lock so two concurrent overwrites
+        of one path can't both snapshot the same predecessor and leak
+        the loser's chunks (the find+create+GC TOCTOU)."""
         entry.full_path = norm_path(entry.full_path)
         if entry.full_path == "/":
             return entry
-        self._ensure_parents(entry.full_path)
-        old = self.store.find_entry(entry.full_path)
-        if old is not None and old.is_directory and not entry.is_directory:
-            raise IsADirectoryError(entry.full_path)
-        if old is not None and old.hard_link_id and \
-                entry.hard_link_id != old.hard_link_id:
-            # this NAME now points elsewhere (plain overwrite or a
-            # different link id): drop one reference on the old record;
-            # shared chunks are freed only at the last name
-            freed = self._hardlink_unref(old)
-            if freed:
-                self.on_delete_chunks(freed)
-        logged = entry
-        if entry.hard_link_id and not entry.is_directory:
-            # content lives in the shared record: a write through any
-            # name must be visible through every name — and the chunks
-            # it replaces must be reclaimed (every other overwrite path
-            # skips GC for hardlinked entries, so this is the one spot).
-            # A save whose hardlink_ver doesn't match saw STALE content
-            # (e.g. chmod built from an old read racing a writer): its
-            # metadata is stored but its chunk list is ignored — it
-            # must not resurrect old chunks or delete newer ones.
-            caller_ver = entry.extended.pop("hardlink_ver", None)
-            replaced: list[FileChunk] = []
-            with self._hardlink_lock:
-                rec = self._hardlink_record(entry.hard_link_id) or \
-                    {"count": 1, "ver": 0, "chunks": []}
-                current = int(rec.get("ver", 0))
-                accept = (caller_ver is not None
-                          and int(caller_ver) == current) or \
-                    not rec.get("chunks")
-                if accept:
-                    keep = {c.fid for c in entry.chunks}
-                    replaced = [FileChunk.from_dict(c)
-                                for c in rec.get("chunks", [])
-                                if c.get("fid") not in keep]
-                    rec["chunks"] = [c.to_dict()
-                                     for c in entry.chunks]
-                    rec["ver"] = current + 1
-                    self._put_hardlink_record(entry.hard_link_id, rec)
-            entry = replace(entry, chunks=[])
-            if replaced:
-                self.on_delete_chunks(replaced)
-        self.store.insert_entry(entry)
-        d, _ = entry.dir_and_name
-        # the event carries the RESOLVED shape (real chunks): metadata
-        # subscribers (other mounts, backups, replication) must not see
-        # hardlinked files as empty
-        self.meta_log.append(d, old, logged, signatures)
+        freed: list[FileChunk] = []
+        with self._mutation_lock:
+            self._ensure_parents(entry.full_path)
+            old = self.store.find_entry(entry.full_path)
+            if old is not None and old.is_directory \
+                    and not entry.is_directory:
+                raise IsADirectoryError(entry.full_path)
+            if old is not None and old.hard_link_id and \
+                    entry.hard_link_id != old.hard_link_id:
+                # this NAME now points elsewhere: drop one reference on
+                # the old record; chunks free only at the last name
+                freed.extend(self._hardlink_unref(old))
+            logged = entry
+            if entry.hard_link_id and not entry.is_directory:
+                # content lives in the shared record: a write through
+                # any name must be visible through every name. A save
+                # whose hardlink_ver doesn't match saw STALE content
+                # (chmod built from an old read racing a writer): its
+                # metadata lands but its chunk list is ignored — it
+                # must not resurrect freed chunks or delete newer ones.
+                try:
+                    caller_ver = int(
+                        entry.extended.pop("hardlink_ver"))
+                except (KeyError, TypeError, ValueError):
+                    caller_ver = None
+                with self._hardlink_lock:
+                    rec = self._hardlink_record(entry.hard_link_id) \
+                        or {"count": 1, "ver": 0, "chunks": []}
+                    current = int(rec.get("ver", 0))
+                    # ver 0 = record never written (fresh link target);
+                    # an empty chunk list at ver>=1 is a real truncate
+                    # and must NOT readmit stale saves
+                    accept = caller_ver == current or current == 0
+                    if accept:
+                        keep = {c.fid for c in entry.chunks}
+                        freed.extend(
+                            FileChunk.from_dict(c)
+                            for c in rec.get("chunks", [])
+                            if c.get("fid") not in keep)
+                        rec["chunks"] = [c.to_dict()
+                                         for c in entry.chunks]
+                        rec["ver"] = current + 1
+                        self._put_hardlink_record(entry.hard_link_id,
+                                                  rec)
+                    else:
+                        # rejected: free NOTHING here — the discarded
+                        # list may be a stale reader's historical view
+                        # (those chunks were already reclaimed when
+                        # they were replaced, and must not be "freed"
+                        # again) or a losing writer's fresh uploads
+                        # (left for volume.fsck's orphan sweep). The
+                        # event log must carry what the record ACTUALLY
+                        # contains, not the discarded list.
+                        logged = replace(
+                            logged,
+                            chunks=[FileChunk.from_dict(c)
+                                    for c in rec.get("chunks", [])])
+                entry = replace(entry, chunks=[])
+            elif gc_old_chunks and old is not None and \
+                    not old.is_directory and not old.hard_link_id:
+                keep = {c.fid for c in entry.chunks}
+                freed.extend(c for c in old.chunks
+                             if c.fid not in keep)
+            self.store.insert_entry(entry)
+            d, _ = entry.dir_and_name
+            # the event carries the RESOLVED shape (real chunks):
+            # subscribers must not see hardlinked files as empty
+            self.meta_log.append(d, old, logged, signatures)
+        if freed:
+            # chunk deletion does volume-server round trips: never
+            # under the metadata locks
+            self.on_delete_chunks(freed)
         return self._resolve_hardlink(entry)
 
     def update_entry(self, entry: Entry,
@@ -279,9 +313,18 @@ class Filer:
         entry still references the same chunks, e.g. multipart
         completion)."""
         path = norm_path(path)
+        with self._mutation_lock:
+            dead = self._delete_entry_locked(path, recursive,
+                                             signatures)
+        if dead and delete_chunks:
+            # volume-server round trips happen outside the lock
+            self.on_delete_chunks(dead)
+
+    def _delete_entry_locked(self, path, recursive,
+                             signatures) -> list[FileChunk]:
         e = self.find_entry(path)
         if e is None:
-            return
+            return []
         dead_chunks: list[FileChunk] = []
         if e.is_directory:
             children = self.list_entries(path, limit=1)
@@ -304,8 +347,7 @@ class Filer:
         self.store.delete_entry(path)
         d, _ = e.dir_and_name
         self.meta_log.append(d, e, None, signatures)
-        if dead_chunks and delete_chunks:
-            self.on_delete_chunks(dead_chunks)
+        return dead_chunks
 
     def rename(self, old_path: str, new_path: str,
                signatures: list[int] | None = None) -> None:
@@ -313,12 +355,13 @@ class Filer:
         only streaming rename of filer_grpc_server_rename.go; chunks
         stay where they are."""
         old_path, new_path = norm_path(old_path), norm_path(new_path)
-        e = self.find_entry(old_path)
-        if e is None:
-            raise FileNotFoundError(old_path)
-        if self.find_entry(new_path) is not None:
-            raise FileExistsError(new_path)
-        self._move(e, new_path, signatures)
+        with self._mutation_lock:
+            e = self.find_entry(old_path)
+            if e is None:
+                raise FileNotFoundError(old_path)
+            if self.find_entry(new_path) is not None:
+                raise FileExistsError(new_path)
+            self._move(e, new_path, signatures)
 
     def _move(self, e: Entry, new_path: str,
               signatures: list[int] | None) -> None:

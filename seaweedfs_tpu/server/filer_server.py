@@ -463,15 +463,12 @@ class FilerServer:
             d = json.loads(await req.text())
             d["full_path"] = path
             entry = Entry.from_dict(d)
-            old = self.filer.find_entry(path)
+            # old-chunk GC happens INSIDE create_entry's mutation lock:
+            # a find-here/create-there split would let two concurrent
+            # overwrites snapshot the same predecessor and leak chunks
             await asyncio.to_thread(
-                self.filer.create_entry, entry, signatures=signatures)
-            if old is not None and not old.is_directory \
-                and not old.hard_link_id:
-                keep = {c.fid for c in entry.chunks}
-                await asyncio.to_thread(
-                    self._delete_chunks,
-                    [c for c in old.chunks if c.fid not in keep])
+                self.filer.create_entry, entry, signatures=signatures,
+                gc_old_chunks=True)
             return web.json_response(entry.to_dict(), status=201)
         if "mkdir" in req.query or (raw_path.endswith("/")
                                     and req.content_length in (None, 0)):
@@ -527,18 +524,13 @@ class FilerServer:
             maybe_manifestize, lambda b: self._upload_chunk(
                 b, filename, collection, replication, ttl)[0], chunks)
 
-        old = self.filer.find_entry(path)
         entry = Entry(full_path=path, mime=mime,
                       ttl_sec=_ttl_seconds(ttl),
                       md5=md5_all.hexdigest(), collection=collection,
                       replication=replication, chunks=chunks)
         await asyncio.to_thread(
-            self.filer.create_entry, entry, signatures=signatures)
-        if old is not None and not old.is_directory \
-                and not old.hard_link_id:
-            dead = [c for c in old.chunks
-                    if c.fid not in {n.fid for n in chunks}]
-            await asyncio.to_thread(self._delete_chunks, dead)
+            self.filer.create_entry, entry, signatures=signatures,
+            gc_old_chunks=True)
         metrics.counter_add("filer_write_bytes", total)
         return web.json_response(
             {"name": filename, "size": total,

@@ -232,6 +232,13 @@ class VolumeServer:
         metrics.histogram_observe("volume_server_read_seconds",
                                   time.perf_counter() - start)
         headers = {"Etag": f'"{n.etag()}"'}
+        if n.pairs:
+            try:
+                for k, v in json.loads(n.pairs).items():
+                    if k.lower().startswith("seaweed-"):
+                        headers[k] = str(v)
+            except (json.JSONDecodeError, AttributeError):
+                pass
         if n.last_modified:
             headers["Last-Modified"] = time.strftime(
                 "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
@@ -334,6 +341,13 @@ class VolumeServer:
             n.mime = req.query["mime"].encode("latin-1", "replace")
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
+        # custom metadata pairs: Seaweed-* headers stored as JSON in
+        # the needle (needle_parse_upload.go parsePairs)
+        pairs = {k: v for k, v in req.headers.items()
+                 if k.lower().startswith("seaweed-")}
+        if pairs:
+            n.pairs = json.dumps(pairs, separators=(",", ":")).encode()
+            n.flags |= ndl.FLAG_HAS_PAIRS
         # transparent compression (needle_parse_upload.go): a client's
         # pre-gzipped body normally arrives already inflated (aiohttp
         # decodes Content-Encoding) and re-compresses below; if it
@@ -421,6 +435,14 @@ class VolumeServer:
                 # re-encoded as UTF-8 on the other side and non-ASCII
                 # mime bytes would diverge from the primary
                 params["mime"] = needle.mime.decode("latin-1")
+            if needle.pairs:
+                try:
+                    headers.update({
+                        k: str(v)
+                        for k, v in json.loads(needle.pairs).items()
+                        if k.lower().startswith("seaweed-")})
+                except (json.JSONDecodeError, AttributeError):
+                    pass
             if needle.is_compressed:
                 # marker param, NOT Content-Encoding: the receiving
                 # server must append these bytes verbatim (inflate +

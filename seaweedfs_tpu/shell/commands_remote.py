@@ -112,17 +112,23 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
     list_prefix = prefix.rstrip("/") + "/" if prefix else ""
     created = updated = removed = 0
     seen: set[str] = set()
+    # one tree walk up front (a directory listing per dir) instead of a
+    # meta-GET round trip per remote object — a 100k-object bucket
+    # would otherwise issue 100k serial requests
+    local: dict[str, dict] = {e["full_path"]: e
+                              for e in _walk(env, dir)}
     for re_ in client.traverse(list_prefix):
         if list_prefix and not re_.key.startswith(list_prefix):
             continue
         rel = re_.key[len(list_prefix):]
-        path = f"{dir}/{rel}" if rel else dir
+        if not rel or rel.endswith("/"):
+            continue  # bucket directory-marker objects aren't files
+        path = f"{dir}/{rel}"
         seen.add(path)
-        r = requests.get(f"{_filer(env)}{path}", params={"meta": "1"},
-                         timeout=30)
         meta = {"key": re_.key, "size": re_.size, "mtime": re_.mtime,
                 "etag": re_.etag}
-        if r.status_code == 404:
+        ent = local.get(path)
+        if ent is None:
             entry = {"full_path": path, "mtime": re_.mtime or None,
                      "extended": {"remote": json.dumps(meta)}}
             requests.post(f"{_filer(env)}{path}",
@@ -131,7 +137,6 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
                           ).raise_for_status()
             created += 1
             continue
-        ent = r.json()
         old = json.loads(ent.get("extended", {}).get("remote", "{}"))
         if old.get("etag") == re_.etag and old.get("size") == re_.size \
                 and old.get("etag"):
@@ -142,9 +147,11 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
                       data=json.dumps(ent), timeout=60).raise_for_status()
         updated += 1
     # prune placeholders whose remote object is gone (uncached only —
-    # never delete local bytes on a listing hiccup)
-    for e in list(_walk(env, dir)):
-        path = e["full_path"]
+    # never delete local bytes on a listing hiccup); the snapshot from
+    # before the sync is exact for this: entries created above are in
+    # `seen`, and anything else that appeared mid-sync is left alone
+    # for the next run
+    for path, e in local.items():
         if path in seen or e.get("chunks") or \
                 not e.get("extended", {}).get("remote"):
             continue

@@ -209,3 +209,26 @@ class TestNameFidelity:
             "résumé 日本.txt".encode()
         assert needles[0].mime == needles[1].mime
         assert needles[0].data == needles[1].data
+
+
+class TestNeedlePairs:
+    def test_seaweed_headers_round_trip_and_replicate(self, cluster):
+        a = verbs.assign(cluster.master_url, replication="001")
+        r = requests.post(
+            f"http://{a.url}/{a.fid}", data=b"with pairs",
+            headers={"Seaweed-Tag": "alpha", "Seaweed-Owner": "ops",
+                     "X-Other": "ignored",
+                     **({"Authorization": f"Bearer {a.auth}"}
+                        if a.auth else {})})
+        assert r.status_code == 201, r.text
+        got = requests.get(f"http://{a.url}/{a.fid}")
+        assert got.headers.get("Seaweed-Tag") == "alpha"
+        assert got.headers.get("Seaweed-Owner") == "ops"
+        assert "X-Other" not in got.headers
+        # pairs replicate too
+        vid, key, _ = parse_file_id(a.fid)
+        needles = [s.find_volume(vid).read_needle(key)
+                   for s in cluster.stores
+                   if s.find_volume(vid) is not None]
+        assert len(needles) == 2
+        assert needles[0].pairs == needles[1].pairs != b""
