@@ -36,8 +36,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-raftDir", dest="raft_dir", default="",
                    help="raft log/term persistence dir")
     p.add_argument("-sequencer", default="memory",
-                   help="file-id sequencer: memory | snowflake "
-                        "(HA masters force snowflake)")
+                   choices=["memory", "snowflake"],
+                   help="file-id sequencer (HA masters force "
+                        "snowflake)")
     p.add_argument("-admin.scripts", dest="admin_scripts",
                    default="",
                    help="semicolon-separated shell maintenance commands "

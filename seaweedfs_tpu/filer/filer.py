@@ -46,6 +46,11 @@ class Filer:
         # mutation may invoke on its own thread.
         self._mutation_lock = threading.RLock()
         self._hardlink_lock = threading.RLock()
+        # chunks freed by TTL expiry hit volume servers over HTTP; when
+        # expiry fires inside a locked mutation the frees are queued
+        # here and drained once the locks are released
+        self._free_lock = threading.Lock()
+        self._free_queue: list[FileChunk] = []
 
     # -- hard links (filerstore_hardlink.go) ----------------------------
     # Linked entries share one content record in the store's KV space:
@@ -120,6 +125,7 @@ class Filer:
         d, _ = dst.dir_and_name
         # log the RESOLVED entry: subscribers must see real chunks
         self.meta_log.append(d, None, dst, signatures)
+        self._drain_freed()
         return dst
 
     def _hardlink_unref(self, e: Entry) -> list[FileChunk]:
@@ -140,12 +146,26 @@ class Filer:
 
     def _expire(self, e: Entry) -> None:
         """Drop a TTL-expired name; a hardlinked name must release its
-        record reference or the shared chunks leak forever."""
+        record reference or the shared chunks leak forever. Frees are
+        queued — this can run inside a locked mutation's read."""
         self.store.delete_entry(e.full_path)
         if e.hard_link_id and not e.is_directory:
             freed = self._hardlink_unref(e)
             if freed:
-                self.on_delete_chunks(freed)
+                with self._free_lock:
+                    self._free_queue.extend(freed)
+
+    def _drain_freed(self) -> None:
+        """Run queued chunk deletions — only once no metadata lock is
+        held by this thread (mutations drain on their way out)."""
+        if getattr(self._mutation_lock, "_is_owned", lambda: False)() \
+                or getattr(self._hardlink_lock, "_is_owned",
+                           lambda: False)():
+            return
+        with self._free_lock:
+            chunks, self._free_queue = self._free_queue, []
+        if chunks:
+            self.on_delete_chunks(chunks)
 
     # -- reads ----------------------------------------------------------
     def find_entry(self, path: str) -> Entry | None:
@@ -155,6 +175,7 @@ class Filer:
         e = self.store.find_entry(path)
         if e is not None and e.is_expired():
             self._expire(e)
+            self._drain_freed()
             return None
         return self._resolve_hardlink(e) if e is not None else None
 
@@ -170,6 +191,7 @@ class Filer:
                 self._expire(e)
                 continue
             out.append(self._resolve_hardlink(e))
+        self._drain_freed()
         return out
 
     def iter_tree(self, dirpath: str):
@@ -262,9 +284,11 @@ class Filer:
                             chunks=[FileChunk.from_dict(c)
                                     for c in rec.get("chunks", [])])
                 entry = replace(entry, chunks=[])
-            elif gc_old_chunks and old is not None and \
+            if gc_old_chunks and old is not None and \
                     not old.is_directory and not old.hard_link_id:
-                keep = {c.fid for c in entry.chunks}
+                # logged always carries the REAL new content (even for
+                # hardlinked entries whose stored chunks are cleared)
+                keep = {c.fid for c in logged.chunks}
                 freed.extend(c for c in old.chunks
                              if c.fid not in keep)
             self.store.insert_entry(entry)
@@ -276,6 +300,7 @@ class Filer:
             # chunk deletion does volume-server round trips: never
             # under the metadata locks
             self.on_delete_chunks(freed)
+        self._drain_freed()
         return self._resolve_hardlink(entry)
 
     def update_entry(self, entry: Entry,
@@ -319,6 +344,7 @@ class Filer:
         if dead and delete_chunks:
             # volume-server round trips happen outside the lock
             self.on_delete_chunks(dead)
+        self._drain_freed()
 
     def _delete_entry_locked(self, path, recursive,
                              signatures) -> list[FileChunk]:
@@ -362,6 +388,7 @@ class Filer:
             if self.find_entry(new_path) is not None:
                 raise FileExistsError(new_path)
             self._move(e, new_path, signatures)
+        self._drain_freed()
 
     def _move(self, e: Entry, new_path: str,
               signatures: list[int] | None) -> None:

@@ -155,6 +155,17 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
         if path in seen or e.get("chunks") or \
                 not e.get("extended", {}).get("remote"):
             continue
+        # the snapshot is minutes old for big buckets: re-check the
+        # LIVE entry so a placeholder that gained chunks (remote.cache
+        # or a local write) mid-sync is never deleted with its bytes
+        live = requests.get(f"{_filer(env)}{path}",
+                            params={"meta": "1"}, timeout=30)
+        if live.status_code != 200:
+            continue
+        le = live.json()
+        if le.get("chunks") or \
+                not le.get("extended", {}).get("remote"):
+            continue
         requests.delete(f"{_filer(env)}{path}", timeout=30)
         removed += 1
     return {"created": created, "updated": updated, "removed": removed}
