@@ -103,23 +103,32 @@ def main() -> None:
     log(f"cpu numpy rebuild:          {cpu / 1e6:.0f} MB/s")
     tpu = bench_tpu(coef, rng)
     log(f"tpu codec dispatch rebuild: {tpu / 1e6:.0f} MB/s")
-    # BASELINE.json configs #3/#4: batched encode + wide-code shapes
-    # (informational; the recorded metric stays the RS(10,4) rebuild)
-    enc = rs_matrix.parity_rows(10, 4)
-    tpu_enc = bench_tpu(enc, rng, batch=8, reps=2)
-    log(f"tpu batched encode RS(10,4):{tpu_enc / 1e6:.0f} MB/s")
-    wide = rs_matrix.parity_rows(28, 4)
-    tpu_wide = bench_tpu(wide, rng, batch=4, reps=2)
-    log(f"tpu wide-code enc RS(28,4): {tpu_wide / 1e6:.0f} MB/s")
-    e2e = bench_tpu_e2e(coef, rng)
-    log(f"tpu e2e via relay (info):   {e2e / 1e6:.0f} MB/s")
 
+    # the recorded metric is the RS(10,4) rebuild — print it FIRST so
+    # the driver gets its JSON line even if an informational bench
+    # below dies or times out
     print(json.dumps({
         "metric": "ec_rebuild_rs10_4_throughput",
         "value": round(tpu / 1e6, 1),
         "unit": "MB/s",
         "vs_baseline": round(tpu / cpu, 2),
-    }))
+    }), flush=True)
+
+    if "--headline-only" in sys.argv:
+        return
+    # BASELINE.json configs #3/#4: batched encode + wide-code shapes
+    # (informational only)
+    try:
+        enc = rs_matrix.parity_rows(10, 4)
+        tpu_enc = bench_tpu(enc, rng, batch=8, reps=2)
+        log(f"tpu batched encode RS(10,4):{tpu_enc / 1e6:.0f} MB/s")
+        wide = rs_matrix.parity_rows(28, 4)
+        tpu_wide = bench_tpu(wide, rng, batch=4, reps=2)
+        log(f"tpu wide-code enc RS(28,4): {tpu_wide / 1e6:.0f} MB/s")
+        e2e = bench_tpu_e2e(coef, rng)
+        log(f"tpu e2e via relay (info):   {e2e / 1e6:.0f} MB/s")
+    except Exception as e:  # pragma: no cover - info benches only
+        log(f"informational benches aborted: {e!r}")
 
 
 if __name__ == "__main__":

@@ -158,9 +158,12 @@ class Filer:
     def _drain_freed(self) -> None:
         """Run queued chunk deletions — only once no metadata lock is
         held by this thread (mutations drain on their way out)."""
-        if getattr(self._mutation_lock, "_is_owned", lambda: False)() \
+        # _is_owned is a private CPython RLock API; if it ever
+        # disappears, fail SAFE by deferring (the exit-path drain picks
+        # the queue up), never by draining under a metadata lock
+        if getattr(self._mutation_lock, "_is_owned", lambda: True)() \
                 or getattr(self._hardlink_lock, "_is_owned",
-                           lambda: False)():
+                           lambda: True)():
             return
         with self._free_lock:
             chunks, self._free_queue = self._free_queue, []

@@ -37,6 +37,30 @@ class S3AuthError(Exception):
         self.status = status
 
 
+class StreamingContext:
+    """Everything needed to verify + decode an aws-chunked body after
+    header authentication (chunked_reader_v4.go newSignV4ChunkedReader).
+    `key` is None for STREAMING-UNSIGNED-PAYLOAD-TRAILER."""
+
+    def __init__(self, key: bytes | None, amz_date: str, scope: str,
+                 seed_signature: str, decoded_length: int | None = None):
+        self.key = key
+        self.amz_date = amz_date
+        self.scope = scope
+        self.seed_signature = seed_signature
+        self.decoded_length = decoded_length
+
+    def decode(self, payload: bytes) -> bytes:
+        from .chunked import ChunkSignatureError, decode_chunked
+        try:
+            return decode_chunked(
+                payload, key=self.key, amz_date=self.amz_date,
+                scope=self.scope, seed_signature=self.seed_signature,
+                expected_length=self.decoded_length)
+        except ChunkSignatureError as e:
+            raise S3AuthError("SignatureDoesNotMatch", str(e))
+
+
 class Identity:
     def __init__(self, name: str, credentials: list[dict],
                  actions: list[str]):
@@ -93,18 +117,40 @@ class IdentityAccessManagement:
                      payload_hash: str) -> Identity | None:
         """Verify a request; returns the Identity (None if open mode).
         Raises S3AuthError on bad signatures."""
+        return self.authenticate_ctx(method, path, query, headers,
+                                     payload_hash)[0]
+
+    def authenticate_ctx(
+            self, method: str, path: str, query: dict[str, str],
+            headers: dict[str, str], payload_hash: str,
+    ) -> tuple[Identity | None, "StreamingContext | None"]:
+        """Like authenticate(), but also returns a StreamingContext when
+        the request body is aws-chunked framed (signed or unsigned
+        streaming) and must be decoded before use."""
+        from .chunked import STREAMING_UNSIGNED
+
+        declared = headers.get(
+            "x-amz-content-sha256",
+            headers.get("X-Amz-Content-Sha256", ""))
         if "X-Amz-Signature" in query or "X-Amz-Algorithm" in query:
-            return self._verify_presigned(method, path, query, headers)
+            return self._verify_presigned(method, path, query,
+                                          headers), None
         auth = headers.get("Authorization", "")
         if auth.startswith(ALGORITHM):
-            return self._verify_header(method, path, query, headers,
-                                       payload_hash, auth)
+            identity, ctx = self._verify_header(
+                method, path, query, headers, payload_hash, auth)
+            return identity, ctx
         if self.is_open:
-            return None
+            ctx = None
+            if declared == STREAMING_UNSIGNED:
+                ctx = StreamingContext(None, "", "", "")
+            return None, ctx
         raise S3AuthError("AccessDenied", "no credentials provided")
 
     def _verify_header(self, method, path, query, headers, payload_hash,
-                       auth) -> Identity:
+                       auth) -> tuple[Identity, "StreamingContext | None"]:
+        from .chunked import (STREAMING_SIGNED, STREAMING_UNSIGNED,
+                              signing_key)
         fields = {}
         for part in auth[len(ALGORITHM):].strip().split(","):
             k, _, v = part.strip().partition("=")
@@ -118,11 +164,15 @@ class IdentityAccessManagement:
         signed_headers = fields.get("SignedHeaders", "").split(";")
         amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date", "")
         # the declared payload hash must match the actual body, or a
-        # captured signature authorizes arbitrary substituted bodies
+        # captured signature authorizes arbitrary substituted bodies.
+        # Streaming uploads declare a sentinel instead: the body is
+        # integrity-checked per chunk by the signature chain.
         declared = headers.get(
             "x-amz-content-sha256",
             headers.get("X-Amz-Content-Sha256", payload_hash))
-        if declared != "UNSIGNED-PAYLOAD" and declared != payload_hash:
+        streaming = declared in (STREAMING_SIGNED, STREAMING_UNSIGNED)
+        if not streaming and declared != "UNSIGNED-PAYLOAD" \
+                and declared != payload_hash:
             raise S3AuthError("XAmzContentSHA256Mismatch",
                               "payload hash does not match body", 400)
         # SigV4 requires rejecting stale requests or any captured
@@ -144,7 +194,20 @@ class IdentityAccessManagement:
         if not hmac.compare_digest(expect, fields.get("Signature", "")):
             raise S3AuthError("SignatureDoesNotMatch",
                               "signature mismatch")
-        return identity
+        ctx = None
+        if streaming:
+            decoded_len = headers.get(
+                "x-amz-decoded-content-length",
+                headers.get("X-Amz-Decoded-Content-Length", ""))
+            if not decoded_len.isdigit():
+                raise S3AuthError("MissingContentLength",
+                                  "streaming upload must declare "
+                                  "x-amz-decoded-content-length", 411)
+            key = signing_key(secret, datestamp, region, service) \
+                if declared == STREAMING_SIGNED else None
+            ctx = StreamingContext(key, amz_date, scope, expect,
+                                   int(decoded_len))
+        return identity, ctx
 
     def _verify_presigned(self, method, path, query, headers) -> Identity:
         q = dict(query)
@@ -195,29 +258,30 @@ def _canonical_request(method: str, path: str, query: dict[str, str],
 
 
 def _signature(secret: str, amz_date: str, scope: str, creq: str) -> str:
+    from .chunked import signing_key
+
     sts = "\n".join([ALGORITHM, amz_date, scope,
                      hashlib.sha256(creq.encode()).hexdigest()])
     datestamp, region, service, _ = scope.split("/")
-    k = hmac.new(("AWS4" + secret).encode(), datestamp.encode(),
-                 hashlib.sha256).digest()
-    for msg in (region, service, "aws4_request"):
-        k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
+    k = signing_key(secret, datestamp, region, service)
     return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
 
 
 def sign_request(method: str, url: str, access_key: str, secret: str,
                  region: str = "us-east-1",
                  payload: bytes = b"",
-                 extra_headers: dict | None = None) -> dict[str, str]:
+                 extra_headers: dict | None = None,
+                 content_sha256: str | None = None) -> dict[str, str]:
     """Client-side SigV4 header signing (for tests and the shell's s3
-    commands). Returns headers to attach."""
+    commands). Returns headers to attach. `content_sha256` overrides
+    the payload hash (e.g. the STREAMING-* sentinels)."""
     parsed = urllib.parse.urlsplit(url)
     query = dict(urllib.parse.parse_qsl(parsed.query,
                                         keep_blank_values=True))
     now = datetime.now(timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    payload_hash = content_sha256 or hashlib.sha256(payload).hexdigest()
     headers = {"host": parsed.netloc, "x-amz-date": amz_date,
                "x-amz-content-sha256": payload_hash}
     if extra_headers:

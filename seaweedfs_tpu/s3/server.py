@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import re
 import time
 import urllib.parse
 import uuid
@@ -93,6 +94,68 @@ def _error_response(code: str, message: str, status: int,
     root.append(_leaf("Message", message))
     root.append(_leaf("Resource", resource))
     return _xml_response(root, status)
+
+
+def _src_bucket_of(src: str) -> str:
+    """Bucket name out of an x-amz-copy-source header value."""
+    return urllib.parse.unquote(src.lstrip("/")).partition("/")[0]
+
+
+OWNER_ID = "seaweedfs_tpu"
+
+
+def _canned_from_acl_xml(payload: bytes) -> str:
+    """Map an AccessControlPolicy body onto the modeled canned ACLs:
+    owner-only FULL_CONTROL -> private, plus AllUsers READ ->
+    public-read; any grant to another principal is unsupported
+    (returned verbatim so the caller rejects with NotImplemented)."""
+    if not payload.strip():
+        return "private"
+    try:
+        root = ET.fromstring(payload)
+    except ET.ParseError:
+        raise S3Error("MalformedACLError", "bad ACL XML", 400)
+    grants = []
+    for g in root.iter():
+        if not g.tag.endswith("Grant"):
+            continue
+        uri = perm = gid = ""
+        for el in g.iter():
+            if el.tag.endswith("URI") and el.text:
+                uri = el.text
+            if el.tag.split("}")[-1] == "ID" and el.text:
+                gid = el.text
+            if el.tag.endswith("Permission") and el.text:
+                perm = el.text
+        grants.append((uri, gid, perm))
+    public = ("http://acs.amazonaws.com/groups/global/AllUsers", "",
+              "READ")
+    # the owner grant must actually name the owner (or no principal at
+    # all); FULL_CONTROL for any other canonical ID is a real grant to
+    # someone else and must not be silently dropped
+    owner_full = [(u, i, p) for u, i, p in grants
+                  if p == "FULL_CONTROL" and not u
+                  and i in ("", OWNER_ID)]
+    other = [g for g in grants if g != public and g not in owner_full]
+    if other:
+        return "unsupported-grants"
+    return "public-read" if public in grants else "private"
+
+
+def _ttl_to_days(ttl: str) -> int:
+    """'5d'/'48h'/'60m'... -> whole days, 0 when under a day (mirrors
+    the reference's ttl.Minutes()/60/24 truncation,
+    s3api_bucket_handlers.go:338)."""
+    if not ttl:
+        return 0
+    units = {"m": 60, "h": 3600, "d": 86400, "w": 7 * 86400,
+             "M": 30 * 86400, "y": 365 * 86400}
+    try:
+        secs = int(ttl[:-1]) * units[ttl[-1]] if ttl[-1] in units \
+            else int(ttl)
+    except (ValueError, KeyError):
+        return 0
+    return secs // 86400
 
 
 def _iso(ts: float) -> str:
@@ -262,17 +325,21 @@ class S3ApiServer:
             # browser form upload (POST policy) authenticates via the
             # signed policy document, not headers
             return await self._post_policy_upload(req, bucket, payload)
-        identity = self.iam.authenticate(
+        identity, stream_ctx = self.iam.authenticate_ctx(
             req.method, req.path,
             {k: v for k, v in req.query.items()},
             {k: v for k, v in req.headers.items()},
             hashlib.sha256(payload).hexdigest())
+        if stream_ctx is not None:
+            # aws-chunked framed body (SigV4 streaming upload): verify
+            # the chunk-signature chain and unwrap to the real bytes
+            payload = stream_ctx.decode(payload)
 
-        def check(action: str):
-            if identity is not None and not identity.allows(action,
-                                                            bucket):
+        def check(action: str, target: str | None = None):
+            b = bucket if target is None else target
+            if identity is not None and not identity.allows(action, b):
                 raise S3Error("AccessDenied",
-                              f"{action} denied on {bucket}", 403)
+                              f"{action} denied on {b}", 403)
 
         q = req.query
         if not bucket:
@@ -284,6 +351,20 @@ class S3ApiServer:
 
     async def _bucket_op(self, req, bucket, q, payload, check):
         m = req.method
+        # sub-resources the reference also rejects
+        # (s3api_bucket_skip_handlers.go): bucket policy, CORS
+        if "policy" in q or "cors" in q:
+            raise S3Error("NotImplemented",
+                          "this sub-resource is not implemented", 501)
+        if "acl" in q:
+            check(ACTION_READ if m == "GET" else ACTION_ADMIN)
+            return await self._bucket_acl_op(m, bucket, req, payload)
+        if "lifecycle" in q:
+            check(ACTION_READ if m == "GET" else ACTION_ADMIN)
+            return await self._lifecycle_op(m, bucket, payload)
+        if "ownershipControls" in q:
+            check(ACTION_READ if m == "GET" else ACTION_ADMIN)
+            return await self._ownership_op(m, bucket, payload)
         if m == "PUT":
             check(ACTION_ADMIN)
             return await self._put_bucket(bucket)
@@ -305,6 +386,10 @@ class S3ApiServer:
             if "location" in q:
                 root = _xml("LocationConstraint", text=self.region)
                 return _xml_response(root)
+            if "requestPayment" in q:
+                root = _xml("RequestPaymentConfiguration")
+                root.append(_leaf("Payer", "BucketOwner"))
+                return _xml_response(root)
             return await self._list_objects(bucket, q)
         raise S3Error("MethodNotAllowed", f"{m} on bucket", 405)
 
@@ -322,6 +407,15 @@ class S3ApiServer:
             return await self._abort_multipart(bucket, q["uploadId"])
         if m == "PUT" and "partNumber" in q:
             check(ACTION_WRITE)
+            src = req.headers.get("x-amz-copy-source", "")
+            if src:
+                # copying reads the SOURCE bucket: the writer identity
+                # must hold Read there too, or part-copy becomes a
+                # cross-bucket read bypass
+                check(ACTION_READ, _src_bucket_of(src))
+                return await self._upload_part_copy(
+                    bucket, q["uploadId"], int(q["partNumber"]), src,
+                    req.headers.get("x-amz-copy-source-range", ""))
             return await self._upload_part(bucket, q["uploadId"],
                                            int(q["partNumber"]), payload)
         if m == "GET" and "uploadId" in q:
@@ -330,6 +424,12 @@ class S3ApiServer:
         if "tagging" in q:
             check(ACTION_TAGGING)
             return await self._tagging_op(m, bucket, key, payload)
+        # object ACL / retention / legal-hold / object-lock: the
+        # reference rejects all of these (s3api_object_skip_handlers.go)
+        if "acl" in q or "retention" in q or "legal-hold" in q \
+                or "object-lock" in q:
+            raise S3Error("NotImplemented",
+                          "this sub-resource is not implemented", 501)
         if m == "POST" and "select" in q:
             check(ACTION_READ)
             return await self._select_object_content(bucket, key,
@@ -338,6 +438,7 @@ class S3ApiServer:
             check(ACTION_WRITE)
             src = req.headers.get("x-amz-copy-source", "")
             if src:
+                check(ACTION_READ, _src_bucket_of(src))
                 return await self._copy_object(bucket, key, src)
             return await self._put_object(bucket, key, payload, req)
         if m in ("GET", "HEAD"):
@@ -441,6 +542,217 @@ class S3ApiServer:
             e.append(_leaf("Code", "InternalError"))
             out.append(e)
         return _xml_response(out)
+
+    # -- bucket sub-resources -------------------------------------------
+    async def _update_bucket_meta(self, bucket: str,
+                                  mutate) -> dict:
+        """Read-modify-write the bucket directory entry's extended
+        attributes (the reference keeps bucket metadata on the bucket
+        entry too, s3api/bucket_metadata.go)."""
+        meta = await self._require_bucket(bucket)
+        ext = dict(meta.get("extended", {}))
+        mutate(ext)
+        meta["extended"] = ext
+        meta.pop("full_path", None)
+        resp = await self._filer("PUT", self._fpath(bucket) + "?meta=1",
+                                 json=meta)
+        if resp.status_code >= 300:
+            raise S3Error("AccessDenied" if resp.status_code == 403
+                          else "InternalError", resp.text,
+                          resp.status_code)
+        return ext
+
+    async def _bucket_acl_op(self, m: str, bucket: str, req,
+                             payload: bytes) -> web.Response:
+        """Canned-ACL subset, like the reference's
+        Get/PutBucketAclHandler (s3api_bucket_handlers.go:252-313):
+        only `private` and `public-read` are modeled."""
+        if m == "PUT":
+            canned = req.headers.get("x-amz-acl", "")
+            if not canned:
+                # no canned header: the intent is in the XML body — map
+                # the grant sets we model, reject the rest rather than
+                # silently recording an ACL the caller didn't ask for
+                canned = _canned_from_acl_xml(payload)
+            if canned not in ("private", "public-read"):
+                raise S3Error("NotImplemented",
+                              f"canned acl {canned!r} not supported",
+                              501)
+            await self._update_bucket_meta(
+                bucket, lambda ext: ext.__setitem__("s3_acl", canned))
+            return web.Response(status=200)
+        if m == "GET":
+            meta = await self._require_bucket(bucket)
+            canned = meta.get("extended", {}).get("s3_acl", "private")
+            owner = _xml("Owner")
+            owner.append(_leaf("ID", "seaweedfs_tpu"))
+            grants = ET.Element("AccessControlList")
+
+            def grant(grantee_children, permission):
+                g = ET.Element("Grant")
+                grantee = ET.Element("Grantee")
+                grantee.set("xmlns:xsi",
+                            "http://www.w3.org/2001/XMLSchema-instance")
+                for c in grantee_children:
+                    grantee.append(c)
+                g.append(grantee)
+                g.append(_leaf("Permission", permission))
+                grants.append(g)
+
+            grant([_leaf("ID", "seaweedfs_tpu")], "FULL_CONTROL")
+            if canned == "public-read":
+                grant([_leaf("URI", "http://acs.amazonaws.com/groups/"
+                             "global/AllUsers")], "READ")
+            root = _xml("AccessControlPolicy", owner, grants)
+            return _xml_response(root)
+        raise S3Error("MethodNotAllowed", f"{m} on ?acl", 405)
+
+    async def _lifecycle_op(self, m: str, bucket: str,
+                            payload: bytes) -> web.Response:
+        """Bucket lifecycle <-> filer.conf TTL rules. GET mirrors the
+        reference (s3api_bucket_handlers.go:315: rules derived from the
+        filer conf's TTLs for the bucket's collection); PUT goes one
+        step further and writes Days-based expiration rules back as
+        per-prefix TTL rules; DELETE drops them (reference DELETE is a
+        204 no-op)."""
+        from ..filer.filer_conf import CONF_KEY, FilerConf, PathConf
+
+        await self._require_bucket(bucket)
+        prefix_root = f"{BUCKETS_DIR}/{bucket}/"
+        resp = await self._filer(
+            "GET", f"{self.filer_url}/kv/{CONF_KEY}")
+        conf = FilerConf.from_json(resp.content) \
+            if resp.status_code == 200 else FilerConf()
+
+        if m == "GET":
+            # only whole-day TTLs surface as lifecycle rules (the
+            # reference truncates the same way and skips day-0 rules,
+            # s3api_bucket_handlers.go:338-341)
+            rules = [r for r in conf.rules
+                     if r.location_prefix.startswith(prefix_root)
+                     and r.ttl and _ttl_to_days(r.ttl) > 0]
+            if not rules:
+                raise S3Error("NoSuchLifecycleConfiguration",
+                              "no lifecycle configuration", 404)
+            root = _xml("LifecycleConfiguration")
+            for r in rules:
+                days = _ttl_to_days(r.ttl)
+                rule = ET.Element("Rule")
+                rule.append(_leaf("Status", "Enabled"))
+                filt = ET.Element("Filter")
+                filt.append(_leaf(
+                    "Prefix", r.location_prefix[len(prefix_root):]))
+                rule.append(filt)
+                exp = ET.Element("Expiration")
+                exp.append(_leaf("Days", str(days)))
+                rule.append(exp)
+                root.append(rule)
+            return _xml_response(root)
+
+        if m == "PUT":
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError as e:
+                raise S3Error("MalformedXML", str(e), 400)
+            # S3 PUT replaces the entire configuration: drop this
+            # bucket's previous TTL rules before adding the new set
+            for r in list(conf.rules):
+                if r.location_prefix.startswith(prefix_root) and r.ttl:
+                    conf.delete_rule(r.location_prefix)
+            put_any = False
+            for rule in root.iter():
+                if not rule.tag.endswith("Rule"):
+                    continue
+                status = _find(rule, "Status")
+                if status is None or status.text != "Enabled":
+                    continue
+                days = None
+                for exp in rule.iter():
+                    if exp.tag.endswith("Expiration"):
+                        d = _find(exp, "Days")
+                        if d is not None and d.text:
+                            try:
+                                days = int(d.text)
+                            except ValueError:
+                                raise S3Error(
+                                    "MalformedXML",
+                                    f"bad Days {d.text!r}", 400)
+                            if days <= 0:
+                                raise S3Error(
+                                    "InvalidArgument",
+                                    "Days must be positive", 400)
+                if days is None:
+                    raise S3Error("NotImplemented",
+                                  "only Days-based expiration is "
+                                  "supported", 501)
+                prefix = ""
+                for el in rule.iter():
+                    if el.tag.endswith("Prefix") and el.text:
+                        prefix = el.text
+                conf.set_rule(PathConf(
+                    location_prefix=prefix_root + prefix,
+                    ttl=f"{days}d"))
+                put_any = True
+            if not put_any:
+                raise S3Error("MalformedXML",
+                              "no enabled rules with expiration", 400)
+            await self._filer("PUT",
+                              f"{self.filer_url}/kv/{CONF_KEY}",
+                              data=conf.to_json().encode())
+            return web.Response(status=200)
+
+        if m == "DELETE":
+            changed = False
+            for r in list(conf.rules):
+                if r.location_prefix.startswith(prefix_root) and r.ttl:
+                    conf.delete_rule(r.location_prefix)
+                    changed = True
+            if changed:
+                await self._filer("PUT",
+                                  f"{self.filer_url}/kv/{CONF_KEY}",
+                                  data=conf.to_json().encode())
+            return web.Response(status=204)
+        raise S3Error("MethodNotAllowed", f"{m} on ?lifecycle", 405)
+
+    async def _ownership_op(self, m: str, bucket: str,
+                            payload: bytes) -> web.Response:
+        """Bucket ownership controls, stored on the bucket entry
+        (s3api_bucket_handlers.go:382-498)."""
+        valid = ("BucketOwnerPreferred", "ObjectWriter",
+                 "BucketOwnerEnforced")
+        if m == "PUT":
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError as e:
+                raise S3Error("MalformedXML", str(e), 400)
+            ownership = ""
+            for el in root.iter():
+                if el.tag.endswith("ObjectOwnership") and el.text:
+                    ownership = el.text
+            if ownership not in valid:
+                raise S3Error("InvalidRequest",
+                              f"ownership must be one of {valid}", 400)
+            await self._update_bucket_meta(
+                bucket,
+                lambda ext: ext.__setitem__("s3_ownership", ownership))
+            return web.Response(status=200)
+        if m == "GET":
+            meta = await self._require_bucket(bucket)
+            ownership = meta.get("extended", {}).get("s3_ownership", "")
+            if not ownership:
+                raise S3Error("OwnershipControlsNotFoundError",
+                              "no ownership controls", 404)
+            root = _xml("OwnershipControls")
+            rule = ET.Element("Rule")
+            rule.append(_leaf("ObjectOwnership", ownership))
+            root.append(rule)
+            return _xml_response(root)
+        if m == "DELETE":
+            await self._update_bucket_meta(
+                bucket, lambda ext: ext.pop("s3_ownership", None))
+            return web.Response(status=204)
+        raise S3Error("MethodNotAllowed",
+                      f"{m} on ?ownershipControls", 405)
 
     # -- object ---------------------------------------------------------
     async def _post_policy_upload(self, req: web.Request, bucket: str,
@@ -763,6 +1075,44 @@ class S3ApiServer:
             raise S3Error("InternalError", resp.text, 500)
         etag = resp.json().get("etag", "")
         return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _upload_part_copy(self, bucket: str, upload_id: str,
+                                part_number: int, src: str,
+                                src_range: str) -> web.Response:
+        """UploadPartCopy (s3api_object_copy_handlers.go:135
+        CopyObjectPartHandler): copy a source object — optionally an
+        `x-amz-copy-source-range: bytes=a-b` slice — in as a part."""
+        await self._upload_marker(bucket, upload_id)
+        src = urllib.parse.unquote(src.lstrip("/"))
+        src_bucket, _, src_key = src.partition("/")
+        await self._entry_meta(src_bucket, src_key)
+        headers = {}
+        if src_range:
+            m = re.fullmatch(r"bytes=(\d+)-(\d+)", src_range.strip())
+            if not m:
+                raise S3Error("InvalidArgument",
+                              f"bad copy range {src_range!r}", 400)
+            headers["Range"] = src_range
+        data = await self._filer("GET", self._fpath(src_bucket, src_key),
+                                 headers=headers)
+        if data.status_code == 416:
+            raise S3Error("InvalidRange",
+                          f"copy range {src_range!r} is outside the "
+                          "source object", 416)
+        if data.status_code not in (200, 206):
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        part_path = f"{self._upload_dir(bucket, upload_id)}/" \
+            f"{part_number:05d}.part"
+        resp = await self._filer("POST", self._fpath(bucket, part_path),
+                                 params={"collection": bucket},
+                                 data=data.content)
+        if resp.status_code >= 300:
+            raise S3Error("InternalError", resp.text, 500)
+        etag = resp.json().get("etag", "")
+        root = _xml("CopyPartResult")
+        root.append(_leaf("ETag", f'"{etag}"'))
+        root.append(_leaf("LastModified", _iso(time.time())))
+        return _xml_response(root)
 
     async def _complete_multipart(self, bucket: str, key: str,
                                   upload_id: str,

@@ -1,0 +1,446 @@
+"""Extended S3 surface: streaming chunked SigV4 uploads, UploadPartCopy,
+bucket ACL / lifecycle / ownership-controls sub-resources, and the
+NotImplemented parity stubs (reference: chunked_reader_v4.go,
+s3api_object_copy_handlers.go:135, s3api_bucket_handlers.go:252-498,
+s3api_bucket_skip_handlers.go, s3api_object_skip_handlers.go).
+"""
+import xml.etree.ElementTree as ET
+
+import pytest
+import requests
+
+from seaweedfs_tpu.s3 import chunked
+from seaweedfs_tpu.s3.auth import sign_request
+from seaweedfs_tpu.server.cluster import Cluster
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("s3_ext")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_s3=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(cluster):
+    url = cluster.s3_url
+    requests.put(f"{url}/ext")
+    return url
+
+
+def _signed_streaming_put(s3_url, path, data, access_key, secret,
+                          tamper=False, chunk_size=256):
+    """Frame `data` aws-chunked and sign it the way the AWS CLI does."""
+    import urllib.parse
+    from datetime import datetime, timezone
+
+    now = datetime.now(timezone.utc)
+    datestamp = now.strftime("%Y%m%d")
+    scope = f"{datestamp}/us-east-1/s3/aws4_request"
+    headers = sign_request(
+        "PUT", f"{s3_url}{path}", access_key, secret,
+        content_sha256=chunked.STREAMING_SIGNED,
+        extra_headers={
+            "content-encoding": "aws-chunked",
+            "x-amz-decoded-content-length": str(len(data)),
+        })
+    seed = headers["Authorization"].rsplit("Signature=", 1)[1]
+    key = chunked.signing_key(secret, datestamp, "us-east-1", "s3")
+    amz_date = headers["x-amz-date"]
+    body = chunked.encode_chunked(
+        data, key=key, amz_date=amz_date, scope=scope,
+        seed_signature=seed, chunk_size=chunk_size)
+    if tamper:
+        # flip a data byte after signing: chunk signature must catch it
+        idx = body.index(b"\r\n", body.index(b"\r\n") + 2) - 2
+        body = body[:idx] + bytes([body[idx] ^ 0xFF]) + body[idx + 1:]
+    return requests.put(f"{s3_url}{path}", data=body, headers=headers)
+
+
+class TestStreamingChunked:
+    @pytest.fixture(scope="class")
+    def auth_cluster(self, tmp_path_factory):
+        cfg = {"identities": [
+            {"name": "admin",
+             "credentials": [{"accessKey": "AKID", "secretKey": "SK"}],
+             "actions": ["Admin"]}]}
+        c = Cluster(str(tmp_path_factory.mktemp("s3_stream")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_s3=True, s3_config=cfg)
+        h = sign_request("PUT", f"{c.s3_url}/sb", "AKID", "SK")
+        assert requests.put(f"{c.s3_url}/sb",
+                            headers=h).status_code == 200
+        yield c
+        c.stop()
+
+    def test_signed_streaming_round_trip(self, auth_cluster):
+        s3_url = auth_cluster.s3_url
+        data = bytes(range(256)) * 5  # multiple chunks at 256B framing
+        r = _signed_streaming_put(s3_url, "/sb/stream.bin", data,
+                                  "AKID", "SK")
+        assert r.status_code == 200, r.text
+        h = sign_request("GET", f"{s3_url}/sb/stream.bin", "AKID", "SK")
+        assert requests.get(f"{s3_url}/sb/stream.bin",
+                            headers=h).content == data
+
+    def test_tampered_chunk_rejected(self, auth_cluster):
+        s3_url = auth_cluster.s3_url
+        data = b"payload that will be corrupted in transit" * 8
+        r = _signed_streaming_put(s3_url, "/sb/bad.bin", data,
+                                  "AKID", "SK", tamper=True)
+        assert r.status_code == 403
+        assert "SignatureDoesNotMatch" in r.text
+
+    def test_streaming_without_decoded_length_rejected(
+            self, auth_cluster):
+        s3_url = auth_cluster.s3_url
+        headers = sign_request(
+            "PUT", f"{s3_url}/sb/nolen.bin", "AKID", "SK",
+            content_sha256=chunked.STREAMING_SIGNED)
+        r = requests.put(f"{s3_url}/sb/nolen.bin", data=b"0\r\n\r\n",
+                         headers=headers)
+        assert r.status_code == 411
+
+    def test_unsigned_trailer_streaming_open_mode(self, s3):
+        data = b"unsigned streaming body" * 100
+        body = chunked.encode_chunked(data, chunk_size=1024)
+        r = requests.put(
+            f"{s3}/ext/unsigned.bin", data=body,
+            headers={
+                "x-amz-content-sha256": chunked.STREAMING_UNSIGNED,
+                "content-encoding": "aws-chunked",
+                "x-amz-decoded-content-length": str(len(data)),
+            })
+        assert r.status_code == 200, r.text
+        assert requests.get(f"{s3}/ext/unsigned.bin").content == data
+
+
+class TestUploadPartCopy:
+    def test_part_copy_with_range(self, s3):
+        src = bytes(range(200)) * 50  # 10 KB source
+        requests.put(f"{s3}/ext/src.bin", data=src)
+        r = requests.post(f"{s3}/ext/joined.bin?uploads")
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+
+        r1 = requests.put(
+            f"{s3}/ext/joined.bin?partNumber=1&uploadId={upload_id}",
+            headers={"x-amz-copy-source": "/ext/src.bin",
+                     "x-amz-copy-source-range": "bytes=0-4999"})
+        assert r1.status_code == 200, r1.text
+        assert ET.fromstring(r1.text).find(f"{NS}ETag") is not None
+        r2 = requests.put(
+            f"{s3}/ext/joined.bin?partNumber=2&uploadId={upload_id}",
+            headers={"x-amz-copy-source": "/ext/src.bin"})
+        assert r2.status_code == 200
+
+        parts = "".join(
+            f"<Part><PartNumber>{n}</PartNumber></Part>"
+            for n in (1, 2))
+        r = requests.post(
+            f"{s3}/ext/joined.bin?uploadId={upload_id}",
+            data=f"<CompleteMultipartUpload>{parts}"
+                 f"</CompleteMultipartUpload>")
+        assert r.status_code == 200, r.text
+        got = requests.get(f"{s3}/ext/joined.bin").content
+        assert got == src[:5000] + src
+
+    def test_bad_range_rejected(self, s3):
+        requests.put(f"{s3}/ext/src2.bin", data=b"x" * 100)
+        r = requests.post(f"{s3}/ext/j2.bin?uploads")
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        r = requests.put(
+            f"{s3}/ext/j2.bin?partNumber=1&uploadId={upload_id}",
+            headers={"x-amz-copy-source": "/ext/src2.bin",
+                     "x-amz-copy-source-range": "bytes=nonsense"})
+        assert r.status_code == 400
+
+
+class TestBucketAcl:
+    def test_default_private(self, s3):
+        r = requests.get(f"{s3}/ext?acl")
+        assert r.status_code == 200
+        assert "FULL_CONTROL" in r.text
+        assert "AllUsers" not in r.text
+
+    def test_put_public_read(self, s3):
+        r = requests.put(f"{s3}/ext?acl",
+                         headers={"x-amz-acl": "public-read"})
+        assert r.status_code == 200
+        got = requests.get(f"{s3}/ext?acl").text
+        assert "AllUsers" in got and "READ" in got
+        requests.put(f"{s3}/ext?acl", headers={"x-amz-acl": "private"})
+        assert "AllUsers" not in requests.get(f"{s3}/ext?acl").text
+
+    def test_exotic_canned_acl_rejected(self, s3):
+        r = requests.put(f"{s3}/ext?acl",
+                         headers={"x-amz-acl": "authenticated-read"})
+        assert r.status_code == 501
+
+
+class TestLifecycle:
+    def test_none_configured_404(self, s3):
+        requests.put(f"{s3}/lc")
+        r = requests.get(f"{s3}/lc?lifecycle")
+        assert r.status_code == 404
+        assert "NoSuchLifecycleConfiguration" in r.text
+
+    def test_put_get_delete_round_trip(self, s3):
+        requests.put(f"{s3}/lc2")
+        body = ("<LifecycleConfiguration><Rule>"
+                "<Status>Enabled</Status>"
+                "<Filter><Prefix>logs/</Prefix></Filter>"
+                "<Expiration><Days>7</Days></Expiration>"
+                "</Rule></LifecycleConfiguration>")
+        assert requests.put(f"{s3}/lc2?lifecycle",
+                            data=body).status_code == 200
+        got = requests.get(f"{s3}/lc2?lifecycle")
+        assert got.status_code == 200
+        root = ET.fromstring(got.text)
+        days = [d.text for d in root.iter(f"{NS}Days")]
+        prefixes = [p.text for p in root.iter(f"{NS}Prefix")]
+        assert days == ["7"] and prefixes == ["logs/"]
+        # the rule lands in filer.conf as a TTL (the reference derives
+        # lifecycle FROM those TTL rules, s3api_bucket_handlers.go:330)
+        assert requests.delete(f"{s3}/lc2?lifecycle").status_code == 204
+        assert requests.get(f"{s3}/lc2?lifecycle").status_code == 404
+
+    def test_rule_without_days_rejected(self, s3):
+        requests.put(f"{s3}/lc3")
+        body = ("<LifecycleConfiguration><Rule>"
+                "<Status>Enabled</Status>"
+                "</Rule></LifecycleConfiguration>")
+        assert requests.put(f"{s3}/lc3?lifecycle",
+                            data=body).status_code == 501
+
+    def test_put_replaces_whole_configuration(self, s3):
+        requests.put(f"{s3}/lc4")
+
+        def rule(prefix, days):
+            return (f"<Rule><Status>Enabled</Status>"
+                    f"<Filter><Prefix>{prefix}</Prefix></Filter>"
+                    f"<Expiration><Days>{days}</Days></Expiration>"
+                    f"</Rule>")
+
+        requests.put(f"{s3}/lc4?lifecycle",
+                     data=f"<LifecycleConfiguration>{rule('logs/', 7)}"
+                          f"</LifecycleConfiguration>")
+        requests.put(f"{s3}/lc4?lifecycle",
+                     data=f"<LifecycleConfiguration>{rule('tmp/', 1)}"
+                          f"</LifecycleConfiguration>")
+        got = requests.get(f"{s3}/lc4?lifecycle").text
+        assert "tmp/" in got and "logs/" not in got
+
+    def test_subday_ttl_rules_do_not_surface(self, s3, cluster):
+        # an operator fs.configure TTL of 12h is below lifecycle's
+        # day granularity: GET must say NoSuchLifecycleConfiguration,
+        # not return an empty (invalid) configuration
+        requests.put(f"{s3}/lc5")
+        conf = requests.get(f"{cluster.filer_url}/kv/filer.conf")
+        import json as _json
+        rules = (_json.loads(conf.content).get("rules", [])
+                 if conf.status_code == 200 else [])
+        rules.append({"location_prefix": "/buckets/lc5/", "ttl": "12h"})
+        requests.put(f"{cluster.filer_url}/kv/filer.conf",
+                     data=_json.dumps({"rules": rules}))
+        assert requests.get(f"{s3}/lc5?lifecycle").status_code == 404
+
+
+class TestOwnershipAndMisc:
+    def test_ownership_controls_round_trip(self, s3):
+        assert requests.get(f"{s3}/ext?ownershipControls")\
+            .status_code == 404
+        body = ("<OwnershipControls><Rule>"
+                "<ObjectOwnership>BucketOwnerEnforced</ObjectOwnership>"
+                "</Rule></OwnershipControls>")
+        assert requests.put(f"{s3}/ext?ownershipControls",
+                            data=body).status_code == 200
+        got = requests.get(f"{s3}/ext?ownershipControls")
+        assert "BucketOwnerEnforced" in got.text
+        assert requests.delete(f"{s3}/ext?ownershipControls")\
+            .status_code == 204
+        assert requests.get(f"{s3}/ext?ownershipControls")\
+            .status_code == 404
+
+    def test_bad_ownership_value_rejected(self, s3):
+        body = ("<OwnershipControls><Rule>"
+                "<ObjectOwnership>Nonsense</ObjectOwnership>"
+                "</Rule></OwnershipControls>")
+        assert requests.put(f"{s3}/ext?ownershipControls",
+                            data=body).status_code == 400
+
+    def test_request_payment(self, s3):
+        r = requests.get(f"{s3}/ext?requestPayment")
+        assert r.status_code == 200
+        assert "BucketOwner" in r.text
+
+    def test_not_implemented_stubs(self, s3):
+        requests.put(f"{s3}/ext/stub.txt", data=b"x")
+        for url in (f"{s3}/ext?policy", f"{s3}/ext?cors",
+                    f"{s3}/ext/stub.txt?acl",
+                    f"{s3}/ext/stub.txt?retention",
+                    f"{s3}/ext/stub.txt?legal-hold"):
+            r = requests.get(url)
+            assert r.status_code == 501, url
+            assert "NotImplemented" in r.text
+
+
+class TestChunkedCodec:
+    def test_round_trip_signed(self):
+        key = chunked.signing_key("secret", "20260730", "us-east-1",
+                                  "s3")
+        data = b"abc" * 10000
+        body = chunked.encode_chunked(
+            data, key=key, amz_date="20260730T000000Z",
+            scope="20260730/us-east-1/s3/aws4_request",
+            seed_signature="0" * 64, chunk_size=4096)
+        out = chunked.decode_chunked(
+            body, key=key, amz_date="20260730T000000Z",
+            scope="20260730/us-east-1/s3/aws4_request",
+            seed_signature="0" * 64)
+        assert out == data
+
+    def test_empty_body(self):
+        body = chunked.encode_chunked(b"")
+        assert chunked.decode_chunked(body) == b""
+
+    def test_wrong_seed_rejected(self):
+        key = chunked.signing_key("secret", "20260730", "us-east-1",
+                                  "s3")
+        body = chunked.encode_chunked(
+            b"data", key=key, amz_date="d", scope="s",
+            seed_signature="a" * 64)
+        with pytest.raises(chunked.ChunkSignatureError):
+            chunked.decode_chunked(body, key=key, amz_date="d",
+                                   scope="s", seed_signature="b" * 64)
+
+    def test_truncated_stream_rejected(self):
+        # drop the final 0-size chunk: every remaining chunk verifies
+        # but the stream must still be rejected as incomplete
+        key = chunked.signing_key("secret", "20260730", "us-east-1",
+                                  "s3")
+        body = chunked.encode_chunked(
+            b"x" * 5000, key=key, amz_date="d", scope="s",
+            seed_signature="a" * 64, chunk_size=1024)
+        final = body.rfind(b"0;chunk-signature=")
+        with pytest.raises(chunked.ChunkSignatureError,
+                           match="final chunk"):
+            chunked.decode_chunked(body[:final], key=key, amz_date="d",
+                                   scope="s", seed_signature="a" * 64)
+
+    def test_declared_length_mismatch_rejected(self):
+        body = chunked.encode_chunked(b"x" * 100)
+        with pytest.raises(chunked.ChunkSignatureError,
+                           match="declared"):
+            chunked.decode_chunked(body, expected_length=200)
+
+
+class TestAclXmlBody:
+    def test_xml_body_public_read(self, s3):
+        requests.put(f"{s3}/aclx")
+        body = ('<AccessControlPolicy>'
+                '<Owner><ID>seaweedfs_tpu</ID></Owner>'
+                '<AccessControlList>'
+                '<Grant><Grantee><ID>seaweedfs_tpu</ID></Grantee>'
+                '<Permission>FULL_CONTROL</Permission></Grant>'
+                '<Grant><Grantee><URI>http://acs.amazonaws.com/groups/'
+                'global/AllUsers</URI></Grantee>'
+                '<Permission>READ</Permission></Grant>'
+                '</AccessControlList></AccessControlPolicy>')
+        assert requests.put(f"{s3}/aclx?acl",
+                            data=body).status_code == 200
+        assert "AllUsers" in requests.get(f"{s3}/aclx?acl").text
+
+    def test_unmodeled_grants_rejected(self, s3):
+        body = ('<AccessControlPolicy><AccessControlList>'
+                '<Grant><Grantee><URI>http://acs.amazonaws.com/groups/'
+                'global/AuthenticatedUsers</URI></Grantee>'
+                '<Permission>WRITE</Permission></Grant>'
+                '</AccessControlList></AccessControlPolicy>')
+        r = requests.put(f"{s3}/aclx?acl", data=body)
+        assert r.status_code == 501
+
+    def test_full_control_for_other_principal_rejected(self, s3):
+        # FULL_CONTROL for a different canonical ID is a grant to
+        # someone else — it must not silently map to 'private'
+        body = ('<AccessControlPolicy><AccessControlList>'
+                '<Grant><Grantee><ID>some-other-user</ID></Grantee>'
+                '<Permission>FULL_CONTROL</Permission></Grant>'
+                '</AccessControlList></AccessControlPolicy>')
+        r = requests.put(f"{s3}/aclx?acl", data=body)
+        assert r.status_code == 501
+
+
+class TestLifecycleValidation:
+    def test_non_numeric_days_is_400(self, s3):
+        requests.put(f"{s3}/lcv")
+        body = ("<LifecycleConfiguration><Rule>"
+                "<Status>Enabled</Status>"
+                "<Expiration><Days>soon</Days></Expiration>"
+                "</Rule></LifecycleConfiguration>")
+        r = requests.put(f"{s3}/lcv?lifecycle", data=body)
+        assert r.status_code == 400
+        assert "MalformedXML" in r.text
+
+    def test_nonpositive_days_is_400(self, s3):
+        body = ("<LifecycleConfiguration><Rule>"
+                "<Status>Enabled</Status>"
+                "<Expiration><Days>0</Days></Expiration>"
+                "</Rule></LifecycleConfiguration>")
+        r = requests.put(f"{s3}/lcv?lifecycle", data=body)
+        assert r.status_code == 400
+
+
+class TestCopySourcePermission:
+    @pytest.fixture(scope="class")
+    def wcluster(self, tmp_path_factory):
+        cfg = {"identities": [
+            {"name": "admin",
+             "credentials": [{"accessKey": "AKID", "secretKey": "SK"}],
+             "actions": ["Admin"]},
+            {"name": "writer",
+             "credentials": [{"accessKey": "WKID", "secretKey": "WS"}],
+             "actions": ["Write:dest", "Read:dest", "List:dest"]},
+        ]}
+        c = Cluster(str(tmp_path_factory.mktemp("s3_copysrc")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_s3=True, s3_config=cfg)
+        s3_url = c.s3_url
+        for b in ("dest", "secret"):
+            h = sign_request("PUT", f"{s3_url}/{b}", "AKID", "SK")
+            assert requests.put(f"{s3_url}/{b}",
+                                headers=h).status_code == 200
+        h = sign_request("PUT", f"{s3_url}/secret/private.txt", "AKID",
+                         "SK", payload=b"classified")
+        requests.put(f"{s3_url}/secret/private.txt", data=b"classified",
+                     headers=h)
+        yield c
+        c.stop()
+
+    def test_part_copy_requires_source_read(self, wcluster):
+        s3_url = wcluster.s3_url
+        h = sign_request("POST", f"{s3_url}/dest/out.bin?uploads",
+                         "WKID", "WS")
+        r = requests.post(f"{s3_url}/dest/out.bin?uploads", headers=h)
+        assert r.status_code == 200
+        uid = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        url = f"{s3_url}/dest/out.bin?partNumber=1&uploadId={uid}"
+        h = sign_request(
+            "PUT", url, "WKID", "WS",
+            extra_headers={"x-amz-copy-source": "/secret/private.txt"})
+        r = requests.put(url, headers={
+            **h, "x-amz-copy-source": "/secret/private.txt"})
+        assert r.status_code == 403
+
+    def test_copy_object_requires_source_read(self, wcluster):
+        s3_url = wcluster.s3_url
+        url = f"{s3_url}/dest/stolen.txt"
+        h = sign_request(
+            "PUT", url, "WKID", "WS",
+            extra_headers={"x-amz-copy-source": "/secret/private.txt"})
+        r = requests.put(url, headers={
+            **h, "x-amz-copy-source": "/secret/private.txt"})
+        assert r.status_code == 403
