@@ -32,6 +32,44 @@ def norm_path(path: str) -> str:
     return out
 
 
+class _TrackedRLock:
+    """RLock with a portable is-held-by-this-thread probe, for
+    interpreters whose RLock lacks the private _is_owned API. The
+    deferred chunk-free drain depends on that probe to never run
+    deletions while a metadata lock is held (see _drain_freed)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._owner: int | None = None
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            self._depth += 1
+        return got
+
+    def release(self):
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+
+def _owned_rlock():
+    lock = threading.RLock()
+    return lock if hasattr(lock, "_is_owned") else _TrackedRLock()
+
+
 class Filer:
     def __init__(self, store: FilerStore | str = "memory",
                  on_delete_chunks: Callable[[list[FileChunk]], None]
@@ -44,8 +82,8 @@ class Filer:
         # _hardlink_lock (shared-record read-modify-write) inner. Both
         # reentrant: the TTL-expiry path runs inside readers that a
         # mutation may invoke on its own thread.
-        self._mutation_lock = threading.RLock()
-        self._hardlink_lock = threading.RLock()
+        self._mutation_lock = _owned_rlock()
+        self._hardlink_lock = _owned_rlock()
         # chunks freed by TTL expiry hit volume servers over HTTP; when
         # expiry fires inside a locked mutation the frees are queued
         # here and drained once the locks are released
@@ -158,12 +196,8 @@ class Filer:
     def _drain_freed(self) -> None:
         """Run queued chunk deletions — only once no metadata lock is
         held by this thread (mutations drain on their way out)."""
-        # _is_owned is a private CPython RLock API; if it ever
-        # disappears, fail SAFE by deferring (the exit-path drain picks
-        # the queue up), never by draining under a metadata lock
-        if getattr(self._mutation_lock, "_is_owned", lambda: True)() \
-                or getattr(self._hardlink_lock, "_is_owned",
-                           lambda: True)():
+        if self._mutation_lock._is_owned() or \
+                self._hardlink_lock._is_owned():
             return
         with self._free_lock:
             chunks, self._free_queue = self._free_queue, []

@@ -189,6 +189,7 @@ class RaftNode:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term, "voted_for": self.voted_for,
+                       "peers": self.peers,
                        "log": [e.to_json() for e in self.log]}, f)
         os.replace(tmp, path)
 
@@ -200,6 +201,9 @@ class RaftNode:
             d = json.load(f)
         self.current_term = d["term"]
         self.voted_for = d.get("voted_for")
+        # membership changes committed through the log survive restarts
+        self.peers = [p for p in d.get("peers", self.peers)
+                      if p != self.me]
         self.log = [LogEntry.from_json(e) for e in d.get("log", [])]
 
     # ------------------------------------------------------------------
@@ -387,6 +391,9 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             cmd = self.log[self.last_applied - 1].command
+            if str(cmd.get("type", "")).startswith("raft."):
+                self._apply_conf_change(cmd)
+                continue
             self.fsm.apply(cmd)
             if self.on_apply is not None:
                 self.on_apply(cmd)
@@ -404,6 +411,41 @@ class RaftNode:
             else:
                 still.append((idx, term, fut))
         self._commit_waiters = still
+
+    # ------------------------------------------------------------------
+    # membership (single-server changes through the log, the
+    # hashicorp-raft AddVoter/RemoveServer analog used by the
+    # reference's cluster.raft.add/remove shell commands,
+    # raft_hashicorp.go + command_cluster_raft_*.go)
+    # ------------------------------------------------------------------
+    def _apply_conf_change(self, cmd: dict) -> None:
+        peer = cmd.get("peer", "")
+        if cmd["type"] == "raft.add_peer":
+            if peer and peer != self.me and peer not in self.peers:
+                self.peers.append(peer)
+                if self.state == LEADER:
+                    self.next_index[peer] = len(self.log) + 1
+                    self.match_index[peer] = 0
+        elif cmd["type"] == "raft.remove_peer":
+            if peer in self.peers:
+                self.peers.remove(peer)
+                self.next_index.pop(peer, None)
+                self.match_index.pop(peer, None)
+        self._persist()
+
+    async def add_peer(self, peer: str, timeout: float = 5.0) -> bool:
+        """Leader-only: commit a config entry adding `peer` as a voter.
+        The new server must be started with the full peer list (it
+        learns the log by catching up from the leader)."""
+        return await self.propose(
+            {"type": "raft.add_peer", "peer": peer}, timeout)
+
+    async def remove_peer(self, peer: str, timeout: float = 5.0) -> bool:
+        """Leader-only: commit a config entry removing `peer`. The
+        removed server keeps running but no longer counts for quorum;
+        shut it down separately."""
+        return await self.propose(
+            {"type": "raft.remove_peer", "peer": peer}, timeout)
 
     # ------------------------------------------------------------------
     # RPC handlers (called by transport)

@@ -36,12 +36,13 @@ class VolumeInfo:
 
 class DataNode:
     def __init__(self, node_id: str, ip: str, port: int, public_url: str,
-                 max_volumes: int, rack: "Rack"):
+                 max_volumes: int, rack: "Rack", disk_type: str = "hdd"):
         self.id = node_id
         self.ip = ip
         self.port = port
         self.public_url = public_url
         self.max_volumes = max_volumes
+        self.disk_type = disk_type
         self.rack = rack
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, int] = {}  # vid -> shard bits
@@ -164,16 +165,18 @@ class Topology:
     def register_node(self, node_id: str, ip: str, port: int,
                       public_url: str, max_volumes: int,
                       dc: str = "DefaultDataCenter",
-                      rack: str = "DefaultRack") -> DataNode:
+                      rack: str = "DefaultRack",
+                      disk_type: str = "hdd") -> DataNode:
         with self.lock:
             node = self.nodes.get(node_id)
             if node is None:
                 dc_obj = self.dcs.setdefault(dc, DataCenter(dc))
                 rack_obj = dc_obj.racks.setdefault(rack, Rack(rack, dc_obj))
                 node = DataNode(node_id, ip, port, public_url, max_volumes,
-                                rack_obj)
+                                rack_obj, disk_type)
                 rack_obj.nodes[node_id] = node
                 self.nodes[node_id] = node
+            node.disk_type = disk_type
             node.last_seen = time.monotonic()
             return node
 
@@ -386,6 +389,7 @@ class Topology:
                             "ec_volumes": {str(v): b for v, b in
                                            n.ec_shards.items()},
                             "max_volumes": n.max_volumes,
+                            "disk_type": n.disk_type,
                         } for n in r.nodes.values()],
                     } for r in dc.racks.values()],
                 } for dc in self.dcs.values()],

@@ -112,6 +112,17 @@ def make_queue(kind: str, **kwargs) -> NotificationQueue:
     return queues[kind](**kwargs)
 
 
+def queue_from_config(conf: dict) -> NotificationQueue:
+    """Build a queue from a stored config dict
+    ({"kind": "log", "path": ...} — the notification.toml analog kept
+    in the filer KV space as `notification.conf`)."""
+    conf = dict(conf)
+    kind = conf.pop("kind", "")
+    if not kind:
+        raise KeyError("notification config missing 'kind'")
+    return make_queue(kind, **conf)
+
+
 def attach_notifier(filer, q: NotificationQueue,
                     path_prefix: str = "/") -> threading.Thread:
     """Subscribe to a Filer's in-process metadata log and publish every

@@ -56,6 +56,11 @@ class RemoteStorageClient:
     def remove_directory(self, key: str) -> None:
         pass
 
+    def list_buckets(self) -> list[str]:
+        """Top-level containers of this storage (remote.mount.buckets;
+        remote_storage.go RemoteStorageClient ListBuckets)."""
+        raise NotImplementedError
+
 
 class LocalRemoteClient(RemoteStorageClient):
     """A plain directory as the remote (type "local")."""
@@ -90,6 +95,11 @@ class LocalRemoteClient(RemoteStorageClient):
             return None
         return RemoteEntry(key=key.lstrip("/"), size=st.st_size,
                            mtime=st.st_mtime)
+
+    def list_buckets(self) -> list[str]:
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d)))
 
     def read_file(self, key: str, offset: int = 0,
                   size: int = -1) -> bytes:
@@ -153,6 +163,9 @@ class S3RemoteClient(RemoteStorageClient):
 
     def delete_file(self, key: str) -> None:
         self._c.delete_object(key)
+
+    def list_buckets(self) -> list[str]:
+        return self._c.list_buckets()
 
 
 _makers: dict[str, Callable[..., RemoteStorageClient]] = {

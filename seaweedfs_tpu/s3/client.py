@@ -165,6 +165,17 @@ class S3Client:
         return n
 
     # -- listing --------------------------------------------------------
+    def list_buckets(self) -> list[str]:
+        """Service-level ListBuckets (ignores this client's bucket
+        scope) — remote.mount.buckets discovery."""
+        import requests
+        url = f"{self.endpoint}/"
+        r = requests.get(url, headers=self.headers("GET", url),
+                         timeout=300)
+        r.raise_for_status()
+        root = ET.fromstring(r.text)
+        return [n.text for n in root.iter(f"{_NS}Name") if n.text]
+
     def list_objects(self, prefix: str = "") -> Iterator[ObjectInfo]:
         """ListObjectsV2 with continuation-token paging."""
         import requests

@@ -104,6 +104,26 @@ def _src_bucket_of(src: str) -> str:
 OWNER_ID = "seaweedfs_tpu"
 
 
+def _clear_bucket_ttls(conf, prefix_root: str) -> bool:
+    """Drop the TTLs a bucket's lifecycle owns from the filer conf:
+    rules that carry only a ttl are removed, rules that also hold other
+    fs.configure settings (replication, readOnly, ...) keep those and
+    just lose the ttl. Returns whether anything changed."""
+    from ..filer.filer_conf import PathConf
+
+    changed = False
+    for r in list(conf.rules):
+        if not (r.location_prefix.startswith(prefix_root) and r.ttl):
+            continue
+        changed = True
+        bare = PathConf(location_prefix=r.location_prefix, ttl=r.ttl)
+        if r == bare:
+            conf.delete_rule(r.location_prefix)
+        else:
+            r.ttl = ""
+    return changed
+
+
 def _canned_from_acl_xml(payload: bytes) -> str:
     """Map an AccessControlPolicy body onto the modeled canned ACLs:
     owner-only FULL_CONTROL -> private, plus AllUsers READ ->
@@ -654,11 +674,11 @@ class S3ApiServer:
                 root = ET.fromstring(payload)
             except ET.ParseError as e:
                 raise S3Error("MalformedXML", str(e), 400)
-            # S3 PUT replaces the entire configuration: drop this
-            # bucket's previous TTL rules before adding the new set
-            for r in list(conf.rules):
-                if r.location_prefix.startswith(prefix_root) and r.ttl:
-                    conf.delete_rule(r.location_prefix)
+            # S3 PUT replaces the entire configuration: clear this
+            # bucket's previous TTLs before adding the new set — but
+            # only the ttl field, so fs.configure settings that share a
+            # rule (replication, readOnly, ...) survive
+            _clear_bucket_ttls(conf, prefix_root)
             put_any = False
             for rule in root.iter():
                 if not rule.tag.endswith("Rule"):
@@ -689,9 +709,14 @@ class S3ApiServer:
                 for el in rule.iter():
                     if el.tag.endswith("Prefix") and el.text:
                         prefix = el.text
-                conf.set_rule(PathConf(
-                    location_prefix=prefix_root + prefix,
-                    ttl=f"{days}d"))
+                loc = prefix_root + prefix
+                existing = next((r for r in conf.rules
+                                 if r.location_prefix == loc), None)
+                if existing is not None:
+                    existing.ttl = f"{days}d"
+                else:
+                    conf.set_rule(PathConf(location_prefix=loc,
+                                           ttl=f"{days}d"))
                 put_any = True
             if not put_any:
                 raise S3Error("MalformedXML",
@@ -702,12 +727,7 @@ class S3ApiServer:
             return web.Response(status=200)
 
         if m == "DELETE":
-            changed = False
-            for r in list(conf.rules):
-                if r.location_prefix.startswith(prefix_root) and r.ttl:
-                    conf.delete_rule(r.location_prefix)
-                    changed = True
-            if changed:
+            if _clear_bucket_ttls(conf, prefix_root):
                 await self._filer("PUT",
                                   f"{self.filer_url}/kv/{CONF_KEY}",
                                   data=conf.to_json().encode())

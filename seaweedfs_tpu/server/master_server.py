@@ -57,6 +57,7 @@ class MasterServer:
         self.guard = Guard(jwt_secret)
         self.garbage_threshold = garbage_threshold
         self.pulse_seconds = pulse_seconds
+        self.vacuum_disabled = False
         self._clients: set[web.WebSocketResponse] = set()
         self._grow_lock = asyncio.Lock()
         from ..cluster.membership import ClusterMembership
@@ -117,6 +118,11 @@ class MasterServer:
                 try:
                     env.acquire_lock()
                     for line in self.admin_scripts:
+                        if self.vacuum_disabled and \
+                                line.startswith("volume.vacuum"):
+                            out.append({"script": line, "ok": False,
+                                        "error": "vacuum disabled"})
+                            continue
                         try:
                             run_command(env, line)
                             out.append({"script": line, "ok": True})
@@ -175,6 +181,11 @@ class MasterServer:
             web.get("/cluster/ec_shards", self.handle_ec_shards),
             web.get("/ws/heartbeat", self.handle_heartbeat_ws),
             web.get("/ws/keepconnected", self.handle_keepconnected_ws),
+            web.post("/vol/vacuum/disable", self.handle_vacuum_toggle),
+            web.post("/vol/vacuum/enable", self.handle_vacuum_toggle),
+            web.post("/cluster/raft/add", self.handle_raft_membership),
+            web.post("/cluster/raft/remove",
+                     self.handle_raft_membership),
             web.get("/metrics", self.handle_metrics),
             web.get("/", self.handle_ui),
         ])
@@ -343,7 +354,8 @@ class MasterServer:
                     hb.get("public_url", node_id),
                     hb.get("max_volume_count", 8),
                     hb.get("data_center", "DefaultDataCenter"),
-                    hb.get("rack", "DefaultRack"))
+                    hb.get("rack", "DefaultRack"),
+                    hb.get("disk_type", "hdd"))
                 if "volumes" in hb:
                     self.topo.sync_node_volumes(
                         node, [VolumeInfo(
@@ -447,8 +459,41 @@ class MasterServer:
             "IsLeader": self.raft.is_leader() if self.raft else True,
             "Leader": (self.raft.leader() or "") if self.raft else "",
             "Peers": self.raft.peers if self.raft else [],
+            "VacuumDisabled": self.vacuum_disabled,
             "Topology": self.topo.to_dict(),
         })
+
+    async def handle_vacuum_toggle(self, req: web.Request) -> web.Response:
+        """volume.vacuum.disable / enable (command_volume_vacuum_disable
+        .go): a master-side switch the maintenance cron and the shell's
+        vacuum command both consult."""
+        redirect = self._leader_redirect(req)
+        if redirect is not None:
+            return redirect
+        self.vacuum_disabled = req.path.endswith("/disable")
+        return json_ok({"vacuum_disabled": self.vacuum_disabled})
+
+    async def handle_raft_membership(self, req: web.Request) -> web.Response:
+        """cluster.raft.add / remove (command_cluster_raft_server_add
+        .go / _remove.go): single-server membership change committed
+        through the raft log."""
+        if self.raft is None:
+            return json_error("raft is not enabled on this master",
+                              status=400)
+        redirect = self._leader_redirect(req)
+        if redirect is not None:
+            return redirect
+        peer = req.query.get("peer", "")
+        if not peer:
+            return json_error("missing ?peer=host:port", status=400)
+        if req.path.endswith("/add"):
+            ok = await self.raft.add_peer(peer)
+        else:
+            ok = await self.raft.remove_peer(peer)
+        if not ok:
+            return json_error("membership change did not commit "
+                              "(no quorum or not leader)", status=503)
+        return json_ok({"peers": self.raft.peers})
 
     async def handle_cluster_leader(self, req: web.Request) -> web.Response:
         """Leadership probe without serializing the topology (cheap

@@ -38,8 +38,10 @@ class VolumeServer:
                  jwt_secret: str = "",
                  pulse_seconds: float = 5.0,
                  max_concurrent_writes: int = 64,
-                 tier_backends: dict[str, dict] | None = None):
+                 tier_backends: dict[str, dict] | None = None,
+                 disk_type: str = "hdd"):
         self.store = store
+        self.disk_type = disk_type
         # comma-separated list in HA mode; heartbeats follow the raft
         # leader (volume_grpc_client_to_master.go:50 tries all masters)
         self.masters = [
@@ -94,6 +96,7 @@ class VolumeServer:
             web.get("/admin/needle_read", self.handle_needle_read),
             web.post("/admin/needle_write", self.handle_needle_write),
             web.post("/admin/needle_delete", self.handle_needle_delete),
+            web.post("/admin/leave", self.handle_leave),
             web.post("/admin/volume_replication",
                      self.handle_volume_replication),
             web.post("/admin/vacuum_check", self.handle_vacuum_check),
@@ -124,6 +127,20 @@ class VolumeServer:
 
     async def _on_startup(self, app) -> None:
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def handle_leave(self, req: web.Request) -> web.Response:
+        """volume.server.leave (command_volume_server_leave.go →
+        VolumeServerLeave rpc): stop heartbeating so the master drops
+        this node from the topology; the server keeps serving reads
+        until the operator shuts it down."""
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except asyncio.CancelledError:
+                pass
+            self._hb_task = None
+        return web.json_response({"left": True})
 
     async def _on_cleanup(self, app) -> None:
         if self._hb_task is not None:
@@ -170,6 +187,7 @@ class VolumeServer:
                             hb = self.store.collect_heartbeat()
                             hb["data_center"] = self.data_center
                             hb["rack"] = self.rack
+                            hb["disk_type"] = self.disk_type
                             await ws.send_json(hb)
                             msg = await ws.receive(
                                 timeout=self.pulse_seconds * 4)
@@ -638,10 +656,24 @@ class VolumeServer:
              "deleted": deleted})
 
     async def handle_volume_replication(self, req: web.Request) -> web.Response:
+        """GET the replica placement — or rewrite it in the superblock
+        when the body carries `replication`, the
+        VolumeConfigure rpc behind volume.configure.replication
+        (command_volume_configure_replication.go)."""
         body = await req.json()
         v = self.store.find_volume(int(body["volume"]))
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
+        if "replication" in body:
+            from ..storage.super_block import ReplicaPlacement
+            try:
+                rp = ReplicaPlacement.parse(body["replication"])
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            v.super_block.replica_placement = rp
+            await asyncio.to_thread(
+                v.dat.write_at, v.super_block.to_bytes(), 0)
+            self.poke_heartbeat()
         return web.json_response(
             {"replication": str(v.super_block.replica_placement)})
 

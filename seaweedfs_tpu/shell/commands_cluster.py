@@ -33,6 +33,30 @@ def cluster_ps(env: CommandEnv) -> dict:
     return out
 
 
+def cluster_raft_change(env: CommandEnv, peer: str,
+                        add: bool) -> dict:
+    """cluster.raft.add / cluster.raft.remove
+    (command_cluster_raft_server_add.go / _remove.go): single-server
+    membership change committed through the raft log. A newly added
+    server must be started with the full -peers list so it can catch
+    up from the leader."""
+    env.confirm_locked()
+    if not peer:
+        raise ShellError("needs -peer=host:port")
+    verb = "add" if add else "remove"
+    # followers 307 to the leader; requests re-POSTs on 307
+    resp = requests.post(
+        f"{env.master_url}/cluster/raft/{verb}",
+        params={"peer": peer}, timeout=30)
+    if resp.status_code >= 300:
+        try:
+            err = resp.json().get("error", resp.text)
+        except Exception:
+            err = resp.text
+        raise ShellError(f"cluster.raft.{verb}: {err}")
+    return resp.json()
+
+
 def cluster_raft_ps(env: CommandEnv) -> dict:
     """Raft status of each master peer (command_cluster_raft_ps.go)."""
     status = env.master_get("/cluster/status")

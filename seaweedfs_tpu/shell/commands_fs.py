@@ -142,6 +142,114 @@ def fs_tree(env: CommandEnv, path: str = "/") -> list[str]:
     return lines
 
 
+def fs_cd(env: CommandEnv, path: str = "/") -> str:
+    """Change the shell's working directory (command_fs_cd.go); fs.*
+    commands resolve relative paths against it."""
+    target = env.resolve(path)
+    if target != "/" and not _is_dir(_stat(env, target)):
+        raise ShellError(f"not a directory: {target}")
+    env.cwd = target
+    return env.cwd
+
+
+def fs_pwd(env: CommandEnv) -> str:
+    """Print the shell's working directory (command_fs_pwd.go)."""
+    return env.cwd
+
+
+def fs_meta_cat(env: CommandEnv, path: str) -> dict:
+    """Full stored metadata of one entry, chunks included
+    (command_fs_meta_cat.go)."""
+    return _stat(env, path)
+
+
+def fs_meta_change_volume_id(env: CommandEnv, path: str,
+                             mapping: str,
+                             apply: bool = False) -> dict:
+    """Rewrite chunk fids after volumes changed ids
+    (command_fs_meta_change_volume_id.go): -mapping=old1:new1,old2:new2
+    walks the subtree and rewrites every chunk whose volume id matches.
+    Dry-run unless -apply (the reference's -force)."""
+    if apply:
+        env.confirm_locked()
+    vid_map: dict[int, int] = {}
+    for pair in mapping.split(","):
+        old, _, new = pair.partition(":")
+        if not (old.strip().isdigit() and new.strip().isdigit()):
+            raise ShellError(f"bad mapping {pair!r} "
+                             "(want old:new[,old:new...])")
+        vid_map[int(old)] = int(new)
+    entries = 0
+    for e in _walk(env, path):
+        if _is_dir(e):
+            continue
+        touched = False
+        for c in e.get("chunks", []):
+            fid = c.get("fid", "")
+            vid_s, _, rest = fid.partition(",")
+            if vid_s.isdigit() and int(vid_s) in vid_map:
+                c["fid"] = f"{vid_map[int(vid_s)]},{rest}"
+                touched = True
+        if touched:
+            entries += 1
+            if apply:
+                full = e["full_path"]
+                e.pop("full_path", None)
+                resp = requests.put(f"{_filer(env)}{full}?meta=1",
+                                    json=e, timeout=60)
+                if resp.status_code >= 300:
+                    raise ShellError(f"update {full}: {resp.text}")
+    return {"entries_rewritten": entries, "applied": apply,
+            "mapping": {str(k): v for k, v in vid_map.items()}}
+
+
+def fs_meta_notify(env: CommandEnv, path: str = "/") -> dict:
+    """Re-publish create events for every entry under `path` to the
+    configured notification queue (command_fs_meta_notify.go) — used to
+    prime a fresh downstream consumer."""
+    from ..notification.queues import queue_from_config
+
+    conf = requests.get(f"{_filer(env)}/kv/notification.conf",
+                        timeout=30)
+    if conf.status_code != 200:
+        raise ShellError("no notification.conf configured in the filer "
+                         "KV store")
+    q = queue_from_config(json.loads(conf.content))
+    sent = 0
+    try:
+        for e in _walk(env, path):
+            q.send(e["full_path"], {"event": "create", "entry": e})
+            sent += 1
+    finally:
+        q.close()
+    return {"notified": sent}
+
+
+def mount_configure(env: CommandEnv, dir: str = "",
+                    quota_mb: int = -1) -> dict:
+    """Per-mount quota config stored in the filer KV space
+    (command_mount_configure.go): FUSE mounts read it at start and on
+    metadata events. -quotaMB=0 clears the quota."""
+    key = "mount.conf"
+    resp = requests.get(f"{_filer(env)}/kv/{key}", timeout=30)
+    conf = json.loads(resp.content) if resp.status_code == 200 else {}
+    if not dir:
+        return conf
+    env.confirm_locked()
+    dir = "/" + dir.strip("/")
+    if quota_mb < 0:
+        raise ShellError("mount.configure needs -quotaMB=<n> (0 clears)")
+    if quota_mb == 0:
+        conf.pop(dir, None)
+    else:
+        conf[dir] = {"quota_bytes": quota_mb << 20}
+    r = requests.put(f"{_filer(env)}/kv/{key}",
+                     data=json.dumps(conf).encode(), timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"mount.configure: {r.text}")
+    return conf
+
+
 def fs_meta_save(env: CommandEnv, path: str, out_file: str) -> int:
     """Snapshot the subtree's metadata to a JSONL file
     (command_fs_meta_save.go). Returns entry count."""

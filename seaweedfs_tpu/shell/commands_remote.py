@@ -73,6 +73,30 @@ def remote_mount(env: CommandEnv, dir: str = "",
     return {"mounted": dir, **synced}
 
 
+def remote_mount_buckets(env: CommandEnv, remote: str,
+                         bucket_pattern: str = "") -> dict:
+    """Mount every top-level bucket/dir of a remote storage under
+    /buckets (command_remote_mount_buckets.go). -remote=storagename,
+    optional -bucketPattern=glob filter."""
+    import fnmatch
+
+    env.confirm_locked()
+    rc = load_conf(_filer(env))
+    storage, _, _ = remote.partition("/")
+    if storage not in rc.storages:
+        raise ShellError(f"storage {storage!r} not configured "
+                         f"(known: {sorted(rc.storages)})")
+    client = make_client(rc.storages[storage])
+    mounted = []
+    for name in client.list_buckets():
+        if bucket_pattern and not fnmatch.fnmatch(name, bucket_pattern):
+            continue
+        remote_mount(env, dir=f"/buckets/{name}",
+                     remote=f"{storage}/{name}")
+        mounted.append(name)
+    return {"mounted": mounted}
+
+
 def remote_unmount(env: CommandEnv, dir: str) -> dict:
     """Detach a dir from its storage. Local entries stay; uncached
     remote placeholders under it become dead metadata, so the reference

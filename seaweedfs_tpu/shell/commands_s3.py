@@ -116,6 +116,106 @@ def s3_bucket_delete(env: CommandEnv, name: str,
     return {"deleted": name}
 
 
+def _bucket_usage_bytes(env: CommandEnv, name: str) -> int:
+    from .commands_fs import _size, _walk
+
+    total = 0
+    for e in _walk(env, f"{BUCKETS_DIR}/{name}"):
+        if not _is_dir(e):
+            total += _size(e)
+    return total
+
+
+def s3_bucket_quota(env: CommandEnv, name: str,
+                    quota_mb: int = -1) -> dict:
+    """Show or set a bucket's size quota
+    (command_s3_bucketquota.go): stored on the bucket entry; enforced
+    by s3.bucket.quota.enforce. -quotaMB=0 removes the quota."""
+    if not name:
+        raise ShellError("s3.bucket.quota needs -name")
+    from .commands_fs import _stat
+
+    path = f"{BUCKETS_DIR}/{name}"
+    meta = _stat(env, path)
+    ext = dict(meta.get("extended", {}))
+    if quota_mb < 0:
+        return {"bucket": name,
+                "quota_bytes": int(ext.get("s3_quota_bytes", 0)),
+                "used_bytes": _bucket_usage_bytes(env, name)}
+    env.confirm_locked()
+    if quota_mb == 0:
+        ext.pop("s3_quota_bytes", None)
+    else:
+        ext["s3_quota_bytes"] = str(quota_mb << 20)
+    meta["extended"] = ext
+    meta.pop("full_path", None)
+    r = requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
+                     timeout=30)
+    if r.status_code >= 300:
+        raise ShellError(f"s3.bucket.quota: {r.text}")
+    return {"bucket": name,
+            "quota_bytes": int(ext.get("s3_quota_bytes", 0))}
+
+
+def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
+    """Walk all buckets with quotas; mark a bucket's collection volumes
+    read-only when over quota and writable again when back under
+    (command_s3_bucketquota.go enforcement pass, run from the master
+    maintenance cron in the reference)."""
+    env.confirm_locked()
+    out = []
+    for b in s3_bucket_list(env):
+        name = b["name"]
+        from .commands_fs import _stat
+
+        ext = _stat(env, f"{BUCKETS_DIR}/{name}").get("extended", {})
+        quota = int(ext.get("s3_quota_bytes", 0) or 0)
+        if quota <= 0:
+            continue
+        used = _bucket_usage_bytes(env, name)
+        over = used > quota
+        # bucket objects are written into collection=<bucket>
+        touched = []
+        for n in env.data_nodes():
+            for vid in n["volumes"]:
+                if n.get("collections", {}).get(str(vid)) != name:
+                    continue
+                path = "/admin/mark_readonly" if over \
+                    else "/admin/mark_writable"
+                env.vs_post(n["url"], path, {"volume": vid})
+                touched.append(vid)
+        out.append({"bucket": name, "used": used, "quota": quota,
+                    "over": over, "volumes": sorted(set(touched))})
+    return out
+
+
+def s3_clean_uploads(env: CommandEnv,
+                     time_ago_seconds: int = 86400) -> list[str]:
+    """Abort multipart uploads older than -timeAgo
+    (command_s3_clean_uploads.go): removes stale .uploads/<id> dirs."""
+    env.confirm_locked()
+    import time as _time
+
+    from .commands_fs import _list as _ls
+
+    cutoff = _time.time() - time_ago_seconds
+    removed = []
+    for b in s3_bucket_list(env):
+        updir = f"{BUCKETS_DIR}/{b['name']}/.uploads"
+        try:
+            uploads = _ls(env, updir)
+        except ShellError:
+            continue
+        for u in uploads:
+            if u.get("mtime", 0) < cutoff:
+                full = u["full_path"]
+                requests.delete(f"{_filer(env)}{full}",
+                                params={"recursive": "true"},
+                                timeout=60)
+                removed.append(full)
+    return removed
+
+
 def s3_circuit_breaker(env: CommandEnv, global_conf: str = "",
                        bucket: str = "", bucket_conf: str = "",
                        delete: bool = False,

@@ -40,9 +40,13 @@ def ttl_pair_seconds(ttl) -> int:
 def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]:
     """Scan all volumes' garbage ratios; compact those above threshold,
     and destroy TTL volumes whose last write has expired
-    (topology_vacuum.go:216 Vacuum + volume TTL expiry)."""
+    (topology_vacuum.go:216 Vacuum + volume TTL expiry). Refuses to run
+    while vacuum is disabled cluster-wide (volume.vacuum.disable)."""
     import time as _time
 
+    if env.master_get("/cluster/status").get("VacuumDisabled"):
+        raise ShellError("vacuum is disabled cluster-wide "
+                         "(volume.vacuum.enable to re-enable)")
     done = []
     now = _time.time()
     nodes = env.data_nodes()  # one topology snapshot for both passes
@@ -489,6 +493,113 @@ def volume_tier_download(env: CommandEnv, vid: int) -> list[dict]:
                         {"volume": vid,
                          "deleteRemote": i == len(urls) - 1})
             for i, url in enumerate(urls)]
+
+
+def volume_configure_replication(env: CommandEnv, vid: int,
+                                 replication: str) -> list[dict]:
+    """Rewrite the replica placement in every replica's superblock
+    (command_volume_configure_replication.go). Takes effect on the next
+    heartbeat; volume.fix.replication then creates/removes copies to
+    match."""
+    env.confirm_locked()
+    ReplicaPlacement.parse(replication)  # validate before touching disks
+    urls = env.volume_locations(vid)
+    if not urls:
+        raise ShellError(f"volume {vid} not found")
+    return [{"server": u,
+             **env.vs_post(u, "/admin/volume_replication",
+                           {"volume": vid, "replication": replication})}
+            for u in urls]
+
+
+def volume_delete_empty(env: CommandEnv,
+                        quiet_for_seconds: int = 86400,
+                        force: bool = False) -> list[dict]:
+    """Delete volumes with no live files that have been quiet for
+    `quietFor` (command_volume_delete_empty.go). -force skips the
+    quiet-period check."""
+    env.confirm_locked()
+    import time as _time
+
+    now = _time.time()
+    deleted = []
+    for n in env.data_nodes():
+        # live counts come from the server's status report (the
+        # topology snapshot doesn't carry file counts)
+        resp = requests.get(f"http://{n['url']}/status", timeout=30)
+        vols = {v["id"]: v for v in resp.json().get("volumes", [])}
+        for vid in n["volumes"]:
+            v = vols.get(vid)
+            if v is None:
+                continue
+            live = v.get("file_count", 0) - v.get("delete_count", 0)
+            modified = v.get("modified_at", 0)
+            quiet = (now - modified) if modified else 0.0
+            if live <= 0 and (force or quiet >= quiet_for_seconds):
+                env.vs_post(n["url"], "/admin/delete_volume",
+                            {"volume": vid})
+                deleted.append({"volume": vid, "server": n["url"]})
+    return deleted
+
+
+def volume_server_leave(env: CommandEnv, server: str) -> dict:
+    """Ask one volume server to stop heartbeating and leave the cluster
+    (command_volume_server_leave.go); it keeps serving until shut
+    down."""
+    env.confirm_locked()
+    return env.vs_post(server, "/admin/leave", {})
+
+
+def volume_tier_move(env: CommandEnv, to_disk_type: str,
+                     collection: str = "",
+                     from_disk_type: str = "") -> list[dict]:
+    """Move volumes from servers of one disk type onto servers of
+    another (command_volume_tier_move.go): pick each matching volume,
+    copy it to the least-loaded target-tier server, delete the source
+    copy."""
+    env.confirm_locked()
+    nodes = env.data_nodes()
+    targets = [n for n in nodes if n.get("disk_type", "hdd")
+               == to_disk_type]
+    if not targets:
+        raise ShellError(f"no volume servers with disk type "
+                         f"{to_disk_type!r}")
+    moved = []
+    for n in nodes:
+        src_type = n.get("disk_type", "hdd")
+        if src_type == to_disk_type:
+            continue
+        if from_disk_type and src_type != from_disk_type:
+            continue
+        for vid in n["volumes"]:
+            if collection and \
+                    n.get("collections", {}).get(str(vid)) != collection:
+                continue
+            held = {t["url"] for t in targets
+                    if vid in t["volumes"]}
+            candidates = [t for t in targets
+                          if t["url"] not in held
+                          and len(t["volumes"]) < t["max_volumes"]]
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda t: len(t["volumes"]))
+            volume_move(env, vid, n["url"], target["url"])
+            target["volumes"].append(vid)
+            moved.append({"volume": vid, "from": n["url"],
+                          "to": target["url"],
+                          "tier": f"{src_type}->{to_disk_type}"})
+    return moved
+
+
+def volume_vacuum_toggle(env: CommandEnv, disable: bool) -> dict:
+    """volume.vacuum.disable / enable: master-side switch consulted by
+    the maintenance cron and the manual vacuum command."""
+    env.confirm_locked()
+    path = "/vol/vacuum/disable" if disable else "/vol/vacuum/enable"
+    resp = requests.post(f"{env.master_url}{path}", timeout=30)
+    if resp.status_code >= 300:
+        raise ShellError(f"{path}: {resp.text}")
+    return resp.json()
 
 
 def collection_list(env: CommandEnv) -> list[str]:

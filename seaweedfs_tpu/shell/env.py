@@ -23,6 +23,17 @@ class CommandEnv:
         self.filer_url = filer_url.rstrip("/")
         self.locked = False
         self._dlm = None
+        # fs.cd / fs.pwd working directory (commands.go option.directory)
+        self.cwd = "/"
+
+    def resolve(self, path: str) -> str:
+        """Resolve a possibly-relative shell path against fs.cd's cwd."""
+        import posixpath
+
+        if not path.startswith("/"):
+            path = posixpath.join(self.cwd, path)
+        norm = posixpath.normpath(path)
+        return norm if norm != "." else "/"
 
     ADMIN_LOCK = "admin"  # cluster-wide exclusive shell lock name
 

@@ -20,11 +20,18 @@ HELP = """commands:
   cluster.check                     cluster health summary
   cluster.ps                        list masters/filers/volume servers
   cluster.raft.ps                   raft peer status
+  cluster.raft.add -peer=H:P        add a master to the raft quorum
+  cluster.raft.remove -peer=H:P     remove a master from the quorum
   collection.list                   list collections
   collection.delete <name>          delete all volumes of a collection
   volume.list                       list volumes and ec shards
   volume.grow [-count=1] [-collection=] [-replication=]
   volume.vacuum [-threshold=0.3]    compact garbage-heavy volumes
+  volume.vacuum.disable/.enable     toggle vacuum cluster-wide
+  volume.configure.replication -volumeId=N -replication=xyz
+  volume.deleteEmpty [-quietFor=86400] [-force]
+  volume.server.leave -server=H     stop a server's heartbeats
+  volume.tier.move -toDiskType=ssd [-fromDiskType=] [-collection=]
   volume.balance                    even out volume counts
   volume.fix.replication            re-replicate under-replicated volumes
   volume.copy -volumeId=N -source=H -target=H
@@ -41,6 +48,7 @@ HELP = """commands:
   ec.rebuild -volumeId=N            rebuild missing shards
   ec.balance                        even out shard counts
   ec.decode -volumeId=N             decode shards back to a volume
+  fs.cd <dir> / fs.pwd              shell working directory
   fs.ls [-l] <dir>                  list a filer directory
   fs.cat <file>                     print file contents
   fs.du <dir>                       recursive usage
@@ -50,11 +58,16 @@ HELP = """commands:
   fs.mv <src> <dst>                 rename/move
   fs.meta.save <dir> <out.jsonl>    snapshot metadata
   fs.meta.load <in.jsonl>           restore metadata
+  fs.meta.cat <path>                print one entry's stored metadata
+  fs.meta.notify <dir>              re-publish events to notifications
+  fs.meta.changeVolumeId <dir> -mapping=old:new[,..] [-apply]
+  mount.configure -dir=/d -quotaMB=N   per-mount quota (0 clears)
   fs.verify <dir>                   check chunks are readable
   fs.configure [-locationPrefix=/p -collection=C -ttl=1d -readOnly=true
                 -replication=001 -maxFileNameLength=N -delete -apply]
   remote.configure [-name=X -type=s3|local ...] [-delete]
   remote.mount [-dir=/d -remote=storage/prefix]
+  remote.mount.buckets -remote=storage [-bucketPattern=glob]
   remote.unmount -dir=/d
   remote.meta.sync -dir=/d          pull remote listing into metadata
   remote.cache -dir=/d              materialise remote files locally
@@ -63,6 +76,9 @@ HELP = """commands:
                 -actions=Read,Write -delete -apply]
   s3.bucket.list / s3.bucket.create -name=B
   s3.bucket.delete -name=B [-includeObjects]
+  s3.bucket.quota -name=B [-quotaMB=N]   show/set quota (0 clears)
+  s3.bucket.quota.enforce           mark over-quota buckets read-only
+  s3.clean.uploads [-timeAgo=86400] abort stale multipart uploads
   s3.circuit.breaker [-global='{"writeCount":32}'
                       -bucket=B -bucketConf='{...}' -delete -apply]
   mq.topic.list                     list message-queue topics
@@ -109,6 +125,12 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_cluster.cluster_ps(env)
     if cmd == "cluster.raft.ps":
         return commands_cluster.cluster_raft_ps(env)
+    if cmd == "cluster.raft.add":
+        return commands_cluster.cluster_raft_change(
+            env, opts.get("peer", ""), add=True)
+    if cmd == "cluster.raft.remove":
+        return commands_cluster.cluster_raft_change(
+            env, opts.get("peer", ""), add=False)
     if cmd == "collection.list":
         return commands_volume.collection_list(env)
     if cmd == "collection.delete":
@@ -124,6 +146,23 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "volume.vacuum":
         return commands_volume.volume_vacuum(
             env, float(opts.get("threshold", 0.3)))
+    if cmd == "volume.vacuum.disable":
+        return commands_volume.volume_vacuum_toggle(env, disable=True)
+    if cmd == "volume.vacuum.enable":
+        return commands_volume.volume_vacuum_toggle(env, disable=False)
+    if cmd == "volume.configure.replication":
+        return commands_volume.volume_configure_replication(
+            env, int(opts["volumeId"]), opts.get("replication", ""))
+    if cmd == "volume.deleteEmpty":
+        return commands_volume.volume_delete_empty(
+            env, quiet_for_seconds=int(opts.get("quietFor", "86400")),
+            force="force" in opts)
+    if cmd == "volume.server.leave":
+        return commands_volume.volume_server_leave(env, opts["server"])
+    if cmd == "volume.tier.move":
+        return commands_volume.volume_tier_move(
+            env, opts["toDiskType"], opts.get("collection", ""),
+            opts.get("fromDiskType", ""))
     if cmd == "volume.balance":
         return commands_volume.volume_balance(env)
     if cmd == "volume.fix.replication":
@@ -173,30 +212,51 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_ec.ec_decode(env, int(opts["volumeId"]),
                                      opts.get("collection", ""))
     # -- filesystem -----------------------------------------------------
+    def rarg(i: int, default: str | None = None) -> str:
+        # fs paths resolve against the fs.cd working directory
+        return env.resolve(arg(i, default))
+
+    if cmd == "fs.cd":
+        return commands_fs.fs_cd(env, arg(0, "/"))
+    if cmd == "fs.pwd":
+        return commands_fs.fs_pwd(env)
     if cmd == "fs.ls":
-        return commands_fs.fs_ls(env, arg(0, "/"), long="l" in opts)
+        return commands_fs.fs_ls(env, rarg(0, "."), long="l" in opts)
     if cmd == "fs.cat":
-        return commands_fs.fs_cat(env, arg(0)).decode(errors="replace")
+        return commands_fs.fs_cat(env, rarg(0)).decode(errors="replace")
     if cmd == "fs.du":
-        return commands_fs.fs_du(env, arg(0, "/"))
+        return commands_fs.fs_du(env, rarg(0, "."))
     if cmd == "fs.tree":
-        return "\n".join(commands_fs.fs_tree(env, arg(0, "/")))
+        return "\n".join(commands_fs.fs_tree(env, rarg(0, ".")))
     if cmd == "fs.mkdir":
-        return commands_fs.fs_mkdir(env, arg(0))
+        return commands_fs.fs_mkdir(env, rarg(0))
     if cmd == "fs.rm":
-        commands_fs.fs_rm(env, arg(0), recursive="r" in opts)
+        commands_fs.fs_rm(env, rarg(0), recursive="r" in opts)
         return "removed"
     if cmd == "fs.mv":
-        commands_fs.fs_mv(env, arg(0), arg(1))
+        commands_fs.fs_mv(env, rarg(0), rarg(1))
         return "moved"
     if cmd == "fs.meta.save":
-        n = commands_fs.fs_meta_save(env, arg(0, "/"), arg(1, "meta.jsonl"))
+        n = commands_fs.fs_meta_save(env, rarg(0, "."),
+                                     arg(1, "meta.jsonl"))
         return f"saved {n} entries"
     if cmd == "fs.meta.load":
         n = commands_fs.fs_meta_load(env, arg(0))
         return f"loaded {n} entries"
+    if cmd == "fs.meta.cat":
+        return commands_fs.fs_meta_cat(env, rarg(0))
+    if cmd == "fs.meta.notify":
+        return commands_fs.fs_meta_notify(env, rarg(0, "."))
+    if cmd == "fs.meta.changeVolumeId":
+        return commands_fs.fs_meta_change_volume_id(
+            env, rarg(0, "."), opts.get("mapping", ""),
+            apply="apply" in opts or "force" in opts)
     if cmd == "fs.verify":
-        return commands_fs.fs_verify(env, arg(0, "/"))
+        return commands_fs.fs_verify(env, rarg(0, "."))
+    if cmd == "mount.configure":
+        return commands_fs.mount_configure(
+            env, opts.get("dir", ""),
+            int(opts.get("quotaMB", "-1")))
     if cmd == "fs.configure":
         return commands_fs.fs_configure(
             env, opts.pop("locationPrefix", ""),
@@ -211,6 +271,10 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "remote.mount":
         return commands_remote.remote_mount(
             env, opts.get("dir", ""), opts.get("remote", ""))
+    if cmd == "remote.mount.buckets":
+        return commands_remote.remote_mount_buckets(
+            env, opts.get("remote", ""),
+            opts.get("bucketPattern", ""))
     if cmd == "remote.unmount":
         return commands_remote.remote_unmount(env, opts["dir"])
     if cmd == "remote.meta.sync":
@@ -237,6 +301,15 @@ def run_command(env: CommandEnv, line: str) -> object:
         return commands_s3.s3_bucket_delete(
             env, opts.get("name") or arg(0, ""),
             include_objects=opts.get("includeObjects", "") == "true")
+    if cmd == "s3.bucket.quota":
+        return commands_s3.s3_bucket_quota(
+            env, opts.get("name") or arg(0, ""),
+            quota_mb=int(opts.get("quotaMB", "-1")))
+    if cmd == "s3.bucket.quota.enforce":
+        return commands_s3.s3_bucket_quota_enforce(env)
+    if cmd == "s3.clean.uploads":
+        return commands_s3.s3_clean_uploads(
+            env, time_ago_seconds=int(opts.get("timeAgo", "86400")))
     if cmd == "s3.circuit.breaker":
         return commands_s3.s3_circuit_breaker(
             env, global_conf=opts.get("global", ""),

@@ -234,6 +234,34 @@ class TestLifecycle:
         got = requests.get(f"{s3}/lc4?lifecycle").text
         assert "tmp/" in got and "logs/" not in got
 
+    def test_lifecycle_preserves_other_conf_fields(self, s3, cluster):
+        # an fs.configure rule carrying replication AND ttl must keep
+        # its replication when S3 lifecycle PUT/DELETE touches the ttl
+        import json as _json
+        requests.put(f"{s3}/lc6")
+        conf = {"rules": [{"location_prefix": "/buckets/lc6/logs/",
+                           "ttl": "30d", "replication": "001"}]}
+        requests.put(f"{cluster.filer_url}/kv/filer.conf",
+                     data=_json.dumps(conf))
+        body = ("<LifecycleConfiguration><Rule>"
+                "<Status>Enabled</Status>"
+                "<Filter><Prefix>logs/</Prefix></Filter>"
+                "<Expiration><Days>7</Days></Expiration>"
+                "</Rule></LifecycleConfiguration>")
+        assert requests.put(f"{s3}/lc6?lifecycle",
+                            data=body).status_code == 200
+        rules = _json.loads(requests.get(
+            f"{cluster.filer_url}/kv/filer.conf").content)["rules"]
+        r = next(r for r in rules
+                 if r["location_prefix"] == "/buckets/lc6/logs/")
+        assert r["ttl"] == "7d" and r["replication"] == "001"
+        assert requests.delete(f"{s3}/lc6?lifecycle").status_code == 204
+        rules = _json.loads(requests.get(
+            f"{cluster.filer_url}/kv/filer.conf").content)["rules"]
+        r = next(r for r in rules
+                 if r["location_prefix"] == "/buckets/lc6/logs/")
+        assert r["ttl"] == "" and r["replication"] == "001"
+
     def test_subday_ttl_rules_do_not_surface(self, s3, cluster):
         # an operator fs.configure TTL of 12h is below lifecycle's
         # day granularity: GET must say NoSuchLifecycleConfiguration,

@@ -1,0 +1,312 @@
+"""Shell commands closing the registry gap with the reference:
+fs.cd/pwd, fs.meta.cat/changeVolumeId/notify, mount.configure,
+volume.configure.replication / deleteEmpty / server.leave / tier.move /
+vacuum.disable, cluster.raft.add/remove, s3.bucket.quota(.enforce),
+s3.clean.uploads, remote.mount.buckets (weed/shell command registry,
+SURVEY.md section 2.9).
+"""
+import json
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell import (commands_fs, commands_remote,
+                                 commands_s3, commands_volume, repl)
+from seaweedfs_tpu.shell.env import CommandEnv, ShellError
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("shell_ext")),
+                n_volume_servers=2, volume_size_limit=4 << 20,
+                max_volumes=40, with_filer=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    e = CommandEnv(cluster.master_url, filer_url=cluster.filer_url)
+    e.acquire_lock()
+    yield e
+    e.close()
+
+
+def put(cluster, path: str, data: bytes) -> None:
+    r = requests.post(f"{cluster.filer_url}{path}", data=data)
+    assert r.status_code < 300, (path, r.status_code)
+
+
+class TestFsCdPwd:
+    def test_cd_pwd_relative_resolution(self, cluster, env):
+        put(cluster, "/wd/a/f.txt", b"rel")
+        assert commands_fs.fs_pwd(env) == "/"
+        assert commands_fs.fs_cd(env, "/wd") == "/wd"
+        assert commands_fs.fs_pwd(env) == "/wd"
+        # relative paths resolve under the cwd through the repl
+        out = repl.run_command(env, "fs.cat a/f.txt")
+        assert out == "rel"
+        assert repl.run_command(env, "fs.pwd") == "/wd"
+        repl.run_command(env, "fs.cd a")
+        assert env.cwd == "/wd/a"
+        repl.run_command(env, "fs.cd ..")
+        assert env.cwd == "/wd"
+        repl.run_command(env, "fs.cd /")
+        assert env.cwd == "/"
+
+    def test_cd_to_file_fails(self, cluster, env):
+        with pytest.raises(ShellError):
+            commands_fs.fs_cd(env, "/wd/a/f.txt")
+
+
+class TestFsMetaExt:
+    def test_meta_cat(self, cluster, env):
+        put(cluster, "/mc/x.bin", b"y" * 100)
+        meta = commands_fs.fs_meta_cat(env, "/mc/x.bin")
+        assert meta["chunks"] and meta["chunks"][0]["size"] == 100
+
+    def test_change_volume_id_dry_and_apply(self, cluster, env):
+        put(cluster, "/cv/f.bin", b"data here")
+        meta = commands_fs.fs_meta_cat(env, "/cv/f.bin")
+        old_vid = int(meta["chunks"][0]["fid"].partition(",")[0])
+        new_vid = old_vid + 100
+        dry = commands_fs.fs_meta_change_volume_id(
+            env, "/cv", f"{old_vid}:{new_vid}")
+        assert dry["entries_rewritten"] == 1 and not dry["applied"]
+        # dry run didn't touch anything
+        meta2 = commands_fs.fs_meta_cat(env, "/cv/f.bin")
+        assert meta2["chunks"][0]["fid"].startswith(f"{old_vid},")
+        commands_fs.fs_meta_change_volume_id(
+            env, "/cv", f"{old_vid}:{new_vid}", apply=True)
+        meta3 = commands_fs.fs_meta_cat(env, "/cv/f.bin")
+        assert meta3["chunks"][0]["fid"].startswith(f"{new_vid},")
+        # map back so the file stays readable for other tests
+        commands_fs.fs_meta_change_volume_id(
+            env, "/cv", f"{new_vid}:{old_vid}", apply=True)
+
+    def test_bad_mapping_rejected(self, env):
+        with pytest.raises(ShellError):
+            commands_fs.fs_meta_change_volume_id(env, "/", "abc")
+
+    def test_meta_notify_to_log_queue(self, cluster, env, tmp_path):
+        log_path = str(tmp_path / "events.jsonl")
+        requests.put(f"{cluster.filer_url}/kv/notification.conf",
+                     data=json.dumps({"kind": "log", "path": log_path}))
+        put(cluster, "/nt/one.txt", b"1")
+        put(cluster, "/nt/two.txt", b"2")
+        out = commands_fs.fs_meta_notify(env, "/nt")
+        assert out["notified"] == 2
+        lines = [json.loads(l) for l in open(log_path)]
+        keys = {l["key"] for l in lines}
+        assert keys == {"/nt/one.txt", "/nt/two.txt"}
+
+
+class TestMountConfigure:
+    def test_quota_round_trip(self, cluster, env):
+        conf = commands_fs.mount_configure(env, dir="/wd", quota_mb=5)
+        assert conf["/wd"]["quota_bytes"] == 5 << 20
+        assert commands_fs.mount_configure(env)["/wd"]
+        conf = commands_fs.mount_configure(env, dir="/wd", quota_mb=0)
+        assert "/wd" not in conf
+
+
+class TestVolumeExt:
+    def test_configure_replication(self, cluster, env):
+        put(cluster, "/vr/f.txt", b"x" * 50)
+        meta = commands_fs.fs_meta_cat(env, "/vr/f.txt")
+        vid = int(meta["chunks"][0]["fid"].partition(",")[0])
+        out = commands_volume.volume_configure_replication(env, vid,
+                                                           "001")
+        assert all(r["replication"] == "001" for r in out)
+        # survives a reload: verify via the volume server status page
+        out2 = commands_volume.volume_configure_replication(env, vid,
+                                                            "000")
+        assert all(r["replication"] == "000" for r in out2)
+
+    def test_bad_replication_rejected(self, env):
+        with pytest.raises(ValueError):
+            commands_volume.volume_configure_replication(env, 1, "9z")
+
+    def test_delete_empty(self, cluster, env):
+        # grow a fresh collection volume, never write to it
+        commands_volume.volume_grow(env, count=1, collection="emptycol")
+        before = {v["volume"] for v in commands_volume.volume_list(env)
+                  if v.get("server")}
+        deleted = commands_volume.volume_delete_empty(env, force=True)
+        assert deleted  # at least the fresh empty volume went away
+        for d in deleted:
+            assert d["volume"] in before
+
+    def test_vacuum_toggle(self, cluster, env):
+        out = commands_volume.volume_vacuum_toggle(env, disable=True)
+        assert out["vacuum_disabled"] is True
+        status = env.master_get("/cluster/status")
+        assert status["VacuumDisabled"] is True
+        # manual vacuum honors the switch too
+        with pytest.raises(ShellError, match="disabled"):
+            commands_volume.volume_vacuum(env)
+        out = commands_volume.volume_vacuum_toggle(env, disable=False)
+        assert out["vacuum_disabled"] is False
+        commands_volume.volume_vacuum(env)  # runs again
+
+    def test_dispatch_new_commands(self, cluster, env):
+        assert repl.run_command(env, "volume.vacuum.enable")[
+            "vacuum_disabled"] is False
+        assert isinstance(
+            repl.run_command(env, "volume.deleteEmpty -force"), list)
+
+
+class TestServerLeave:
+    def test_leave_removes_from_topology(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("leave")),
+                    n_volume_servers=2, volume_size_limit=4 << 20,
+                    with_filer=False)
+        try:
+            e = CommandEnv(c.master_url)
+            e.acquire_lock()
+            nodes = e.data_nodes()
+            assert len(nodes) == 2
+            victim = nodes[0]["url"]
+            out = commands_volume.volume_server_leave(e, victim)
+            assert out.get("left")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                left = {n["url"] for n in e.data_nodes()}
+                if victim not in left:
+                    break
+                time.sleep(0.2)
+            assert victim not in {n["url"] for n in e.data_nodes()}
+        finally:
+            c.stop()
+
+
+class TestS3QuotaAndUploads:
+    def test_bucket_quota_set_and_enforce(self, cluster, env):
+        requests.post(f"{cluster.filer_url}/buckets/qb/",
+                      params={"mkdir": "1"})
+        # objects in collection "qb"
+        r = requests.post(f"{cluster.filer_url}/buckets/qb/big.bin",
+                          params={"collection": "qb"},
+                          data=b"z" * (1 << 20))
+        assert r.status_code < 300
+        out = commands_s3.s3_bucket_quota(env, "qb", quota_mb=0)
+        commands_s3.s3_bucket_quota(env, "qb", quota_mb=1)
+        info = commands_s3.s3_bucket_quota(env, "qb")
+        assert info["quota_bytes"] == 1 << 20
+        assert info["used_bytes"] == 1 << 20
+
+        # push over quota and enforce -> volumes readonly
+        requests.post(f"{cluster.filer_url}/buckets/qb/more.bin",
+                      params={"collection": "qb"}, data=b"z" * 4096)
+        res = commands_s3.s3_bucket_quota_enforce(env)
+        rec = next(r for r in res if r["bucket"] == "qb")
+        assert rec["over"] and rec["volumes"]
+
+        # drop quota -> writable again
+        commands_s3.s3_bucket_quota(env, "qb", quota_mb=100)
+        res = commands_s3.s3_bucket_quota_enforce(env)
+        rec = next(r for r in res if r["bucket"] == "qb")
+        assert not rec["over"]
+
+    def test_clean_uploads(self, cluster, env):
+        requests.post(f"{cluster.filer_url}/buckets/ub/",
+                      params={"mkdir": "1"})
+        requests.post(
+            f"{cluster.filer_url}/buckets/ub/.uploads/stale123/",
+            params={"mkdir": "1"})
+        removed = commands_s3.s3_clean_uploads(env, time_ago_seconds=-5)
+        assert any("stale123" in p for p in removed)
+        listing = requests.get(
+            f"{cluster.filer_url}/buckets/ub/.uploads/",
+            headers={"Accept": "application/json"})
+        names = [e["full_path"] for e in
+                 (listing.json().get("entries", [])
+                  if listing.status_code == 200 else [])]
+        assert not any("stale123" in n for n in names)
+
+
+class TestRemoteMountBuckets:
+    def test_mount_all_buckets(self, cluster, env, tmp_path):
+        root = tmp_path / "remote_root"
+        for b in ("alpha", "beta", "gamma"):
+            (root / b).mkdir(parents=True)
+            (root / b / "obj.txt").write_text(f"in {b}")
+        commands_remote.remote_configure(env, "store1", type="local",
+                                         root=str(root))
+        out = commands_remote.remote_mount_buckets(env, "store1")
+        assert set(out["mounted"]) == {"alpha", "beta", "gamma"}
+        # mounted buckets are browsable through the filer
+        got = commands_fs.fs_cat(env, "/buckets/alpha/obj.txt")
+        assert got == b"in alpha"
+
+    def test_pattern_filter(self, cluster, env, tmp_path):
+        root = tmp_path / "remote_root2"
+        for b in ("red", "green", "greed"):
+            (root / b).mkdir(parents=True)
+        commands_remote.remote_configure(env, "store2", type="local",
+                                         root=str(root))
+        out = commands_remote.remote_mount_buckets(
+            env, "store2", bucket_pattern="gre*")
+        assert set(out["mounted"]) == {"green", "greed"}
+
+
+class TestRaftMembership:
+    def test_add_remove_peer_round_trip(self, tmp_path_factory):
+        import socket
+
+        from seaweedfs_tpu.server.cluster import ServerThread
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.shell import commands_cluster
+
+        base = tmp_path_factory.mktemp("raft_m")
+        socks, ports = [], []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        masters = [MasterServer(pulse_seconds=0.4, me=me, peers=peers,
+                                raft_state_dir=str(base), raft_tick=0.6)
+                   for me in peers]
+        threads = [ServerThread(m.app, port=p).start()
+                   for m, p in zip(masters, ports)]
+        try:
+            leader = None
+            deadline = time.time() + 20
+            while time.time() < deadline and leader is None:
+                for p in peers:
+                    try:
+                        st = requests.get(f"http://{p}/raft/status",
+                                          timeout=2).json()
+                        if st["state"] == "leader":
+                            leader = p
+                    except Exception:
+                        pass
+                time.sleep(0.1)
+            assert leader, "no leader elected"
+            e = CommandEnv(f"http://{leader}")
+            e.locked = True  # no filer DLM in this fixture
+            out = commands_cluster.cluster_raft_change(
+                e, "127.0.0.1:59999", add=True)
+            assert "127.0.0.1:59999" in out["peers"]
+            # the change replicated to followers
+            follower = next(p for p in peers if p != leader)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                st = requests.get(f"http://{follower}/raft/status",
+                                  timeout=2).json()
+                if "127.0.0.1:59999" in st["peers"]:
+                    break
+                time.sleep(0.1)
+            assert "127.0.0.1:59999" in st["peers"]
+            out = commands_cluster.cluster_raft_change(
+                e, "127.0.0.1:59999", add=False)
+            assert "127.0.0.1:59999" not in out["peers"]
+        finally:
+            for t in threads:
+                t.stop()
