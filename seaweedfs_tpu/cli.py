@@ -120,6 +120,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-path", default="/")
     p.add_argument("-o", dest="output", default="filer_meta_backup.db")
 
+    p = sub.add_parser("filer.backup",
+                       help="continuous file backup into a local dir "
+                            "(filer.replicate with a local sink)")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-path", default="/", help="source path prefix")
+    p.add_argument("-dir", required=True, help="local target directory")
+
+    p = sub.add_parser("filer.meta.tail",
+                       help="print the filer metadata event stream")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-path", default="/", help="path prefix filter")
+    p.add_argument("-pattern", default="",
+                   help="only events whose path contains this substring")
+
     p = sub.add_parser("mq.broker", help="start a message-queue broker")
     p.add_argument("-port", type=int, default=17777)
     p.add_argument("-ip", default="127.0.0.1")
@@ -330,6 +344,43 @@ def _dispatch(args) -> int:
                 _t.sleep(3600)
         except KeyboardInterrupt:
             w.stop()
+        return 0
+    if args.cmd == "filer.backup":
+        import time as _t
+
+        from .replication.replicator import Replicator
+        from .replication.sink import LocalSink
+
+        r = Replicator(args.filer, LocalSink(args.dir),
+                       path_prefix=args.path)
+        r.start()
+        print(f"backing up {args.filer}{args.path} -> {args.dir}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            r.stop()
+        return 0
+    if args.cmd == "filer.meta.tail":
+        import json as _json
+        import time as _t
+
+        from .rpc.meta_subscriber import MetaSubscriber
+
+        def emit(ev: dict) -> None:
+            entry = ev.get("new_entry") or ev.get("old_entry") or {}
+            path = entry.get("full_path") or ev.get("directory", "")
+            if args.pattern and args.pattern not in path:
+                return
+            print(_json.dumps(ev, separators=(",", ":")), flush=True)
+
+        sub_ = MetaSubscriber(args.filer, args.path, emit)
+        sub_.start()
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            sub_.stop()
         return 0
     if args.cmd == "filer.meta.backup":
         import time as _t

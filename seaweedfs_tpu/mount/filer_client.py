@@ -40,6 +40,10 @@ class FilerClient:
         self._stop = threading.Event()
 
     # -- entries --------------------------------------------------------
+    def kv_get(self, key: str) -> bytes | None:
+        r = requests.get(f"{self.filer_url}/kv/{key}", timeout=30)
+        return r.content if r.status_code == 200 else None
+
     def lookup_entry(self, path: str) -> Entry | None:
         r = requests.get(f"{self.filer_url}{path}", params={"meta": "1"},
                          timeout=30)

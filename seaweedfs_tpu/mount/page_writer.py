@@ -81,9 +81,13 @@ class DirtyPages:
         self._owns_pipeline = pipeline is None
         self._lock = threading.Lock()
         self._mtime_ns = 0
+        # upper bound of bytes this handle has buffered/uploaded since
+        # the last flush (rewrites double-count) — quota accounting
+        self.written_bytes = 0
 
     def write(self, offset: int, data: bytes) -> None:
         with self._lock:
+            self.written_bytes += len(data)
             pos = 0
             while pos < len(data):
                 idx = (offset + pos) // self.chunk_size

@@ -15,6 +15,7 @@ writer observes its own writes before flush.
 """
 from __future__ import annotations
 
+import errno
 import os
 import stat
 import threading
@@ -76,6 +77,15 @@ class WeedFS:
                 self.client.mkdir(self.root)
             except Exception:
                 pass
+        # per-mount quota from the shell's mount.configure
+        # (command_mount_configure.go): refreshed with the usage cache
+        self.quota_bytes = 0
+        self._usage_cache: tuple[float, int] = (-1e18, 0)
+        self.quota_refresh_seconds = 15.0
+        try:
+            self._refresh_quota()
+        except Exception:
+            pass  # filer hiccup must not abort mounting; retried on use
         if subscribe:
             self.client.subscribe_meta(self.root or "/",
                                        self._on_meta_event)
@@ -309,8 +319,60 @@ class WeedFS:
     # ------------------------------------------------------------------
     # io
     # ------------------------------------------------------------------
+    def _refresh_quota(self) -> None:
+        import json as _json
+
+        raw = self.client.kv_get("mount.conf")
+        conf = _json.loads(raw) if raw else {}
+        mount_dir = self.root or "/"
+        self.quota_bytes = int(
+            conf.get(mount_dir, {}).get("quota_bytes", 0))
+
+    def _du(self, path: str) -> int:
+        total = 0
+        for e in self.client.list_dir(path):
+            if e.is_directory:
+                total += self._du(e.full_path)
+            else:
+                total += total_size(e.chunks)
+        return total
+
+    def _check_quota(self, incoming: int) -> None:
+        """EDQUOT when the mount is over its configured quota
+        (weedfs_quota.go maybeCheckQuota): usage is the filer's view
+        refreshed periodically, plus bytes buffered in open handles.
+        The config is re-read on the same cadence even when no quota is
+        currently set, so mount.configure takes effect on live mounts;
+        refresh errors keep the previous view (fail open) — a filer
+        hiccup must not fail writes that never depended on it."""
+        now = time.monotonic()
+        ts, usage = self._usage_cache
+        if now - ts > self.quota_refresh_seconds:
+            try:
+                self._refresh_quota()
+                usage = self._du(self.root or "/") \
+                    if self.quota_bytes else 0
+                # flushed handles are in the filer's usage now; only
+                # keep counting what is still dirty
+                with self._lock:
+                    for h in self._handles.values():
+                        if not h.dirty.has_dirty():
+                            h.dirty.written_bytes = 0
+            except Exception:
+                pass  # keep the stale view; retried next window
+            self._usage_cache = (now, usage)
+        if not self.quota_bytes:
+            return
+        with self._lock:
+            buffered = sum(h.dirty.written_bytes
+                           for h in self._handles.values())
+        if usage + buffered + incoming > self.quota_bytes:
+            raise FuseError(errno.EDQUOT,
+                            f"quota {self.quota_bytes} exceeded")
+
     def write(self, fh: int, offset: int, data: bytes) -> int:
         h = self._handle(fh)
+        self._check_quota(len(data))
         h.dirty.write(offset, data)
         return len(data)
 

@@ -144,11 +144,16 @@ class MasterServer:
 
     def _on_raft_apply(self, cmd: dict) -> None:
         """Committed raft entries drive the topology's volume-id
-        high-water mark on every master (raft_server.go:72)."""
+        high-water mark on every master (raft_server.go:72); the
+        cluster-wide vacuum switch rides the same log so every master
+        answers /cluster/status consistently and the setting survives
+        leader failover."""
         if cmd.get("op") == "max_volume_id":
             with self.topo.lock:
                 self.topo.max_volume_id = max(self.topo.max_volume_id,
                                               int(cmd["value"]))
+        elif cmd.get("op") == "vacuum_disabled":
+            self.vacuum_disabled = bool(cmd["value"])
 
     def _leader_redirect(self, req: web.Request) -> web.Response | None:
         """Leader proxy for control verbs (master_server.go:219): a
@@ -470,7 +475,15 @@ class MasterServer:
         redirect = self._leader_redirect(req)
         if redirect is not None:
             return redirect
-        self.vacuum_disabled = req.path.endswith("/disable")
+        disabled = req.path.endswith("/disable")
+        if self.raft is not None:
+            ok = await self.raft.propose(
+                {"op": "vacuum_disabled", "value": disabled})
+            if not ok:
+                return json_error("vacuum toggle did not commit "
+                                  "(no quorum)", status=503)
+        else:
+            self.vacuum_disabled = disabled
         return json_ok({"vacuum_disabled": self.vacuum_disabled})
 
     async def handle_raft_membership(self, req: web.Request) -> web.Response:

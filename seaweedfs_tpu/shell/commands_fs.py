@@ -232,7 +232,14 @@ def mount_configure(env: CommandEnv, dir: str = "",
     metadata events. -quotaMB=0 clears the quota."""
     key = "mount.conf"
     resp = requests.get(f"{_filer(env)}/kv/{key}", timeout=30)
-    conf = json.loads(resp.content) if resp.status_code == 200 else {}
+    if resp.status_code == 200:
+        conf = json.loads(resp.content)
+    elif resp.status_code == 404:
+        conf = {}
+    else:
+        # a transient filer error must not read as "empty config" and
+        # then wipe every other mount's quota on the write-back
+        raise ShellError(f"read {key}: http {resp.status_code}")
     if not dir:
         return conf
     env.confirm_locked()

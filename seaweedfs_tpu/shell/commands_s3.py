@@ -158,32 +158,47 @@ def s3_bucket_quota(env: CommandEnv, name: str,
 
 
 def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
-    """Walk all buckets with quotas; mark a bucket's collection volumes
-    read-only when over quota and writable again when back under
+    """Walk the buckets; mark a bucket's collection volumes read-only
+    when over quota and writable again when back under — including
+    buckets whose quota was since removed, tracked by an
+    `s3_quota_enforced` latch on the bucket entry so clearing a quota
+    releases the volumes instead of leaving them read-only forever
     (command_s3_bucketquota.go enforcement pass, run from the master
     maintenance cron in the reference)."""
     env.confirm_locked()
+    from .commands_fs import _stat
+
     out = []
     for b in s3_bucket_list(env):
         name = b["name"]
-        from .commands_fs import _stat
-
-        ext = _stat(env, f"{BUCKETS_DIR}/{name}").get("extended", {})
+        path = f"{BUCKETS_DIR}/{name}"
+        meta = _stat(env, path)
+        ext = dict(meta.get("extended", {}))
         quota = int(ext.get("s3_quota_bytes", 0) or 0)
-        if quota <= 0:
+        latched = ext.get("s3_quota_enforced") == "true"
+        if quota <= 0 and not latched:
             continue
-        used = _bucket_usage_bytes(env, name)
-        over = used > quota
+        used = _bucket_usage_bytes(env, name) if quota > 0 else 0
+        over = quota > 0 and used > quota
         # bucket objects are written into collection=<bucket>
         touched = []
         for n in env.data_nodes():
             for vid in n["volumes"]:
                 if n.get("collections", {}).get(str(vid)) != name:
                     continue
-                path = "/admin/mark_readonly" if over \
+                vs_path = "/admin/mark_readonly" if over \
                     else "/admin/mark_writable"
-                env.vs_post(n["url"], path, {"volume": vid})
+                env.vs_post(n["url"], vs_path, {"volume": vid})
                 touched.append(vid)
+        if over != latched:
+            if over:
+                ext["s3_quota_enforced"] = "true"
+            else:
+                ext.pop("s3_quota_enforced", None)
+            meta["extended"] = ext
+            meta.pop("full_path", None)
+            requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
+                         timeout=30)
         out.append({"bucket": name, "used": used, "quota": quota,
                     "over": over, "volumes": sorted(set(touched))})
     return out

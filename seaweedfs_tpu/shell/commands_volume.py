@@ -534,7 +534,9 @@ def volume_delete_empty(env: CommandEnv,
                 continue
             live = v.get("file_count", 0) - v.get("delete_count", 0)
             modified = v.get("modified_at", 0)
-            quiet = (now - modified) if modified else 0.0
+            # never-written volumes (modified_at 0) have been quiet
+            # since creation — the primary target of this command
+            quiet = (now - modified) if modified else float("inf")
             if live <= 0 and (force or quiet >= quiet_for_seconds):
                 env.vs_post(n["url"], "/admin/delete_volume",
                             {"volume": vid})

@@ -1,0 +1,152 @@
+"""Mount quota enforcement (mount.configure -> EDQUOT on writes) and
+the filer.backup / filer.meta.tail CLI verbs built on the replication
+and metadata-subscription substrate."""
+import errno
+import json
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("quota")),
+                n_volume_servers=1, with_filer=True)
+    c.wait_for_nodes(1)
+    yield c
+    c.stop()
+
+
+class TestMountQuota:
+    def test_writes_blocked_over_quota(self, cluster, tmp_path):
+        from seaweedfs_tpu.mount.weedfs import FuseError, WeedFS
+
+        requests.put(f"{cluster.filer_url}/kv/mount.conf",
+                     data=json.dumps(
+                         {"/q1": {"quota_bytes": 4096}}))
+        fs = WeedFS(cluster.filer_url, master_url=cluster.master_url,
+                    root="/q1", chunk_size=256,
+                    cache_dir=str(tmp_path / "c1"), subscribe=False)
+        try:
+            assert fs.quota_bytes == 4096
+            fh = fs.create("/small.bin")
+            fs.write(fh, 0, b"x" * 1024)  # within quota
+            with pytest.raises(FuseError) as ei:
+                fs.write(fh, 1024, b"y" * 8192)  # would exceed
+            assert ei.value.args[0] == errno.EDQUOT
+            fs.release(fh)
+        finally:
+            fs.destroy()
+
+    def test_quota_set_after_mount_takes_effect(self, cluster,
+                                                tmp_path):
+        from seaweedfs_tpu.mount.weedfs import FuseError, WeedFS
+
+        fs = WeedFS(cluster.filer_url, master_url=cluster.master_url,
+                    root="/q4", chunk_size=256,
+                    cache_dir=str(tmp_path / "c4"), subscribe=False)
+        try:
+            assert fs.quota_bytes == 0
+            fh = fs.create("/pre.bin")
+            fs.write(fh, 0, b"p" * 512)
+            fs.release(fh)
+            # quota configured while the mount is live
+            requests.put(f"{cluster.filer_url}/kv/mount.conf",
+                         data=json.dumps(
+                             {"/q4": {"quota_bytes": 1024}}))
+            fs.quota_refresh_seconds = 0.0
+            fh = fs.create("/post.bin")
+            with pytest.raises(FuseError):
+                fs.write(fh, 0, b"q" * 4096)
+            fs.release(fh)
+        finally:
+            fs.destroy()
+
+    def test_no_quota_no_limit(self, cluster, tmp_path):
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+
+        fs = WeedFS(cluster.filer_url, master_url=cluster.master_url,
+                    root="/q2", chunk_size=256,
+                    cache_dir=str(tmp_path / "c2"), subscribe=False)
+        try:
+            assert fs.quota_bytes == 0
+            fh = fs.create("/big.bin")
+            fs.write(fh, 0, b"z" * 65536)
+            fs.release(fh)
+        finally:
+            fs.destroy()
+
+    def test_quota_accounts_committed_data(self, cluster, tmp_path):
+        from seaweedfs_tpu.mount.weedfs import FuseError, WeedFS
+
+        requests.put(f"{cluster.filer_url}/kv/mount.conf",
+                     data=json.dumps({"/q3": {"quota_bytes": 2048}}))
+        fs = WeedFS(cluster.filer_url, master_url=cluster.master_url,
+                    root="/q3", chunk_size=256,
+                    cache_dir=str(tmp_path / "c3"), subscribe=False)
+        try:
+            fh = fs.create("/a.bin")
+            fs.write(fh, 0, b"a" * 1500)
+            fs.release(fh)  # flushes: committed into the filer
+            fs.quota_refresh_seconds = 0.0  # force usage recompute
+            fh = fs.create("/b.bin")
+            with pytest.raises(FuseError):
+                fs.write(fh, 0, b"b" * 1500)
+            fs.release(fh)
+        finally:
+            fs.destroy()
+
+
+class TestFilerBackupCli:
+    def test_backup_mirrors_writes(self, cluster, tmp_path):
+        from seaweedfs_tpu.replication.replicator import Replicator
+        from seaweedfs_tpu.replication.sink import LocalSink
+
+        target = tmp_path / "backup_out"
+        r = Replicator(cluster.filer_url, LocalSink(str(target)),
+                       path_prefix="/bk")
+        r.start()
+        try:
+            requests.post(f"{cluster.filer_url}/bk/doc.txt",
+                          data=b"backup me")
+            deadline = time.time() + 10
+            f = target / "doc.txt"
+            while time.time() < deadline and not f.exists():
+                time.sleep(0.1)
+            assert f.read_bytes() == b"backup me"
+        finally:
+            r.stop()
+
+
+class TestMetaTailCli:
+    def test_tail_prints_events(self, cluster):
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "filer.meta.tail",
+             "-filer", cluster.filer_url, "-path", "/tailme"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            time.sleep(1.0)  # let the subscription connect
+            requests.post(f"{cluster.filer_url}/tailme/x.txt",
+                          data=b"ev")
+            line = ""
+            deadline = time.time() + 15
+            import select
+            while time.time() < deadline:
+                ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+                if ready:
+                    line = proc.stdout.readline()
+                    if "x.txt" in line:
+                        break
+            ev = json.loads(line)
+            path = (ev.get("new_entry") or {}).get("full_path", "")
+            assert path == "/tailme/x.txt"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

@@ -210,6 +210,35 @@ class TestS3QuotaAndUploads:
         rec = next(r for r in res if r["bucket"] == "qb")
         assert not rec["over"]
 
+    def test_clearing_quota_releases_readonly_latch(self, cluster,
+                                                    env):
+        requests.post(f"{cluster.filer_url}/buckets/latch/",
+                      params={"mkdir": "1"})
+        requests.post(f"{cluster.filer_url}/buckets/latch/f.bin",
+                      params={"collection": "latch"}, data=b"q" * 8192)
+        commands_s3.s3_bucket_quota(env, "latch", quota_mb=1)
+        # force over-quota by shrinking the quota below usage: 8KB used
+        from seaweedfs_tpu.shell.commands_fs import _stat
+        meta = _stat(env, "/buckets/latch")
+        ext = dict(meta.get("extended", {}))
+        ext["s3_quota_bytes"] = "4096"
+        meta["extended"] = ext
+        meta.pop("full_path", None)
+        requests.put(f"{cluster.filer_url}/buckets/latch?meta=1",
+                     json=meta)
+        res = commands_s3.s3_bucket_quota_enforce(env)
+        rec = next(r for r in res if r["bucket"] == "latch")
+        assert rec["over"] and rec["volumes"]
+        vids = rec["volumes"]
+        # REMOVE the quota entirely: enforce must release the volumes
+        commands_s3.s3_bucket_quota(env, "latch", quota_mb=0)
+        res = commands_s3.s3_bucket_quota_enforce(env)
+        rec = next(r for r in res if r["bucket"] == "latch")
+        assert not rec["over"] and set(rec["volumes"]) == set(vids)
+        # latch cleared: bucket drops out of future enforce passes
+        res = commands_s3.s3_bucket_quota_enforce(env)
+        assert not any(r["bucket"] == "latch" for r in res)
+
     def test_clean_uploads(self, cluster, env):
         requests.post(f"{cluster.filer_url}/buckets/ub/",
                       params={"mkdir": "1"})
@@ -307,6 +336,23 @@ class TestRaftMembership:
             out = commands_cluster.cluster_raft_change(
                 e, "127.0.0.1:59999", add=False)
             assert "127.0.0.1:59999" not in out["peers"]
+
+            # the vacuum switch rides the raft log: disabling via the
+            # leader must be visible in every follower's status
+            r = requests.post(
+                f"http://{leader}/vol/vacuum/disable", timeout=10)
+            assert r.json()["vacuum_disabled"] is True
+            follower = next(p for p in peers if p != leader)
+            deadline = time.time() + 10
+            seen = False
+            while time.time() < deadline and not seen:
+                seen = requests.get(
+                    f"http://{follower}/cluster/status",
+                    timeout=2).json().get("VacuumDisabled", False)
+                time.sleep(0.1)
+            assert seen, "follower never saw VacuumDisabled"
+            requests.post(f"http://{leader}/vol/vacuum/enable",
+                          timeout=10)
         finally:
             for t in threads:
                 t.stop()
