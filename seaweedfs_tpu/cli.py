@@ -59,6 +59,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact")
+    p.add_argument("-disk", default="hdd",
+                   help="disk class of this server (hdd | ssd)")
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -346,13 +348,22 @@ def _dispatch(args) -> int:
             w.stop()
         return 0
     if args.cmd == "filer.backup":
+        import hashlib as _hashlib
+        import os as _os
         import time as _t
 
         from .replication.replicator import Replicator
         from .replication.sink import LocalSink
 
+        # per-target resume offset: two backups (different -dir or
+        # -path) must not share/clobber one offset key
+        target_id = _hashlib.sha256(
+            f"{args.path}\x00{_os.path.abspath(args.dir)}".encode()
+        ).hexdigest()[:16]
         r = Replicator(args.filer, LocalSink(args.dir),
-                       path_prefix=args.path)
+                       path_prefix=args.path,
+                       offset_key=f"replication/backup/{target_id}/"
+                                  "offset")
         r.start()
         print(f"backing up {args.filer}{args.path} -> {args.dir}")
         try:
@@ -510,7 +521,7 @@ def _run_volume(args) -> int:
         loc.max_volumes = args.max
     # scheme normalization for each master happens inside VolumeServer
     vs = VolumeServer(store, args.mserver, data_center=args.dataCenter,
-                      rack=args.rack)
+                      rack=args.rack, disk_type=args.disk)
     t = ServerThread(vs.app, host=args.ip, port=args.port).start()
     store.port = t.port
     store.public_url = t.address

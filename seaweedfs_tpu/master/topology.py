@@ -81,14 +81,22 @@ class DataCenter:
         return sum(r.free_slots() for r in self.racks.values())
 
 
+def norm_disk(disk_type: str) -> str:
+    """'' and 'hdd' are the same disk class (the reference's
+    types.ToDiskType maps empty to HardDriveType)."""
+    return disk_type or "hdd"
+
+
 @dataclass
 class LayoutKey:
     collection: str
     replication: str
     ttl: tuple[int, int]
+    disk_type: str = "hdd"
 
     def __hash__(self):
-        return hash((self.collection, self.replication, self.ttl))
+        return hash((self.collection, self.replication, self.ttl,
+                     self.disk_type))
 
 
 class VolumeLayout:
@@ -238,8 +246,10 @@ class Topology:
             node.rack.nodes.pop(node_id, None)
 
     def _layout(self, collection: str, replication: str,
-                ttl: tuple[int, int]) -> VolumeLayout:
-        key = LayoutKey(collection, replication, ttl)
+                ttl: tuple[int, int],
+                disk_type: str = "hdd") -> VolumeLayout:
+        key = LayoutKey(collection, replication, ttl,
+                        norm_disk(disk_type))
         layout = self.layouts.get(key)
         if layout is None:
             layout = VolumeLayout(key, self.volume_size_limit)
@@ -247,12 +257,14 @@ class Topology:
         return layout
 
     def _register_volume(self, v: VolumeInfo, node: DataNode) -> None:
-        self._layout(v.collection, v.replica_placement, v.ttl).register(
-            v, node)
+        # a volume's disk class is its server's (volume layouts are
+        # keyed (collection, rp, ttl, diskType), volume_layout.go:107)
+        self._layout(v.collection, v.replica_placement, v.ttl,
+                     node.disk_type).register(v, node)
 
     def _unregister_volume(self, v: VolumeInfo, node: DataNode) -> None:
-        self._layout(v.collection, v.replica_placement, v.ttl).unregister(
-            v.vid, node)
+        self._layout(v.collection, v.replica_placement, v.ttl,
+                     node.disk_type).unregister(v.vid, node)
 
     def _unregister_ec_shard(self, vid: int, sid: int,
                              node: DataNode) -> None:
@@ -293,9 +305,11 @@ class Topology:
     # -- write assignment ------------------------------------------------
     def pick_for_write(self, collection: str = "", replication: str = "000",
                        ttl: tuple[int, int] = (0, 0),
-                       count: int = 1) -> tuple[int, list[DataNode]]:
+                       count: int = 1,
+                       disk_type: str = "") -> tuple[int, list[DataNode]]:
         with self.lock:
-            layout = self._layout(collection, replication, ttl)
+            layout = self._layout(collection, replication, ttl,
+                                  disk_type)
             return layout.pick_for_write(self.rng)
 
     def next_volume_id(self) -> int:
@@ -305,28 +319,39 @@ class Topology:
 
     # -- growth placement -------------------------------------------------
     def find_empty_slots(self, replication: str = "000",
-                         preferred_dc: str | None = None) -> list[DataNode]:
+                         preferred_dc: str | None = None,
+                         disk_type: str = "") -> list[DataNode]:
         """Choose servers for one volume + replicas honoring the xyz
         placement (volume_growth.go:134-230): randomized main-node pick
         among candidates with enough free slots in the required
-        dc/rack/server spread."""
+        dc/rack/server spread. `disk_type` restricts candidates to
+        servers of that disk class."""
         rp = ReplicaPlacement.parse(replication)
+        disk = norm_disk(disk_type)
         with self.lock:
             dcs = [d for d in self.dcs.values()
                    if preferred_dc is None or d.id == preferred_dc]
             self.rng.shuffle(dcs)
             for dc in dcs:
-                result = self._pick_in_dc(dc, rp)
+                result = self._pick_in_dc(dc, rp, disk)
                 if result is not None:
                     return result
             raise NoFreeSlots(
-                f"no free slots for replication {replication}")
+                f"no free slots for replication {replication} "
+                f"on disk type {disk!r}")
 
-    def _pick_in_dc(self, dc: DataCenter, rp) -> list[DataNode] | None:
-        racks = [r for r in dc.racks.values() if r.free_slots() > 0]
+    def _pick_in_dc(self, dc: DataCenter, rp,
+                    disk: str) -> list[DataNode] | None:
+        def fits(n: DataNode) -> bool:
+            return n.free_slots() > 0 and n.disk_type == disk
+
+        def rack_fits(r: Rack) -> bool:
+            return any(fits(n) for n in r.nodes.values())
+
+        racks = [r for r in dc.racks.values() if rack_fits(r)]
         self.rng.shuffle(racks)
         for rack in racks:
-            nodes = [n for n in rack.nodes.values() if n.free_slots() > 0]
+            nodes = [n for n in rack.nodes.values() if fits(n)]
             if len(nodes) < rp.same_rack + 1:
                 continue
             self.rng.shuffle(nodes)
@@ -334,10 +359,10 @@ class Topology:
             # replicas on other racks in this dc
             other_racks: list[DataNode] = []
             candidates = [r for r in dc.racks.values()
-                          if r is not rack and r.free_slots() > 0]
+                          if r is not rack and rack_fits(r)]
             self.rng.shuffle(candidates)
             for r in candidates[:rp.diff_rack]:
-                ns = [n for n in r.nodes.values() if n.free_slots() > 0]
+                ns = [n for n in r.nodes.values() if fits(n)]
                 if ns:
                     other_racks.append(self.rng.choice(ns))
             if len(other_racks) < rp.diff_rack:
@@ -345,11 +370,12 @@ class Topology:
             # replicas in other dcs
             other_dcs: list[DataNode] = []
             dc_candidates = [d for d in self.dcs.values()
-                             if d is not dc and d.free_slots() > 0]
+                             if d is not dc and any(
+                                 rack_fits(r) for r in d.racks.values())]
             self.rng.shuffle(dc_candidates)
             for d in dc_candidates[:rp.diff_dc]:
                 ns = [n for r in d.racks.values()
-                      for n in r.nodes.values() if n.free_slots() > 0]
+                      for n in r.nodes.values() if fits(n)]
                 if ns:
                     other_dcs.append(self.rng.choice(ns))
             if len(other_dcs) < rp.diff_dc:

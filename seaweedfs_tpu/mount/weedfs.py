@@ -82,6 +82,7 @@ class WeedFS:
         self.quota_bytes = 0
         self._usage_cache: tuple[float, int] = (-1e18, 0)
         self.quota_refresh_seconds = 15.0
+        self._quota_refreshing = threading.Event()
         try:
             self._refresh_quota()
         except Exception:
@@ -337,6 +338,30 @@ class WeedFS:
                 total += total_size(e.chunks)
         return total
 
+    def refresh_quota_now(self) -> None:
+        """Synchronous quota + usage refresh (tests and tooling; the
+        write path refreshes in the background instead)."""
+        self._quota_refreshing.set()
+        self._refresh_usage_bg(time.monotonic())
+
+    def _refresh_usage_bg(self, now: float) -> None:
+        try:
+            self._refresh_quota()
+            usage = self._du(self.root or "/") if self.quota_bytes \
+                else 0
+            # flushed handles are in the filer's usage now; only keep
+            # counting what is still dirty
+            with self._lock:
+                for h in self._handles.values():
+                    if not h.dirty.has_dirty():
+                        h.dirty.written_bytes = 0
+            self._usage_cache = (now, usage)
+        except Exception:
+            # keep the stale view; retried next window
+            self._usage_cache = (now, self._usage_cache[1])
+        finally:
+            self._quota_refreshing.clear()
+
     def _check_quota(self, incoming: int) -> None:
         """EDQUOT when the mount is over its configured quota
         (weedfs_quota.go maybeCheckQuota): usage is the filer's view
@@ -347,20 +372,14 @@ class WeedFS:
         hiccup must not fail writes that never depended on it."""
         now = time.monotonic()
         ts, usage = self._usage_cache
-        if now - ts > self.quota_refresh_seconds:
-            try:
-                self._refresh_quota()
-                usage = self._du(self.root or "/") \
-                    if self.quota_bytes else 0
-                # flushed handles are in the filer's usage now; only
-                # keep counting what is still dirty
-                with self._lock:
-                    for h in self._handles.values():
-                        if not h.dirty.has_dirty():
-                            h.dirty.written_bytes = 0
-            except Exception:
-                pass  # keep the stale view; retried next window
-            self._usage_cache = (now, usage)
+        if now - ts > self.quota_refresh_seconds and \
+                not self._quota_refreshing.is_set():
+            # the usage walk is one list_dir per directory — never run
+            # it inline in write(); a background refresh keeps write
+            # latency flat and the stale view serves meanwhile
+            self._quota_refreshing.set()
+            threading.Thread(target=self._refresh_usage_bg,
+                             args=(now,), daemon=True).start()
         if not self.quota_bytes:
             return
         with self._lock:

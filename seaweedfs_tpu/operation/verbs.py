@@ -23,7 +23,7 @@ class AssignResult:
 
 def assign(master_url: str, count: int = 1, collection: str = "",
            replication: str = "", ttl: str = "",
-           data_center: str = "") -> AssignResult:
+           data_center: str = "", disk_type: str = "") -> AssignResult:
     params = {"count": count}
     if collection:
         params["collection"] = collection
@@ -33,6 +33,8 @@ def assign(master_url: str, count: int = 1, collection: str = "",
         params["ttl"] = ttl
     if data_center:
         params["dataCenter"] = data_center
+    if disk_type:
+        params["disk"] = disk_type
     resp = requests.get(f"{master_url.rstrip('/')}/dir/assign",
                         params=params, timeout=30)
     body = resp.json()

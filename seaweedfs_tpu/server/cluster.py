@@ -35,8 +35,10 @@ class Cluster:
                  s3_config: dict | None = None,
                  tier_backends: dict[str, dict] | None = None,
                  admin_scripts: list[str] | None = None,
-                 admin_script_interval: float = 60.0):
-        """topology: optional per-server (data_center, rack) labels."""
+                 admin_script_interval: float = 60.0,
+                 disk_types: list[str] | None = None):
+        """topology: optional per-server (data_center, rack) labels;
+        disk_types: optional per-server disk class (hdd/ssd)."""
         self.base_dir = base_dir
         self.master = MasterServer(
             volume_size_limit=volume_size_limit,
@@ -64,7 +66,9 @@ class Cluster:
             vs = VolumeServer(store, self.master_url, data_center=dc,
                               rack=rack, jwt_secret=jwt_secret,
                               pulse_seconds=pulse_seconds,
-                              tier_backends=tier_backends)
+                              tier_backends=tier_backends,
+                              disk_type=(disk_types[i] if disk_types
+                                         else "hdd"))
             thread = ServerThread(vs.app).start()
             store.port = thread.port
             store.public_url = thread.address

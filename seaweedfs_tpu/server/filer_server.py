@@ -481,6 +481,7 @@ class FilerServer:
         replication = req.query.get("replication", "") \
             or rule.replication or self.replication
         ttl = req.query.get("ttl", "") or rule.ttl
+        disk_type = req.query.get("disk", "") or rule.disk_type
         chunk_size = int(req.query.get("maxMB", "0")) << 20 or \
             self.chunk_size
 
@@ -510,7 +511,7 @@ class FilerServer:
                 break
             fid, etag = await asyncio.to_thread(
                 self._upload_chunk, piece, filename, collection,
-                replication, ttl)
+                replication, ttl, disk_type)
             md5_all.update(piece)
             chunks.append(FileChunk(fid=fid, offset=offset,
                                     size=len(piece),
@@ -522,7 +523,8 @@ class FilerServer:
 
         chunks = await asyncio.to_thread(
             maybe_manifestize, lambda b: self._upload_chunk(
-                b, filename, collection, replication, ttl)[0], chunks)
+                b, filename, collection, replication, ttl,
+                disk_type)[0], chunks)
 
         entry = Entry(full_path=path, mime=mime,
                       ttl_sec=_ttl_seconds(ttl),
@@ -602,9 +604,11 @@ class FilerServer:
         return web.json_response(entry.to_dict())
 
     def _upload_chunk(self, data: bytes, name: str, collection: str,
-                      replication: str, ttl: str) -> tuple[str, str]:
+                      replication: str, ttl: str,
+                      disk_type: str = "") -> tuple[str, str]:
         a = verbs.assign(self.master_url, collection=collection,
-                         replication=replication, ttl=ttl)
+                         replication=replication, ttl=ttl,
+                         disk_type=disk_type)
         verbs.upload(a, data, name=name)
         return a.fid, hashlib.md5(data).hexdigest()
 

@@ -224,16 +224,19 @@ class MasterServer:
         replication = q.get("replication") or self.default_replication
         ttl = _parse_ttl(q.get("ttl", ""))
         dc = q.get("dataCenter") or None
+        disk = q.get("disk", "")
         try:
-            vid, nodes = self.topo.pick_for_write(collection, replication, ttl)
+            vid, nodes = self.topo.pick_for_write(collection, replication,
+                                                  ttl, disk_type=disk)
         except NoWritableVolume:
             try:
-                await self._grow(collection, replication, ttl, dc)
+                await self._grow(collection, replication, ttl, dc,
+                                 disk_type=disk)
             except NoFreeSlots as e:
                 return json_error(str(e), status=500)
             try:
                 vid, nodes = self.topo.pick_for_write(
-                    collection, replication, ttl)
+                    collection, replication, ttl, disk_type=disk)
             except NoWritableVolume as e:
                 return json_error(str(e), status=500)
         key = self.seq.next_ids(count)
@@ -278,7 +281,8 @@ class MasterServer:
             grown = 0
             for _ in range(count):
                 await self._grow(collection, replication, ttl,
-                                 q.get("dataCenter") or None, force=True)
+                                 q.get("dataCenter") or None, force=True,
+                                 disk_type=q.get("disk", ""))
                 grown += 1
         except NoFreeSlots as e:
             return json_error(str(e), status=500)
@@ -286,7 +290,7 @@ class MasterServer:
 
     async def _grow(self, collection: str, replication: str,
                     ttl: tuple[int, int], dc: str | None = None,
-                    force: bool = False) -> int:
+                    force: bool = False, disk_type: str = "") -> int:
         """findAndGrow (volume_growth.go:107): pick servers, allocate the
         volume on each over its admin API, let heartbeats register it.
         Without `force`, skips when another waiter already grew the
@@ -294,11 +298,13 @@ class MasterServer:
         async with self._grow_lock:
             if not force:
                 try:
-                    self.topo.pick_for_write(collection, replication, ttl)
+                    self.topo.pick_for_write(collection, replication,
+                                             ttl, disk_type=disk_type)
                     return 0
                 except NoWritableVolume:
                     pass
-            nodes = self.topo.find_empty_slots(replication, dc)
+            nodes = self.topo.find_empty_slots(replication, dc,
+                                               disk_type=disk_type)
             if self.raft is not None:
                 # a fresh leader must apply prior terms' committed
                 # high-water marks before minting a new volume id, or a

@@ -197,8 +197,13 @@ def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
                 ext.pop("s3_quota_enforced", None)
             meta["extended"] = ext
             meta.pop("full_path", None)
-            requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
-                         timeout=30)
+            r = requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
+                             timeout=30)
+            if r.status_code >= 300:
+                # a lost latch write would leave the volumes read-only
+                # with nothing left to release them
+                raise ShellError(
+                    f"quota latch update for {name}: {r.text}")
         out.append({"bucket": name, "used": used, "quota": quota,
                     "over": over, "volumes": sorted(set(touched))})
     return out

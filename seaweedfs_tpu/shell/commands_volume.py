@@ -248,14 +248,16 @@ def volume_unmount(env: CommandEnv, vid: int, server: str) -> dict:
 
 
 def volume_grow(env: CommandEnv, count: int = 1, collection: str = "",
-                replication: str = "") -> dict:
+                replication: str = "", disk_type: str = "") -> dict:
     """Pre-grow writable volumes via the master (command_volume_grow /
-    master /vol/grow)."""
+    master /vol/grow); -disk targets servers of that disk class."""
     params = {"count": count}
     if collection:
         params["collection"] = collection
     if replication:
         params["replication"] = replication
+    if disk_type:
+        params["disk"] = disk_type
     return env.master_get("/vol/grow", **params)
 
 
@@ -534,9 +536,11 @@ def volume_delete_empty(env: CommandEnv,
                 continue
             live = v.get("file_count", 0) - v.get("delete_count", 0)
             modified = v.get("modified_at", 0)
-            # never-written volumes (modified_at 0) have been quiet
-            # since creation — the primary target of this command
-            quiet = (now - modified) if modified else float("inf")
+            # never-written volumes report their .dat creation mtime
+            # (volume.modified_at_second's stat fallback), so quietFor
+            # covers them naturally; 0 means the stat itself failed
+            # (e.g. tiered-away .dat) — don't reap those without -force
+            quiet = (now - modified) if modified else 0.0
             if live <= 0 and (force or quiet >= quiet_for_seconds):
                 env.vs_post(n["url"], "/admin/delete_volume",
                             {"volume": vid})

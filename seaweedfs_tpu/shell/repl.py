@@ -142,7 +142,7 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "volume.grow":
         return commands_volume.volume_grow(
             env, int(opts.get("count", "1")), opts.get("collection", ""),
-            opts.get("replication", ""))
+            opts.get("replication", ""), opts.get("disk", ""))
     if cmd == "volume.vacuum":
         return commands_volume.volume_vacuum(
             env, float(opts.get("threshold", 0.3)))

@@ -1,0 +1,114 @@
+"""Disk-class support: volume layouts keyed (collection, rp, ttl,
+diskType) (SURVEY.md section 2.4; volume_layout.go:107), ?disk= on
+assign/grow, filer.conf disk routing, and volume.tier.move."""
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell import commands_volume
+from seaweedfs_tpu.shell.env import CommandEnv
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("disks")),
+                n_volume_servers=2, volume_size_limit=4 << 20,
+                max_volumes=20, with_filer=True,
+                disk_types=["hdd", "ssd"])
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    e = CommandEnv(cluster.master_url, filer_url=cluster.filer_url)
+    e.acquire_lock()
+    yield e
+    e.close()
+
+
+def server_of(cluster, disk):
+    return next(t.address for vs, t in
+                zip(cluster.volume_servers, cluster.volume_threads)
+                if vs.disk_type == disk)
+
+
+class TestDiskAssign:
+    def test_topology_reports_disk_types(self, cluster, env):
+        types = {n["url"]: n["disk_type"] for n in env.data_nodes()}
+        assert sorted(types.values()) == ["hdd", "ssd"]
+
+    def test_assign_targets_disk_class(self, cluster, env):
+        ssd_server = server_of(cluster, "ssd")
+        hdd_server = server_of(cluster, "hdd")
+        for disk, want in (("ssd", ssd_server), ("hdd", hdd_server)):
+            r = requests.get(f"{cluster.master_url}/dir/assign",
+                             params={"disk": disk,
+                                     "collection": f"c{disk}"})
+            body = r.json()
+            assert r.status_code == 200, body
+            assert body["url"] == want, (disk, body)
+
+    def test_default_assign_is_hdd(self, cluster, env):
+        r = requests.get(f"{cluster.master_url}/dir/assign",
+                         params={"collection": "plain"})
+        assert r.json()["url"] == server_of(cluster, "hdd")
+
+    def test_grow_with_disk(self, cluster, env):
+        out = commands_volume.volume_grow(env, count=1,
+                                          collection="growssd",
+                                          disk_type="ssd")
+        assert out["count"] == 1
+        ssd_server = server_of(cluster, "ssd")
+        nodes = {n["url"]: n for n in env.data_nodes()}
+        grown = [v for v, col in nodes[ssd_server]
+                 .get("collections", {}).items() if col == "growssd"]
+        assert grown
+
+    def test_unknown_disk_class_errors(self, cluster):
+        r = requests.get(f"{cluster.master_url}/dir/assign",
+                         params={"disk": "tape", "collection": "nope"})
+        assert r.status_code == 500
+        assert "tape" in r.json().get("error", "")
+
+
+class TestFilerDiskRouting:
+    def test_filer_conf_disk_rule_routes_uploads(self, cluster, env):
+        import json as _json
+        conf = {"rules": [{"location_prefix": "/fast/",
+                           "disk_type": "ssd",
+                           "collection": "fastcol"}]}
+        requests.put(f"{cluster.filer_url}/kv/filer.conf",
+                     data=_json.dumps(conf))
+        r = requests.post(f"{cluster.filer_url}/fast/f.bin",
+                          data=b"ssd bytes")
+        assert r.status_code < 300
+        # the chunk must live on the ssd server
+        meta = requests.get(f"{cluster.filer_url}/fast/f.bin",
+                            params={"meta": "1"}).json()
+        vid = int(meta["chunks"][0]["fid"].partition(",")[0])
+        locs = requests.get(f"{cluster.master_url}/dir/lookup",
+                            params={"volumeId": str(vid)}).json()
+        urls = {l["url"] for l in locs["locations"]}
+        assert urls == {server_of(cluster, "ssd")}
+
+
+class TestTierMove:
+    def test_tier_move_hdd_to_ssd(self, cluster, env):
+        # land a volume on the hdd server
+        r = requests.get(f"{cluster.master_url}/dir/assign",
+                         params={"disk": "hdd",
+                                 "collection": "movecol"})
+        body = r.json()
+        requests.post(f"http://{body['url']}/{body['fid']}",
+                      files={"file": b"move these bytes"},
+                      params={"auth": body.get("auth", "")})
+        moved = commands_volume.volume_tier_move(
+            env, "ssd", collection="movecol")
+        assert moved, "nothing moved"
+        assert all(m["to"] == server_of(cluster, "ssd")
+                   for m in moved)
+        # data still readable after the move
+        got = requests.get(
+            f"http://{server_of(cluster, 'ssd')}/{body['fid']}")
+        assert got.content == b"move these bytes"

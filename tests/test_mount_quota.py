@@ -57,7 +57,7 @@ class TestMountQuota:
             requests.put(f"{cluster.filer_url}/kv/mount.conf",
                          data=json.dumps(
                              {"/q4": {"quota_bytes": 1024}}))
-            fs.quota_refresh_seconds = 0.0
+            fs.refresh_quota_now()
             fh = fs.create("/post.bin")
             with pytest.raises(FuseError):
                 fs.write(fh, 0, b"q" * 4096)
@@ -91,7 +91,7 @@ class TestMountQuota:
             fh = fs.create("/a.bin")
             fs.write(fh, 0, b"a" * 1500)
             fs.release(fh)  # flushes: committed into the filer
-            fs.quota_refresh_seconds = 0.0  # force usage recompute
+            fs.refresh_quota_now()  # force usage recompute
             fh = fs.create("/b.bin")
             with pytest.raises(FuseError):
                 fs.write(fh, 0, b"b" * 1500)
