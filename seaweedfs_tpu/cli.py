@@ -160,6 +160,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-cacheDir", dest="cache_dir", default="")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
+    p.add_argument("-o", dest="mount_options", default="",
+                   help="extra comma-separated fuse options "
+                        "(allow_other, ro, ...)")
 
     p = sub.add_parser("shell", help="interactive admin shell")
     p.add_argument("-master", default="http://127.0.0.1:9333")
@@ -441,6 +444,7 @@ def _dispatch(args) -> int:
         from .mount.fuse_adapter import mount
 
         mount(args.filer, args.dir, root=args.filer_path,
+              options=args.mount_options or None,
               cache_dir=args.cache_dir or None,
               collection=args.collection, replication=args.replication)
         return 0

@@ -181,10 +181,10 @@ class Topology:
                 dc_obj = self.dcs.setdefault(dc, DataCenter(dc))
                 rack_obj = dc_obj.racks.setdefault(rack, Rack(rack, dc_obj))
                 node = DataNode(node_id, ip, port, public_url, max_volumes,
-                                rack_obj, disk_type)
+                                rack_obj, norm_disk(disk_type))
                 rack_obj.nodes[node_id] = node
                 self.nodes[node_id] = node
-            node.disk_type = disk_type
+            node.disk_type = norm_disk(disk_type)
             node.last_seen = time.monotonic()
             return node
 

@@ -105,12 +105,28 @@ class WeedFuseOps(Operations):  # pragma: no cover - needs kernel fuse
 
 
 def mount(filer_url: str, mountpoint: str, root: str = "/",
+          options: str | None = None,
           **weedfs_kwargs) -> None:  # pragma: no cover
-    """Block serving `filer_url`'s `root` directory at `mountpoint`."""
-    if not HAVE_FUSE:
+    """Block serving `filer_url`'s `root` directory at `mountpoint`.
+
+    Prefers fusepy if installed; otherwise uses the self-contained
+    ctypes binding to libfuse.so.2 (fuse_ctypes.py), so a real kernel
+    mount needs nothing beyond the system libfuse."""
+    if HAVE_FUSE:
+        fs = WeedFS(filer_url, root=root, **weedfs_kwargs)
+        extra = {}
+        for opt in (options or "").split(","):
+            if not opt:
+                continue
+            k, sep, v = opt.partition("=")
+            extra[k] = v if sep else True
+        FUSE(WeedFuseOps(fs), mountpoint, foreground=True, nothreads=False,
+             big_writes=True, **extra)
+        return
+    from . import fuse_ctypes
+    if not fuse_ctypes.libfuse_available():
         raise RuntimeError(
-            "fusepy is not installed in this environment; the mount "
+            "neither fusepy nor libfuse.so.2 is available; the mount "
             "core is still usable as a library via mount.WeedFS")
-    fs = WeedFS(filer_url, root=root, **weedfs_kwargs)
-    FUSE(WeedFuseOps(fs), mountpoint, foreground=True, nothreads=False,
-         big_writes=True)
+    fuse_ctypes.mount(filer_url, mountpoint, root=root, options=options,
+                      **weedfs_kwargs)

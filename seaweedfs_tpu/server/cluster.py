@@ -67,8 +67,9 @@ class Cluster:
                               rack=rack, jwt_secret=jwt_secret,
                               pulse_seconds=pulse_seconds,
                               tier_backends=tier_backends,
-                              disk_type=(disk_types[i] if disk_types
-                                         else "hdd"))
+                              disk_type=(disk_types[i]
+                                     if disk_types and i < len(disk_types)
+                                     else "hdd"))
             thread = ServerThread(vs.app).start()
             store.port = thread.port
             store.public_url = thread.address

@@ -1,0 +1,208 @@
+"""Real kernel FUSE e2e: mount a filer directory through the ctypes
+libfuse binding and exercise it with ordinary OS file I/O — the
+single-host analogue of the reference's fio-over-mount e2e
+(.github/workflows/e2e.yml:44-83). Skipped when the environment cannot
+mount (no /dev/fuse, no libfuse, or not privileged).
+"""
+import hashlib
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _can_fuse():
+    if not os.path.exists("/dev/fuse"):
+        return False
+    sys.path.insert(0, REPO)
+    try:
+        from seaweedfs_tpu.mount.fuse_ctypes import libfuse_available
+        return libfuse_available()
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _can_fuse(),
+                                reason="no usable /dev/fuse + libfuse")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_http(url, timeout=30):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            requests.get(url, timeout=1)
+            return
+        except requests.RequestException as e:
+            last = e
+            time.sleep(0.15)
+    raise TimeoutError(f"{url} never came up: {last}")
+
+
+@pytest.fixture(scope="module")
+def mounted(tmp_path_factory):
+    base = tmp_path_factory.mktemp("fusee2e")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+
+    def spawn(*argv):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *argv], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    mport, vport, fport = free_port(), free_port(), free_port()
+    master = f"http://127.0.0.1:{mport}"
+    filer = f"http://127.0.0.1:{fport}"
+    voldir = base / "vol"
+    voldir.mkdir()
+    filerdir = base / "filermeta"
+    filerdir.mkdir()
+    mnt = base / "mnt"
+    mnt.mkdir()
+    spawn("master", "-port", str(mport), "-volumeSizeLimitMB", "64")
+    wait_http(f"{master}/cluster/status")
+    spawn("volume", "-port", str(vport), "-dir", str(voldir),
+          "-max", "8", "-mserver", master)
+    wait_http(f"http://127.0.0.1:{vport}/status")
+    spawn("filer", "-port", str(fport), "-master", master,
+          "-store", "leveldb", "-store.path", str(filerdir / "db"))
+    wait_http(f"{filer}/status")
+    mproc = spawn("mount", "-filer", filer, "-dir", str(mnt))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.ismount(mnt):
+            break
+        if mproc.poll() is not None:
+            out = mproc.stdout.read()
+            raise RuntimeError(f"mount process died:\n{out}")
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("mountpoint never became a mount")
+    try:
+        yield str(mnt), filer
+    finally:
+        subprocess.run(["fusermount", "-u", str(mnt)],
+                       capture_output=True)
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in reversed(procs):
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_write_read_roundtrip(mounted):
+    mnt, _ = mounted
+    payload = os.urandom(3 * 1024 * 1024 + 12345)
+    path = os.path.join(mnt, "blob.bin")
+    with open(path, "wb") as f:
+        f.write(payload)
+    with open(path, "rb") as f:
+        assert hashlib.sha256(f.read()).digest() == \
+            hashlib.sha256(payload).digest()
+    st = os.stat(path)
+    assert st.st_size == len(payload)
+
+
+def test_visible_through_filer_http(mounted):
+    mnt, filer = mounted
+    with open(os.path.join(mnt, "hello.txt"), "w") as f:
+        f.write("hello kernel\n")
+    r = requests.get(f"{filer}/hello.txt")
+    assert r.status_code == 200 and r.text == "hello kernel\n"
+
+
+def test_mkdir_rename_listing(mounted):
+    mnt, _ = mounted
+    os.makedirs(os.path.join(mnt, "a/b"), exist_ok=True)
+    src = os.path.join(mnt, "a/b/x.txt")
+    with open(src, "w") as f:
+        f.write("x")
+    dst = os.path.join(mnt, "a/y.txt")
+    os.rename(src, dst)
+    assert "y.txt" in os.listdir(os.path.join(mnt, "a"))
+    assert "x.txt" not in os.listdir(os.path.join(mnt, "a/b"))
+    with open(dst) as f:
+        assert f.read() == "x"
+
+
+def test_unlink_and_stat_errors(mounted):
+    mnt, _ = mounted
+    p = os.path.join(mnt, "gone.txt")
+    with open(p, "w") as f:
+        f.write("bye")
+    os.unlink(p)
+    with pytest.raises(FileNotFoundError):
+        os.stat(p)
+
+
+def test_random_rw_through_kernel(mounted):
+    """Small fio-style verified random read/write workload."""
+    import random
+    rng = random.Random(7)
+    mnt, _ = mounted
+    path = os.path.join(mnt, "randrw.bin")
+    size = 1 << 20
+    shadow = bytearray(size)
+    with open(path, "wb") as f:
+        f.write(bytes(size))
+    with open(path, "r+b") as f:
+        for _ in range(64):
+            off = rng.randrange(0, size - 4096)
+            if rng.random() < 0.5:
+                blk = rng.randbytes(4096)
+                f.seek(off)
+                f.write(blk)
+                shadow[off:off + 4096] = blk
+            else:
+                f.seek(off)
+                assert f.read(4096) == bytes(shadow[off:off + 4096])
+            if rng.random() < 0.1:
+                f.flush()
+                os.fsync(f.fileno())
+    with open(path, "rb") as f:
+        assert f.read() == bytes(shadow)
+
+
+def test_symlink_hardlink_truncate(mounted):
+    mnt, _ = mounted
+    tgt = os.path.join(mnt, "orig.txt")
+    with open(tgt, "w") as f:
+        f.write("0123456789")
+    os.symlink("orig.txt", os.path.join(mnt, "sym.txt"))
+    assert os.readlink(os.path.join(mnt, "sym.txt")) == "orig.txt"
+    with open(os.path.join(mnt, "sym.txt")) as f:
+        assert f.read() == "0123456789"
+    os.link(tgt, os.path.join(mnt, "hard.txt"))
+    with open(os.path.join(mnt, "hard.txt")) as f:
+        assert f.read() == "0123456789"
+    os.truncate(tgt, 4)
+    assert os.stat(tgt).st_size == 4
+    with open(tgt) as f:
+        assert f.read() == "0123"
+
+
+def test_statvfs(mounted):
+    mnt, _ = mounted
+    sv = os.statvfs(mnt)
+    assert sv.f_bsize > 0 and sv.f_blocks > 0
