@@ -48,6 +48,13 @@ def main(argv: list[str] | None = None) -> int:
                    dest="admin_script_interval", type=float,
                    default=60.0)
 
+    p = sub.add_parser("master.follower",
+                       help="read-only master follower for lookup traffic")
+    p.add_argument("-port", type=int, default=9334)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-masters", default="http://127.0.0.1:9333",
+                   help="comma-separated master urls to follow")
+
     p = sub.add_parser("volume", help="start a volume server")
     p.add_argument("-port", type=int, default=8080)
     p.add_argument("-ip", default="127.0.0.1")
@@ -115,6 +122,20 @@ def main(argv: list[str] | None = None) -> int:
                             "back to the cloud storage")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-dir", required=True, help="mounted directory")
+
+    p = sub.add_parser("filer.remote.gateway",
+                       help="mirror bucket creation/deletion and bucket "
+                            "contents to the primary remote storage")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-createBucketAt", dest="create_bucket_at", default="",
+                   help="remote storage name for new buckets "
+                        "(defaults to the only configured storage)")
+    p.add_argument("-createBucketWithRandomSuffix", dest="bucket_suffix",
+                   action="store_true")
+    p.add_argument("-include", default="",
+                   help="glob of bucket names to mirror, e.g. s3*")
+    p.add_argument("-exclude", default="",
+                   help="glob of bucket names to skip, e.g. local*")
 
     p = sub.add_parser("filer.meta.backup",
                        help="continuous metadata backup to sqlite")
@@ -310,6 +331,20 @@ def _dispatch(args) -> int:
         return 0
     if args.cmd == "master":
         return _run_master(args)
+    if args.cmd == "master.follower":
+        from .rpc.http import ServerThread, run_apps_forever
+        from .server.master_follower import MasterFollower
+
+        masters = [m.strip() if m.strip().startswith("http")
+                   else f"http://{m.strip()}"
+                   for m in args.masters.split(",") if m.strip()]
+        mf = MasterFollower(masters)
+        t = ServerThread(mf.build_app(), host=args.ip,
+                         port=args.port).start()
+        print(f"master follower listening on {t.url}, "
+              f"following {masters}")
+        run_apps_forever([t])
+        return 0
     if args.cmd == "volume":
         return _run_volume(args)
     if args.cmd == "server":
@@ -334,6 +369,24 @@ def _dispatch(args) -> int:
                 _t.sleep(3600)
         except KeyboardInterrupt:
             sync.stop()
+        return 0
+    if args.cmd == "filer.remote.gateway":
+        import time as _t
+
+        from .remote_storage.gateway import RemoteGateway
+
+        g = RemoteGateway(args.filer,
+                          create_bucket_at=args.create_bucket_at,
+                          bucket_suffix=args.bucket_suffix,
+                          include=args.include, exclude=args.exclude)
+        g.start()
+        print(f"mirroring {args.filer}/buckets to remote storage "
+              f"{g.create_bucket_at or '(none configured)'}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            g.stop()
         return 0
     if args.cmd == "filer.remote.sync":
         import time as _t

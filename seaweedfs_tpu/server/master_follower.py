@@ -1,0 +1,53 @@
+"""Read-only master follower.
+
+Equivalent of /root/reference/weed/command/master_follower.go: a
+stateless service that does NOT participate in raft election and holds
+no topology — it follows the live masters through the KeepConnected
+push stream (wdclient.MasterClient) and answers volume/file-id lookup
+traffic locally, relieving the leader of read QPS in large clusters.
+
+Handles the same surface the reference documents (master_follower.go
+/dir/lookup?volumeId=4 and ?fileId=4,49c...) plus /status.
+"""
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..rpc.http import json_error, json_ok
+from ..wdclient.client import MasterClient
+
+
+class MasterFollower:
+    def __init__(self, master_urls: list[str] | str):
+        self.client = MasterClient(master_urls, subscribe=True)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes([
+            web.get("/dir/lookup", self.handle_lookup),
+            web.get("/status", self.handle_status),
+        ])
+        return app
+
+    @property
+    def app(self) -> web.Application:
+        return self.build_app()
+
+    async def handle_lookup(self, req: web.Request) -> web.Response:
+        vid_s = req.query.get("volumeId", "") or req.query.get("fileId", "")
+        try:
+            vid = int(vid_s.split(",")[0])
+        except ValueError:
+            return json_error(f"unparsable volume id {vid_s!r}", status=400)
+        locs = self.client.lookup(vid)
+        if not locs:
+            return json_error(f"volume {vid} not found", status=404)
+        return json_ok({"volumeId": str(vid), "locations": locs})
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return json_ok({
+            "isFollower": True,
+            "masters": self.client.masters,
+            "leader": self.client.master_url,
+            "cachedVolumes": len(self.client._vid_cache),
+        })
