@@ -452,8 +452,8 @@ class S3ApiServer:
                           "this sub-resource is not implemented", 501)
         if m == "POST" and "select" in q:
             check(ACTION_READ)
-            return await self._select_object_content(bucket, key,
-                                                     payload)
+            return await self._select_object_content(
+                bucket, key, payload, ndjson=q.get("output") == "ndjson")
         if m == "PUT":
             check(ACTION_WRITE)
             src = req.headers.get("x-amz-copy-source", "")
@@ -1246,13 +1246,16 @@ class S3ApiServer:
     SELECT_MAX_OBJECT_BYTES = 256 << 20
 
     async def _select_object_content(self, bucket: str, key: str,
-                                     payload: bytes) -> web.Response:
+                                     payload: bytes,
+                                     ndjson: bool = False) -> web.Response:
         """SelectObjectContent subset: SQL over JSON objects
         (POST /{key}?select&select-type=2). The projection/filter engine
         is the same one behind the volume server's Query rpc
-        (weed/query/json); records come back as NDJSON rather than the
-        AWS binary event-stream framing."""
+        (weed/query/json); records are framed as an AWS binary
+        event-stream (Records*, Stats, End) so stock SDK clients can
+        parse them. `?output=ndjson` keeps the raw-lines extension."""
         from ..query import parse_select, query_json_bytes
+        from .eventstream import select_response
 
         try:
             root = ET.fromstring(payload)
@@ -1286,8 +1289,13 @@ class S3ApiServer:
             raise S3Error("InvalidTextEncoding",
                           f"object is not valid JSON: {e}", 400)
         body = ("\n".join(lines) + "\n").encode() if lines else b""
-        return web.Response(body=body,
-                            content_type="application/octet-stream")
+        if ndjson:
+            return web.Response(body=body,
+                                content_type="application/octet-stream")
+        return web.Response(
+            body=select_response(body, scanned=len(resp.content),
+                                 processed=len(resp.content)),
+            content_type="application/vnd.amazon.eventstream")
 
     async def _tagging_op(self, method: str, bucket: str, key: str,
                           payload: bytes) -> web.Response:
