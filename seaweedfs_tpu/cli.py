@@ -90,8 +90,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-store", default="memory",
-                   help="metadata store: memory | sqlite | leveldb")
+                   help="metadata store: memory | sqlite | leveldb | "
+                        "redis | mysql | postgres (drivers permitting)")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
+    p.add_argument("-store.host", dest="store_host", default="")
+    p.add_argument("-store.port", dest="store_port", type=int, default=0)
+    p.add_argument("-store.password", dest="store_password", default="")
+    p.add_argument("-store.database", dest="store_database", default="")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
 
@@ -622,9 +627,19 @@ def _run_filer(args) -> int:
 
     master = args.master if args.master.startswith("http") else \
         f"http://{args.master}"
+    store_options = {}
+    if args.store_host:
+        store_options["host"] = args.store_host
+    if args.store_port:
+        store_options["port"] = args.store_port
+    if args.store_password:
+        store_options["password"] = args.store_password
+    if args.store_database:
+        store_options["database"] = args.store_database
     fs = FilerServer(master, store=args.store, store_path=args.store_path,
                      collection=args.collection,
-                     replication=args.replication)
+                     replication=args.replication,
+                     store_options=store_options)
     t = ServerThread(fs.app, host=args.ip, port=args.port).start()
     fs.address = t.address
     print(f"filer listening on {t.url} (store={args.store})")

@@ -12,6 +12,8 @@ from .filechunks import (ChunkView, VisibleInterval, compact_file_chunks,
                          non_overlapping_visible_intervals,
                          resolve_chunk_manifest, view_from_chunks)
 from .filer import Filer, norm_path
+from . import abstract_sql as _abstract_sql  # registers mysql/postgres
+from . import redis_store as _redis_store    # registers redis
 from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,
                          make_store, register_store)
 from .stream import ChunkStreamReader, read_fid, stream_content

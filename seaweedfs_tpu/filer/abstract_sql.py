@@ -1,0 +1,199 @@
+"""Shared SQL filer-store layer + mysql/postgres adapters.
+
+Equivalent of /root/reference/weed/filer/abstract_sql/ (the 472-LoC
+abstract_sql_store.go shared by the mysql/postgres/sqlite plugins):
+one table keyed (dir, name) holding encoded entry blobs, plus a KV
+table, with the dialect differences (parameter placeholders, upsert
+syntax, LIKE escaping) isolated in a small Dialect object.
+
+The sqlite store in filerstore.py predates this layer and stays
+self-contained; mysql and postgres register here, gated on their
+drivers (pymysql / psycopg2·pg8000) being importable — the build image
+ships neither, mirroring how the reference compiles those stores in
+but only activates them when configured.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+
+from .entry import Entry
+from .filerstore import FilerStore, _like_escape, _norm, _split, \
+    register_store
+
+
+@dataclass
+class Dialect:
+    placeholder: str               # "?" or "%s"
+    upsert_meta: str               # full upsert statement for filemeta
+    upsert_kv: str                 # full upsert statement for kv
+    create_meta: str
+    create_kv: str
+    like_escape_clause: str = r" ESCAPE '\'"
+
+
+def _ph(d: Dialect, n: int) -> str:
+    return ",".join([d.placeholder] * n)
+
+
+MYSQL_DIALECT = Dialect(
+    placeholder="%s",
+    create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
+        dir VARCHAR(766) NOT NULL, name VARCHAR(766) NOT NULL,
+        meta LONGTEXT NOT NULL, PRIMARY KEY(dir, name))""",
+    create_kv="""CREATE TABLE IF NOT EXISTS kv(
+        k VARCHAR(766) PRIMARY KEY, v LONGBLOB NOT NULL)""",
+    upsert_meta="""INSERT INTO filemeta(dir,name,meta) VALUES(%s,%s,%s)
+        ON DUPLICATE KEY UPDATE meta=VALUES(meta)""",
+    upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
+        ON DUPLICATE KEY UPDATE v=VALUES(v)""",
+    like_escape_clause=" ESCAPE '\\\\'",
+)
+
+POSTGRES_DIALECT = Dialect(
+    placeholder="%s",
+    create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
+        dir TEXT NOT NULL, name TEXT NOT NULL,
+        meta TEXT NOT NULL, PRIMARY KEY(dir, name))""",
+    create_kv="""CREATE TABLE IF NOT EXISTS kv(
+        k TEXT PRIMARY KEY, v BYTEA NOT NULL)""",
+    upsert_meta="""INSERT INTO filemeta(dir,name,meta) VALUES(%s,%s,%s)
+        ON CONFLICT(dir,name) DO UPDATE SET meta=EXCLUDED.meta""",
+    upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
+        ON CONFLICT(k) DO UPDATE SET v=EXCLUDED.v""",
+)
+
+
+class AbstractSqlStore(FilerStore):
+    """FilerStore over any DB-API 2.0 connection."""
+
+    def __init__(self, conn, dialect: Dialect):
+        self._conn = conn
+        self._d = dialect
+        self._lock = threading.RLock()
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(dialect.create_meta)
+            cur.execute(dialect.create_kv)
+            self._conn.commit()
+
+    def _exec(self, sql: str, args: tuple = ()) -> list:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(sql, args)
+            rows = cur.fetchall() if cur.description else []
+            self._conn.commit()
+            return rows
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = entry.dir_and_name
+        self._exec(self._d.upsert_meta,
+                   (d, n, json.dumps(entry.to_dict())))
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry | None:
+        d, n = _split(path)
+        if not n:
+            return None
+        ph = self._d.placeholder
+        rows = self._exec(
+            f"SELECT meta FROM filemeta WHERE dir={ph} AND name={ph}",
+            (d, n))
+        return Entry.from_dict(json.loads(rows[0][0])) if rows else None
+
+    def delete_entry(self, path: str) -> None:
+        d, n = _split(path)
+        ph = self._d.placeholder
+        self._exec(
+            f"DELETE FROM filemeta WHERE dir={ph} AND name={ph}", (d, n))
+
+    def delete_folder_children(self, path: str) -> None:
+        path = _norm(path)
+        like = _like_escape(
+            path if path.endswith("/") else path + "/") + "%"
+        ph = self._d.placeholder
+        self._exec(
+            f"DELETE FROM filemeta WHERE dir={ph} OR dir LIKE {ph}"
+            f"{self._d.like_escape_clause}", (path, like))
+
+    def list_directory_entries(self, dirpath: str, start_from: str = "",
+                               inclusive: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dirpath = _norm(dirpath)
+        ph = self._d.placeholder
+        cmp = ">=" if inclusive else ">"
+        q = f"SELECT meta FROM filemeta WHERE dir={ph}"
+        args: list = [dirpath]
+        if start_from:
+            q += f" AND name {cmp} {ph}"
+            args.append(start_from)
+        if prefix:
+            q += f" AND name LIKE {ph}{self._d.like_escape_clause}"
+            args.append(_like_escape(prefix) + "%")
+        q += f" ORDER BY name LIMIT {ph}"
+        args.append(limit)
+        rows = self._exec(q, tuple(args))
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._exec(self._d.upsert_kv, (key, value))
+
+    def kv_get(self, key: str) -> bytes | None:
+        ph = self._d.placeholder
+        rows = self._exec(f"SELECT v FROM kv WHERE k={ph}", (key,))
+        return bytes(rows[0][0]) if rows else None
+
+    def kv_delete(self, key: str) -> None:
+        ph = self._d.placeholder
+        self._exec(f"DELETE FROM kv WHERE k={ph}", (key,))
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+@register_store("mysql")
+class MysqlStore(AbstractSqlStore):
+    """weed/filer/mysql equivalent; requires the pymysql driver."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 3306,
+                 user: str = "root", password: str = "",
+                 database: str = "seaweedfs", **_):
+        try:
+            import pymysql
+        except ImportError as e:
+            raise ImportError(
+                "filer store 'mysql' needs the pymysql driver, which "
+                "is not installed in this environment") from e
+        conn = pymysql.connect(host=host, port=port, user=user,
+                               password=password, database=database,
+                               autocommit=False)
+        super().__init__(conn, MYSQL_DIALECT)
+
+
+@register_store("postgres")
+class PostgresStore(AbstractSqlStore):
+    """weed/filer/postgres equivalent; requires psycopg2 or pg8000."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5432,
+                 user: str = "postgres", password: str = "",
+                 database: str = "seaweedfs", **_):
+        conn = None
+        try:
+            import psycopg2
+            conn = psycopg2.connect(host=host, port=port, user=user,
+                                    password=password, dbname=database)
+        except ImportError:
+            try:
+                import pg8000.dbapi
+                conn = pg8000.dbapi.Connection(
+                    user, host=host, port=port, password=password,
+                    database=database)
+            except ImportError as e:
+                raise ImportError(
+                    "filer store 'postgres' needs psycopg2 or pg8000, "
+                    "neither of which is installed") from e
+        super().__init__(conn, POSTGRES_DIALECT)

@@ -380,17 +380,46 @@ class _GatedStore(FilerStore):
             "available everywhere: memory, sqlite, leveldb")
 
 
-@register_store("redis")
-class RedisStore(_GatedStore):
-    KIND, NEEDS = "redis", "redis"
+# redis / mysql / postgres have real implementations now — see
+# redis_store.py (self-contained RESP client) and abstract_sql.py
+# (shared SQL layer; mysql/postgres still need their drivers).
+# The remaining reference store families stay gated placeholders:
+
+@register_store("mongodb")
+class MongodbStore(_GatedStore):
+    KIND, NEEDS = "mongodb", "pymongo"
 
 
-@register_store("mysql")
-class MysqlStore(_GatedStore):
-    KIND, NEEDS = "mysql", "pymysql (layout: abstract_sql, like the "\
-                           "sqlite store's table scheme)"
+@register_store("cassandra")
+class CassandraStore(_GatedStore):
+    KIND, NEEDS = "cassandra", "cassandra-driver"
 
 
-@register_store("postgres")
-class PostgresStore(_GatedStore):
-    KIND, NEEDS = "postgres", "psycopg2"
+@register_store("etcd")
+class EtcdStore(_GatedStore):
+    KIND, NEEDS = "etcd", "etcd3"
+
+
+@register_store("tikv")
+class TikvStore(_GatedStore):
+    KIND, NEEDS = "tikv", "tikv-client"
+
+
+@register_store("ydb")
+class YdbStore(_GatedStore):
+    KIND, NEEDS = "ydb", "ydb"
+
+
+@register_store("arangodb")
+class ArangodbStore(_GatedStore):
+    KIND, NEEDS = "arangodb", "python-arango"
+
+
+@register_store("hbase")
+class HbaseStore(_GatedStore):
+    KIND, NEEDS = "hbase", "happybase"
+
+
+@register_store("elastic")
+class ElasticStore(_GatedStore):
+    KIND, NEEDS = "elastic", "elasticsearch"

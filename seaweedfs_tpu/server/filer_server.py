@@ -39,14 +39,16 @@ class FilerServer:
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  signature: int = 0,
-                 announce_pulse: float = 3.0):
+                 announce_pulse: float = 3.0,
+                 store_options: dict | None = None):
         self.master_url = master_url.rstrip("/")
         self.masters = MasterClient(self.master_url)
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
         self.filer = Filer(store, on_delete_chunks=self._delete_chunks,
-                           signature=signature, path=store_path)
+                           signature=signature, path=store_path,
+                           **(store_options or {}))
         # cluster membership + distributed lock manager: this filer's
         # address is resolved after the listen socket binds (the runner
         # sets .address, like volume servers' store.port)

@@ -1,0 +1,165 @@
+"""In-process mini redis server for exercising the RESP filer store.
+
+Implements just the command subset RedisStore speaks (SET/GET/DEL/
+ZADD/ZREM/ZRANGE/ZRANGEBYLEX/AUTH/SELECT/PING/FLUSHALL) with real RESP2
+framing, so the store's socket client is tested against an actual wire
+protocol rather than a monkeypatch — the same spirit as the
+reference's docker-compose redis test variants, minus the container.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class MiniRedis:
+    def __init__(self):
+        self.kv: dict[bytes, bytes] = {}
+        self.zsets: dict[bytes, set[bytes]] = {}
+        self.lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- plumbing -------------------------------------------------------
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf2 = buf.split(b"\r\n", 1)
+            buf = buf2
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n + 2:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            data, buf = buf[:n], buf[n + 2:]
+            return data
+
+        try:
+            while True:
+                line = read_line()
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    return
+                argc = int(line[1:])
+                args = []
+                for _ in range(argc):
+                    hdr = read_line()
+                    assert hdr.startswith(b"$")
+                    args.append(read_exact(int(hdr[1:])))
+                conn.sendall(self._dispatch(args))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- replies --------------------------------------------------------
+    @staticmethod
+    def _bulk(v: bytes | None) -> bytes:
+        return b"$-1\r\n" if v is None else \
+            b"$%d\r\n%s\r\n" % (len(v), v)
+
+    @staticmethod
+    def _arr(items: list[bytes]) -> bytes:
+        return b"*%d\r\n" % len(items) + \
+            b"".join(MiniRedis._bulk(i) for i in items)
+
+    # -- commands -------------------------------------------------------
+    def _dispatch(self, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd in (b"PING",):
+                return b"+PONG\r\n"
+            if cmd in (b"AUTH", b"SELECT", b"FLUSHALL"):
+                if cmd == b"FLUSHALL":
+                    self.kv.clear()
+                    self.zsets.clear()
+                return b"+OK\r\n"
+            if cmd == b"SET":
+                self.kv[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                return self._bulk(self.kv.get(args[1]))
+            if cmd == b"DEL":
+                n = 0
+                for k in args[1:]:
+                    n += self.kv.pop(k, None) is not None
+                    n += self.zsets.pop(k, None) is not None
+                return b":%d\r\n" % n
+            if cmd == b"ZADD":
+                z = self.zsets.setdefault(args[1], set())
+                added = 0
+                for member in args[3::2]:
+                    added += member not in z
+                    z.add(member)
+                return b":%d\r\n" % added
+            if cmd == b"ZREM":
+                z = self.zsets.get(args[1], set())
+                n = 0
+                for member in args[2:]:
+                    if member in z:
+                        z.discard(member)
+                        n += 1
+                return b":%d\r\n" % n
+            if cmd == b"ZRANGE":
+                members = sorted(self.zsets.get(args[1], set()))
+                start, stop = int(args[2]), int(args[3])
+                if stop == -1:
+                    stop = len(members) - 1
+                return self._arr(members[start:stop + 1])
+            if cmd == b"ZRANGEBYLEX":
+                members = sorted(self.zsets.get(args[1], set()))
+                lo, hi = args[2], args[3]
+
+                def above_lo(m):
+                    if lo == b"-":
+                        return True
+                    if lo.startswith(b"["):
+                        return m >= lo[1:]
+                    return m > lo[1:]
+
+                def below_hi(m):
+                    if hi == b"+":
+                        return True
+                    if hi.startswith(b"["):
+                        return m <= hi[1:]
+                    return m < hi[1:]
+
+                sel = [m for m in members if above_lo(m) and below_hi(m)]
+                if len(args) >= 7 and args[4].upper() == b"LIMIT":
+                    off, cnt = int(args[5]), int(args[6])
+                    sel = sel[off:] if cnt < 0 else sel[off:off + cnt]
+                return self._arr(sel)
+        return b"-ERR unknown command %s\r\n" % cmd
