@@ -14,6 +14,20 @@ import os
 import sys
 
 
+def _ssl_ctx(args):
+    """Build the server SSLContext from -security (None = plain HTTP).
+    Applied to control-plane/gateway listeners (master, follower,
+    filer, s3, webdav, iam, mq); the volume HTTP data path stays
+    plain like the reference's (tls.go wraps gRPC, not the blob
+    HTTP port)."""
+    path = getattr(args, "security", "")
+    if not path:
+        return None
+    from .utils.tls import context_from_config, load_security_config
+
+    return context_from_config(load_security_config(path))
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="seaweedfs-tpu",
@@ -23,6 +37,13 @@ def main(argv: list[str] | None = None) -> int:
         help="write a cProfile dump here on exit (the reference's "
              "grace.SetupProfiling, util/grace/pprof.go:11); place "
              "BEFORE the subcommand")
+    parser.add_argument(
+        "-security", default="",
+        help="path to a security config JSON (scaffold "
+             "-config=security): enables HTTPS (+ optional mutual "
+             "TLS) on this process's listeners; place BEFORE the "
+             "subcommand. Clients trust the CA via REQUESTS_CA_BUNDLE/"
+             "SSL_CERT_FILE")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("master", help="start a master server")
@@ -344,8 +365,8 @@ def _dispatch(args) -> int:
                    else f"http://{m.strip()}"
                    for m in args.masters.split(",") if m.strip()]
         mf = MasterFollower(masters)
-        t = ServerThread(mf.build_app(), host=args.ip,
-                         port=args.port).start()
+        t = ServerThread(mf.build_app(), host=args.ip, port=args.port,
+                         ssl_context=_ssl_ctx(args)).start()
         print(f"master follower listening on {t.url}, "
               f"following {masters}")
         run_apps_forever([t])
@@ -485,7 +506,8 @@ def _dispatch(args) -> int:
         from .webdav.server import WebDavServer
 
         w = WebDavServer(args.filer, root=args.filer_path)
-        t = ServerThread(w.app, host=args.ip, port=args.port).start()
+        t = ServerThread(w.app, host=args.ip, port=args.port,
+                         ssl_context=_ssl_ctx(args)).start()
         print(f"webdav listening on {t.url}")
         run_apps_forever([t])
         return 0
@@ -494,7 +516,8 @@ def _dispatch(args) -> int:
         from .rpc.http import ServerThread, run_apps_forever
 
         i = IamApiServer(args.filer)
-        t = ServerThread(i.app, host=args.ip, port=args.port).start()
+        t = ServerThread(i.app, host=args.ip, port=args.port,
+                         ssl_context=_ssl_ctx(args)).start()
         print(f"iam api listening on {t.url}")
         run_apps_forever([t])
         return 0
@@ -563,7 +586,8 @@ def _run_master(args) -> int:
                       raft_state_dir=raft_dir or None,
                       admin_scripts=scripts,
                       admin_script_interval=args.admin_script_interval)
-    t = ServerThread(ms.app, host=args.ip, port=args.port).start()
+    t = ServerThread(ms.app, host=args.ip, port=args.port,
+                     ssl_context=_ssl_ctx(args)).start()
     ms.admin_scripts_url = t.url
     print(f"master listening on {t.url}")
     run_apps_forever([t])
@@ -640,7 +664,8 @@ def _run_filer(args) -> int:
                      collection=args.collection,
                      replication=args.replication,
                      store_options=store_options)
-    t = ServerThread(fs.app, host=args.ip, port=args.port).start()
+    t = ServerThread(fs.app, host=args.ip, port=args.port,
+                     ssl_context=_ssl_ctx(args)).start()
     fs.address = t.address
     print(f"filer listening on {t.url} (store={args.store})")
     run_apps_forever([t])
@@ -658,7 +683,8 @@ def _run_s3(args) -> int:
         with open(args.config) as f:
             config = json.load(f)
     s3 = S3ApiServer(filer, iam_config=config)
-    t = ServerThread(s3.app, host=args.ip, port=args.port).start()
+    t = ServerThread(s3.app, host=args.ip, port=args.port,
+                     ssl_context=_ssl_ctx(args)).start()
     print(f"s3 gateway listening on {t.url}")
     run_apps_forever([t])
     return 0

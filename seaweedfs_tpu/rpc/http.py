@@ -34,10 +34,12 @@ class ServerThread:
     process for tests and `weed server`-style combined startup."""
 
     def __init__(self, app_factory: Callable[[], Awaitable[web.Application]]
-                 | web.Application, host: str = "127.0.0.1", port: int = 0):
+                 | web.Application, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self._app_factory = app_factory
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self.loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
@@ -64,7 +66,8 @@ class ServerThread:
         self.app = app
         self._runner = web.AppRunner(app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=self.ssl_context)
         await site.start()
         # resolve ephemeral port
         server = site._server
@@ -74,7 +77,8 @@ class ServerThread:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.ssl_context is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     @property
     def address(self) -> str:

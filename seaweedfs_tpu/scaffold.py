@@ -34,8 +34,13 @@ TEMPLATES: dict[str, dict] = {
     "security": {
         "//": "shared JWT secret: volume servers verify write tokens "
               "minted by the master (security.toml jwt.signing "
-              "equivalent). Empty disables auth.",
+              "equivalent). Empty disables auth. The https section "
+              "(security.toml [https.*] equivalent) enables TLS on "
+              "control/gateway listeners when passed via the global "
+              "-security flag; ca + client_auth turns on mutual TLS.",
         "jwt.secret": "change-me",
+        "https": {"cert": "", "key": "", "ca": "",
+                  "client_auth": False},
     },
     "replication": {
         "//": "sink for `filer.replicate` (replication.toml "

@@ -1,0 +1,174 @@
+"""TLS for the HTTP/WebSocket plane.
+
+Equivalent of /root/reference/weed/security/tls.go — the reference
+loads per-component cert/key/ca from security.toml and wires mutual
+TLS into its gRPC channels. Here the transport is HTTP(S), so the
+same configuration becomes ssl.SSLContext objects handed to the
+aiohttp servers (rpc/http.py ServerThread) and, client-side, trusted
+via the standard env vars (REQUESTS_CA_BUNDLE / SSL_CERT_FILE), which
+requests and aiohttp both honor.
+
+Config shape (JSON, `scaffold -config=security`):
+
+    {"https": {"cert": "/path/server.crt", "key": "/path/server.key",
+               "ca": "/path/ca.crt", "client_auth": false}}
+
+`ca` + `client_auth: true` enables mutual TLS: only clients bearing a
+certificate signed by that CA may connect.
+
+generate_self_signed() mints a throwaway CA + server pair (tests,
+quick starts) using the cryptography package when present, falling
+back to the openssl binary.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import ssl
+import subprocess
+
+
+def load_security_config(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def server_ssl_context(cert: str, key: str, ca: str = "",
+                       client_auth: bool = False) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    if ca:
+        ctx.load_verify_locations(ca)
+        if client_auth:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(ca: str = "", cert: str = "",
+                       key: str = "") -> ssl.SSLContext:
+    ctx = ssl.create_default_context(
+        cafile=ca or None)
+    if cert:
+        ctx.load_cert_chain(cert, key or None)
+    return ctx
+
+
+def context_from_config(conf: dict) -> ssl.SSLContext | None:
+    https = conf.get("https", {})
+    if not https.get("cert"):
+        return None
+    return server_ssl_context(https["cert"], https["key"],
+                              ca=https.get("ca", ""),
+                              client_auth=https.get("client_auth", False))
+
+
+def generate_self_signed(out_dir: str, cn: str = "localhost",
+                         sans: tuple[str, ...] = ("localhost",
+                                                  "127.0.0.1")) -> dict:
+    """Mint ca.crt/ca.key + server.crt/server.key (+ client pair)
+    under out_dir; returns the path map."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {n: os.path.join(out_dir, f)
+             for n, f in (("ca_cert", "ca.crt"), ("ca_key", "ca.key"),
+                          ("cert", "server.crt"), ("key", "server.key"),
+                          ("client_cert", "client.crt"),
+                          ("client_key", "client.key"))}
+    try:
+        _generate_with_cryptography(paths, cn, sans)
+    except ImportError:  # pragma: no cover - image ships cryptography
+        _generate_with_openssl(paths, cn, sans)
+    return paths
+
+
+def _generate_with_cryptography(paths: dict, cn: str,
+                                sans: tuple[str, ...]) -> None:
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    def keypair():
+        return ec.generate_private_key(ec.SECP256R1())
+
+    def write_key(key, path):
+        with open(path, "wb") as f:
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()))
+
+    def write_cert(cert, path):
+        with open(path, "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    week = now + datetime.timedelta(days=7)
+
+    ca_key = keypair()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "seaweedfs-test-ca")])
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(ca_name).issuer_name(ca_name)
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now).not_valid_after(week)
+               .add_extension(x509.BasicConstraints(ca=True,
+                                                    path_length=None),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+    write_key(ca_key, paths["ca_key"])
+    write_cert(ca_cert, paths["ca_cert"])
+
+    san_list = []
+    for s in sans:
+        try:
+            san_list.append(x509.IPAddress(ipaddress.ip_address(s)))
+        except ValueError:
+            san_list.append(x509.DNSName(s))
+
+    for role, cert_p, key_p in (("server", paths["cert"], paths["key"]),
+                                ("client", paths["client_cert"],
+                                 paths["client_key"])):
+        key = keypair()
+        cert = (x509.CertificateBuilder()
+                .subject_name(x509.Name([x509.NameAttribute(
+                    NameOID.COMMON_NAME, cn if role == "server"
+                    else "seaweedfs-client")]))
+                .issuer_name(ca_name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now).not_valid_after(week)
+                .add_extension(x509.SubjectAlternativeName(san_list),
+                               critical=False)
+                .sign(ca_key, hashes.SHA256()))
+        write_key(key, key_p)
+        write_cert(cert, cert_p)
+
+
+def _generate_with_openssl(paths: dict, cn: str,
+                           sans: tuple[str, ...]) -> None:
+    san = ",".join(
+        (f"IP:{s}" if s.replace(".", "").isdigit() else f"DNS:{s}")
+        for s in sans)
+    def run(*a, **kw):
+        subprocess.run(a, check=True, capture_output=True, **kw)
+    run("openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+        "ec_paramgen_curve:prime256v1", "-keyout", paths["ca_key"],
+        "-out", paths["ca_cert"], "-days", "7", "-nodes",
+        "-subj", "/CN=seaweedfs-test-ca")
+    for role, cert_p, key_p in (("server", paths["cert"], paths["key"]),
+                                ("client", paths["client_cert"],
+                                 paths["client_key"])):
+        csr = cert_p + ".csr"
+        run("openssl", "req", "-newkey", "ec", "-pkeyopt",
+            "ec_paramgen_curve:prime256v1", "-keyout", key_p,
+            "-out", csr, "-nodes", "-subj", f"/CN={cn}")
+        run("openssl", "x509", "-req", "-in", csr, "-CA",
+            paths["ca_cert"], "-CAkey", paths["ca_key"],
+            "-CAcreateserial", "-out", cert_p, "-days", "7",
+            "-extfile", "/dev/stdin",
+            input=f"subjectAltName={san}".encode())
+        os.remove(csr)

@@ -1,0 +1,140 @@
+"""TLS on the HTTP plane: cert generation, HTTPS listeners, mTLS.
+
+Counterpart of the reference's weed/security/tls.go configuration
+(there applied to gRPC channels; here to the aiohttp listeners).
+"""
+import json
+import ssl
+
+import pytest
+import requests
+
+from seaweedfs_tpu.rpc.http import ServerThread, json_ok
+from seaweedfs_tpu.utils import tls
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    return tls.generate_self_signed(
+        str(tmp_path_factory.mktemp("certs")))
+
+
+@pytest.fixture(scope="module")
+def https_server(certs):
+    from aiohttp import web
+
+    async def hello(req):
+        return json_ok({"ok": True})
+
+    app = web.Application()
+    app.add_routes([web.get("/status", hello)])
+    ctx = tls.server_ssl_context(certs["cert"], certs["key"])
+    t = ServerThread(app, ssl_context=ctx).start()
+    yield t
+    t.stop()
+
+
+def test_url_scheme_and_verified_fetch(https_server, certs):
+    assert https_server.url.startswith("https://")
+    r = requests.get(f"{https_server.url}/status",
+                     verify=certs["ca_cert"])
+    assert r.status_code == 200 and r.json()["ok"] is True
+
+
+def test_untrusted_ca_rejected(https_server):
+    with pytest.raises(requests.exceptions.SSLError):
+        requests.get(f"{https_server.url}/status", verify=True)
+
+
+def test_plain_http_to_tls_port_fails(https_server):
+    with pytest.raises(requests.RequestException):
+        requests.get(f"http://127.0.0.1:{https_server.port}/status",
+                     timeout=3)
+
+
+class TestMutualTLS:
+    @pytest.fixture(scope="class")
+    def mtls_server(self, certs):
+        from aiohttp import web
+
+        async def hello(req):
+            return json_ok({"mtls": True})
+
+        app = web.Application()
+        app.add_routes([web.get("/status", hello)])
+        ctx = tls.server_ssl_context(certs["cert"], certs["key"],
+                                     ca=certs["ca_cert"],
+                                     client_auth=True)
+        t = ServerThread(app, ssl_context=ctx).start()
+        yield t
+        t.stop()
+
+    def test_client_cert_required(self, mtls_server, certs):
+        with pytest.raises(requests.RequestException):
+            requests.get(f"{mtls_server.url}/status",
+                         verify=certs["ca_cert"], timeout=3)
+        r = requests.get(f"{mtls_server.url}/status",
+                         verify=certs["ca_cert"],
+                         cert=(certs["client_cert"],
+                               certs["client_key"]))
+        assert r.json()["mtls"] is True
+
+
+def test_context_from_config(certs, tmp_path):
+    conf = {"https": {"cert": certs["cert"], "key": certs["key"]}}
+    ctx = tls.context_from_config(conf)
+    assert isinstance(ctx, ssl.SSLContext)
+    assert tls.context_from_config({"https": {}}) is None
+    assert tls.context_from_config({}) is None
+    p = tmp_path / "sec.json"
+    p.write_text(json.dumps(conf))
+    assert isinstance(
+        tls.context_from_config(tls.load_security_config(str(p))),
+        ssl.SSLContext)
+
+
+def test_cli_master_with_security(certs, tmp_path):
+    """End-to-end: a master started with -security serves HTTPS."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    sec = tmp_path / "security.json"
+    sec.write_text(json.dumps(
+        {"https": {"cert": certs["cert"], "key": certs["key"]}}))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "-security", str(sec),
+         "master", "-port", str(port)],
+        env=dict(os.environ, PYTHONPATH=repo),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 30
+        last = None
+        while time.time() < deadline:
+            try:
+                r = requests.get(
+                    f"https://127.0.0.1:{port}/cluster/status",
+                    verify=certs["ca_cert"], timeout=2)
+                assert r.status_code == 200
+                break
+            except requests.RequestException as e:
+                last = e
+                if proc.poll() is not None:
+                    raise RuntimeError(proc.stdout.read())
+                time.sleep(0.3)
+        else:
+            raise TimeoutError(str(last))
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
