@@ -128,6 +128,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-config", default="",
                    help="json file with s3 identities")
 
+    p = sub.add_parser("ftp", help="start an FTP gateway")
+    p.add_argument("-port", type=int, default=8021)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-filer.path", dest="filer_path", default="/")
+    p.add_argument("-user", default="",
+                   help="user:password (empty = anonymous)")
+
     p = sub.add_parser("filer.replicate",
                        help="mirror filer changes into a sink")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
@@ -490,6 +498,25 @@ def _dispatch(args) -> int:
                 _t.sleep(3600)
         except KeyboardInterrupt:
             b.stop()
+        return 0
+    if args.cmd == "ftp":
+        import time as _t
+
+        from .ftpd import FtpServer
+
+        users = {}
+        if args.user:
+            u, _, pw = args.user.partition(":")
+            users[u] = pw
+        f = FtpServer(args.filer, port=args.port, host=args.ip,
+                      root=args.filer_path, users=users,
+                      anonymous=not users).start()
+        print(f"ftp gateway listening on {args.ip}:{f.port}")
+        try:
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            f.stop()
         return 0
     if args.cmd == "mq.broker":
         from .mq.broker import BrokerServer

@@ -1,0 +1,119 @@
+"""FTP gateway e2e with the stdlib ftplib client against a live
+in-process cluster — upload, download, listing, rename, delete,
+directories, resume, auth.
+"""
+import ftplib
+import io
+
+import pytest
+
+from seaweedfs_tpu.ftpd import FtpServer
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("ftp_cluster")),
+                n_volume_servers=1, volume_size_limit=8 << 20,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def ftp_srv(cluster):
+    s = FtpServer(cluster.filer_url, port=0,
+                  users={"admin": "secret"}, anonymous=False).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def ftp(ftp_srv):
+    c = ftplib.FTP()
+    c.connect("127.0.0.1", ftp_srv.port, timeout=10)
+    c.login("admin", "secret")
+    yield c
+    try:
+        c.quit()
+    except ftplib.all_errors:
+        pass
+
+
+def test_bad_login_rejected(ftp_srv):
+    c = ftplib.FTP()
+    c.connect("127.0.0.1", ftp_srv.port, timeout=10)
+    with pytest.raises(ftplib.error_perm):
+        c.login("admin", "wrong")
+    c.close()
+
+
+def test_store_retrieve_roundtrip(ftp):
+    payload = b"ftp payload " * 1000
+    ftp.storbinary("STOR big.bin", io.BytesIO(payload))
+    out = io.BytesIO()
+    ftp.retrbinary("RETR big.bin", out.write)
+    assert out.getvalue() == payload
+    assert ftp.size("big.bin") == len(payload)
+
+
+def test_listing_and_dirs(ftp):
+    ftp.mkd("photos")
+    ftp.cwd("photos")
+    assert ftp.pwd() == "/photos"
+    ftp.storbinary("STOR a.jpg", io.BytesIO(b"JPEG"))
+    ftp.storbinary("STOR b.jpg", io.BytesIO(b"JPEG2"))
+    names = ftp.nlst()
+    assert sorted(names) == ["a.jpg", "b.jpg"]
+    lines = []
+    ftp.retrlines("LIST", lines.append)
+    assert any("a.jpg" in l for l in lines)
+    ftp.cwd("/")
+    assert "photos" in ftp.nlst()
+
+
+def test_rename_and_delete(ftp):
+    ftp.storbinary("STOR old.txt", io.BytesIO(b"data"))
+    ftp.rename("old.txt", "new.txt")
+    assert "new.txt" in ftp.nlst()
+    assert "old.txt" not in ftp.nlst()
+    ftp.delete("new.txt")
+    assert "new.txt" not in ftp.nlst()
+
+
+def test_rmd_recursive(ftp):
+    ftp.mkd("scratch")
+    ftp.storbinary("STOR scratch/x.txt", io.BytesIO(b"x"))
+    ftp.rmd("scratch")
+    assert "scratch" not in ftp.nlst()
+
+
+def test_append(ftp):
+    ftp.storbinary("STOR log.txt", io.BytesIO(b"one\n"))
+    ftp.storbinary("APPE log.txt", io.BytesIO(b"two\n"))
+    out = io.BytesIO()
+    ftp.retrbinary("RETR log.txt", out.write)
+    assert out.getvalue() == b"one\ntwo\n"
+
+
+def test_rest_resume(ftp):
+    payload = bytes(range(256)) * 16
+    ftp.storbinary("STOR seek.bin", io.BytesIO(payload))
+    out = io.BytesIO()
+    ftp.retrbinary("RETR seek.bin", out.write, rest=100)
+    assert out.getvalue() == payload[100:]
+
+
+def test_mdtm_and_missing(ftp):
+    ftp.storbinary("STOR t.txt", io.BytesIO(b"t"))
+    resp = ftp.sendcmd("MDTM t.txt")
+    assert resp.startswith("213 ")
+    with pytest.raises(ftplib.error_perm):
+        ftp.size("missing.txt")
+
+
+def test_visible_via_filer_http(ftp, cluster):
+    import requests
+    ftp.storbinary("STOR shared.txt", io.BytesIO(b"cross-gateway"))
+    r = requests.get(f"{cluster.filer_url}/shared.txt")
+    assert r.status_code == 200 and r.content == b"cross-gateway"

@@ -41,10 +41,12 @@ class TestScaffold:
         assert "admin.scripts" in open(dest).read()
 
 
-class TestFtpStub:
-    def test_start_explains_status(self):
-        with pytest.raises(NotImplementedError):
-            FtpServer("http://filer:8888").start()
+class TestFtpGateway:
+    def test_start_binds_and_stops(self):
+        # full protocol coverage lives in tests/test_ftp.py
+        s = FtpServer("http://filer:8888", port=0).start()
+        assert s.port > 0
+        s.stop()
 
 
 class TestGatewayMetrics:
