@@ -219,6 +219,18 @@ def main(argv: list[str] | None = None) -> int:
                    help="extra comma-separated fuse options "
                         "(allow_other, ro, ...)")
 
+    p = sub.add_parser(
+        "fuse",
+        help="/sbin/mount.fuse-style mount helper: "
+             "`fuse <mountpoint> -o filer=...,filer.path=/,ro` "
+             "(the reference's weed fuse, command/fuse.go) — lets "
+             "/etc/fstab mount a filer via `mount -t fuse.seaweedfs`")
+    p.add_argument("mountpoint")
+    p.add_argument("-o", dest="fuse_options", default="",
+                   help="comma-separated key=value options; recognised: "
+                        "filer, filer.path, collection, replication, "
+                        "cacheDir; everything else passes to fuse")
+
     p = sub.add_parser("shell", help="interactive admin shell")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-filer", default="",
@@ -555,6 +567,26 @@ def _dispatch(args) -> int:
               options=args.mount_options or None,
               cache_dir=args.cache_dir or None,
               collection=args.collection, replication=args.replication)
+        return 0
+    if args.cmd == "fuse":
+        from .mount.fuse_adapter import mount
+
+        known = {"filer": "http://127.0.0.1:8888", "filer.path": "/",
+                 "collection": "", "replication": "", "cacheDir": ""}
+        passthrough = []
+        for opt in (args.fuse_options or "").split(","):
+            if not opt:
+                continue
+            k, sep, v = opt.partition("=")
+            if k in known:
+                known[k] = v if sep else "true"
+            else:
+                passthrough.append(opt)
+        mount(known["filer"], args.mountpoint, root=known["filer.path"],
+              options=",".join(passthrough) or None,
+              cache_dir=known["cacheDir"] or None,
+              collection=known["collection"],
+              replication=known["replication"])
         return 0
     if args.cmd == "shell":
         from .shell.repl import run_shell

@@ -567,6 +567,19 @@ class MasterServer:
     async def handle_metrics(self, req: web.Request) -> web.Response:
         from ..utils import metrics
 
+        with self.topo.lock:
+            metrics.gauge_set("master_volume_servers",
+                              len(self.topo.nodes))
+            metrics.gauge_set("master_ec_volumes",
+                              len(self.topo.ec_locations))
+            metrics.gauge_set("master_max_volume_id",
+                              self.topo.max_volume_id)
+            for key, layout in self.topo.layouts.items():
+                lab = {"collection": key.collection or "default"}
+                metrics.gauge_set("master_volumes",
+                                  len(layout.locations), lab)
+                metrics.gauge_set("master_writable_volumes",
+                                  len(layout.writable), lab)
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

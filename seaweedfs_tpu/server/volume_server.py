@@ -1114,6 +1114,39 @@ class VolumeServer:
         return web.json_response({"Version": "seaweedfs-tpu", **hb})
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
+        # disk gauges recomputed at scrape time (the reference keeps
+        # volume/EC size gauges in stats/metrics.go + store_ec.go:41)
+        by_col: dict[str, dict] = {}
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                s = by_col.setdefault(v.collection,
+                                      {"n": 0, "bytes": 0, "files": 0})
+                s["n"] += 1
+                s["bytes"] += v.content_size()
+                s["files"] += v.nm.file_count
+        for col, s in by_col.items():
+            lab = {"collection": col or "default"}
+            metrics.gauge_set("volume_server_volumes", s["n"], lab)
+            metrics.gauge_set("volume_server_total_disk_size",
+                              s["bytes"], lab)
+            metrics.gauge_set("volume_server_file_count", s["files"], lab)
+        ec_by_col: dict[str, dict] = {}
+        for ecv in self.store.ec_volumes.values():
+            s = ec_by_col.setdefault(ecv.collection,
+                                     {"shards": 0, "bytes": 0})
+            n = ecv.shard_bits().count()
+            s["shards"] += n
+            try:
+                s["bytes"] += n * ecv.shard_size()
+            except Exception:
+                pass
+        for col, s in ec_by_col.items():
+            lab = {"collection": col or "default"}
+            metrics.gauge_set("volume_server_ec_shards", s["shards"], lab)
+            metrics.gauge_set("volume_server_ec_bytes", s["bytes"], lab)
+        metrics.gauge_set(
+            "volume_server_max_volumes",
+            sum(l.max_volumes for l in self.store.locations))
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

@@ -63,6 +63,15 @@ class TestGatewayMetrics:
             assert 's3_requests_total{code="200",method="PUT"}' in m
             fm = requests.get(f"{c.filer_url}/metrics").text
             assert "filer_request_seconds_count" in fm
+            # scrape-time disk/topology gauges (store_ec.go:41 /
+            # stats/metrics.go counterparts)
+            vm = requests.get(c.volume_url(0) + "/metrics").text
+            assert "volume_server_volumes{" in vm
+            assert "volume_server_total_disk_size{" in vm
+            assert "volume_server_max_volumes" in vm
+            mm = requests.get(f"{c.master_url}/metrics").text
+            assert "master_volume_servers" in mm
+            assert "master_writable_volumes{" in mm
         finally:
             c.stop()
 
