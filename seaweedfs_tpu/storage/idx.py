@@ -15,8 +15,19 @@ import numpy as np
 
 from . import types as t
 
-IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">u4")])
-assert IDX_DTYPE.itemsize == t.NEEDLE_MAP_ENTRY_SIZE
+if t.OFFSET_SIZE == 4:
+    # logical layout == disk layout
+    IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"),
+                          ("size", ">u4")])
+    _RAW_DTYPE = IDX_DTYPE
+else:
+    # 5BytesOffset variant (offset_5bytes.go): on disk the offset is
+    # 4 BE lower bytes then 1 high byte; in memory a uniform u8 column
+    IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u8"),
+                          ("size", ">u4")])
+    _RAW_DTYPE = np.dtype([("key", ">u8"), ("off_lo", ">u4"),
+                           ("off_hi", "u1"), ("size", ">u4")])
+assert _RAW_DTYPE.itemsize == t.NEEDLE_MAP_ENTRY_SIZE
 
 
 def read_index(path: str) -> np.ndarray:
@@ -25,12 +36,28 @@ def read_index(path: str) -> np.ndarray:
     usable = (size // t.NEEDLE_MAP_ENTRY_SIZE) * t.NEEDLE_MAP_ENTRY_SIZE
     with open(path, "rb") as f:
         buf = f.read(usable)
-    return np.frombuffer(buf, dtype=IDX_DTYPE)
+    raw = np.frombuffer(buf, dtype=_RAW_DTYPE)
+    if _RAW_DTYPE is IDX_DTYPE:
+        return raw
+    arr = np.empty(len(raw), dtype=IDX_DTYPE)
+    arr["key"] = raw["key"]
+    arr["offset"] = (raw["off_hi"].astype(np.uint64) << 32) | \
+        raw["off_lo"].astype(np.uint64)
+    arr["size"] = raw["size"]
+    return arr
 
 
 def write_index(path: str, entries: np.ndarray) -> None:
+    entries = np.ascontiguousarray(entries, dtype=IDX_DTYPE)
+    if _RAW_DTYPE is not IDX_DTYPE:
+        raw = np.empty(len(entries), dtype=_RAW_DTYPE)
+        raw["key"] = entries["key"]
+        raw["off_lo"] = entries["offset"] & 0xFFFFFFFF
+        raw["off_hi"] = entries["offset"] >> 32
+        raw["size"] = entries["size"]
+        entries = raw
     with open(path, "wb") as f:
-        f.write(np.ascontiguousarray(entries, dtype=IDX_DTYPE).tobytes())
+        f.write(entries.tobytes())
 
 
 def append_entry(f, key: int, offset: int, size: int) -> None:

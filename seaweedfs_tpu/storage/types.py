@@ -2,24 +2,46 @@
 
 Byte-compatible with the reference formats (so fixtures and tools
 interoperate): /root/reference/weed/storage/types/needle_types.go:33-40 and
-offset_4bytes.go:14-17. Offsets are stored in units of NEEDLE_PADDING (8
-bytes) as 4-byte big-endian, giving a 32GB max volume; sizes are int32 with
--1 as the tombstone marker.
+offset_4bytes.go:14-17 / offset_5bytes.go:14-17. Offsets are stored in
+units of NEEDLE_PADDING (8 bytes); the default 4-byte big-endian form
+gives a 32GB max volume. Setting WEED_5BYTES_OFFSET=1 in the
+environment selects the reference's `5BytesOffset` build-tag variant:
+17-byte index entries whose offset is 4 BE lower bytes followed by one
+high byte (offset_5bytes.go OffsetToBytes order), raising the ceiling
+to 8PB volumes. Like the build tag, the choice is process-wide and
+must match the files on disk. Sizes are int32 with -1 as the tombstone
+marker.
 """
 from __future__ import annotations
 
+import os as _os
 from dataclasses import dataclass
 
 NEEDLE_ID_SIZE = 8
-OFFSET_SIZE = 4
+OFFSET_SIZE = 5 if _os.environ.get("WEED_5BYTES_OFFSET") == "1" else 4
 SIZE_SIZE = 4
 COOKIE_SIZE = 4
 NEEDLE_PADDING = 8
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
-NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16 / 17
 TIMESTAMP_SIZE = 8
 TOMBSTONE_SIZE = -1  # Size value marking a deleted needle
-MAX_VOLUME_SIZE = 8 * (1 << 32)  # 32GB with 4-byte padded offsets
+# 32GB with 4-byte padded offsets; 8PB with 5
+MAX_VOLUME_SIZE = NEEDLE_PADDING * (1 << (8 * OFFSET_SIZE))
+
+
+def offset_to_disk_bytes(offset: int) -> bytes:
+    """Stored (padded-unit) offset -> its on-disk index encoding."""
+    if OFFSET_SIZE == 4:
+        return offset.to_bytes(4, "big")
+    return (offset & 0xFFFFFFFF).to_bytes(4, "big") + \
+        bytes([offset >> 32])
+
+
+def disk_bytes_to_offset(b: bytes) -> int:
+    if OFFSET_SIZE == 4:
+        return int.from_bytes(b[:4], "big")
+    return (b[4] << 32) | int.from_bytes(b[:4], "big")
 
 SIZE_MASK = 0xFFFFFFFF
 
@@ -65,14 +87,15 @@ class NeedleValue:
 
     def to_bytes(self) -> bytes:
         return (self.key.to_bytes(NEEDLE_ID_SIZE, "big")
-                + self.offset.to_bytes(OFFSET_SIZE, "big")
+                + offset_to_disk_bytes(self.offset)
                 + size_to_u32(self.size).to_bytes(SIZE_SIZE, "big"))
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "NeedleValue":
         key = int.from_bytes(b[:8], "big")
-        offset = int.from_bytes(b[8:12], "big")
-        size = u32_to_size(int.from_bytes(b[12:16], "big"))
+        offset = disk_bytes_to_offset(b[8:8 + OFFSET_SIZE])
+        size = u32_to_size(int.from_bytes(
+            b[8 + OFFSET_SIZE:8 + OFFSET_SIZE + SIZE_SIZE], "big"))
         return cls(key, offset, size)
 
 

@@ -1,0 +1,87 @@
+"""5-byte offset variant (the reference's `5BytesOffset` build tag,
+offset_5bytes.go): 17-byte index entries, 8PB volume ceiling.
+
+The mode is process-wide (selected at import via WEED_5BYTES_OFFSET=1,
+like a build tag), so the full storage/EC behavior check runs the
+existing suites in a subprocess with the env set; in-process tests here
+only verify the byte layout contract against hand-built fixtures.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def in_5b_subprocess(code: str) -> str:
+    env = dict(os.environ, WEED_5BYTES_OFFSET="1", PYTHONPATH=REPO,
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def test_entry_layout_matches_reference_order():
+    """offset_5bytes.go OffsetToBytes: 4 BE lower bytes then high byte."""
+    out = in_5b_subprocess("""
+from seaweedfs_tpu.storage import types as t
+assert t.OFFSET_SIZE == 5 and t.NEEDLE_MAP_ENTRY_SIZE == 17
+assert t.MAX_VOLUME_SIZE == 8 * (1 << 40)
+v = t.NeedleValue(0x1122334455667788, (7 << 32) | 0xAABBCCDD, 4096)
+b = v.to_bytes()
+assert len(b) == 17
+assert b[:8] == bytes.fromhex("1122334455667788")
+assert b[8:12] == bytes.fromhex("AABBCCDD")   # lower 4, big-endian
+assert b[12] == 7                             # high byte appended
+assert b[13:] == (4096).to_bytes(4, "big")
+r = t.NeedleValue.from_bytes(b)
+assert (r.key, r.offset, r.size) == (v.key, v.offset, v.size)
+print("layout-ok")
+""")
+    assert "layout-ok" in out
+
+
+def test_idx_numpy_roundtrip_above_32gb():
+    out = in_5b_subprocess("""
+import numpy as np, tempfile, os
+from seaweedfs_tpu.storage import idx, types as t
+arr = np.zeros(3, dtype=idx.IDX_DTYPE)
+arr["key"] = [1, 2, 3]
+# stored offsets beyond the 4-byte range (volume > 32GB)
+arr["offset"] = [10, 1 << 33, (1 << 39) + 5]
+arr["size"] = [100, 200, 300]
+p = os.path.join(tempfile.mkdtemp(), "x.idx")
+idx.write_index(p, arr)
+assert os.path.getsize(p) == 3 * 17
+back = idx.read_index(p)
+assert list(back["offset"]) == [10, 1 << 33, (1 << 39) + 5]
+assert list(back["key"]) == [1, 2, 3]
+# append_entry agrees with the vectorized writer
+with open(p, "ab") as f:
+    idx.append_entry(f, 4, (1 << 38) + 1, 400)
+back = idx.read_index(p)
+assert int(back["offset"][-1]) == (1 << 38) + 1
+print("idx-ok")
+""")
+    assert "idx-ok" in out
+
+
+def test_default_mode_unchanged():
+    from seaweedfs_tpu.storage import types as t
+    assert t.OFFSET_SIZE == 4
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 16
+    assert t.MAX_VOLUME_SIZE == 8 * (1 << 32)
+
+
+def test_storage_and_ec_suites_under_5bytes():
+    """The real check: the whole storage engine + EC golden tests pass
+    with 17-byte entries."""
+    env = dict(os.environ, WEED_5BYTES_OFFSET="1", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         "tests/test_storage.py", "tests/test_ec_files.py",
+         "tests/test_needle_map_compact.py",
+         "tests/test_crash_recovery.py"],
+        env=env, capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]

@@ -143,7 +143,11 @@ class TestS3Select:
         r = requests.post(f"{s3}/logs/day1.ndjson?select&select-type=2",
                           data=xml.encode())
         assert r.status_code == 200, r.text
-        rows = [json.loads(line) for line in r.text.splitlines()]
+        from seaweedfs_tpu.s3.eventstream import decode_messages
+        records = b"".join(m.payload for m in decode_messages(r.content)
+                           if m.event_type == "Records")
+        rows = [json.loads(line)
+                for line in records.decode().splitlines()]
         assert rows == [{"ms": 12}, {"ms": 33}]
 
     def test_select_bad_sql(self, cluster):

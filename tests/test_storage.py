@@ -84,7 +84,7 @@ class TestIdx:
         arr[1] = (2, 20, 200)
         arr[2] = (1, 0, t.size_to_u32(t.TOMBSTONE_SIZE))
         idxmod.write_index(p, arr)
-        assert os.path.getsize(p) == 48
+        assert os.path.getsize(p) == 3 * t.NEEDLE_MAP_ENTRY_SIZE
         back = idxmod.read_index(p)
         assert list(back["key"]) == [1, 2, 1]
         entries = list(idxmod.iter_entries(p))
