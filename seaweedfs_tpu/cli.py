@@ -89,6 +89,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="needle map kind: memory | compact")
     p.add_argument("-disk", default="hdd",
                    help="disk class of this server (hdd | ssd)")
+    p.add_argument("-concurrentUploadLimitMB", dest="upload_limit_mb",
+                   type=int, default=256,
+                   help="limit total in-flight upload bytes (0 = off)")
+    p.add_argument("-concurrentDownloadLimitMB",
+                   dest="download_limit_mb", type=int, default=256,
+                   help="limit total in-flight download bytes (0 = off)")
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -666,7 +672,10 @@ def _run_volume(args) -> int:
         loc.max_volumes = args.max
     # scheme normalization for each master happens inside VolumeServer
     vs = VolumeServer(store, args.mserver, data_center=args.dataCenter,
-                      rack=args.rack, disk_type=args.disk)
+                      rack=args.rack, disk_type=args.disk,
+                      concurrent_upload_limit=args.upload_limit_mb << 20,
+                      concurrent_download_limit=args.download_limit_mb
+                      << 20)
     t = ServerThread(vs.app, host=args.ip, port=args.port).start()
     store.port = t.port
     store.public_url = t.address

@@ -31,6 +31,49 @@ from ..utils import metrics
 from ..utils.security import Guard
 
 
+class InFlightLimiter:
+    """Byte-based in-flight accounting with cond-var backpressure —
+    the volume_server.go:24-28 inFlightUpload/DownloadDataSize +
+    sync.Cond scheme: a request WAITS while the tally is over the
+    limit (so one oversized request can't starve), is admitted as soon
+    as it drops below, and 429s after `timeout` seconds of waiting.
+    limit<=0 means account-only (no backpressure)."""
+
+    def __init__(self, limit: int, timeout: float = 30.0):
+        self.limit = limit
+        self.timeout = timeout
+        self.value = 0
+        self._cond: asyncio.Condition | None = None
+
+    def _c(self) -> asyncio.Condition:
+        if self._cond is None:  # bind lazily to the serving loop
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    async def wait_admit(self) -> bool:
+        if self.limit <= 0 or self.value <= self.limit:
+            return True
+        cond = self._c()
+        try:
+            async with cond:
+                await asyncio.wait_for(
+                    cond.wait_for(lambda: self.value <= self.limit),
+                    self.timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def add(self, n: int) -> None:
+        self.value += n
+
+    async def release(self, n: int) -> None:
+        self.value -= n
+        if self.limit > 0:
+            cond = self._c()
+            async with cond:
+                cond.notify_all()
+
+
 class VolumeServer:
     def __init__(self, store: Store, master_url: str,
                  data_center: str = "DefaultDataCenter",
@@ -39,7 +82,9 @@ class VolumeServer:
                  pulse_seconds: float = 5.0,
                  max_concurrent_writes: int = 64,
                  tier_backends: dict[str, dict] | None = None,
-                 disk_type: str = "hdd"):
+                 disk_type: str = "hdd",
+                 concurrent_upload_limit: int = 256 << 20,
+                 concurrent_download_limit: int = 256 << 20):
         self.store = store
         self.disk_type = disk_type
         # comma-separated list in HA mode; heartbeats follow the raft
@@ -54,6 +99,8 @@ class VolumeServer:
         self.guard = Guard(jwt_secret)
         self.pulse_seconds = pulse_seconds
         self._write_sem = asyncio.Semaphore(max_concurrent_writes)
+        self._upload_flight = InFlightLimiter(concurrent_upload_limit)
+        self._download_flight = InFlightLimiter(concurrent_download_limit)
         self._hb_task: asyncio.Task | None = None
         self._hb_wake = asyncio.Event()
         self.store.remote_shard_reader = self._remote_shard_read_sync
@@ -221,9 +268,27 @@ class VolumeServer:
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         if req.method in ("GET", "HEAD"):
-            return await self._read_fid(req, vid, key, cookie)
+            # byte-based in-flight download backpressure
+            # (volume_server.go:25 + handlers.go cond-var wait)
+            if not await self._download_flight.wait_admit():
+                return web.Response(
+                    status=429, text="too many in-flight downloads")
+            est = self.store.needle_size(vid, key)
+            self._download_flight.add(est)
+            try:
+                return await self._read_fid(req, vid, key, cookie)
+            finally:
+                await self._download_flight.release(est)
         if req.method == "POST" or req.method == "PUT":
-            return await self._write_fid(req, fid, vid, key, cookie)
+            if not await self._upload_flight.wait_admit():
+                return web.Response(
+                    status=429, text="too many in-flight uploads")
+            est = req.content_length or 0
+            self._upload_flight.add(est)
+            try:
+                return await self._write_fid(req, fid, vid, key, cookie)
+            finally:
+                await self._upload_flight.release(est)
         if req.method == "DELETE":
             return await self._delete_fid(req, fid, vid, key)
         return web.Response(status=405)
@@ -1147,6 +1212,10 @@ class VolumeServer:
         metrics.gauge_set(
             "volume_server_max_volumes",
             sum(l.max_volumes for l in self.store.locations))
+        metrics.gauge_set("volume_server_in_flight_upload_bytes",
+                          self._upload_flight.value)
+        metrics.gauge_set("volume_server_in_flight_download_bytes",
+                          self._download_flight.value)
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

@@ -64,6 +64,15 @@ class Store:
     def has_volume(self, vid: int) -> bool:
         return self.find_volume(vid) is not None
 
+    def needle_size(self, vid: int, needle_id: int) -> int:
+        """Cheap O(1) size estimate from the needle map (no disk IO);
+        0 when unknown — feeds in-flight download accounting."""
+        v = self.find_volume(vid)
+        if v is None:
+            return 0
+        loc = v.nm.get(needle_id)
+        return int(loc[1]) if loc else 0
+
     def add_volume(self, vid: int, collection: str = "",
                    replication: str = "000", ttl: bytes = b"\x00\x00"):
         if self.find_volume(vid) is not None:

@@ -1,0 +1,95 @@
+"""In-flight byte accounting + cond-var backpressure on the volume
+server (volume_server.go:24-28 inFlightUpload/DownloadDataSize).
+"""
+import asyncio
+import threading
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.server.volume_server import InFlightLimiter
+
+
+class TestLimiter:
+    def _run(self, coro):
+        return asyncio.new_event_loop().run_until_complete(coro)
+
+    def test_admits_under_limit(self):
+        async def go():
+            lim = InFlightLimiter(100)
+            assert await lim.wait_admit()
+            lim.add(80)
+            assert await lim.wait_admit()  # 80 <= 100
+            lim.add(80)
+            # now over limit: next waiter times out
+            lim.timeout = 0.2
+            assert not await lim.wait_admit()
+            await lim.release(80)
+            assert await lim.wait_admit()
+        self._run(go())
+
+    def test_waiter_wakes_on_release(self):
+        async def go():
+            lim = InFlightLimiter(10, timeout=5)
+            lim.add(50)
+            results = []
+
+            async def waiter():
+                results.append(await lim.wait_admit())
+
+            task = asyncio.ensure_future(waiter())
+            await asyncio.sleep(0.05)
+            assert not task.done()  # parked on the condition
+            await lim.release(50)
+            await asyncio.wait_for(task, 2)
+            assert results == [True]
+        self._run(go())
+
+    def test_unlimited_mode_accounts_only(self):
+        async def go():
+            lim = InFlightLimiter(0)
+            lim.add(1 << 40)
+            assert await lim.wait_admit()  # never blocks
+            await lim.release(1 << 40)
+            assert lim.value == 0
+        self._run(go())
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        c = Cluster(str(tmp_path_factory.mktemp("ifl")),
+                    n_volume_servers=1, volume_size_limit=16 << 20)
+        yield c
+        c.stop()
+
+    def test_normal_traffic_unaffected(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, b"x" * 100_000)
+        assert verbs.download(f"http://{a.url}/{a.fid}") == b"x" * 100_000
+        vs = cluster.volume_servers[0]
+        assert vs._upload_flight.value == 0
+        assert vs._download_flight.value == 0
+
+    def test_over_limit_upload_rejected_after_timeout(self, cluster):
+        vs = cluster.volume_servers[0]
+        vs._upload_flight.limit = 10
+        vs._upload_flight.timeout = 0.3
+        vs._upload_flight.add(1000)  # simulate a huge in-flight body
+        try:
+            a = verbs.assign(cluster.master_url)
+            r = requests.post(f"http://{a.url}/{a.fid}",
+                              files={"file": ("x.bin", b"y" * 100)},
+                              timeout=10)
+            assert r.status_code == 429
+        finally:
+            vs._upload_flight.value -= 1000
+            vs._upload_flight.limit = 256 << 20
+            vs._upload_flight.timeout = 30.0
+
+    def test_metrics_exported(self, cluster):
+        m = requests.get(cluster.volume_url(0) + "/metrics").text
+        assert "volume_server_in_flight_upload_bytes" in m
+        assert "volume_server_in_flight_download_bytes" in m
