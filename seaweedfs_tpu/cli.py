@@ -38,6 +38,18 @@ def main(argv: list[str] | None = None) -> int:
              "grace.SetupProfiling, util/grace/pprof.go:11); place "
              "BEFORE the subcommand")
     parser.add_argument(
+        "-memprofile", default="",
+        help="write a tracemalloc top-allocations report here on exit "
+             "(the reference's -memprofile); place BEFORE the "
+             "subcommand")
+    parser.add_argument(
+        "-metrics.address", dest="metrics_address", default="",
+        help="Prometheus pushgateway address to push metrics to "
+             "(stats/metrics.go pusher); place BEFORE the subcommand")
+    parser.add_argument(
+        "-metrics.intervalSec", dest="metrics_interval", type=float,
+        default=15.0)
+    parser.add_argument(
         "-security", default="",
         help="path to a security config JSON (scaffold "
              "-config=security): enables HTTPS (+ optional mutual "
@@ -308,21 +320,54 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-output", default="",
                    help="write to a file instead of stdout")
 
+    p = sub.add_parser(
+        "autocomplete",
+        help="print shell tab-completion setup (the reference's "
+             "autocomplete command); eval it or add to your rc file")
+    p.add_argument("-shell", default="bash", choices=["bash", "zsh"])
+
+    sub.add_parser("unautocomplete",
+                   help="print how to remove shell completion")
+
+    sub.add_parser("update",
+                   help="self-update placeholder (no binary releases "
+                        "in this distribution)")
+
     p = sub.add_parser("version")
 
     args = parser.parse_args(argv)
-    if args.cpuprofile:
-        import cProfile
+    args._subcommands = list(sub.choices)
+    if args.metrics_address:
+        from .utils import metrics as _metrics
 
-        prof = cProfile.Profile()
-        prof.enable()
-        try:
-            return _dispatch(args)
-        finally:
-            prof.disable()
-            prof.dump_stats(args.cpuprofile)
-            print(f"cpu profile written to {args.cpuprofile}")
-    return _dispatch(args)
+        _metrics.start_push(args.metrics_address, job=args.cmd,
+                            interval_seconds=args.metrics_interval)
+    if args.memprofile:
+        import tracemalloc
+
+        tracemalloc.start(16)
+    try:
+        if args.cpuprofile:
+            import cProfile
+
+            prof = cProfile.Profile()
+            prof.enable()
+            try:
+                return _dispatch(args)
+            finally:
+                prof.disable()
+                prof.dump_stats(args.cpuprofile)
+                print(f"cpu profile written to {args.cpuprofile}")
+        return _dispatch(args)
+    finally:
+        if args.memprofile:
+            import tracemalloc
+
+            snap = tracemalloc.take_snapshot()
+            with open(args.memprofile, "w") as f:
+                for stat in snap.statistics("lineno")[:200]:
+                    f.write(f"{stat}\n")
+            print(f"memory profile written to {args.memprofile}")
 
 
 def _dispatch(args) -> int:
@@ -331,6 +376,26 @@ def _dispatch(args) -> int:
 
         print(f"seaweedfs-tpu {__version__}")
         return 0
+    if args.cmd == "autocomplete":
+        cmds = " ".join(sorted(getattr(args, "_subcommands", [])))
+        if args.shell == "bash":
+            print(f"complete -W '{cmds}' seaweedfs-tpu\n"
+                  f"complete -W '{cmds}' weed\n"
+                  "# add the lines above to ~/.bashrc, or: "
+                  "eval \"$(seaweedfs-tpu autocomplete)\"")
+        else:
+            print(f"compdef '_arguments \"1:command:({cmds})\"' "
+                  "seaweedfs-tpu\n# add to ~/.zshrc after compinit")
+        return 0
+    if args.cmd == "unautocomplete":
+        print("remove the 'complete -W ... seaweedfs-tpu' lines from "
+              "your shell rc file (this build never edits it for you)")
+        return 0
+    if args.cmd == "update":
+        print("seaweedfs-tpu is distributed as a Python package, not "
+              "a downloadable binary; update it with your package "
+              "manager / git checkout")
+        return 1
     if args.cmd == "scaffold":
         from .scaffold import scaffold
         text = scaffold(args.config)

@@ -53,8 +53,9 @@ class Volume:
             # the remote object recorded in the .vif
             self.dat = bk.DiskFile(base + ".dat")
             self.read_only = True
-        elif backend_kind == "disk":
-            self.dat = bk.DiskFile(base + ".dat", create=create or not exists)
+        elif backend_kind in ("disk", "mmap"):
+            self.dat = bk.create(backend_kind, base + ".dat",
+                                 create=create or not exists)
         else:
             self.dat = bk.create(backend_kind, base + ".dat")
         if (exists or remote is not None) and self.dat.size() >= 8:

@@ -86,3 +86,51 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+
+
+# -- pushgateway loop ---------------------------------------------------
+# The reference's JoinCluster...Start... pusher (stats/metrics.go):
+# servers can periodically PUT their rendered metrics to a Prometheus
+# pushgateway instead of (or besides) being scraped.
+
+_push_thread = None
+_push_stop = None
+
+
+def start_push(gateway_url: str, job: str,
+               interval_seconds: float = 15.0,
+               instance: str = "") -> None:
+    global _push_thread, _push_stop
+    if _push_thread is not None:
+        return
+    import threading as _th
+
+    import requests as _rq
+
+    url = gateway_url.rstrip("/")
+    if not url.startswith("http"):
+        url = "http://" + url
+    url += f"/metrics/job/{job}"
+    if instance:
+        url += f"/instance/{instance}"
+    _push_stop = _th.Event()
+
+    def loop():
+        while not _push_stop.wait(interval_seconds):
+            try:
+                _rq.put(url, data=render().encode(),
+                        headers={"Content-Type": "text/plain"},
+                        timeout=10)
+            except _rq.RequestException:
+                pass  # gateway outages must never hurt the server
+
+    _push_thread = _th.Thread(target=loop, daemon=True)
+    _push_thread.start()
+
+
+def stop_push() -> None:
+    global _push_thread, _push_stop
+    if _push_stop is not None:
+        _push_stop.set()
+    _push_thread = None
+    _push_stop = None

@@ -1,0 +1,74 @@
+"""CLI odds and ends: autocomplete/update verbs, -memprofile, and the
+metrics pushgateway loop (stats/metrics.go pusher).
+"""
+import http.server
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, PYTHONPATH=REPO)
+
+
+def run_cli(*argv):
+    return subprocess.run([sys.executable, "-m", "seaweedfs_tpu", *argv],
+                          env=ENV, capture_output=True, text=True)
+
+
+class TestVerbs:
+    def test_autocomplete_lists_all_subcommands(self):
+        out = run_cli("autocomplete")
+        assert out.returncode == 0
+        for cmd in ("master", "volume", "filer", "s3", "shell",
+                    "fuse", "ftp"):
+            assert cmd in out.stdout
+
+    def test_autocomplete_zsh(self):
+        out = run_cli("autocomplete", "-shell", "zsh")
+        assert out.returncode == 0 and "compdef" in out.stdout
+
+    def test_unautocomplete_and_update(self):
+        assert run_cli("unautocomplete").returncode == 0
+        assert run_cli("update").returncode == 1
+
+    def test_memprofile_written(self, tmp_path):
+        p = tmp_path / "mem.txt"
+        out = run_cli("-memprofile", str(p), "version")
+        assert out.returncode == 0
+        assert p.exists()
+
+
+class TestMetricsPush:
+    def test_push_loop_delivers(self):
+        received = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                received.append((self.path, body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        from seaweedfs_tpu.utils import metrics
+        metrics.counter_add("push_test_total", 3)
+        try:
+            metrics.start_push(f"127.0.0.1:{srv.server_port}",
+                               job="unittest", interval_seconds=0.2)
+            deadline = time.time() + 10
+            while not received and time.time() < deadline:
+                time.sleep(0.05)
+            assert received, "pushgateway never received a PUT"
+            path, body = received[0]
+            assert path == "/metrics/job/unittest"
+            assert b"push_test_total" in body
+        finally:
+            metrics.stop_push()
+            srv.shutdown()
