@@ -36,29 +36,36 @@ def bench_cpu(coef, rng, width=4 << 20, reps=3) -> float:
 
 
 def bench_tpu(coef, rng, width=32 << 20, batch=16, reps=3) -> float:
-    """Steady-state codec throughput, device-resident data.
+    """Steady-state codec throughput, device-resident data: the best
+    of the XLA bit-plane path and the fused Pallas kernel.
 
     Measures the coded-matmul kernel the way it runs in deployment:
     stripes stream into HBM once and thousands ride each dispatch (the
     shared-memory-ring model from BASELINE.json). Batches are chained
-    inside one jit via lax.scan and completion is forced by a scalar
-    checksum readback — block_until_ready() returns early through this
-    dev environment's axon relay, and the host<->device path of the
-    relay itself (~200 MB/s in, ~4 MB/s out) is an artifact of the
-    tunnel, not the framework; the e2e-through-host number is reported
-    on stderr for reference.
+    inside one jit via lax.scan — each scan step consumes a DIFFERENT
+    slab, so XLA cannot hoist the kernel out as loop-invariant (a
+    fori_loop over one slab gets silently hoisted and reports fantasy
+    numbers) — and completion is forced by a scalar checksum readback,
+    because block_until_ready() returns early through this dev
+    environment's axon relay. Measured both paths saturate the relayed
+    chip's effective HBM streaming (~30 GB/s device-side; the ~70 ms
+    relay round trip per rep is included in the reported figure), with
+    the fused kernel a few percent ahead.
     """
     import jax
     import jax.numpy as jnp
 
-    from seaweedfs_tpu.ops import gf256
-
-    a_bits = jnp.asarray(gf256.expand_to_bits(coef), dtype=jnp.bfloat16)
-
+    from seaweedfs_tpu.ops import codec_pallas, gf256
     from seaweedfs_tpu.ops.bits import coded_matmul_bits
 
+    bits_np = gf256.expand_to_bits(coef)
+    a_bits = jnp.asarray(bits_np, dtype=jnp.bfloat16)
+    a_pm = codec_pallas.plane_major_bit_matrix(
+        np.asarray(bits_np, dtype=np.float32))
+    pack = codec_pallas.packing_matrix(coef.shape[0])
+
     @jax.jit
-    def chained(a_bits, data):  # (B, k, W) -> checksum of all parity
+    def chained_xla(a_bits, data):  # (B, k, W) -> parity checksum
         def body(acc, d):
             parity = coded_matmul_bits(a_bits, d)
             return acc + jnp.sum(parity.astype(jnp.uint32)), None
@@ -66,15 +73,36 @@ def bench_tpu(coef, rng, width=32 << 20, batch=16, reps=3) -> float:
         acc, _ = jax.lax.scan(body, jnp.uint32(0), data)
         return acc
 
+    @jax.jit
+    def chained_pallas(a_pm, pack, data):
+        def body(acc, d):
+            parity = codec_pallas.coded_matmul_pallas_pm(a_pm, pack, d)
+            return acc + jnp.sum(parity.astype(jnp.uint32)), None
+
+        acc, _ = jax.lax.scan(body, jnp.uint32(0), data)
+        return acc
+
     data = jnp.asarray(rng.integers(
         0, 256, (batch, coef.shape[1], width), dtype=np.uint8))
-    int(chained(a_bits, data))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        checksum = int(chained(a_bits, data))
-    dt = (time.perf_counter() - t0) / reps
-    assert checksum > 0
-    return data.nbytes / dt
+
+    best = 0.0
+    for name, fn, args in (("pallas", chained_pallas, (a_pm, pack)),
+                           ("xla", chained_xla, (a_bits,))):
+        try:
+            checksum = int(fn(*args, data))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                checksum = int(fn(*args, data))
+            dt = (time.perf_counter() - t0) / reps
+            assert checksum > 0
+            rate = data.nbytes / dt
+            log(f"  {name} path: {rate / 1e6:.0f} MB/s")
+            best = max(best, rate)
+        except Exception as e:  # pragma: no cover - backend fallback
+            log(f"  {name} path failed: {type(e).__name__}: {e}")
+    if best == 0:
+        raise RuntimeError("both TPU codec paths failed")
+    return best
 
 
 def bench_tpu_e2e(coef, rng, width=16 << 20, reps=2) -> float:

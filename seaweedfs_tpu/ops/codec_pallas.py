@@ -18,11 +18,16 @@ the power-of-two packing matrix P[i, 8i+b] = 2^b — exact in f32.
 
 Bit/byte semantics are EXACTLY bits.coded_matmul_bits (golden tests
 run identical vectors through both paths). Measured on the dev chip
-through the axon relay the fused kernel's marginal throughput beats
-the XLA path (~56 vs ~21 GB/s single-dispatch) but scan-chained
-pipelines land at parity — the relay's fixed ~100 ms round trip and
-scan overheads swamp the difference there; profiling on direct-attach
-hardware is the follow-up. Selected with -ec.backend=pallas.
+through the axon relay, scan-chained pipelines put the fused kernel a
+few percent ahead of the XLA path (21.6 vs 20.6 GB/s) with BOTH
+saturating the relayed chip's effective HBM streaming (~30 GB/s
+device-side — raw copy-through-kernel measures the same); the fused
+kernel's 20x traffic reduction should open a real gap on direct-attach
+hardware. Beware two measurement traps this file's history hit:
+closing over the data array turns it into a multi-GB jit constant,
+and a fori_loop over one slab gets hoisted as loop-invariant and
+reports fantasy numbers — bench.py's scan-over-distinct-slabs is the
+honest shape. Selected with -ec.backend=pallas.
 """
 from __future__ import annotations
 
