@@ -38,6 +38,13 @@ def main(argv: list[str] | None = None) -> int:
              "grace.SetupProfiling, util/grace/pprof.go:11); place "
              "BEFORE the subcommand")
     parser.add_argument(
+        "-v", dest="verbosity", type=int, default=0,
+        help="log verbosity for glog.v() messages (the reference's "
+             "-v); place BEFORE the subcommand")
+    parser.add_argument(
+        "-vmodule", default="",
+        help="per-file log levels, e.g. store=2,volume_server=3")
+    parser.add_argument(
         "-memprofile", default="",
         help="write a tracemalloc top-allocations report here on exit "
              "(the reference's -memprofile); place BEFORE the "
@@ -337,6 +344,11 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
     args._subcommands = list(sub.choices)
+    if args.verbosity or args.vmodule:
+        from .utils import glog
+
+        glog.set_verbosity(args.verbosity)
+        glog.set_vmodule(args.vmodule)
     if args.metrics_address:
         from .utils import metrics as _metrics
 

@@ -8,8 +8,9 @@ volume_grpc_copy.go file streaming, volume_grpc_vacuum.go), and the
 heartbeat loop (volume_grpc_client_to_master.go:50-120).
 
 In-flight byte accounting backpressure (volume_server.go:17-40) is
-replaced by aiohttp's connection limits + an asyncio semaphore around
-writes — same guarantee, idiomatic asyncio.
+implemented by InFlightLimiter below (cond-var waits + 429 on
+timeout), alongside an asyncio semaphore bounding concurrent disk
+writes.
 """
 from __future__ import annotations
 
@@ -27,7 +28,7 @@ from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
-from ..utils import metrics
+from ..utils import glog, metrics
 from ..utils.security import Guard
 
 
@@ -249,10 +250,14 @@ class VolumeServer:
                                 pass
                 # graceful close (e.g. a follower refusing our stream
                 # while no leader exists): back off before re-probing
+                glog.v(1, "heartbeat stream to %s closed; re-probing",
+                       self.master_url)
                 await asyncio.sleep(min(1.0, self.pulse_seconds))
             except asyncio.CancelledError:
                 return
-            except Exception:
+            except Exception as e:
+                glog.v(1, "heartbeat to %s failed: %s; retrying",
+                       self.master_url, e)
                 await asyncio.sleep(1)
 
     def poke_heartbeat(self) -> None:

@@ -1,0 +1,59 @@
+"""Leveled logging (utils/glog.py — the weed/glog equivalent)."""
+import io
+import re
+
+import pytest
+
+from seaweedfs_tpu.utils import glog
+
+
+@pytest.fixture(autouse=True)
+def capture():
+    buf = io.StringIO()
+    glog.set_output(buf)
+    glog.set_verbosity(0)
+    glog.set_vmodule("")
+    yield buf
+    glog.set_output(__import__("sys").stderr)
+    glog.set_verbosity(0)
+    glog.set_vmodule("")
+
+
+def test_line_format(capture):
+    glog.info("hello %s", "world")
+    line = capture.getvalue()
+    # I0730 12:00:00.000000 <tid> test_glog.py:<line>] hello world
+    assert re.match(
+        r"I\d{4} \d\d:\d\d:\d\d\.\d{6} \d+ test_glog\.py:\d+\] "
+        r"hello world\n", line), line
+
+
+def test_severities(capture):
+    glog.warning("w")
+    glog.error("e")
+    out = capture.getvalue()
+    assert out.startswith("W") and "\nE" in out
+
+
+def test_v_gated_by_verbosity(capture):
+    glog.v(2, "hidden")
+    assert capture.getvalue() == ""
+    glog.set_verbosity(2)
+    glog.v(2, "shown %d", 42)
+    assert "shown 42" in capture.getvalue()
+
+
+def test_vmodule_overrides_per_file(capture):
+    glog.set_verbosity(0)
+    glog.set_vmodule("test_glog=3,other=1")
+    glog.v(3, "module-level visible")
+    assert "module-level visible" in capture.getvalue()
+    glog.set_vmodule("other=5")
+    glog.v(1, "not ours")
+    assert "not ours" not in capture.getvalue()
+
+
+def test_fatal_exits(capture):
+    with pytest.raises(SystemExit):
+        glog.fatal("boom")
+    assert capture.getvalue().startswith("F")
