@@ -278,6 +278,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
     p.add_argument("-collection", default="")
 
+    for name, hlp in (("see.dat", "offline: dump every .dat record as "
+                                  "JSON lines (debug inspector)"),
+                      ("see.idx", "offline: dump every .idx entry as "
+                                  "JSON lines (debug inspector)")):
+        p = sub.add_parser(name, help=hlp)
+        p.add_argument("-dir", default=".")
+        p.add_argument("-volumeId", dest="volume_id", type=int,
+                       required=True)
+        p.add_argument("-collection", default="")
+
     p = sub.add_parser("compact", help="offline: vacuum a volume's "
                                        "deleted records")
     p.add_argument("-dir", default=".")
@@ -417,6 +427,15 @@ def _dispatch(args) -> int:
             print(f"wrote {args.output}")
         else:
             print(text, end="")
+        return 0
+    if args.cmd in ("see.dat", "see.idx"):
+        import json as _json
+
+        from .operation import tools
+        it = (tools.see_dat if args.cmd == "see.dat" else
+              tools.see_idx)(args.dir, args.volume_id, args.collection)
+        for rec in it:
+            print(_json.dumps(rec))
         return 0
     if args.cmd in ("fix", "compact", "export"):
         import json as _json

@@ -88,3 +88,62 @@ def export_volume(dirname: str, vid: int, out_tar: str,
                 "tar": os.path.abspath(out_tar)}
     finally:
         v.close()
+
+
+def see_dat(dirname: str, vid: int, collection: str = ""):
+    """Yield one dict per record in .dat order — the unmaintained
+    see_dat inspector: full needle decode (name/mime/flags/ttl),
+    deleted records included. For spot-checking volume files."""
+    from ..storage import needle as ndl
+
+    _require_dat(dirname, vid, collection)
+    v = Volume(dirname, collection, vid)
+    try:
+        import struct
+
+        offset = v.super_block.block_size
+        size = v.dat.size()
+        while offset + t.NEEDLE_HEADER_SIZE <= size:
+            head = v.dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
+            _, nid, size_u32 = struct.unpack(">IQI", head)
+            nsize = t.u32_to_size(size_u32)
+            disk = ndl.disk_size(max(nsize, 0), v.version)
+            if offset + disk > size:
+                break
+            rec = {"offset": offset, "id": nid, "size": nsize,
+                   "deleted": nsize <= 0}
+            if nsize > 0:
+                try:
+                    n = ndl.Needle.from_bytes(
+                        v.dat.read_at(disk, offset), v.version)
+                    rec.update({
+                        "cookie": n.cookie,
+                        "name": n.name.decode("utf-8", "replace"),
+                        "mime": n.mime.decode("utf-8", "replace"),
+                        "data_bytes": len(n.data),
+                        "flags": n.flags,
+                        "last_modified": n.last_modified,
+                        "crc_ok": True,
+                    })
+                except ValueError as e:
+                    rec["crc_ok"] = False
+                    rec["error"] = str(e)
+            yield rec
+            offset += disk
+    finally:
+        v.close()
+
+
+def see_idx(dirname: str, vid: int, collection: str = ""):
+    """Yield (key, offset, size) per .idx entry in file order — the
+    unmaintained see_idx inspector."""
+    from ..storage import idx as idxmod
+
+    name = f"{collection}_{vid}" if collection else str(vid)
+    path = os.path.join(dirname, name + ".idx")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no index file {path}")
+    for e in idxmod.iter_entries(path):
+        yield {"key": e.key, "offset": e.offset,
+               "byte_offset": t.offset_to_actual(e.offset),
+               "size": e.size, "deleted": e.size <= 0}

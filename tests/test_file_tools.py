@@ -140,3 +140,59 @@ class TestFilerCliVerbs:
             tools.export_volume(str(tmp_path), 99,
                                 str(tmp_path / "x.tar"))
         assert not os.path.exists(tmp_path / "99.dat")
+
+
+class TestSeeTools:
+    def test_see_dat_and_idx(self, tmp_path):
+        from seaweedfs_tpu.operation import tools
+        from seaweedfs_tpu.storage import needle as ndl
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), "", 9, create=True)
+        v.append_needle(ndl.Needle(id=1, cookie=7, data=b"abc",
+                                   name=b"a.txt", mime=b"text/plain"))
+        v.append_needle(ndl.Needle(id=2, cookie=8, data=b"defg"))
+        v.delete_needle(1)
+        v.close()
+
+        recs = list(tools.see_dat(str(tmp_path), 9))
+        live = [r for r in recs if not r["deleted"]]
+        assert {r["id"] for r in live} == {1, 2}
+        a = next(r for r in live if r["id"] == 1)
+        assert a["name"] == "a.txt" and a["mime"] == "text/plain"
+        assert a["crc_ok"] and a["data_bytes"] == 3
+        # the tombstone append shows up as a deleted record
+        assert any(r["deleted"] for r in recs)
+
+        entries = list(tools.see_idx(str(tmp_path), 9))
+        assert entries[0]["key"] == 1 and not entries[0]["deleted"]
+        assert entries[-1]["deleted"]  # trailing tombstone
+
+    def test_see_missing_volume(self, tmp_path):
+        from seaweedfs_tpu.operation import tools
+        with pytest.raises(FileNotFoundError):
+            list(tools.see_dat(str(tmp_path), 404))
+        with pytest.raises(FileNotFoundError):
+            list(tools.see_idx(str(tmp_path), 404))
+
+    def test_cli_see_dat(self, tmp_path):
+        import json as _json
+        import os
+        import subprocess
+        import sys
+
+        from seaweedfs_tpu.storage import needle as ndl
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), "", 5, create=True)
+        v.append_needle(ndl.Needle(id=11, cookie=1, data=b"x" * 10))
+        v.close()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu", "see.dat",
+             "-dir", str(tmp_path), "-volumeId", "5"],
+            env=dict(os.environ, PYTHONPATH=repo),
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        recs = [_json.loads(l) for l in out.stdout.splitlines()]
+        assert recs[0]["id"] == 11 and recs[0]["data_bytes"] == 10
