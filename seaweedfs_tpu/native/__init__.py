@@ -57,6 +57,12 @@ def _load_locked() -> ctypes.CDLL:
     lib.crc32c_batch.restype = None
     lib.native_simd_level.argtypes = []
     lib.native_simd_level.restype = ctypes.c_int
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.dat_scan.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), i64p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, i64p]
+    lib.dat_scan.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -102,3 +108,26 @@ def crc32c_batch(rows: np.ndarray) -> np.ndarray:
 def simd_level() -> int:
     """0=scalar, 1=SSSE3, 2=SSSE3+SSE4.2, 3=AVX2."""
     return int(load().native_simd_level())
+
+
+def dat_scan(dat: np.ndarray, start: int, version: int
+             ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Walk a .dat image natively -> (ids u64, byte offsets i64,
+    signed sizes i32, end_offset). end_offset < len(dat) means the
+    tail is torn after the last whole record."""
+    lib = load()
+    dat = np.ascontiguousarray(dat, dtype=np.uint8)
+    # smallest record is an empty v2 tombstone: 16+4 padded -> 24
+    cap = max(1, dat.size // 24)
+    ids = np.empty(cap, dtype=np.uint64)
+    offsets = np.empty(cap, dtype=np.int64)
+    sizes = np.empty(cap, dtype=np.int32)
+    end = ctypes.c_int64(0)
+    n = lib.dat_scan(
+        _u8p(dat), ctypes.c_int64(dat.size), ctypes.c_int64(start),
+        ctypes.c_int(version),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(cap), ctypes.byref(end))
+    return ids[:n], offsets[:n], sizes[:n], int(end.value)

@@ -164,6 +164,45 @@ void gf256_mul_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
   mul_xor_row(c, src, dst, static_cast<size_t>(n));
 }
 
+// Walk a .dat image record-by-record — the hot loop of offline .idx
+// reconstruction (`weed fix`, storage/volume.py rebuild_index) and the
+// torn-tail integrity check, natively. Header layout per
+// storage/needle.py: cookie u32be, id u64be, size u32be (signed;
+// <=0 marks a tombstone); record disk size = 16 + size + 4 checksum
+// (+8 timestamp for v3), padded to the next multiple of 8 with at
+// least one pad byte.
+//
+// Emits per-record (id, byte offset, signed size) into caller arrays
+// of capacity `cap`; returns the record count and stores the byte
+// offset after the last whole record in *end_off (a caller seeing
+// *end_off < dat_size knows the tail is torn and truncates there).
+int64_t dat_scan(const uint8_t* dat, int64_t dat_size, int64_t start,
+                 int version, uint64_t* ids, int64_t* offsets,
+                 int32_t* sizes, int64_t cap, int64_t* end_off) {
+  int64_t off = start, count = 0;
+  const int64_t extra = (version >= 3) ? 8 : 0;
+  while (off + 16 <= dat_size && count < cap) {
+    uint64_t nid = 0;
+    for (int b = 0; b < 8; ++b) nid = (nid << 8) | dat[off + 4 + b];
+    uint32_t szu = (static_cast<uint32_t>(dat[off + 12]) << 24) |
+                   (static_cast<uint32_t>(dat[off + 13]) << 16) |
+                   (static_cast<uint32_t>(dat[off + 14]) << 8) |
+                   static_cast<uint32_t>(dat[off + 15]);
+    int32_t nsize = static_cast<int32_t>(szu);
+    int64_t body = (nsize < 0) ? 0 : nsize;
+    int64_t total = 16 + body + 4 + extra;
+    int64_t disk = total + (8 - (total % 8));  // pad is always 1..8
+    if (off + disk > dat_size) break;
+    ids[count] = nid;
+    offsets[count] = off;
+    sizes[count] = nsize;
+    ++count;
+    off += disk;
+  }
+  *end_off = off;
+  return count;
+}
+
 uint32_t crc32c_update(uint32_t crc, const uint8_t* data, int64_t len) {
   crc = ~crc;
   size_t n = static_cast<size_t>(len);

@@ -204,8 +204,14 @@ class Volume:
     def rebuild_index(self) -> None:
         """Offline .idx reconstruction by scanning the .dat — the
         `weed fix` tool (command/fix.go:24-40) as an engine method, also
-        the recovery path for a torn compact commit."""
+        the recovery path for a torn compact commit. Uses the native
+        C++ record walker when available (the scan itself drops from
+        seconds to milliseconds on large volumes; end-to-end ~2x since
+        the needle-map replay dominates); the Python loop below is the
+        always-works fallback and the semantic reference."""
         base = self.file_name()
+        if self._rebuild_index_native(base):
+            return
         self._idx_f.close()
         self.nm = nmap.new_needle_map(self.needle_map_kind)
         with open(base + ".idx", "wb") as idxf:
@@ -230,6 +236,50 @@ class Volume:
                     idxmod.append_entry(idxf, nid, 0, t.TOMBSTONE_SIZE)
                 offset += disk
         self._idx_f = open(base + ".idx", "ab")
+
+    def _rebuild_index_native(self, base: str) -> bool:
+        """C++ fast path of rebuild_index: bulk-scan the .dat, write
+        the .idx vectorized, reload the map through the standard
+        loader. Returns False when the native library or a scannable
+        file isn't available (caller falls back to the Python walk)."""
+        import numpy as np
+
+        from .. import native
+
+        path = self.dat.name
+        if not native.available() or not os.path.exists(path):
+            return False
+        try:
+            lib_ok = native.load() is not None
+        except Exception:
+            return False
+        if not lib_ok:
+            return False
+        self.dat.flush()
+        size = self.dat.size()
+        start = self.super_block.block_size
+        if size <= start:
+            ids = offs = sizes = np.empty(0, dtype=np.int64)
+            end = size
+        else:
+            dat = np.memmap(path, dtype=np.uint8, mode="r", shape=(size,))
+            ids, offs, sizes, end = native.dat_scan(
+                dat, start, self.version)
+            del dat
+        if end < size:
+            self.dat.truncate(end)  # torn tail after the last record
+        self._idx_f.close()
+        arr = np.empty(len(ids), dtype=idxmod.IDX_DTYPE)
+        live = sizes > 0
+        arr["key"] = ids
+        arr["offset"] = np.where(live, offs // t.NEEDLE_PADDING, 0)
+        arr["size"] = np.where(live, sizes.astype(np.int64),
+                               t.size_to_u32(t.TOMBSTONE_SIZE))
+        idxmod.write_index(base + ".idx", arr)
+        self.nm = nmap.load_needle_map(base + ".idx",
+                                       self.needle_map_kind)
+        self._idx_f = open(base + ".idx", "ab")
+        return True
 
     # -- incremental sync (volume_backup.go, volume_grpc_copy_incremental.go)
     def _walk_records(self, start: int, end: int | None = None):

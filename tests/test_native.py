@@ -89,3 +89,61 @@ class TestCrc32c:
 
     def test_empty(self):
         assert nat.crc32c(b"") == 0
+
+
+class TestNativeDatScan:
+    def test_rebuild_index_native_matches_python(self, tmp_path):
+        import numpy as np
+
+        from seaweedfs_tpu import native
+        from seaweedfs_tpu.storage import idx as idxmod
+        from seaweedfs_tpu.storage import needle as ndl
+        from seaweedfs_tpu.storage.volume import Volume
+
+        (tmp_path / "a").mkdir()
+        v = Volume(str(tmp_path / "a"), "", 1, create=True)
+        for i in range(50):
+            v.append_needle(ndl.Needle(id=i + 1, cookie=i,
+                                       data=bytes([i % 250]) * (i * 7)))
+        for i in (3, 9, 30):
+            v.delete_needle(i)
+        v.close()
+        import shutil
+        shutil.copytree(str(tmp_path / "a"), str(tmp_path / "b"))
+
+        va = Volume(str(tmp_path / "a"), "", 1)
+        assert va._rebuild_index_native(va.file_name())  # native ran
+        va.close()
+        vb = Volume(str(tmp_path / "b"), "", 1)
+        # force the pure-Python reference path
+        orig = Volume._rebuild_index_native
+        Volume._rebuild_index_native = lambda self, base: False
+        try:
+            vb.rebuild_index()
+        finally:
+            Volume._rebuild_index_native = orig
+        vb.close()
+
+        a = idxmod.read_index(str(tmp_path / "a" / "1.idx"))
+        b = idxmod.read_index(str(tmp_path / "b" / "1.idx"))
+        assert np.array_equal(a, b)
+
+    def test_native_rebuild_truncates_torn_tail(self, tmp_path):
+        from seaweedfs_tpu.storage import needle as ndl
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), "", 2, create=True)
+        v.append_needle(ndl.Needle(id=1, cookie=1, data=b"whole"))
+        v.close()
+        dat = tmp_path / "2.dat"
+        with open(dat, "ab") as f:
+            f.write(b"\xde\xad\xbe")  # torn partial record
+        v2 = Volume(str(tmp_path), "", 2)
+        assert v2._rebuild_index_native(v2.file_name())
+        assert v2.nm.file_count == 1
+        assert v2.read_needle(1, cookie=1).data == b"whole"
+        size_after = v2.dat.size()
+        v2.close()
+        import os
+        assert os.path.getsize(dat) == size_after
+        assert size_after % 8 == 0
