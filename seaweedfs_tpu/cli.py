@@ -921,8 +921,10 @@ def _run_benchmark(args) -> int:
     read_lat: list[float] = []
     err = [0]
 
+    from .rpc.httpclient import session as _pooled
+
     def writer(count):
-        sess = requests.Session()
+        sess = _pooled()
         for _ in range(count):
             t0 = time.perf_counter()
             try:
@@ -939,7 +941,7 @@ def _run_benchmark(args) -> int:
         from .wdclient.client import MasterClient
 
         mc = MasterClient(args.master)
-        sess = requests.Session()
+        sess = _pooled()
         for fid in my_fids:
             t0 = time.perf_counter()
             try:

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Callable
 
 import requests
+from ..rpc.httpclient import session
 
 from .entry import FileChunk
 from .filechunks import resolve_chunk_manifest, view_from_chunks
@@ -25,7 +26,7 @@ def read_fid(lookup: LookupFn, fid: str, offset: int = 0,
         headers["Range"] = f"bytes={offset}-{offset + size - 1}"
     elif offset:
         headers["Range"] = f"bytes={offset}-"
-    resp = requests.get(url, headers=headers, timeout=60)
+    resp = session().get(url, headers=headers, timeout=60)
     if resp.status_code not in (200, 206):
         raise IOError(f"read {fid}: http {resp.status_code}")
     return resp.content

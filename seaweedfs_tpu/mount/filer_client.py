@@ -13,6 +13,7 @@ import json
 import threading
 
 import requests
+from ..rpc.httpclient import session
 
 from ..filer.entry import Entry
 from ..operation import verbs
@@ -29,7 +30,7 @@ class FilerClient:
         # master for chunk assign/lookup; discovered from the filer's
         # status if not given
         if master_url is None:
-            st = requests.get(f"{self.filer_url}/status",
+            st = session().get(f"{self.filer_url}/status",
                               timeout=10).json()
             master_url = st.get("master", "")
         self.master_url = master_url
@@ -41,11 +42,11 @@ class FilerClient:
 
     # -- entries --------------------------------------------------------
     def kv_get(self, key: str) -> bytes | None:
-        r = requests.get(f"{self.filer_url}/kv/{key}", timeout=30)
+        r = session().get(f"{self.filer_url}/kv/{key}", timeout=30)
         return r.content if r.status_code == 200 else None
 
     def lookup_entry(self, path: str) -> Entry | None:
-        r = requests.get(f"{self.filer_url}{path}", params={"meta": "1"},
+        r = session().get(f"{self.filer_url}{path}", params={"meta": "1"},
                          timeout=30)
         if r.status_code == 404:
             return None
@@ -56,7 +57,7 @@ class FilerClient:
         out: list[Entry] = []
         last = ""
         while True:
-            r = requests.get(f"{self.filer_url}{path or '/'}",
+            r = session().get(f"{self.filer_url}{path or '/'}",
                              params={"limit": str(min(limit, 1024)),
                                      "lastFileName": last},
                              headers={"Accept": "application/json"},
@@ -73,31 +74,31 @@ class FilerClient:
             last = d.get("lastFileName", "")
 
     def save_entry(self, entry: Entry) -> None:
-        r = requests.put(f"{self.filer_url}{entry.full_path}",
+        r = session().put(f"{self.filer_url}{entry.full_path}",
                          params={"meta": "1"},
                          data=json.dumps(entry.to_dict()), timeout=60)
         r.raise_for_status()
 
     def mkdir(self, path: str) -> None:
-        r = requests.put(f"{self.filer_url}{path}", params={"mkdir": "1"},
+        r = session().put(f"{self.filer_url}{path}", params={"mkdir": "1"},
                          timeout=30)
         r.raise_for_status()
 
     def delete(self, path: str, recursive: bool = False) -> None:
-        r = requests.delete(f"{self.filer_url}{path}",
+        r = session().delete(f"{self.filer_url}{path}",
                             params={"recursive": "true"} if recursive
                             else {}, timeout=60)
         if r.status_code not in (200, 204, 404):
             r.raise_for_status()
 
     def rename(self, old: str, new: str) -> None:
-        r = requests.put(f"{self.filer_url}{new}",
+        r = session().put(f"{self.filer_url}{new}",
                          params={"mv.from": old}, timeout=60)
         r.raise_for_status()
 
     # -- chunks ---------------------------------------------------------
     def link(self, src: str, dst: str) -> None:
-        r = requests.post(f"{self.filer_url}{dst}",
+        r = session().post(f"{self.filer_url}{dst}",
                           params={"link.from": src}, timeout=60)
         if r.status_code >= 300:
             raise OSError(r.status_code, r.text)

@@ -10,6 +10,8 @@ from dataclasses import dataclass, field
 
 import requests
 
+from ..rpc.httpclient import session
+
 
 @dataclass
 class AssignResult:
@@ -35,7 +37,7 @@ def assign(master_url: str, count: int = 1, collection: str = "",
         params["dataCenter"] = data_center
     if disk_type:
         params["disk"] = disk_type
-    resp = requests.get(f"{master_url.rstrip('/')}/dir/assign",
+    resp = session().get(f"{master_url.rstrip('/')}/dir/assign",
                         params=params, timeout=30)
     body = resp.json()
     if resp.status_code != 200 or "error" in body:
@@ -64,7 +66,7 @@ def upload(url_or_assign, data: bytes, name: str = "",
         params["ts"] = str(ts)
     files = {"file": (name or "file", data,
                       mime or "application/octet-stream")}
-    resp = requests.post(url, files=files, headers=headers, params=params,
+    resp = session().post(url, files=files, headers=headers, params=params,
                          timeout=60)
     body = resp.json()
     if resp.status_code >= 300 or "error" in body:
@@ -74,7 +76,7 @@ def upload(url_or_assign, data: bytes, name: str = "",
 
 def download(url: str, auth: str = "") -> bytes:
     headers = {"Authorization": f"Bearer {auth}"} if auth else {}
-    resp = requests.get(url, headers=headers, timeout=60)
+    resp = session().get(url, headers=headers, timeout=60)
     if resp.status_code != 200:
         raise RuntimeError(f"download {url}: {resp.status_code}")
     return resp.content
@@ -82,7 +84,7 @@ def download(url: str, auth: str = "") -> bytes:
 
 def delete(url: str, auth: str = "") -> None:
     headers = {"Authorization": f"Bearer {auth}"} if auth else {}
-    resp = requests.delete(url, headers=headers, timeout=30)
+    resp = session().delete(url, headers=headers, timeout=30)
     if resp.status_code not in (200, 202, 404):
         raise RuntimeError(f"delete {url}: {resp.status_code}")
 

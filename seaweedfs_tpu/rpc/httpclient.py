@@ -1,0 +1,39 @@
+"""Shared pooled HTTP client for sync (requests-based) call sites.
+
+The reference reuses net/http's connection pool everywhere
+(util/http_client pooling); bare `requests.get` opens and tears down a
+TCP connection per call, which dominated the data-plane benchmark
+(assign+upload+read all paid a fresh handshake). One Session per
+thread (requests Sessions aren't documented thread-safe) with a wide
+urllib3 pool gives keep-alive across all client verbs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import requests
+
+_local = threading.local()
+
+
+def session() -> requests.Session:
+    s = getattr(_local, "session", None)
+    if s is None:
+        s = requests.Session()
+        # cluster-internal traffic: skip the per-request proxy-env
+        # scan (getproxies_environment walked os.environ on EVERY
+        # call — ~15% of client CPU in the write benchmark).
+        # trust_env=False would also drop REQUESTS_CA_BUNDLE, which the
+        # TLS story relies on — resolve it once here instead.
+        s.trust_env = False
+        ca = os.environ.get("REQUESTS_CA_BUNDLE") or \
+            os.environ.get("CURL_CA_BUNDLE")
+        if ca:
+            s.verify = ca
+        adapter = requests.adapters.HTTPAdapter(
+            pool_connections=32, pool_maxsize=32)
+        s.mount("http://", adapter)
+        s.mount("https://", adapter)
+        _local.session = s
+    return s

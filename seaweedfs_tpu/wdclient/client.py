@@ -13,6 +13,7 @@ import threading
 import time
 
 import requests
+from ..rpc.httpclient import session
 
 
 class MasterClient:
@@ -47,7 +48,7 @@ class MasterClient:
                 return locs
         for _ in range(len(self.masters)):
             try:
-                resp = requests.get(f"{self.master_url}/dir/lookup",
+                resp = session().get(f"{self.master_url}/dir/lookup",
                                     params={"volumeId": str(vid)},
                                     timeout=10)
                 if resp.status_code == 404:
