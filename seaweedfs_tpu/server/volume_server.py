@@ -147,6 +147,7 @@ class VolumeServer:
             web.post("/admin/leave", self.handle_leave),
             web.post("/admin/volume_replication",
                      self.handle_volume_replication),
+            web.post("/admin/volume_scrub", self.handle_volume_scrub),
             web.post("/admin/vacuum_check", self.handle_vacuum_check),
             web.post("/admin/vacuum_compact", self.handle_vacuum_compact),
             web.post("/admin/tier_upload", self.handle_tier_upload),
@@ -776,6 +777,17 @@ class VolumeServer:
             self.poke_heartbeat()
         return web.json_response(
             {"replication": str(v.super_block.replica_placement)})
+
+    async def handle_volume_scrub(self, req: web.Request) -> web.Response:
+        """Full-read needle verification for one local volume (the
+        per-volume arm of cluster scrub)."""
+        body = await req.json()
+        vid = int(body["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return web.Response(status=404, text=f"volume {vid}")
+        out = await asyncio.to_thread(v.scrub, int(body.get("limit", 0)))
+        return web.json_response(out)
 
     async def handle_vacuum_check(self, req: web.Request) -> web.Response:
         body = await req.json()

@@ -632,3 +632,33 @@ def collection_delete(env: CommandEnv, collection: str) -> list[int]:
                     continue
                 deleted.append(vid)
     return sorted(set(deleted))
+
+
+def volume_scrub(env: CommandEnv, volume_id: int = 0,
+                 collection: str = "", limit: int = 0) -> list[dict]:
+    """Full-read needle verification across the cluster (the
+    per-volume arm of cluster scrub, BASELINE config #5): every
+    replica of every targeted volume re-reads its live needles so disk
+    reads, size checks and CRC32C all fire. ec.verify covers the EC
+    arm."""
+    targets: list[tuple[int, str]] = []
+    if volume_id:
+        for url in env.volume_locations(volume_id):
+            targets.append((volume_id, url))
+        if not targets:
+            raise ShellError(f"volume {volume_id} not found")
+    else:
+        for n in env.data_nodes():
+            for vid_s in n["volumes"]:
+                vid = int(vid_s)
+                if collection and \
+                        env.volume_collection(vid) != collection:
+                    continue
+                targets.append((vid, n["url"]))
+    out = []
+    for vid, url in targets:
+        r = env.vs_post(url, "/admin/volume_scrub",
+                        {"volume": vid, "limit": limit})
+        r["server"] = url
+        out.append(r)
+    return out

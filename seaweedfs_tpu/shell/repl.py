@@ -44,7 +44,11 @@ HELP = """commands:
   volume.fsck                       filer chunks vs volume needles
   volume.tier.upload -volumeId=N [-dest=s3.default] [-keepLocalDatFile]
   volume.tier.download -volumeId=N  bring a tiered .dat back to disk
+  volume.scrub [-volumeId=N] [-collection=C] [-limit=N]
+                                    full-read CRC verification
   ec.encode -volumeId=N             erasure-code a volume
+  ec.verify -volumeId=N [-sampleMB=4] [-backend=numpy|native|jax]
+                                    parity-check spread shards
   ec.rebuild -volumeId=N            rebuild missing shards
   ec.balance                        even out shard counts
   ec.decode -volumeId=N             decode shards back to a volume
@@ -192,6 +196,10 @@ def run_command(env: CommandEnv, line: str) -> object:
             env, int(opts["volumeId"]))
     if cmd == "volume.fsck":
         return commands_volume.volume_fsck(env)
+    if cmd == "volume.scrub":
+        return commands_volume.volume_scrub(
+            env, int(opts.get("volumeId", 0)),
+            opts.get("collection", ""), int(opts.get("limit", 0)))
     if cmd == "volume.tier.upload":
         return commands_volume.volume_tier_upload(
             env, int(opts["volumeId"]), opts.get("dest", "s3.default"),
@@ -211,6 +219,11 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "ec.decode":
         return commands_ec.ec_decode(env, int(opts["volumeId"]),
                                      opts.get("collection", ""))
+    if cmd == "ec.verify":
+        return commands_ec.ec_verify(
+            env, int(opts["volumeId"]),
+            sample_mb=int(opts.get("sampleMB", 4)),
+            backend=opts.get("backend", "numpy"))
     # -- filesystem -----------------------------------------------------
     def rarg(i: int, default: str | None = None) -> str:
         # fs paths resolve against the fs.cd working directory

@@ -190,7 +190,11 @@ class Needle:
         if size <= 0:
             return n
         body = blob[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + size]
-        n._parse_body(body)
+        try:
+            n._parse_body(body)
+        except (IndexError, struct.error) as e:
+            # a flipped length byte must read as corruption, not crash
+            raise ValueError(f"corrupt needle body: {e}") from e
         stored_crc = struct.unpack_from(
             ">I", blob, t.NEEDLE_HEADER_SIZE + size)[0]
         if verify_crc and n.data:

@@ -237,6 +237,24 @@ class Volume:
                 offset += disk
         self._idx_f = open(base + ".idx", "ab")
 
+    def scrub(self, limit: int = 0) -> dict:
+        """Verify every live needle end-to-end: disk read, size check,
+        CRC32C (needle.from_bytes raises on mismatch). The per-volume
+        arm of cluster scrub (BASELINE config #5); the EC arm is the
+        shell's ec.verify parity check. `limit` bounds the record
+        count (0 = all)."""
+        checked = 0
+        bad: list[dict] = []
+        for key, _off, _size in list(self.nm.live_items()):
+            if limit and checked >= limit:
+                break
+            checked += 1
+            try:
+                self.read_needle(key)
+            except (ValueError, IOError, KeyError) as e:
+                bad.append({"id": key, "error": str(e)})
+        return {"volume": self.vid, "checked": checked, "bad": bad}
+
     def _rebuild_index_native(self, base: str) -> bool:
         """C++ fast path of rebuild_index: bulk-scan the .dat, write
         the .idx vectorized, reload the map through the standard

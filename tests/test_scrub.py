@@ -1,0 +1,105 @@
+"""Cluster scrub: volume.scrub full-read CRC verification and
+ec.verify parity checking of spread shards (the two arms of BASELINE
+config #5 as operator verbs).
+"""
+import secrets
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.shell import commands_ec, commands_volume
+from seaweedfs_tpu.shell.env import CommandEnv
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("scrub")),
+                n_volume_servers=3, volume_size_limit=4 << 20,
+                max_volumes=40)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    e = CommandEnv(cluster.master_url)
+    e.acquire_lock()
+    return e
+
+
+def fill_volume(cluster, col, n=20, size=4096):
+    rng = np.random.default_rng(1)
+    a0 = verbs.assign(cluster.master_url, collection=col)
+    vid = int(a0.fid.split(",")[0])
+    verbs.upload(a0, rng.bytes(size))
+    for _ in range(n - 1):
+        a = verbs.assign(cluster.master_url, collection=col)
+        verbs.upload(a, rng.bytes(size))
+    return vid
+
+
+class TestVolumeScrub:
+    def test_clean_volume_scrubs_clean(self, cluster, env):
+        col = "sc" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col)
+        out = commands_volume.volume_scrub(env, volume_id=vid)
+        assert out and all(r["bad"] == [] for r in out)
+        assert sum(r["checked"] for r in out) >= 1
+
+    def test_corruption_detected(self, cluster, env):
+        col = "bad" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=8)
+        # flip a data byte on the primary's .dat behind the server's back
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        v = store.find_volume(vid)
+        key, off, size = next(v.nm.live_items())
+        from seaweedfs_tpu.storage import types as t
+        byte_off = t.offset_to_actual(off) + t.NEEDLE_HEADER_SIZE + 2
+        orig = v.dat.read_at(1, byte_off)
+        v.dat.write_at(bytes([orig[0] ^ 0xFF]), byte_off)
+        out = commands_volume.volume_scrub(env, volume_id=vid)
+        bad = [b for r in out for b in r["bad"]]
+        assert any(b["id"] == key for b in bad)
+        # restore so other tests aren't poisoned
+        v.dat.write_at(orig, byte_off)
+
+    def test_scrub_all_with_limit(self, cluster, env):
+        out = commands_volume.volume_scrub(env, limit=3)
+        assert all(r["checked"] <= 3 for r in out)
+
+
+class TestEcVerify:
+    def test_verify_after_encode(self, cluster, env):
+        col = "ev" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=12, size=8192)
+        commands_ec.ec_encode(env, vid)
+        out = commands_ec.ec_verify(env, vid, sample_mb=1)
+        assert out["verified"] is True
+        assert out["bytes_checked_per_shard"] > 0
+
+    def test_verify_detects_shard_corruption(self, cluster, env):
+        col = "evc" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=12, size=8192)
+        commands_ec.ec_encode(env, vid)
+        # corrupt one mounted shard's bytes directly
+        ecv = next(s.ec_volumes[vid] for s in cluster.stores
+                   if vid in s.ec_volumes)
+        sid, shard = next(iter(ecv.shards.items()))
+        orig = shard.read_at(10, 1)
+        with open(shard.path, "r+b") as f:
+            f.seek(10)
+            f.write(bytes([orig[0] ^ 0x5A]))
+        try:
+            out = commands_ec.ec_verify(env, vid, sample_mb=1)
+            assert out["verified"] is False
+        finally:
+            with open(shard.path, "r+b") as f:
+                f.seek(10)
+                f.write(orig)
+
+    def test_missing_shards_reported(self, env):
+        out = commands_ec.ec_verify(env, 999_999)
+        assert out["verified"] is False and out["missing_shards"]
