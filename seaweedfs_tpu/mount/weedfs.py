@@ -397,20 +397,26 @@ class WeedFS:
 
     def read(self, fh: int, offset: int, size: int) -> bytes:
         h = self._handle(fh)
-        committed_size = total_size(h.entry.chunks)
-        out = bytearray(size)
-        # committed chunks first
-        n_committed = 0
-        if offset < committed_size:
-            want = min(size, committed_size - offset)
-            data = self._read_chunks(h.entry.chunks, offset, want)
-            out[:len(data)] = data
-            n_committed = len(data)
-        # dirty overlay wins over committed bytes
-        covered = h.dirty.read_overlay(offset, size, out)
-        max_extent = max(
-            [offset + n_committed] + [e for _, e in covered]) - offset
-        return bytes(out[:min(size, max_extent)])
+        # h.lock makes the (entry.chunks, dirty overlay) pair atomic
+        # against flush: mid-flush the overlay is already drained but
+        # the chunks aren't merged yet — an unlocked read in that
+        # window returns zeros, and a concurrent kernel READAHEAD
+        # hitting it poisons the page cache with them
+        with h.lock:
+            committed_size = total_size(h.entry.chunks)
+            out = bytearray(size)
+            # committed chunks first
+            n_committed = 0
+            if offset < committed_size:
+                want = min(size, committed_size - offset)
+                data = self._read_chunks(h.entry.chunks, offset, want)
+                out[:len(data)] = data
+                n_committed = len(data)
+            # dirty overlay wins over committed bytes
+            covered = h.dirty.read_overlay(offset, size, out)
+            max_extent = max(
+                [offset + n_committed] + [e for _, e in covered]) - offset
+            return bytes(out[:min(size, max_extent)])
 
     def _read_chunks(self, chunks: list[FileChunk], offset: int,
                      size: int) -> bytes:

@@ -170,7 +170,9 @@ class VolumeServer:
                      self.handle_volume_tail_receive),
             web.get("/admin/volume_info", self.handle_volume_info),
             web.post("/admin/query", self.handle_query),
-            web.route("*", "/{fid:[0-9]+,[0-9a-fA-F]+}", self.handle_fid),
+            # `_N` suffix = assign?count batch slot (ParsePath:121-141)
+            web.route("*", "/{fid:[0-9]+,[0-9a-fA-F]+(_[0-9]+)?}",
+                      self.handle_fid),
         ])
         return app
 

@@ -105,11 +105,21 @@ def format_file_id(volume_id: int, key: int, cookie: int) -> str:
 
 
 def parse_file_id(fid: str) -> tuple[int, int, int]:
-    """fid string -> (volume_id, key, cookie)."""
+    """fid string -> (volume_id, key, cookie). A `_N` suffix adds N to
+    the key (needle.go ParsePath:121-141) — that's how clients address
+    the extra slots of an `assign?count=N` batch: fid, fid_1, ...,
+    fid_{N-1}."""
     vid_s, _, rest = fid.partition(",")
+    delta = 0
+    if "_" in rest:
+        rest, _, delta_s = rest.rpartition("_")
+        try:
+            delta = int(delta_s)
+        except ValueError:
+            raise ValueError(f"bad file id delta {fid!r}") from None
     if not rest or len(rest) <= 8:
         raise ValueError(f"bad file id {fid!r}")
     volume_id = int(vid_s)
-    key = int(rest[:-8], 16)
+    key = int(rest[:-8], 16) + delta
     cookie = int(rest[-8:], 16)
     return volume_id, key, cookie

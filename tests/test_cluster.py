@@ -195,3 +195,15 @@ class TestNodeDeath:
             assert c.master.topo.lookup(vid) == []
         finally:
             c.stop()
+
+
+class TestAssignCountBatch:
+    def test_upload_to_suffix_fids(self, cluster):
+        a = verbs.assign(cluster.master_url, count=3)
+        assert a.count == 3
+        for i, payload in enumerate((b"zero", b"one", b"two")):
+            fid = a.fid if i == 0 else f"{a.fid}_{i}"
+            verbs.upload(f"http://{a.url}/{fid}", payload)
+        for i, payload in enumerate((b"zero", b"one", b"two")):
+            fid = a.fid if i == 0 else f"{a.fid}_{i}"
+            assert verbs.download(f"http://{a.url}/{fid}") == payload

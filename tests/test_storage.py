@@ -233,3 +233,19 @@ class TestMmapBackend:
         from seaweedfs_tpu.storage import backend as bk
         with pytest.raises((RuntimeError, NotImplementedError)):
             bk.create("rclone", "remote:path")
+
+
+class TestFidCountSuffix:
+    """`assign?count=N` batch addressing: fid_1..fid_{N-1} add to the
+    key (needle.go ParsePath)."""
+
+    def test_suffix_parses_as_key_delta(self):
+        base_vid, base_key, base_cookie = t.parse_file_id("3,01637037d6")
+        for i in (1, 2, 15):
+            vid, key, cookie = t.parse_file_id(f"3,01637037d6_{i}")
+            assert (vid, key - base_key, cookie) == \
+                (base_vid, i, base_cookie)
+
+    def test_bad_suffix_rejected(self):
+        with pytest.raises(ValueError):
+            t.parse_file_id("3,01637037d6_x")
