@@ -73,3 +73,82 @@ def sharded_encode_scrub(mesh, k: int = 10, m: int = 4):
         out_shardings=(data_sh, repl),
     )
     return step, a_bits, data_sh
+
+
+def rebuild_mesh(n_devices: int | None = None):
+    """1-D mesh over the `shard` axis: device i holds shard-rows i*k/d
+    .. (i+1)*k/d — the layout that mirrors storage reality, where each
+    shard lives on a different server/chip."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), ("shard",))
+
+
+def sharded_rebuild(mesh, k: int = 10, m: int = 4,
+                    present: list[int] | None = None,
+                    missing: list[int] | None = None):
+    """Distributed reconstruction with shard rows spread across the
+    mesh — the framework's ring/all-to-all sequence-parallel analogue.
+
+    Each device holds a row block of the (8k, n) bit expansion (its
+    local shards); it computes the partial parity counts its rows
+    contribute, and a reduce-scatter ring (lax.psum_scatter over the
+    `shard` axis — XLA lowers it onto ICI as a ring) leaves every
+    device with the finished column slice of the rebuilt shards. The
+    mod-2 fold happens after the ring: integer partial counts sum
+    exactly in int32, and total_count & 1 == XOR.
+
+    Returns (step, a_pm) where step(a_pm, shards_rowsharded) ->
+    rebuilt bytes, column-sharded. shards input: (k, n) uint8 with k
+    divisible by the mesh size; n divisible by 8*mesh size.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    if present is None or missing is None:
+        missing = list(range(m))
+        present = list(range(m, k + m))[:k]
+    coef, _ = rs_matrix.recovery_rows(k, len(missing), present, missing)
+    a_bits = gf256.expand_to_bits(coef)  # (8m', 8k)
+    d = mesh.devices.size
+    # granularity is BIT rows: the (8k, n) expansion shards over
+    # devices, so 8k (80 for RS(10,4)) must divide — device
+    # boundaries may cut across a byte's bit-planes, which is fine
+    # because the dot contracts all of them
+    assert (8 * k) % d == 0, f"{8 * k} bit rows over {d} devices"
+
+    def step(a, local_bits_rows):
+        # a: full (8m', 8k) replicated; local rows: (8k/d, n)
+        i = jax.lax.axis_index("shard")
+        rows_per = a.shape[1] // d
+        a_block = jax.lax.dynamic_slice(
+            a, (0, i * rows_per), (a.shape[0], rows_per))
+        partial = jax.lax.dot_general(
+            a_block, local_bits_rows, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        # reduce-scatter ring: sum partials, scatter columns
+        total = jax.lax.psum_scatter(partial, "shard",
+                                     scatter_dimension=1, tiled=True)
+        return total & 1
+
+    smapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("shard", None)),
+        out_specs=P(None, "shard"))
+
+    @jax.jit
+    def rebuild(a, shards_u8):
+        from ..ops.bits import pack_bits_uint8, unpack_bits_bf16
+
+        bits = unpack_bits_bf16(shards_u8)       # (8k, n)
+        out_bits = smapped(a, bits)              # (8m', n) col-sharded
+        return pack_bits_uint8(out_bits)
+
+    a_dev = jnp.asarray(a_bits, dtype=jnp.bfloat16)
+    return rebuild, a_dev, coef

@@ -1,0 +1,51 @@
+"""Distributed reconstruction over the virtual 8-device mesh: shard
+rows spread across devices, reduce-scatter ring (psum_scatter over
+ICI) folding the partial parities — bit-exact vs the numpy codec.
+"""
+import jax
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models import ec_pipeline
+from seaweedfs_tpu.ops import codec_numpy
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest provides 8 cpu devices"
+    return ec_pipeline.rebuild_mesh(8)
+
+
+def test_rebuild_bit_exact_vs_numpy(mesh):
+    k, m = 10, 4
+    missing = [0, 3, 11, 13]
+    present = [i for i in range(k + m) if i not in missing]
+    rebuild, a_dev, coef = ec_pipeline.sharded_rebuild(
+        mesh, k=k, m=m, present=present, missing=missing)
+    rng = np.random.default_rng(0)
+    n = 8 * 1024  # divisible by the 8-way scatter
+    shards = rng.integers(0, 256, (k, n), dtype=np.uint8)
+    got = np.asarray(rebuild(a_dev, shards))
+    want = codec_numpy.coded_matmul(coef, shards)
+    assert np.array_equal(got, want)
+
+
+def test_output_is_column_sharded(mesh):
+    rebuild, a_dev, _ = ec_pipeline.sharded_rebuild(mesh)
+    rng = np.random.default_rng(1)
+    shards = rng.integers(0, 256, (10, 4096), dtype=np.uint8)
+    out = rebuild(a_dev, shards)
+    # the ring leaves each device holding its column slice
+    assert len(out.sharding.device_set) == 8
+
+
+def test_collective_in_compiled_program(mesh):
+    """The compiled step really contains a cross-device reduce
+    (reduce-scatter or its all-reduce lowering), not a gather of
+    everything to one device."""
+    rebuild, a_dev, _ = ec_pipeline.sharded_rebuild(mesh)
+    rng = np.random.default_rng(2)
+    shards = rng.integers(0, 256, (10, 4096), dtype=np.uint8)
+    txt = jax.jit(rebuild).lower(a_dev, shards).compile().as_text()
+    assert "reduce-scatter" in txt or "all-reduce" in txt, \
+        txt[:2000]
