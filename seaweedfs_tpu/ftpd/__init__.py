@@ -46,8 +46,10 @@ class FtpSession(threading.Thread):
         return (root + norm) if norm != "/" else (root or "/")
 
     def _filer(self, method: str, path: str, **kw) -> requests.Response:
-        return requests.request(method, f"{self.srv.filer_url}{path}",
-                                timeout=600, **kw)
+        from ..rpc.httpclient import session
+
+        return session().request(method, f"{self.srv.filer_url}{path}",
+                                 timeout=600, **kw)
 
     def _open_data(self) -> socket.socket:
         if self._pasv is None:
@@ -163,7 +165,9 @@ class FtpSession(threading.Thread):
 
     def _cmd_pasv(self, arg: str) -> None:
         self._listen_pasv()
-        ip = self.srv.host.replace(".", ",")
+        # advertise the address the client already reached us on — a
+        # wildcard bind (0.0.0.0) must never leak into the 227 reply
+        ip = self.conn.getsockname()[0].replace(".", ",")
         port = self._pasv.getsockname()[1]
         self.reply(227, f"entering passive mode "
                         f"({ip},{port >> 8},{port & 0xFF})")
@@ -253,25 +257,40 @@ class FtpSession(threading.Thread):
             r.close()
         self.reply(226, "transfer complete")
 
+    # spill uploads to disk past this; an FTP gateway's whole job is
+    # large transfers, so the body must never have to fit in RAM
+    SPOOL_MAX = 16 << 20
+
     def _store(self, arg: str, append: bool) -> None:
+        import shutil
+        import tempfile
+
         path = self._abs(arg)
         self.reply(150, "opening data connection")
         data = self._open_data()
-        chunks = []
+        spool = tempfile.SpooledTemporaryFile(max_size=self.SPOOL_MAX)
         try:
+            if append:
+                # prefix with the existing content, streamed
+                r = self._filer("GET", path, stream=True)
+                if r.status_code == 200:
+                    shutil.copyfileobj(r.raw, spool, 256 << 10)
+                r.close()
             while True:
                 chunk = data.recv(256 << 10)
                 if not chunk:
                     break
-                chunks.append(chunk)
-        finally:
+                spool.write(chunk)
             data.close()
-        body = b"".join(chunks)
-        if append:
-            r = self._filer("GET", path)
-            if r.status_code == 200:
-                body = r.content + body
-        self._filer("POST", path, data=body).raise_for_status()
+            data = None
+            spool.seek(0)
+            # file-object body streams as chunked transfer encoding;
+            # the filer's autochunk splits it into volume chunks
+            self._filer("POST", path, data=spool).raise_for_status()
+        finally:
+            if data is not None:
+                data.close()
+            spool.close()
         self.reply(226, "transfer complete")
 
     def _cmd_stor(self, arg: str) -> None:

@@ -204,6 +204,13 @@ def _load_libfuse():
 
 
 def libfuse_available() -> bool:
+    import platform
+
+    # the struct layouts below are the x86-64 glibc ABI; on another
+    # arch this binding would write stat fields at wrong offsets and
+    # serve garbage — fail over to the clear "not available" error
+    if platform.machine() != "x86_64":
+        return False
     try:
         _load_libfuse()
         return True

@@ -24,6 +24,8 @@ import uuid
 
 import requests
 
+from ..rpc.httpclient import session
+
 from ..filer.entry import Entry
 from ..rpc.meta_subscriber import MetaSubscriber
 from .client import make_client
@@ -69,7 +71,7 @@ class RemoteGateway:
 
     def _load_offset(self) -> int:
         try:
-            r = requests.get(f"{self.filer}/kv/{self.offset_key}",
+            r = session().get(f"{self.filer}/kv/{self.offset_key}",
                              timeout=5)
             if r.status_code == 200:
                 return int(r.content)
@@ -79,7 +81,7 @@ class RemoteGateway:
 
     def _save_offset(self, ts_ns: int) -> None:
         try:
-            requests.put(f"{self.filer}/kv/{self.offset_key}",
+            session().put(f"{self.filer}/kv/{self.offset_key}",
                          data=str(ts_ns).encode(), timeout=5)
         except requests.RequestException:
             pass

@@ -574,12 +574,19 @@ class MasterServer:
                               len(self.topo.ec_locations))
             metrics.gauge_set("master_max_volume_id",
                               self.topo.max_volume_id)
+            # layouts are keyed (collection, rp, ttl, disk); aggregate
+            # per collection or same-label gauge_set calls overwrite
+            per_col: dict[str, list[int]] = {}
             for key, layout in self.topo.layouts.items():
-                lab = {"collection": key.collection or "default"}
-                metrics.gauge_set("master_volumes",
-                                  len(layout.locations), lab)
-                metrics.gauge_set("master_writable_volumes",
-                                  len(layout.writable), lab)
+                agg = per_col.setdefault(key.collection or "default",
+                                         [0, 0])
+                agg[0] += len(layout.locations)
+                agg[1] += len(layout.writable)
+            for col, (total, writable) in per_col.items():
+                lab = {"collection": col}
+                metrics.gauge_set("master_volumes", total, lab)
+                metrics.gauge_set("master_writable_volumes", writable,
+                                  lab)
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

@@ -513,7 +513,9 @@ class VolumeServer:
         vid = int(fid.split(",")[0])
         # single-copy volumes have no peers by definition: skip the
         # master lookup entirely (it would otherwise cost one master
-        # round-trip PER WRITE — measured 5x the needle-write time)
+        # round-trip PER WRITE — measured 5x the needle-write time).
+        # Same rule as the reference (store_replicate.go:191
+        # GetWritableRemoteReplications returns early on copy count 1).
         v = self.store.find_volume(vid)
         if v is not None and \
                 v.super_block.replica_placement.copy_count <= 1:

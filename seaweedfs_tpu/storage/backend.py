@@ -249,7 +249,12 @@ class MmapFile:
     appends extend the file and remap. Best for read-heavy volumes
     whose working set fits the page cache."""
 
-    GROW = 1 << 20  # remap granularity for appends
+    # appends extend the backing file in GROW steps so a remap happens
+    # once per megabyte, not once per record; the file is trimmed back
+    # to the logical size on close. (After a crash the grow padding
+    # survives as trailing zeros — the volume load scan walks them as
+    # empty tombstones, same as any torn tail.)
+    GROW = 1 << 20
 
     def __init__(self, path: str, create: bool = False):
         import mmap as _mmap
@@ -260,22 +265,21 @@ class MmapFile:
         self._f = open(path, mode)
         self._path = path
         self._lock = threading.RLock()
-        self._size = os.path.getsize(path)
+        self._size = os.path.getsize(path)    # logical bytes
+        self._mapped = self._size             # physical/mapped bytes
         self._mmap_mod = _mmap
         self._map = None
-        self._mapped = 0
         self._remap()
 
     def _remap(self) -> None:
         if self._map is not None:
             self._map.close()
             self._map = None
-        if self._size > 0:
+        if self._mapped > 0:
             self._f.flush()
             self._map = self._mmap_mod.mmap(
-                self._f.fileno(), self._size,
+                self._f.fileno(), self._mapped,
                 access=self._mmap_mod.ACCESS_WRITE)
-        self._mapped = self._size
 
     @property
     def name(self) -> str:
@@ -291,12 +295,13 @@ class MmapFile:
     def write_at(self, data: bytes, offset: int) -> int:
         with self._lock:
             end = offset + len(data)
-            if end > self._size:
-                self._f.seek(0, os.SEEK_END)
-                self._f.truncate(end)
-                self._size = end
+            if end > self._mapped:
+                grown = ((end + self.GROW - 1) // self.GROW) * self.GROW
+                self._f.truncate(grown)
+                self._mapped = grown
                 self._remap()
             self._map[offset:end] = data
+            self._size = max(self._size, end)
             return len(data)
 
     def append(self, data: bytes) -> int:
@@ -309,6 +314,7 @@ class MmapFile:
         with self._lock:
             self._f.truncate(size)
             self._size = size
+            self._mapped = size
             self._remap()
 
     def size(self) -> int:
@@ -331,6 +337,12 @@ class MmapFile:
             if self._map is not None:
                 self._map.close()
                 self._map = None
+            # drop the grow padding so the on-disk file ends at the
+            # logical size (plain DiskFile can reopen it verbatim)
+            try:
+                self._f.truncate(self._size)
+            except OSError:
+                pass
             self._f.close()
 
 

@@ -8,7 +8,7 @@ gives a 32GB max volume. Setting WEED_5BYTES_OFFSET=1 in the
 environment selects the reference's `5BytesOffset` build-tag variant:
 17-byte index entries whose offset is 4 BE lower bytes followed by one
 high byte (offset_5bytes.go OffsetToBytes order), raising the ceiling
-to 8PB volumes. Like the build tag, the choice is process-wide and
+to 8TiB volumes (the reference's large-disk limit). Like the build tag, the choice is process-wide and
 must match the files on disk. Sizes are int32 with -1 as the tombstone
 marker.
 """
@@ -26,7 +26,7 @@ NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
 NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16 / 17
 TIMESTAMP_SIZE = 8
 TOMBSTONE_SIZE = -1  # Size value marking a deleted needle
-# 32GB with 4-byte padded offsets; 8PB with 5
+# 32GB with 4-byte padded offsets; 8TiB with 5
 MAX_VOLUME_SIZE = NEEDLE_PADDING * (1 << (8 * OFFSET_SIZE))
 
 

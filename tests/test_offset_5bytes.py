@@ -1,5 +1,5 @@
 """5-byte offset variant (the reference's `5BytesOffset` build tag,
-offset_5bytes.go): 17-byte index entries, 8PB volume ceiling.
+offset_5bytes.go): 17-byte index entries, 8TiB volume ceiling.
 
 The mode is process-wide (selected at import via WEED_5BYTES_OFFSET=1,
 like a build tag), so the full storage/EC behavior check runs the
