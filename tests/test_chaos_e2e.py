@@ -1,0 +1,160 @@
+"""Chaos e2e over real OS processes: SIGKILL a volume server holding
+replicas, confirm degraded reads keep working, the master drops the
+dead node, and volume.fix.replication restores the replica count onto
+a fresh server — the failure-detection/elastic-recovery loop of
+SURVEY §5 exercised end-to-end.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.shell import commands_volume
+from seaweedfs_tpu.shell.env import CommandEnv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait(pred, timeout=30, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(f"{msg} never became true")
+
+
+class Procs:
+    def __init__(self):
+        self.procs = {}
+        self.env = dict(os.environ, PYTHONPATH=REPO)
+
+    def spawn(self, name, *argv):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *argv],
+            env=self.env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self.procs[name] = p
+        return p
+
+    def sigkill(self, name):
+        self.procs[name].kill()
+        self.procs[name].wait()
+
+    def stop_all(self):
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    procs = Procs()
+    mport = free_port()
+    master = f"http://127.0.0.1:{mport}"
+    procs.spawn("master", "master", "-port", str(mport),
+                "-volumeSizeLimitMB", "64",
+                "-defaultReplication", "001")
+    wait(lambda: requests.get(f"{master}/cluster/status",
+                              timeout=1).ok, msg="master up")
+    vports = {}
+    for name in ("v1", "v2"):
+        vp = free_port()
+        vports[name] = vp
+        d = tmp_path / name
+        d.mkdir()
+        procs.spawn(name, "volume", "-port", str(vp), "-dir", str(d),
+                    "-max", "8", "-mserver", f"127.0.0.1:{mport}",
+                    # fast pulse so death detection fits test timeouts
+                    )
+        wait(lambda vp=vp: requests.get(
+            f"http://127.0.0.1:{vp}/status", timeout=1).ok,
+            msg=f"{name} up")
+    wait(lambda: _node_count(master) >= 2, msg="both registered")
+    try:
+        yield {"master": master, "procs": procs, "vports": vports,
+               "tmp": tmp_path}
+    finally:
+        procs.stop_all()
+
+
+def _node_count(master):
+    topo = requests.get(f"{master}/cluster/status",
+                        timeout=2).json()["Topology"]
+    return sum(len(r["nodes"]) for dc in topo["datacenters"]
+               for r in dc["racks"])
+
+
+def test_kill_replica_then_heal(cluster):
+    master = cluster["master"]
+    procs = cluster["procs"]
+
+    # replicated write lands on both servers
+    a = verbs.assign(master, replication="001")
+    verbs.upload(a, b"survive the crash")
+    vid = int(a.fid.split(",")[0])
+    wait(lambda: len(requests.get(
+        f"{master}/dir/lookup", params={"volumeId": str(vid)},
+        timeout=2).json()["locations"]) == 2, msg="two replicas")
+
+    # hard-kill one holder
+    locs = requests.get(f"{master}/dir/lookup",
+                        params={"volumeId": str(vid)},
+                        timeout=2).json()["locations"]
+    ports_by_url = {f"127.0.0.1:{p}": n
+                    for n, p in cluster["vports"].items()}
+    victim = ports_by_url[locs[0]["url"]]
+    survivor_url = locs[1]["url"]
+    procs.sigkill(victim)
+
+    # master notices the death and drops the node; reads keep working
+    wait(lambda: _node_count(master) == 1, timeout=40,
+         msg="dead node dropped from topology")
+    assert verbs.download(
+        f"http://{survivor_url}/{a.fid}") == b"survive the crash"
+    wait(lambda: len(requests.get(
+        f"{master}/dir/lookup", params={"volumeId": str(vid)},
+        timeout=2).json()["locations"]) == 1, msg="stale location gone")
+
+    # elastic recovery: a fresh server joins, fix.replication heals
+    v3p = free_port()
+    d3 = cluster["tmp"] / "v3"
+    d3.mkdir()
+    procs.spawn("v3", "volume", "-port", str(v3p), "-dir", str(d3),
+                "-max", "8",
+                "-mserver", master.replace("http://", ""))
+    wait(lambda: _node_count(master) == 2, msg="new server joined")
+
+    env = CommandEnv(master)
+    env.acquire_lock()
+    fixes = commands_volume.volume_fix_replication(env)
+    assert any(f.get("volume") == vid for f in fixes), fixes
+
+    wait(lambda: len(requests.get(
+        f"{master}/dir/lookup", params={"volumeId": str(vid)},
+        timeout=2).json()["locations"]) == 2, msg="replica restored")
+    # the healed copy serves the data
+    assert verbs.download(
+        f"http://127.0.0.1:{v3p}/{a.fid}") == b"survive the crash"
