@@ -175,6 +175,43 @@ class TestKeepConnected:
             mc.stop()
 
 
+class TestKeepConnectedInvalidation:
+    def test_node_death_invalidates_subscribed_clients(self, tmp_path):
+        """The subtle KeepConnected case (topology.go:303,330): a dead
+        node's locations must vanish from SUBSCRIBED client caches via
+        the push stream (snapshot replace), without any client-side
+        lookup or TTL expiry."""
+        c = Cluster(str(tmp_path), n_volume_servers=2,
+                    volume_size_limit=8 << 20, pulse_seconds=0.2)
+        mc = MasterClient(c.master_url, subscribe=True)
+        try:
+            a = verbs.assign(c.master_url)
+            verbs.upload(a, b"x")
+            vid = int(a.fid.split(",")[0])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with mc._lock:
+                    if mc._vid_cache.get(vid):
+                        break
+                time.sleep(0.05)
+            with mc._lock:
+                assert mc._vid_cache.get(vid), "push never arrived"
+            owner = next(i for i, s in enumerate(c.stores)
+                         if s.has_volume(vid))
+            c.volume_threads[owner].stop()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with mc._lock:
+                    locs = mc._vid_cache.get(vid, [])
+                if not locs:
+                    break
+                time.sleep(0.1)
+            assert not locs, f"stale locations survived: {locs}"
+        finally:
+            mc.stop()
+            c.stop()
+
+
 class TestNodeDeath:
     def test_unregister_on_disconnect(self, tmp_path):
         c = Cluster(str(tmp_path), n_volume_servers=2,
