@@ -159,10 +159,13 @@ class Filer:
                         hard_link_id=src.hard_link_id)
             self._ensure_parents(dst_path)
             self.store.insert_entry(replace(dst, chunks=[]))
-        dst = self._resolve_hardlink(dst)
-        d, _ = dst.dir_and_name
-        # log the RESOLVED entry: subscribers must see real chunks
-        self.meta_log.append(d, None, dst, signatures)
+            dst = self._resolve_hardlink(dst)
+            d, _ = dst.dir_and_name
+            # log the RESOLVED entry (subscribers must see real
+            # chunks) INSIDE the lock: a racing delete of dst would
+            # otherwise log its delete first and subscribers would
+            # apply create-after-delete, resurrecting the name
+            self.meta_log.append(d, None, dst, signatures)
         self._drain_freed()
         return dst
 
@@ -221,13 +224,24 @@ class Filer:
                      prefix: str = "") -> list[Entry]:
         dirpath = norm_path(dirpath)
         out, now = [], time.time()
-        batch = self.store.list_directory_entries(
-            dirpath, start_from, inclusive, limit, prefix)
-        for e in batch:
-            if e.is_expired(now):
-                self._expire(e)
-                continue
-            out.append(self._resolve_hardlink(e))
+        # TTL-expired entries are filtered AFTER the raw page, so keep
+        # paging until `limit` live entries are in hand or the raw
+        # stream truly ends — otherwise a page with expired entries
+        # under-fills and callers misread it as end-of-directory
+        last, first = start_from, True
+        while len(out) < limit:
+            want = limit - len(out)
+            batch = self.store.list_directory_entries(
+                dirpath, last, inclusive if first else False, want,
+                prefix)
+            for e in batch:
+                if e.is_expired(now):
+                    self._expire(e)
+                    continue
+                out.append(self._resolve_hardlink(e))
+            if len(batch) < want:
+                break
+            last, first = batch[-1].name, False
         self._drain_freed()
         return out
 
@@ -390,8 +404,9 @@ class Filer:
             return []
         dead_chunks: list[FileChunk] = []
         if e.is_directory:
-            children = self.list_entries(path, limit=1)
-            if children and not recursive:
+            # list_entries pages past TTL-expired entries internally,
+            # so one live result == genuinely non-empty
+            if not recursive and self.list_entries(path, limit=1):
                 raise DirectoryNotEmptyError(
                     f"directory not empty: {path}")
             for sub in self.iter_tree(path):
@@ -418,6 +433,14 @@ class Filer:
         only streaming rename of filer_grpc_server_rename.go; chunks
         stay where they are."""
         old_path, new_path = norm_path(old_path), norm_path(new_path)
+        if new_path == old_path or \
+                new_path.startswith(old_path.rstrip("/") + "/"):
+            # moving a directory into its own subtree would copy the
+            # children under the new name and then delete_folder_children
+            # the old tree — INCLUDING the copies (the reference filer
+            # rejects this too)
+            raise ValueError(
+                f"cannot move {old_path} into itself ({new_path})")
         with self._mutation_lock:
             e = self.find_entry(old_path)
             if e is None:

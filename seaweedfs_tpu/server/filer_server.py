@@ -233,7 +233,10 @@ class FilerServer:
             data_chunks = resolve_chunk_manifest(
                 lambda fid: read_fid(self._lookup_fid, fid), chunks)
         except Exception:
-            data_chunks = []
+            # resolution failed (manifest fid unreachable): still
+            # delete the plain chunks already in hand — dropping them
+            # too would leak every regular chunk of the file
+            data_chunks = [c for c in chunks if not c.is_chunk_manifest]
         manifests = [c for c in chunks if c.is_chunk_manifest]
         for c in data_chunks + manifests:
             try:
@@ -376,6 +379,14 @@ class FilerServer:
         prefix = req.query.get("prefix", "")
         entries = self.filer.list_entries(
             path, start_from=last, limit=limit, prefix=prefix)
+        # list_entries filters TTL-expired entries AFTER paging, so a
+        # short result does NOT mean end-of-directory; probe for one
+        # more live entry past the page to drive the more-flag honestly
+        more = False
+        if entries:
+            more = bool(self.filer.list_entries(
+                path, start_from=entries[-1].name, limit=1,
+                prefix=prefix))
         accept = req.headers.get("Accept", "")
         if "text/html" in accept and "application/json" not in accept:
             # browser view (server/filer_ui/ equivalent); API clients
@@ -416,7 +427,7 @@ class FilerServer:
             "path": path,
             "entries": [e.to_dict() for e in entries],
             "lastFileName": entries[-1].name if entries else "",
-            "shouldDisplayLoadMore": len(entries) == limit,
+            "shouldDisplayLoadMore": more,
         })
 
     # -- write path -----------------------------------------------------
@@ -445,9 +456,21 @@ class FilerServer:
                           f"{rule.max_file_name_length}-byte limit set "
                           "by filer.conf"}, status=400)
         if "mv.from" in req.query:  # rename verb, reference-compatible
-            await asyncio.to_thread(
-                self.filer.rename, req.query["mv.from"], path,
-                signatures=signatures)
+            # the SOURCE path's rules apply too: renaming out of a
+            # read-only subtree is a delete there in disguise
+            src = req.query["mv.from"]
+            src_rule = self._filer_conf().match(src)
+            if src_rule.read_only:
+                return web.json_response(
+                    {"error": f"{src_rule.location_prefix or src} is "
+                              "read-only by filer.conf rule"},
+                    status=403)
+            try:
+                await asyncio.to_thread(
+                    self.filer.rename, src, path,
+                    signatures=signatures)
+            except ValueError as e:  # move-into-own-subtree guard
+                return web.json_response({"error": str(e)}, status=400)
             return web.json_response({"path": path})
         if "link.from" in req.query:  # hard link verb
             e = await asyncio.to_thread(

@@ -899,7 +899,16 @@ class VolumeServer:
         collection = body.get("collection", "")
         shard_ids = body["shard_ids"]
         source = body["source"]
+        # if shards of this ec volume are already mounted from another
+        # disk location, the new files must land beside them — writing
+        # to locations[0] would strand them where ec.mount never looks
         loc = self.store.locations[0]
+        ecv = self.store.ec_volumes.get(vid)
+        if ecv is not None:
+            for cand in self.store.locations:
+                if cand.dir == ecv.dir:
+                    loc = cand
+                    break
         base = loc.base_name(collection, vid)
         exts = [geo.shard_ext(sid) for sid in shard_ids]
         if body.get("copy_ecx", True):

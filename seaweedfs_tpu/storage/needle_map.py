@@ -21,6 +21,8 @@ import numpy as np
 from . import idx as idxmod
 from . import types as t
 
+OFFSET_DTYPE = np.uint32 if t.OFFSET_SIZE == 4 else np.uint64
+
 
 class NeedleMap:
     """Live per-volume map: key -> (offset, size), with accounting
@@ -184,7 +186,9 @@ class CompactNeedleMap:
 
     def __init__(self) -> None:
         self._keys = np.empty(0, dtype=np.uint64)
-        self._offsets = np.empty(0, dtype=np.uint32)
+        # u32 holds 4-byte offsets; the 5BytesOffset variant needs
+        # u64 or offsets past 32GB would silently truncate mod 2^32
+        self._offsets = np.empty(0, dtype=OFFSET_DTYPE)
         self._sizes = np.empty(0, dtype=np.int64)  # -1 = tombstone
         self._overlay: dict[int, tuple[int, int]] = {}
         self.file_count = 0
@@ -259,7 +263,7 @@ class CompactNeedleMap:
                       dtype=np.int64).reshape(-1, 2)
         keys = np.concatenate([self._keys, ok])
         offsets = np.concatenate([self._offsets,
-                                  ov[:, 0].astype(np.uint32)])
+                                  ov[:, 0].astype(OFFSET_DTYPE)])
         sizes = np.concatenate([self._sizes, ov[:, 1]])
         # stable sort + keep the LAST occurrence of each key (overlay
         # entries were appended after the base, so they win)
@@ -301,7 +305,7 @@ def load_compact_needle_map(idx_path: str) -> CompactNeedleMap:
     if len(arr) == 0:
         return nm
     keys = arr["key"].astype(np.uint64)
-    offsets = arr["offset"].astype(np.uint32)
+    offsets = arr["offset"].astype(OFFSET_DTYPE)
     sizes = arr["size"].astype(np.int64)
     sizes = np.where(sizes >= 0x80000000, sizes - (1 << 32), sizes)
     # tombstone rows delete; size-0 rows count as deletes too, exactly

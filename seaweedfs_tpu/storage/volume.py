@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import time
 
 from . import backend as bk
@@ -37,6 +38,10 @@ class Volume:
         self.needle_map_kind = needle_map_kind
         self.read_only = False
         self._backend_kind = backend_kind
+        # serializes mutations (append/delete/raw-append) against each
+        # other and against compact's snapshot + commit phases — the
+        # reference's per-volume write lock around Compact2/CommitCompact
+        self.write_lock = threading.RLock()
         base = self.file_name()
         exists = os.path.exists(base + ".dat")
         self.volume_info = vinfo.maybe_load_volume_info(base + ".vif")
@@ -91,6 +96,10 @@ class Volume:
         stay 8-aligned (reference appends already-padded records)."""
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
+        with self.write_lock:
+            return self._append_needle_locked(n)
+
+    def _append_needle_locked(self, n: ndl.Needle) -> tuple[int, int]:
         if not n.append_at_ns:
             # wall clock, not monotonic: append_at_ns orders records
             # ACROSS restarts for incremental sync (volume_backup.go);
@@ -119,18 +128,20 @@ class Volume:
         reference does (volume_write.go deleteNeedle2)."""
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
-        existing = self.nm.get(needle_id)
-        if existing is None:
-            return 0
-        tomb = ndl.Needle(id=needle_id)
-        tomb.append_at_ns = max(time.time_ns(),
-                                self.last_append_at_ns + 1)
-        self.last_append_at_ns = tomb.append_at_ns
-        self.dat.append(tomb.to_bytes(self.version))
-        reclaimed = self.nm.delete(needle_id)
-        idxmod.append_entry(self._idx_f, needle_id, 0, t.TOMBSTONE_SIZE)
-        self._idx_f.flush()
-        return reclaimed
+        with self.write_lock:
+            existing = self.nm.get(needle_id)
+            if existing is None:
+                return 0
+            tomb = ndl.Needle(id=needle_id)
+            tomb.append_at_ns = max(time.time_ns(),
+                                    self.last_append_at_ns + 1)
+            self.last_append_at_ns = tomb.append_at_ns
+            self.dat.append(tomb.to_bytes(self.version))
+            reclaimed = self.nm.delete(needle_id)
+            idxmod.append_entry(self._idx_f, needle_id, 0,
+                                t.TOMBSTONE_SIZE)
+            self._idx_f.flush()
+            return reclaimed
 
     # -- read path -----------------------------------------------------
     def read_needle(self, needle_id: int, cookie: int | None = None) -> ndl.Needle:
@@ -245,7 +256,9 @@ class Volume:
         count (0 = all)."""
         checked = 0
         bad: list[dict] = []
-        for key, _off, _size in list(self.nm.live_items()):
+        with self.write_lock:  # stable snapshot vs concurrent puts
+            snapshot = list(self.nm.live_items())
+        for key, _off, _size in snapshot:
             if limit and checked >= limit:
                 break
             checked += 1
@@ -392,34 +405,37 @@ class Volume:
         error, the transport must frame on record boundaries."""
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
-        start = self.dat.append(data)
-        self.dat.flush()
-        applied = 0
-        end = start
-        # bound the walk at our own bytes: a concurrent client write can
-        # land right after this segment and must not be double-indexed
-        for offset, nid, nsize, disk in self._walk_records(
-                start, start + len(data)):
-            stored = t.actual_to_offset(offset)
-            if nsize > 0:
-                self.nm.put(nid, stored, nsize)
-                idxmod.append_entry(self._idx_f, nid, stored, nsize)
-            else:
-                self.nm.delete(nid)
-                idxmod.append_entry(self._idx_f, nid, 0,
-                                    t.TOMBSTONE_SIZE)
-            self.last_append_at_ns = max(
-                self.last_append_at_ns,
-                self._append_at_ns_at(offset, nsize))
-            applied += 1
-            end = offset + disk
-        self._idx_f.flush()
-        if end != start + len(data):
-            self.dat.truncate(end)
-            raise IOError(
-                f"incremental segment ends mid-record at {end}; "
-                f"{start + len(data) - end} trailing bytes dropped")
-        return applied
+        # the write lock spans append AND the error-path truncate: a
+        # concurrent client write landing right after this segment
+        # would otherwise be chopped off by truncate(end) (its index
+        # entry left pointing past EOF)
+        with self.write_lock:
+            start = self.dat.append(data)
+            self.dat.flush()
+            applied = 0
+            end = start
+            for offset, nid, nsize, disk in self._walk_records(
+                    start, start + len(data)):
+                stored = t.actual_to_offset(offset)
+                if nsize > 0:
+                    self.nm.put(nid, stored, nsize)
+                    idxmod.append_entry(self._idx_f, nid, stored, nsize)
+                else:
+                    self.nm.delete(nid)
+                    idxmod.append_entry(self._idx_f, nid, 0,
+                                        t.TOMBSTONE_SIZE)
+                self.last_append_at_ns = max(
+                    self.last_append_at_ns,
+                    self._append_at_ns_at(offset, nsize))
+                applied += 1
+                end = offset + disk
+            self._idx_f.flush()
+            if end != start + len(data):
+                self.dat.truncate(end)
+                raise IOError(
+                    f"incremental segment ends mid-record at {end}; "
+                    f"{start + len(data) - end} trailing bytes dropped")
+            return applied
 
     def modified_at_second(self) -> int:
         """Unix seconds of the last write, falling back to the .dat
@@ -535,11 +551,17 @@ class Volume:
             ttl=self.super_block.ttl,
             compaction_revision=(self.super_block.compaction_revision + 1)
             & 0xFFFF)
+        with self.write_lock:
+            # snapshot under the write lock: a concurrent put would
+            # otherwise mutate the dict mid-iteration, and the idx
+            # watermark must match the item set exactly
+            items = sorted(self.nm.live_items(), key=lambda kv: kv[1])
+            self._idx_f.flush()
+            idx_snapshot = os.path.getsize(base + ".idx")
         with open(cpd, "wb") as datf, open(cpx, "wb") as idxf:
             datf.write(new_sb.to_bytes())
             write_offset = datf.tell()
-            for key, stored_off, size in sorted(
-                    self.nm.live_items(), key=lambda kv: kv[1]):
+            for key, stored_off, size in items:
                 blob = self.dat.read_at(
                     ndl.disk_size(size, self.version),
                     t.offset_to_actual(stored_off))
@@ -547,19 +569,49 @@ class Volume:
                 idxmod.append_entry(
                     idxf, key, t.actual_to_offset(write_offset), size)
                 write_offset += len(blob)
-        self._commit_compact(cpd, cpx)
+        self._commit_compact(cpd, cpx, idx_snapshot)
 
-    def _commit_compact(self, cpd: str, cpx: str) -> None:
+    def _commit_compact(self, cpd: str, cpx: str,
+                        idx_snapshot: int) -> None:
+        """Swap in the compacted files, first replaying every index
+        entry appended since the snapshot (writes and tombstones that
+        raced the compaction) into them (CommitCompact makeupDiff,
+        volume_vacuum.go:200). Holds the write lock so nothing lands
+        between the replay and the swap."""
         base = self.file_name()
-        self.dat.close()
-        self._idx_f.close()
-        os.replace(cpd, base + ".dat")
-        os.replace(cpx, base + ".idx")
-        self.dat = bk.DiskFile(base + ".dat")
-        self.super_block = self._read_super_block()
-        self.nm = nmap.load_needle_map(base + ".idx",
-                                       kind=self.needle_map_kind)
-        self._idx_f = open(base + ".idx", "ab")
+        with self.write_lock:
+            self._idx_f.flush()
+            with open(base + ".idx", "rb") as f:
+                f.seek(idx_snapshot)
+                delta = f.read()
+            if delta:
+                with open(cpd, "ab") as datf, open(cpx, "ab") as idxf:
+                    write_offset = os.path.getsize(cpd)
+                    step = t.NEEDLE_MAP_ENTRY_SIZE
+                    for i in range(0, len(delta) - step + 1, step):
+                        nv = t.NeedleValue.from_bytes(delta[i:i + step])
+                        if t.size_is_valid(nv.size) and nv.offset > 0:
+                            blob = self.dat.read_at(
+                                ndl.disk_size(nv.size, self.version),
+                                t.offset_to_actual(nv.offset))
+                            datf.write(blob)
+                            idxmod.append_entry(
+                                idxf, nv.key,
+                                t.actual_to_offset(write_offset),
+                                nv.size)
+                            write_offset += len(blob)
+                        else:
+                            idxmod.append_entry(idxf, nv.key, 0,
+                                                t.TOMBSTONE_SIZE)
+            self.dat.close()
+            self._idx_f.close()
+            os.replace(cpd, base + ".dat")
+            os.replace(cpx, base + ".idx")
+            self.dat = bk.DiskFile(base + ".dat")
+            self.super_block = self._read_super_block()
+            self.nm = nmap.load_needle_map(base + ".idx",
+                                           kind=self.needle_map_kind)
+            self._idx_f = open(base + ".idx", "ab")
 
     def sync(self) -> None:
         self.dat.sync()

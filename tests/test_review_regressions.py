@@ -1,0 +1,152 @@
+"""Regression tests for the high-effort review findings: rename into
+own subtree, TTL-expired-child delete/listing traps, mv.from rule
+bypass, compact-map offsets under the 5-byte variant.
+(Compact-during-writes lives in test_crash_recovery.py.)
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Entry, FileChunk, Filer
+from seaweedfs_tpu.filer.filer import DirectoryNotEmptyError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def touch(filer, path, ttl_sec=0):
+    e = Entry(full_path=path, chunks=[
+        FileChunk(fid="1,ab", offset=0, size=10,
+                  mtime_ns=time.time_ns())])
+    e.ttl_sec = ttl_sec
+    if ttl_sec:
+        e.crtime = time.time() - ttl_sec - 10  # already expired
+    return filer.create_entry(e)
+
+
+class TestRenameGuards:
+    def test_move_dir_into_own_subtree_rejected(self):
+        f = Filer("memory")
+        touch(f, "/a/b/file.txt")
+        with pytest.raises(ValueError):
+            f.rename("/a", "/a/b/c")
+        with pytest.raises(ValueError):
+            f.rename("/a", "/a")
+        # the tree is intact
+        assert f.find_entry("/a/b/file.txt") is not None
+        # sibling with common PREFIX is not "inside" /a
+        touch(f, "/ab/x.txt")
+        f.rename("/ab", "/moved")
+        assert f.find_entry("/moved/x.txt") is not None
+        f.close()
+
+
+class TestExpiredChildTraps:
+    def test_nonrecursive_delete_refuses_when_live_children_follow(self):
+        f = Filer("memory")
+        touch(f, "/d/aaa-expired", ttl_sec=1)
+        touch(f, "/d/bbb-live")
+        with pytest.raises(DirectoryNotEmptyError):
+            f.delete_entry("/d", recursive=False)
+        assert f.find_entry("/d/bbb-live") is not None
+        f.close()
+
+    def test_list_pages_past_expired_entries(self):
+        f = Filer("memory")
+        # 3 expired names sort first, then 5 live ones
+        for i in range(3):
+            touch(f, f"/dir/a{i}-exp", ttl_sec=1)
+        for i in range(5):
+            touch(f, f"/dir/z{i}-live")
+        got = [e.name for e in f.list_entries("/dir", limit=4)]
+        assert got == [f"z{i}-live" for i in range(4)]
+        f.close()
+
+
+class TestMvFromRules:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        from seaweedfs_tpu.server.cluster import Cluster
+
+        c = Cluster(str(tmp_path_factory.mktemp("mvro")),
+                    n_volume_servers=1, volume_size_limit=8 << 20,
+                    with_filer=True)
+        yield c
+        c.stop()
+
+    def test_rename_out_of_readonly_subtree_403(self, cluster):
+        requests.post(f"{cluster.filer_url}/protected/f.txt",
+                      data=b"keep me").raise_for_status()
+        from seaweedfs_tpu.filer.filer_conf import (CONF_KEY, FilerConf,
+                                                    PathConf)
+        conf = FilerConf()
+        conf.set_rule(PathConf(location_prefix="/protected",
+                               read_only=True))
+        requests.put(f"{cluster.filer_url}/kv/{CONF_KEY}",
+                     data=conf.to_json().encode()).raise_for_status()
+        time.sleep(2.2)  # filer.conf cache TTL
+        r = requests.put(f"{cluster.filer_url}/tmp/grab.txt",
+                         params={"mv.from": "/protected/f.txt"})
+        assert r.status_code == 403
+        assert requests.get(
+            f"{cluster.filer_url}/protected/f.txt").content == b"keep me"
+
+    def test_rename_into_own_subtree_400_over_http(self, cluster):
+        requests.post(f"{cluster.filer_url}/tree/x.txt",
+                      data=b"x").raise_for_status()
+        r = requests.put(f"{cluster.filer_url}/tree/sub",
+                         params={"mv.from": "/tree"})
+        assert r.status_code == 400
+        assert requests.get(
+            f"{cluster.filer_url}/tree/x.txt").status_code == 200
+
+    def test_listing_more_flag_with_expired(self, cluster):
+        import json as _json
+        base = f"{cluster.filer_url}/pagedir"
+        for i in range(3):
+            requests.post(f"{base}/f{i}.txt",
+                          data=b"x").raise_for_status()
+        r = requests.get(base + "/",
+                         params={"limit": "2"},
+                         headers={"Accept": "application/json"})
+        d = r.json()
+        assert len(d["entries"]) == 2
+        assert d["shouldDisplayLoadMore"] is True
+        r2 = requests.get(base + "/",
+                          params={"limit": "2",
+                                  "lastFileName": d["lastFileName"]},
+                          headers={"Accept": "application/json"})
+        d2 = r2.json()
+        assert len(d2["entries"]) == 1
+        assert d2["shouldDisplayLoadMore"] is False
+
+
+def test_compact_map_5byte_offsets_not_truncated():
+    """Offsets past 2^32 padded units survive the compact needle map
+    under WEED_5BYTES_OFFSET=1."""
+    code = """
+import numpy as np, tempfile, os
+from seaweedfs_tpu.storage import idx, needle_map, types as t
+assert t.OFFSET_SIZE == 5
+p = os.path.join(tempfile.mkdtemp(), "big.idx")
+arr = np.zeros(2, dtype=idx.IDX_DTYPE)
+arr["key"] = [1, 2]
+arr["offset"] = [7, (1 << 33) + 5]   # second is far past 32GB
+arr["size"] = [100, 200]
+idx.write_index(p, arr)
+nm = needle_map.load_compact_needle_map(p)
+assert nm.get(2) == ((1 << 33) + 5, 200), nm.get(2)
+nm.put(3, (1 << 39) + 1, 50)
+nm.merge_overlay()
+assert nm.get(3) == ((1 << 39) + 1, 50), nm.get(3)
+print("5b-compact-ok")
+"""
+    env = dict(os.environ, WEED_5BYTES_OFFSET="1", PYTHONPATH=REPO,
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "5b-compact-ok" in out.stdout
