@@ -317,24 +317,28 @@ class RaftNode:
         are much shorter than the transport timeout)."""
         async def one_peer(peer: str) -> None:
             while not self._stop and self.state == LEADER and \
-                    self.current_term == term:
+                    self.current_term == term and peer in self.peers:
                 await self._replicate_one(peer)
                 self._advance_commit()
                 await asyncio.sleep(0.05 * self.tick)
 
-        if not self.peers:
+        # supervise a DYNAMIC peer set: membership changes
+        # (raft.add_peer) mid-term must start replicating to the new
+        # voter immediately — a snapshot taken at election time would
+        # starve it of heartbeats until a disruptive re-election
+        tasks: dict[str, asyncio.Task] = {}
+        try:
             while not self._stop and self.state == LEADER and \
                     self.current_term == term:
+                for p in list(self.peers):
+                    t = tasks.get(p)
+                    if t is None or t.done():
+                        tasks[p] = asyncio.create_task(one_peer(p))
                 self._advance_commit()
                 await asyncio.sleep(0.05 * self.tick)
-            return
-        loops = [asyncio.create_task(one_peer(p)) for p in self.peers]
-        try:
-            await asyncio.gather(*loops)
-        except asyncio.CancelledError:
-            for t in loops:
+        finally:
+            for t in tasks.values():
                 t.cancel()
-            raise
 
     async def barrier(self, timeout: float = 5.0) -> bool:
         """Wait until this leader has applied everything committed in

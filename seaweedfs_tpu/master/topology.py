@@ -46,6 +46,9 @@ class DataNode:
         self.rack = rack
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, int] = {}  # vid -> shard bits
+        # layout key each vid was registered under — needed to leave
+        # the OLD layout when replication/ttl/disk class changes
+        self.volume_layout_keys: dict[int, "LayoutKey"] = {}
         self.last_seen = time.monotonic()
 
     @property
@@ -199,6 +202,17 @@ class Topology:
                     self._unregister_volume(node.volumes[vid], node)
                     del node.volumes[vid]
             for vid, v in new.items():
+                # a volume whose replication/ttl (or the node's disk
+                # class) changed must leave its OLD layout, or the
+                # stale key keeps serving it as writable with the old
+                # placement contract (volume.configure.replication's
+                # takes-effect-on-heartbeat path)
+                prev_key = node.volume_layout_keys.get(vid)
+                new_key = self._layout_key(v, node)
+                if prev_key is not None and prev_key != new_key:
+                    layout = self.layouts.get(prev_key)
+                    if layout is not None:
+                        layout.unregister(vid, node)
                 node.volumes[vid] = v
                 self._register_volume(v, node)
                 self.max_volume_id = max(self.max_volume_id, vid)
@@ -256,15 +270,25 @@ class Topology:
             self.layouts[key] = layout
         return layout
 
+    def _layout_key(self, v: VolumeInfo, node: DataNode) -> LayoutKey:
+        return LayoutKey(v.collection, v.replica_placement, v.ttl,
+                         norm_disk(node.disk_type))
+
     def _register_volume(self, v: VolumeInfo, node: DataNode) -> None:
         # a volume's disk class is its server's (volume layouts are
         # keyed (collection, rp, ttl, diskType), volume_layout.go:107)
         self._layout(v.collection, v.replica_placement, v.ttl,
                      node.disk_type).register(v, node)
+        node.volume_layout_keys[v.vid] = self._layout_key(v, node)
 
     def _unregister_volume(self, v: VolumeInfo, node: DataNode) -> None:
-        self._layout(v.collection, v.replica_placement, v.ttl,
-                     node.disk_type).unregister(v.vid, node)
+        # prefer the key recorded at registration: the node's disk
+        # class (or the volume's attributes) may have changed since
+        key = node.volume_layout_keys.pop(v.vid, None) or \
+            self._layout_key(v, node)
+        layout = self.layouts.get(key)
+        if layout is not None:
+            layout.unregister(v.vid, node)
 
     def _unregister_ec_shard(self, vid: int, sid: int,
                              node: DataNode) -> None:

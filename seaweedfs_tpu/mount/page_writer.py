@@ -169,12 +169,18 @@ class DirtyPages:
                 chunks.append(FileChunk(fid=fid, offset=file_off,
                                         size=size, mtime_ns=mtime_ns))
         except Exception:
-            # an upload failed: restore everything (completed futures
-            # keep their results) so a retried flush can still commit —
-            # dropping the payloads here would lose the written bytes
-            # while the retry reports success
+            # an upload failed: restore everything so a retried flush
+            # can still commit — but FAILED futures must be replaced
+            # with fresh submissions (a Future replays its cached
+            # exception forever, so restoring it verbatim would make
+            # every retry fail even after the volume server recovers)
+            restored = []
+            for fut, file_off, size, mtime_ns, payload in uploads:
+                if fut.done() and fut.exception() is not None:
+                    fut = self._pipeline.submit(self.upload_fn, payload)
+                restored.append((fut, file_off, size, mtime_ns, payload))
             with self._lock:
-                self._uploads = uploads + self._uploads
+                self._uploads = restored + self._uploads
             raise
         return chunks
 

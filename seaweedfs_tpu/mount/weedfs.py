@@ -209,11 +209,21 @@ class WeedFS:
         self.meta.invalidate(full_old)
         self.meta.invalidate(full_new)
         with self._lock:  # open handles follow the rename
+            targets = []
             for h in self._handles.values():
                 if h.path == old:
-                    h.path = new
+                    targets.append((h, new))
                 elif h.path.startswith(old + "/"):
-                    h.path = new + h.path[len(old):]
+                    targets.append((h, new + h.path[len(old):]))
+        # h.lock is taken OUTSIDE self._lock (release() orders
+        # h.lock -> self._lock; nesting the other way would deadlock)
+        for h, new_path in targets:
+            with h.lock:
+                h.path = new_path
+                # the pinned entry must follow too, or a later flush
+                # saves the dirty chunks back under the OLD path —
+                # resurrecting the deleted name, starving the new one
+                h.entry.full_path = self._abs(new_path)
 
     def link(self, src: str, dst: str) -> None:
         """Hard link (weedfs_link.go): another name for src's chunks,
@@ -414,9 +424,15 @@ class WeedFS:
                 n_committed = len(data)
             # dirty overlay wins over committed bytes
             covered = h.dirty.read_overlay(offset, size, out)
+            # the readable extent includes unflushed HOLES: a write at
+            # offset 1000 makes bytes 0..999 real zeros now, not EOF —
+            # pre- and post-flush reads of a sparse file must agree
+            file_size = max(total_size(h.entry.chunks),
+                            self._dirty_extent(h))
             max_extent = max(
-                [offset + n_committed] + [e for _, e in covered]) - offset
-            return bytes(out[:min(size, max_extent)])
+                [offset + n_committed, min(offset + size, file_size)]
+                + [e for _, e in covered]) - offset
+            return bytes(out[:min(size, max(max_extent, 0))])
 
     def _read_chunks(self, chunks: list[FileChunk], offset: int,
                      size: int) -> bytes:

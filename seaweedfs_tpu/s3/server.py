@@ -25,6 +25,8 @@ import uuid
 import xml.etree.ElementTree as ET
 
 import requests
+
+from ..rpc import httpclient
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
@@ -288,14 +290,14 @@ class S3ApiServer:
         limits (the reference keeps them at
         /etc/s3/circuit_breaker.json, hot-reloaded the same way)."""
         try:
-            resp = requests.get(
+            resp = httpclient.session().get(
                 f"{self.filer_url}/kv/{IDENTITIES_KV_KEY}", timeout=5)
             if resp.status_code == 200:
                 self.iam.load_config(json.loads(resp.content))
         except requests.RequestException:
             pass
         try:
-            resp = requests.get(
+            resp = httpclient.session().get(
                 f"{self.filer_url}/kv/{CIRCUIT_BREAKER_KV_KEY}",
                 timeout=5)
             if resp.status_code == 200:
@@ -345,11 +347,23 @@ class S3ApiServer:
             # browser form upload (POST policy) authenticates via the
             # signed policy document, not headers
             return await self._post_policy_upload(req, bucket, payload)
-        identity, stream_ctx = self.iam.authenticate_ctx(
-            req.method, req.path,
-            {k: v for k, v in req.query.items()},
-            {k: v for k, v in req.headers.items()},
-            hashlib.sha256(payload).hexdigest())
+        try:
+            identity, stream_ctx = self.iam.authenticate_ctx(
+                req.method, req.path,
+                {k: v for k, v in req.query.items()},
+                {k: v for k, v in req.headers.items()},
+                hashlib.sha256(payload).hexdigest())
+        except S3AuthError:
+            # anonymous request: a public-read bucket ACL grants
+            # AllUsers READ, so unauthenticated object GET/HEAD in
+            # such a bucket must work — otherwise the stored ACL is
+            # write-only state and the advertised grant is a lie
+            if req.method in ("GET", "HEAD") and bucket and key and \
+                    not set(req.query) & {"acl", "tagging", "uploads"} \
+                    and await self._bucket_is_public_read(bucket):
+                identity, stream_ctx = None, None
+            else:
+                raise
         if stream_ctx is not None:
             # aws-chunked framed body (SigV4 streaming upload): verify
             # the chunk-signature chain and unwrap to the real bytes
@@ -477,8 +491,19 @@ class S3ApiServer:
         return p
 
     async def _filer(self, method: str, url: str, **kw):
-        return await asyncio.to_thread(
-            requests.request, method, url, timeout=120, **kw)
+        def call():
+            return httpclient.session().request(method, url,
+                                                timeout=120, **kw)
+
+        return await asyncio.to_thread(call)
+
+    async def _bucket_is_public_read(self, bucket: str) -> bool:
+        resp = await self._filer("GET", self._fpath(bucket),
+                                 params={"meta": "1"})
+        if resp.status_code != 200:
+            return False
+        ext = resp.json().get("extended", {}) or {}
+        return ext.get("s3_acl") == "public-read"
 
     async def _require_bucket(self, bucket: str) -> dict:
         resp = await self._filer("GET", self._fpath(bucket),
@@ -874,6 +899,11 @@ class S3ApiServer:
             self._fpath(bucket, key), headers=headers)
         if resp.status_code == 404:
             raise S3Error(*ERR_NO_SUCH_KEY)
+        if resp.status_code == 416:
+            # range past EOF is a client condition, not a server error
+            # (multipart downloaders probe ranges routinely)
+            raise S3Error("InvalidRange",
+                          "the requested range is not satisfiable", 416)
         if resp.status_code >= 400:
             raise S3Error("InternalError", resp.text, 500)
         out_headers = {"ETag": resp.headers.get("ETag", "")}
@@ -983,7 +1013,7 @@ class S3ApiServer:
         def list_dir(dirpath: str, last: str = ""):
             out = []
             while True:
-                r = requests.get(
+                r = httpclient.session().get(
                     f"{self.filer_url}{urllib.parse.quote(dirpath)}/",
                     params={"limit": "1024", "lastFileName": last},
                     timeout=60)
@@ -997,7 +1027,15 @@ class S3ApiServer:
 
         def walk(dirpath: str) -> bool:
             nonlocal truncated
-            for e in list_dir(dirpath):
+            entries = list_dir(dirpath)
+            # S3 key order, not filer name order: a directory 'dir'
+            # emits keys 'dir/...', which sort AFTER 'dir.txt'
+            # ('.' 0x2E < '/' 0x2F) — walking it first would emit keys
+            # out of order and break marker-based pagination (resume
+            # after 'dir/a' would skip 'dir.txt' forever)
+            entries.sort(key=lambda e: e["full_path"].rsplit("/", 1)[-1]
+                         + ("/" if e["mode"] & 0o40000 else ""))
+            for e in entries:
                 name = e["full_path"].rsplit("/", 1)[-1]
                 rel = e["full_path"][len(base) + 1:]
                 is_dir = bool(e["mode"] & 0o40000)
@@ -1082,9 +1120,19 @@ class S3ApiServer:
             raise S3Error(*ERR_NO_SUCH_UPLOAD)
         return resp.json()
 
+    @staticmethod
+    def _check_part_number(part_number: int) -> None:
+        # AWS contract; also keeps the %05d name <-> int round-trip
+        # exact (a 6-digit number would truncate through the parse)
+        if not 1 <= part_number <= 10000:
+            raise S3Error("InvalidArgument",
+                          "part number must be between 1 and 10000",
+                          400)
+
     async def _upload_part(self, bucket: str, upload_id: str,
                            part_number: int,
                            payload: bytes) -> web.Response:
+        self._check_part_number(part_number)
         await self._upload_marker(bucket, upload_id)
         part_path = f"{self._upload_dir(bucket, upload_id)}/" \
             f"{part_number:05d}.part"
@@ -1102,6 +1150,7 @@ class S3ApiServer:
         """UploadPartCopy (s3api_object_copy_handlers.go:135
         CopyObjectPartHandler): copy a source object — optionally an
         `x-amz-copy-source-range: bytes=a-b` slice — in as a part."""
+        self._check_part_number(part_number)
         await self._upload_marker(bucket, upload_id)
         src = urllib.parse.unquote(src.lstrip("/"))
         src_bucket, _, src_key = src.partition("/")

@@ -1,0 +1,225 @@
+"""Regressions for the second high-effort review wave: S3 key-order
+pagination / anonymous public-read / range-416 / part numbers, mount
+rename-then-flush and sparse reads, page-writer upload retry, raft
+mid-term membership, topology layout re-registration.
+"""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("rr2")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_s3=True)
+    yield c
+    c.stop()
+
+
+class TestS3Ordering:
+    def test_dot_vs_slash_key_order_pagination(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/ordb").raise_for_status()
+        requests.put(f"{s3}/ordb/dir/a", data=b"1").raise_for_status()
+        requests.put(f"{s3}/ordb/dir.txt", data=b"2").raise_for_status()
+        # one key per page; collect via markers
+        keys, marker = [], ""
+        for _ in range(5):
+            params = {"max-keys": "1"}
+            if marker:
+                params["marker"] = marker
+            import xml.etree.ElementTree as ET
+            root = ET.fromstring(requests.get(f"{s3}/ordb",
+                                              params=params).text)
+            ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+            page = [k.find(f"{ns}Key").text
+                    for k in root.iter(f"{ns}Contents")]
+            keys += page
+            if root.find(f"{ns}IsTruncated").text != "true":
+                break
+            marker = page[-1]
+        assert keys == ["dir.txt", "dir/a"], keys  # S3 byte order
+
+
+class TestS3AnonymousPublicRead:
+    @pytest.fixture(scope="class")
+    def auth_cluster(self, tmp_path_factory):
+        cfg = {"identities": [{
+            "name": "admin",
+            "credentials": [{"accessKey": "AK", "secretKey": "SK"}],
+            "actions": ["Admin", "Read", "Write", "List", "Tagging"]}]}
+        c = Cluster(str(tmp_path_factory.mktemp("rr2_auth")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_s3=True, s3_config=cfg)
+        yield c
+        c.stop()
+
+    def test_public_read_bucket_allows_anon_get(self, auth_cluster):
+        from seaweedfs_tpu.s3.auth import sign_request
+
+        s3 = auth_cluster.s3_url
+
+        def signed(method, path, payload=b"", extra=None):
+            h = sign_request(method, f"{s3}{path}", "AK", "SK",
+                             payload=payload, extra_headers=extra)
+            return requests.request(method, f"{s3}{path}", headers=h,
+                                    data=payload)
+
+        assert signed("PUT", "/pubb").status_code == 200
+        assert signed("PUT", "/pubb/o.txt",
+                      payload=b"open sesame").status_code == 200
+        # anonymous read denied while private
+        assert requests.get(f"{s3}/pubb/o.txt").status_code == 403
+        # flip to public-read via canned ACL header
+        assert signed("PUT", "/pubb?acl",
+                      extra={"x-amz-acl": "public-read"}
+                      ).status_code == 200
+        r = requests.get(f"{s3}/pubb/o.txt")
+        assert r.status_code == 200 and r.content == b"open sesame"
+        # anonymous WRITE still denied
+        assert requests.put(f"{s3}/pubb/new.txt",
+                            data=b"x").status_code == 403
+
+
+class TestS3RangeAndParts:
+    def test_range_past_eof_is_416(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/rngb").raise_for_status()
+        requests.put(f"{s3}/rngb/small.txt",
+                     data=b"0123456789").raise_for_status()
+        r = requests.get(f"{s3}/rngb/small.txt",
+                         headers={"Range": "bytes=999999-"})
+        assert r.status_code == 416
+        assert b"InvalidRange" in r.content
+
+    def test_part_number_bounds(self, cluster):
+        s3 = cluster.s3_url
+        requests.put(f"{s3}/mpb").raise_for_status()
+        up = requests.post(f"{s3}/mpb/big.bin?uploads").text
+        import re as _re
+        upload_id = _re.search(r"<UploadId>([^<]+)", up).group(1)
+        for bad in (0, -1, 10001, 123456):
+            r = requests.put(
+                f"{s3}/mpb/big.bin",
+                params={"partNumber": str(bad), "uploadId": upload_id},
+                data=b"x" * 10)
+            assert r.status_code == 400, bad
+            assert b"InvalidArgument" in r.content
+
+
+class TestMountFixes:
+    def test_rename_then_flush_lands_at_new_path(self, cluster):
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+
+        fs = WeedFS(cluster.filer_url)
+        try:
+            fh = fs.create("/doc.txt")
+            fs.write(fh, 0, b"draft contents")
+            fs.rename("/doc.txt", "/final.txt")
+            fs.flush(fh)
+            fs.release(fh)
+            r = requests.get(f"{cluster.filer_url}/final.txt")
+            assert r.status_code == 200 and r.content == b"draft contents"
+            assert requests.get(
+                f"{cluster.filer_url}/doc.txt").status_code == 404
+        finally:
+            fs.destroy()
+
+    def test_sparse_hole_reads_zeros_before_flush(self, cluster):
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+
+        fs = WeedFS(cluster.filer_url)
+        try:
+            fh = fs.create("/sparse.bin")
+            fs.write(fh, 1000, b"x")
+            pre = fs.read(fh, 0, 100)
+            assert pre == b"\x00" * 100, pre[:10]
+            fs.flush(fh)
+            assert fs.read(fh, 0, 100) == b"\x00" * 100
+            assert fs.read(fh, 998, 10) == b"\x00\x00x"
+            fs.release(fh)
+        finally:
+            fs.destroy()
+
+    def test_failed_upload_retries_on_next_flush(self, tmp_path):
+        from seaweedfs_tpu.filer.entry import FileChunk
+        from seaweedfs_tpu.mount.page_writer import DirtyPages
+
+        calls = {"n": 0}
+
+        def flaky_upload(data: bytes) -> str:
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("volume briefly down")
+            return f"7,{calls['n']:02x}00000001"
+
+        d = DirtyPages(chunk_size=1 << 20, upload_fn=flaky_upload)
+        d.write(0, b"retry me")
+        with pytest.raises(Exception):
+            d.flush()
+        chunks = d.flush()  # must RESUBMIT, not replay the cached error
+        assert len(chunks) == 1 and chunks[0].size == 8
+        assert isinstance(chunks[0], FileChunk)
+        d.close()
+
+
+class TestRaftMidTermMembership:
+    def test_added_peer_gets_entries_without_reelection(self):
+        import asyncio
+
+        from seaweedfs_tpu.master.raft import (LEADER, MemoryTransport,
+                                               RaftNode)
+
+        async def go():
+            transport = MemoryTransport()
+            a = RaftNode("A", ["A"], transport, tick=0.05)
+            transport.register(a)
+            a.start()
+            deadline = time.monotonic() + 5
+            while a.state != LEADER and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert a.state == LEADER
+            term_before = a.current_term
+            b = RaftNode("B", ["A", "B"], transport, tick=0.05)
+            transport.register(b)
+            b.start()
+            assert await a.add_peer("B")
+            # commit now needs quorum 2: this only succeeds if the
+            # leader started replicating to B mid-term (no snapshot of
+            # the peer set at election time)
+            assert await a.propose({"op": "max_volume_id", "value": 9})
+            assert a.current_term == term_before
+            deadline = time.monotonic() + 3
+            while b.fsm.max_volume_id != 9 and \
+                    time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert b.fsm.max_volume_id == 9
+            await a.stop()
+            await b.stop()
+
+        asyncio.run(go())
+
+
+class TestTopologyRelayout:
+    def test_replication_change_leaves_old_layout(self):
+        from seaweedfs_tpu.master.topology import Topology, VolumeInfo
+
+        topo = Topology(seed=1)
+        n = topo.register_node("n1", "127.0.0.1", 8080, "127.0.0.1:8080",
+                               8)
+        topo.sync_node_volumes(
+            n, [VolumeInfo(vid=5, replica_placement="000")])
+        old_key = n.volume_layout_keys[5]
+        assert 5 in topo.layouts[old_key].writable
+        # heartbeat now reports the volume reconfigured to 010
+        topo.sync_node_volumes(
+            n, [VolumeInfo(vid=5, replica_placement="010")])
+        assert 5 not in topo.layouts[old_key].locations
+        assert 5 not in topo.layouts[old_key].writable
+        new_key = n.volume_layout_keys[5]
+        assert new_key.replication == "010"
+        assert 5 in topo.layouts[new_key].locations
