@@ -361,7 +361,13 @@ class BrokerServer:
         {"key": ..., "value": ...} or {"records": [...]}."""
         topic = self._topic(req)
         body = await req.json()
-        records = body.get("records") or [body]
+        if "records" in body:
+            # an explicitly-empty batch is a no-op, NOT a single
+            # publish of the envelope (`or [body]` treated [] as
+            # missing and acked a phantom empty record)
+            records = body["records"]
+        else:
+            records = [body]
         out = []
         for rec in records:
             key = rec.get("key", "")

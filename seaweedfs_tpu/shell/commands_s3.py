@@ -180,16 +180,20 @@ def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
             continue
         used = _bucket_usage_bytes(env, name) if quota > 0 else 0
         over = quota > 0 and used > quota
-        # bucket objects are written into collection=<bucket>
+        # bucket objects are written into collection=<bucket>.
+        # Volumes are only touched on a latch TRANSITION: blanket
+        # re-marking every run would flip volumes made read-only for
+        # other reasons (tiering, operator volume.mark) back writable
         touched = []
-        for n in env.data_nodes():
-            for vid in n["volumes"]:
-                if n.get("collections", {}).get(str(vid)) != name:
-                    continue
-                vs_path = "/admin/mark_readonly" if over \
-                    else "/admin/mark_writable"
-                env.vs_post(n["url"], vs_path, {"volume": vid})
-                touched.append(vid)
+        if over != latched:
+            for n in env.data_nodes():
+                for vid in n["volumes"]:
+                    if n.get("collections", {}).get(str(vid)) != name:
+                        continue
+                    vs_path = "/admin/mark_readonly" if over \
+                        else "/admin/mark_writable"
+                    env.vs_post(n["url"], vs_path, {"volume": vid})
+                    touched.append(vid)
         if over != latched:
             if over:
                 ext["s3_quota_enforced"] = "true"

@@ -97,10 +97,17 @@ def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]
             except ShellError:
                 continue
             if check["garbage_ratio"] > garbage_threshold:
+                compacted = []
                 for url in env.volume_locations(vid):
-                    env.vs_post(url, "/admin/vacuum_compact",
-                                {"volume": vid})
-                done.append({"volume": vid,
+                    try:
+                        env.vs_post(url, "/admin/vacuum_compact",
+                                    {"volume": vid})
+                        compacted.append(url)
+                    except ShellError:
+                        # one unreachable replica must not abort the
+                        # cluster-wide pass; it catches up next run
+                        continue
+                done.append({"volume": vid, "replicas": compacted,
                              "garbage_ratio": check["garbage_ratio"]})
     return done
 
@@ -125,6 +132,21 @@ def volume_fix_replication(env: CommandEnv) -> list[dict]:
         holder_urls = {n["url"] for n in holders}
         candidates = [n for n in nodes if n["url"] not in holder_urls
                       and len(n["volumes"]) < n["max_volumes"]]
+        # honor the superblock's placement digits: a replica lost from
+        # a diff-rack/diff-dc slot must be recreated in a DIFFERENT
+        # rack/dc than the survivors, or one rack failure can still
+        # lose every copy (xyz scheme, replica_placement.go)
+        holder_dcs = {n["dc"] for n in holders}
+        holder_racks = {(n["dc"], n["rack"]) for n in holders}
+        if rp.diff_dc and len(holder_dcs) <= rp.diff_dc:
+            preferred = [n for n in candidates
+                         if n["dc"] not in holder_dcs]
+            candidates = preferred or candidates
+        elif rp.diff_rack and \
+                len(holder_racks) <= rp.diff_rack:
+            preferred = [n for n in candidates
+                         if (n["dc"], n["rack"]) not in holder_racks]
+            candidates = preferred or candidates
         candidates.sort(key=lambda n: len(n["volumes"]))
         src = holders[0]["url"]
         col = env.volume_collection(vid)
@@ -164,6 +186,10 @@ def volume_balance(env: CommandEnv) -> list[dict]:
             while counts[src] > target and counts[dst] < target and \
                     holdings[src]:
                 vid = holdings[src].pop()
+                if any(int(v) == int(vid) for v in holdings[dst]):
+                    # dst already holds a replica: copying would 409
+                    # (same guard volume_evacuate applies)
+                    continue
                 env.vs_post(dst, "/admin/volume_copy",
                             {"volume": vid,
                              "collection": env.volume_collection(vid),

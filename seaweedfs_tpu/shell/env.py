@@ -41,10 +41,18 @@ class CommandEnv:
     def master_get(self, path: str, **params) -> dict:
         resp = requests.get(f"{self.master_url}{path}", params=params,
                             timeout=60)
-        body = resp.json()
+        # status first: a 502/500 from a proxy carries an HTML body
+        # that would raise JSONDecodeError past ShellError-only callers
         if resp.status_code >= 300:
-            raise ShellError(f"{path}: {body.get('error', resp.status_code)}")
-        return body
+            try:
+                detail = resp.json().get("error", resp.status_code)
+            except ValueError:
+                detail = resp.status_code
+            raise ShellError(f"{path}: {detail}")
+        try:
+            return resp.json()
+        except ValueError as e:
+            raise ShellError(f"{path}: non-json response: {e}") from e
 
     def topology(self) -> dict:
         return self.master_get("/cluster/status")["Topology"]

@@ -19,6 +19,14 @@ from aiohttp import web
 DAV_NS = "DAV:"
 
 
+def _entry_size(entry: dict) -> int:
+    """File size is max(offset+size) over chunks, NOT the chunk-size
+    sum — overlapping rewrites keep superseded chunks in the list
+    (filer/entry.py total_size is the same formula)."""
+    return max((c.get("offset", 0) + c["size"]
+                for c in (entry or {}).get("chunks", [])), default=0)
+
+
 def _prop_xml(href: str, is_dir: bool, size: int, mtime: float,
               name: str) -> str:
     rtype = "<D:resourcetype><D:collection/></D:resourcetype>" if is_dir \
@@ -135,8 +143,7 @@ class WebDavServer:
             parts = []
             is_dir = full == "/" or bool(
                 entry and entry.get("mode", 0) & 0o40000)
-            size = sum(c["size"] for c in (entry or {}).get("chunks", [])) \
-                if entry else 0
+            size = _entry_size(entry) if entry else 0
             href = path if path.startswith("/") else "/" + path
             parts.append(_prop_xml(
                 href + ("/" if is_dir and not href.endswith("/") else ""),
@@ -149,8 +156,7 @@ class WebDavServer:
                     name = e["full_path"].rsplit("/", 1)[-1]
                     child_href = (href.rstrip("/") + "/" + name +
                                   ("/" if child_dir else ""))
-                    child_size = sum(c["size"]
-                                     for c in e.get("chunks", []))
+                    child_size = _entry_size(e)
                     parts.append(_prop_xml(child_href, child_dir,
                                            child_size,
                                            e.get("mtime", 0), name))
@@ -193,7 +199,6 @@ class WebDavServer:
                 return web.Response(status=405)  # collection GET
             async with sess.get(f"{self.filer_url}{full}",
                                 headers=headers) as r:
-                body = await r.read() if req.method == "GET" else b""
                 resp_headers = {k: v for k, v in r.headers.items()
                                 if k in ("ETag", "Content-Range",
                                          "Last-Modified",
@@ -201,8 +206,20 @@ class WebDavServer:
                 if req.method == "HEAD":
                     resp_headers["Content-Length"] = \
                         r.headers.get("Content-Length", "0")
-                return web.Response(status=r.status, body=body,
-                                    headers=resp_headers)
+                    return web.Response(status=r.status,
+                                        headers=resp_headers)
+                # stream: a 20GB download must not materialize in the
+                # gateway's RSS before the first byte goes out
+                if "Content-Length" in r.headers:
+                    resp_headers["Content-Length"] = \
+                        r.headers["Content-Length"]
+                out = web.StreamResponse(status=r.status,
+                                         headers=resp_headers)
+                await out.prepare(req)
+                async for chunk in r.content.iter_chunked(256 << 10):
+                    await out.write(chunk)
+                await out.write_eof()
+                return out
 
     async def do_put(self, req: web.Request) -> web.Response:
         path = "/" + req.match_info["path"]
@@ -318,7 +335,14 @@ class WebDavServer:
         path = "/" + req.match_info["path"]
         if self._lock_conflict(req, path):
             return web.Response(status=423)  # someone else holds it
-        token = f"opaquelocktoken:{uuid.uuid4()}"
+        held = self._locks.get(path)
+        if held is not None and held[0] in req.headers.get("If", ""):
+            # RFC 4918 refresh: the client presented the live token —
+            # extend the TTL and KEEP the token (minting a new one
+            # would 423 every later request still using the original)
+            token = held[0]
+        else:
+            token = f"opaquelocktoken:{uuid.uuid4()}"
         self._locks[path] = (token, time.monotonic() + self.LOCK_TTL)
         body = ('<?xml version="1.0" encoding="utf-8"?>'
                 '<D:prop xmlns:D="DAV:"><D:lockdiscovery><D:activelock>'
@@ -334,5 +358,13 @@ class WebDavServer:
 
     async def do_unlock(self, req: web.Request) -> web.Response:
         path = "/" + req.match_info["path"]
-        self._locks.pop(path, None)
+        held = self._locks.get(path)
+        if held is not None:
+            presented = req.headers.get("Lock-Token", "")
+            if held[0] not in presented:
+                # only the token holder may unlock (RFC 4918) — a
+                # blind UNLOCK would let any client break an exclusive
+                # lock and clobber the holder's in-progress edit
+                return web.Response(status=409)
+            self._locks.pop(path, None)
         return web.Response(status=204)

@@ -223,3 +223,82 @@ class TestTopologyRelayout:
         new_key = n.volume_layout_keys[5]
         assert new_key.replication == "010"
         assert 5 in topo.layouts[new_key].locations
+
+
+class TestWave3:
+    def test_webdav_lock_refresh_keeps_token_and_unlock_validates(
+            self, cluster):
+        from seaweedfs_tpu.rpc.http import ServerThread
+        from seaweedfs_tpu.webdav.server import WebDavServer
+
+        w = WebDavServer(cluster.filer_url)
+        t = ServerThread(w.app).start()
+        try:
+            url = f"{t.url}/locked.txt"
+            r = requests.request("LOCK", url)
+            token = r.headers["Lock-Token"].strip("<>")
+            # refresh presenting the live token: token must be KEPT
+            r2 = requests.request("LOCK", url,
+                                  headers={"If": f"(<{token}>)"})
+            assert token in r2.headers["Lock-Token"]
+            # a third party cannot unlock without the token
+            r3 = requests.request("UNLOCK", url,
+                                  headers={"Lock-Token": "<bogus>"})
+            assert r3.status_code == 409
+            # the holder can
+            r4 = requests.request("UNLOCK", url,
+                                  headers={"Lock-Token": f"<{token}>"})
+            assert r4.status_code == 204
+        finally:
+            t.stop()
+
+    def test_mq_empty_batch_is_noop(self, cluster):
+        from seaweedfs_tpu.mq.broker import BrokerServer
+        from seaweedfs_tpu.rpc.http import ServerThread
+
+        b = BrokerServer(cluster.filer_url, cluster.master_url)
+        t = ServerThread(b.app).start()
+        b.address = t.address
+        try:
+            requests.post(f"{t.url}/topics/ns/t1",
+                          json={"partitions": 1}).raise_for_status()
+            r = requests.post(f"{t.url}/topics/ns/t1/publish",
+                              json={"records": []})
+            assert r.status_code == 200
+            assert r.json().get("acks", []) == []
+            sub = requests.get(
+                f"{t.url}/topics/ns/t1/subscribe",
+                params={"partition": "0", "offset": "0",
+                        "idle_timeout": "0.2", "limit": "0"})
+            assert sub.status_code == 200
+            records = [ln for ln in sub.text.splitlines() if ln.strip()]
+            assert records == []
+        finally:
+            t.stop()
+
+    def test_balance_skips_existing_replica_holder(self):
+        """volume.balance must not copy a volume onto a server that
+        already holds a replica (would 409 and abort)."""
+        from unittest import mock
+
+        from seaweedfs_tpu.shell import commands_volume
+
+        env = mock.Mock()
+        env.confirm_locked = lambda: None
+        # A overloaded with vids 1,2,3 incl replicated vid 1; B holds 1
+        env.data_nodes = lambda: [
+            {"url": "A", "volumes": {"1": {}, "2": {}, "3": {}, "4": {},
+                                     "5": {}},
+             "max_volumes": 8},
+            {"url": "B", "volumes": {"1": {}}, "max_volumes": 8},
+        ]
+        env.volume_collection = lambda vid: ""
+        calls = []
+        env.vs_post = lambda url, path, body: calls.append(
+            (url, path, body))
+        moves = commands_volume.volume_balance(env)
+        copied_to_b = [c for c in calls if c[0] == "B"
+                       and c[1] == "/admin/volume_copy"]
+        assert all(c[2]["volume"] != "1" and c[2]["volume"] != 1
+                   for c in copied_to_b), calls
+        assert moves  # something still moved
