@@ -366,6 +366,9 @@ class BrokerServer:
             # publish of the envelope (`or [body]` treated [] as
             # missing and acked a phantom empty record)
             records = body["records"]
+            if not isinstance(records, list):
+                return web.json_response(
+                    {"error": "records must be a list"}, status=400)
         else:
             records = [body]
         out = []

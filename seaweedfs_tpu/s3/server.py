@@ -359,7 +359,8 @@ class S3ApiServer:
             # such a bucket must work — otherwise the stored ACL is
             # write-only state and the advertised grant is a lie
             if req.method in ("GET", "HEAD") and bucket and key and \
-                    not set(req.query) & {"acl", "tagging", "uploads"} \
+                    not set(req.query) & {"acl", "tagging", "uploads",
+                                          "uploadId"} \
                     and await self._bucket_is_public_read(bucket):
                 identity, stream_ctx = None, None
             else:

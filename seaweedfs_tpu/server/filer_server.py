@@ -382,8 +382,11 @@ class FilerServer:
         # list_entries filters TTL-expired entries AFTER paging, so a
         # short result does NOT mean end-of-directory; probe for one
         # more live entry past the page to drive the more-flag honestly
+        # a short page proves end-of-directory (list_entries pages
+        # past expired entries internally); only a FULL page needs the
+        # one-entry probe
         more = False
-        if entries:
+        if entries and len(entries) == limit:
             more = bool(self.filer.list_entries(
                 path, start_from=entries[-1].name, limit=1,
                 prefix=prefix))
@@ -458,7 +461,7 @@ class FilerServer:
         if "mv.from" in req.query:  # rename verb, reference-compatible
             # the SOURCE path's rules apply too: renaming out of a
             # read-only subtree is a delete there in disguise
-            src = req.query["mv.from"]
+            src = norm_path(req.query["mv.from"])
             src_rule = self._filer_conf().match(src)
             if src_rule.read_only:
                 return web.json_response(

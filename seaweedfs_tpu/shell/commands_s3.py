@@ -181,11 +181,13 @@ def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
         used = _bucket_usage_bytes(env, name) if quota > 0 else 0
         over = quota > 0 and used > quota
         # bucket objects are written into collection=<bucket>.
-        # Volumes are only touched on a latch TRANSITION: blanket
-        # re-marking every run would flip volumes made read-only for
-        # other reasons (tiering, operator volume.mark) back writable
+        # Read-only marking is idempotent and re-runs WHILE over —
+        # volumes auto-grown after the latch must be caught too; the
+        # writable direction fires only on the latch TRANSITION so
+        # volumes made read-only for other reasons (tiering, operator
+        # volume.mark) are never blanket-flipped back
         touched = []
-        if over != latched:
+        if over or over != latched:
             for n in env.data_nodes():
                 for vid in n["volumes"]:
                     if n.get("collections", {}).get(str(vid)) != name:

@@ -91,14 +91,19 @@ def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]
             if vid in seen:
                 continue
             seen.add(vid)
-            try:
-                check = env.vs_post(n["url"], "/admin/vacuum_check",
-                                    {"volume": vid})
-            except ShellError:
-                continue
-            if check["garbage_ratio"] > garbage_threshold:
-                compacted = []
-                for url in env.volume_locations(vid):
+            # check EVERY holder: replicas diverge when one missed a
+            # previous pass (unreachable then) — the first holder
+            # being clean must not hide a garbage-heavy sibling
+            compacted, worst = [], 0.0
+            for url in env.volume_locations(vid):
+                try:
+                    check = env.vs_post(url, "/admin/vacuum_check",
+                                        {"volume": vid})
+                except ShellError:
+                    continue
+                ratio = check["garbage_ratio"]
+                worst = max(worst, ratio)
+                if ratio > garbage_threshold:
                     try:
                         env.vs_post(url, "/admin/vacuum_compact",
                                     {"volume": vid})
@@ -107,8 +112,9 @@ def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]
                         # one unreachable replica must not abort the
                         # cluster-wide pass; it catches up next run
                         continue
+            if compacted:
                 done.append({"volume": vid, "replicas": compacted,
-                             "garbage_ratio": check["garbage_ratio"]})
+                             "garbage_ratio": worst})
     return done
 
 
