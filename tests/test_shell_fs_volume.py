@@ -208,7 +208,16 @@ class TestVolumeCommands:
 
 class TestClusterCommands:
     def test_cluster_ps(self, cluster, env):
-        ps = commands_cluster.cluster_ps(env)
+        import time as _t
+
+        # the filer announces on a pulse; under full-suite load the
+        # first beat may not have landed yet
+        deadline = _t.time() + 15
+        while _t.time() < deadline:
+            ps = commands_cluster.cluster_ps(env)
+            if ps["filers"] and len(ps["volume_servers"]) == 3:
+                break
+            _t.sleep(0.3)
         assert len(ps["volume_servers"]) == 3
         assert ps["filers"], "filer should announce itself"
 
