@@ -158,3 +158,75 @@ def test_kill_replica_then_heal(cluster):
     # the healed copy serves the data
     assert verbs.download(
         f"http://127.0.0.1:{v3p}/{a.fid}") == b"survive the crash"
+
+
+def test_ec_degraded_read_after_shard_holder_death(cluster, tmp_path):
+    """EC chaos: encode across servers, SIGKILL a shard holder, and
+    read through on-the-fly reconstruction (store_ec.go:339) — with
+    only real processes in play."""
+    from seaweedfs_tpu.shell import commands_ec
+    from seaweedfs_tpu.shell.env import CommandEnv
+
+    master = cluster["master"]
+    procs = cluster["procs"]
+
+    # two more volume servers so >=10 shards survive one death
+    extra = {}
+    for name in ("v3", "v4"):
+        vp = free_port()
+        extra[name] = vp
+        d = cluster["tmp"] / f"ec{name}"
+        d.mkdir()
+        procs.spawn(name, "volume", "-port", str(vp), "-dir", str(d),
+                    "-max", "20",
+                    "-mserver", master.replace("http://", ""))
+    wait(lambda: _node_count(master) == 4, msg="4 servers up")
+
+    # fill one volume in its own collection, sealed by uploads
+    import numpy as np
+    rng = np.random.default_rng(3)
+    payloads = {}
+    a0 = verbs.assign(master, collection="ecchaos", replication="000")
+    vid = int(a0.fid.split(",")[0])
+    payloads[a0.fid] = rng.bytes(20_000)
+    verbs.upload(a0, payloads[a0.fid])
+    for _ in range(15):
+        a = verbs.assign(master, collection="ecchaos",
+                         replication="000")
+        if int(a.fid.split(",")[0]) != vid:
+            continue
+        payloads[a.fid] = rng.bytes(10_000)
+        verbs.upload(a, payloads[a.fid])
+
+    env = CommandEnv(master)
+    env.acquire_lock()
+    placement = commands_ec.ec_encode(env, vid)
+    assert len(placement) == 14
+
+    # kill the holder with the FEWEST shards (>=10 must survive)
+    by_server = {}
+    for sid, url in placement.items():
+        by_server.setdefault(url, []).append(sid)
+    victim_url = min(by_server, key=lambda u: len(by_server[u]))
+    survivors = 14 - len(by_server[victim_url])
+    assert survivors >= 10, by_server
+    all_ports = {**cluster["vports"], **extra}
+    victim = next(n for n, p in all_ports.items()
+                  if f"127.0.0.1:{p}" == victim_url)
+    procs.sigkill(victim)
+    wait(lambda: _node_count(master) == 3, timeout=40,
+         msg="dead shard holder dropped")
+
+    # every object reads back bit-exact through degraded reconstruction
+    env2 = CommandEnv(master)
+    ok = 0
+    for fid, want in payloads.items():
+        for url in [u for u in by_server if u != victim_url]:
+            r = requests.get(f"http://{url}/{fid}", timeout=60)
+            if r.status_code == 200:
+                assert r.content == want, fid
+                ok += 1
+                break
+        else:
+            raise AssertionError(f"{fid} unreadable after death")
+    assert ok == len(payloads)
