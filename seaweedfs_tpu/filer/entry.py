@@ -132,3 +132,11 @@ def total_size(chunks: list[FileChunk]) -> int:
     for c in chunks:
         size = max(size, c.offset + c.size)
     return size
+
+
+def entry_size(entry: dict | None) -> int:
+    """total_size for a JSON entry dict (the gateways' wire shape).
+    File size is max(offset+size) over chunks, NOT the chunk-size sum —
+    overlapping rewrites keep superseded chunks in the list."""
+    return max((c.get("offset", 0) + c["size"]
+                for c in (entry or {}).get("chunks", [])), default=0)

@@ -20,6 +20,8 @@ import time
 
 import requests
 
+from ..filer.entry import entry_size as _entry_size
+
 
 class FtpSession(threading.Thread):
     def __init__(self, server: "FtpServer", conn: socket.socket):
@@ -204,7 +206,7 @@ class FtpSession(threading.Thread):
             for e in self._list_entries(path):
                 name = e["full_path"].rstrip("/").rpartition("/")[2]
                 is_dir = bool(e.get("mode", 0) & 0o40000)
-                size = sum(c["size"] for c in e.get("chunks", []))
+                size = _entry_size(e)
                 mtime = time.strftime(
                     "%b %d %H:%M", time.localtime(e.get("mtime", 0)))
                 kind = "d" if is_dir else "-"
@@ -350,8 +352,7 @@ class FtpSession(threading.Thread):
         if e is None or e.get("mode", 0) & 0o40000:
             self.reply(550, "no such file")
             return
-        size = sum(c["size"] for c in e.get("chunks", []))
-        self.reply(213, str(size))
+        self.reply(213, str(_entry_size(e)))
 
     def _cmd_mdtm(self, arg: str) -> None:
         e = self._entry(self._abs(arg))

@@ -379,12 +379,9 @@ class FilerServer:
         prefix = req.query.get("prefix", "")
         entries = self.filer.list_entries(
             path, start_from=last, limit=limit, prefix=prefix)
-        # list_entries filters TTL-expired entries AFTER paging, so a
-        # short result does NOT mean end-of-directory; probe for one
-        # more live entry past the page to drive the more-flag honestly
         # a short page proves end-of-directory (list_entries pages
         # past expired entries internally); only a FULL page needs the
-        # one-entry probe
+        # one-entry probe to drive the more-flag honestly
         more = False
         if entries and len(entries) == limit:
             more = bool(self.filer.list_entries(

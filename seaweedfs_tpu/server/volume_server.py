@@ -524,7 +524,14 @@ class VolumeServer:
         me = f"{self.store.ip}:{self.store.port}"
         peers = [u for u in locations if u != me]
         if not peers:
-            return None
+            # copy_count > 1 (checked above) means peers are EXPECTED:
+            # an empty/failed lookup must fail the write, not silently
+            # ack it under-replicated (GetWritableRemoteReplications
+            # errors the same way when locations < copy count). Drop
+            # any cached self-only list so the next write re-resolves
+            # instead of failing for the rest of the TTL.
+            self._invalidate_lookup(vid)
+            return f"volume {vid}: no replica peers resolvable"
         params = {"type": "replicate"}
         headers = {}
         if needle is not None:
@@ -562,14 +569,19 @@ class VolumeServer:
                     async with sess.post(url, data=data,
                                          headers=headers) as resp:
                         if resp.status >= 300:
+                            self._invalidate_lookup(vid)
                             return (f"replicate to {peer}: "
                                     f"{resp.status}")
                 else:
                     async with sess.delete(url) as resp:
                         if resp.status >= 300 and resp.status != 404:
+                            self._invalidate_lookup(vid)
                             return (f"replicate delete {peer}: "
                                     f"{resp.status}")
             except aiohttp.ClientError as e:
+                # the cached peer may be dead or moved — re-resolve on
+                # the next write instead of failing for the whole TTL
+                self._invalidate_lookup(vid)
                 return f"replicate to {peer}: {e}"
         return None
 
@@ -605,10 +617,24 @@ class VolumeServer:
                     return []
                 body = await resp.json()
                 urls = [l["url"] for l in body.get("locations", [])]
-                cache[vid] = (urls, now)
+                # never cache an empty location list: during that TTL
+                # window _replicate would see no peers and "succeed"
+                # without replicating, and newly-placed replicas would
+                # stay invisible
+                if urls:
+                    cache[vid] = (urls, now)
+                else:
+                    cache.pop(vid, None)
                 return urls
         except aiohttp.ClientError:
             return []
+
+    def _invalidate_lookup(self, vid: int) -> None:
+        """Drop a cached lookup (e.g. after replication to a cached
+        peer fails) so the next write re-resolves placement."""
+        cache = getattr(self, "_lookup_cache", None)
+        if cache is not None:
+            cache.pop(vid, None)
 
     # ------------------------------------------------------------------
     # admin: volume lifecycle

@@ -15,6 +15,7 @@ from typing import Iterator
 
 import requests
 
+from ..filer.entry import entry_size
 from .env import CommandEnv, ShellError
 
 
@@ -37,8 +38,7 @@ def _name(e: dict) -> str:
 
 
 def _size(e: dict) -> int:
-    return max((c["offset"] + c["size"] for c in e.get("chunks", [])),
-               default=0)
+    return entry_size(e)
 
 
 def _list(env: CommandEnv, path: str) -> list[dict]:

@@ -264,8 +264,20 @@ class Volume:
             checked += 1
             try:
                 self.read_needle(key)
-            except (ValueError, IOError, KeyError) as e:
-                bad.append({"id": key, "error": str(e)})
+            except (ValueError, IOError, KeyError):
+                # A needle legitimately deleted — or a vacuum commit
+                # swapping the .dat mid-read — is not corruption. The
+                # retry must run under write_lock: the commit holds it
+                # through the .dat close/replace/reopen, so the locked
+                # retry is serialized after the swap and reads the
+                # fresh map + file instead of a torn pair.
+                with self.write_lock:
+                    if self.nm.get(key) is None:
+                        continue
+                    try:
+                        self.read_needle(key)
+                    except (ValueError, IOError, KeyError) as e2:
+                        bad.append({"id": key, "error": str(e2)})
         return {"volume": self.vid, "checked": checked, "bad": bad}
 
     def _rebuild_index_native(self, base: str) -> bool:
@@ -607,7 +619,12 @@ class Volume:
             self._idx_f.close()
             os.replace(cpd, base + ".dat")
             os.replace(cpx, base + ".idx")
-            self.dat = bk.DiskFile(base + ".dat")
+            # reopen with the volume's configured local backend so an
+            # mmap volume stays mmap after its first vacuum
+            if self._backend_kind in ("disk", "mmap"):
+                self.dat = bk.create(self._backend_kind, base + ".dat")
+            else:
+                self.dat = bk.DiskFile(base + ".dat")
             self.super_block = self._read_super_block()
             self.nm = nmap.load_needle_map(base + ".idx",
                                            kind=self.needle_map_kind)

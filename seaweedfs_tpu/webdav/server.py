@@ -19,12 +19,7 @@ from aiohttp import web
 DAV_NS = "DAV:"
 
 
-def _entry_size(entry: dict) -> int:
-    """File size is max(offset+size) over chunks, NOT the chunk-size
-    sum — overlapping rewrites keep superseded chunks in the list
-    (filer/entry.py total_size is the same formula)."""
-    return max((c.get("offset", 0) + c["size"]
-                for c in (entry or {}).get("chunks", [])), default=0)
+from ..filer.entry import entry_size as _entry_size
 
 
 def _prop_xml(href: str, is_dir: bool, size: int, mtime: float,

@@ -117,3 +117,17 @@ def test_visible_via_filer_http(ftp, cluster):
     ftp.storbinary("STOR shared.txt", io.BytesIO(b"cross-gateway"))
     r = requests.get(f"{cluster.filer_url}/shared.txt")
     assert r.status_code == 200 and r.content == b"cross-gateway"
+
+
+def test_size_with_overlapping_rewrite_chunks():
+    # overlapping rewrites keep superseded chunks in the chunk list;
+    # size must be max(offset+size), not the chunk-size sum (ADVICE r1)
+    from seaweedfs_tpu.ftpd import _entry_size
+    entry = {"chunks": [
+        {"offset": 0, "size": 100},
+        {"offset": 50, "size": 50},   # rewrite of the tail
+        {"offset": 0, "size": 10},    # rewrite of the head
+    ]}
+    assert _entry_size(entry) == 100
+    assert _entry_size({"chunks": []}) == 0
+    assert _entry_size(None) == 0
