@@ -103,7 +103,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-mserver", default="127.0.0.1:9333")
     p.add_argument("-dataCenter", default="DefaultDataCenter")
     p.add_argument("-rack", default="DefaultRack")
-    p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+    p.add_argument("-ec.backend", dest="ec_backend", default="auto")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact")
     p.add_argument("-disk", default="hdd",
@@ -127,7 +127,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
-    p.add_argument("-ec.backend", dest="ec_backend", default="numpy")
+    p.add_argument("-ec.backend", dest="ec_backend", default="auto")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact")
 

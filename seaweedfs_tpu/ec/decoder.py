@@ -21,7 +21,7 @@ from .encoder import rebuild_ec_files
 def write_dat_file(base: str, dat_size: int,
                    large_block: int = geo.LARGE_BLOCK,
                    small_block: int = geo.SMALL_BLOCK,
-                   backend: str = "numpy") -> None:
+                   backend: str = "auto") -> None:
     """Reassemble `base`.dat from data shards .ec00-.ec09."""
     missing_data = [i for i in range(geo.DATA_SHARDS)
                     if not os.path.exists(base + geo.shard_ext(i))]

@@ -86,6 +86,13 @@ class JaxCodec:
         if n == 0:
             return np.zeros((m, 0), dtype=np.uint8)
         a_bits = self._coef_bits(coef)
+        return _collect(self._dispatch(a_bits, shards))
+
+    def _dispatch(self, a_bits, shards: np.ndarray) -> list:
+        """Issue the async device calls for one (k, n) column block,
+        slab-split and bucket-padded; returns [(device_array, width)]
+        without forcing any transfer back."""
+        n = shards.shape[1]
         slab = self.slab
         if n <= slab:
             # pad to power-of-two buckets (>=256) so XLA compiles at most
@@ -94,22 +101,65 @@ class JaxCodec:
             while padded < n:
                 padded <<= 1
             padded = min(padded, slab)  # n <= slab, so padded >= n still
-            out = self._run(a_bits, _pad_cols(shards, padded))
-            return np.asarray(out)[:, :n]
-        # dispatch all slabs asynchronously, then sync once at the end so
-        # device compute overlaps host-side slicing/transfer
-        pending: list[tuple[jax.Array, int]] = []
+            return [(self._run(a_bits, _pad_cols(shards, padded)), n)]
+        out = []
         for off in range(0, n, slab):
             chunk = shards[:, off:off + slab]
             w = chunk.shape[1]
             if w < slab:
                 chunk = _pad_cols(chunk, slab)
-            pending.append((self._run(a_bits, chunk), w))
-        return np.concatenate(
-            [np.asarray(dev)[:, :w] for dev, w in pending], axis=1)
+            out.append((self._run(a_bits, chunk), w))
+        return out
+
+    def coded_matmul_stream(self, coef: np.ndarray, blocks,
+                            depth: int = 2):
+        """Streaming pipeline: for each (k, w) uint8 column block from
+        the iterable `blocks`, yield the matching (m, w) result, in
+        order. Up to `depth` blocks are in flight at once — the
+        producer side issues H2D + compute (both asynchronous under
+        jax's dispatch model) while a single fetch thread drains D2H —
+        so on hardware with independent DMA engines the three stages
+        overlap instead of serializing (the reference streams 256KB
+        buffers through its CPU codec synchronously,
+        ec_encoder.go:198-235; a device codec lives or dies by hiding
+        the transfer latency).
+        """
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        coef = np.asarray(coef, dtype=np.uint8)
+        m = coef.shape[0]
+        a_bits = self._coef_bits(coef)
+
+        with ThreadPoolExecutor(1) as ex:
+            pending: deque = deque()
+            for block in blocks:
+                block = np.asarray(block, dtype=np.uint8)
+                if block.shape[1] == 0:
+                    # empty result still rides the queue: yielding it
+                    # directly would reorder it ahead of pending blocks
+                    pending.append(ex.submit(
+                        lambda: np.zeros((m, 0), dtype=np.uint8)))
+                else:
+                    pending.append(
+                        ex.submit(_collect, self._dispatch(a_bits, block)))
+                while len(pending) > depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
 
     def _run(self, a_bits: jax.Array, shards: np.ndarray) -> jax.Array:
         return _bit_matmul(a_bits, jnp.asarray(shards))
+
+
+def _collect(devs: list) -> np.ndarray:
+    """Force D2H on a _dispatch result and reassemble the (m, n) block
+    (shared by the sync path and the streaming fetch thread)."""
+    if len(devs) == 1:
+        dev, w = devs[0]
+        return np.asarray(dev)[:, :w]
+    return np.concatenate(
+        [np.asarray(dev)[:, :w] for dev, w in devs], axis=1)
 
 
 def _pad_cols(arr: np.ndarray, n: int) -> np.ndarray:

@@ -31,8 +31,11 @@ def session() -> requests.Session:
             os.environ.get("CURL_CA_BUNDLE")
         if ca:
             s.verify = ca
+        # max_retries as an int retries CONNECT failures only (requests
+        # builds Retry(n, read=False)), so a request is never sent
+        # twice; it papers over transient refused/reset on dial.
         adapter = requests.adapters.HTTPAdapter(
-            pool_connections=32, pool_maxsize=32)
+            pool_connections=32, pool_maxsize=32, max_retries=1)
         s.mount("http://", adapter)
         s.mount("https://", adapter)
         _local.session = s

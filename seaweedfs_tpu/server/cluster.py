@@ -26,7 +26,7 @@ class Cluster:
                  volume_size_limit: int = 1 << 30,
                  default_replication: str = "000",
                  pulse_seconds: float = 0.4,
-                 ec_backend: str = "numpy",
+                 ec_backend: str = "auto",
                  jwt_secret: str = "",
                  topology: list[tuple[str, str]] | None = None,
                  with_filer: bool = False,

@@ -32,7 +32,7 @@ RemoteShardReader = Callable[[int, int, int, int], "bytes | None"]
 class Store:
     def __init__(self, dirnames: Iterable[str], ip: str = "localhost",
                  port: int = 8080, public_url: str = "",
-                 ec_backend: str = "numpy",
+                 ec_backend: str = "auto",
                  needle_map_kind: str = "memory"):
         self.locations = [
             DiskLocation(d, needle_map_kind=needle_map_kind)

@@ -28,3 +28,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def pytest_sessionstart(session):
     assert jax.devices()[0].platform == "cpu", jax.devices()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _isolate_process_globals():
+    """Reset process-wide registries between test modules so modules
+    can't leak state into each other (the round-1 order-dependent
+    TestMountFlow failure): the thread-local keep-alive HTTP sessions
+    (a pooled connection to a dead server's reused ephemeral port
+    surfaces as a ConnectionError in a later module) and the tier
+    backend-storage registry configured by configure_storage()."""
+    from seaweedfs_tpu.rpc import httpclient
+    from seaweedfs_tpu.storage import backend as bk
+
+    storages_before = dict(bk._storages)
+    yield
+    bk._storages.clear()
+    bk._storages.update(storages_before)
+    s = getattr(httpclient._local, "session", None)
+    if s is not None:
+        s.close()
+        httpclient._local.session = None
