@@ -213,15 +213,29 @@ class DlmClient:
                 break  # contention is definitive, not routable
         raise RuntimeError(last_err or "no filer reachable for lock rpc")
 
+    # how long lock() waits out "ring empty" (a filer that hasn't seen
+    # its own membership announce pulse yet — a startup transient, not
+    # a lock conflict; shows up under CI load right after cluster boot)
+    RING_WAIT = 10.0
+
     def lock(self, name: str) -> None:
-        with self._mu:
-            held = self._held.get(name)
-        body = {"name": name, "owner": self.owner, "ttl": self.ttl}
-        if held is not None:
-            # already ours: renew instead of contending with ourselves
-            body["token"] = held[1]
-        filer, d = self._request("/dlm/lock", body,
-                                 start=held[0] if held else None)
+        deadline = time.monotonic() + self.RING_WAIT
+        while True:
+            with self._mu:
+                held = self._held.get(name)
+            body = {"name": name, "owner": self.owner, "ttl": self.ttl}
+            if held is not None:
+                # already ours: renew instead of contending with ourselves
+                body["token"] = held[1]
+            try:
+                filer, d = self._request("/dlm/lock", body,
+                                         start=held[0] if held else None)
+            except RuntimeError as e:
+                if "ring empty" in str(e) and time.monotonic() < deadline:
+                    time.sleep(0.2)
+                    continue
+                raise
+            break
         with self._mu:
             self._held[name] = (filer, d["token"])
         self._ensure_renewer()

@@ -105,6 +105,7 @@ class VolumeServer:
         self._hb_task: asyncio.Task | None = None
         self._hb_wake = asyncio.Event()
         self.store.remote_shard_reader = self._remote_shard_read_sync
+        self.store.remote_shards_fetcher = self._remote_shards_fetch_sync
         # tier destinations, e.g. {"s3.default": {"endpoint":..,"bucket":..}}
         # (the reference receives these from master.toml [storage.backend]
         # via the heartbeat response, volume_grpc_client_to_master.go)
@@ -203,6 +204,9 @@ class VolumeServer:
         sess = getattr(self, "_client_sess", None)
         if sess is not None and not sess.closed:
             await sess.close()
+        pool = getattr(self, "_ec_fetch_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
         await asyncio.to_thread(self.store.close)
 
     # ------------------------------------------------------------------
@@ -1233,31 +1237,103 @@ class VolumeServer:
     # degraded reads: fetch remote shard intervals synchronously (called
     # from store threads, store_ec.go:299 readRemoteEcShardInterval)
     # ------------------------------------------------------------------
-    def _remote_shard_read_sync(self, vid: int, sid: int, offset: int,
-                                size: int) -> bytes | None:
+    EC_HOLDERS_TTL = 10.0
+
+    def _ec_holders(self, vid: int) -> dict:
+        """{shard_id_str: [host:port, ...]} from the master, cached
+        briefly (one degraded read used to pay one master lookup PER
+        SHARD; shard placement changes rarely within a read)."""
         import requests
 
+        cache = getattr(self, "_ec_holders_cache", None)
+        if cache is None:
+            cache = self._ec_holders_cache = {}
+        hit = cache.get(vid)
+        now = time.monotonic()
+        if hit is not None and now - hit[1] < self.EC_HOLDERS_TTL:
+            return hit[0]
         try:
             resp = requests.get(
                 f"{self.master_url}/cluster/ec_shards",
                 params={"volumeId": vid}, timeout=5)
-            holders = resp.json().get("shards", {}).get(str(sid), [])
+            shards = resp.json().get("shards", {})
         except requests.RequestException:
-            return None
-        me = f"{self.store.ip}:{self.store.port}"
+            return hit[0] if hit is not None else {}
+        if shards:
+            cache[vid] = (shards, now)
+        return shards
+
+    def _fetch_shard_from_holders(self, vid: int, sid: int,
+                                  holders: list, offset: int, size: int,
+                                  deadline_t: float) -> bytes | None:
+        import requests
+
         for holder in holders:
-            if holder == me:
-                continue
+            remaining = deadline_t - time.monotonic()
+            if remaining <= 0:
+                return None
             try:
                 r = requests.get(
                     f"http://{holder}/admin/ec/shard_read",
                     params={"volume": vid, "shard": sid,
-                            "offset": offset, "size": size}, timeout=10)
+                            "offset": offset, "size": size},
+                    timeout=min(remaining, 10.0))
                 if r.status_code == 200:
                     return r.content
             except requests.RequestException:
                 continue
         return None
+
+    def _remote_shard_read_sync(self, vid: int, sid: int, offset: int,
+                                size: int) -> bytes | None:
+        me = f"{self.store.ip}:{self.store.port}"
+        holders = [h for h in self._ec_holders(vid).get(str(sid), [])
+                   if h != me]
+        return self._fetch_shard_from_holders(
+            vid, sid, holders, offset, size,
+            time.monotonic() + self.store.ec_read_deadline)
+
+    def _remote_shards_fetch_sync(self, vid: int, sids: list, offset: int,
+                                  size: int, need: int,
+                                  deadline: float) -> dict:
+        """Concurrent first-k-wins shard-range fan-out for degraded
+        reads (goroutine fan-out in store_ec.go:349-393): every
+        candidate shard is requested at once; the call returns as soon
+        as `need` of them arrive or the deadline passes, so one hung
+        peer costs nothing but its own thread."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        me = f"{self.store.ip}:{self.store.port}"
+        holders_map = self._ec_holders(vid)
+        deadline_t = time.monotonic() + deadline
+        pool = getattr(self, "_ec_fetch_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._ec_fetch_pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="ec-fetch")
+        futs = {}
+        for sid in sids:
+            holders = [h for h in holders_map.get(str(sid), []) if h != me]
+            if holders:
+                futs[pool.submit(
+                    self._fetch_shard_from_holders, vid, sid, holders,
+                    offset, size, deadline_t)] = sid
+        out: dict[int, bytes] = {}
+        pending = set(futs)
+        while pending and len(out) < need:
+            remaining = deadline_t - time.monotonic()
+            if remaining <= 0:
+                break
+            done, pending = wait(pending, timeout=remaining,
+                                 return_when=FIRST_COMPLETED)
+            for fut in done:
+                data = fut.result()
+                if data is not None:
+                    out[futs[fut]] = data
+        for fut in pending:  # abandoned losers; bounded by timeouts
+            fut.cancel()
+        return out
 
     # ------------------------------------------------------------------
     async def handle_status(self, req: web.Request) -> web.Response:

@@ -28,6 +28,11 @@ from .super_block import ReplicaPlacement
 # fetch(vid, shard_id, offset, size) -> bytes | None
 RemoteShardReader = Callable[[int, int, int, int], "bytes | None"]
 
+# fan-out fetch(vid, candidate_sids, offset, size, need, deadline_s)
+# -> {sid: bytes}; returns as soon as `need` shards arrive (first-k-wins)
+RemoteShardsFetcher = Callable[[int, list, int, int, int, float],
+                               "dict[int, bytes]"]
+
 
 class Store:
     def __init__(self, dirnames: Iterable[str], ip: str = "localhost",
@@ -43,6 +48,12 @@ class Store:
         self.ec_backend = ec_backend
         self.ec_volumes: dict[int, EcVolume] = {}
         self.remote_shard_reader: RemoteShardReader | None = None
+        self.remote_shards_fetcher: RemoteShardsFetcher | None = None
+        # wall-clock budget for one degraded read's remote fan-out: a
+        # single hung peer must not stall the read ladder indefinitely
+        # (the reference bounds this with per-rpc contexts,
+        # store_ec.go:349-393)
+        self.ec_read_deadline = 10.0
         self._rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS,
                                backend=ec_backend)
         for loc in self.locations:
@@ -272,7 +283,17 @@ class Store:
         if data is not None:
             return data
         sid, off = iv.to_shard_and_offset()
-        if self.remote_shard_reader is not None:
+        if self.remote_shards_fetcher is not None:
+            # direct fetch of the owning shard gets only a SLICE of the
+            # read budget: if its holder is hung, the remaining budget
+            # must still cover the reconstruction fan-out (the old
+            # ladder burned the whole deadline on this hop first)
+            got = self.remote_shards_fetcher(
+                ecv.vid, [sid], off, iv.size, 1,
+                min(2.0, self.ec_read_deadline * 0.25))
+            if sid in got:
+                return got[sid]
+        elif self.remote_shard_reader is not None:
             data = self.remote_shard_reader(ecv.vid, sid, off, iv.size)
             if data is not None:
                 return data
@@ -281,19 +302,42 @@ class Store:
     def _reconstruct_interval(self, ecv: EcVolume, missing_sid: int,
                               offset: int, size: int) -> bytes:
         """recoverOneRemoteEcShardInterval (store_ec.go:339): gather the
-        same byte range from >= k other shards and reconstruct."""
+        same byte range from >= k other shards and reconstruct.
+
+        Local shards are read first (cheap); the remaining need is
+        fanned out CONCURRENTLY to every remote candidate via
+        remote_shards_fetcher, first-k-wins under ec_read_deadline —
+        the reference fans out one goroutine per shard the same way
+        (store_ec.go:349-393); a serial walk would pay ≥10 sequential
+        RTTs and a single hung peer would stall the read forever."""
         rows: dict[int, np.ndarray] = {}
+        candidates: list[int] = []
         for sid in range(geo.TOTAL_SHARDS):
-            if sid == missing_sid or len(rows) >= geo.DATA_SHARDS:
+            if sid == missing_sid:
                 continue
             shard = ecv.shards.get(sid)
-            if shard is not None:
+            if shard is not None and len(rows) < geo.DATA_SHARDS:
                 rows[sid] = np.frombuffer(
                     shard.read_at(offset, size), dtype=np.uint8)
-            elif self.remote_shard_reader is not None:
-                data = self.remote_shard_reader(ecv.vid, sid, offset, size)
-                if data is not None:
+            elif shard is None:
+                candidates.append(sid)
+        need = geo.DATA_SHARDS - len(rows)
+        if need > 0 and candidates:
+            if self.remote_shards_fetcher is not None:
+                got = self.remote_shards_fetcher(
+                    ecv.vid, candidates, offset, size, need,
+                    self.ec_read_deadline)
+                for sid, data in got.items():
                     rows[sid] = np.frombuffer(data, dtype=np.uint8)
+            elif self.remote_shard_reader is not None:
+                # legacy serial fallback (tools / tests without a server)
+                for sid in candidates:
+                    if len(rows) >= geo.DATA_SHARDS:
+                        break
+                    data = self.remote_shard_reader(
+                        ecv.vid, sid, offset, size)
+                    if data is not None:
+                        rows[sid] = np.frombuffer(data, dtype=np.uint8)
         if len(rows) < geo.DATA_SHARDS:
             raise IOError(
                 f"cannot reconstruct shard {missing_sid} of volume "

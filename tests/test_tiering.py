@@ -50,9 +50,23 @@ def upload_some(cluster, n=5):
     return fids
 
 
-def read_fid(cluster, fid):
+def read_fid(cluster, fid, timeout=10.0):
+    """Lookup + read, polling briefly: volume mount/unmount announces
+    ride the heartbeat, so a lookup straight after a remount can race
+    it (shows up only under full-suite load on the 1-core CI VM)."""
+    import time
+
     from seaweedfs_tpu.wdclient.client import MasterClient
-    url = MasterClient(cluster.master_url).lookup_file_id(fid)
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            url = MasterClient(cluster.master_url).lookup_file_id(fid)
+            break
+        except LookupError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
     r = requests.get(url)
     r.raise_for_status()
     return r.content
