@@ -183,10 +183,23 @@ def main() -> None:
     log(f"tpu codec dispatch rebuild: {tpu / 1e6:.0f} MB/s")
 
     # e2e PRODUCTION file encode (the round-2 wiring): measured before
-    # the headline line so its numbers ride along in "extra"
+    # the headline line so its numbers ride along in "extra" — under a
+    # hard alarm so a wedged tunnel can never starve the driver of the
+    # headline JSON line
     extra: dict = {}
     try:
-        extra = bench_file_encode(rng)
+        import signal
+
+        def _alarm(signum, frame):
+            raise TimeoutError("file-encode bench budget exceeded")
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(300)
+        try:
+            extra = bench_file_encode(rng)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
     except Exception as e:  # pragma: no cover - keep headline alive
         log(f"file-encode bench aborted: {e!r}")
 

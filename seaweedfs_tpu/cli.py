@@ -145,6 +145,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-store.database", dest="store_database", default="")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
+    p.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
+                   action="store_true",
+                   help="encrypt chunk data on volume servers "
+                        "(AES-256-GCM, per-chunk keys in filer metadata)")
 
     p = sub.add_parser("s3", help="start an S3 gateway")
     p.add_argument("-port", type=int, default=8333)
@@ -827,7 +831,8 @@ def _run_filer(args) -> int:
     fs = FilerServer(master, store=args.store, store_path=args.store_path,
                      collection=args.collection,
                      replication=args.replication,
-                     store_options=store_options)
+                     store_options=store_options,
+                     cipher=args.encrypt_volume_data)
     t = ServerThread(fs.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     fs.address = t.address

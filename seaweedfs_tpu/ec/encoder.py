@@ -70,7 +70,15 @@ class _AsyncWriter:
             f, arr = item
             if not self._err:
                 try:
-                    f.write(_contig_view(arr))
+                    view = _contig_view(arr)
+                    # raw (buffering=0) files may short-write (e.g.
+                    # ENOSPC partway); loop or the next block lands at
+                    # the wrong offset and the shard silently corrupts
+                    while len(view):
+                        n = f.write(view)
+                        if n is None or n == len(view):
+                            break
+                        view = view[n:]
                 except BaseException as e:  # noqa: BLE001 - close re-raises
                     self._err.append(e)
             with self._cond:

@@ -26,6 +26,10 @@ class FileChunk:
     etag: str = ""  # hex md5 of the chunk bytes
     is_compressed: bool = False
     is_chunk_manifest: bool = False  # chunk holds a manifest, not data
+    # per-chunk AES-256-GCM key (filer_pb FileChunk.cipher_key); the
+    # stored bytes at `fid` are ciphertext when this is non-empty.
+    # offset/size always describe the PLAINTEXT span.
+    cipher_key: bytes = b""
 
     def to_dict(self) -> dict:
         d = {"fid": self.fid, "offset": self.offset, "size": self.size,
@@ -36,6 +40,8 @@ class FileChunk:
             d["is_compressed"] = True
         if self.is_chunk_manifest:
             d["is_chunk_manifest"] = True
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key.hex()
         return d
 
     @classmethod
@@ -43,7 +49,8 @@ class FileChunk:
         return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
                    mtime_ns=d["mtime_ns"], etag=d.get("etag", ""),
                    is_compressed=d.get("is_compressed", False),
-                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=bytes.fromhex(d.get("cipher_key", "")))
 
 
 DIR_MODE_FLAG = 0o40000  # os.S_IFDIR bit, as the reference uses os.ModeDir

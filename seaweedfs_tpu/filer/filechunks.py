@@ -30,17 +30,22 @@ class VisibleInterval:
     offset_in_chunk: int  # where `start` falls inside the chunk's data
     chunk_size: int
     is_compressed: bool = False
+    cipher_key: bytes = b""
 
 
 @dataclass
 class ChunkView:
     """A read instruction: fetch view_size bytes at offset_in_chunk of
-    chunk `fid`, place them at view_offset of the file."""
+    chunk `fid`, place them at view_offset of the file. A non-empty
+    cipher_key means the stored bytes are AES-GCM ciphertext: readers
+    must fetch the WHOLE chunk, decrypt, then slice (a ranged read of
+    ciphertext is undecryptable)."""
     fid: str
     offset_in_chunk: int
     view_size: int
     view_offset: int
     is_compressed: bool = False
+    cipher_key: bytes = b""
 
 
 def non_overlapping_visible_intervals(
@@ -63,14 +68,14 @@ def _insert(visibles: list[VisibleInterval],
         if v.start < start:  # left remnant survives
             out.append(VisibleInterval(
                 v.start, start, v.fid, v.mtime_ns, v.offset_in_chunk,
-                v.chunk_size, v.is_compressed))
+                v.chunk_size, v.is_compressed, v.cipher_key))
         if v.stop > stop:  # right remnant survives
             out.append(VisibleInterval(
                 stop, v.stop, v.fid, v.mtime_ns,
                 v.offset_in_chunk + (stop - v.start), v.chunk_size,
-                v.is_compressed))
+                v.is_compressed, v.cipher_key))
     out.append(VisibleInterval(start, stop, c.fid, c.mtime_ns, 0, c.size,
-                               c.is_compressed))
+                               c.is_compressed, c.cipher_key))
     out.sort(key=lambda v: v.start)
     return out
 
@@ -88,7 +93,7 @@ def view_from_chunks(chunks: list[FileChunk], offset: int = 0,
             views.append(ChunkView(
                 fid=v.fid, offset_in_chunk=s - v.start + v.offset_in_chunk,
                 view_size=e - s, view_offset=s,
-                is_compressed=v.is_compressed))
+                is_compressed=v.is_compressed, cipher_key=v.cipher_key))
     return views
 
 
@@ -130,7 +135,9 @@ def maybe_manifestize(
         save_fn: Callable[[bytes], str], chunks: list[FileChunk],
         batch: int = MANIFEST_BATCH) -> list[FileChunk]:
     """Fold runs of `batch` data chunks into manifest chunks. save_fn
-    uploads bytes and returns the new fid."""
+    uploads bytes and returns the new fid — or (fid, cipher_key) when
+    the payload was stored encrypted (the key lands on the manifest
+    chunk so resolve_chunk_manifest can decrypt it)."""
     manifests, data = separate_manifest_chunks(chunks)
     if len(data) < batch:
         return chunks
@@ -140,14 +147,15 @@ def maybe_manifestize(
         group = data[i:i + batch]
         payload = json.dumps(
             {"chunks": [c.to_dict() for c in group]}).encode()
-        fid = save_fn(payload)
+        res = save_fn(payload)
+        fid, ckey = res if isinstance(res, tuple) else (res, b"")
         out.append(FileChunk(
             fid=fid, offset=min(c.offset for c in group),
             size=max(c.offset + c.size for c in group)
             - min(c.offset for c in group),
             mtime_ns=max(c.mtime_ns for c in group),
             etag=hashlib.md5(payload).hexdigest(),
-            is_chunk_manifest=True))
+            is_chunk_manifest=True, cipher_key=ckey))
         i += batch
     out.extend(data[i:])
     out.sort(key=lambda c: c.offset)
@@ -164,7 +172,12 @@ def resolve_chunk_manifest(
         if not c.is_chunk_manifest:
             out.append(c)
             continue
-        payload = json.loads(read_fn(c.fid))
+        raw = read_fn(c.fid)
+        if c.cipher_key:
+            from ..utils import cipher as _cipher
+
+            raw = _cipher.decrypt(raw, c.cipher_key)
+        payload = json.loads(raw)
         nested = [FileChunk.from_dict(d) for d in payload["chunks"]]
         out.extend(resolve_chunk_manifest(read_fn, nested))
     return out

@@ -50,10 +50,16 @@ class ChunkStreamReader:
     def size(self) -> int:
         return max((c.offset + c.size for c in self.chunks), default=0)
 
-    def _chunk_bytes(self, fid: str) -> bytes:
+    def _chunk_bytes(self, fid: str, cipher_key: bytes = b"") -> bytes:
         if fid in self._cache:
             return self._cache[fid]
         data = read_fid(self.lookup, fid)
+        if cipher_key:
+            # stored bytes are nonce||AES-GCM ciphertext; the cache
+            # holds PLAINTEXT so repeat reads don't re-decrypt
+            from ..utils import cipher as _cipher
+
+            data = _cipher.decrypt(data, cipher_key)
         self._cache[fid] = data
         self._cache_order.append(fid)
         if len(self._cache_order) > self._cache_chunks:
@@ -70,9 +76,11 @@ class ChunkStreamReader:
         chunk_sizes = {c.fid: c.size for c in self.chunks}
         out = bytearray(size)  # sparse gaps read as zeros
         for v in view_from_chunks(self.chunks, offset, size):
-            if v.fid in self._cache or \
+            if v.cipher_key or v.fid in self._cache or \
                     v.view_size >= chunk_sizes.get(v.fid, 0):
-                data = self._chunk_bytes(v.fid)
+                # ciphered chunks must always come back whole: a ranged
+                # read of GCM ciphertext cannot be decrypted
+                data = self._chunk_bytes(v.fid, v.cipher_key)
                 piece = data[v.offset_in_chunk:
                              v.offset_in_chunk + v.view_size]
             else:

@@ -29,11 +29,20 @@ class FilerClient:
         self.replication = replication
         # master for chunk assign/lookup; discovered from the filer's
         # status if not given
+        st = None
         if master_url is None:
             st = session().get(f"{self.filer_url}/status",
                               timeout=10).json()
             master_url = st.get("master", "")
         self.master_url = master_url
+        # match the filer's chunk encryption (GetFilerConfiguration):
+        # a mount writing plaintext into a ciphered namespace would
+        # leak data the operator asked to encrypt — so this FAILS
+        # CLOSED: no /status answer means no mount
+        if st is None:
+            st = session().get(f"{self.filer_url}/status",
+                              timeout=10).json()
+        self.cipher = bool(st.get("cipher", False))
         self.masters = MasterClient(master_url)
         self._sub_thread: threading.Thread | None = None
         self._sub_loop_obj = None
@@ -103,16 +112,29 @@ class FilerClient:
         if r.status_code >= 300:
             raise OSError(r.status_code, r.text)
 
-    def upload_chunk(self, data: bytes, name: str = "") -> tuple[str, str]:
-        """-> (fid, etag): assign a fid at the master and upload the
-        chunk bytes to its volume server."""
+    def upload_chunk(self, data: bytes,
+                     name: str = "") -> tuple[str, str, bytes]:
+        """-> (fid, etag, cipher_key): assign a fid at the master and
+        upload the chunk bytes (ciphertext when the filer runs
+        -encryptVolumeData) to its volume server."""
+        ckey = b""
+        if self.cipher:
+            from ..utils import cipher as cip
+
+            ckey = cip.gen_cipher_key()
+            data = cip.encrypt(data, ckey)
         a = verbs.assign(self.master_url, collection=self.collection,
                          replication=self.replication)
         body = verbs.upload(a, data, name=name)
-        return a.fid, body.get("eTag", "")
+        return a.fid, body.get("eTag", ""), ckey
 
-    def read_chunk(self, fid: str) -> bytes:
-        return verbs.download(self.masters.lookup_file_id(fid))
+    def read_chunk(self, fid: str, cipher_key: bytes = b"") -> bytes:
+        data = verbs.download(self.masters.lookup_file_id(fid))
+        if cipher_key:
+            from ..utils import cipher as cip
+
+            data = cip.decrypt(data, cipher_key)
+        return data
 
     # -- metadata subscription (meta_cache_subscribe.go) ----------------
     def subscribe_meta(self, prefix: str, on_event) -> None:

@@ -69,7 +69,8 @@ class DirtyPages:
 
     def __init__(self, upload_fn, chunk_size: int = 8 << 20,
                  pipeline: ThreadPoolExecutor | None = None):
-        """upload_fn(bytes) -> fid; pipeline is shared across handles
+        """upload_fn(bytes) -> fid or (fid, cipher_key); pipeline is
+        shared across handles
         (the mount's bounded concurrent-upload budget)."""
         self.upload_fn = upload_fn
         self.chunk_size = chunk_size
@@ -165,9 +166,13 @@ class DirtyPages:
         chunks = []
         try:
             for fut, file_off, size, mtime_ns, _ in uploads:
-                fid = fut.result()
+                res = fut.result()
+                # upload_fn returns fid, or (fid, cipher_key) when the
+                # filer namespace is encrypted
+                fid, ckey = res if isinstance(res, tuple) else (res, b"")
                 chunks.append(FileChunk(fid=fid, offset=file_off,
-                                        size=size, mtime_ns=mtime_ns))
+                                        size=size, mtime_ns=mtime_ns,
+                                        cipher_key=ckey))
         except Exception:
             # an upload failed: restore everything so a retried flush
             # can still commit — but FAILED futures must be replaced

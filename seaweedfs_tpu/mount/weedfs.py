@@ -315,9 +315,9 @@ class WeedFS:
             return fh
 
     def _uploader(self):
-        def up(data: bytes) -> str:
-            fid, _ = self.client.upload_chunk(data)
-            return fid
+        def up(data: bytes):
+            fid, _etag, ckey = self.client.upload_chunk(data)
+            return fid, ckey
         return up
 
     def _handle(self, fh: int) -> FileHandle:
@@ -443,7 +443,10 @@ class WeedFS:
         for v in views:
             data = self.chunks.get(v.fid)
             if data is None:
-                data = self.client.read_chunk(v.fid)
+                # read_chunk decrypts ciphered chunks; the tiered
+                # cache holds plaintext (keys live in entry metadata,
+                # the cache dir is as trusted as the mount itself)
+                data = self.client.read_chunk(v.fid, v.cipher_key)
                 self.chunks.put(v.fid, data)
             piece = data[v.offset_in_chunk:v.offset_in_chunk + v.view_size]
             pos = v.view_offset - offset
@@ -496,9 +499,12 @@ class WeedFS:
                 if c.offset >= length:
                     continue
                 if c.offset + c.size > length:
-                    c = FileChunk(fid=c.fid, offset=c.offset,
-                                  size=length - c.offset,
-                                  mtime_ns=c.mtime_ns, etag=c.etag)
+                    import dataclasses
+
+                    # replace() keeps every other field — dropping
+                    # cipher_key here would destroy the only copy of
+                    # the chunk's AES key
+                    c = dataclasses.replace(c, size=length - c.offset)
                 kept.append(c)
             entry.chunks = kept
         entry.mtime = time.time()

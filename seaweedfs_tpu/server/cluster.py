@@ -31,6 +31,7 @@ class Cluster:
                  topology: list[tuple[str, str]] | None = None,
                  with_filer: bool = False,
                  filer_store: str = "memory",
+                 filer_cipher: bool = False,
                  with_s3: bool = False,
                  s3_config: dict | None = None,
                  tier_backends: dict[str, dict] | None = None,
@@ -82,7 +83,8 @@ class Cluster:
             store_path = os.path.join(base_dir, "filer.db") \
                 if filer_store == "sqlite" else ":memory:"
             self.filer = FilerServer(self.master_url, store=filer_store,
-                                     store_path=store_path)
+                                     store_path=store_path,
+                                     cipher=filer_cipher)
             self.filer_thread = ServerThread(self.filer.app).start()
             self.filer.address = self.filer_thread.address
         self.s3 = None

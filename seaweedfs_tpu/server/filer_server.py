@@ -40,12 +40,17 @@ class FilerServer:
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  signature: int = 0,
                  announce_pulse: float = 3.0,
-                 store_options: dict | None = None):
+                 store_options: dict | None = None,
+                 cipher: bool = False):
         self.master_url = master_url.rstrip("/")
         self.masters = MasterClient(self.master_url)
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        # -encryptVolumeData: every chunk this filer writes is AES-GCM
+        # ciphertext under a per-chunk key kept in the entry metadata
+        # (filer_server_handlers_write_cipher.go; util/cipher.go)
+        self.cipher = cipher
         self.filer = Filer(store, on_delete_chunks=self._delete_chunks,
                            signature=signature, path=store_path,
                            **(store_options or {}))
@@ -534,22 +539,26 @@ class FilerServer:
             piece = await _read_exactly(reader, chunk_size)
             if not piece:
                 break
-            fid, etag = await asyncio.to_thread(
+            fid, etag, ckey = await asyncio.to_thread(
                 self._upload_chunk, piece, filename, collection,
                 replication, ttl, disk_type)
             md5_all.update(piece)
             chunks.append(FileChunk(fid=fid, offset=offset,
                                     size=len(piece),
-                                    mtime_ns=time.time_ns(), etag=etag))
+                                    mtime_ns=time.time_ns(), etag=etag,
+                                    cipher_key=ckey))
             offset += len(piece)
             total += len(piece)
             if len(piece) < chunk_size:
                 break
 
+        def _save_manifest(b: bytes):
+            fid, _etag, ckey = self._upload_chunk(
+                b, filename, collection, replication, ttl, disk_type)
+            return fid, ckey
+
         chunks = await asyncio.to_thread(
-            maybe_manifestize, lambda b: self._upload_chunk(
-                b, filename, collection, replication, ttl,
-                disk_type)[0], chunks)
+            maybe_manifestize, _save_manifest, chunks)
 
         entry = Entry(full_path=path, mime=mime,
                       ttl_sec=_ttl_seconds(ttl),
@@ -597,12 +606,13 @@ class FilerServer:
                     {"error": f"remote object {meta['key']} ended at "
                               f"{offset}, expected {size} bytes"},
                     status=502)
-            fid, etag = await asyncio.to_thread(
+            fid, etag, ckey = await asyncio.to_thread(
                 self._upload_chunk, piece, name, entry.collection,
                 entry.replication, "")
             chunks.append(FileChunk(fid=fid, offset=offset,
                                     size=len(piece),
-                                    mtime_ns=time.time_ns(), etag=etag))
+                                    mtime_ns=time.time_ns(), etag=etag,
+                                    cipher_key=ckey))
             offset += len(piece)
         entry.chunks = chunks
         await asyncio.to_thread(
@@ -630,12 +640,23 @@ class FilerServer:
 
     def _upload_chunk(self, data: bytes, name: str, collection: str,
                       replication: str, ttl: str,
-                      disk_type: str = "") -> tuple[str, str]:
+                      disk_type: str = "") -> tuple[str, str, bytes]:
+        """-> (fid, etag, cipher_key). With -encryptVolumeData the
+        volume server receives only ciphertext; the etag stays the md5
+        of the PLAINTEXT so content addressing (S3 ETag, sync
+        signatures) is cipher-independent."""
+        etag = hashlib.md5(data).hexdigest()
+        ckey = b""
+        if self.cipher:
+            from ..utils import cipher as cip
+
+            ckey = cip.gen_cipher_key()
+            data = cip.encrypt(data, ckey)
         a = verbs.assign(self.master_url, collection=collection,
                          replication=replication, ttl=ttl,
                          disk_type=disk_type)
         verbs.upload(a, data, name=name)
-        return a.fid, hashlib.md5(data).hexdigest()
+        return a.fid, etag, ckey
 
     async def handle_delete(self, req: web.Request) -> web.Response:
         path = norm_path("/" + req.match_info["path"])
@@ -700,7 +721,10 @@ class FilerServer:
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({
             "master": self.master_url, "store": self.filer.store.name,
-            "signature": self.filer.meta_log.signature})
+            "signature": self.filer.meta_log.signature,
+            # mounts/clients writing chunks directly must match the
+            # filer's encryption (GetFilerConfiguration.cipher)
+            "cipher": self.cipher})
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),

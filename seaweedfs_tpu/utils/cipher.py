@@ -1,0 +1,50 @@
+"""Chunk encryption: AES-256-GCM with a random per-chunk key.
+
+Equivalent of /root/reference/weed/util/cipher.go — GenCipherKey /
+Encrypt / Decrypt. Wire format matches the reference: the random nonce
+is prepended to the GCM ciphertext (which carries its auth tag), so a
+stored cipher-chunk is nonce || ciphertext || tag. Each chunk gets its
+OWN random 256-bit key, stored in the filer entry's chunk record
+(filer_pb FileChunk.cipher_key) — the volume server only ever sees
+ciphertext, and possession of the filer metadata is what grants
+decryption.
+"""
+from __future__ import annotations
+
+import os
+
+KEY_SIZE = 32  # AES-256
+NONCE_SIZE = 12  # GCM standard nonce
+
+
+def gen_cipher_key() -> bytes:
+    """Random per-chunk key (GenCipherKey, cipher.go:15)."""
+    return os.urandom(KEY_SIZE)
+
+
+def _aesgcm(key: bytes):
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"cipher key must be {KEY_SIZE} bytes")
+    return AESGCM(key)
+
+
+def encrypt(plaintext: bytes, key: bytes) -> bytes:
+    """nonce || AES-256-GCM(plaintext) (Encrypt, cipher.go:23)."""
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + _aesgcm(key).encrypt(nonce, plaintext, None)
+
+
+def decrypt(ciphertext: bytes, key: bytes) -> bytes:
+    """Inverse of encrypt; raises ValueError on tamper/short input
+    (Decrypt, cipher.go:41)."""
+    if len(ciphertext) < NONCE_SIZE:
+        raise ValueError("ciphertext too short")
+    from cryptography.exceptions import InvalidTag
+
+    nonce, ct = ciphertext[:NONCE_SIZE], ciphertext[NONCE_SIZE:]
+    try:
+        return _aesgcm(key).decrypt(nonce, ct, None)
+    except InvalidTag as e:
+        raise ValueError("cipher chunk failed authentication") from e

@@ -1,0 +1,151 @@
+"""Encrypted storage (cipher) path: -encryptVolumeData end-to-end.
+
+Round-2 VERDICT item 4. Equivalents:
+/root/reference/weed/util/cipher.go (AES-256-GCM, nonce-prefixed),
+/root/reference/weed/server/filer_server_handlers_write_cipher.go
+(filer encrypts chunks before the volume server ever sees them,
+per-chunk key in the entry metadata), read-side decrypt in
+/root/reference/weed/filer/stream.go.
+"""
+import json
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.rpc.http import ServerThread
+from seaweedfs_tpu.utils import cipher
+
+
+# ---------------------------------------------------------------------
+# primitive
+# ---------------------------------------------------------------------
+
+def test_cipher_round_trip():
+    key = cipher.gen_cipher_key()
+    assert len(key) == 32
+    ct = cipher.encrypt(b"attack at dawn", key)
+    assert ct != b"attack at dawn"
+    # nonce prefix + tag: ciphertext is strictly longer
+    assert len(ct) == cipher.NONCE_SIZE + len(b"attack at dawn") + 16
+    assert cipher.decrypt(ct, key) == b"attack at dawn"
+
+
+def test_cipher_tamper_and_short():
+    key = cipher.gen_cipher_key()
+    ct = bytearray(cipher.encrypt(b"payload", key))
+    ct[-1] ^= 0x01
+    with pytest.raises(ValueError):
+        cipher.decrypt(bytes(ct), key)
+    with pytest.raises(ValueError):
+        cipher.decrypt(b"\x00" * 4, key)
+    with pytest.raises(ValueError):
+        cipher.encrypt(b"x", b"short-key")
+
+
+# ---------------------------------------------------------------------
+# e2e: ciphered filer namespace
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("cipher_cluster")),
+                n_volume_servers=1, volume_size_limit=16 << 20,
+                with_filer=True, filer_cipher=True)
+    yield c
+    c.stop()
+
+
+def _entry_meta(cluster, path: str) -> dict:
+    r = requests.get(f"{cluster.filer_url}{path}", params={"meta": "1"})
+    r.raise_for_status()
+    return r.json()
+
+
+def _raw_chunk_bytes(cluster, fid: str) -> bytes:
+    vid = fid.split(",")[0]
+    loc = requests.get(f"{cluster.master_url}/dir/lookup",
+                       params={"volumeId": vid}).json()
+    url = loc["locations"][0]["url"]
+    r = requests.get(f"http://{url}/{fid}")
+    r.raise_for_status()
+    return r.content
+
+
+def test_volume_bytes_are_ciphertext_and_roundtrip(cluster):
+    payload = b"very secret business data " * 1000
+    url = f"{cluster.filer_url}/sec/doc.bin"
+    r = requests.post(url, data=payload,
+                      headers={"Content-Type": "application/x-thing"})
+    assert r.status_code == 201, r.text
+
+    meta = _entry_meta(cluster, "/sec/doc.bin")
+    chunks = meta["chunks"]
+    assert chunks and all(c.get("cipher_key") for c in chunks)
+
+    # the bytes AT REST on the volume server are unreadable ciphertext
+    raw = _raw_chunk_bytes(cluster, chunks[0]["fid"])
+    assert b"very secret" not in raw
+    assert raw != payload
+    # ...and decrypt with the chunk key back to the plaintext piece
+    key = bytes.fromhex(chunks[0]["cipher_key"])
+    assert cipher.decrypt(raw, key) == payload[:chunks[0]["size"]]
+
+    # full read through the filer round-trips
+    assert requests.get(url).content == payload
+
+
+def test_cipher_ranged_read(cluster):
+    payload = bytes(range(256)) * 100
+    url = f"{cluster.filer_url}/sec/ranged.bin"
+    requests.post(url, data=payload).raise_for_status()
+    r = requests.get(url, headers={"Range": "bytes=1000-1999"})
+    assert r.status_code == 206
+    assert r.content == payload[1000:2000]
+
+
+def test_cipher_multichunk_and_manifest(cluster, tmp_path_factory):
+    # a filer with a tiny chunk size + tiny manifest batch exercises
+    # the multi-chunk and (ciphered) manifest paths
+    from seaweedfs_tpu.filer import filechunks as fc
+
+    fs = FilerServer(cluster.master_url, chunk_size=1024, cipher=True)
+    t = ServerThread(fs.app).start()
+    fs.address = t.address
+    try:
+        payload = bytes(range(256)) * 40  # 10 chunks of 1KB
+        url = f"{t.url}/multi.bin"
+        requests.post(url, data=payload).raise_for_status()
+        meta = requests.get(url, params={"meta": "1"}).json()
+        assert len(meta["chunks"]) > 1 or \
+            any(c.get("is_chunk_manifest") for c in meta["chunks"])
+        assert requests.get(url).content == payload
+        # ranged read across a chunk boundary
+        r = requests.get(url, headers={"Range": "bytes=1500-2600"})
+        assert r.content == payload[1500:2601]
+    finally:
+        t.stop()
+
+
+def test_mount_client_reads_and_writes_cipher(cluster):
+    # FilerClient detects the ciphered namespace from /status and
+    # encrypts direct chunk uploads / decrypts chunk reads
+    from seaweedfs_tpu.filer.entry import Entry, FileChunk
+    from seaweedfs_tpu.mount.filer_client import FilerClient
+
+    fc = FilerClient(cluster.filer_url)
+    assert fc.cipher is True
+    fid, _etag, ckey = fc.upload_chunk(b"mount-side secret")
+    assert ckey
+    # raw bytes at rest are ciphertext; client read decrypts
+    assert _raw_chunk_bytes(cluster, fid) != b"mount-side secret"
+    assert fc.read_chunk(fid, ckey) == b"mount-side secret"
+
+    # an entry saved with that chunk reads back through the FILER too
+    entry = Entry(full_path="/sec/from-mount.bin", chunks=[
+        FileChunk(fid=fid, offset=0, size=len(b"mount-side secret"),
+                  mtime_ns=1, cipher_key=ckey)])
+    fc.save_entry(entry)
+    got = requests.get(f"{cluster.filer_url}/sec/from-mount.bin")
+    assert got.content == b"mount-side secret"
