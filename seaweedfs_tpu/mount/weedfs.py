@@ -56,7 +56,8 @@ class WeedFS:
                  upload_workers: int = 8,
                  collection: str = "", replication: str = "",
                  subscribe: bool = True,
-                 meta_ttl: float = 60.0):
+                 meta_ttl: float = 60.0,
+                 write_memory_limit: int = 64 << 20):
         """root: the filer directory this mount exposes as '/'."""
         self.client = FilerClient(filer_url, master_url,
                                   collection=collection,
@@ -67,6 +68,10 @@ class WeedFS:
         self.meta = MetaCache(ttl=meta_ttl)
         self.chunks = TieredChunkCache(cache_mem_bytes, cache_dir,
                                        cache_disk_bytes)
+        # dirty-write RAM cap per handle; spill goes next to the read
+        # cache when one is configured (page_writer.go swap file)
+        self.write_memory_limit = write_memory_limit
+        self.swap_dir = cache_dir
         self.pipeline = ThreadPoolExecutor(max_workers=upload_workers)
         self._handles: dict[int, FileHandle] = {}
         self._next_fh = 1
@@ -310,7 +315,9 @@ class WeedFS:
             fh = self._next_fh
             self._next_fh += 1
             dirty = DirtyPages(self._uploader(), self.chunk_size,
-                               pipeline=self.pipeline)
+                               pipeline=self.pipeline,
+                               memory_limit=self.write_memory_limit,
+                               swap_dir=self.swap_dir)
             self._handles[fh] = FileHandle(fh, path, entry, dirty)
             return fh
 
