@@ -58,15 +58,18 @@ def upload(url_or_assign, data: bytes, name: str = "",
         auth = auth or url_or_assign.auth
     else:
         url = url_or_assign
-    headers = {}
+    headers = {"Content-Type": mime or "application/octet-stream"}
     if auth:
         headers["Authorization"] = f"Bearer {auth}"
     params = {}
     if ts:
         params["ts"] = str(ts)
-    files = {"file": (name or "file", data,
-                      mime or "application/octet-stream")}
-    resp = session().post(url, files=files, headers=headers, params=params,
+    if name:
+        params["name"] = name
+    # raw body, not multipart: the volume server accepts both
+    # (needle_parse_upload.go does too), and multipart encode+parse
+    # measured ~1ms/req of pure CPU on the 1KB write benchmark
+    resp = session().post(url, data=data, headers=headers, params=params,
                          timeout=60)
     body = resp.json()
     if resp.status_code >= 300 or "error" in body:

@@ -207,6 +207,9 @@ class VolumeServer:
         pool = getattr(self, "_ec_fetch_pool", None)
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
+        mc = getattr(self, "_ec_master_client", None)
+        if mc is not None:
+            mc.stop()
         await asyncio.to_thread(self.store.close)
 
     # ------------------------------------------------------------------
@@ -319,8 +322,20 @@ class VolumeServer:
                     f"http://{url}/{req.match_info['fid']}")
             return web.Response(status=404, text=f"volume {vid} not found")
         try:
-            n = await asyncio.to_thread(
-                self.store.read_needle, vid, key, cookie)
+            # the needle map gives the size in O(1): small reads are a
+            # page-cache pread, cheaper inline than a to_thread hop.
+            # NEVER inline a remote-backed (tiered) volume: its read is
+            # a network call that would block the event loop — and can
+            # deadlock outright when the tier bucket lives on this same
+            # cluster (s3 gateway -> filer -> this very server)
+            v = self.store.find_volume(vid)
+            if v is not None and not getattr(v.dat, "remote", True) and \
+                    self.store.needle_size(vid, key) <= (64 << 10) and \
+                    vid not in self.store.ec_volumes:
+                n = self.store.read_needle(vid, key, cookie)
+            else:
+                n = await asyncio.to_thread(
+                    self.store.read_needle, vid, key, cookie)
         except KeyError:
             return web.Response(status=404)
         except PermissionError:
@@ -469,8 +484,14 @@ class VolumeServer:
                 n.flags |= ndl.FLAG_IS_COMPRESSED
         async with self._write_sem:
             try:
-                _, size = await asyncio.to_thread(
-                    self.store.write_needle, vid, n)
+                # small appends land in the page cache in ~10us: the
+                # to_thread hop costs more than the write on the 1-core
+                # benchmark; only big bodies leave the event loop
+                if len(n.data) <= (64 << 10):
+                    _, size = self.store.write_needle(vid, n)
+                else:
+                    _, size = await asyncio.to_thread(
+                        self.store.write_needle, vid, n)
             except KeyError:
                 return web.Response(status=404)
             except PermissionError as e:
@@ -1240,28 +1261,31 @@ class VolumeServer:
     EC_HOLDERS_TTL = 10.0
 
     def _ec_holders(self, vid: int) -> dict:
-        """{shard_id_str: [host:port, ...]} from the master, cached
-        briefly (one degraded read used to pay one master lookup PER
-        SHARD; shard placement changes rarely within a read)."""
-        import requests
+        """{shard_id_str: [host:port, ...]} from the client vid cache —
+        a subscribed MasterClient whose KeepConnected ec_updates stream
+        invalidates on shard moves, so degraded reads neither poll the
+        master per shard nor serve a stale map after ec.balance
+        (vid_map.go:169-236)."""
+        mc = getattr(self, "_ec_master_client", None)
+        if mc is None:
+            import threading
 
-        cache = getattr(self, "_ec_holders_cache", None)
-        if cache is None:
-            cache = self._ec_holders_cache = {}
-        hit = cache.get(vid)
-        now = time.monotonic()
-        if hit is not None and now - hit[1] < self.EC_HOLDERS_TTL:
-            return hit[0]
-        try:
-            resp = requests.get(
-                f"{self.master_url}/cluster/ec_shards",
-                params={"volumeId": vid}, timeout=5)
-            shards = resp.json().get("shards", {})
-        except requests.RequestException:
-            return hit[0] if hit is not None else {}
-        if shards:
-            cache[vid] = (shards, now)
-        return shards
+            from ..wdclient.client import MasterClient
+
+            lock = getattr(self, "_ec_mc_lock", None)
+            if lock is None:
+                lock = self.__dict__.setdefault(
+                    "_ec_mc_lock", threading.Lock())
+            with lock:
+                mc = getattr(self, "_ec_master_client", None)
+                if mc is None:
+                    # double-checked: concurrent fan-out threads must
+                    # not each spawn a subscriber websocket
+                    mc = self._ec_master_client = MasterClient(
+                        self.masters or [self.master_url],
+                        subscribe=True)
+        shards = mc.lookup_ec(vid, max_age=self.EC_HOLDERS_TTL)
+        return {str(sid): urls for sid, urls in shards.items()}
 
     def _fetch_shard_from_holders(self, vid: int, sid: int,
                                   holders: list, offset: int, size: int,

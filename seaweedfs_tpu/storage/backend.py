@@ -28,6 +28,8 @@ class StorageFile(Protocol):
 class DiskFile:
     """Local-disk backend (backend/disk_file.go equivalent)."""
 
+    remote = False  # reads are page-cache, not network
+
     def __init__(self, path: str, create: bool = False):
         mode = "r+b" if os.path.exists(path) else ("w+b" if create else None)
         if mode is None:
@@ -102,6 +104,8 @@ class DiskFile:
 
 
 class MemoryFile:
+    remote = False
+
     """In-memory backend for tests and the memory_map analogue."""
 
     def __init__(self, name: str = "<memory>"):
@@ -149,6 +153,8 @@ class S3RangeFile:
     S3BackendStorageFile): reads become ranged GETs; writes are
     forbidden — tiered volumes are read-only by construction
     (shell/command_volume_tier_upload.go marks them so first)."""
+
+    remote = True  # every read is a network round trip
 
     def __init__(self, storage: "S3BackendStorage", key: str, size: int):
         self._storage = storage
@@ -243,6 +249,8 @@ class S3BackendStorage:
 
 
 class MmapFile:
+    remote = False
+
     """Memory-mapped volume file backend — the counterpart of the
     reference's memory_map backend (storage/backend/memory_map/, the
     `-memoryMapLimitMB` path): reads come straight out of the mapping,
