@@ -64,7 +64,9 @@ class ServerThread:
         if not isinstance(app, web.Application):
             app = await app()
         self.app = app
-        self._runner = web.AppRunner(app)
+        # bound shutdown: a lingering client connection (e.g. a
+        # subscriber websocket) must not stall process exit
+        self._runner = web.AppRunner(app, shutdown_timeout=2.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
                            ssl_context=self.ssl_context)

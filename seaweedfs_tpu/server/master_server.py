@@ -194,6 +194,18 @@ class MasterServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/", self.handle_ui),
         ])
+        # proactively close KeepConnected websockets at shutdown:
+        # aiohttp otherwise waits its shutdown timeout for subscribed
+        # clients that would happily hold the stream open forever
+        async def _close_ws_clients(app):
+            for ws in list(self._clients):
+                try:
+                    await ws.close()
+                except Exception:
+                    pass
+            self._clients.clear()
+
+        app.on_shutdown.append(_close_ws_clients)
         if self.admin_scripts:
             app.on_startup.append(self._start_admin_scripts)
             app.on_cleanup.append(self._stop_admin_scripts)
@@ -408,7 +420,8 @@ class MasterServer:
             return ws
         self._clients.add(ws)
         try:
-            await ws.send_json({"snapshot": self._location_snapshot()})
+            await ws.send_json({"snapshot": self._location_snapshot(),
+                                "ec_snapshot": self._ec_shard_snapshot()})
             async for _ in ws:
                 pass
         finally:
@@ -430,6 +443,17 @@ class MasterServer:
                      "ec": True} for n in nodes]
         return out
 
+    def _ec_shard_snapshot(self) -> dict:
+        """{vid: {sid: [urls]}} — the per-shard map clients cache so EC
+        reads never poll /dir/lookup_ec (vid_map.go:169-236 ecVidMap)."""
+        out: dict[str, dict] = {}
+        with self.topo.lock:
+            for vid in self.topo.ec_locations:
+                shards = self.topo.lookup_ec_shards(vid)
+                out[str(vid)] = {str(sid): [n.url for n in nodes]
+                                 for sid, nodes in shards.items()}
+        return out
+
     async def _broadcast_location(self, vid: int, nodes) -> None:
         msg = {"updates": {str(vid): [
             {"url": n.url, "publicUrl": n.public_url} for n in nodes]}}
@@ -437,6 +461,7 @@ class MasterServer:
 
     async def _broadcast_node_update(self, node) -> None:
         updates = {}
+        ec_updates = {}
         with self.topo.lock:
             for vid in node.volumes:
                 updates[str(vid)] = [
@@ -446,11 +471,22 @@ class MasterServer:
                 updates[str(vid)] = [
                     {"url": n.url, "publicUrl": n.public_url, "ec": True}
                     for n in self.topo.lookup(vid)]
-        if updates:
-            await self._send_to_clients({"updates": updates})
+                ec_updates[str(vid)] = {
+                    str(sid): [n.url for n in nodes]
+                    for sid, nodes in
+                    self.topo.lookup_ec_shards(vid).items()}
+        if updates or ec_updates:
+            msg: dict = {"updates": updates}
+            if ec_updates:
+                # per-shard delta: an ec.balance shard move invalidates
+                # subscribed client caches without any polling
+                msg["ec_updates"] = ec_updates
+            await self._send_to_clients(msg)
 
     async def _broadcast_all_locations(self) -> None:
-        await self._send_to_clients({"snapshot": self._location_snapshot()})
+        await self._send_to_clients({"snapshot": self._location_snapshot(),
+                                     "ec_snapshot":
+                                         self._ec_shard_snapshot()})
 
     async def _send_to_clients(self, msg: dict) -> None:
         dead = []

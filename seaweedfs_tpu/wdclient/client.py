@@ -25,6 +25,11 @@ class MasterClient:
         self._current = 0
         self._vid_cache: dict[int, list[dict]] = {}
         self._cache_time: dict[int, float] = {}
+        # EC per-shard locations: vid -> {shard_id: [urls]}
+        # (vid_map.go:169-236 ecVidMap — kept fresh by the same
+        # KeepConnected stream, so EC reads never poll the master)
+        self._ec_cache: dict[int, dict[int, list[str]]] = {}
+        self._ec_cache_time: dict[int, float] = {}
         self._lock = threading.Lock()
         self._ws_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -71,10 +76,41 @@ class MasterClient:
             raise LookupError(f"volume {vid} has no locations")
         return f"http://{locs[0]['url']}/{fid}"
 
+    def lookup_ec(self, vid: int,
+                  max_age: float = 600.0) -> dict[int, list[str]]:
+        """-> {shard_id: [urls]} for an EC volume, cached; refreshed by
+        the KeepConnected ec_updates stream when subscribed."""
+        with self._lock:
+            shards = self._ec_cache.get(vid)
+            if shards is not None and \
+                    time.monotonic() - self._ec_cache_time.get(vid, 0) \
+                    < max_age:
+                return shards
+        for _ in range(len(self.masters)):
+            try:
+                resp = session().get(f"{self.master_url}/cluster/ec_shards",
+                                    params={"volumeId": str(vid)},
+                                    timeout=10)
+                resp.raise_for_status()
+                shards = {int(sid): urls for sid, urls in
+                          resp.json().get("shards", {}).items()}
+                with self._lock:
+                    self._ec_cache[vid] = shards
+                    self._ec_cache_time[vid] = time.monotonic()
+                return shards
+            except requests.RequestException:
+                self._failover()
+        # master unreachable: a stale map beats no map — the shards
+        # themselves are still where they were for almost all reads
+        with self._lock:
+            return self._ec_cache.get(vid, {})
+
     def invalidate(self, vid: int) -> None:
         with self._lock:
             self._vid_cache.pop(vid, None)
             self._cache_time.pop(vid, None)
+            self._ec_cache.pop(vid, None)
+            self._ec_cache_time.pop(vid, None)
 
     # -- KeepConnected subscription -------------------------------------
     def start_subscription(self) -> None:
@@ -142,3 +178,12 @@ class MasterClient:
             for vid, locs in msg.get("updates", {}).items():
                 self._vid_cache[int(vid)] = locs
                 self._cache_time[int(vid)] = now
+            if "ec_snapshot" in msg:
+                self._ec_cache = {
+                    int(vid): {int(s): urls for s, urls in shards.items()}
+                    for vid, shards in msg["ec_snapshot"].items()}
+                self._ec_cache_time = {v: now for v in self._ec_cache}
+            for vid, shards in msg.get("ec_updates", {}).items():
+                self._ec_cache[int(vid)] = {
+                    int(s): urls for s, urls in shards.items()}
+                self._ec_cache_time[int(vid)] = now
