@@ -9,11 +9,17 @@ masters must agree on before handing out volume ids.
 Design: asyncio single-threaded per node; a pluggable `Transport` lets
 tests run a 3-node cluster deterministically in-process (the reference's
 strategy of testing cluster logic without a cluster, SURVEY.md section 4)
-while `HTTPTransport` carries the same two RPCs (/raft/request_vote,
-/raft/append_entries) between real master processes over DCN. Log +
-term/vote are persisted to a JSON sidecar (the boltdb-store analog);
-snapshots are implicit because the FSM is a single integer carried in
-every AppendEntries commit.
+while `HTTPTransport` carries the same three RPCs (/raft/request_vote,
+/raft/append_entries, /raft/install_snapshot) between real master
+processes over DCN. Log + term/vote are persisted to a JSON sidecar
+(the boltdb-store analog).
+
+Log compaction (reference raft_server.go:53-99 snapshotting): once the
+applied log grows past `compact_threshold`, the FSM state is snapshotted
+and entries up to last_applied are dropped — persistence and restart
+replay stay O(threshold) instead of O(history). A follower that has
+fallen behind the leader's snapshot receives InstallSnapshot instead of
+AppendEntries.
 """
 from __future__ import annotations
 
@@ -52,6 +58,13 @@ class MaxVolumeIdFSM:
             self.max_volume_id = max(self.max_volume_id,
                                      int(command["value"]))
 
+    # snapshot support (raft_server.go Snapshot/Restore)
+    def to_dict(self) -> dict:
+        return {"max_volume_id": self.max_volume_id}
+
+    def from_dict(self, d: dict) -> None:
+        self.max_volume_id = int(d.get("max_volume_id", 0))
+
 
 class Transport:
     """RPC carrier between raft peers."""
@@ -60,6 +73,9 @@ class Transport:
         raise NotImplementedError
 
     async def append_entries(self, peer: str, args: dict) -> dict | None:
+        raise NotImplementedError
+
+    async def install_snapshot(self, peer: str, args: dict) -> dict | None:
         raise NotImplementedError
 
 
@@ -88,6 +104,12 @@ class MemoryTransport(Transport):
         if node is None or not self._reachable(args["leader"], peer):
             return None
         return node.on_append_entries(args)
+
+    async def install_snapshot(self, peer: str, args: dict) -> dict | None:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["leader"], peer):
+            return None
+        return node.on_install_snapshot(args)
 
 
 class HTTPTransport(Transport):
@@ -120,6 +142,9 @@ class HTTPTransport(Transport):
     async def append_entries(self, peer: str, args: dict) -> dict | None:
         return await self._post(peer, "/raft/append_entries", args)
 
+    async def install_snapshot(self, peer: str, args: dict) -> dict | None:
+        return await self._post(peer, "/raft/install_snapshot", args)
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
@@ -135,7 +160,7 @@ class RaftNode:
 
     def __init__(self, me: str, peers: list[str], transport: Transport,
                  state_dir: str | None = None, tick: float = 1.0,
-                 on_apply=None):
+                 on_apply=None, compact_threshold: int = 1024):
         self.me = me
         self.peers = [p for p in peers if p != me]
         self.transport = transport
@@ -144,10 +169,14 @@ class RaftNode:
         self.fsm = MaxVolumeIdFSM()
         self.on_apply = on_apply
 
-        # persistent state
+        # persistent state; `log` holds entries AFTER snap_index — all
+        # absolute 1-based indexes go through _entry()/_term_at()
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[LogEntry] = []
+        self.snap_index = 0  # last log index folded into the snapshot
+        self.snap_term = 0
+        self.compact_threshold = compact_threshold
 
         # volatile
         self.state = FOLLOWER
@@ -190,6 +219,9 @@ class RaftNode:
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term, "voted_for": self.voted_for,
                        "peers": self.peers,
+                       "snapshot": {"index": self.snap_index,
+                                    "term": self.snap_term,
+                                    "fsm": self.fsm.to_dict()},
                        "log": [e.to_json() for e in self.log]}, f)
         os.replace(tmp, path)
 
@@ -205,6 +237,47 @@ class RaftNode:
         self.peers = [p for p in d.get("peers", self.peers)
                       if p != self.me]
         self.log = [LogEntry.from_json(e) for e in d.get("log", [])]
+        snap = d.get("snapshot") or {}
+        self.snap_index = int(snap.get("index", 0))
+        self.snap_term = int(snap.get("term", 0))
+        if self.snap_index:
+            # restart-from-snapshot: the compacted prefix is already
+            # applied state, not replayable entries
+            self.fsm.from_dict(snap.get("fsm", {}))
+            self.commit_index = self.snap_index
+            self.last_applied = self.snap_index
+
+    # -- absolute-index helpers over the compacted log ------------------
+    def _last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _entry(self, idx: int) -> LogEntry:
+        return self.log[idx - self.snap_index - 1]
+
+    def _term_at(self, idx: int) -> int:
+        if idx == self.snap_index:
+            return self.snap_term
+        if idx <= 0 or idx > self._last_index() or idx < self.snap_index:
+            return 0
+        return self._entry(idx).term
+
+    def _maybe_compact(self) -> None:
+        """Fold the applied prefix into the snapshot once the log is
+        past the threshold (raft_server.go snapshot analog). Never
+        compacts past a pending commit waiter, so waiter term checks
+        stay exact."""
+        if len(self.log) <= self.compact_threshold:
+            return
+        limit = self.last_applied
+        for idx, _term, _fut in self._commit_waiters:
+            limit = min(limit, idx - 1)
+        if limit <= self.snap_index:
+            return
+        cut = limit - self.snap_index
+        self.snap_term = self._term_at(limit)
+        del self.log[:cut]
+        self.snap_index = limit
+        self._persist()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -243,8 +316,8 @@ class RaftNode:
         self.leader_id = None
         self._persist()
         term = self.current_term
-        last_idx = len(self.log)
-        last_term = self.log[-1].term if self.log else 0
+        last_idx = self._last_index()
+        last_term = self._term_at(last_idx)
         args = {"term": term, "candidate": self.me,
                 "last_log_index": last_idx, "last_log_term": last_term}
         votes, needed = 1, (len(self.peers) + 1) // 2 + 1
@@ -281,14 +354,14 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.state = LEADER
         self.leader_id = self.me
-        self.next_index = {p: len(self.log) + 1 for p in self.peers}
+        self.next_index = {p: self._last_index() + 1 for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
         # no-op entry of the new term: commits (and therefore applies)
         # any surviving prior-term entries without waiting for a client
         # proposal — the standard raft leader-completeness step.
         self.log.append(LogEntry(self.current_term, {"op": "noop"}))
         self._persist()
-        self._term_start_index = len(self.log)
+        self._term_start_index = self._last_index()
         if self._hb_task is not None and not self._hb_task.done():
             self._hb_task.cancel()
         self._hb_task = asyncio.create_task(
@@ -359,11 +432,31 @@ class RaftNode:
             return False
 
     async def _replicate_one(self, peer: str) -> None:
-        ni = self.next_index.get(peer, len(self.log) + 1)
+        ni = self.next_index.get(peer, self._last_index() + 1)
+        if ni <= self.snap_index:
+            # the entries this peer needs are compacted away: ship the
+            # snapshot instead (InstallSnapshot, raft paper section 7)
+            args = {"term": self.current_term, "leader": self.me,
+                    "snap_index": self.snap_index,
+                    "snap_term": self.snap_term,
+                    "fsm": self.fsm.to_dict(),
+                    # full voter set: conf changes compacted into the
+                    # snapshot must reach the follower too
+                    "voters": self.peers + [self.me]}
+            r = await self.transport.install_snapshot(peer, args)
+            if r is None or self.state != LEADER:
+                return
+            if r["term"] > self.current_term:
+                self._step_down(r["term"])
+                return
+            if r.get("success"):
+                self.match_index[peer] = self.snap_index
+                self.next_index[peer] = self.snap_index + 1
+            return
         prev_idx = ni - 1
-        prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and \
-            prev_idx <= len(self.log) else 0
-        entries = [e.to_json() for e in self.log[ni - 1:]]
+        prev_term = self._term_at(prev_idx)
+        entries = [e.to_json()
+                   for e in self.log[ni - self.snap_index - 1:]]
         args = {"term": self.current_term, "leader": self.me,
                 "prev_log_index": prev_idx, "prev_log_term": prev_term,
                 "entries": entries, "leader_commit": self.commit_index}
@@ -380,9 +473,9 @@ class RaftNode:
             self.next_index[peer] = max(1, ni - 1)
 
     def _advance_commit(self) -> None:
-        n = len(self.log)
+        n = self._last_index()
         while n > self.commit_index:
-            if self.log[n - 1].term == self.current_term:
+            if self._term_at(n) == self.current_term:
                 votes = 1 + sum(1 for p in self.peers
                                 if self.match_index.get(p, 0) >= n)
                 if votes * 2 > len(self.peers) + 1:
@@ -394,7 +487,7 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            cmd = self.log[self.last_applied - 1].command
+            cmd = self._entry(self.last_applied).command
             if str(cmd.get("type", "")).startswith("raft."):
                 self._apply_conf_change(cmd)
                 continue
@@ -405,16 +498,23 @@ class RaftNode:
         for idx, term, fut in self._commit_waiters:
             if idx <= self.commit_index:
                 if not fut.done():
-                    committed_term = self.log[idx - 1].term \
-                        if idx <= len(self.log) else -1
+                    # idx inside an installed snapshot -> _term_at is 0
+                    # and the waiter resolves False: the outcome is
+                    # genuinely unknown here, and raft's propose
+                    # contract only promises no false POSITIVES —
+                    # callers must treat failure as "retry / verify"
+                    committed_term = self._term_at(idx) \
+                        if idx <= self._last_index() else -1
                     fut.set_result(committed_term == term)
-            elif idx <= len(self.log) and self.log[idx - 1].term != term:
+            elif idx <= self._last_index() and \
+                    self._term_at(idx) != term:
                 # overwritten by a newer leader before committing
                 if not fut.done():
                     fut.set_result(False)
             else:
                 still.append((idx, term, fut))
         self._commit_waiters = still
+        self._maybe_compact()
 
     # ------------------------------------------------------------------
     # membership (single-server changes through the log, the
@@ -428,7 +528,7 @@ class RaftNode:
             if peer and peer != self.me and peer not in self.peers:
                 self.peers.append(peer)
                 if self.state == LEADER:
-                    self.next_index[peer] = len(self.log) + 1
+                    self.next_index[peer] = self._last_index() + 1
                     self.match_index[peer] = 0
         elif cmd["type"] == "raft.remove_peer":
             if peer in self.peers:
@@ -461,8 +561,8 @@ class RaftNode:
         granted = False
         if term == self.current_term and \
                 self.voted_for in (None, args["candidate"]):
-            my_last_term = self.log[-1].term if self.log else 0
-            my_last_idx = len(self.log)
+            my_last_idx = self._last_index()
+            my_last_term = self._term_at(my_last_idx)
             up_to_date = (args["last_log_term"], args["last_log_index"]) >= \
                 (my_last_term, my_last_idx)
             if up_to_date:
@@ -486,22 +586,30 @@ class RaftNode:
         self.leader_id = args["leader"]
 
         prev_idx = args["prev_log_index"]
-        if prev_idx > len(self.log):
+        entries = [LogEntry.from_json(e) for e in args["entries"]]
+        if prev_idx > self._last_index():
             return {"term": self.current_term, "success": False}
-        if prev_idx >= 1 and self.log[prev_idx - 1].term != \
-                args["prev_log_term"]:
-            del self.log[prev_idx - 1:]
+        if prev_idx < self.snap_index:
+            # our snapshot already covers part of this batch: entries at
+            # or before snap_index are committed state here, skip them
+            skip = self.snap_index - prev_idx
+            if skip >= len(entries):
+                return {"term": self.current_term, "success": True}
+            entries = entries[skip:]
+            prev_idx = self.snap_index
+        elif prev_idx > self.snap_index and \
+                self._term_at(prev_idx) != args["prev_log_term"]:
+            del self.log[prev_idx - self.snap_index - 1:]
             self._persist()
             return {"term": self.current_term, "success": False}
 
-        entries = [LogEntry.from_json(e) for e in args["entries"]]
         idx = prev_idx
         changed = False
         for e in entries:
             idx += 1
-            if idx <= len(self.log):
-                if self.log[idx - 1].term != e.term:
-                    del self.log[idx - 1:]
+            if idx <= self._last_index():
+                if self._term_at(idx) != e.term:
+                    del self.log[idx - self.snap_index - 1:]
                     self.log.append(e)
                     changed = True
             else:
@@ -510,8 +618,36 @@ class RaftNode:
         if changed:
             self._persist()
         if args["leader_commit"] > self.commit_index:
-            self.commit_index = min(args["leader_commit"], len(self.log))
+            self.commit_index = min(args["leader_commit"],
+                                    self._last_index())
             self._apply_committed()
+        return {"term": self.current_term, "success": True}
+
+    def on_install_snapshot(self, args: dict) -> dict:
+        """Adopt the leader's snapshot when our log is too far behind
+        for AppendEntries to bridge (compacted away at the leader)."""
+        term = args["term"]
+        if term < self.current_term:
+            return {"term": self.current_term, "success": False}
+        if term > self.current_term or self.state != FOLLOWER:
+            self._step_down(term)
+        self._last_heartbeat = time.monotonic()
+        self.leader_id = args["leader"]
+        snap_index = int(args["snap_index"])
+        if snap_index <= self.commit_index:
+            # we already have everything the snapshot covers
+            return {"term": self.current_term, "success": True}
+        self.log = []
+        self.snap_index = snap_index
+        self.snap_term = int(args["snap_term"])
+        self.fsm.from_dict(args.get("fsm", {}))
+        voters = args.get("voters")
+        if voters:
+            # membership changes compacted into the snapshot
+            self.peers = [p for p in voters if p != self.me]
+        self.commit_index = snap_index
+        self.last_applied = snap_index
+        self._persist()
         return {"term": self.current_term, "success": True}
 
     # ------------------------------------------------------------------
@@ -531,7 +667,7 @@ class RaftNode:
         term = self.current_term
         self.log.append(LogEntry(term, command))
         self._persist()
-        idx = len(self.log)
+        idx = self._last_index()
         fut = asyncio.get_event_loop().create_future()
         self._commit_waiters.append((idx, term, fut))
         if not self.peers:
@@ -551,6 +687,10 @@ class RaftNode:
         async def ae(req):
             return web.json_response(self.on_append_entries(await req.json()))
 
+        async def snap(req):
+            return web.json_response(
+                self.on_install_snapshot(await req.json()))
+
         async def status(req):
             return web.json_response({
                 "me": self.me, "state": self.state,
@@ -561,4 +701,5 @@ class RaftNode:
 
         return [web.post("/raft/request_vote", rv),
                 web.post("/raft/append_entries", ae),
+                web.post("/raft/install_snapshot", snap),
                 web.get("/raft/status", status)]
