@@ -22,23 +22,27 @@ def write_dat_file(base: str, dat_size: int,
                    large_block: int = geo.LARGE_BLOCK,
                    small_block: int = geo.SMALL_BLOCK,
                    backend: str = "auto") -> None:
-    """Reassemble `base`.dat from data shards .ec00-.ec09."""
-    missing_data = [i for i in range(geo.DATA_SHARDS)
+    """Reassemble `base`.dat from the volume's data shards."""
+    from .encoder import codec_of
+
+    k, _m = codec_of(base)
+    missing_data = [i for i in range(k)
                     if not os.path.exists(base + geo.shard_ext(i))]
     if missing_data:
         # only data shards are read below — don't waste compute/disk
         # regenerating absent parity files (reference ReconstructData)
         rebuild_ec_files(base, backend=backend, only_shards=missing_data)
 
-    n_large, n_small = geo.row_layout(dat_size, large_block, small_block)
+    n_large, n_small = geo.row_layout(dat_size, large_block, small_block,
+                                      data_shards=k)
     shards = [np.memmap(base + geo.shard_ext(i), dtype=np.uint8, mode="r")
-              for i in range(geo.DATA_SHARDS)]
+              for i in range(k)]
     remaining = dat_size
     with open(base + ".dat", "wb") as out:
         shard_off = 0
         for block, rows in ((large_block, n_large), (small_block, n_small)):
             for _ in range(rows):
-                for i in range(geo.DATA_SHARDS):
+                for i in range(k):
                     take = min(block, remaining)
                     if take <= 0:
                         break

@@ -106,25 +106,60 @@ def write_sorted_ecx(base: str, ext: str = ".ecx") -> None:
     db.save_to_idx(base + ext)
 
 
+def codec_of(base: str) -> tuple[int, int]:
+    """(data_shards, parity_shards) of the shard set at `base`, read
+    from the .vif sidecar ('' -> the RS(10,4) default)."""
+    from ..storage import volume_info as vinfo
+
+    vi = vinfo.maybe_load_volume_info(base + ".vif")
+    return geo.parse_codec(vi.ec_codec if vi else "")
+
+
+def _record_codec(base: str, codec: str) -> None:
+    """Persist a non-default codec in the .vif so every later consumer
+    (mount, rebuild, decode, degraded read) agrees on the geometry."""
+    from ..storage import volume_info as vinfo
+
+    vi = vinfo.maybe_load_volume_info(base + ".vif") or vinfo.VolumeInfo()
+    vi.ec_codec = codec
+    vinfo.save_volume_info(base + ".vif", vi)
+
+
 def write_ec_files(base: str, backend: str = "auto",
                    large_block: int = geo.LARGE_BLOCK,
                    small_block: int = geo.SMALL_BLOCK,
-                   chunk: int = DEFAULT_CHUNK) -> None:
-    """Generate .ec00..ec13 from `base`.dat (WriteEcFiles equivalent)."""
-    rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS, backend=backend)
+                   chunk: int = DEFAULT_CHUNK,
+                   codec: str = "") -> None:
+    """Generate .ec00..ecNN from `base`.dat (WriteEcFiles equivalent).
+    `codec` ("k.m") selects a wide code; default RS(10,4)."""
+    k, m = geo.parse_codec(codec)
+    if (k, m) != (geo.DATA_SHARDS, geo.PARITY_SHARDS):
+        _record_codec(base, codec)
+    else:
+        # re-encoding at the default codec must CLEAR a stale wide-code
+        # marker left by a previous encode/decode cycle, or every later
+        # consumer reads 10+4 shard files with k=28 geometry
+        from ..storage import volume_info as vinfo
+
+        vi = vinfo.maybe_load_volume_info(base + ".vif")
+        if vi is not None and vi.ec_codec:
+            vi.ec_codec = ""
+            vinfo.save_volume_info(base + ".vif", vi)
+    rs = ReedSolomon(k, m, backend=backend)
     dat_path = base + ".dat"
     dat_size = os.path.getsize(dat_path)
-    n_large, n_small = geo.row_layout(dat_size, large_block, small_block)
+    n_large, n_small = geo.row_layout(dat_size, large_block, small_block,
+                                      data_shards=k)
 
     dat = np.memmap(dat_path, dtype=np.uint8, mode="r") if dat_size else \
         np.zeros(0, dtype=np.uint8)
     # buffering=0: every write here is a full shard block; the default
     # BufferedWriter adds a copy that measured ~2x on this path
     outs = [open(base + geo.shard_ext(i), "wb", buffering=0)
-            for i in range(geo.TOTAL_SHARDS)]
+            for i in range(k + m)]
     try:
         _encode_region(rs, dat, 0, n_large, large_block, chunk, outs)
-        _encode_region(rs, dat, n_large * large_block * geo.DATA_SHARDS,
+        _encode_region(rs, dat, n_large * large_block * k,
                        n_small, small_block, chunk, outs)
     finally:
         for f in outs:
@@ -134,11 +169,10 @@ def write_ec_files(base: str, backend: str = "auto",
 
 
 def _region_blocks(dat: np.ndarray, start: int, n_rows: int,
-                   block: int, chunk: int):
+                   block: int, chunk: int, k: int = geo.DATA_SHARDS):
     """Yield the (k, w) codec input blocks for `n_rows` stripe rows of
     `block`-sized blocks starting at file offset `start`, in shard-file
     write order."""
-    k = geo.DATA_SHARDS
     row_bytes = block * k
     if block >= chunk:
         # large blocks: walk one row at a time, column-chunked
@@ -146,7 +180,7 @@ def _region_blocks(dat: np.ndarray, start: int, n_rows: int,
             row_start = start + r * row_bytes
             for c0 in range(0, block, chunk):
                 c1 = min(c0 + chunk, block)
-                yield _gather_columns(dat, row_start, block, c0, c1)
+                yield _gather_columns(dat, row_start, block, c0, c1, k)
         return
     # small blocks: pack many rows per dispatch
     rows_per = max(1, chunk // block)
@@ -177,11 +211,12 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
     backend's streaming pipeline, which keeps `depth` blocks in flight
     on a device codec so H2D, MXU compute, and D2H overlap instead of
     serializing per block."""
-    k = geo.DATA_SHARDS
+    k = rs.k
     w = _AsyncWriter()
     try:
         def gen():
-            for data in _region_blocks(dat, start, n_rows, block, chunk):
+            for data in _region_blocks(dat, start, n_rows, block, chunk,
+                                       k):
                 for i in range(k):
                     w.put(outs[i], data[i])
                 yield data
@@ -194,9 +229,9 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
 
 
 def _gather_columns(dat: np.ndarray, row_start: int, block: int,
-                    c0: int, c1: int) -> np.ndarray:
+                    c0: int, c1: int,
+                    k: int = geo.DATA_SHARDS) -> np.ndarray:
     """(k, c1-c0) data matrix for one stripe row, zero-padded past EOF."""
-    k = geo.DATA_SHARDS
     w = c1 - c0
     out = np.zeros((k, w), dtype=np.uint8)
     total = dat.shape[0]
@@ -214,20 +249,21 @@ def rebuild_ec_files(base: str, backend: str = "auto",
     """Regenerate missing .ecXX files from the present ones
     (RebuildEcFiles, ec_encoder.go:61). Returns rebuilt shard ids.
     `only_shards` restricts which missing shards are produced."""
+    k, m = codec_of(base)
     present, missing = [], []
-    for i in range(geo.TOTAL_SHARDS):
+    for i in range(k + m):
         (present if os.path.exists(base + geo.shard_ext(i)) else
          missing).append(i)
     if only_shards is not None:
         missing = [i for i in missing if i in set(only_shards)]
     if not missing:
         return []
-    if len(present) < geo.DATA_SHARDS:
+    if len(present) < k:
         raise ValueError(
-            f"need >= {geo.DATA_SHARDS} shards to rebuild, have "
+            f"need >= {k} shards to rebuild, have "
             f"{len(present)}")
 
-    rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS, backend=backend)
+    rs = ReedSolomon(k, m, backend=backend)
     sizes = {os.path.getsize(base + geo.shard_ext(i)) for i in present}
     if len(sizes) != 1:
         raise ValueError(f"present shards disagree on size: {sizes}")
@@ -264,9 +300,10 @@ def rebuild_ec_files(base: str, backend: str = "auto",
 
 def verify_ec_files(base: str, backend: str = "auto",
                     chunk: int = DEFAULT_CHUNK) -> bool:
-    """Parity-check all 14 shard files (scrub building block)."""
-    rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS, backend=backend)
-    paths = [base + geo.shard_ext(i) for i in range(geo.TOTAL_SHARDS)]
+    """Parity-check the full shard set (scrub building block)."""
+    k, m = codec_of(base)
+    rs = ReedSolomon(k, m, backend=backend)
+    paths = [base + geo.shard_ext(i) for i in range(k + m)]
     if not all(os.path.exists(p) for p in paths):
         return False
     size = os.path.getsize(paths[0])
@@ -276,7 +313,6 @@ def verify_ec_files(base: str, backend: str = "auto",
             return False
     from collections import deque
 
-    k = geo.DATA_SHARDS
     expected: deque = deque()
 
     def gen():

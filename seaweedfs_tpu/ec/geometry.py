@@ -5,6 +5,12 @@ erasure_coding/ec_encoder.go:17-23, ec_locate.go): a volume's .dat is
 striped row-major — while more than one full large row (10 x 1GB) remains,
 emit large rows; then 10 x 1MB small rows, the last one zero-padded. Data
 shard i of a row holds block i; parity shards .ec10-.ec13 extend each row.
+
+Beyond-reference: the same math generalizes to WIDE codes — every
+function takes an optional `data_shards`, and `parse_codec("28.4")`
+names an RS(28,4) volume tier for cold collections (BASELINE config #4:
+wider stripes cost the same MXU dispatch but 1/7th the parity
+overhead). The reference hard-codes 10+4.
 """
 from __future__ import annotations
 
@@ -13,8 +19,27 @@ from dataclasses import dataclass
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
 TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+# widest supported code: ShardBits is a uint32 mask, shard_ext 2 digits
+MAX_SHARD_COUNT = 32
 LARGE_BLOCK = 1 << 30  # 1GB
 SMALL_BLOCK = 1 << 20  # 1MB
+
+
+def parse_codec(codec: str) -> tuple[int, int]:
+    """'k.m' -> (data_shards, parity_shards); '' -> the RS(10,4)
+    default. Validates against the uint32 shard mask."""
+    if not codec:
+        return DATA_SHARDS, PARITY_SHARDS
+    k_s, _, m_s = codec.partition(".")
+    k, m = int(k_s), int(m_s)
+    if k <= 0 or m <= 0 or k + m > MAX_SHARD_COUNT:
+        raise ValueError(
+            f"codec {codec!r}: need k>0, m>0, k+m<={MAX_SHARD_COUNT}")
+    return k, m
+
+
+def codec_name(k: int, m: int) -> str:
+    return f"{k}.{m}"
 
 
 def shard_ext(index: int) -> str:
@@ -23,28 +48,31 @@ def shard_ext(index: int) -> str:
 
 
 def row_layout(dat_size: int, large_block: int = LARGE_BLOCK,
-               small_block: int = SMALL_BLOCK) -> tuple[int, int]:
+               small_block: int = SMALL_BLOCK,
+               data_shards: int = DATA_SHARDS) -> tuple[int, int]:
     """-> (n_large_rows, n_small_rows) for a .dat of dat_size bytes.
 
     Matches encodeDatFile's loop structure (ec_encoder.go:198-235): large
-    rows are emitted while remaining > 10*large_block (strictly), then
+    rows are emitted while remaining > k*large_block (strictly), then
     small rows while remaining > 0, last one zero-padded.
     """
     remaining = dat_size
     n_large = 0
-    while remaining > large_block * DATA_SHARDS:
+    while remaining > large_block * data_shards:
         n_large += 1
-        remaining -= large_block * DATA_SHARDS
+        remaining -= large_block * data_shards
     n_small = 0
     while remaining > 0:
         n_small += 1
-        remaining -= small_block * DATA_SHARDS
+        remaining -= small_block * data_shards
     return n_large, n_small
 
 
 def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK,
-                    small_block: int = SMALL_BLOCK) -> int:
-    n_large, n_small = row_layout(dat_size, large_block, small_block)
+                    small_block: int = SMALL_BLOCK,
+                    data_shards: int = DATA_SHARDS) -> int:
+    n_large, n_small = row_layout(dat_size, large_block, small_block,
+                                  data_shards)
     return n_large * large_block + n_small * small_block
 
 
@@ -57,23 +85,25 @@ class Interval:
     size: int
     is_large_block: bool
     large_block_rows: int   # large-row count of the volume
+    data_shards: int = DATA_SHARDS  # stripe width of the volume's codec
 
     def to_shard_and_offset(self, large_block: int = LARGE_BLOCK,
                             small_block: int = SMALL_BLOCK) -> tuple[int, int]:
         """-> (shard_id, offset within shard file) — Interval.
         ToShardIdAndOffset (ec_locate.go:77)."""
-        row = self.block_index // DATA_SHARDS
+        row = self.block_index // self.data_shards
         off = self.inner_offset
         if self.is_large_block:
             off += row * large_block
         else:
             off += self.large_block_rows * large_block + row * small_block
-        return self.block_index % DATA_SHARDS, off
+        return self.block_index % self.data_shards, off
 
 
 def locate(dat_size: int, offset: int, size: int,
            large_block: int = LARGE_BLOCK,
-           small_block: int = SMALL_BLOCK) -> list[Interval]:
+           small_block: int = SMALL_BLOCK,
+           data_shards: int = DATA_SHARDS) -> list[Interval]:
     """Map a logical [offset, offset+size) range of the original .dat to
     shard-block intervals (LocateData, ec_locate.go:15).
 
@@ -83,8 +113,9 @@ def locate(dat_size: int, offset: int, size: int,
     is within 10*small of an exact large-row multiple, where the
     reference's locate would point into the wrong region.
     """
-    n_large_rows, _ = row_layout(dat_size, large_block, small_block)
-    large_row = large_block * DATA_SHARDS
+    n_large_rows, _ = row_layout(dat_size, large_block, small_block,
+                                 data_shards)
+    large_row = large_block * data_shards
 
     if offset < n_large_rows * large_row:
         is_large = True
@@ -99,10 +130,10 @@ def locate(dat_size: int, offset: int, size: int,
         block = large_block if is_large else small_block
         take = min(size, block - inner)
         out.append(Interval(int(block_index), int(inner), int(take),
-                            is_large, int(n_large_rows)))
+                            is_large, int(n_large_rows), data_shards))
         size -= take
         block_index += 1
-        if is_large and block_index == n_large_rows * DATA_SHARDS:
+        if is_large and block_index == n_large_rows * data_shards:
             is_large = False
             block_index = 0
         inner = 0

@@ -41,7 +41,7 @@ class ShardBits:
         return bool(self.bits >> i & 1)
 
     def ids(self) -> list[int]:
-        return [i for i in range(geo.TOTAL_SHARDS) if self.has(i)]
+        return [i for i in range(geo.MAX_SHARD_COUNT) if self.has(i)]
 
     def count(self) -> int:
         return bin(self.bits).count("1")
@@ -82,6 +82,13 @@ class EcVolume:
         self.vid = vid
         self.shards: dict[int, EcVolumeShard] = {}
         base = self.base_name()
+        # per-volume codec from the .vif sidecar (wide-code tier);
+        # absent -> the RS(10,4) default
+        from ..storage import volume_info as vinfo
+
+        vi = vinfo.maybe_load_volume_info(base + ".vif")
+        self.k, self.m = geo.parse_codec(vi.ec_codec if vi else "")
+        self.total = self.k + self.m
         self._ecx = idxmod.read_index(base + ".ecx") if \
             os.path.exists(base + ".ecx") else np.empty(0, idxmod.IDX_DTYPE)
         self._keys = self._ecx["key"].astype(np.uint64)
@@ -138,7 +145,7 @@ class EcVolume:
             n_large -= 1
             n_small = geo.LARGE_BLOCK // geo.SMALL_BLOCK
         return (n_large * geo.LARGE_BLOCK + n_small * geo.SMALL_BLOCK) * \
-            geo.DATA_SHARDS
+            self.k
 
     # -- needle lookup -------------------------------------------------
     def locate_needle(self, needle_id: int) -> tuple[int, int]:
@@ -155,7 +162,8 @@ class EcVolume:
     def needle_intervals(self, needle_id: int) -> tuple[list[geo.Interval], int]:
         offset, size = self.locate_needle(needle_id)
         disk = ndl.disk_size(size)
-        return geo.locate(self.derived_dat_size(), offset, disk), size
+        return geo.locate(self.derived_dat_size(), offset, disk,
+                          data_shards=self.k), size
 
     def live_needle_ids(self) -> list[tuple[int, int]]:
         """Live (needle_id, size) pairs from the .ecx minus .ecj

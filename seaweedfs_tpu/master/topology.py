@@ -166,6 +166,7 @@ class Topology:
         # EC registry: vid -> shard id -> [DataNode]
         self.ec_locations: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
+        self.ec_codecs: dict[int, str] = {}  # vid -> "k.m" wide codes
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
@@ -218,26 +219,33 @@ class Topology:
                 self.max_volume_id = max(self.max_volume_id, vid)
 
     def sync_node_ec_shards(self, node: DataNode,
-                            shards: list[tuple[int, str, int]]) -> None:
-        """shards: [(vid, collection, shard_bits)] (topology_ec.go:16)."""
+                            shards: list[tuple[int, str, int, str]]) -> None:
+        """shards: [(vid, collection, shard_bits, codec)]
+        (topology_ec.go:16; codec '' = RS(10,4), 'k.m' = wide tier)."""
         with self.lock:
-            new = {vid: bits for vid, _, bits in shards}
+            new = {s[0]: s[2] for s in shards}
             # unregister shards no longer reported
             for vid in list(node.ec_shards):
                 old_bits = node.ec_shards[vid]
                 now_bits = new.get(vid, 0)
-                for sid in range(geo.TOTAL_SHARDS):
+                for sid in range(geo.MAX_SHARD_COUNT):
                     if old_bits >> sid & 1 and not now_bits >> sid & 1:
                         self._unregister_ec_shard(vid, sid, node)
                 if now_bits == 0:
                     node.ec_shards.pop(vid, None)
-            for vid, col, bits in shards:
+            for vid, col, bits, codec in shards:
                 if bits == 0:
                     continue
                 node.ec_shards[vid] = bits
                 self.ec_collections[vid] = col
+                if codec:
+                    self.ec_codecs[vid] = codec
+                else:
+                    # default-codec heartbeat overwrites a stale wide
+                    # marker from a previous encode/decode cycle
+                    self.ec_codecs.pop(vid, None)
                 vol = self.ec_locations.setdefault(vid, {})
-                for sid in range(geo.TOTAL_SHARDS):
+                for sid in range(geo.MAX_SHARD_COUNT):
                     if bits >> sid & 1:
                         nodes = vol.setdefault(sid, [])
                         if node not in nodes:
@@ -254,7 +262,7 @@ class Topology:
             for v in node.volumes.values():
                 self._unregister_volume(v, node)
             for vid in node.ec_shards:
-                for sid in range(geo.TOTAL_SHARDS):
+                for sid in range(geo.MAX_SHARD_COUNT):
                     if node.ec_shards[vid] >> sid & 1:
                         self._unregister_ec_shard(vid, sid, node)
             node.rack.nodes.pop(node_id, None)
@@ -303,6 +311,7 @@ class Topology:
         if not vol:
             self.ec_locations.pop(vid, None)
             self.ec_collections.pop(vid, None)
+            self.ec_codecs.pop(vid, None)
 
     # -- lookup ---------------------------------------------------------
     def lookup(self, vid: int) -> list[DataNode]:

@@ -396,7 +396,8 @@ class MasterServer:
                 if "ec_shards" in hb:
                     self.topo.sync_node_ec_shards(
                         node, [(e["id"], e.get("collection", ""),
-                                e["shard_bits"]) for e in hb["ec_shards"]])
+                                e["shard_bits"], e.get("codec", ""))
+                               for e in hb["ec_shards"]])
                 await ws.send_json({
                     "volume_size_limit": self.topo.volume_size_limit,
                     "pulse_seconds": self.pulse_seconds,
@@ -596,6 +597,7 @@ class MasterServer:
         return json_ok({
             "volumeId": vid,
             "collection": self.topo.ec_collections.get(vid, ""),
+            "codec": self.topo.ec_codecs.get(vid, ""),
             "shards": {str(sid): [n.url for n in nodes]
                        for sid, nodes in shards.items()},
         })

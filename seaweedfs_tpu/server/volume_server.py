@@ -927,7 +927,8 @@ class VolumeServer:
         body = await req.json()
         vid = int(body["volume"])
         try:
-            await asyncio.to_thread(self.store.generate_ec_shards, vid)
+            await asyncio.to_thread(self.store.generate_ec_shards, vid,
+                                    body.get("codec", ""))
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
         return web.json_response({"volume": vid})
@@ -966,13 +967,16 @@ class VolumeServer:
             exts += [".ecx"]
         if body.get("copy_ecj", False):
             exts += [".ecj"]
+        # the .vif sidecar names the volume's EC codec: a wide-code
+        # shard set copied without it would be misread as RS(10,4)
+        exts += [".vif"]
         async with aiohttp.ClientSession() as sess:
             for ext in exts:
                 async with sess.get(
                         f"http://{source}/admin/copy_file",
                         params={"volume": vid, "collection": collection,
                                 "ext": ext}) as resp:
-                    if resp.status == 404 and ext == ".ecj":
+                    if resp.status == 404 and ext in (".ecj", ".vif"):
                         continue
                     if resp.status != 200:
                         return web.json_response(

@@ -14,11 +14,15 @@ from .env import CommandEnv, ShellError
 
 
 def ec_encode(env: CommandEnv, volume_id: int,
-              collection: str = "") -> dict:
-    """Mark readonly, generate 14 shards on the source server, spread
-    them across servers by free slots, then delete the original volume
-    everywhere (command_ec_encode.go:95-192)."""
+              collection: str = "", codec: str = "") -> dict:
+    """Mark readonly, generate the shard set on the source server,
+    spread shards across servers by free slots, then delete the
+    original volume everywhere (command_ec_encode.go:95-192).
+    `codec` ("k.m", e.g. "28.4") selects the beyond-reference wide-code
+    tier for cold collections; default RS(10,4)."""
     env.confirm_locked()
+    k, m = geo.parse_codec(codec)
+    total = k + m
     sources = env.volume_locations(volume_id)
     if not sources:
         raise ShellError(f"volume {volume_id} not found")
@@ -28,17 +32,20 @@ def ec_encode(env: CommandEnv, volume_id: int,
         env.vs_post(url, "/admin/mark_readonly", {"volume": volume_id})
     source = sources[0]
     env.vs_post(source, "/admin/ec/generate",
-                {"volume": volume_id, "collection": collection})
-    placement = spread_ec_shards(env, volume_id, collection, source)
+                {"volume": volume_id, "collection": collection,
+                 "codec": codec})
+    placement = spread_ec_shards(env, volume_id, collection, source,
+                                 total=total)
     # delete original replicas now that shards are mounted
     for url in sources:
         env.vs_post(url, "/admin/delete_volume", {"volume": volume_id})
-    env.wait_for_ec_registration(volume_id, geo.TOTAL_SHARDS)
+    env.wait_for_ec_registration(volume_id, total)
     return {sid: url for sid, url in placement.items()}
 
 
 def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
-                     source: str) -> dict[int, str]:
+                     source: str,
+                     total: int = geo.TOTAL_SHARDS) -> dict[int, str]:
     """Allocate shards to servers by descending free slots
     (command_ec_encode.go:145 spreadEcShards, balanced like
     command_ec_common.go:111)."""
@@ -54,7 +61,7 @@ def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
     order = sorted(nodes, key=free, reverse=True)
     placement: dict[int, str] = {}
     per_node: dict[str, list[int]] = defaultdict(list)
-    for sid in range(geo.TOTAL_SHARDS):
+    for sid in range(total):
         node = order[sid % len(order)]
         placement[sid] = node["url"]
         per_node[node["url"]].append(sid)
@@ -69,7 +76,7 @@ def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
                      "shard_ids": sids})
     # source keeps only its assigned shards
     source_keeps = set(per_node.get(source, []))
-    drop = [sid for sid in range(geo.TOTAL_SHARDS)
+    drop = [sid for sid in range(total)
             if sid not in source_keeps]
     if drop:
         env.vs_post(source, "/admin/ec/delete",
@@ -84,18 +91,18 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
     rebuilder, run the local rebuild, mount the rebuilt shards, drop the
     borrowed copies."""
     env.confirm_locked()
+    reg_collection, (k, m), locations = env.ec_info(volume_id)
     if not collection:
-        collection = env.ec_collection(volume_id)
-    locations = env.ec_shard_locations(volume_id)
+        collection = reg_collection
     present = set(locations)
-    missing = [sid for sid in range(geo.TOTAL_SHARDS)
+    missing = [sid for sid in range(k + m)
                if sid not in present]
     if not missing:
         return {"rebuilt": []}
-    if len(present) < geo.DATA_SHARDS:
+    if len(present) < k:
         raise ShellError(
             f"volume {volume_id}: only {len(present)} shards survive, "
-            f"need {geo.DATA_SHARDS}")
+            f"need {k}")
     nodes = env.data_nodes()
     rebuilder = max(
         nodes,
@@ -125,7 +132,7 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
     if borrowed:
         env.vs_post(rebuilder, "/admin/ec/delete",
                     {"volume": volume_id, "shard_ids": borrowed})
-    env.wait_for_ec_registration(volume_id, geo.TOTAL_SHARDS)
+    env.wait_for_ec_registration(volume_id, k + m)
     return {"rebuilt": rebuilt, "rebuilder": rebuilder}
 
 
@@ -142,7 +149,7 @@ def ec_balance(env: CommandEnv, collection: str = "") -> list[dict]:
     holdings: dict[str, list[tuple[int, int]]] = defaultdict(list)
     for n in nodes:
         for vid_s, bits in n["ec_volumes"].items():
-            for sid in range(geo.TOTAL_SHARDS):
+            for sid in range(geo.MAX_SHARD_COUNT):
                 if bits >> sid & 1:
                     holdings[n["url"]].append((int(vid_s), sid))
     total = sum(shard_count.values())
@@ -179,13 +186,13 @@ def ec_decode(env: CommandEnv, volume_id: int,
     """Collect all shards onto one server and decode back to a normal
     volume (command_ec_decode.go)."""
     env.confirm_locked()
+    reg_collection, (k, m), locations = env.ec_info(volume_id)
     if not collection:
-        collection = env.ec_collection(volume_id)
-    locations = env.ec_shard_locations(volume_id)
+        collection = reg_collection
     if not locations:
         raise ShellError(f"ec volume {volume_id} not found")
     present = set(locations)
-    if len(present) < geo.DATA_SHARDS:
+    if len(present) < k:
         raise ShellError(f"only {len(present)} shards survive")
     # choose the server with most shards as the collector
     count_by_server: dict[str, int] = defaultdict(int)
@@ -194,7 +201,7 @@ def ec_decode(env: CommandEnv, volume_id: int,
             count_by_server[u] += 1
     collector = max(count_by_server, key=count_by_server.get)
     have = {sid for sid, urls in locations.items() if collector in urls}
-    need = sorted((present - have))[:geo.TOTAL_SHARDS]
+    need = sorted((present - have))[:k + m]
     for sid in need:
         src = locations[sid][0]
         env.vs_post(collector, "/admin/ec/copy",
@@ -228,14 +235,14 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
 
     from ..ec.backend import ReedSolomon
 
-    locs = env.ec_shard_locations(volume_id)
-    missing = [sid for sid in range(geo.TOTAL_SHARDS) if sid not in locs]
+    _col, (k, m), locs = env.ec_info(volume_id)
+    missing = [sid for sid in range(k + m) if sid not in locs]
     if missing:
         return {"volume": volume_id, "verified": False,
                 "missing_shards": missing}
     sample = sample_mb << 20
     shards = []
-    for sid in range(geo.TOTAL_SHARDS):
+    for sid in range(k + m):
         url = locs[sid][0]
         params = {"volume": str(volume_id), "shard": str(sid),
                   "offset": "0"}
@@ -251,8 +258,7 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
         shards.append(np.frombuffer(resp.content, dtype=np.uint8))
     n = min(len(s) for s in shards)
     stack = np.stack([s[:n] for s in shards])
-    rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS,
-                     backend=backend)
+    rs = ReedSolomon(k, m, backend=backend)
     ok = bool(rs.verify(stack))
     return {"volume": volume_id, "verified": ok,
             "bytes_checked_per_shard": int(n), "backend": backend}

@@ -76,6 +76,23 @@ class CommandEnv:
         return self.master_get("/cluster/ec_shards",
                                volumeId=vid).get("collection", "")
 
+    def ec_codec(self, vid: int) -> tuple[int, int]:
+        """(k, m) of an EC volume from the master registry
+        ('' -> RS(10,4) default)."""
+        return self.ec_info(vid)[1]
+
+    def ec_info(self, vid: int) -> tuple[str, tuple[int, int],
+                                         "dict[int, list[str]]"]:
+        """(collection, (k, m), {shard_id: [urls]}) in ONE master
+        round trip — /cluster/ec_shards carries all three."""
+        from ..ec import geometry as geo
+
+        body = self.master_get("/cluster/ec_shards", volumeId=vid)
+        return (body.get("collection", ""),
+                geo.parse_codec(body.get("codec", "")),
+                {int(sid): urls
+                 for sid, urls in body.get("shards", {}).items()})
+
     def volume_collection(self, vid: int) -> str:
         for n in self.data_nodes():
             col = n.get("collections", {}).get(str(vid))

@@ -46,7 +46,7 @@ HELP = """commands:
   volume.tier.download -volumeId=N  bring a tiered .dat back to disk
   volume.scrub [-volumeId=N] [-collection=C] [-limit=N]
                                     full-read CRC verification
-  ec.encode -volumeId=N             erasure-code a volume
+  ec.encode -volumeId=N [-codec=k.m]  erasure-code a volume (wide tier)
   ec.verify -volumeId=N [-sampleMB=4] [-backend=numpy|native|jax]
                                     parity-check spread shards
   ec.rebuild -volumeId=N            rebuild missing shards
@@ -210,7 +210,8 @@ def run_command(env: CommandEnv, line: str) -> object:
     # -- erasure coding -------------------------------------------------
     if cmd == "ec.encode":
         return commands_ec.ec_encode(env, int(opts["volumeId"]),
-                                     opts.get("collection", ""))
+                                     opts.get("collection", ""),
+                                     codec=opts.get("codec", ""))
     if cmd == "ec.rebuild":
         return commands_ec.ec_rebuild(env, int(opts["volumeId"]),
                                       opts.get("collection", ""))

@@ -192,15 +192,16 @@ class Store:
         raise KeyError(f"volume {vid} not found")
 
     # -- EC lifecycle ---------------------------------------------------
-    def generate_ec_shards(self, vid: int) -> None:
+    def generate_ec_shards(self, vid: int, codec: str = "") -> None:
         """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:38):
-        .dat -> 14 shards + .ecx, using the configured codec backend."""
+        .dat -> shard files + .ecx, using the configured codec backend.
+        `codec` ("k.m") selects a wide code (beyond-reference tier)."""
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
         v.sync()
         base = v.file_name()
-        write_ec_files(base, backend=self.ec_backend)
+        write_ec_files(base, backend=self.ec_backend, codec=codec)
         write_sorted_ecx(base)
 
     def rebuild_ec_shards(self, vid: int) -> list[int]:
@@ -235,7 +236,7 @@ class Store:
     def delete_ec_shards(self, vid: int,
                          shard_ids: Iterable[int] | None = None) -> None:
         ids = set(shard_ids) if shard_ids is not None else None
-        self.unmount_ec_shards(vid, ids or range(geo.TOTAL_SHARDS))
+        self.unmount_ec_shards(vid, ids or range(geo.MAX_SHARD_COUNT))
         for loc in self.locations:
             loc.remove_ec_shards(vid, ids)
 
@@ -255,7 +256,7 @@ class Store:
     def _loc_with_ec_files(self, vid: int, collection: str) -> DiskLocation:
         for loc in self.locations:
             name = f"{collection}_{vid}" if collection else str(vid)
-            for sid in range(geo.TOTAL_SHARDS):
+            for sid in range(geo.MAX_SHARD_COUNT):
                 if os.path.exists(os.path.join(
                         loc.dir, name + geo.shard_ext(sid))):
                     return loc
@@ -312,16 +313,16 @@ class Store:
         RTTs and a single hung peer would stall the read forever."""
         rows: dict[int, np.ndarray] = {}
         candidates: list[int] = []
-        for sid in range(geo.TOTAL_SHARDS):
+        for sid in range(ecv.total):
             if sid == missing_sid:
                 continue
             shard = ecv.shards.get(sid)
-            if shard is not None and len(rows) < geo.DATA_SHARDS:
+            if shard is not None and len(rows) < ecv.k:
                 rows[sid] = np.frombuffer(
                     shard.read_at(offset, size), dtype=np.uint8)
             elif shard is None:
                 candidates.append(sid)
-        need = geo.DATA_SHARDS - len(rows)
+        need = ecv.k - len(rows)
         if need > 0 and candidates:
             if self.remote_shards_fetcher is not None:
                 got = self.remote_shards_fetcher(
@@ -332,18 +333,32 @@ class Store:
             elif self.remote_shard_reader is not None:
                 # legacy serial fallback (tools / tests without a server)
                 for sid in candidates:
-                    if len(rows) >= geo.DATA_SHARDS:
+                    if len(rows) >= ecv.k:
                         break
                     data = self.remote_shard_reader(
                         ecv.vid, sid, offset, size)
                     if data is not None:
                         rows[sid] = np.frombuffer(data, dtype=np.uint8)
-        if len(rows) < geo.DATA_SHARDS:
+        if len(rows) < ecv.k:
             raise IOError(
                 f"cannot reconstruct shard {missing_sid} of volume "
                 f"{ecv.vid}: only {len(rows)} shards reachable")
-        rec = self._rs.reconstruct(rows, [missing_sid])
+        rec = self._rs_for(ecv).reconstruct(rows, [missing_sid])
         return rec[missing_sid].tobytes()
+
+    def _rs_for(self, ecv: EcVolume) -> ReedSolomon:
+        """Per-codec ReedSolomon, cached — wide-code volumes carry their
+        own (k, m) from the .vif sidecar."""
+        if (ecv.k, ecv.m) == (geo.DATA_SHARDS, geo.PARITY_SHARDS):
+            return self._rs
+        cache = getattr(self, "_rs_cache", None)
+        if cache is None:
+            cache = self._rs_cache = {}
+        rs = cache.get((ecv.k, ecv.m))
+        if rs is None:
+            rs = cache[(ecv.k, ecv.m)] = ReedSolomon(
+                ecv.k, ecv.m, backend=self.ec_backend)
+        return rs
 
     # -- heartbeat -------------------------------------------------------
     def collect_heartbeat(self) -> dict:
@@ -370,7 +385,10 @@ class Store:
                 })
         ec_shards = [
             {"id": vid, "collection": ecv.collection,
-             "shard_bits": ecv.shard_bits().bits}
+             "shard_bits": ecv.shard_bits().bits,
+             "codec": geo.codec_name(ecv.k, ecv.m)
+             if (ecv.k, ecv.m) != (geo.DATA_SHARDS, geo.PARITY_SHARDS)
+             else ""}
             for vid, ecv in self.ec_volumes.items()
         ]
         return {

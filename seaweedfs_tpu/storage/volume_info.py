@@ -36,6 +36,9 @@ class VolumeInfo:
     version: int = 3
     replication: str = ""
     files: list[RemoteFile] = field(default_factory=list)
+    # EC codec of this volume's shard set, "k.m" (empty = RS(10,4)
+    # default). Beyond-reference: wide codes for cold collections.
+    ec_codec: str = ""
 
     def remote_file(self) -> RemoteFile | None:
         return self.files[0] if self.files else None
@@ -57,4 +60,5 @@ def maybe_load_volume_info(path: str) -> VolumeInfo | None:
     return VolumeInfo(
         version=raw.get("version", 3),
         replication=raw.get("replication", ""),
-        files=[RemoteFile(**rf) for rf in raw.get("files", [])])
+        files=[RemoteFile(**rf) for rf in raw.get("files", [])],
+        ec_codec=raw.get("ec_codec", ""))
