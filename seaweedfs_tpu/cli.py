@@ -105,7 +105,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-rack", default="DefaultRack")
     p.add_argument("-ec.backend", dest="ec_backend", default="auto")
     p.add_argument("-index", default="memory",
-                   help="needle map kind: memory | compact")
+                   help="needle map kind: memory | compact | btree "
+                        "(on-disk index for RAM-constrained servers)")
     p.add_argument("-disk", default="hdd",
                    help="disk class of this server (hdd | ssd)")
     p.add_argument("-concurrentUploadLimitMB", dest="upload_limit_mb",
@@ -129,7 +130,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="auto")
     p.add_argument("-index", default="memory",
-                   help="needle map kind: memory | compact")
+                   help="needle map kind: memory | compact | btree "
+                        "(on-disk index for RAM-constrained servers)")
 
     p = sub.add_parser("filer", help="start a filer server")
     p.add_argument("-port", type=int, default=8888)

@@ -977,6 +977,15 @@ class VolumeServer:
                         params={"volume": vid, "collection": collection,
                                 "ext": ext}) as resp:
                     if resp.status == 404 and ext in (".ecj", ".vif"):
+                        if ext == ".vif":
+                            # source has no codec sidecar (default
+                            # RS(10,4)): a stale local one from an
+                            # earlier wide-code volume would poison
+                            # this shard set's geometry
+                            try:
+                                os.unlink(base + ext)
+                            except FileNotFoundError:
+                                pass
                         continue
                     if resp.status != 200:
                         return web.json_response(

@@ -136,6 +136,14 @@ class DiskLocation:
                 pass
         if not entry.shard_ids:
             self.ec_shards.pop(vid, None)
+            # drop the codec sidecar with the last shard — unless a
+            # normal volume still owns the base (its tiering record
+            # lives in the same .vif)
+            if not os.path.exists(base + ".dat"):
+                try:
+                    os.remove(base + ".vif")
+                except FileNotFoundError:
+                    pass
             for ext in (".ecx", ".ecj"):
                 try:
                     os.remove(base + ext)

@@ -30,13 +30,11 @@ else:
 assert _RAW_DTYPE.itemsize == t.NEEDLE_MAP_ENTRY_SIZE
 
 
-def read_index(path: str) -> np.ndarray:
-    """Whole index file -> structured array (key, offset, size-u32)."""
-    size = os.path.getsize(path)
-    usable = (size // t.NEEDLE_MAP_ENTRY_SIZE) * t.NEEDLE_MAP_ENTRY_SIZE
-    with open(path, "rb") as f:
-        buf = f.read(usable)
-    raw = np.frombuffer(buf, dtype=_RAW_DTYPE)
+def parse_index_bytes(buf: bytes) -> np.ndarray:
+    """Raw index bytes -> structured array (key, offset, size-u32)."""
+    usable = (len(buf) // t.NEEDLE_MAP_ENTRY_SIZE) * \
+        t.NEEDLE_MAP_ENTRY_SIZE
+    raw = np.frombuffer(buf[:usable], dtype=_RAW_DTYPE)
     if _RAW_DTYPE is IDX_DTYPE:
         return raw
     arr = np.empty(len(raw), dtype=IDX_DTYPE)
@@ -45,6 +43,13 @@ def read_index(path: str) -> np.ndarray:
         raw["off_lo"].astype(np.uint64)
     arr["size"] = raw["size"]
     return arr
+
+
+def read_index(path: str) -> np.ndarray:
+    """Whole index file -> structured array (key, offset, size-u32)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    return parse_index_bytes(buf)
 
 
 def write_index(path: str, entries: np.ndarray) -> None:

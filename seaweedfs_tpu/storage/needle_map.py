@@ -89,12 +89,18 @@ class NeedleMap:
                 yield k
 
 
-def new_needle_map(kind: str = "memory"):
+def new_needle_map(kind: str = "memory", idx_path: str = ""):
     """Fresh, empty map of the configured strategy — rebuild paths must
     honor the kind too, or a compact-configured node falls back to the
     dict map's ~6x memory after crash recovery."""
     if kind == "compact":
         return CompactNeedleMap()
+    if kind == "btree":
+        if not idx_path:
+            raise ValueError("btree needle map needs the idx path")
+        nm = BtreeNeedleMap(idx_path)
+        nm.clear()
+        return nm
     if kind != "memory":
         raise ValueError(f"unknown needle map kind {kind!r}")
     return NeedleMap()
@@ -104,10 +110,13 @@ def load_needle_map(idx_path: str, kind: str = "memory"):
     """Replay an .idx log into a live map (needle_map_memory.go
     LoadCompactNeedleMap equivalent): later entries win; tombstones
     (size<0 or offset==0&&size==0 per reference semantics) delete.
-    kind selects the strategy: "memory" (dict) or "compact" (sorted
-    numpy array, needle_map_kind in store.go:57)."""
+    kind selects the strategy: "memory" (dict), "compact" (sorted
+    numpy array, needle_map_kind in store.go:57), or "btree" (on-disk
+    sqlite sidecar — the reference's -index=leveldb analog)."""
     if kind == "compact":
         return load_compact_needle_map(idx_path)
+    if kind == "btree":
+        return load_btree_needle_map(idx_path)
     if kind != "memory":
         raise ValueError(f"unknown needle map kind {kind!r}")
     nm = new_needle_map(kind)
@@ -336,3 +345,243 @@ def load_compact_needle_map(idx_path: str) -> CompactNeedleMap:
     nm.deleted_bytes = int(shadowed_live.sum())
     nm.max_key = int(nm._keys[-1]) if len(nm._keys) else 0
     return nm
+
+
+class BtreeNeedleMap:
+    """On-disk needle index: the reference's third strategy
+    (needle_map_leveldb.go, `-index=leveldb`) for servers whose needle
+    maps don't fit RAM. sqlite's B-tree plays the leveldb role — O(log
+    n) key probes with O(1) resident memory; only the map METRICS
+    (file/deleted counts and bytes, mapMetric) live in RAM.
+
+    Startup rides a watermark like the reference's
+    (needle_map_leveldb.go:70 levelDbWrite watermark): the sidecar
+    remembers how many .idx bytes it reflects; reopening replays only
+    the .idx TAIL past the watermark (later-wins, idempotent), and a
+    truncated .idx (vacuum commit) triggers a full rebuild.
+    """
+
+    COMMIT_EVERY = 4096  # puts per transaction (per-put fsync is ~1ms)
+
+    def __init__(self, idx_path: str):
+        import sqlite3
+
+        self.db_path = idx_path + ".bdb"
+        self._db = sqlite3.connect(self.db_path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=OFF")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS needles ("
+            "key INTEGER PRIMARY KEY, offset INTEGER, size INTEGER)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v)")
+        self._lock = __import__("threading").RLock()
+        self._dirty = 0
+        self.file_count = 0
+        self.deleted_count = 0
+        self.file_bytes = 0
+        self.deleted_bytes = 0
+        self.max_key = 0
+        self._load_metrics()
+
+    # -- metrics persistence (mapMetric analog) -------------------------
+    METRIC_KEYS = ("file_count", "deleted_count", "file_bytes",
+                   "deleted_bytes", "max_key")
+
+    def _load_metrics(self) -> None:
+        rows = dict(self._db.execute("SELECT k, v FROM meta"))
+        for k in self.METRIC_KEYS:
+            setattr(self, k, int(rows.get(k, 0)))
+
+    def _save_metrics(self) -> None:
+        self._db.executemany(
+            "INSERT OR REPLACE INTO meta (k, v) VALUES (?, ?)",
+            [(k, getattr(self, k)) for k in self.METRIC_KEYS])
+
+    def watermark(self) -> int:
+        row = self._db.execute(
+            "SELECT v FROM meta WHERE k='idx_bytes'").fetchone()
+        return int(row[0]) if row else 0
+
+    def set_watermark(self, idx_bytes: int) -> None:
+        with self._lock:
+            self._save_metrics()
+            self._db.execute(
+                "INSERT OR REPLACE INTO meta (k, v) VALUES "
+                "('idx_bytes', ?)", (idx_bytes,))
+            self._db.commit()
+            self._dirty = 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM needles")
+            self._db.execute("DELETE FROM meta")
+            for k in self.METRIC_KEYS:
+                setattr(self, k, 0)
+            self._db.commit()
+
+    # -- signed-size storage: rows keep tombstones (size<0) so the
+    # deleted-keys census works without the .idx
+    def _lookup(self, key: int) -> tuple[int, int] | None:
+        row = self._db.execute(
+            "SELECT offset, size FROM needles WHERE key=?",
+            (key,)).fetchone()
+        return (int(row[0]), int(row[1])) if row else None
+
+    def __len__(self) -> int:
+        return int(self._db.execute(
+            "SELECT COUNT(*) FROM needles").fetchone()[0])
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        with self._lock:
+            v = self._lookup(key)
+        if v is None or t.size_is_deleted(v[1]):
+            return None
+        return v
+
+    def _bump(self) -> None:
+        self._dirty += 1
+        if self._dirty >= self.COMMIT_EVERY:
+            self._db.commit()
+            self._dirty = 0
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            old = self._lookup(key)
+            if old == (offset, size):
+                # identical row: watermark-tail replay after a crash
+                # re-applies committed puts — counting them as
+                # overwrites would inflate deleted_count/bytes
+                return
+            if old is not None and t.size_is_valid(old[1]):
+                self.deleted_count += 1
+                self.deleted_bytes += old[1]
+                self.file_count -= 1
+                self.file_bytes -= old[1]
+            self._db.execute(
+                "INSERT OR REPLACE INTO needles (key, offset, size) "
+                "VALUES (?, ?, ?)", (key, offset, size))
+            if t.size_is_valid(size):
+                self.file_count += 1
+                self.file_bytes += size
+            self.max_key = max(self.max_key, key)
+            self._bump()
+
+    def delete(self, key: int) -> int:
+        with self._lock:
+            old = self._lookup(key)
+            if old is None or not t.size_is_valid(old[1]):
+                return 0
+            self._db.execute(
+                "UPDATE needles SET size=? WHERE key=?",
+                (t.TOMBSTONE_SIZE, key))
+            self.deleted_count += 1
+            self.deleted_bytes += old[1]
+            self.file_count -= 1
+            self.file_bytes -= old[1]
+            self._bump()
+            return old[1]
+
+    def recount_live(self) -> None:
+        """Recompute file_count/file_bytes from the rows (one SQL
+        aggregate, no Python materialization) — used after a tail
+        replay, where interleaved crash windows can drift the
+        incremental counters."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT COUNT(*), COALESCE(SUM(size), 0) FROM needles "
+                "WHERE size >= 0").fetchone()
+            self.file_count, self.file_bytes = int(row[0]), int(row[1])
+            row = self._db.execute(
+                "SELECT COALESCE(MAX(key), 0) FROM needles").fetchone()
+            self.max_key = max(self.max_key, int(row[0]))
+
+    ITEMS_BATCH = 4096
+
+    def items(self) -> Iterator[tuple[int, int, int]]:
+        # keyset pagination, NOT fetchall: this map exists for volumes
+        # whose index doesn't fit RAM — scrub/compact iteration must
+        # stay O(batch) resident
+        with self._lock:
+            self._db.commit()
+        last = -1
+        while True:
+            with self._lock:
+                rows = self._db.execute(
+                    "SELECT key, offset, size FROM needles "
+                    "WHERE key > ? ORDER BY key LIMIT ?",
+                    (last, self.ITEMS_BATCH)).fetchall()
+            if not rows:
+                return
+            for k, off, size in rows:
+                yield int(k), int(off), int(size)
+            last = int(rows[-1][0])
+
+    def live_items(self) -> Iterator[tuple[int, int, int]]:
+        for k, off, size in self.items():
+            if t.size_is_valid(size):
+                yield k, off, size
+
+    def deleted_keys(self) -> Iterator[int]:
+        for k, _off, size in self.items():
+            if t.size_is_deleted(size):
+                yield k
+
+    def sync(self) -> None:
+        with self._lock:
+            self._db.commit()
+            self._dirty = 0
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._save_metrics()
+                self._db.commit()
+                self._db.close()
+            except Exception:
+                pass
+
+
+def load_btree_needle_map(idx_path: str) -> BtreeNeedleMap:
+    """Open the .bdb sidecar and catch up from the .idx log tail past
+    the watermark (full rebuild when the .idx shrank, i.e. a vacuum
+    rewrote it)."""
+    nm = BtreeNeedleMap(idx_path)
+    idx_size = os.path.getsize(idx_path) if os.path.exists(idx_path) \
+        else 0
+    mark = nm.watermark()
+    if mark > idx_size:
+        nm.clear()  # idx rewritten shorter (vacuum commit): rebuild
+        mark = 0
+    if mark < idx_size:
+        entry = t.NEEDLE_MAP_ENTRY_SIZE
+        mark -= mark % entry  # torn tail of a previous run
+        with open(idx_path, "rb") as f:
+            f.seek(mark)
+            blob = f.read(idx_size - mark)
+        arr = idxmod.parse_index_bytes(blob)
+        for rec in arr:
+            key = int(rec["key"])
+            off = int(rec["offset"])
+            size = t.u32_to_size(int(rec["size"]))
+            if off > 0 and t.size_is_valid(size):
+                nm.put(key, off, size)
+            else:
+                nm.delete(key)
+        # replay over already-committed rows can drift the incremental
+        # live counters; one aggregate fixes them exactly
+        nm.recount_live()
+    nm.set_watermark(idx_size)
+    return nm
+
+
+def drop_btree_sidecar(idx_path: str) -> None:
+    """Remove the .bdb sidecar (and WAL files) so the next open does a
+    full rebuild — required whenever the .idx is REWRITTEN rather than
+    appended (vacuum commit, index rebuild): the size-only watermark
+    cannot detect same-size reordered content."""
+    for suffix in (".bdb", ".bdb-wal", ".bdb-shm"):
+        try:
+            os.remove(idx_path + suffix)
+        except FileNotFoundError:
+            pass

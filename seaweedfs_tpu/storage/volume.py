@@ -224,7 +224,10 @@ class Volume:
         if self._rebuild_index_native(base):
             return
         self._idx_f.close()
-        self.nm = nmap.new_needle_map(self.needle_map_kind)
+        if hasattr(self.nm, "close"):
+            self.nm.close()
+        self.nm = nmap.new_needle_map(self.needle_map_kind,
+                                      idx_path=base + ".idx")
         with open(base + ".idx", "wb") as idxf:
             offset = self.super_block.block_size
             size = self.dat.size()
@@ -319,6 +322,12 @@ class Volume:
         arr["size"] = np.where(live, sizes.astype(np.int64),
                                t.size_to_u32(t.TOMBSTONE_SIZE))
         idxmod.write_index(base + ".idx", arr)
+        if hasattr(self.nm, "close"):
+            self.nm.close()
+        if self.needle_map_kind == "btree":
+            # the .idx was rewritten wholesale: a stale sidecar with a
+            # coincidentally-equal watermark would serve wrong offsets
+            nmap.drop_btree_sidecar(base + ".idx")
         self.nm = nmap.load_needle_map(base + ".idx",
                                        self.needle_map_kind)
         self._idx_f = open(base + ".idx", "ab")
@@ -617,6 +626,12 @@ class Volume:
                                                 t.TOMBSTONE_SIZE)
             self.dat.close()
             self._idx_f.close()
+            if self.needle_map_kind == "btree":
+                # drop the sidecar BEFORE the .idx swap: a crash in
+                # between leaves no sidecar (full rebuild next open)
+                # instead of a stale one whose size-only watermark
+                # could coincidentally match the rewritten .idx
+                nmap.drop_btree_sidecar(base + ".idx")
             os.replace(cpd, base + ".dat")
             os.replace(cpx, base + ".idx")
             # reopen with the volume's configured local backend so an
@@ -626,6 +641,8 @@ class Volume:
             else:
                 self.dat = bk.DiskFile(base + ".dat")
             self.super_block = self._read_super_block()
+            if hasattr(self.nm, "close"):
+                self.nm.close()
             self.nm = nmap.load_needle_map(base + ".idx",
                                            kind=self.needle_map_kind)
             self._idx_f = open(base + ".idx", "ab")
@@ -634,6 +651,10 @@ class Volume:
         self.dat.sync()
         self._idx_f.flush()
         os.fsync(self._idx_f.fileno())
+        if hasattr(self.nm, "set_watermark"):
+            # btree sidecar: remember how much .idx the committed db
+            # reflects, so reopen replays only the tail past it
+            self.nm.set_watermark(self._idx_f.tell())
 
     def close(self) -> None:
         try:
@@ -641,6 +662,8 @@ class Volume:
         finally:
             self.dat.close()
             self._idx_f.close()
+            if hasattr(self.nm, "close"):
+                self.nm.close()
 
     def destroy(self) -> None:
         remote = self.volume_info.remote_file() if self.volume_info else None
@@ -651,7 +674,16 @@ class Volume:
             except KeyError:
                 pass  # backend no longer configured; leave the object
         base = self.file_name()
-        for ext in (".dat", ".idx", ".vif"):
+        exts = [".dat", ".idx"]
+        # ec.encode deletes the source volume AFTER generating shards:
+        # the .vif now carries the shard set's codec record and must
+        # survive as long as any shard file does
+        from ..ec import geometry as _geo
+
+        if not any(os.path.exists(base + _geo.shard_ext(i))
+                   for i in range(_geo.MAX_SHARD_COUNT)):
+            exts.append(".vif")
+        for ext in exts:
             try:
                 os.remove(base + ext)
             except FileNotFoundError:

@@ -138,6 +138,18 @@ def test_wide_encode_spread_degraded_read(cluster):
         # master learned the codec from the heartbeat
         assert env.ec_codec(vid) == (28, 4)
 
+        # the codec record survives the source-volume delete ON DISK:
+        # a restarted server must re-derive (28, 4), not the default
+        # (round-2 review: Volume.destroy used to unlink the .vif)
+        from seaweedfs_tpu.ec.volume import EcVolume
+
+        srv_ecv = next(s.store.ec_volumes[vid]
+                       for s in cluster.volume_servers
+                       if vid in s.store.ec_volumes)
+        fresh = EcVolume(srv_ecv.dir, srv_ecv.collection, vid)
+        assert (fresh.k, fresh.m) == (28, 4)
+        fresh.close()
+
         # reads through any holder (local + remote shard fetch)
         locs = env.ec_shard_locations(vid)
         holder = locs[0][0]
