@@ -126,6 +126,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
     p.add_argument("-s3", action="store_true")
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    p.add_argument("-s3.config", dest="s3_config", default="",
+                   help="json file with s3 identities")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="auto")
@@ -903,9 +905,15 @@ def _run_server(args) -> int:
         threads.append(ft)
         print(f"filer listening on {ft.url}")
         if args.s3:
+            import json as _json
+
             from .s3.server import S3ApiServer
 
-            s3 = S3ApiServer(ft.url)
+            iam_cfg = None
+            if args.s3_config:
+                with open(args.s3_config) as f:
+                    iam_cfg = _json.load(f)
+            s3 = S3ApiServer(ft.url, iam_config=iam_cfg)
             st = ServerThread(s3.app, host=args.ip,
                               port=args.s3_port).start()
             threads.append(st)

@@ -875,6 +875,14 @@ class S3ApiServer:
         params = {"collection": bucket}
         mime = req.headers.get("Content-Type", "")
         headers = {"Content-Type": mime} if mime else {}
+        # x-amz-meta-* rides the SAME filer create as the chunks
+        # (x-seaweed-ext-*) — a second read-modify-write would race a
+        # concurrent PUT of the same key and strand freed chunks
+        # (SaveAmzMetaData, s3api_object_handlers_put.go)
+        for k, v in req.headers.items():
+            if k.lower().startswith("x-amz-meta-"):
+                name = k.lower()[len("x-amz-meta-"):]
+                headers[f"x-seaweed-ext-s3_meta_{name}"] = v
         resp = await self._filer("POST", self._fpath(bucket, key),
                                  params=params, data=payload,
                                  headers=headers)
@@ -889,7 +897,12 @@ class S3ApiServer:
         # a key that exists only as a directory/prefix is NoSuchKey in
         # S3 — without this, the filer's JSON dir listing would leak
         # out as the object body
-        meta = await self._entry_meta(bucket, key)
+        try:
+            meta = await self._entry_meta(bucket, key)
+        except S3Error:
+            # S3 distinguishes a missing BUCKET from a missing KEY
+            await self._require_bucket(bucket)
+            raise
         if meta.get("mode", 0) & 0o40000:
             raise S3Error(*ERR_NO_SUCH_KEY)
         headers = {}
@@ -912,6 +925,9 @@ class S3ApiServer:
                   "Content-Length"):
             if h in resp.headers:
                 out_headers[h] = resp.headers[h]
+        for k, v in meta.get("extended", {}).items():
+            if k.startswith("s3_meta_"):
+                out_headers[f"x-amz-meta-{k[len('s3_meta_'):]}"] = str(v)
         body = resp.content if req.method == "GET" else b""
         if req.method == "HEAD":
             return web.Response(
@@ -962,33 +978,50 @@ class S3ApiServer:
         if token:
             start_after = urllib.parse.unquote(token)
 
+        # encoding-type=url: keys/prefixes are percent-encoded in the
+        # XML (clients with control chars in keys require it).
+        # Validated BEFORE the walk — a bad argument must not pay a
+        # full bucket traversal first.
+        enc = q.get("encoding-type", "")
+        if enc not in ("", "url"):
+            raise S3Error("InvalidArgument",
+                          f"invalid encoding-type {enc}", 400)
+
+        def _enc(s: str) -> str:
+            return urllib.parse.quote(s, safe="/") if enc == "url" else s
+
         items, truncated = await asyncio.to_thread(
             self._walk_keys, bucket, prefix, delimiter, start_after,
             max_keys)
 
+        from ..filer.entry import entry_size
+
         root = _xml("ListBucketResult")
         root.append(_leaf("Name", bucket))
-        root.append(_leaf("Prefix", prefix))
+        root.append(_leaf("Prefix", _enc(prefix)))
         root.append(_leaf("MaxKeys", max_keys))
         root.append(_leaf("IsTruncated", "true" if truncated else "false"))
+        if enc:
+            root.append(_leaf("EncodingType", enc))
         if delimiter:
-            root.append(_leaf("Delimiter", delimiter))
+            root.append(_leaf("Delimiter", _enc(delimiter)))
         for kind, name, meta in items:
             if kind != "key":
                 continue
             c = ET.Element("Contents")
-            c.append(_leaf("Key", name))
+            c.append(_leaf("Key", _enc(name)))
             c.append(_leaf("LastModified", _iso(meta.get("mtime", 0))))
             etag = meta.get("md5", "")
             c.append(_leaf("ETag", f'"{etag}"'))
-            c.append(_leaf("Size", sum(
-                ch["size"] for ch in meta.get("chunks", []))))
+            # max(offset+size), NOT the chunk-size sum: overlapping
+            # rewrites keep superseded chunks in the list
+            c.append(_leaf("Size", entry_size(meta)))
             c.append(_leaf("StorageClass", "STANDARD"))
             root.append(c)
         for kind, name, _ in items:
             if kind == "prefix":
                 cp = ET.Element("CommonPrefixes")
-                cp.append(_leaf("Prefix", name))
+                cp.append(_leaf("Prefix", _enc(name)))
                 root.append(cp)
         if v2:
             root.append(_leaf("KeyCount", len(items)))
@@ -996,7 +1029,7 @@ class S3ApiServer:
                 root.append(_leaf("NextContinuationToken",
                                   urllib.parse.quote(items[-1][1])))
         elif truncated and items:
-            root.append(_leaf("NextMarker", items[-1][1]))
+            root.append(_leaf("NextMarker", _enc(items[-1][1])))
         return _xml_response(root)
 
     def _walk_keys(self, bucket: str, prefix: str, delimiter: str,

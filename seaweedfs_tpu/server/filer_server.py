@@ -560,10 +560,17 @@ class FilerServer:
         chunks = await asyncio.to_thread(
             maybe_manifestize, _save_manifest, chunks)
 
+        # extended attributes carried on the upload itself (atomic
+        # with the entry create — no read-modify-write race): the S3
+        # gateway ships x-amz-meta-* through these
+        extended = {k[len("x-seaweed-ext-"):]: v
+                    for k, v in req.headers.items()
+                    if k.lower().startswith("x-seaweed-ext-")}
         entry = Entry(full_path=path, mime=mime,
                       ttl_sec=_ttl_seconds(ttl),
                       md5=md5_all.hexdigest(), collection=collection,
-                      replication=replication, chunks=chunks)
+                      replication=replication, chunks=chunks,
+                      extended=extended)
         await asyncio.to_thread(
             self.filer.create_entry, entry, signatures=signatures,
             gc_old_chunks=True)

@@ -545,11 +545,19 @@ class BtreeNeedleMap:
 def load_btree_needle_map(idx_path: str) -> BtreeNeedleMap:
     """Open the .bdb sidecar and catch up from the .idx log tail past
     the watermark (full rebuild when the .idx shrank, i.e. a vacuum
-    rewrote it)."""
-    nm = BtreeNeedleMap(idx_path)
+    rewrote it). A corrupt sidecar (synchronous=OFF allows it after an
+    OS crash) is dropped and rebuilt from the intact .idx, never fatal."""
+    import sqlite3
+
+    try:
+        nm = BtreeNeedleMap(idx_path)
+        mark = nm.watermark()
+    except sqlite3.DatabaseError:
+        drop_btree_sidecar(idx_path)
+        nm = BtreeNeedleMap(idx_path)
+        mark = 0
     idx_size = os.path.getsize(idx_path) if os.path.exists(idx_path) \
         else 0
-    mark = nm.watermark()
     if mark > idx_size:
         nm.clear()  # idx rewritten shorter (vacuum commit): rebuild
         mark = 0
@@ -568,8 +576,32 @@ def load_btree_needle_map(idx_path: str) -> BtreeNeedleMap:
                 nm.put(key, off, size)
             else:
                 nm.delete(key)
-        # replay over already-committed rows can drift the incremental
-        # live counters; one aggregate fixes them exactly
+        # an unclean shutdown means the tail was replayed over rows the
+        # db may already hold: idempotent re-application keeps the ROWS
+        # right but cannot reconstruct overwrite/delete counters (the
+        # original sizes are gone from the rows). The .idx has the full
+        # history — recompute ALL metrics from it exactly, the same way
+        # the compact loader does (garbage_ratio feeds vacuum decisions
+        # and must not drift down).
+        full = idxmod.read_index(idx_path)
+        if len(full):
+            import numpy as np
+
+            keys = full["key"].astype(np.uint64)
+            sizes = full["size"].astype(np.int64)
+            sizes = np.where(sizes >= 0x80000000, sizes - (1 << 32),
+                             sizes)
+            offs = full["offset"].astype(np.uint64)
+            dead = (offs == 0) | (sizes <= 0)
+            sizes = np.where(dead, np.int64(t.TOMBSTONE_SIZE), sizes)
+            order = np.argsort(keys, kind="stable")
+            keys_s, sizes_s = keys[order], sizes[order]
+            keep = np.ones(len(keys_s), dtype=bool)
+            keep[:-1] = keys_s[:-1] != keys_s[1:]
+            shadowed = sizes_s[~keep]
+            shadowed_live = shadowed[shadowed >= 0]
+            nm.deleted_count = int(len(shadowed_live))
+            nm.deleted_bytes = int(shadowed_live.sum())
         nm.recount_live()
     nm.set_watermark(idx_size)
     return nm

@@ -688,3 +688,6 @@ class Volume:
                 os.remove(base + ext)
             except FileNotFoundError:
                 pass
+        # a leftover sidecar would poison a future same-vid volume
+        # copied in from a peer (its watermark could pass the size check)
+        nmap.drop_btree_sidecar(base + ".idx")

