@@ -395,11 +395,6 @@ class CassandraStore(_GatedStore):
     KIND, NEEDS = "cassandra", "cassandra-driver"
 
 
-@register_store("etcd")
-class EtcdStore(_GatedStore):
-    KIND, NEEDS = "etcd", "etcd3"
-
-
 @register_store("tikv")
 class TikvStore(_GatedStore):
     KIND, NEEDS = "tikv", "tikv-client"

@@ -1,0 +1,111 @@
+"""Minimal etcd v3 HTTP gateway double for wire-protocol tests.
+
+Speaks the same /v3/kv/{put,range,deleterange} JSON surface a real
+etcd gateway exposes (base64 keys/values, range_end prefixes, ASCEND
+key sort, limit + more), the way tests/miniredis.py plays the RESP
+server role for the redis store. Single-threaded aiohttp on an
+ephemeral port; state is an in-memory sorted dict.
+"""
+from __future__ import annotations
+
+import base64
+import bisect
+import threading
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class MiniEtcd:
+    def __init__(self):
+        self._kv: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []  # sorted view of _kv's keys
+        self._lock = threading.Lock()
+        self._thread = None
+
+    # -- kv core --------------------------------------------------------
+    def _put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._kv:
+                bisect.insort(self._keys, key)
+            self._kv[key] = value
+
+    def _range(self, key: bytes, range_end: bytes, limit: int):
+        with self._lock:
+            if not range_end:
+                rows = [(key, self._kv[key])] if key in self._kv else []
+                return rows, False
+            lo = bisect.bisect_left(self._keys, key)
+            hi = bisect.bisect_left(self._keys, range_end)
+            span = self._keys[lo:hi]
+            more = bool(limit) and len(span) > limit
+            if limit:
+                span = span[:limit]
+            return [(k, self._kv[k]) for k in span], more
+
+    def _delete(self, key: bytes, range_end: bytes) -> int:
+        with self._lock:
+            if not range_end:
+                if key in self._kv:
+                    del self._kv[key]
+                    self._keys.remove(key)
+                    return 1
+                return 0
+            lo = bisect.bisect_left(self._keys, key)
+            hi = bisect.bisect_left(self._keys, range_end)
+            doomed = self._keys[lo:hi]
+            for k in doomed:
+                del self._kv[k]
+            del self._keys[lo:hi]
+            return len(doomed)
+
+    # -- gateway --------------------------------------------------------
+    def app(self):
+        from aiohttp import web
+
+        async def put(req):
+            d = await req.json()
+            self._put(_unb64(d["key"]), _unb64(d.get("value", "")))
+            return web.json_response({"header": {}})
+
+        async def rng(req):
+            d = await req.json()
+            rows, more = self._range(
+                _unb64(d["key"]), _unb64(d.get("range_end", "")),
+                int(d.get("limit", 0)))
+            return web.json_response({
+                "header": {}, "count": str(len(rows)), "more": more,
+                "kvs": [{"key": _b64(k), "value": _b64(v)}
+                        for k, v in rows]})
+
+        async def deleterange(req):
+            d = await req.json()
+            n = self._delete(_unb64(d["key"]),
+                             _unb64(d.get("range_end", "")))
+            return web.json_response({"header": {},
+                                      "deleted": str(n)})
+
+        app = web.Application()
+        app.add_routes([web.post("/v3/kv/put", put),
+                        web.post("/v3/kv/range", rng),
+                        web.post("/v3/kv/deleterange", deleterange)])
+        return app
+
+    def start(self):
+        from seaweedfs_tpu.rpc.http import ServerThread
+
+        self._thread = ServerThread(self.app()).start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._thread.port
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.stop()

@@ -192,13 +192,15 @@ class TestGatedStores:
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
-        for kind in ("mongodb", "cassandra", "etcd", "tikv", "ydb",
+        for kind in ("mongodb", "cassandra", "tikv", "ydb",
                      "arangodb", "hbase", "elastic"):
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
-        # redis is fully implemented (RESP over a socket): with no
-        # server listening it fails at connect, not at import
+        # redis (RESP over a socket) and etcd (v3 HTTP gateway) are
+        # fully implemented wire protocols: with no server listening
+        # they fail at connect, not at import
         assert "redis" in STORES
+        assert "etcd" in STORES
         with _pytest.raises(OSError):
             make_store("redis", port=1)
