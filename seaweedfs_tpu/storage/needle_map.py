@@ -433,8 +433,16 @@ class BtreeNeedleMap:
             "SELECT COUNT(*) FROM needles").fetchone()[0])
 
     def get(self, key: int) -> tuple[int, int] | None:
-        with self._lock:
-            v = self._lookup(key)
+        import sqlite3
+
+        try:
+            with self._lock:
+                v = self._lookup(key)
+        except sqlite3.ProgrammingError as e:
+            # a vacuum commit closed this map object under a concurrent
+            # unlocked reader; OSError routes the caller into the
+            # locked retry, which re-reads the volume's NEW map
+            raise OSError(f"needle map closed: {e}") from e
         if v is None or t.size_is_deleted(v[1]):
             return None
         return v

@@ -145,6 +145,19 @@ class Volume:
 
     # -- read path -----------------------------------------------------
     def read_needle(self, needle_id: int, cookie: int | None = None) -> ndl.Needle:
+        try:
+            return self._read_needle_once(needle_id, cookie)
+        except (ValueError, OSError, struct.error):
+            # a vacuum commit can swap .dat/.idx under an unlocked
+            # reader (closed file, or stale offsets against the new
+            # file). The commit holds write_lock through the swap, so
+            # one retry serialized behind it reads consistent state;
+            # a repeat failure is real corruption and propagates.
+            with self.write_lock:
+                return self._read_needle_once(needle_id, cookie)
+
+    def _read_needle_once(self, needle_id: int,
+                          cookie: int | None = None) -> ndl.Needle:
         loc = self.nm.get(needle_id)
         if loc is None:
             raise KeyError(f"needle {needle_id} not found")
@@ -267,7 +280,7 @@ class Volume:
             checked += 1
             try:
                 self.read_needle(key)
-            except (ValueError, IOError, KeyError):
+            except (ValueError, IOError, KeyError, struct.error):
                 # A needle legitimately deleted — or a vacuum commit
                 # swapping the .dat mid-read — is not corruption. The
                 # retry must run under write_lock: the commit holds it
@@ -279,7 +292,8 @@ class Volume:
                         continue
                     try:
                         self.read_needle(key)
-                    except (ValueError, IOError, KeyError) as e2:
+                    except (ValueError, IOError, KeyError,
+                            struct.error) as e2:
                         bad.append({"id": key, "error": str(e2)})
         return {"volume": self.vid, "checked": checked, "bad": bad}
 
