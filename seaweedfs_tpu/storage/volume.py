@@ -147,6 +147,8 @@ class Volume:
     def read_needle(self, needle_id: int, cookie: int | None = None) -> ndl.Needle:
         try:
             return self._read_needle_once(needle_id, cookie)
+        except PermissionError:
+            raise  # cookie mismatch is definitive, never retry-worthy
         except (ValueError, OSError, struct.error):
             # a vacuum commit can swap .dat/.idx under an unlocked
             # reader (closed file, or stale offsets against the new
@@ -165,6 +167,12 @@ class Volume:
         offset = t.offset_to_actual(stored_offset)
         blob = self.dat.read_at(ndl.disk_size(size, self.version), offset)
         n = ndl.Needle.from_bytes(blob, self.version)
+        if n.id != needle_id:
+            # a stale offset after a vacuum swap can land on a DIFFERENT
+            # valid record of the same size — without this check the
+            # wrong needle's data would be served silently
+            raise ValueError(
+                f"needle id mismatch: want {needle_id} got {n.id}")
         if n.size != size:
             raise ValueError(
                 f"size mismatch: index {size} vs disk {n.size}")

@@ -14,7 +14,6 @@ import random
 import threading
 import time
 
-import numpy as np
 import pytest
 
 # fixed default so CI runs are reproducible; export RACE_SEED to
@@ -82,9 +81,12 @@ def test_volume_concurrent_ops(tmp_path, nm_kind):
                     acked[key] = data
                 _jitter(rng)
                 if rng.random() < 0.2:
-                    v.delete_needle(key)
+                    # mark deleted BEFORE the delete lands: a reader
+                    # must never observe a failing key it still
+                    # believes is live
                     with acked_lock:
                         deleted.add(key)
+                    v.delete_needle(key)
         return go
 
     def vacuumer(rng):
@@ -179,7 +181,14 @@ def test_dirty_pages_concurrent(tmp_path):
     committed = []  # chunks from EVERY flush, like the entry would hold
     clock = threading.Lock()
 
-    def flusher(rng):
+    writer_done = threading.Event()
+
+    def writer_group(rng):
+        _run_fleet([writer(x) for x in range(LANES)], SEED * 7)
+        writer_done.set()
+        stop.set()
+
+    def flusher_loop(rng):
         while not stop.is_set():
             _jitter(rng, p=0.6)
             out = dp.flush()
@@ -188,7 +197,7 @@ def test_dirty_pages_concurrent(tmp_path):
         with clock:
             committed.extend(dp.flush())
 
-    def overlay_reader(rng):
+    def reader_loop(rng):
         while not stop.is_set():
             lane = rng.randrange(LANES)
             off = rng.randrange(0, SPAN - 600)
@@ -196,21 +205,8 @@ def test_dirty_pages_concurrent(tmp_path):
             dp.read_overlay(lane * SPAN + off, 600, out)
             _jitter(rng, p=0.3)
 
-    threads = [threading.Thread(target=lambda w=writer(x): w(
-        random.Random(SEED * 7 + x))) for x in range(LANES)]
-    aux = [threading.Thread(target=flusher,
-                            args=(random.Random(SEED + 99),)),
-           threading.Thread(target=overlay_reader,
-                            args=(random.Random(SEED + 100),))]
-    for t in aux:
-        t.start()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    stop.set()
-    for t in aux:
-        t.join()
+    _run_fleet([writer_group, flusher_loop, reader_loop], SEED + 200)
+    assert writer_done.is_set()
     committed.extend(dp.flush())
 
     # assemble what the accumulated chunk list says the file is; per
