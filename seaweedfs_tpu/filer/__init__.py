@@ -14,6 +14,7 @@ from .filechunks import (ChunkView, VisibleInterval, compact_file_chunks,
 from .filer import Filer, norm_path
 from . import abstract_sql as _abstract_sql  # registers mysql/postgres
 from . import etcd_store as _etcd_store      # registers etcd (v3 http)
+from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis
 from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,
                          make_store, register_store)

@@ -385,11 +385,6 @@ class _GatedStore(FilerStore):
 # (shared SQL layer; mysql/postgres still need their drivers).
 # The remaining reference store families stay gated placeholders:
 
-@register_store("mongodb")
-class MongodbStore(_GatedStore):
-    KIND, NEEDS = "mongodb", "pymongo"
-
-
 @register_store("cassandra")
 class CassandraStore(_GatedStore):
     KIND, NEEDS = "cassandra", "cassandra-driver"

@@ -1,0 +1,170 @@
+"""Minimal mongod double speaking OP_MSG for wire-protocol tests.
+
+Implements the command subset the mongodb filer store issues — ping,
+createIndexes (accepted, unindexed), update (upsert by q equality),
+find (equality + $gt/$gte/$lt[e] conditions, sort by one key, limit,
+batchSize + getMore cursors), delete (limit 0/1) — the miniredis /
+minietcd role for the OP_MSG wire. Uses the store's own bson_lite
+codec for framing (the codec itself is spec-tested separately).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.filer.bson_lite import OP_MSG, decode_doc, encode_doc
+
+
+def _match(row: dict, q: dict) -> bool:
+    for k, cond in q.items():
+        v = row.get(k)
+        if isinstance(cond, dict):
+            for op, rhs in cond.items():
+                if v is None:
+                    return False
+                if op == "$gt" and not v > rhs:
+                    return False
+                if op == "$gte" and not v >= rhs:
+                    return False
+                if op == "$lt" and not v < rhs:
+                    return False
+                if op == "$lte" and not v <= rhs:
+                    return False
+        elif v != cond:
+            return False
+    return True
+
+
+class MiniMongo:
+    def __init__(self):
+        # db -> collection -> _id -> row
+        self._data: dict[str, dict[str, dict]] = {}
+        self._cursors: dict[int, list[dict]] = {}
+        self._next_cursor = [1]
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self.port = 0
+
+    def _coll(self, db: str, name: str) -> dict:
+        return self._data.setdefault(db, {}).setdefault(name, {})
+
+    def _handle(self, cmd: dict) -> dict:
+        db = cmd.get("$db", "test")
+        with self._lock:
+            if "ping" in cmd or "hello" in cmd or "ismaster" in cmd:
+                return {"ok": 1}
+            if "createIndexes" in cmd:
+                return {"ok": 1, "numIndexesAfter": 2}
+            if "update" in cmd:
+                coll = self._coll(db, cmd["update"])
+                n = 0
+                for u in cmd["updates"]:
+                    hits = [r for r in coll.values()
+                            if _match(r, u["q"])]
+                    if hits:
+                        for r in hits:
+                            r.clear()
+                            r.update(u["u"])
+                            n += 1
+                    elif u.get("upsert"):
+                        coll[u["u"]["_id"]] = dict(u["u"])
+                        n += 1
+                return {"ok": 1, "n": n}
+            if "delete" in cmd:
+                coll = self._coll(db, cmd["delete"])
+                n = 0
+                for d in cmd["deletes"]:
+                    hits = [rid for rid, r in coll.items()
+                            if _match(r, d["q"])]
+                    if d.get("limit") == 1:
+                        hits = hits[:1]
+                    for rid in hits:
+                        del coll[rid]
+                        n += 1
+                return {"ok": 1, "n": n}
+            if "find" in cmd:
+                coll = self._coll(db, cmd["find"])
+                rows = [r for r in coll.values()
+                        if _match(r, cmd.get("filter", {}))]
+                for key, direction in reversed(
+                        list(cmd.get("sort", {}).items())):
+                    rows.sort(key=lambda r: r.get(key),
+                              reverse=direction < 0)
+                limit = cmd.get("limit", 0)
+                if limit:
+                    rows = rows[:limit]
+                batch = cmd.get("batchSize", 101)
+                first, rest = rows[:batch], rows[batch:]
+                cid = 0
+                if rest:
+                    cid = self._next_cursor[0]
+                    self._next_cursor[0] += 1
+                    self._cursors[cid] = rest
+                return {"ok": 1, "cursor": {
+                    "id": cid, "ns": f"{db}.{cmd['find']}",
+                    "firstBatch": first}}
+            if "getMore" in cmd:
+                cid = cmd["getMore"]
+                rows = self._cursors.get(cid, [])
+                batch = cmd.get("batchSize", 101)
+                out, rest = rows[:batch], rows[batch:]
+                if rest:
+                    self._cursors[cid] = rest
+                    nxt = cid
+                else:
+                    self._cursors.pop(cid, None)
+                    nxt = 0
+                return {"ok": 1, "cursor": {
+                    "id": nxt, "ns": f"{db}.{cmd['collection']}",
+                    "nextBatch": out}}
+        return {"ok": 0, "errmsg": f"unsupported command {cmd}"}
+
+    # -- wire loop ------------------------------------------------------
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                head = b""
+                while len(head) < 16:
+                    piece = conn.recv(16 - len(head))
+                    if not piece:
+                        return
+                    head += piece
+                length, rid, _, opcode = struct.unpack("<iiii", head)
+                body = b""
+                while len(body) < length - 16:
+                    piece = conn.recv(length - 16 - len(body))
+                    if not piece:  # half-closed: exit, don't spin
+                        return
+                    body += piece
+                if opcode != OP_MSG or body[4] != 0:
+                    return
+                reply = self._handle(decode_doc(body[5:]))
+                payload = b"\x00\x00\x00\x00\x00" + encode_doc(reply)
+                conn.sendall(struct.pack(
+                    "<iiii", 16 + len(payload), 0, rid, OP_MSG)
+                    + payload)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def start(self) -> "MiniMongo":
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+
+        def loop():
+            while True:
+                try:
+                    conn, _ = self._srv.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=loop, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.close()

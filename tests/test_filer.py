@@ -192,15 +192,16 @@ class TestGatedStores:
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
-        for kind in ("mongodb", "cassandra", "tikv", "ydb",
+        for kind in ("cassandra", "tikv", "ydb",
                      "arangodb", "hbase", "elastic"):
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
-        # redis (RESP over a socket) and etcd (v3 HTTP gateway) are
-        # fully implemented wire protocols: with no server listening
-        # they fail at connect, not at import
+        # redis (RESP over a socket), etcd (v3 HTTP gateway), and
+        # mongodb (OP_MSG/BSON) are fully implemented wire protocols:
+        # with no server listening they fail at connect, not at import
         assert "redis" in STORES
         assert "etcd" in STORES
+        assert "mongodb" in STORES
         with _pytest.raises(OSError):
             make_store("redis", port=1)
