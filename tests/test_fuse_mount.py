@@ -85,7 +85,10 @@ def mounted(tmp_path_factory):
     spawn("filer", "-port", str(fport), "-master", master,
           "-store", "leveldb", "-store.path", str(filerdir / "db"))
     wait_http(f"{filer}/status")
-    mproc = spawn("mount", "-filer", filer, "-dir", str(mnt))
+    # a 1MB dirty cap forces the swap-file spill path under real
+    # kernel IO (the fio-with-verify role of the reference's e2e gate)
+    mproc = spawn("mount", "-filer", filer, "-dir", str(mnt),
+                  "-writeMemoryLimitMB", "1")
     deadline = time.time() + 30
     while time.time() < deadline:
         if os.path.ismount(mnt):
@@ -182,6 +185,51 @@ def test_random_rw_through_kernel(mounted):
                 os.fsync(f.fileno())
     with open(path, "rb") as f:
         assert f.read() == bytes(shadow)
+
+
+def test_random_write_128k_blocks_verified(mounted):
+    """fio randwrite bs=128k with whole-file hash verify (the
+    reference's e2e matrix covers 4k/128k/1m block sizes,
+    .github/workflows/e2e.yml:44-83) — under the 1MB dirty cap this
+    drives the spill path through the real kernel mount."""
+    import hashlib
+    import random
+    rng = random.Random(11)
+    mnt, _ = mounted
+    path = os.path.join(mnt, "rand128k.bin")
+    size = 8 << 20
+    shadow = bytearray(size)
+    with open(path, "wb") as f:
+        f.write(bytes(size))
+    with open(path, "r+b") as f:
+        for _ in range(48):
+            off = rng.randrange(0, (size - (128 << 10)) // 4096) * 4096
+            blk = rng.randbytes(128 << 10)
+            f.seek(off)
+            f.write(blk)
+            shadow[off:off + len(blk)] = blk
+        os.fsync(f.fileno())
+    with open(path, "rb") as f:
+        got = f.read()
+    assert hashlib.sha256(got).hexdigest() == \
+        hashlib.sha256(bytes(shadow)).hexdigest()
+
+
+def test_large_sequential_1m_blocks(mounted):
+    """fio write bs=1m equivalent: sequential large blocks, verified."""
+    import hashlib
+    import random
+    rng = random.Random(12)
+    mnt, _ = mounted
+    path = os.path.join(mnt, "seq1m.bin")
+    h = hashlib.sha256()
+    with open(path, "wb") as f:
+        for _ in range(12):
+            blk = rng.randbytes(1 << 20)
+            f.write(blk)
+            h.update(blk)
+    with open(path, "rb") as f:
+        assert hashlib.sha256(f.read()).hexdigest() == h.hexdigest()
 
 
 def test_symlink_hardlink_truncate(mounted):
