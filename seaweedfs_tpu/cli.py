@@ -141,7 +141,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-store", default="memory",
                    help="metadata store: memory | sqlite | leveldb | "
-                        "redis | mysql | postgres (drivers permitting)")
+                        "redis | etcd | mongodb | mysql | postgres "
+                        "(SQL drivers permitting)")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
     p.add_argument("-store.host", dest="store_host", default="")
     p.add_argument("-store.port", dest="store_port", type=int, default=0)
