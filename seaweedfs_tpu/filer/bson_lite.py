@@ -19,6 +19,12 @@ OP_MSG = 2013
 _req_ids = itertools.count(1)
 
 
+class MongoError(IOError):
+    """Server-side {ok: 0} reply. The connection stays synced (the
+    full reply was read) — callers must not treat this as a transport
+    failure worth a reconnect."""
+
+
 class Int64(int):
     """Force int64 encoding: some wire fields (getMore's cursor id)
     must be BSON type long even when the value fits in 31 bits."""
@@ -118,14 +124,23 @@ class MongoWire:
         header = struct.pack("<iiii", 16 + len(payload), rid, 0, OP_MSG)
         self._sock.sendall(header + payload)
         raw = self._recv_exact(16)
-        length = struct.unpack_from("<i", raw)[0]
+        length, _reply_id, response_to, _op = struct.unpack_from(
+            "<iiii", raw)
+        if response_to != rid:
+            # a stray frame (e.g. the unread reply left behind by an
+            # earlier timeout) must not be attributed to this command;
+            # the connection is desynced beyond recovery
+            self.close()
+            raise IOError(
+                f"mongodb reply desync: responseTo {response_to} "
+                f"!= requestID {rid}")
         body = self._recv_exact(length - 16)
         # flagBits:4 then kind byte then the reply document
         if body[4] != 0:
             raise IOError("unexpected OP_MSG section kind")
         reply = decode_doc(body[5:])
         if reply.get("ok") != 1:  # 1 == 1.0 covers the double form
-            raise IOError(f"mongodb error: {reply}")
+            raise MongoError(f"mongodb error: {reply}")
         return reply
 
     def _recv_exact(self, n: int) -> bytes:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 import threading
 
-from .bson_lite import Int64, MongoWire
+from .bson_lite import Int64, MongoError, MongoWire
 from .entry import Entry
 from .filerstore import FilerStore, _norm, _split, register_store
 
@@ -35,7 +35,8 @@ class MongodbStore(FilerStore):
     def __init__(self, host: str = "127.0.0.1", port: int = 27017,
                  database: str = "seaweedfs", **_):
         self.db = database
-        self._wire = MongoWire(host, int(port))
+        self._host, self._port = host, int(port)
+        self._wire = MongoWire(self._host, self._port)
         self._lock = threading.Lock()  # one socket, serialized cmds
         # fail fast like the reference's initial ping
         self._cmd({"ping": 1})
@@ -51,7 +52,23 @@ class MongodbStore(FilerStore):
         doc = dict(doc)
         doc["$db"] = self.db
         with self._lock:
-            return self._wire.command(doc)
+            try:
+                return self._wire.command(doc)
+            except MongoError:
+                raise  # server-side error; connection still synced
+            except (IOError, OSError):
+                # transport failure: the wire closes itself on
+                # timeout/desync (an unread reply would be
+                # mis-attributed); reconnect so a single slow query
+                # doesn't wedge the store forever. Retry only
+                # IDEMPOTENT commands — a getMore consumes the cursor
+                # server-side, so re-sending it after a lost reply
+                # would silently skip a whole batch.
+                self._wire.close()
+                self._wire = MongoWire(self._host, self._port)
+                if "getMore" in doc:
+                    raise
+                return self._wire.command(doc)
 
     # -- entries --------------------------------------------------------
     @staticmethod

@@ -176,6 +176,12 @@ class RaftNode:
         self.log: list[LogEntry] = []
         self.snap_index = 0  # last log index folded into the snapshot
         self.snap_term = 0
+        # FSM state frozen AT snap_index. The live fsm can be ahead of
+        # snap_index (entries applied but not yet compacted), and a
+        # receiver re-applies (snap_index, …] after adopting a snapshot
+        # — shipping live state would double-apply those entries for
+        # any non-idempotent FSM command.
+        self.snap_fsm: dict = {}
         self.compact_threshold = compact_threshold
 
         # volatile
@@ -221,7 +227,7 @@ class RaftNode:
                        "peers": self.peers,
                        "snapshot": {"index": self.snap_index,
                                     "term": self.snap_term,
-                                    "fsm": self.fsm.to_dict()},
+                                    "fsm": self.snap_fsm},
                        "log": [e.to_json() for e in self.log]}, f)
         os.replace(tmp, path)
 
@@ -240,10 +246,11 @@ class RaftNode:
         snap = d.get("snapshot") or {}
         self.snap_index = int(snap.get("index", 0))
         self.snap_term = int(snap.get("term", 0))
+        self.snap_fsm = snap.get("fsm", {}) or {}
         if self.snap_index:
             # restart-from-snapshot: the compacted prefix is already
             # applied state, not replayable entries
-            self.fsm.from_dict(snap.get("fsm", {}))
+            self.fsm.from_dict(self.snap_fsm)
             self.commit_index = self.snap_index
             self.last_applied = self.snap_index
 
@@ -268,15 +275,23 @@ class RaftNode:
         stay exact."""
         if len(self.log) <= self.compact_threshold:
             return
+        if any(idx <= self.last_applied
+               for idx, _term, _fut in self._commit_waiters):
+            # never compact past a pending waiter (its term check needs
+            # the entry), and never cut below last_applied either — the
+            # live FSM can't be rewound to "state as of" an earlier
+            # index. Purely defensive at today's only call site (end of
+            # _apply_committed, where such waiters have just resolved);
+            # guards any future caller.
+            return
         limit = self.last_applied
-        for idx, _term, _fut in self._commit_waiters:
-            limit = min(limit, idx - 1)
         if limit <= self.snap_index:
             return
         cut = limit - self.snap_index
         self.snap_term = self._term_at(limit)
         del self.log[:cut]
         self.snap_index = limit
+        self.snap_fsm = self.fsm.to_dict()  # frozen exactly at limit
         self._persist()
 
     # ------------------------------------------------------------------
@@ -439,7 +454,7 @@ class RaftNode:
             args = {"term": self.current_term, "leader": self.me,
                     "snap_index": self.snap_index,
                     "snap_term": self.snap_term,
-                    "fsm": self.fsm.to_dict(),
+                    "fsm": self.snap_fsm,
                     # full voter set: conf changes compacted into the
                     # snapshot must reach the follower too
                     "voters": self.peers + [self.me]}
@@ -640,7 +655,8 @@ class RaftNode:
         self.log = []
         self.snap_index = snap_index
         self.snap_term = int(args["snap_term"])
-        self.fsm.from_dict(args.get("fsm", {}))
+        self.snap_fsm = args.get("fsm", {}) or {}
+        self.fsm.from_dict(self.snap_fsm)
         voters = args.get("voters")
         if voters:
             # membership changes compacted into the snapshot

@@ -563,7 +563,7 @@ class FilerServer:
         # extended attributes carried on the upload itself (atomic
         # with the entry create — no read-modify-write race): the S3
         # gateway ships x-amz-meta-* through these
-        extended = {k[len("x-seaweed-ext-"):]: v
+        extended = {k.lower()[len("x-seaweed-ext-"):]: v
                     for k, v in req.headers.items()
                     if k.lower().startswith("x-seaweed-ext-")}
         entry = Entry(full_path=path, mime=mime,

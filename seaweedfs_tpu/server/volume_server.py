@@ -311,6 +311,21 @@ class VolumeServer:
             return await self._delete_fid(req, fid, vid, key)
         return web.Response(status=405)
 
+    async def _inline_or_thread(self, v, inline_ok: bool, fn, *args):
+        """Run `fn` inline on the event loop only when it is cheap
+        (caller's `inline_ok`) AND the volume's write_lock is free —
+        a vacuum commit holds it across the .dat/.idx swap (seconds
+        for a btree rebuild), and blocking inline would stall every
+        volume on this server, not just this request. Contended or
+        heavyweight calls take the worker-thread hop."""
+        if inline_ok and v is not None and \
+                v.write_lock.acquire(blocking=False):
+            try:
+                return fn(*args)
+            finally:
+                v.write_lock.release()
+        return await asyncio.to_thread(fn, *args)
+
     async def _read_fid(self, req, vid, key, cookie) -> web.Response:
         start = time.perf_counter()
         if not self.store.has_volume(vid) and \
@@ -329,13 +344,12 @@ class VolumeServer:
             # deadlock outright when the tier bucket lives on this same
             # cluster (s3 gateway -> filer -> this very server)
             v = self.store.find_volume(vid)
-            if v is not None and not getattr(v.dat, "remote", True) and \
-                    self.store.needle_size(vid, key) <= (64 << 10) and \
-                    vid not in self.store.ec_volumes:
-                n = self.store.read_needle(vid, key, cookie)
-            else:
-                n = await asyncio.to_thread(
-                    self.store.read_needle, vid, key, cookie)
+            inline_ok = (
+                v is not None and not getattr(v.dat, "remote", True)
+                and self.store.needle_size(vid, key) <= (64 << 10)
+                and vid not in self.store.ec_volumes)
+            n = await self._inline_or_thread(
+                v, inline_ok, self.store.read_needle, vid, key, cookie)
         except KeyError:
             return web.Response(status=404)
         except PermissionError:
@@ -487,11 +501,10 @@ class VolumeServer:
                 # small appends land in the page cache in ~10us: the
                 # to_thread hop costs more than the write on the 1-core
                 # benchmark; only big bodies leave the event loop
-                if len(n.data) <= (64 << 10):
-                    _, size = self.store.write_needle(vid, n)
-                else:
-                    _, size = await asyncio.to_thread(
-                        self.store.write_needle, vid, n)
+                _, size = await self._inline_or_thread(
+                    self.store.find_volume(vid),
+                    len(n.data) <= (64 << 10),
+                    self.store.write_needle, vid, n)
             except KeyError:
                 return web.Response(status=404)
             except PermissionError as e:

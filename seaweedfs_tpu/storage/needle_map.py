@@ -399,8 +399,11 @@ class BtreeNeedleMap:
             [(k, getattr(self, k)) for k in self.METRIC_KEYS])
 
     def watermark(self) -> int:
-        row = self._db.execute(
-            "SELECT v FROM meta WHERE k='idx_bytes'").fetchone()
+        # sqlite connections are not safe for unsynchronized concurrent
+        # use even with check_same_thread=False
+        with self._lock:
+            row = self._db.execute(
+                "SELECT v FROM meta WHERE k='idx_bytes'").fetchone()
         return int(row[0]) if row else 0
 
     def set_watermark(self, idx_bytes: int) -> None:
@@ -429,8 +432,9 @@ class BtreeNeedleMap:
         return (int(row[0]), int(row[1])) if row else None
 
     def __len__(self) -> int:
-        return int(self._db.execute(
-            "SELECT COUNT(*) FROM needles").fetchone()[0])
+        with self._lock:
+            return int(self._db.execute(
+                "SELECT COUNT(*) FROM needles").fetchone()[0])
 
     def get(self, key: int) -> tuple[int, int] | None:
         import sqlite3
