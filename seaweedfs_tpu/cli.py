@@ -115,6 +115,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-concurrentDownloadLimitMB",
                    dest="download_limit_mb", type=int, default=256,
                    help="limit total in-flight download bytes (0 = off)")
+    p.add_argument("-dataplane", default="auto",
+                   choices=["auto", "native", "python"],
+                   help="object hot-path server: native = C++ epoll "
+                        "front (GET/POST by fid), python = asyncio "
+                        "only, auto = native when the library builds")
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -336,6 +341,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-collection", default="")
 
     p = sub.add_parser("benchmark", help="write/read load generator")
+    p.add_argument("-client", default="python",
+                   choices=["python", "native"],
+                   help="load generator: python threads (requests) or "
+                        "the C++ keep-alive client — use native to "
+                        "measure a native-dataplane server without the "
+                        "client's GIL being the bottleneck")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-n", type=int, default=1000)
     p.add_argument("-size", type=int, default=1024)
@@ -787,12 +798,53 @@ def _run_volume(args) -> int:
                       concurrent_upload_limit=args.upload_limit_mb << 20,
                       concurrent_download_limit=args.download_limit_mb
                       << 20)
-    t = ServerThread(vs.app, host=args.ip, port=args.port).start()
-    store.port = t.port
-    store.public_url = t.address
-    print(f"volume server listening on {t.url}, dirs={dirs}")
+    native_port = _start_volume_front(vs, args, dirs)
+    if native_port is None:
+        t = ServerThread(vs.app, host=args.ip, port=args.port).start()
+        store.port = t.port
+        store.public_url = t.address
+        print(f"volume server listening on {t.url}, dirs={dirs}")
+    else:
+        t = vs._backend_thread
+        store.port = native_port
+        store.public_url = f"{args.ip}:{native_port}"
+        print(f"volume server listening on http://{store.public_url} "
+              f"(native data plane; python backend :{t.port}), "
+              f"dirs={dirs}")
     run_apps_forever([t])
     return 0
+
+
+def _start_volume_front(vs, args, dirs) -> int | None:
+    """Try to put the C++ data plane in front (volume server only).
+    Returns the public port, or None to serve pure-Python."""
+    mode = getattr(args, "dataplane", "auto")
+    if mode == "python":
+        return None
+    from .native import dataplane as dpmod
+    from .rpc.http import ServerThread
+
+    if not dpmod.available():
+        if mode == "native":
+            raise SystemExit("-dataplane=native: g++ / prebuilt "
+                             "libseaweed_dataplane.so not found")
+        return None
+    # build/load the library BEFORE the backend thread starts: once the
+    # backend runs, stopping it would fire _on_cleanup -> store.close(),
+    # leaving nothing servable — so all graceful fallback happens here
+    try:
+        dpmod._load()
+    except Exception as e:
+        if mode == "native":
+            raise
+        print(f"native data plane unavailable ({e}); "
+              "serving pure-Python", file=sys.stderr)
+        return None
+    # past this point failures are fatal, exactly like the pure-Python
+    # server failing to bind its port
+    backend = ServerThread(vs.app, host="127.0.0.1", port=0).start()
+    vs._backend_thread = backend
+    return vs.enable_native(args.port, backend.port, listen_ip=args.ip)
 
 
 def _run_replicate(args) -> int:
@@ -935,6 +987,8 @@ def _run_benchmark(args) -> int:
     from .operation import verbs
 
     n, size, conc = args.n, args.size, args.concurrency
+    if getattr(args, "client", "python") == "native":
+        return _run_benchmark_native(args)
     payload_rng = np.random.default_rng(0)
     payload = payload_rng.bytes(size)
     fids: list[str] = []
@@ -1016,6 +1070,60 @@ def _run_benchmark(args) -> int:
         "read_p50_ms": round(pct(read_lat, 50), 2),
         "read_p99_ms": round(pct(read_lat, 99), 2),
         "errors": err[0],
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _run_benchmark_native(args) -> int:
+    """Benchmark with the C++ load generator: Python only assigns fids
+    (batched) and aggregates; every timed request is native."""
+    import numpy as np
+
+    from .native import dataplane as dpmod
+    from .operation import verbs
+
+    n, size, conc = args.n, args.size, args.concurrency
+    by_url: dict[str, list[str]] = {}
+    left = n
+    while left > 0:
+        batch = min(1000, left)
+        a = verbs.assign(args.master, count=batch,
+                         collection=args.collection)
+        fids = by_url.setdefault(a.url, [])
+        fids.append(a.fid)
+        fids.extend(f"{a.fid}_{i}" for i in range(1, batch))
+        left -= batch
+
+    def run(mode: str) -> tuple[float, list, int, int]:
+        total_wall, lats, errs, count = 0.0, [], 0, 0
+        for url, fids in by_url.items():
+            host, _, port = url.partition(":")
+            wall, lat, err = dpmod.bench(host, int(port), mode, fids,
+                                         size, conc)
+            total_wall += wall
+            lats.append(lat[lat > 0])
+            errs += err
+            count += len(fids) - err
+        return total_wall, np.concatenate(lats), errs, count
+
+    wwall, wlat, werr, wcount = run("post")
+    rwall, rlat, rerr, rcount = run("get")
+
+    def pct(lat, p):
+        return float(np.percentile(lat, p)) * 1000 if len(lat) else 0
+
+    out = {
+        "client": "native",
+        "write_rps": round(wcount / wwall, 1),
+        "write_mbps": round(wcount * size / wwall / 1e6, 2),
+        "write_p50_ms": round(pct(wlat, 50), 2),
+        "write_p99_ms": round(pct(wlat, 99), 2),
+        "read_rps": round(rcount / rwall, 1),
+        "read_mbps": round(rcount * size / rwall / 1e6, 2),
+        "read_p50_ms": round(pct(rlat, 50), 2),
+        "read_p99_ms": round(pct(rlat, 99), 2),
+        "errors": werr + rerr,
     }
     print(json.dumps(out, indent=2))
     return 0

@@ -1,4 +1,5 @@
-"""Build the native library: g++ -> libseaweed_native.so.
+"""Build the native libraries: g++ -> libseaweed_native.so (codec) and
+libseaweed_dataplane.so (HTTP data plane).
 
 Run directly (`python seaweedfs_tpu/native/build.py`) or let
 seaweedfs_tpu.native build lazily on first import. No pybind11 — the
@@ -13,25 +14,39 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(HERE, "gf256_codec.cc")
 LIB = os.path.join(HERE, "libseaweed_native.so")
+DP_SRC = os.path.join(HERE, "dataplane.cc")
+DP_LIB = os.path.join(HERE, "libseaweed_dataplane.so")
 
 
-def build(verbose: bool = True) -> str:
-    """Compile if missing or stale; returns the .so path."""
-    if os.path.exists(LIB) and \
-            os.path.getmtime(LIB) >= os.path.getmtime(SRC):
-        return LIB
+def _compile(src: str, lib: str, verbose: bool,
+             extra: list[str] | None = None) -> str:
+    if os.path.exists(lib) and \
+            os.path.getmtime(lib) >= os.path.getmtime(src):
+        return lib
     # compile to a temp name + rename so a concurrent process never
     # dlopens a half-written library
-    tmp = LIB + f".tmp{os.getpid()}"
+    tmp = lib + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-           "-std=c++17", "-o", tmp, SRC]
+           "-std=c++17", "-o", tmp, src] + (extra or [])
     if verbose:
         print("+", " ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)
-    os.replace(tmp, LIB)
-    return LIB
+    os.replace(tmp, lib)
+    return lib
+
+
+def build(verbose: bool = True) -> str:
+    """Compile the codec library if missing or stale; returns its path."""
+    return _compile(SRC, LIB, verbose)
+
+
+def build_dataplane(verbose: bool = True) -> str:
+    """Compile the data-plane library; returns its path."""
+    return _compile(DP_SRC, DP_LIB, verbose, extra=["-pthread"])
 
 
 if __name__ == "__main__":
     build()
     print(LIB)
+    build_dataplane()
+    print(DP_LIB)

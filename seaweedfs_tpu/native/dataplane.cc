@@ -1,0 +1,1467 @@
+// Native volume-server data plane: epoll HTTP front for GET/POST by fid.
+//
+// The reference serves its object hot path from compiled Go
+// (/root/reference/weed/server/volume_server_handlers_read.go:31
+// GetOrHeadHandler, volume_server_handlers_write.go:18 PostHandler,
+// hot loop volume_write.go:144 doWriteRequest); the Python asyncio
+// server tops out ~1k req/s/core on the same path. This library owns
+// the volume server's public port and serves the two hot verbs —
+// GET/HEAD and POST of a plain needle — entirely in C++: pre-parsed
+// fid routing, native needle-map lookup, pread/pwrite on the .dat,
+// CRC32C, zero Python in the loop. Everything else (admin RPCs, EC
+// reads, deletes, range/image requests, replicated or guarded
+// writes) is transparently relayed to the Python aiohttp backend on
+// a loopback port, which stays the control plane.
+//
+// Concurrency model: one epoll IO thread runs the parser and the
+// fast paths; a small pool of proxy workers does blocking relays so
+// a slow admin call (vacuum, EC generate) can never stall the data
+// plane. Python threads call into the same per-volume mutexes via
+// the dp_* C ABI (ctypes), so the needle map has ONE authority —
+// this library — while a volume is attached; detach hands the
+// files back to Python for maintenance (vacuum, EC encode, copy).
+//
+// ABI consumers: seaweedfs_tpu/native/dataplane.py.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
+#include <ctype.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli) — hardware when available, slicing table otherwise.
+// Mirrors needle.py crc32c + legacy_crc_value (needle/crc.go:26-28).
+// ---------------------------------------------------------------------------
+uint32_t crc32c_table[8][256];
+std::once_flag crc_once;
+
+void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+    crc32c_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int t = 1; t < 8; t++)
+      crc32c_table[t][i] =
+          (crc32c_table[t - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[t - 1][i] & 0xFF];
+}
+
+uint32_t crc32c(uint32_t crc, const uint8_t* p, size_t n) {
+  std::call_once(crc_once, crc_init);
+  crc = ~crc;
+#if defined(__SSE4_2__)
+  while (n >= 8) {
+    crc = (uint32_t)_mm_crc32_u64(crc, *(const uint64_t*)p);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = _mm_crc32_u8(crc, *p++);
+#else
+  while (n >= 8) {
+    crc ^= *(const uint32_t*)p;
+    uint32_t hi = *(const uint32_t*)(p + 4);
+    crc = crc32c_table[7][crc & 0xFF] ^ crc32c_table[6][(crc >> 8) & 0xFF] ^
+          crc32c_table[5][(crc >> 16) & 0xFF] ^ crc32c_table[4][crc >> 24] ^
+          crc32c_table[3][hi & 0xFF] ^ crc32c_table[2][(hi >> 8) & 0xFF] ^
+          crc32c_table[1][(hi >> 16) & 0xFF] ^ crc32c_table[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = crc32c_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+#endif
+  return ~crc;
+}
+
+uint32_t legacy_crc_value(uint32_t c) {
+  return (((c >> 15) | (c << 17)) + 0xA282EAD8u);
+}
+
+// ---------------------------------------------------------------------------
+// Needle record constants (needle.py / needle_write.go:20-110 layout)
+// ---------------------------------------------------------------------------
+constexpr int HEADER = 16;  // cookie(4) id(8) size(4), all big-endian
+constexpr int PADDING = 8;
+constexpr int CHECKSUM = 4;
+constexpr int TS = 8;  // append_at_ns, version 3 only
+constexpr uint8_t FLAG_IS_COMPRESSED = 0x01;
+constexpr uint8_t FLAG_HAS_NAME = 0x02;
+constexpr uint8_t FLAG_HAS_MIME = 0x04;
+constexpr uint8_t FLAG_HAS_LAST_MODIFIED = 0x08;
+constexpr uint8_t FLAG_HAS_TTL = 0x10;
+constexpr uint8_t FLAG_HAS_PAIRS = 0x20;
+
+int64_t disk_size(int64_t body, int version) {
+  int64_t total = HEADER + body + CHECKSUM + (version == 3 ? TS : 0);
+  return total + (PADDING - total % PADDING);  // full 8 pad when aligned
+}
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t)p[0] << 24 | (uint32_t)p[1] << 16 | (uint32_t)p[2] << 8 | p[3];
+}
+uint64_t be64(const uint8_t* p) {
+  return (uint64_t)be32(p) << 32 | be32(p + 4);
+}
+void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+void put_be64(uint8_t* p, uint64_t v) {
+  put_be32(p, v >> 32);
+  put_be32(p + 4, (uint32_t)v);
+}
+
+// ---------------------------------------------------------------------------
+// Volume registry
+// ---------------------------------------------------------------------------
+struct MapVal {
+  int64_t offset;  // byte offset in .dat
+  int32_t size;    // body size; <0 = tombstone
+};
+
+struct Vol {
+  std::mutex mu;
+  int dat_fd = -1;
+  int idx_fd = -1;
+  int version = 3;
+  int offset_size = 4;  // index offset width: 4 or 5 bytes
+  bool read_only = false;
+  bool has_replicas = false;
+  int64_t tail = 0;      // .dat append offset
+  int64_t idx_tail = 0;  // .idx append offset
+  uint64_t last_append_ns = 0;
+  // counters mirror needle_map.py NeedleMap accounting exactly
+  int64_t file_count = 0, file_bytes = 0;
+  int64_t deleted_count = 0, deleted_bytes = 0;
+  uint64_t max_key = 0;
+  // set under mu by dp_detach: an op that resolved this Vol just before
+  // the detach must notice and bail instead of appending to files that
+  // Python is about to vacuum/replace
+  bool detached = false;
+  std::unordered_map<uint64_t, MapVal> map;
+
+  ~Vol() {
+    if (dat_fd >= 0) close(dat_fd);
+    if (idx_fd >= 0) close(idx_fd);
+  }
+
+  // put/delete replicate NeedleMap.put/.delete counter semantics
+  void put(uint64_t key, int64_t off, int32_t size) {
+    auto it = map.find(key);
+    if (it != map.end() && it->second.size > 0) {
+      deleted_count++;
+      deleted_bytes += it->second.size;
+      file_count--;
+      file_bytes -= it->second.size;
+    }
+    map[key] = {off, size};
+    if (size > 0) {
+      file_count++;
+      file_bytes += size;
+    }
+    if (key > max_key) max_key = key;
+  }
+
+  int64_t del(uint64_t key) {
+    auto it = map.find(key);
+    if (it == map.end() || it->second.size <= 0) return 0;
+    int64_t reclaimed = it->second.size;
+    it->second.size = -1;
+    deleted_count++;
+    deleted_bytes += reclaimed;
+    file_count--;
+    file_bytes -= reclaimed;
+    return reclaimed;
+  }
+
+  // append one .idx log entry: key(8 BE) offset-units(4|5) size-u32(4 BE)
+  int write_idx(uint64_t key, int64_t byte_off, uint32_t size_u32) {
+    uint8_t e[17];
+    put_be64(e, key);
+    uint64_t units = (uint64_t)(byte_off / PADDING);
+    int n;
+    if (offset_size == 4) {
+      put_be32(e + 8, (uint32_t)units);
+      put_be32(e + 12, size_u32);
+      n = 16;
+    } else {  // 5-byte: 4 BE low bytes then one high byte (offset_5bytes.go)
+      put_be32(e + 8, (uint32_t)(units & 0xFFFFFFFF));
+      e[12] = (uint8_t)(units >> 32);
+      put_be32(e + 13, size_u32);
+      n = 17;
+    }
+    if (pwrite(idx_fd, e, n, idx_tail) != n) return -1;
+    idx_tail += n;
+    return 0;
+  }
+};
+
+std::shared_mutex vols_mu;
+// shared_ptr: a fast-path request may still hold the Vol while a
+// concurrent dp_detach removes it from the registry
+std::unordered_map<uint32_t, std::shared_ptr<Vol>> vols;
+std::atomic<bool> jwt_required{false};
+
+std::shared_ptr<Vol> find_vol(uint32_t vid) {
+  std::shared_lock<std::shared_mutex> lk(vols_mu);
+  auto it = vols.find(vid);
+  return it == vols.end() ? nullptr : it->second;
+}
+
+// request counters, surfaced through dp_http_stats
+std::atomic<int64_t> n_fast_get{0}, n_fast_post{0}, n_proxied{0}, n_errors{0};
+
+// ---------------------------------------------------------------------------
+// HTTP front
+// ---------------------------------------------------------------------------
+struct Request {
+  // views into Conn::in — valid only until the buffer is consumed
+  const char* method = nullptr;
+  size_t method_len = 0;
+  const char* path = nullptr;  // path only, query excluded
+  size_t path_len = 0;
+  bool has_query = false;
+  size_t head_len = 0;   // request line + headers + CRLFCRLF
+  int64_t content_len = 0;
+  bool chunked = false;
+  bool keep_alive = true;
+  bool accept_gzip = false;
+  bool expect_100 = false;
+  bool plain_upload = true;  // content-type empty or octet-stream
+  bool proxy_only = false;   // auth / seaweed-* / range headers present
+};
+
+struct Conn {
+  int fd = -1;
+  std::string in;        // buffered request bytes
+  size_t in_off = 0;     // consumed prefix
+  std::string out;       // pending response bytes
+  size_t out_off = 0;
+  bool want_close = false;
+  bool in_epoll = false;
+  bool sent_100 = false;  // 100-continue sent for the current request
+  time_t last_active = 0;
+  int backend_fd = -1;  // persistent backend conn for this client conn
+};
+
+struct Server {
+  uint16_t backend_port = 0;
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int event_fd = -1;  // wakes the IO thread for returned conns / stop
+  std::atomic<bool> stop{false};
+  std::thread io_thread;
+  std::vector<std::thread> workers;
+  // proxy handoff
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Conn*> proxy_q;
+  std::mutex ret_mu;
+  std::deque<Conn*> returned;
+  std::unordered_map<int, Conn*> conns;
+};
+
+Server* g_srv = nullptr;
+
+void set_nonblock(int fd, bool nb) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
+}
+
+bool ieq(const char* a, size_t alen, const char* b) {
+  size_t blen = strlen(b);
+  if (alen != blen) return false;
+  for (size_t i = 0; i < alen; i++)
+    if (tolower((unsigned char)a[i]) != tolower((unsigned char)b[i])) return false;
+  return true;
+}
+
+bool icontains(const char* s, size_t n, const char* needle) {
+  size_t m = strlen(needle);
+  if (m > n) return false;
+  for (size_t i = 0; i + m <= n; i++) {
+    size_t j = 0;
+    while (j < m && tolower((unsigned char)s[i + j]) == needle[j]) j++;
+    if (j == m) return true;
+  }
+  return false;
+}
+
+// Parse request head out of buf[off..len). Returns head length (>0), 0 if
+// incomplete, -1 on malformed input.
+ssize_t parse_head(const char* buf, size_t len, Request* r) {
+  const char* end = (const char*)memmem(buf, len, "\r\n\r\n", 4);
+  if (!end) return len > (64 << 10) ? -1 : 0;
+  size_t head_len = end - buf + 4;
+  const char* line_end = (const char*)memmem(buf, head_len, "\r\n", 2);
+  if (!line_end) return -1;
+  const char* sp1 = (const char*)memchr(buf, ' ', line_end - buf);
+  if (!sp1) return -1;
+  const char* sp2 = (const char*)memchr(sp1 + 1, ' ', line_end - sp1 - 1);
+  if (!sp2) return -1;
+  r->method = buf;
+  r->method_len = sp1 - buf;
+  const char* target = sp1 + 1;
+  size_t target_len = sp2 - target;
+  const char* q = (const char*)memchr(target, '?', target_len);
+  r->path = target;
+  r->path_len = q ? (size_t)(q - target) : target_len;
+  r->has_query = q != nullptr;
+  r->keep_alive = memmem(line_end - 3, 3, "1.1", 3) != nullptr;
+  r->head_len = head_len;
+  r->content_len = 0;
+  // header scan
+  const char* p = line_end + 2;
+  while (p < buf + head_len - 2) {
+    const char* le = (const char*)memmem(p, buf + head_len - p, "\r\n", 2);
+    if (!le) break;
+    const char* colon = (const char*)memchr(p, ':', le - p);
+    if (colon) {
+      size_t klen = colon - p;
+      const char* v = colon + 1;
+      while (v < le && *v == ' ') v++;
+      size_t vlen = le - v;
+      if (ieq(p, klen, "content-length")) {
+        r->content_len = strtoll(std::string(v, vlen).c_str(), nullptr, 10);
+      } else if (ieq(p, klen, "transfer-encoding")) {
+        if (icontains(v, vlen, "chunked")) r->chunked = true;
+      } else if (ieq(p, klen, "connection")) {
+        if (icontains(v, vlen, "close")) r->keep_alive = false;
+        if (icontains(v, vlen, "keep-alive")) r->keep_alive = true;
+      } else if (ieq(p, klen, "accept-encoding")) {
+        if (icontains(v, vlen, "gzip")) r->accept_gzip = true;
+      } else if (ieq(p, klen, "expect")) {
+        if (icontains(v, vlen, "100-continue")) r->expect_100 = true;
+      } else if (ieq(p, klen, "content-type")) {
+        r->plain_upload =
+            vlen == 0 || icontains(v, vlen, "application/octet-stream");
+      } else if (ieq(p, klen, "authorization") || ieq(p, klen, "range") ||
+                 (klen >= 8 && ieq(p, 8, "seaweed-"))) {
+        r->proxy_only = true;
+      }
+    }
+    p = le + 2;
+  }
+  return (ssize_t)head_len;
+}
+
+// How many body bytes (after the head) does this request carry, given what
+// is buffered? For chunked, returns -1 until the terminating chunk is
+// buffered, then the framed length. `avail` excludes the head.
+int64_t body_len_buffered(const Request& r, const char* body, size_t avail,
+                          bool* complete) {
+  if (!r.chunked) {
+    *complete = (int64_t)avail >= r.content_len;
+    return r.content_len;
+  }
+  // walk chunk frames
+  size_t pos = 0;
+  while (true) {
+    const char* le = (const char*)memmem(body + pos, avail - pos, "\r\n", 2);
+    if (!le) {
+      *complete = false;
+      return -1;
+    }
+    long sz = strtol(std::string(body + pos, le - (body + pos)).c_str(), nullptr, 16);
+    size_t next = (le - body) + 2 + sz + 2;  // chunk data + CRLF
+    if (sz == 0) {
+      // optional trailers until CRLFCRLF; we sent none and accept none
+      *complete = next <= avail;
+      return *complete ? (int64_t)next : -1;
+    }
+    if (next > avail) {
+      *complete = false;
+      return -1;
+    }
+    pos = next;
+  }
+}
+
+// fid path: "/<vid>,<keyhex><cookie8hex>[_delta][.ext]"
+// (types.py parse_file_id / needle.go ParsePath:121-141)
+bool parse_fid_path(const char* p, size_t n, uint32_t* vid, uint64_t* key,
+                    uint32_t* cookie) {
+  if (n < 2 || p[0] != '/') return false;
+  p++;
+  n--;
+  // strip extension
+  const char* dot = (const char*)memchr(p, '.', n);
+  if (dot) n = dot - p;
+  const char* comma = (const char*)memchr(p, ',', n);
+  if (!comma) return false;
+  uint64_t v = 0;
+  for (const char* c = p; c < comma; c++) {
+    if (*c < '0' || *c > '9') return false;
+    v = v * 10 + (*c - '0');
+    if (v > 0xFFFFFFFFull) return false;
+  }
+  const char* rest = comma + 1;
+  size_t rlen = n - (comma + 1 - p);
+  uint64_t delta = 0;
+  const char* us = (const char*)memrchr(rest, '_', rlen);
+  if (us) {
+    for (const char* c = us + 1; c < rest + rlen; c++) {
+      if (*c < '0' || *c > '9') return false;
+      delta = delta * 10 + (*c - '0');
+    }
+    rlen = us - rest;
+  }
+  if (rlen <= 8 || rlen > 24) return false;
+  uint64_t k = 0;
+  for (size_t i = 0; i < rlen - 8; i++) {
+    char c = rest[i];
+    int d = c >= '0' && c <= '9'   ? c - '0'
+            : c >= 'a' && c <= 'f' ? c - 'a' + 10
+            : c >= 'A' && c <= 'F' ? c - 'A' + 10
+                                   : -1;
+    if (d < 0) return false;
+    k = k << 4 | d;
+  }
+  uint32_t ck = 0;
+  for (size_t i = rlen - 8; i < rlen; i++) {
+    char c = rest[i];
+    int d = c >= '0' && c <= '9'   ? c - '0'
+            : c >= 'a' && c <= 'f' ? c - 'a' + 10
+            : c >= 'A' && c <= 'F' ? c - 'A' + 10
+                                   : -1;
+    if (d < 0) return false;
+    ck = ck << 4 | d;
+  }
+  *vid = (uint32_t)v;
+  *key = k + delta;
+  *cookie = ck;
+  return true;
+}
+
+void simple_response(Conn* c, int code, const char* text, bool keep_alive) {
+  const char* reason = code == 200   ? "OK"
+                       : code == 201 ? "Created"
+                       : code == 400 ? "Bad Request"
+                       : code == 403 ? "Forbidden"
+                       : code == 404 ? "Not Found"
+                       : code == 409 ? "Conflict"
+                       : code == 500 ? "Internal Server Error"
+                                     : "Error";
+  char head[256];
+  int body_len = (int)strlen(text);
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 %d %s\r\nContent-Length: %d\r\n"
+                   "Content-Type: text/plain\r\n%s\r\n",
+                   code, reason, body_len,
+                   keep_alive ? "" : "Connection: close\r\n");
+  c->out.append(head, n);
+  c->out.append(text, body_len);
+  if (!keep_alive) c->want_close = true;
+}
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+// GET/HEAD fast path. Returns false when the request must be proxied.
+bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
+                uint32_t cookie, bool is_head) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return false;  // not attached (EC, remote, elsewhere): proxy
+  int64_t off;
+  int32_t size;
+  int version;
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached) return false;
+    auto it = v->map.find(key);
+    if (it == v->map.end() || it->second.size <= 0) {
+      simple_response(c, 404, "", r.keep_alive);
+      return true;
+    }
+    off = it->second.offset;
+    size = it->second.size;
+    version = v->version;
+  }
+  int64_t rec_len = disk_size(size, version);
+  std::string rec;
+  rec.resize(rec_len);
+  ssize_t got = pread(v->dat_fd, &rec[0], rec_len, off);
+  if (got != rec_len) {
+    n_errors++;
+    simple_response(c, 500, "short read", r.keep_alive);
+    return true;
+  }
+  const uint8_t* p = (const uint8_t*)rec.data();
+  uint32_t disk_cookie = be32(p);
+  uint64_t disk_id = be64(p + 4);
+  int32_t disk_size_field = (int32_t)be32(p + 12);
+  if (disk_id != key || disk_size_field != size) {
+    n_errors++;
+    simple_response(c, 500, "needle mismatch", r.keep_alive);
+    return true;
+  }
+  if (disk_cookie != cookie) {
+    simple_response(c, 403, "cookie mismatch", r.keep_alive);
+    return true;
+  }
+  // body: data_size(4) data flags(1) [name] [mime] [lm] [ttl] [pairs]
+  uint32_t data_size = be32(p + HEADER);
+  if ((int64_t)data_size + 5 > size) {
+    n_errors++;
+    simple_response(c, 500, "corrupt needle", r.keep_alive);
+    return true;
+  }
+  const uint8_t* data = p + HEADER + 4;
+  const uint8_t* cur = data + data_size;
+  uint8_t flags = *cur++;
+  if (flags & FLAG_HAS_PAIRS) return false;  // python emits pair headers
+  bool compressed = flags & FLAG_IS_COMPRESSED;
+  if (compressed && !r.accept_gzip) return false;  // python inflates
+  const uint8_t* mime = nullptr;
+  size_t mime_len = 0;
+  const uint8_t* body_end = p + HEADER + size;
+  if (flags & FLAG_HAS_NAME && cur < body_end) cur += 1 + *cur;
+  if (flags & FLAG_HAS_MIME && cur < body_end) {
+    mime_len = *cur++;
+    mime = cur;
+    cur += mime_len;
+  }
+  uint64_t last_modified = 0;
+  if (flags & FLAG_HAS_LAST_MODIFIED && cur + 5 <= body_end) {
+    for (int i = 0; i < 5; i++) last_modified = last_modified << 8 | cur[i];
+    cur += 5;
+  }
+  if (cur > body_end) {
+    n_errors++;
+    simple_response(c, 500, "corrupt needle body", r.keep_alive);
+    return true;
+  }
+  uint32_t stored_crc = be32(p + HEADER + size);
+  uint32_t actual = data_size ? crc32c(0, data, data_size) : 0;
+  if (data_size && stored_crc != actual &&
+      stored_crc != legacy_crc_value(actual)) {
+    n_errors++;
+    simple_response(c, 500, "CRC error: data on disk corrupted", r.keep_alive);
+    return true;
+  }
+  char head[512];
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 200 OK\r\nContent-Length: %u\r\n"
+                   "Content-Type: %.*s\r\nEtag: \"%08x\"\r\n",
+                   data_size,
+                   mime ? (int)mime_len : 24,
+                   mime ? (const char*)mime : "application/octet-stream",
+                   actual);
+  c->out.append(head, n);
+  if (compressed) c->out.append("Content-Encoding: gzip\r\n");
+  if (last_modified) {
+    char datebuf[64];
+    time_t lm = (time_t)last_modified;
+    struct tm tmv;
+    gmtime_r(&lm, &tmv);
+    strftime(datebuf, sizeof datebuf,
+             "Last-Modified: %a, %d %b %Y %H:%M:%S GMT\r\n", &tmv);
+    c->out.append(datebuf);
+  }
+  if (!r.keep_alive) {
+    c->out.append("Connection: close\r\n");
+    c->want_close = true;
+  }
+  c->out.append("\r\n");
+  if (!is_head) c->out.append((const char*)data, data_size);
+  n_fast_get++;
+  return true;
+}
+
+// POST fast path: plain body, no query/auth/metadata, unreplicated
+// writable volume. Mirrors the minimal branch of _write_fid +
+// Volume.append_needle (volume_write.go:144 doWriteRequest).
+bool handle_post(Conn* c, const Request& r, uint32_t vid, uint64_t key,
+                 uint32_t cookie, const uint8_t* body, int64_t body_len) {
+  if (jwt_required.load(std::memory_order_relaxed)) return false;
+  if (r.has_query || r.proxy_only || !r.plain_upload || r.chunked) return false;
+  if (body_len <= 0 || body_len > (8 << 20)) return false;
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return false;
+  if (v->has_replicas) return false;  // python does the replica fan-out
+  // record layout (v2/v3): header, data_size, data, flags, crc[, ts], pad
+  int32_t size = (int32_t)(4 + body_len + 1);
+  int64_t rec_len = disk_size(size, 3);
+  std::string rec;
+  rec.resize(rec_len, '\0');
+  uint8_t* p = (uint8_t*)&rec[0];
+  put_be32(p, cookie);
+  put_be64(p + 4, key);
+  put_be32(p + 12, (uint32_t)size);
+  put_be32(p + 16, (uint32_t)body_len);
+  memcpy(p + 20, body, body_len);
+  p[20 + body_len] = 0;  // flags
+  uint32_t crc = crc32c(0, body, body_len);
+  put_be32(p + 21 + body_len, crc);
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached) return false;
+    if (v->read_only) {
+      simple_response(c, 409, "volume is read only", r.keep_alive);
+      return true;
+    }
+    if (v->version != 3) return false;  // v2 volumes: rare, python path
+    uint64_t ns = now_ns();
+    if (ns <= v->last_append_ns) ns = v->last_append_ns + 1;
+    v->last_append_ns = ns;
+    put_be64(p + 25 + body_len, ns);
+    ssize_t wrote = pwrite(v->dat_fd, rec.data(), rec_len, v->tail);
+    if (wrote != rec_len) {
+      n_errors++;
+      simple_response(c, 500, "write failed", r.keep_alive);
+      return true;
+    }
+    int64_t off = v->tail;
+    v->tail += rec_len;
+    v->put(key, off, size);
+    if (v->write_idx(key, off, (uint32_t)size) != 0) {
+      n_errors++;
+      simple_response(c, 500, "idx write failed", r.keep_alive);
+      return true;
+    }
+  }
+  char resp[256];
+  char jbody[128];
+  int bl = snprintf(jbody, sizeof jbody,
+                    "{\"name\": \"\", \"size\": %lld, \"eTag\": \"%08x\"}",
+                    (long long)body_len, crc);
+  int n = snprintf(resp, sizeof resp,
+                   "HTTP/1.1 201 Created\r\nContent-Length: %d\r\n"
+                   "Content-Type: application/json\r\n%s\r\n",
+                   bl, r.keep_alive ? "" : "Connection: close\r\n");
+  c->out.append(resp, n);
+  c->out.append(jbody, bl);
+  if (!r.keep_alive) c->want_close = true;
+  n_fast_post++;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Proxy relay (blocking, runs on worker threads)
+// ---------------------------------------------------------------------------
+int connect_backend(uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in a = {};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (connect(fd, (struct sockaddr*)&a, sizeof a) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct timeval tv = {300, 0};  // vacuum/EC admin calls can run minutes
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  return fd;
+}
+
+bool send_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+// Relay one already-head-parsed request from client conn to the backend and
+// its response back. Client fd is in BLOCKING mode here. Returns false if
+// either connection must be dropped.
+bool proxy_one(Server* s, Conn* c, const Request& r) {
+  n_proxied++;
+  if (c->backend_fd < 0) c->backend_fd = connect_backend(s->backend_port);
+  if (c->backend_fd < 0) {
+    simple_response(c, 502, "backend unavailable", false);
+    return send_all(c->fd, c->out.data(), c->out.size()), false;
+  }
+  int bfd = c->backend_fd;
+  // 1. forward head + whatever body is buffered
+  const char* req0 = c->in.data() + c->in_off;
+  size_t avail = c->in.size() - c->in_off;
+  bool body_complete = false;
+  int64_t framed = body_len_buffered(r, req0 + r.head_len, avail - r.head_len,
+                                     &body_complete);
+  size_t fwd = body_complete
+                   ? r.head_len + (r.chunked ? framed : (size_t)r.content_len)
+                   : avail;
+  if (!send_all(bfd, req0, fwd)) return false;
+  // 2. stream any remaining request body client->backend
+  int64_t remaining = body_complete ? 0
+                      : r.chunked   ? -1
+                                    : r.content_len - (int64_t)(avail - r.head_len);
+  char buf[64 << 10];
+  std::string tail_acc;  // chunked: scan for terminator across reads
+  if (!body_complete && r.chunked)
+    tail_acc.assign(req0 + r.head_len, avail - r.head_len);
+  while (!body_complete && (remaining > 0 || r.chunked)) {
+    // for content-length bodies, never read past the request: the next
+    // pipelined request's bytes must not leak into this relay
+    size_t want = r.chunked ? sizeof buf
+                            : (size_t)std::min<int64_t>(remaining, sizeof buf);
+    ssize_t got = recv(c->fd, buf, want, 0);
+    if (got <= 0) return false;
+    if (!send_all(bfd, buf, got)) return false;
+    if (r.chunked) {
+      tail_acc.append(buf, got);
+      bool done = false;
+      body_len_buffered(r, tail_acc.data(), tail_acc.size(), &done);
+      if (done) break;
+      if (tail_acc.size() > (1 << 20))  // only the tail matters
+        tail_acc.erase(0, tail_acc.size() - 1024);
+    } else {
+      remaining -= got;
+    }
+  }
+  c->in_off += body_complete
+                   ? fwd
+                   : c->in.size() - c->in_off;  // streamed rest came off the wire
+  // 3. read backend response head
+  std::string resp;
+  size_t resp_head = 0;
+  int64_t resp_cl = -1;
+  bool resp_chunked = false;
+  bool resp_close = false;
+  while (true) {
+    const char* e = (const char*)memmem(resp.data(), resp.size(), "\r\n\r\n", 4);
+    if (e) {
+      resp_head = e - resp.data() + 4;
+      break;
+    }
+    ssize_t got = recv(bfd, buf, sizeof buf, 0);
+    if (got <= 0) return false;
+    resp.append(buf, got);
+    if (resp.size() > (1 << 20)) return false;
+  }
+  // parse response framing headers
+  {
+    const char* p = resp.data();
+    const char* hend = p + resp_head;
+    const char* le = (const char*)memmem(p, resp_head, "\r\n", 2);
+    while (le && le + 2 < hend) {
+      const char* ls = le + 2;
+      const char* ne = (const char*)memmem(ls, hend - ls, "\r\n", 2);
+      if (!ne) break;
+      const char* colon = (const char*)memchr(ls, ':', ne - ls);
+      if (colon) {
+        size_t klen = colon - ls;
+        const char* v = colon + 1;
+        while (v < ne && *v == ' ') v++;
+        size_t vlen = ne - v;
+        if (ieq(ls, klen, "content-length"))
+          resp_cl = strtoll(std::string(v, vlen).c_str(), nullptr, 10);
+        else if (ieq(ls, klen, "transfer-encoding") &&
+                 icontains(v, vlen, "chunked"))
+          resp_chunked = true;
+        else if (ieq(ls, klen, "connection") && icontains(v, vlen, "close"))
+          resp_close = true;
+      }
+      le = ne;
+    }
+  }
+  bool head_only = ieq(r.method, r.method_len, "HEAD");
+  // 4. relay response to client
+  if (!send_all(c->fd, resp.data(), resp.size())) return false;
+  int64_t body_have = resp.size() - resp_head;
+  if (!head_only) {
+    if (resp_chunked) {
+      std::string acc = resp.substr(resp_head);
+      bool done = false;
+      Request fake;
+      fake.chunked = true;
+      body_len_buffered(fake, acc.data(), acc.size(), &done);
+      while (!done) {
+        ssize_t got = recv(bfd, buf, sizeof buf, 0);
+        if (got <= 0) return false;
+        if (!send_all(c->fd, buf, got)) return false;
+        acc.append(buf, got);
+        body_len_buffered(fake, acc.data(), acc.size(), &done);
+        if (acc.size() > (1 << 20)) acc.erase(0, acc.size() - 1024);
+      }
+    } else if (resp_cl >= 0) {
+      int64_t remaining2 = resp_cl - body_have;
+      while (remaining2 > 0) {
+        ssize_t got = recv(bfd, buf,
+                           (size_t)std::min<int64_t>(remaining2, sizeof buf), 0);
+        if (got <= 0) return false;
+        if (!send_all(c->fd, buf, got)) return false;
+        remaining2 -= got;
+      }
+    } else {
+      // no framing: relay until backend closes, then drop the client conn
+      while (true) {
+        ssize_t got = recv(bfd, buf, sizeof buf, 0);
+        if (got < 0) return false;
+        if (got == 0) break;
+        if (!send_all(c->fd, buf, got)) return false;
+      }
+      resp_close = true;
+    }
+  }
+  if (resp_close) {
+    close(c->backend_fd);
+    c->backend_fd = -1;
+  }
+  return r.keep_alive;
+}
+
+// ---------------------------------------------------------------------------
+// IO loop
+// ---------------------------------------------------------------------------
+void close_conn(Server* s, Conn* c) {
+  if (c->in_epoll) epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  s->conns.erase(c->fd);
+  if (c->backend_fd >= 0) close(c->backend_fd);
+  close(c->fd);
+  delete c;
+}
+
+void arm(Server* s, Conn* c, uint32_t events) {
+  struct epoll_event ev = {};
+  ev.events = events;
+  ev.data.ptr = c;
+  if (c->in_epoll) {
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+  } else {
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
+    c->in_epoll = true;
+  }
+}
+
+// Try to serve buffered requests. Returns: 0 keep reading, 1 handed to
+// proxy workers, -1 close.
+int pump(Server* s, Conn* c) {
+  while (true) {
+    if (c->in_off > 0 && c->in_off == c->in.size()) {
+      c->in.clear();
+      c->in_off = 0;
+    }
+    size_t avail = c->in.size() - c->in_off;
+    if (avail == 0) break;
+    Request r;
+    ssize_t hl = parse_head(c->in.data() + c->in_off, avail, &r);
+    if (hl < 0) return -1;
+    if (hl == 0) break;  // need more bytes
+    bool is_get = ieq(r.method, r.method_len, "GET");
+    bool is_head = ieq(r.method, r.method_len, "HEAD");
+    bool is_post =
+        ieq(r.method, r.method_len, "POST") || ieq(r.method, r.method_len, "PUT");
+    uint32_t vid;
+    uint64_t key;
+    uint32_t cookie;
+    bool fid_ok = parse_fid_path(r.path, r.path_len, &vid, &key, &cookie);
+    // GET/HEAD fast path needs no body
+    if ((is_get || is_head) && fid_ok && !r.has_query && !r.proxy_only &&
+        !r.chunked && r.content_len == 0) {
+      if (handle_get(c, r, vid, key, cookie, is_head)) {
+        c->in_off += r.head_len;
+        c->sent_100 = false;
+        continue;
+      }
+      // fall through to proxy
+    } else if (is_post && fid_ok && !r.has_query && !r.proxy_only &&
+               !r.chunked && r.content_len > 0 && r.content_len <= (8 << 20)) {
+      if (r.expect_100 && !c->sent_100 &&
+          avail - r.head_len < (size_t)r.content_len) {
+        // client waits for the go-ahead before sending the body;
+        // send the interim response exactly once per request
+        c->out.append("HTTP/1.1 100 Continue\r\n\r\n");
+        c->sent_100 = true;
+      }
+      if (avail - r.head_len < (size_t)r.content_len) break;  // need body
+      if (handle_post(c, r, vid, key, cookie,
+                      (const uint8_t*)c->in.data() + c->in_off + r.head_len,
+                      r.content_len)) {
+        c->in_off += r.head_len + r.content_len;
+        c->sent_100 = false;
+        continue;
+      }
+      // fall through to proxy
+    }
+    // a proxied request with Expect: 100-continue must get the interim
+    // response from US before the relay blocks waiting for its body —
+    // the backend's own 100 (if any) is relayed too, which clients
+    // tolerate (1xx may repeat)
+    if (r.expect_100 && !c->sent_100) {
+      bool body_done = false;
+      body_len_buffered(r, c->in.data() + c->in_off + r.head_len,
+                        avail - r.head_len, &body_done);
+      if (!body_done) {
+        c->out.append("HTTP/1.1 100 Continue\r\n\r\n");
+        c->sent_100 = true;
+      }
+    }
+    // proxy: hand the whole connection to a worker thread (it is
+    // removed from the conns table too — the worker owns and may
+    // delete it; re-registration happens via the returned queue)
+    if (c->in_epoll) {
+      epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+      c->in_epoll = false;
+    }
+    s->conns.erase(c->fd);
+    // flush anything already queued (fast responses for pipelined reqs)
+    if (c->out.size() > c->out_off) {
+      set_nonblock(c->fd, false);
+      send_all(c->fd, c->out.data() + c->out_off, c->out.size() - c->out_off);
+      c->out.clear();
+      c->out_off = 0;
+    }
+    {
+      std::lock_guard<std::mutex> lk(s->q_mu);
+      s->proxy_q.push_back(c);
+    }
+    s->q_cv.notify_one();
+    return 1;
+  }
+  return 0;
+}
+
+// Returns false when the Conn was closed AND FREED — the caller must
+// not touch `c` again after a false return.
+bool flush_out(Server* s, Conn* c) {
+  while (c->out_off < c->out.size()) {
+    ssize_t w = send(c->fd, c->out.data() + c->out_off,
+                     c->out.size() - c->out_off, MSG_NOSIGNAL);
+    if (w > 0) {
+      c->out_off += w;
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      arm(s, c, EPOLLIN | EPOLLOUT);
+      return true;
+    }
+    close_conn(s, c);
+    return false;
+  }
+  c->out.clear();
+  c->out_off = 0;
+  if (c->want_close) {
+    close_conn(s, c);
+    return false;
+  }
+  arm(s, c, EPOLLIN);
+  return true;
+}
+
+void io_loop(Server* s) {
+  struct epoll_event evs[128];
+  while (!s->stop.load()) {
+    int n = epoll_wait(s->epoll_fd, evs, 128, 1000);
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.ptr == nullptr) {  // listen fd
+        while (true) {
+          int fd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (fd < 0) break;
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          Conn* c = new Conn();
+          c->fd = fd;
+          c->last_active = time(nullptr);
+          s->conns[fd] = c;
+          arm(s, c, EPOLLIN);
+        }
+        continue;
+      }
+      if (evs[i].data.ptr == (void*)s) {  // eventfd: returned conns
+        uint64_t junk;
+        (void)!read(s->event_fd, &junk, 8);
+        std::deque<Conn*> back;
+        {
+          std::lock_guard<std::mutex> lk(s->ret_mu);
+          back.swap(s->returned);
+        }
+        for (Conn* c : back) {
+          s->conns[c->fd] = c;
+          set_nonblock(c->fd, true);
+          int st = pump(s, c);
+          if (st == -1)
+            close_conn(s, c);
+          else if (st == 0)
+            flush_out(s, c);
+          // st == 1: handed off again
+        }
+        continue;
+      }
+      Conn* c = (Conn*)evs[i].data.ptr;
+      c->last_active = time(nullptr);
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!flush_out(s, c)) continue;  // conn freed
+      }
+      if (evs[i].events & EPOLLIN) {
+        char buf[64 << 10];
+        bool closed = false;
+        while (true) {
+          ssize_t got = recv(c->fd, buf, sizeof buf, 0);
+          if (got > 0) {
+            c->in.append(buf, got);
+            if (c->in.size() - c->in_off > (size_t)(280 << 20)) {
+              closed = true;  // runaway request
+              break;
+            }
+            continue;
+          }
+          if (got == 0) closed = true;
+          break;  // EAGAIN or EOF
+        }
+        int st = pump(s, c);
+        if (st == 1) continue;  // handed to proxy worker
+        if (st == -1 || (closed && c->out.size() == c->out_off)) {
+          close_conn(s, c);
+          continue;
+        }
+        flush_out(s, c);
+      }
+    }
+  }
+}
+
+void worker_loop(Server* s) {
+  while (true) {
+    Conn* c;
+    {
+      std::unique_lock<std::mutex> lk(s->q_mu);
+      s->q_cv.wait(lk, [&] { return s->stop.load() || !s->proxy_q.empty(); });
+      if (s->stop.load() && s->proxy_q.empty()) return;
+      c = s->proxy_q.front();
+      s->proxy_q.pop_front();
+    }
+    set_nonblock(c->fd, false);
+    // the head was parsed by the IO thread, parse again here (cheap, and
+    // the Request views must point into this thread's copy of the buffer)
+    Request r;
+    ssize_t hl =
+        parse_head(c->in.data() + c->in_off, c->in.size() - c->in_off, &r);
+    bool ok = hl > 0 && proxy_one(s, c, r);
+    if (!ok) {
+      if (c->backend_fd >= 0) close(c->backend_fd);
+      close(c->fd);
+      delete c;
+      continue;
+    }
+    c->sent_100 = false;
+    {
+      std::lock_guard<std::mutex> lk(s->ret_mu);
+      s->returned.push_back(c);
+    }
+    uint64_t one = 1;
+    (void)!write(s->event_fd, &one, 8);
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+// Start the front server. Returns 0, or -errno. `actual_port` reports
+// the bound port (differs from listen_port when that was 0).
+// `listen_ip` honors the operator's bind address (-ip) exactly like
+// the Python listener; NULL/"" = all interfaces.
+int dp_start(uint16_t listen_port, uint16_t backend_port, int n_proxy_workers,
+             uint16_t* actual_port, const char* listen_ip) {
+  if (g_srv) return -EALREADY;
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (lfd < 0) return -errno;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a = {};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(listen_port);
+  a.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (listen_ip && *listen_ip &&
+      inet_pton(AF_INET, listen_ip, &a.sin_addr) != 1) {
+    close(lfd);
+    return -EINVAL;
+  }
+  if (bind(lfd, (struct sockaddr*)&a, sizeof a) != 0 || listen(lfd, 1024) != 0) {
+    int e = errno;
+    close(lfd);
+    return -e;
+  }
+  if (actual_port) {
+    struct sockaddr_in bound = {};
+    socklen_t blen = sizeof bound;
+    getsockname(lfd, (struct sockaddr*)&bound, &blen);
+    *actual_port = ntohs(bound.sin_port);
+  }
+  Server* s = new Server();
+  s->backend_port = backend_port;
+  s->listen_fd = lfd;
+  s->epoll_fd = epoll_create1(0);
+  s->event_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev = {};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, lfd, &ev);
+  struct epoll_event ev2 = {};
+  ev2.events = EPOLLIN;
+  ev2.data.ptr = (void*)s;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->event_fd, &ev2);
+  g_srv = s;
+  s->io_thread = std::thread(io_loop, s);
+  if (n_proxy_workers < 1) n_proxy_workers = 2;
+  for (int i = 0; i < n_proxy_workers; i++)
+    s->workers.emplace_back(worker_loop, s);
+  return 0;
+}
+
+void dp_stop(void) {
+  Server* s = g_srv;
+  if (!s) return;
+  s->stop.store(true);
+  s->q_cv.notify_all();
+  uint64_t one = 1;
+  (void)!write(s->event_fd, &one, 8);
+  s->io_thread.join();
+  for (auto& w : s->workers) w.join();
+  for (auto& [fd, c] : s->conns) {
+    if (c->backend_fd >= 0) close(c->backend_fd);
+    close(fd);
+    delete c;
+  }
+  for (Conn* c : s->returned) {
+    if (c->backend_fd >= 0) close(c->backend_fd);
+    close(c->fd);
+    delete c;
+  }
+  close(s->listen_fd);
+  close(s->epoll_fd);
+  close(s->event_fd);
+  delete s;
+  g_srv = nullptr;
+  std::unique_lock<std::shared_mutex> lk(vols_mu);
+  vols.clear();
+}
+
+void dp_config(int jwt_req) { jwt_required.store(jwt_req != 0); }
+
+// Attach a volume: open files, replay the index arrays (byte offsets,
+// signed sizes, in .idx file order — load_needle_map semantics).
+int dp_attach(uint32_t vid, const char* dat_path, const char* idx_path,
+              int version, int offset_size, int read_only, int has_replicas,
+              int64_t tail, uint64_t last_append_ns, const uint64_t* keys,
+              const int64_t* byte_offsets, const int32_t* sizes, int64_t n) {
+  auto v = std::make_shared<Vol>();
+  v->dat_fd = open(dat_path, O_RDWR);
+  if (v->dat_fd < 0) return -errno;
+  v->idx_fd = open(idx_path, O_RDWR);
+  if (v->idx_fd < 0) return -errno;
+  struct stat st;
+  fstat(v->idx_fd, &st);
+  v->idx_tail = st.st_size;
+  v->version = version;
+  v->offset_size = offset_size;
+  v->read_only = read_only != 0;
+  v->has_replicas = has_replicas != 0;
+  v->tail = tail;
+  v->last_append_ns = last_append_ns;
+  v->map.reserve((size_t)n * 2);
+  for (int64_t i = 0; i < n; i++) {
+    if (byte_offsets[i] > 0 && sizes[i] > 0)
+      v->put(keys[i], byte_offsets[i], sizes[i]);
+    else
+      v->del(keys[i]);
+  }
+  std::unique_lock<std::shared_mutex> lk(vols_mu);
+  if (vols.count(vid)) return -EEXIST;
+  vols[vid] = std::move(v);
+  return 0;
+}
+
+int dp_detach(uint32_t vid, int64_t* out_tail, uint64_t* out_last_ns) {
+  std::unique_lock<std::shared_mutex> lk(vols_mu);
+  auto it = vols.find(vid);
+  if (it == vols.end()) return -ENOENT;
+  {
+    // taking mu drains in-flight ops; the detached flag turns away any
+    // op that resolved the Vol before the erase but locks after it
+    std::lock_guard<std::mutex> vk(it->second->mu);
+    it->second->detached = true;
+    if (out_tail) *out_tail = it->second->tail;
+    if (out_last_ns) *out_last_ns = it->second->last_append_ns;
+  }
+  vols.erase(it);
+  return 0;
+}
+
+int dp_set_readonly(uint32_t vid, int ro) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  v->read_only = ro != 0;
+  return 0;
+}
+
+int dp_set_replicas(uint32_t vid, int has) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  v->has_replicas = has != 0;
+  return 0;
+}
+
+// Append a pre-built record (Python Volume.append_needle delegated path).
+// Returns the byte offset of the record, or -errno.
+int64_t dp_append(uint32_t vid, const uint8_t* rec, int64_t len, uint64_t key,
+                  int32_t size, uint64_t append_ns) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  if (v->detached) return -ENOENT;
+  if (v->read_only) return -EROFS;
+  if (pwrite(v->dat_fd, rec, len, v->tail) != len) return -EIO;
+  int64_t off = v->tail;
+  v->tail += len;
+  v->put(key, off, size);
+  if (v->write_idx(key, off, (uint32_t)size) != 0) return -EIO;
+  if (append_ns > v->last_append_ns) v->last_append_ns = append_ns;
+  return off;
+}
+
+// Append a tombstone record; returns reclaimed bytes (0 = was absent,
+// tombstone NOT written then — delete_needle semantics), or -errno.
+int64_t dp_delete(uint32_t vid, uint64_t key, const uint8_t* tomb, int64_t len,
+                  uint64_t append_ns) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  if (v->detached) return -ENOENT;
+  if (v->read_only) return -EROFS;
+  auto it = v->map.find(key);
+  if (it == v->map.end() || it->second.size <= 0) return 0;
+  if (pwrite(v->dat_fd, tomb, len, v->tail) != len) return -EIO;
+  v->tail += len;
+  int64_t reclaimed = v->del(key);
+  if (v->write_idx(key, 0, 0xFFFFFFFFu) != 0) return -EIO;
+  if (append_ns > v->last_append_ns) v->last_append_ns = append_ns;
+  return reclaimed;
+}
+
+// Live lookup. Returns 1 hit, 0 miss, -ENOENT no such volume.
+int dp_lookup(uint32_t vid, uint64_t key, int64_t* out_byte_off,
+              int32_t* out_size) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  auto it = v->map.find(key);
+  if (it == v->map.end() || it->second.size <= 0) return 0;
+  *out_byte_off = it->second.offset;
+  *out_size = it->second.size;
+  return 1;
+}
+
+// out[0..8] = file_count, file_bytes, deleted_count, deleted_bytes, tail,
+// last_append_ns, max_key, map_len, read_only
+int dp_stats(uint32_t vid, int64_t* out) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  out[0] = v->file_count;
+  out[1] = v->file_bytes;
+  out[2] = v->deleted_count;
+  out[3] = v->deleted_bytes;
+  out[4] = v->tail;
+  out[5] = (int64_t)v->last_append_ns;
+  out[6] = (int64_t)v->max_key;
+  out[7] = (int64_t)v->map.size();
+  out[8] = v->read_only ? 1 : 0;
+  return 0;
+}
+
+// Dump the whole map (tombstones included, size=-1). Returns count or -errno.
+int64_t dp_export(uint32_t vid, uint64_t* keys, int64_t* byte_offsets,
+                  int32_t* sizes, int64_t cap) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  int64_t n = 0;
+  for (auto& [k, mv] : v->map) {
+    if (n >= cap) return -ENOSPC;
+    keys[n] = k;
+    byte_offsets[n] = mv.offset;
+    sizes[n] = mv.size;
+    n++;
+  }
+  return n;
+}
+
+// out[0..3] = fast gets, fast posts, proxied, errors
+void dp_http_stats(int64_t* out) {
+  out[0] = n_fast_get.load();
+  out[1] = n_fast_post.load();
+  out[2] = n_proxied.load();
+  out[3] = n_errors.load();
+}
+
+// ---------------------------------------------------------------------------
+// Benchmark client (the `weed benchmark` load-generator loop,
+// command/benchmark.go:145 benchWrite / :172 benchRead, as native code —
+// the Python requests client saturates one core at ~1.5k rps and would
+// measure itself, not the server).
+// ---------------------------------------------------------------------------
+
+// mode 0 = GET, 1 = POST `payload_size` random-ish bytes.
+// fids: newline-separated "vid,hex" strings. latencies_ns: one per fid.
+// Returns wall-clock ns for the whole run, or -errno.
+int64_t dp_bench(const char* host, uint16_t port, int mode, const char* fids,
+                 int64_t n_fids, int64_t payload_size, int concurrency,
+                 int64_t* latencies_ns, int64_t* out_errors) {
+  std::vector<std::pair<const char*, size_t>> fid_list;
+  fid_list.reserve(n_fids);
+  const char* p = fids;
+  for (int64_t i = 0; i < n_fids; i++) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) nl = p + strlen(p);
+    fid_list.emplace_back(p, nl - p);
+    if (!*nl) break;
+    p = nl + 1;
+  }
+  std::string payload(payload_size, 'x');
+  for (int64_t i = 0; i < payload_size; i++)
+    payload[i] = (char)('a' + (i * 31 + 7) % 26);
+  std::atomic<int64_t> next{0};
+  std::atomic<int64_t> errors{0};
+  struct sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -EINVAL;
+
+  auto worker = [&]() {
+    int fd = -1;
+    std::string resp;
+    char buf[64 << 10];
+    while (true) {
+      int64_t i = next.fetch_add(1);
+      if (i >= (int64_t)fid_list.size()) break;
+      struct timespec t0, t1;
+      clock_gettime(CLOCK_MONOTONIC, &t0);
+      bool ok = false;
+      for (int attempt = 0; attempt < 2 && !ok; attempt++) {
+        if (fd < 0) {
+          fd = socket(AF_INET, SOCK_STREAM, 0);
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          struct timeval tv = {30, 0};
+          setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+          if (connect(fd, (struct sockaddr*)&addr, sizeof addr) != 0) {
+            close(fd);
+            fd = -1;
+            continue;
+          }
+        }
+        char req[512];
+        int rn;
+        if (mode == 1) {
+          rn = snprintf(req, sizeof req,
+                        "POST /%.*s HTTP/1.1\r\nHost: bench\r\n"
+                        "Content-Type: application/octet-stream\r\n"
+                        "Content-Length: %lld\r\n\r\n",
+                        (int)fid_list[i].second, fid_list[i].first,
+                        (long long)payload_size);
+        } else {
+          rn = snprintf(req, sizeof req,
+                        "GET /%.*s HTTP/1.1\r\nHost: bench\r\n\r\n",
+                        (int)fid_list[i].second, fid_list[i].first);
+        }
+        if (!send_all(fd, req, rn) ||
+            (mode == 1 && !send_all(fd, payload.data(), payload.size()))) {
+          close(fd);
+          fd = -1;
+          continue;
+        }
+        // read response: headers + content-length body
+        resp.clear();
+        ssize_t head_end = -1;
+        int64_t cl = -1;
+        while (true) {
+          if (head_end < 0) {
+            const char* e =
+                (const char*)memmem(resp.data(), resp.size(), "\r\n\r\n", 4);
+            if (e) {
+              head_end = e - resp.data() + 4;
+              const char* clh = (const char*)memmem(
+                  resp.data(), head_end, "Content-Length:", 15);
+              if (!clh)
+                clh = (const char*)memmem(resp.data(), head_end,
+                                          "content-length:", 15);
+              if (clh) cl = strtoll(clh + 15, nullptr, 10);
+            }
+          }
+          if (head_end >= 0 && cl >= 0 &&
+              (int64_t)resp.size() >= head_end + cl)
+            break;
+          ssize_t got = recv(fd, buf, sizeof buf, 0);
+          if (got <= 0) break;
+          resp.append(buf, got);
+        }
+        if (head_end >= 0 && cl >= 0 &&
+            (int64_t)resp.size() >= head_end + cl &&
+            resp.size() > 9 && (resp[9] == '2')) {  // HTTP/1.1 2xx
+          ok = true;
+        } else {
+          close(fd);
+          fd = -1;
+        }
+      }
+      clock_gettime(CLOCK_MONOTONIC, &t1);
+      latencies_ns[i] = (t1.tv_sec - t0.tv_sec) * 1000000000ll +
+                        (t1.tv_nsec - t0.tv_nsec);
+      if (!ok) {
+        errors++;
+        latencies_ns[i] = -latencies_ns[i];  // mark failed
+      }
+    }
+    if (fd >= 0) close(fd);
+  };
+
+  struct timespec w0, w1;
+  clock_gettime(CLOCK_MONOTONIC, &w0);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < concurrency; t++) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+  clock_gettime(CLOCK_MONOTONIC, &w1);
+  if (out_errors) *out_errors = errors.load();
+  return (w1.tv_sec - w0.tv_sec) * 1000000000ll + (w1.tv_nsec - w0.tv_nsec);
+}
+
+}  // extern "C"

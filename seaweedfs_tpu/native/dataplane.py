@@ -1,0 +1,315 @@
+"""ctypes binding for the native HTTP data plane (dataplane.cc).
+
+The C++ front owns the volume server's public port: GET/HEAD and plain
+POST by fid are served natively (reference hot path
+volume_server_handlers_read.go:31 / volume_write.go:144); everything
+else is relayed to the Python aiohttp backend. While a volume is
+attached, the native library is the single authority for its needle
+map and append offsets — Python's Volume delegates mutations here and
+reads counters through NativeNeedleMap.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from ..storage import idx as idxmod
+from ..storage import types as t
+
+_lib = None
+_load_lock = threading.Lock()
+
+
+def available() -> bool:
+    from . import build as _b
+    import shutil
+
+    return os.path.exists(_b.DP_LIB) or shutil.which("g++") is not None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        from . import build as _b
+
+        lib = ctypes.CDLL(_b.build_dataplane(verbose=False))
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.dp_start.argtypes = [ctypes.c_uint16, ctypes.c_uint16,
+                                 ctypes.c_int, u16p, ctypes.c_char_p]
+        lib.dp_start.restype = ctypes.c_int
+        lib.dp_stop.argtypes = []
+        lib.dp_stop.restype = None
+        lib.dp_config.argtypes = [ctypes.c_int]
+        lib.dp_config.restype = None
+        lib.dp_attach.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_uint64, u64p, i64p, i32p, ctypes.c_int64]
+        lib.dp_attach.restype = ctypes.c_int
+        lib.dp_detach.argtypes = [ctypes.c_uint32, i64p, u64p]
+        lib.dp_detach.restype = ctypes.c_int
+        lib.dp_set_readonly.argtypes = [ctypes.c_uint32, ctypes.c_int]
+        lib.dp_set_readonly.restype = ctypes.c_int
+        lib.dp_set_replicas.argtypes = [ctypes.c_uint32, ctypes.c_int]
+        lib.dp_set_replicas.restype = ctypes.c_int
+        lib.dp_append.argtypes = [ctypes.c_uint32, u8p, ctypes.c_int64,
+                                  ctypes.c_uint64, ctypes.c_int32,
+                                  ctypes.c_uint64]
+        lib.dp_append.restype = ctypes.c_int64
+        lib.dp_delete.argtypes = [ctypes.c_uint32, ctypes.c_uint64, u8p,
+                                  ctypes.c_int64, ctypes.c_uint64]
+        lib.dp_delete.restype = ctypes.c_int64
+        lib.dp_lookup.argtypes = [ctypes.c_uint32, ctypes.c_uint64, i64p,
+                                  i32p]
+        lib.dp_lookup.restype = ctypes.c_int
+        lib.dp_stats.argtypes = [ctypes.c_uint32, i64p]
+        lib.dp_stats.restype = ctypes.c_int
+        lib.dp_export.argtypes = [ctypes.c_uint32, u64p, i64p, i32p,
+                                  ctypes.c_int64]
+        lib.dp_export.restype = ctypes.c_int64
+        lib.dp_http_stats.argtypes = [i64p]
+        lib.dp_http_stats.restype = None
+        lib.dp_bench.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                 ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_int64, ctypes.c_int64,
+                                 ctypes.c_int, i64p, i64p]
+        lib.dp_bench.restype = ctypes.c_int64
+        _lib = lib
+        return lib
+
+
+def bench(host: str, port: int, mode: str, fids: list[str],
+          payload_size: int, concurrency: int
+          ) -> tuple[float, np.ndarray, int]:
+    """Native load generator (no server needed on this side): drives
+    GETs/POSTs over keep-alive connections from C++ worker threads.
+    -> (wall seconds, per-request latency seconds — negative entries
+    are failures, error count)."""
+    lib = _load()
+    blob = "\n".join(fids).encode()
+    lats = np.empty(len(fids), np.int64)
+    errs = ctypes.c_int64(0)
+    wall = lib.dp_bench(
+        host.encode(), port, 1 if mode == "post" else 0, blob,
+        len(fids), payload_size, concurrency,
+        lats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(errs))
+    if wall < 0:
+        raise OSError(-wall, os.strerror(-wall))
+    return wall / 1e9, lats.astype(np.float64) / 1e9, int(errs.value)
+
+
+def _u8p(b: bytes):
+    return ctypes.cast(ctypes.c_char_p(b), ctypes.POINTER(ctypes.c_uint8))
+
+
+class DataPlane:
+    """One native front server per process (the C library is a
+    singleton); `attach` hands a volume's hot path to it."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self.port = 0
+        self.backend_port = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, listen_port: int, backend_port: int,
+              workers: int = 2, listen_ip: str = "") -> int:
+        """listen_ip '' = all interfaces; otherwise the -ip bind
+        address, honored exactly like the Python listener."""
+        actual = ctypes.c_uint16(0)
+        rc = self._lib.dp_start(listen_port, backend_port, workers,
+                                ctypes.byref(actual), listen_ip.encode())
+        if rc != 0:
+            raise OSError(-rc, f"dp_start failed: {os.strerror(-rc)}")
+        self.port = int(actual.value)
+        self.backend_port = backend_port
+        return self.port
+
+    def stop(self) -> None:
+        self._lib.dp_stop()
+
+    def config(self, jwt_required: bool) -> None:
+        self._lib.dp_config(1 if jwt_required else 0)
+
+    # -- volumes --------------------------------------------------------
+    def attach(self, vid: int, dat_path: str, idx_path: str, version: int,
+               read_only: bool, has_replicas: bool, tail: int,
+               last_append_ns: int) -> None:
+        """Load the .idx log and hand the volume to the native plane.
+        The index replay (same semantics as load_needle_map) happens in
+        C from the raw entry arrays."""
+        if os.path.exists(idx_path):
+            arr = idxmod.read_index(idx_path)
+            keys = np.ascontiguousarray(arr["key"], dtype=np.uint64)
+            offs = np.ascontiguousarray(
+                arr["offset"].astype(np.int64) * t.NEEDLE_PADDING)
+            sizes = np.ascontiguousarray(
+                arr["size"].astype(np.uint32).view(np.int32))
+        else:
+            keys = np.empty(0, np.uint64)
+            offs = np.empty(0, np.int64)
+            sizes = np.empty(0, np.int32)
+        rc = self._lib.dp_attach(
+            vid, dat_path.encode(), idx_path.encode(), version,
+            t.OFFSET_SIZE, 1 if read_only else 0, 1 if has_replicas else 0,
+            tail, last_append_ns,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(keys))
+        if rc != 0:
+            raise OSError(-rc, f"dp_attach({vid}): {os.strerror(-rc)}")
+
+    def detach(self, vid: int) -> tuple[int, int]:
+        """-> (dat tail offset, last_append_ns) at the detach point."""
+        tail = ctypes.c_int64(0)
+        ns = ctypes.c_uint64(0)
+        rc = self._lib.dp_detach(vid, ctypes.byref(tail), ctypes.byref(ns))
+        if rc != 0:
+            raise OSError(-rc, f"dp_detach({vid}): {os.strerror(-rc)}")
+        return int(tail.value), int(ns.value)
+
+    def set_readonly(self, vid: int, ro: bool) -> None:
+        self._lib.dp_set_readonly(vid, 1 if ro else 0)
+
+    def set_replicas(self, vid: int, has: bool) -> None:
+        self._lib.dp_set_replicas(vid, 1 if has else 0)
+
+    # -- needle ops (Python-side delegation) ----------------------------
+    def append(self, vid: int, rec: bytes, key: int, size: int,
+               append_ns: int) -> int:
+        off = self._lib.dp_append(vid, _u8p(rec), len(rec), key, size,
+                                  append_ns)
+        if off < 0:
+            raise IOError(f"native append vid={vid}: {os.strerror(-off)}")
+        return int(off)
+
+    def delete(self, vid: int, key: int, tomb: bytes,
+               append_ns: int) -> int:
+        r = self._lib.dp_delete(vid, key, _u8p(tomb), len(tomb), append_ns)
+        if r < 0:
+            raise IOError(f"native delete vid={vid}: {os.strerror(-r)}")
+        return int(r)
+
+    def lookup(self, vid: int, key: int) -> tuple[int, int] | None:
+        """-> (byte offset, size) of a live needle, else None."""
+        off = ctypes.c_int64(0)
+        size = ctypes.c_int32(0)
+        rc = self._lib.dp_lookup(vid, key, ctypes.byref(off),
+                                 ctypes.byref(size))
+        if rc == 1:
+            return int(off.value), int(size.value)
+        return None
+
+    def stats(self, vid: int) -> dict:
+        out = (ctypes.c_int64 * 9)()
+        rc = self._lib.dp_stats(vid, out)
+        if rc != 0:
+            raise KeyError(f"volume {vid} not attached")
+        return {"file_count": out[0], "file_bytes": out[1],
+                "deleted_count": out[2], "deleted_bytes": out[3],
+                "tail": out[4], "last_append_ns": out[5],
+                "max_key": out[6], "map_len": out[7],
+                "read_only": bool(out[8])}
+
+    def export(self, vid: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Full map dump incl. tombstones -> (keys u64, byte offsets
+        i64, signed sizes i32)."""
+        cap = max(16, self.stats(vid)["map_len"] + 1024)
+        while True:
+            keys = np.empty(cap, np.uint64)
+            offs = np.empty(cap, np.int64)
+            sizes = np.empty(cap, np.int32)
+            n = self._lib.dp_export(
+                vid, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+            if n == -28:  # ENOSPC: grew between stats and export
+                cap *= 2
+                continue
+            if n < 0:
+                raise KeyError(f"volume {vid} not attached")
+            return keys[:n], offs[:n], sizes[:n]
+
+    def http_stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.dp_http_stats(out)
+        return {"fast_get": out[0], "fast_post": out[1],
+                "proxied": out[2], "errors": out[3]}
+
+
+class NativeNeedleMap:
+    """needle_map interface over an attached volume's native map —
+    get/counters/iteration for the Python control plane; mutations go
+    through Volume's delegated append/delete, never through here."""
+
+    def __init__(self, dp: DataPlane, vid: int):
+        self._dp = dp
+        self._vid = vid
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        hit = self._dp.lookup(self._vid, key)
+        if hit is None:
+            return None
+        byte_off, size = hit
+        return byte_off // t.NEEDLE_PADDING, size
+
+    def __len__(self) -> int:
+        return self._dp.stats(self._vid)["map_len"]
+
+    @property
+    def file_count(self) -> int:
+        return self._dp.stats(self._vid)["file_count"]
+
+    @property
+    def file_bytes(self) -> int:
+        return self._dp.stats(self._vid)["file_bytes"]
+
+    @property
+    def deleted_count(self) -> int:
+        return self._dp.stats(self._vid)["deleted_count"]
+
+    @property
+    def deleted_bytes(self) -> int:
+        return self._dp.stats(self._vid)["deleted_bytes"]
+
+    @property
+    def max_key(self) -> int:
+        return self._dp.stats(self._vid)["max_key"]
+
+    def items(self):
+        keys, offs, sizes = self._dp.export(self._vid)
+        for k, o, s in zip(keys, offs, sizes):
+            yield int(k), int(o) // t.NEEDLE_PADDING, int(s)
+
+    def live_items(self):
+        for k, o, s in self.items():
+            if t.size_is_valid(s):
+                yield k, o, s
+
+    def deleted_keys(self):
+        for k, _o, s in self.items():
+            if t.size_is_deleted(s):
+                yield k
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        raise RuntimeError(
+            "volume is natively attached; mutations must go through "
+            "Volume.append_needle/delete_needle (delegated)")
+
+    delete = put
+
+    def close(self) -> None:
+        pass  # lifetime is the attach window; detach owns cleanup

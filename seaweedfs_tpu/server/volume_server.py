@@ -99,6 +99,9 @@ class VolumeServer:
         self.rack = rack
         self.guard = Guard(jwt_secret)
         self.pulse_seconds = pulse_seconds
+        # native C++ data plane (native/dataplane.cc): set by
+        # enable_native(); None = pure-Python serving
+        self.dp = None
         self._write_sem = asyncio.Semaphore(max_concurrent_writes)
         self._upload_flight = InFlightLimiter(concurrent_upload_limit)
         self._download_flight = InFlightLimiter(concurrent_download_limit)
@@ -177,6 +180,64 @@ class VolumeServer:
         ])
         return app
 
+    # -- native data plane ---------------------------------------------
+    def enable_native(self, public_port: int, backend_port: int,
+                      workers: int = 2,
+                      listen_ip: str = "0.0.0.0") -> int:
+        """Start the C++ HTTP front on `public_port` (0 = ephemeral),
+        proxying non-hot-path requests to the Python app listening on
+        `backend_port`, and attach every eligible volume. Returns the
+        bound public port."""
+        from ..native.dataplane import DataPlane
+
+        dp = DataPlane()
+        port = dp.start(public_port, backend_port, workers,
+                        listen_ip=listen_ip)
+        dp.config(self.guard.enabled)
+        self.dp = dp
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                self._dp_attach(v)
+        return port
+
+    def disable_native(self) -> None:
+        if self.dp is None:
+            return
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                v.detach_native()
+        self.dp.stop()
+        self.dp = None
+
+    def _dp_attach(self, v) -> None:
+        """Attach one volume to the native plane (no-op when the plane
+        is off or the volume isn't a plain local-disk one)."""
+        if self.dp is None or v is None:
+            return
+        try:
+            v.attach_native(self.dp)
+        except OSError as e:
+            glog.warning(f"native attach of volume {v.vid} failed: {e}")
+
+    def _dp_detached(self, vid: int):
+        """Context manager: exclusive Python ownership of a volume for
+        maintenance (vacuum, tier, raw segment application); reattaches
+        on exit if the volume still exists and qualifies."""
+        server = self
+
+        class _Ctx:
+            def __enter__(self):
+                v = server.store.find_volume(vid)
+                if v is not None:
+                    v.detach_native()
+                return v
+
+            def __exit__(self, *exc):
+                server._dp_attach(server.store.find_volume(vid))
+                return False
+
+        return _Ctx()
+
     async def _on_startup(self, app) -> None:
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
 
@@ -210,6 +271,8 @@ class VolumeServer:
         mc = getattr(self, "_ec_master_client", None)
         if mc is not None:
             mc.stop()
+        if self.dp is not None:
+            await asyncio.to_thread(self.disable_native)
         await asyncio.to_thread(self.store.close)
 
     # ------------------------------------------------------------------
@@ -687,6 +750,7 @@ class VolumeServer:
                 bytes(body.get("ttl", (0, 0))))
         except FileExistsError as e:
             return web.json_response({"error": str(e)}, status=409)
+        self._dp_attach(self.store.find_volume(vid))
         self.poke_heartbeat()
         return web.json_response({"volume": vid})
 
@@ -746,6 +810,7 @@ class VolumeServer:
 
         loc.volumes[vid] = await asyncio.to_thread(
             Volume, loc.dir, collection, vid)
+        self._dp_attach(loc.volumes[vid])
         self.poke_heartbeat()
         return web.json_response({"volume": vid})
 
@@ -768,6 +833,7 @@ class VolumeServer:
                 self.store.mount_volume, int(body["volume"]))
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
+        self._dp_attach(self.store.find_volume(int(body["volume"])))
         self.poke_heartbeat()
         return web.json_response({})
 
@@ -866,10 +932,18 @@ class VolumeServer:
 
     async def handle_vacuum_compact(self, req: web.Request) -> web.Response:
         body = await req.json()
-        v = self.store.find_volume(int(body["volume"]))
+        vid = int(body["volume"])
+        v = self.store.find_volume(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
-        await asyncio.to_thread(v.compact)
+
+        def _compact_detached():
+            # vacuum swaps .dat/.idx wholesale: the native plane must
+            # hand the volume back to Python for the duration
+            with self._dp_detached(vid):
+                v.compact()
+
+        await asyncio.to_thread(_compact_detached)
         self.poke_heartbeat()
         return web.json_response({"size": v.content_size()})
 
@@ -929,6 +1003,7 @@ class VolumeServer:
                 v.tier_download, bool(body.get("deleteRemote", True)))
         except (ValueError, KeyError) as e:
             return web.json_response({"error": str(e)}, status=400)
+        self._dp_attach(v)  # local disk again: back onto the fast path
         self.poke_heartbeat()
         return web.json_response({"volume": v.vid,
                                   "size": v.content_size()})
@@ -1054,6 +1129,7 @@ class VolumeServer:
                 from ..storage.volume import Volume
 
                 loc.volumes[vid] = Volume(loc.dir, collection, vid)
+                self._dp_attach(loc.volumes[vid])
         self.poke_heartbeat()
         return web.json_response({"volume": vid})
 
@@ -1219,8 +1295,22 @@ class VolumeServer:
                                      status=404)
         since_ns = int(body.get("since_ns", v.last_append_at_ns))
         idle_timeout = float(body.get("idle_timeout", 3))
-        applied = 0
         buf = bytearray()
+        # raw segment application needs exclusive Python ownership of
+        # the tail (multi-record append + error-path truncate); detach
+        # off the loop (it replays the .idx into a fresh map) and
+        # ALWAYS reattach — the error returns below must not strand the
+        # volume on the slow path
+        await asyncio.to_thread(v.detach_native)
+        try:
+            return await self._tail_receive_stream(
+                req, v, vid, source, since_ns, idle_timeout, buf)
+        finally:
+            await asyncio.to_thread(self._dp_attach, v)
+
+    async def _tail_receive_stream(self, req, v, vid, source, since_ns,
+                                   idle_timeout, buf) -> web.Response:
+        applied = 0
         async with aiohttp.ClientSession() as sess:
             async with sess.get(
                     f"http://{source}/admin/volume_tail",
@@ -1388,7 +1478,10 @@ class VolumeServer:
     # ------------------------------------------------------------------
     async def handle_status(self, req: web.Request) -> web.Response:
         hb = self.store.collect_heartbeat()
-        return web.json_response({"Version": "seaweedfs-tpu", **hb})
+        out = {"Version": "seaweedfs-tpu", **hb}
+        if self.dp is not None:
+            out["native_dataplane"] = self.dp.http_stats()
+        return web.json_response(out)
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
         # disk gauges recomputed at scrape time (the reference keeps

@@ -36,6 +36,11 @@ class Volume:
         self.collection = collection
         self.vid = vid
         self.needle_map_kind = needle_map_kind
+        # native data-plane delegation (native/dataplane.py): while set,
+        # the C++ library is the single authority for this volume's
+        # needle map, .dat tail and .idx log — every mutation below
+        # routes through it instead of touching the files directly
+        self.delegate = None
         self.read_only = False
         self._backend_kind = backend_kind
         # serializes mutations (append/delete/raw-append) against each
@@ -79,6 +84,79 @@ class Volume:
             self.check_integrity()
             self.last_append_at_ns = self._recover_last_append_at_ns()
 
+    # -- native data-plane delegation ----------------------------------
+    @property
+    def read_only(self) -> bool:
+        return self._read_only
+
+    @read_only.setter
+    def read_only(self, value: bool) -> None:
+        self._read_only = bool(value)
+        if self.delegate is not None:
+            self.delegate.set_readonly(self.vid, self._read_only)
+
+    @property
+    def last_append_at_ns(self) -> int:
+        if self.delegate is not None:
+            return self.delegate.stats(self.vid)["last_append_ns"]
+        return self._last_append_at_ns
+
+    @last_append_at_ns.setter
+    def last_append_at_ns(self, value: int) -> None:
+        self._last_append_at_ns = int(value)
+
+    def attach_native(self, dp) -> bool:
+        """Hand the hot path to the native data plane. Only plain local
+        disk volumes qualify — remote/tiered and mmap stay Python.
+        Returns True when attached."""
+        if self.delegate is not None:
+            return True
+        if self._backend_kind != "disk" or not isinstance(
+                self.dat, bk.DiskFile):
+            return False
+        base = self.file_name()
+        with self.write_lock:
+            self.dat.flush()
+            self._idx_f.flush()
+            from ..native.dataplane import NativeNeedleMap
+
+            dp.attach(self.vid, base + ".dat", base + ".idx",
+                      self.version, self.read_only,
+                      self.super_block.replica_placement.copy_count > 1,
+                      self.dat.size(), self._last_append_at_ns)
+            if hasattr(self.nm, "close"):
+                self.nm.close()  # btree: persists its watermark
+            self.nm = NativeNeedleMap(dp, self.vid)
+            self.delegate = dp
+        return True
+
+    def detach_native(self, reload_map: bool = True) -> None:
+        """Take the volume back from the native plane (vacuum, tier,
+        EC, unmount all need exclusive Python ownership)."""
+        if self.delegate is None:
+            return
+        base = self.file_name()
+        with self.write_lock:
+            dp = self.delegate
+            self.delegate = None
+            _tail, last_ns = dp.detach(self.vid)
+            self._last_append_at_ns = max(self._last_append_at_ns,
+                                          last_ns)
+            # reopen the .idx append handle at the true EOF and rebuild
+            # the Python map from the .idx log (the btree sidecar's
+            # watermark catch-up consumes exactly the natively appended
+            # tail)
+            self._idx_f.close()
+            self._idx_f = open(base + ".idx", "ab")
+            if reload_map:
+                self.nm = nmap.load_needle_map(
+                    base + ".idx", kind=self.needle_map_kind)
+            else:
+                self.nm = nmap.new_needle_map(
+                    self.needle_map_kind, idx_path=base + ".idx") \
+                    if self.needle_map_kind != "btree" else \
+                    nmap.NeedleMap()
+
     # -- naming --------------------------------------------------------
     def file_name(self) -> str:
         name = f"{self.collection}_{self.vid}" if self.collection else \
@@ -108,6 +186,11 @@ class Volume:
                                  self.last_append_at_ns + 1)
         self.last_append_at_ns = n.append_at_ns
         blob = n.to_bytes(self.version)
+        if self.delegate is not None:
+            # native plane owns the tail, map and .idx for this volume
+            offset = self.delegate.append(self.vid, blob, n.id, n.size,
+                                          n.append_at_ns)
+            return offset, n.size
         offset = self.dat.append(blob)
         if offset % t.NEEDLE_PADDING:
             # torn previous write: realign (reference truncates on load)
@@ -129,12 +212,18 @@ class Volume:
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
         with self.write_lock:
-            existing = self.nm.get(needle_id)
-            if existing is None:
-                return 0
             tomb = ndl.Needle(id=needle_id)
             tomb.append_at_ns = max(time.time_ns(),
                                     self.last_append_at_ns + 1)
+            if self.delegate is not None:
+                # the native side checks existence, appends the
+                # tombstone record + .idx entry atomically
+                return self.delegate.delete(self.vid, needle_id,
+                                            tomb.to_bytes(self.version),
+                                            tomb.append_at_ns)
+            existing = self.nm.get(needle_id)
+            if existing is None:
+                return 0
             self.last_append_at_ns = tomb.append_at_ns
             self.dat.append(tomb.to_bytes(self.version))
             reclaimed = self.nm.delete(needle_id)
@@ -448,6 +537,10 @@ class Volume:
         error, the transport must frame on record boundaries."""
         if self.read_only:
             raise PermissionError(f"volume {self.vid} is read only")
+        if self.delegate is not None:
+            raise RuntimeError(
+                f"volume {self.vid} is natively attached; detach "
+                "before applying raw segments")
         # the write lock spans append AND the error-path truncate: a
         # concurrent client write landing right after this segment
         # would otherwise be chopped off by truncate(end) (its index
@@ -516,6 +609,7 @@ class Volume:
         if self.is_remote or (self.volume_info and
                               self.volume_info.remote_file()):
             raise ValueError(f"volume {self.vid} is already tiered")
+        self.detach_native()  # the .dat is about to be closed/removed
         base = self.file_name()
         was_read_only = self.read_only
         self.read_only = True
@@ -541,6 +635,7 @@ class Volume:
         once, not N times."""
         if self.is_remote:
             raise ValueError(f"volume {self.vid} is already tiered")
+        self.detach_native()
         self.read_only = True
         self.sync()
         self._adopt_remote(rf, keep_local, bk.get_storage(rf.backend_name))
@@ -586,6 +681,10 @@ class Volume:
         if self.is_remote:
             raise PermissionError(
                 f"volume {self.vid} is tiered; download before compacting")
+        if self.delegate is not None:
+            raise RuntimeError(
+                f"volume {self.vid} is natively attached; detach "
+                "before compacting")
         base = self.file_name()
         cpd, cpx = base + ".cpd", base + ".cpx"
         new_sb = SuperBlock(
@@ -671,6 +770,11 @@ class Volume:
 
     def sync(self) -> None:
         self.dat.sync()
+        if self.delegate is not None:
+            # native writes are unbuffered pwrites; fsync the .idx
+            # through our own handle to the same file
+            os.fsync(self._idx_f.fileno())
+            return
         self._idx_f.flush()
         os.fsync(self._idx_f.fileno())
         if hasattr(self.nm, "set_watermark"):
@@ -679,6 +783,7 @@ class Volume:
             self.nm.set_watermark(self._idx_f.tell())
 
     def close(self) -> None:
+        self.detach_native(reload_map=False)
         try:
             self.sync()
         finally:
