@@ -146,11 +146,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-store", default="memory",
                    help="metadata store: memory | sqlite | leveldb | "
-                        "redis | etcd | mongodb | mysql | postgres "
-                        "(SQL drivers permitting)")
+                        "redis | etcd | mongodb | cassandra | mysql | "
+                        "postgres (SQL drivers permitting)")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
     p.add_argument("-store.host", dest="store_host", default="")
     p.add_argument("-store.port", dest="store_port", type=int, default=0)
+    p.add_argument("-store.user", dest="store_user", default="",
+                   help="db username (mysql/postgres/cassandra)")
     p.add_argument("-store.password", dest="store_password", default="")
     p.add_argument("-store.database", dest="store_database", default="")
     p.add_argument("-collection", default="")
@@ -887,6 +889,8 @@ def _run_filer(args) -> int:
         store_options["host"] = args.store_host
     if args.store_port:
         store_options["port"] = args.store_port
+    if args.store_user:
+        store_options["user"] = args.store_user
     if args.store_password:
         store_options["password"] = args.store_password
     if args.store_database:

@@ -7,13 +7,13 @@ table, with the dialect differences (parameter placeholders, upsert
 syntax, LIKE escaping) isolated in a small Dialect object.
 
 The sqlite store in filerstore.py predates this layer and stays
-self-contained; mysql and postgres register here, gated on their
-drivers (pymysql / psycopg2·pg8000) being importable — the build image
-ships neither, mirroring how the reference compiles those stores in
-but only activates them when configured.
+self-contained; mysql and postgres register here over the in-tree
+wire clients (mysql_lite.py / pg_lite.py) — no external drivers, the
+same zero-SDK approach as the redis/etcd/mongodb/cassandra stores.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 from dataclasses import dataclass
@@ -21,6 +21,18 @@ from dataclasses import dataclass
 from .entry import Entry
 from .filerstore import FilerStore, _like_escape, _norm, _split, \
     register_store
+
+
+def dir_hash(directory: str) -> int:
+    """First 64 bits of MD5(dir) as a signed big-endian int64 — the
+    reference's util.HashStringToLong (util/bytes.go:77), which keys
+    the filemeta primary index. The full directory still rides every
+    WHERE clause, so a 64-bit collision can't cross-read; the PK
+    (dirhash, name) keeps index keys inside InnoDB's 3072-byte limit
+    (8 + 766*4 with utf8mb4 = exactly 3072, hence VARCHAR(766))."""
+    v = int.from_bytes(hashlib.md5(directory.encode()).digest()[:8],
+                       "big")
+    return v - (1 << 64) if v >= (1 << 63) else v
 
 
 @dataclass
@@ -33,18 +45,20 @@ class Dialect:
     like_escape_clause: str = r" ESCAPE '\'"
 
 
-def _ph(d: Dialect, n: int) -> str:
-    return ",".join([d.placeholder] * n)
-
-
 MYSQL_DIALECT = Dialect(
+    # schema mirrors the reference's scaffold (filer.toml [mysql],
+    # mysql/mysql_sql_gen.go:24-49)
     placeholder="%s",
     create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
-        dir VARCHAR(766) NOT NULL, name VARCHAR(766) NOT NULL,
-        meta LONGTEXT NOT NULL, PRIMARY KEY(dir, name))""",
+        dirhash BIGINT NOT NULL, name VARCHAR(766) NOT NULL,
+        directory TEXT NOT NULL, meta LONGBLOB,
+        PRIMARY KEY(dirhash, name))
+        DEFAULT CHARSET=utf8mb4 COLLATE=utf8mb4_bin""",
     create_kv="""CREATE TABLE IF NOT EXISTS kv(
-        k VARCHAR(766) PRIMARY KEY, v LONGBLOB NOT NULL)""",
-    upsert_meta="""INSERT INTO filemeta(dir,name,meta) VALUES(%s,%s,%s)
+        k VARCHAR(766) PRIMARY KEY, v LONGBLOB NOT NULL)
+        DEFAULT CHARSET=utf8mb4 COLLATE=utf8mb4_bin""",
+    upsert_meta="""INSERT INTO filemeta(dirhash,name,directory,meta)
+        VALUES(%s,%s,%s,%s)
         ON DUPLICATE KEY UPDATE meta=VALUES(meta)""",
     upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
         ON DUPLICATE KEY UPDATE v=VALUES(v)""",
@@ -54,42 +68,80 @@ MYSQL_DIALECT = Dialect(
 POSTGRES_DIALECT = Dialect(
     placeholder="%s",
     create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
-        dir TEXT NOT NULL, name TEXT NOT NULL,
-        meta TEXT NOT NULL, PRIMARY KEY(dir, name))""",
+        dirhash BIGINT NOT NULL, name TEXT NOT NULL,
+        directory TEXT NOT NULL, meta BYTEA,
+        PRIMARY KEY(dirhash, name))""",
     create_kv="""CREATE TABLE IF NOT EXISTS kv(
         k TEXT PRIMARY KEY, v BYTEA NOT NULL)""",
-    upsert_meta="""INSERT INTO filemeta(dir,name,meta) VALUES(%s,%s,%s)
-        ON CONFLICT(dir,name) DO UPDATE SET meta=EXCLUDED.meta""",
+    upsert_meta="""INSERT INTO filemeta(dirhash,name,directory,meta)
+        VALUES(%s,%s,%s,%s)
+        ON CONFLICT(dirhash,name) DO UPDATE SET meta=EXCLUDED.meta""",
     upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
         ON CONFLICT(k) DO UPDATE SET v=EXCLUDED.v""",
 )
 
 
 class AbstractSqlStore(FilerStore):
-    """FilerStore over any DB-API 2.0 connection."""
+    """FilerStore over any DB-API 2.0 connection.
+
+    Query shapes mirror the reference's generators
+    (mysql/mysql_sql_gen.go:24-49): every filemeta statement keys on
+    dirhash AND carries the full directory, so index keys stay short
+    and hash collisions stay harmless.
+
+    Transport failures reconnect once via `_connect` (long-lived
+    sockets get idle-closed by the server — MySQL's wait_timeout —
+    and a reconnect must not surface as a filer error); server-side
+    SQL errors (`server_errors` classes) are never retried, the
+    connection is still synced after them."""
+
+    # exception types that mean "the server answered with an error" —
+    # set by subclasses to their wire client's error class
+    server_errors: tuple = ()
 
     def __init__(self, conn, dialect: Dialect):
         self._conn = conn
         self._d = dialect
         self._lock = threading.RLock()
-        with self._lock:
-            cur = self._conn.cursor()
-            cur.execute(dialect.create_meta)
-            cur.execute(dialect.create_kv)
-            self._conn.commit()
+        self._exec(dialect.create_meta)
+        self._exec(dialect.create_kv)
+
+    def _connect(self):
+        """Build a replacement connection after a transport failure;
+        subclasses with reconnect support override this."""
+        raise NotImplementedError
 
     def _exec(self, sql: str, args: tuple = ()) -> list:
         with self._lock:
-            cur = self._conn.cursor()
-            cur.execute(sql, args)
-            rows = cur.fetchall() if cur.description else []
-            self._conn.commit()
-            return rows
+            try:
+                return self._exec_locked(sql, args)
+            except self.server_errors:
+                raise  # SQL error on a healthy, synced connection
+            except (IOError, OSError):
+                try:
+                    replacement = self._connect()
+                except NotImplementedError:
+                    raise
+                try:
+                    self._conn.close()
+                except (IOError, OSError):
+                    pass
+                self._conn = replacement
+                return self._exec_locked(sql, args)
+
+    def _exec_locked(self, sql: str, args: tuple) -> list:
+        cur = self._conn.cursor()
+        cur.execute(sql, args)
+        rows = cur.fetchall() if cur.description else []
+        self._conn.commit()
+        return rows
 
     def insert_entry(self, entry: Entry) -> None:
         d, n = entry.dir_and_name
+        d = _norm(d)
         self._exec(self._d.upsert_meta,
-                   (d, n, json.dumps(entry.to_dict())))
+                   (dir_hash(d), n, d,
+                    json.dumps(entry.to_dict()).encode()))
 
     update_entry = insert_entry
 
@@ -99,24 +151,28 @@ class AbstractSqlStore(FilerStore):
             return None
         ph = self._d.placeholder
         rows = self._exec(
-            f"SELECT meta FROM filemeta WHERE dir={ph} AND name={ph}",
-            (d, n))
+            f"SELECT meta FROM filemeta WHERE dirhash={ph} AND "
+            f"name={ph} AND directory={ph}", (dir_hash(d), n, d))
         return Entry.from_dict(json.loads(rows[0][0])) if rows else None
 
     def delete_entry(self, path: str) -> None:
         d, n = _split(path)
         ph = self._d.placeholder
         self._exec(
-            f"DELETE FROM filemeta WHERE dir={ph} AND name={ph}", (d, n))
+            f"DELETE FROM filemeta WHERE dirhash={ph} AND name={ph} "
+            f"AND directory={ph}", (dir_hash(d), n, d))
 
     def delete_folder_children(self, path: str) -> None:
         path = _norm(path)
         like = _like_escape(
             path if path.endswith("/") else path + "/") + "%"
         ph = self._d.placeholder
+        # whole-subtree delete (the directory LIKE arm walks nested
+        # dirs; the reference deletes one level and recurses in the
+        # filer — same end state, fewer round trips here)
         self._exec(
-            f"DELETE FROM filemeta WHERE dir={ph} OR dir LIKE {ph}"
-            f"{self._d.like_escape_clause}", (path, like))
+            f"DELETE FROM filemeta WHERE directory={ph} OR directory "
+            f"LIKE {ph}{self._d.like_escape_clause}", (path, like))
 
     def list_directory_entries(self, dirpath: str, start_from: str = "",
                                inclusive: bool = False,
@@ -125,8 +181,9 @@ class AbstractSqlStore(FilerStore):
         dirpath = _norm(dirpath)
         ph = self._d.placeholder
         cmp = ">=" if inclusive else ">"
-        q = f"SELECT meta FROM filemeta WHERE dir={ph}"
-        args: list = [dirpath]
+        q = (f"SELECT meta FROM filemeta WHERE dirhash={ph} AND "
+             f"directory={ph}")
+        args: list = [dir_hash(dirpath), dirpath]
         if start_from:
             q += f" AND name {cmp} {ph}"
             args.append(start_from)
@@ -157,43 +214,48 @@ class AbstractSqlStore(FilerStore):
 
 @register_store("mysql")
 class MysqlStore(AbstractSqlStore):
-    """weed/filer/mysql equivalent; requires the pymysql driver."""
+    """weed/filer/mysql equivalent
+    (/root/reference/weed/filer/mysql/mysql_store.go:14). The driver
+    is the in-tree wire client (mysql_lite.py: HandshakeV10 +
+    mysql_native_password + COM_QUERY text protocol), so the mysql
+    dialect is a first-class store, not SDK-gated."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  user: str = "root", password: str = "",
                  database: str = "seaweedfs", **_):
-        try:
-            import pymysql
-        except ImportError as e:
-            raise ImportError(
-                "filer store 'mysql' needs the pymysql driver, which "
-                "is not installed in this environment") from e
-        conn = pymysql.connect(host=host, port=port, user=user,
-                               password=password, database=database,
-                               autocommit=False)
-        super().__init__(conn, MYSQL_DIALECT)
+        from .mysql_lite import MysqlConnection, MysqlError
+
+        self._args = (host, int(port), user, password, database)
+        self.server_errors = (MysqlError,)
+        super().__init__(self._connect(), MYSQL_DIALECT)
+
+    def _connect(self):
+        from .mysql_lite import MysqlConnection
+
+        host, port, user, password, database = self._args
+        return MysqlConnection(host, port, user=user, password=password,
+                               database=database)
 
 
 @register_store("postgres")
 class PostgresStore(AbstractSqlStore):
-    """weed/filer/postgres equivalent; requires psycopg2 or pg8000."""
+    """weed/filer/postgres equivalent
+    (/root/reference/weed/filer/postgres/postgres_store.go:14). The
+    driver is the in-tree wire client (pg_lite.py: StartupMessage,
+    cleartext/md5 auth, simple Query protocol, bytea hex codec)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 5432,
                  user: str = "postgres", password: str = "",
                  database: str = "seaweedfs", **_):
-        conn = None
-        try:
-            import psycopg2
-            conn = psycopg2.connect(host=host, port=port, user=user,
-                                    password=password, dbname=database)
-        except ImportError:
-            try:
-                import pg8000.dbapi
-                conn = pg8000.dbapi.Connection(
-                    user, host=host, port=port, password=password,
-                    database=database)
-            except ImportError as e:
-                raise ImportError(
-                    "filer store 'postgres' needs psycopg2 or pg8000, "
-                    "neither of which is installed") from e
-        super().__init__(conn, POSTGRES_DIALECT)
+        from .pg_lite import PgError
+
+        self._args = (host, int(port), user, password, database)
+        self.server_errors = (PgError,)
+        super().__init__(self._connect(), POSTGRES_DIALECT)
+
+    def _connect(self):
+        from .pg_lite import PgConnection
+
+        host, port, user, password, database = self._args
+        return PgConnection(host, port, user=user, password=password,
+                            database=database)

@@ -365,10 +365,10 @@ def _range_end(prefix: bytes) -> bytes:
 
 
 class _GatedStore(FilerStore):
-    """Placeholder for store plugins whose client SDK isn't installed
-    (the reference's 20+ external-DB stores: redis, mysql, postgres,
-    mongodb, cassandra, etcd, ...). Registered so `-store=<name>`
-    errors with guidance instead of an unknown-store KeyError."""
+    """Placeholder for store plugins whose client SDK isn't installed.
+    Registered so `-store=<name>` errors with guidance instead of an
+    unknown-store KeyError. (redis/etcd/mongodb/cassandra/mysql/
+    postgres graduated to real in-tree wire clients.)"""
 
     KIND = ""
     NEEDS = ""
@@ -380,15 +380,10 @@ class _GatedStore(FilerStore):
             "available everywhere: memory, sqlite, leveldb")
 
 
-# redis / mysql / postgres have real implementations now — see
-# redis_store.py (self-contained RESP client) and abstract_sql.py
-# (shared SQL layer; mysql/postgres still need their drivers).
+# redis / cassandra / mysql / postgres have real implementations now —
+# see redis_store.py (RESP), cassandra_store.py (CQL v4 via
+# cql_lite.py), and abstract_sql.py (shared SQL layer).
 # The remaining reference store families stay gated placeholders:
-
-@register_store("cassandra")
-class CassandraStore(_GatedStore):
-    KIND, NEEDS = "cassandra", "cassandra-driver"
-
 
 @register_store("tikv")
 class TikvStore(_GatedStore):

@@ -699,6 +699,66 @@ bool send_all(int fd, const char* p, size_t n) {
   return true;
 }
 
+// Incremental chunked-transfer scanner: feed() consumes any byte
+// slice and remembers mid-chunk state, so relays never re-parse a
+// trimmed buffer (re-parsing from an arbitrary offset misreads chunk
+// payload bytes as size lines).
+struct ChunkScan {
+  enum { SIZE_LINE, DATA, DATA_CRLF, TRAILER } state = SIZE_LINE;
+  std::string line;      // current size/trailer line accumulator
+  int64_t remaining = 0; // payload bytes left in the current chunk
+  bool last = false;     // saw the 0-size chunk
+  bool done = false;
+
+  // Consumes up to n bytes; returns how many were consumed (< n only
+  // when the terminator was reached mid-slice).
+  size_t feed(const char* p, size_t n) {
+    size_t i = 0;
+    while (i < n && !done) {
+      switch (state) {
+        case SIZE_LINE:
+          line.push_back(p[i++]);
+          if (line.size() >= 2 && line[line.size() - 2] == '\r' &&
+              line.back() == '\n') {
+            remaining = strtoll(line.c_str(), nullptr, 16);
+            last = remaining == 0;
+            state = last ? TRAILER : DATA;
+            line.clear();
+          } else if (line.size() > 4096) {
+            done = true;  // malformed; stop consuming
+          }
+          break;
+        case DATA: {
+          int64_t take = std::min<int64_t>(remaining, n - i);
+          remaining -= take;
+          i += take;
+          if (remaining == 0) state = DATA_CRLF;
+          break;
+        }
+        case DATA_CRLF:
+          line.push_back(p[i++]);
+          if (line.size() == 2) {
+            line.clear();
+            state = SIZE_LINE;
+          }
+          break;
+        case TRAILER:
+          line.push_back(p[i++]);
+          if (line.size() >= 2 && line[line.size() - 2] == '\r' &&
+              line.back() == '\n') {
+            if (line.size() == 2) {
+              done = true;  // empty line terminates the trailer block
+            } else {
+              line.clear();  // a trailer header line; keep scanning
+            }
+          }
+          break;
+      }
+    }
+    return i;
+  }
+};
+
 // Relay one already-head-parsed request from client conn to the backend and
 // its response back. Client fd is in BLOCKING mode here. Returns false if
 // either connection must be dropped.
@@ -710,46 +770,51 @@ bool proxy_one(Server* s, Conn* c, const Request& r) {
     return send_all(c->fd, c->out.data(), c->out.size()), false;
   }
   int bfd = c->backend_fd;
-  // 1. forward head + whatever body is buffered
+  // 1+2. forward head + body (buffered part first, then streamed) —
+  // chunked framing is tracked by the incremental ChunkScan so a
+  // body of any size relays without re-parsing from buffer offsets
   const char* req0 = c->in.data() + c->in_off;
   size_t avail = c->in.size() - c->in_off;
-  bool body_complete = false;
-  int64_t framed = body_len_buffered(r, req0 + r.head_len, avail - r.head_len,
-                                     &body_complete);
-  size_t fwd = body_complete
-                   ? r.head_len + (r.chunked ? framed : (size_t)r.content_len)
-                   : avail;
-  if (!send_all(bfd, req0, fwd)) return false;
-  // 2. stream any remaining request body client->backend
-  int64_t remaining = body_complete ? 0
-                      : r.chunked   ? -1
-                                    : r.content_len - (int64_t)(avail - r.head_len);
   char buf[64 << 10];
-  std::string tail_acc;  // chunked: scan for terminator across reads
-  if (!body_complete && r.chunked)
-    tail_acc.assign(req0 + r.head_len, avail - r.head_len);
-  while (!body_complete && (remaining > 0 || r.chunked)) {
-    // for content-length bodies, never read past the request: the next
-    // pipelined request's bytes must not leak into this relay
-    size_t want = r.chunked ? sizeof buf
-                            : (size_t)std::min<int64_t>(remaining, sizeof buf);
-    ssize_t got = recv(c->fd, buf, want, 0);
-    if (got <= 0) return false;
-    if (!send_all(bfd, buf, got)) return false;
-    if (r.chunked) {
-      tail_acc.append(buf, got);
-      bool done = false;
-      body_len_buffered(r, tail_acc.data(), tail_acc.size(), &done);
-      if (done) break;
-      if (tail_acc.size() > (1 << 20))  // only the tail matters
-        tail_acc.erase(0, tail_acc.size() - 1024);
-    } else {
+  if (r.chunked) {
+    ChunkScan scan;
+    size_t used0 = scan.feed(req0 + r.head_len, avail - r.head_len);
+    if (!send_all(bfd, req0, r.head_len + used0)) return false;
+    std::string leftover;
+    while (!scan.done) {
+      ssize_t got = recv(c->fd, buf, sizeof buf, 0);
+      if (got <= 0) return false;
+      size_t used = scan.feed(buf, got);
+      if (!send_all(bfd, buf, used)) return false;
+      if (scan.done && used < (size_t)got)
+        leftover.assign(buf + used, got - used);  // pipelined bytes
+    }
+    c->in_off += r.head_len + used0;
+    if (!leftover.empty()) {
+      c->in.erase(0, c->in_off);
+      c->in_off = 0;
+      c->in += leftover;
+    }
+  } else {
+    bool body_complete =
+        (int64_t)(avail - r.head_len) >= r.content_len;
+    size_t fwd =
+        body_complete ? r.head_len + (size_t)r.content_len : avail;
+    if (!send_all(bfd, req0, fwd)) return false;
+    int64_t remaining = body_complete
+                            ? 0
+                            : r.content_len - (int64_t)(avail - r.head_len);
+    while (remaining > 0) {
+      // never read past the request: the next pipelined request's
+      // bytes must not leak into this relay
+      size_t want = (size_t)std::min<int64_t>(remaining, sizeof buf);
+      ssize_t got = recv(c->fd, buf, want, 0);
+      if (got <= 0) return false;
+      if (!send_all(bfd, buf, got)) return false;
       remaining -= got;
     }
+    c->in_off += fwd;  // streamed body came straight off the wire
   }
-  c->in_off += body_complete
-                   ? fwd
-                   : c->in.size() - c->in_off;  // streamed rest came off the wire
   // 3. read backend response head
   std::string resp;
   size_t resp_head = 0;
@@ -799,18 +864,16 @@ bool proxy_one(Server* s, Conn* c, const Request& r) {
   int64_t body_have = resp.size() - resp_head;
   if (!head_only) {
     if (resp_chunked) {
-      std::string acc = resp.substr(resp_head);
-      bool done = false;
-      Request fake;
-      fake.chunked = true;
-      body_len_buffered(fake, acc.data(), acc.size(), &done);
-      while (!done) {
+      ChunkScan scan;
+      scan.feed(resp.data() + resp_head, resp.size() - resp_head);
+      while (!scan.done) {
         ssize_t got = recv(bfd, buf, sizeof buf, 0);
         if (got <= 0) return false;
-        if (!send_all(c->fd, buf, got)) return false;
-        acc.append(buf, got);
-        body_len_buffered(fake, acc.data(), acc.size(), &done);
-        if (acc.size() > (1 << 20)) acc.erase(0, acc.size() - 1024);
+        size_t used = scan.feed(buf, got);
+        if (!send_all(c->fd, buf, used)) return false;
+        // one request in flight per backend conn: bytes past the
+        // terminator would mean a broken backend — drop the conn
+        if (scan.done && used < (size_t)got) resp_close = true;
       }
     } else if (resp_cl >= 0) {
       int64_t remaining2 = resp_cl - body_have;

@@ -102,6 +102,10 @@ class VolumeServer:
         # native C++ data plane (native/dataplane.cc): set by
         # enable_native(); None = pure-Python serving
         self.dp = None
+        import threading as _threading
+
+        self._dp_maint: dict[int, int] = {}  # vid -> open windows
+        self._dp_maint_lock = _threading.Lock()
         self._write_sem = asyncio.Semaphore(max_concurrent_writes)
         self._upload_flight = InFlightLimiter(concurrent_upload_limit)
         self._download_flight = InFlightLimiter(concurrent_download_limit)
@@ -211,28 +215,44 @@ class VolumeServer:
 
     def _dp_attach(self, v) -> None:
         """Attach one volume to the native plane (no-op when the plane
-        is off or the volume isn't a plain local-disk one)."""
+        is off, the volume isn't a plain local-disk one, or another
+        maintenance window still holds it)."""
         if self.dp is None or v is None:
             return
-        try:
-            v.attach_native(self.dp)
-        except OSError as e:
-            glog.warning(f"native attach of volume {v.vid} failed: {e}")
+        with self._dp_maint_lock:
+            if self._dp_maint.get(v.vid, 0) > 0:
+                return  # a concurrent _dp_detached window is still open
+            try:
+                v.attach_native(self.dp)
+            except OSError as e:
+                glog.warning(
+                    f"native attach of volume {v.vid} failed: {e}")
 
     def _dp_detached(self, vid: int):
         """Context manager: exclusive Python ownership of a volume for
-        maintenance (vacuum, tier, raw segment application); reattaches
-        on exit if the volume still exists and qualifies."""
+        maintenance (vacuum, tier, raw segment application);
+        reattaches on exit only when the LAST overlapping window
+        closes — two concurrent admin ops on one volume must not
+        reattach it under each other."""
         server = self
 
         class _Ctx:
             def __enter__(self):
+                with server._dp_maint_lock:
+                    server._dp_maint[vid] = \
+                        server._dp_maint.get(vid, 0) + 1
                 v = server.store.find_volume(vid)
                 if v is not None:
                     v.detach_native()
                 return v
 
             def __exit__(self, *exc):
+                with server._dp_maint_lock:
+                    left = server._dp_maint.get(vid, 1) - 1
+                    if left > 0:
+                        server._dp_maint[vid] = left
+                        return False
+                    server._dp_maint.pop(vid, None)
                 server._dp_attach(server.store.find_volume(vid))
                 return False
 
@@ -1297,16 +1317,19 @@ class VolumeServer:
         idle_timeout = float(body.get("idle_timeout", 3))
         buf = bytearray()
         # raw segment application needs exclusive Python ownership of
-        # the tail (multi-record append + error-path truncate); detach
-        # off the loop (it replays the .idx into a fresh map) and
-        # ALWAYS reattach — the error returns below must not strand the
-        # volume on the slow path
-        await asyncio.to_thread(v.detach_native)
+        # the tail (multi-record append + error-path truncate); the
+        # maintenance window runs off the loop (detach replays the
+        # .idx into a fresh map) and ALWAYS closes — error returns
+        # must not strand the volume on the slow path, and the
+        # counter keeps a concurrent vacuum's window from being
+        # broken by this one's reattach
+        ctx = self._dp_detached(vid)
+        await asyncio.to_thread(ctx.__enter__)
         try:
             return await self._tail_receive_stream(
                 req, v, vid, source, since_ns, idle_timeout, buf)
         finally:
-            await asyncio.to_thread(self._dp_attach, v)
+            await asyncio.to_thread(ctx.__exit__, None, None, None)
 
     async def _tail_receive_stream(self, req, v, vid, source, since_ns,
                                    idle_timeout, buf) -> web.Response:

@@ -1,0 +1,228 @@
+"""Minimal cassandra double speaking CQL binary protocol v4.
+
+Implements exactly the statement shapes the cassandra filer store
+issues — USE, INSERT ... USING TTL, point SELECT, range SELECT with
+LIMIT, partition/point DELETE — over real v4 frames (STARTUP, optional
+PLAIN auth, PREPARE/EXECUTE, RESULT rows with global_tables_spec
+metadata). The miniredis / minietcd / minimongo role for the CQL wire.
+Row TTLs expire like the real server's (checked lazily on read).
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+import socket
+import struct
+import threading
+import time
+
+from seaweedfs_tpu.filer import cql_lite as cql
+
+VARCHAR, BLOB, INT = 0x0D, 0x03, 0x09
+
+
+class MiniCassandra:
+    def __init__(self, username: str = "", password: str = ""):
+        self.username = username
+        self.password = password
+        # {directory: {name: (meta bytes, expire_at or None)}}
+        self.data: dict[str, dict[str, tuple[bytes, float | None]]] = {}
+        self.prepared: dict[bytes, str] = {}
+        self.lock = threading.Lock()
+        self.queries: list[str] = []
+        self.warn_with: list[str] = []  # attach v4 warnings to replies
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- plumbing -------------------------------------------------------
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, 9)
+                if hdr is None:
+                    return
+                _ver, _fl, stream, opcode, length = struct.unpack(
+                    ">BBhBI", hdr)
+                body = self._recv_exact(conn, length) or b""
+                resp_op, resp_body = self._handle(conn, stream, opcode,
+                                                  body)
+                if resp_op is not None:
+                    self._send(conn, stream, resp_op, resp_body)
+        except (OSError, IOError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        out = b""
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return out
+
+    def _send(self, conn, stream, opcode, body):
+        flags = 0
+        if self.warn_with:
+            # v4 warning flag: [string list] of warnings prefixes the
+            # body (real cassandra does this for tombstone scans)
+            warns = struct.pack(">H", len(self.warn_with))
+            for w in self.warn_with:
+                wb = w.encode()
+                warns += struct.pack(">H", len(wb)) + wb
+            body = warns + body
+            flags |= 0x08
+        conn.sendall(struct.pack(">BBhBI", 0x84, flags, stream, opcode,
+                                 len(body)) + body)
+
+    # -- protocol -------------------------------------------------------
+    def _handle(self, conn, stream, opcode, body):
+        if opcode == cql.OP_OPTIONS:
+            return cql.OP_SUPPORTED, struct.pack(">H", 0)
+        if opcode == cql.OP_STARTUP:
+            if self.username:
+                return (cql.OP_AUTHENTICATE, cql.enc_string(
+                    "org.apache.cassandra.auth.PasswordAuthenticator"))
+            return cql.OP_READY, b""
+        if opcode == cql.OP_AUTH_RESPONSE:
+            r = cql._Reader(body)
+            token = r.bytes_() or b""
+            parts = token.split(b"\x00")
+            if len(parts) == 3 and parts[1].decode() == self.username \
+                    and parts[2].decode() == self.password:
+                return cql.OP_AUTH_SUCCESS, struct.pack(">i", -1)
+            return cql.OP_ERROR, (struct.pack(">i", 0x0100) +
+                                  cql.enc_string("bad credentials"))
+        if opcode == cql.OP_PREPARE:
+            r = cql._Reader(body)
+            q = r.take(r.i32()).decode()
+            stmt_id = hashlib.md5(q.encode()).digest()
+            with self.lock:
+                self.prepared[stmt_id] = q
+            # RESULT kind=prepared: id + v4 metadata (flags, cols, pk)
+            meta = struct.pack(">iii", 0, q.count("?"), 0)
+            return cql.OP_RESULT, (struct.pack(">i", cql.RESULT_PREPARED)
+                                   + struct.pack(">H", 16) + stmt_id
+                                   + meta + struct.pack(">ii", 0x0004, 0))
+        if opcode in (cql.OP_QUERY, cql.OP_EXECUTE):
+            r = cql._Reader(body)
+            if opcode == cql.OP_QUERY:
+                q = r.take(r.i32()).decode()
+            else:
+                stmt_id = r.short_bytes()
+                with self.lock:
+                    q = self.prepared.get(stmt_id, "")
+                if not q:
+                    return cql.OP_ERROR, (struct.pack(">i", 0x2500) +
+                                          cql.enc_string("unprepared"))
+            _consistency = r.u16()
+            flags = r.u8()
+            values: list[bytes | None] = []
+            if flags & 0x01:
+                for _ in range(r.u16()):
+                    values.append(r.bytes_())
+            try:
+                return self._run(q, values)
+            except Exception as e:  # malformed statement = server error
+                return cql.OP_ERROR, (struct.pack(">i", 0x0000) +
+                                      cql.enc_string(str(e)))
+        return cql.OP_ERROR, (struct.pack(">i", 0x000A) +
+                              cql.enc_string(f"bad opcode {opcode}"))
+
+    # -- statement engine ----------------------------------------------
+    @staticmethod
+    def _rows(names_types, rows):
+        out = struct.pack(">i", cql.RESULT_ROWS)
+        out += struct.pack(">ii", 0x0001, len(names_types))  # global spec
+        out += cql.enc_string("ks") + cql.enc_string("filemeta")
+        for name, tid in names_types:
+            out += cql.enc_string(name) + struct.pack(">H", tid)
+        out += struct.pack(">i", len(rows))
+        for row in rows:
+            for cell in row:
+                out += cql.enc_bytes(cell)
+        return cql.OP_RESULT, out
+
+    VOID = struct.pack(">i", cql.RESULT_VOID)
+
+    def _live(self, d: str):
+        now = time.time()
+        part = self.data.get(d, {})
+        return {n: m for n, (m, exp) in part.items()
+                if exp is None or exp > now}
+
+    def _run(self, q: str, values):
+        self.queries.append(q)
+        qs = q.strip().rstrip(";").strip()
+        with self.lock:
+            if re.fullmatch(r'USE\s+"?\w+"?', qs, re.I):
+                return cql.OP_RESULT, (
+                    struct.pack(">i", cql.RESULT_SET_KEYSPACE) +
+                    cql.enc_string("ks"))
+            if qs.upper().startswith("INSERT INTO FILEMETA"):
+                d = (values[0] or b"").decode()
+                n = (values[1] or b"").decode()
+                meta = values[2] or b""
+                ttl = struct.unpack(">i", values[3])[0] if values[3] \
+                    else 0
+                exp = time.time() + ttl if ttl > 0 else None
+                self.data.setdefault(d, {})[n] = (meta, exp)
+                return cql.OP_RESULT, self.VOID
+            m = re.fullmatch(
+                r"SELECT meta FROM filemeta WHERE directory=\? "
+                r"AND name=\?", qs, re.I)
+            if m:
+                d = (values[0] or b"").decode()
+                n = (values[1] or b"").decode()
+                live = self._live(d)
+                rows = [[live[n]]] if n in live else []
+                return self._rows([("meta", BLOB)], rows)
+            m = re.fullmatch(
+                r"SELECT name, meta FROM filemeta WHERE directory=\? "
+                r"AND name(>=|>)\? LIMIT \?", qs, re.I)
+            if m:
+                op = m.group(1)
+                d = (values[0] or b"").decode()
+                start = (values[1] or b"").decode()
+                limit = struct.unpack(">i", values[2])[0]
+                live = self._live(d)
+                names = sorted(n for n in live
+                               if (n >= start if op == ">=" else
+                                   n > start))
+                rows = [[n.encode(), live[n]] for n in names[:limit]]
+                return self._rows([("name", VARCHAR), ("meta", BLOB)],
+                                  rows)
+            if re.fullmatch(r"DELETE FROM filemeta WHERE directory=\? "
+                            r"AND name=\?", qs, re.I):
+                d = (values[0] or b"").decode()
+                n = (values[1] or b"").decode()
+                self.data.get(d, {}).pop(n, None)
+                return cql.OP_RESULT, self.VOID
+            if re.fullmatch(r"DELETE FROM filemeta WHERE directory=\?",
+                            qs, re.I):
+                self.data.pop((values[0] or b"").decode(), None)
+                return cql.OP_RESULT, self.VOID
+        raise ValueError(f"mini-cassandra: unsupported statement {q!r}")

@@ -1,0 +1,256 @@
+"""Minimal mysqld double speaking the MySQL client/server protocol.
+
+Server side of filer/mysql_lite.py: HandshakeV10 with
+mysql_native_password verification, COM_QUERY with OK/ERR/resultset
+(+EOF) framing. Statements execute on an in-memory sqlite database
+after a faithful de-interpolation pass — every quoted/hex literal is
+parsed back out per MySQL quoting rules and re-bound as a parameter,
+so the client's escaping is round-tripped for real, then the two
+MySQL-only constructs (ON DUPLICATE KEY UPDATE, type names) are
+rewritten to sqlite. The miniredis/minimongo/minicassandra role for
+the MySQL wire.
+"""
+from __future__ import annotations
+
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+from seaweedfs_tpu.filer.mysql_lite import native_password_token
+
+
+def _lenenc_bytes(b: bytes) -> bytes:
+    n = len(b)
+    if n < 0xFB:
+        return bytes([n]) + b
+    if n < 0x10000:
+        return b"\xfc" + struct.pack("<H", n) + b
+    if n < 0x1000000:
+        return b"\xfd" + n.to_bytes(3, "little") + b
+    return b"\xfe" + struct.pack("<Q", n) + b
+
+
+def de_interpolate(sql: str) -> tuple[str, list]:
+    """MySQL statement with inline literals -> (parameterized SQL,
+    params). Handles '...' with backslash escapes and '' doubling,
+    and X'..' hex literals."""
+    out: list[str] = []
+    params: list = []
+    i = 0
+    n = len(sql)
+    unesc = {"0": "\x00", "n": "\n", "r": "\r", "Z": "\x1a", "'": "'",
+             '"': '"', "\\": "\\"}
+    while i < n:
+        ch = sql[i]
+        if ch in ("X", "x") and i + 1 < n and sql[i + 1] == "'":
+            j = sql.index("'", i + 2)
+            params.append(bytes.fromhex(sql[i + 2:j]))
+            out.append("?")
+            i = j + 1
+            continue
+        if ch == "'":
+            buf: list[str] = []
+            i += 1
+            while i < n:
+                c = sql[i]
+                if c == "\\" and i + 1 < n:
+                    buf.append(unesc.get(sql[i + 1], sql[i + 1]))
+                    i += 2
+                elif c == "'" and i + 1 < n and sql[i + 1] == "'":
+                    buf.append("'")
+                    i += 2
+                elif c == "'":
+                    i += 1
+                    break
+                else:
+                    buf.append(c)
+                    i += 1
+            params.append("".join(buf))
+            out.append("?")
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out), params
+
+
+def to_sqlite(sql: str) -> str:
+    """Rewrite the MySQL-isms the filer dialect uses."""
+    sql = re.sub(
+        r"ON DUPLICATE KEY UPDATE (\w+)=VALUES\(\1\)",
+        lambda m: ("ON CONFLICT(dirhash,name) DO UPDATE SET "
+                   f"{m.group(1)}=excluded.{m.group(1)}")
+        if m.group(1) == "meta" else
+        f"ON CONFLICT(k) DO UPDATE SET {m.group(1)}=excluded.{m.group(1)}",
+        sql, flags=re.I)
+    sql = re.sub(r"VARCHAR\(\d+\)", "TEXT", sql, flags=re.I)
+    sql = re.sub(r"\bLONGTEXT\b", "TEXT", sql, flags=re.I)
+    sql = re.sub(r"\bLONGBLOB\b", "BLOB", sql, flags=re.I)
+    sql = re.sub(r"DEFAULT CHARSET=\w+( COLLATE=\w+)?", "", sql,
+                 flags=re.I)
+    return sql
+
+
+class MiniMysql:
+    def __init__(self, user: str = "root", password: str = ""):
+        self.user = user
+        self.password = password
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.Lock()
+        self.queries: list[str] = []
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    # -- framing --------------------------------------------------------
+    @staticmethod
+    def _recv_exact(conn, n):
+        out = b""
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return out
+
+    def _recv(self, conn):
+        out = b""
+        while True:
+            hdr = self._recv_exact(conn, 4)
+            if hdr is None:
+                return None, 0
+            length = int.from_bytes(hdr[:3], "little")
+            piece = self._recv_exact(conn, length)
+            if piece is None:
+                return None, 0
+            out += piece
+            if length < 0xFFFFFF:  # 0xFFFFFF = continuation follows
+                return out, hdr[3]
+
+    @staticmethod
+    def _send(conn, seq: int, payload: bytes) -> int:
+        at = 0
+        while True:
+            chunk = payload[at:at + 0xFFFFFF]
+            conn.sendall(len(chunk).to_bytes(3, "little") +
+                         bytes([seq & 0xFF]) + chunk)
+            seq += 1
+            at += len(chunk)
+            if len(chunk) < 0xFFFFFF:
+                return seq
+
+    @staticmethod
+    def _ok() -> bytes:
+        return b"\x00\x00\x00\x02\x00\x00\x00"
+
+    @staticmethod
+    def _eof() -> bytes:
+        return b"\xfe\x00\x00\x02\x00"
+
+    @staticmethod
+    def _err(errno: int, msg: str) -> bytes:
+        return (b"\xff" + struct.pack("<H", errno) + b"#HY000" +
+                msg.encode())
+
+    # -- session --------------------------------------------------------
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            nonce = os.urandom(20)
+            greet = (bytes([10]) + b"8.0.mini\x00" +
+                     struct.pack("<I", 1) + nonce[:8] + b"\x00" +
+                     struct.pack("<H", 0xF7FF) + bytes([0x21]) +
+                     struct.pack("<H", 2) +
+                     struct.pack("<H", (0x80000 | 0x8000) >> 16) +
+                     bytes([21]) + b"\x00" * 10 +
+                     nonce[8:] + b"\x00" +
+                     b"mysql_native_password\x00")
+            seq = self._send(conn, 0, greet)
+            resp, seq_in = self._recv(conn)
+            if resp is None:
+                return
+            # HandshakeResponse41: caps(4) max(4) charset(1) 23 zeros
+            at = 4 + 4 + 1 + 23
+            end = resp.index(b"\x00", at)
+            user = resp[at:end].decode()
+            at = end + 1
+            tok_len = resp[at]
+            token = resp[at + 1:at + 1 + tok_len]
+            expected = native_password_token(self.password, nonce)
+            if user != self.user or token != expected:
+                self._send(conn, seq_in + 1,
+                           self._err(1045, "access denied"))
+                return
+            self._send(conn, seq_in + 1, self._ok())
+            while True:
+                cmd, _ = self._recv(conn)
+                if cmd is None or cmd[:1] == b"\x01":  # COM_QUIT
+                    return
+                if cmd[:1] != b"\x03":  # only COM_QUERY
+                    self._send(conn, 1, self._err(1047, "bad command"))
+                    continue
+                self._run_query(conn, cmd[1:].decode())
+        except (OSError, ValueError, IndexError):
+            pass
+        finally:
+            conn.close()
+
+    def _run_query(self, conn, sql: str) -> None:
+        self.queries.append(sql)
+        try:
+            psql, params = de_interpolate(sql)
+            psql = to_sqlite(psql)
+            with self.lock:
+                cur = self.db.execute(psql, params)
+                rows = cur.fetchall() if cur.description else None
+                cols = [d[0] for d in cur.description] \
+                    if cur.description else []
+                self.db.commit()
+        except sqlite3.Error as e:
+            self._send(conn, 1, self._err(1064, str(e)))
+            return
+        if rows is None:
+            self._send(conn, 1, self._ok())
+            return
+        seq = self._send(conn, 1, bytes([len(cols)]))
+        for name in cols:
+            nb = name.encode()
+            col = (_lenenc_bytes(b"def") + _lenenc_bytes(b"") +
+                   _lenenc_bytes(b"t") + _lenenc_bytes(b"t") +
+                   _lenenc_bytes(nb) + _lenenc_bytes(nb) +
+                   b"\x0c" + struct.pack("<HIBHB", 0x21, 1024, 0xFC,
+                                         0, 0) + b"\x00\x00")
+            seq = self._send(conn, seq, col)
+        seq = self._send(conn, seq, self._eof())
+        for row in rows:
+            payload = b""
+            for v in row:
+                if v is None:
+                    payload += b"\xfb"
+                elif isinstance(v, bytes):
+                    payload += _lenenc_bytes(v)
+                else:
+                    payload += _lenenc_bytes(str(v).encode())
+            seq = self._send(conn, seq, payload)
+        self._send(conn, seq, self._eof())

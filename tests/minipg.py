@@ -1,0 +1,200 @@
+"""Minimal postgres double speaking frontend/backend protocol v3.
+
+Server side of filer/pg_lite.py: StartupMessage + md5 auth, simple
+Query protocol with RowDescription/DataRow/CommandComplete framing.
+Statements run on in-memory sqlite after de-interpolating literals per
+postgres quoting rules ('' doubling, '\\x..'::bytea hex); bytea
+columns are served back as \\x hex text with oid 17, exactly like a
+real server in text format. The minimysql sibling for the pg wire.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+BYTEA_OID = 17
+TEXT_OID = 25
+
+
+def de_interpolate(sql: str) -> tuple[str, list]:
+    """Postgres statement with inline literals -> (sql, params)."""
+    out: list[str] = []
+    params: list = []
+    i = 0
+    n = len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            buf: list[str] = []
+            i += 1
+            while i < n:
+                if sql[i] == "'" and i + 1 < n and sql[i + 1] == "'":
+                    buf.append("'")
+                    i += 2
+                elif sql[i] == "'":
+                    i += 1
+                    break
+                else:
+                    buf.append(sql[i])
+                    i += 1
+            lit = "".join(buf)
+            if sql[i:i + 7] == "::bytea":
+                i += 7
+                assert lit.startswith("\\x"), lit
+                params.append(bytes.fromhex(lit[2:]))
+            else:
+                params.append(lit)
+            out.append("?")
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out), params
+
+
+def to_sqlite(sql: str) -> str:
+    sql = re.sub(r"\bBYTEA\b", "BLOB", sql, flags=re.I)
+    return sql
+
+
+class MiniPg:
+    def __init__(self, user: str = "postgres", password: str = ""):
+        self.user = user
+        self.password = password
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.Lock()
+        self.queries: list[str] = []
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        out = b""
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return out
+
+    @staticmethod
+    def _msg(kind: bytes, payload: bytes) -> bytes:
+        return kind + struct.pack(">I", len(payload) + 4) + payload
+
+    def _error(self, code: str, msg: str) -> bytes:
+        return self._msg(b"E", b"S" + b"ERROR\x00" +
+                         b"C" + code.encode() + b"\x00" +
+                         b"M" + msg.encode() + b"\x00\x00")
+
+    READY = b"Z" + struct.pack(">I", 5) + b"I"
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            raw = self._recv_exact(conn, 4)
+            if raw is None:
+                return
+            (length,) = struct.unpack(">I", raw)
+            body = self._recv_exact(conn, length - 4) or b""
+            (_proto,) = struct.unpack_from(">I", body)
+            kvs = body[4:].rstrip(b"\x00").split(b"\x00")
+            params = dict(zip(kvs[::2], kvs[1::2]))
+            user = params.get(b"user", b"").decode()
+            # md5 challenge
+            salt = os.urandom(4)
+            conn.sendall(self._msg(b"R", struct.pack(">I", 5) + salt))
+            kind = self._recv_exact(conn, 1)
+            if kind != b"p":
+                return
+            (ln,) = struct.unpack(">I", self._recv_exact(conn, 4))
+            token = (self._recv_exact(conn, ln - 4) or b"").rstrip(
+                b"\x00").decode()
+            inner = hashlib.md5(
+                self.password.encode() + self.user.encode()).hexdigest()
+            expect = "md5" + hashlib.md5(
+                inner.encode() + salt).hexdigest()
+            if user != self.user or token != expect:
+                conn.sendall(self._error("28P01", "auth failed"))
+                return
+            conn.sendall(self._msg(b"R", struct.pack(">I", 0)) +
+                         self._msg(b"S", b"server_version\x00mini\x00") +
+                         self.READY)
+            while True:
+                kind = self._recv_exact(conn, 1)
+                if kind is None or kind == b"X":
+                    return
+                (ln,) = struct.unpack(">I", self._recv_exact(conn, 4))
+                payload = self._recv_exact(conn, ln - 4) or b""
+                if kind != b"Q":
+                    conn.sendall(self._error("0A000", "bad message") +
+                                 self.READY)
+                    continue
+                self._run_query(conn, payload.rstrip(b"\x00").decode())
+        except (OSError, ValueError, IndexError):
+            pass
+        finally:
+            conn.close()
+
+    def _run_query(self, conn, sql: str) -> None:
+        self.queries.append(sql)
+        try:
+            psql, params = de_interpolate(sql)
+            with self.lock:
+                cur = self.db.execute(to_sqlite(psql), params)
+                rows = cur.fetchall() if cur.description else None
+                cols = [d[0] for d in cur.description] \
+                    if cur.description else []
+                self.db.commit()
+        except (sqlite3.Error, AssertionError) as e:
+            conn.sendall(self._error("42601", str(e)) + self.READY)
+            return
+        if rows is None:
+            conn.sendall(self._msg(b"C", b"OK\x00") + self.READY)
+            return
+        oids = [BYTEA_OID if rows and isinstance(rows[0][i], bytes)
+                else TEXT_OID for i in range(len(cols))]
+        desc = struct.pack(">H", len(cols))
+        for name, oid in zip(cols, oids):
+            desc += name.encode() + b"\x00" + struct.pack(
+                ">IHIhiH", 0, 0, oid, -1, -1, 0)
+        out = self._msg(b"T", desc)
+        for row in rows:
+            payload = struct.pack(">H", len(row))
+            for v, oid in zip(row, oids):
+                if v is None:
+                    payload += struct.pack(">i", -1)
+                    continue
+                if isinstance(v, bytes):
+                    val = b"\\x" + v.hex().encode()
+                elif isinstance(v, str):
+                    val = v.encode()
+                else:
+                    val = str(v).encode()
+                payload += struct.pack(">i", len(val)) + val
+            out += self._msg(b"D", payload)
+        out += self._msg(b"C", f"SELECT {len(rows)}\x00".encode())
+        conn.sendall(out + self.READY)

@@ -188,20 +188,17 @@ class TestGatedStores:
         import pytest as _pytest
 
         from seaweedfs_tpu.filer.filerstore import STORES, make_store
-        for kind in ("mysql", "postgres"):  # drivers not in this image
+        for kind in ("tikv", "ydb", "arangodb", "hbase", "elastic"):
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
-        for kind in ("cassandra", "tikv", "ydb",
-                     "arangodb", "hbase", "elastic"):
-            assert kind in STORES
-            with _pytest.raises(ImportError):
-                make_store(kind)
-        # redis (RESP over a socket), etcd (v3 HTTP gateway), and
-        # mongodb (OP_MSG/BSON) are fully implemented wire protocols:
+        # redis (RESP), etcd (v3 HTTP gateway), mongodb (OP_MSG/BSON),
+        # cassandra (CQL v4), mysql (client/server protocol), and
+        # postgres (protocol v3) are fully implemented wire protocols:
         # with no server listening they fail at connect, not at import
-        assert "redis" in STORES
-        assert "etcd" in STORES
-        assert "mongodb" in STORES
-        with _pytest.raises(OSError):
-            make_store("redis", port=1)
+        for kind in ("redis", "etcd", "mongodb", "cassandra",
+                     "mysql", "postgres"):
+            assert kind in STORES
+        for kind in ("redis", "cassandra", "mysql", "postgres"):
+            with _pytest.raises(OSError):
+                make_store(kind, port=1)

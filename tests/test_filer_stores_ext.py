@@ -20,12 +20,13 @@ from .miniredis import MiniRedis
 SQLITE_DIALECT = Dialect(
     placeholder="?",
     create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
-        dir TEXT NOT NULL, name TEXT NOT NULL,
-        meta TEXT NOT NULL, PRIMARY KEY(dir, name))""",
+        dirhash INTEGER NOT NULL, name TEXT NOT NULL,
+        directory TEXT NOT NULL, meta BLOB,
+        PRIMARY KEY(dirhash, name))""",
     create_kv="""CREATE TABLE IF NOT EXISTS kv(
         k TEXT PRIMARY KEY, v BLOB NOT NULL)""",
-    upsert_meta="INSERT OR REPLACE INTO filemeta(dir,name,meta) "
-                "VALUES(?,?,?)",
+    upsert_meta="INSERT OR REPLACE INTO filemeta(dirhash,name,"
+                "directory,meta) VALUES(?,?,?,?)",
     upsert_kv="INSERT OR REPLACE INTO kv(k,v) VALUES(?,?)",
 )
 
