@@ -183,7 +183,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-path", default="/", help="source path prefix")
     p.add_argument("-sink", required=True,
                    help="local:<dir> | filer:<url>[,<destPath>] | "
-                        "s3:<endpoint>,<bucket>[,<prefix>]")
+                        "s3:<endpoint>,<bucket>[,<prefix>] | "
+                        "gcs:<bucket>[,<prefix>[,<endpoint>]] | "
+                        "azure:<account>,<key>,<container>[,<prefix>] | "
+                        "b2:<keyId>,<appKey>,<bucket>[,<prefix>]")
 
     p = sub.add_parser("filer.sync",
                        help="active-active sync between two filers")
@@ -864,6 +867,21 @@ def _run_replicate(args) -> int:
     elif kind == "s3":
         sink = make_sink("s3", endpoint=parts[0], bucket=parts[1],
                          prefix=parts[2] if len(parts) > 2 else "")
+    elif kind == "gcs":
+        sink = make_sink(
+            "gcs", bucket=parts[0],
+            prefix=parts[1] if len(parts) > 1 else "",
+            endpoint=parts[2] if len(parts) > 2 else "")
+    elif kind == "azure":
+        sink = make_sink(
+            "azure", account=parts[0], key=parts[1],
+            container=parts[2],
+            prefix=parts[3] if len(parts) > 3 else "")
+    elif kind == "b2":
+        sink = make_sink(
+            "b2", key_id=parts[0], application_key=parts[1],
+            bucket=parts[2],
+            prefix=parts[3] if len(parts) > 3 else "")
     else:
         print(f"unknown sink kind {kind!r}")
         return 1

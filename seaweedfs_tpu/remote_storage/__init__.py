@@ -8,12 +8,17 @@ remote.* commands and `filer.remote.sync` use
 """
 from .client import (LocalRemoteClient, RemoteEntry, RemoteStorageClient,
                      S3RemoteClient, make_client, register_remote)
+from . import azure_client as _azure_client  # registers "azure" (REST)
+from . import gcs_client as _gcs_client      # registers "gcs" (JSON API)
+from .azure_client import AzureRemoteClient
+from .gcs_client import GcsRemoteClient
 from .mount import (RemoteConf, RemoteMount, find_mount, load_conf,
                     remote_key_for, save_conf)
 
 __all__ = [
     "RemoteEntry", "RemoteStorageClient", "LocalRemoteClient",
-    "S3RemoteClient", "make_client", "register_remote",
+    "S3RemoteClient", "GcsRemoteClient", "AzureRemoteClient",
+    "make_client", "register_remote",
     "RemoteConf", "RemoteMount", "load_conf", "save_conf",
     "find_mount", "remote_key_for",
 ]

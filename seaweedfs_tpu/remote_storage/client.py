@@ -174,9 +174,10 @@ _makers: dict[str, Callable[..., RemoteStorageClient]] = {
 }
 
 # present in the reference via cloud SDKs not shipped in this image;
-# named so configuration errors are explicit, not "unknown type"
-UNAVAILABLE_TYPES = ("gcs", "azure", "b2", "aliyun", "tencent", "wasabi",
-                     "hdfs")
+# named so configuration errors are explicit, not "unknown type".
+# (gcs and azure graduated to real in-tree REST clients; b2's
+# S3-compatible endpoint works through type "s3".)
+UNAVAILABLE_TYPES = ("aliyun", "tencent", "wasabi", "hdfs")
 
 
 def register_remote(type_name: str,

@@ -1,0 +1,191 @@
+"""Google Cloud Storage remote client over the raw JSON API.
+
+The slot of /root/reference/weed/remote_storage/gcs/gcs_storage_client.go:21
+with plain HTTP instead of cloud.google.com/go/storage — the same
+zero-SDK approach as the filer wire stores.
+
+Auth modes (pick one in remote.configure):
+  (none)                — anonymous (public buckets, fake-gcs-server)
+  -token=...            — static OAuth2 bearer token
+  -token_url=...        — metadata-style endpoint returning
+                          {"access_token": ..., "expires_in": ...}
+                          (GCE/GKE workload identity)
+  -credentials_file=... — service-account JSON key; the OAuth2 JWT
+                          grant is signed in-tree (utils/rs256.py),
+                          no google-auth needed
+
+`-endpoint` overrides https://storage.googleapis.com for emulators.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from typing import Iterator
+
+import requests
+
+from .client import RemoteEntry, RemoteStorageClient, register_remote
+
+GCS_ENDPOINT = "https://storage.googleapis.com"
+TOKEN_URL = "https://oauth2.googleapis.com/token"
+SCOPE = "https://www.googleapis.com/auth/devstorage.read_write"
+
+
+def _rfc3339_to_unix(s: str) -> float:
+    # 2024-01-02T03:04:05.678Z — stdlib-parsable after the tz fixup
+    try:
+        from datetime import datetime
+
+        return datetime.fromisoformat(s.replace("Z", "+00:00")) \
+            .timestamp()
+    except ValueError:
+        return 0.0
+
+
+class GcsRemoteClient(RemoteStorageClient):
+    def __init__(self, bucket: str = "", endpoint: str = "",
+                 token: str = "", token_url: str = "",
+                 credentials_file: str = "", project: str = "", **_):
+        if not bucket:
+            raise ValueError("gcs remote storage needs -bucket")
+        self.bucket = bucket
+        self.endpoint = (endpoint or GCS_ENDPOINT).rstrip("/")
+        self.project = project
+        self._static_token = token
+        self._token_url = token_url
+        self._sa = None
+        if credentials_file:
+            with open(credentials_file) as f:
+                self._sa = json.load(f)
+        self._token = token
+        self._token_exp = float("inf") if token else 0.0
+        self._sess = requests.Session()
+        self._auth()  # fail fast on bad credentials
+
+    # -- auth -----------------------------------------------------------
+    def _auth(self) -> dict:
+        if time.time() < self._token_exp - 60:
+            return {"Authorization": f"Bearer {self._token}"} \
+                if self._token else {}
+        if self._token_url:
+            r = self._sess.get(
+                self._token_url,
+                headers={"Metadata-Flavor": "Google"}, timeout=30)
+            r.raise_for_status()
+            d = r.json()
+            self._token = d["access_token"]
+            self._token_exp = time.time() + float(
+                d.get("expires_in", 3600))
+        elif self._sa is not None:
+            self._token, self._token_exp = self._jwt_grant()
+        else:
+            return {}  # anonymous
+        return {"Authorization": f"Bearer {self._token}"}
+
+    def _jwt_grant(self) -> tuple[str, float]:
+        """OAuth2 JWT bearer grant signed with the service account's
+        RSA key (RFC 7523; what google-auth does under the hood)."""
+        import base64
+
+        from ..utils import rs256
+
+        def b64(b: bytes) -> bytes:
+            return base64.urlsafe_b64encode(b).rstrip(b"=")
+
+        now = int(time.time())
+        header = b64(json.dumps(
+            {"alg": "RS256", "typ": "JWT"}).encode())
+        token_uri = self._sa.get("token_uri", TOKEN_URL)
+        claims = b64(json.dumps({
+            "iss": self._sa["client_email"], "scope": SCOPE,
+            "aud": token_uri, "iat": now, "exp": now + 3600,
+        }).encode())
+        signing_input = header + b"." + claims
+        sig = rs256.sign(self._sa["private_key"], signing_input)
+        assertion = (signing_input + b"." + b64(sig)).decode()
+        r = self._sess.post(token_uri, data={
+            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+            "assertion": assertion}, timeout=30)
+        r.raise_for_status()
+        d = r.json()
+        return d["access_token"], time.time() + float(
+            d.get("expires_in", 3600))
+
+    # -- helpers --------------------------------------------------------
+    def _obj_url(self, key: str, media: bool = False) -> str:
+        q = urllib.parse.quote(key.lstrip("/"), safe="")
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/{q}")
+        return url + "?alt=media" if media else url
+
+    @staticmethod
+    def _entry(item: dict) -> RemoteEntry:
+        return RemoteEntry(
+            key=item["name"], size=int(item.get("size", 0)),
+            mtime=_rfc3339_to_unix(item.get("updated", "")),
+            etag=item.get("md5Hash", item.get("etag", "")))
+
+    # -- verbs ----------------------------------------------------------
+    def traverse(self, prefix: str = "") -> Iterator[RemoteEntry]:
+        page = ""
+        while True:
+            params = {"prefix": prefix.lstrip("/")}
+            if page:
+                params["pageToken"] = page
+            r = self._sess.get(
+                f"{self.endpoint}/storage/v1/b/{self.bucket}/o",
+                params=params, headers=self._auth(), timeout=60)
+            r.raise_for_status()
+            d = r.json()
+            for item in d.get("items", []):
+                yield self._entry(item)
+            page = d.get("nextPageToken", "")
+            if not page:
+                return
+
+    def head(self, key: str) -> RemoteEntry | None:
+        r = self._sess.get(self._obj_url(key), headers=self._auth(),
+                           timeout=30)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return self._entry(r.json())
+
+    def read_file(self, key: str, offset: int = 0,
+                  size: int = -1) -> bytes:
+        headers = self._auth()
+        if offset or size >= 0:
+            end = "" if size < 0 else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        r = self._sess.get(self._obj_url(key, media=True),
+                           headers=headers, timeout=300)
+        r.raise_for_status()
+        return r.content
+
+    def write_file(self, key: str, data: bytes) -> RemoteEntry:
+        r = self._sess.post(
+            f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o",
+            params={"uploadType": "media", "name": key.lstrip("/")},
+            data=data, headers={
+                **self._auth(),
+                "Content-Type": "application/octet-stream"},
+            timeout=300)
+        r.raise_for_status()
+        return self._entry(r.json())
+
+    def delete_file(self, key: str) -> None:
+        r = self._sess.delete(self._obj_url(key), headers=self._auth(),
+                              timeout=60)
+        if r.status_code not in (204, 404):
+            r.raise_for_status()
+
+    def list_buckets(self) -> list[str]:
+        params = {"project": self.project} if self.project else {}
+        r = self._sess.get(f"{self.endpoint}/storage/v1/b",
+                           params=params, headers=self._auth(),
+                           timeout=30)
+        r.raise_for_status()
+        return sorted(i["name"] for i in r.json().get("items", []))
+
+
+register_remote("gcs", GcsRemoteClient)

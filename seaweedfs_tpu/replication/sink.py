@@ -1,9 +1,9 @@
 """Replication sinks: where filer metadata events get mirrored.
 
-Equivalent of /root/reference/weed/replication/sink/ (filersink,
-localsink, s3sink — the gcs/azure/b2 sinks are the same interface over
-cloud SDKs not present in this environment, so they register as
-unavailable rather than silently half-working). A sink receives entry
+Equivalent of /root/reference/weed/replication/sink/: filersink,
+localsink, s3sink, plus gcssink/azuresink over the in-tree REST
+remote clients and b2sink over the native B2 API — every cloud sink
+speaks its real wire protocol, no SDKs. A sink receives entry
 lifecycle callbacks; file content is provided by a reader callable so
 sinks don't need to know the source's chunk layout.
 """
@@ -17,6 +17,13 @@ import requests
 from ..filer.entry import Entry
 
 DataReader = Callable[[], bytes]
+
+
+def _prefixed_key(prefix: str, path: str) -> str:
+    """Object key for a filer path under an optional key prefix —
+    shared by every flat-keyspace sink."""
+    key = path.lstrip("/")
+    return f"{prefix}/{key}" if prefix else key
 
 
 class ReplicationSink:
@@ -130,8 +137,7 @@ class S3Sink(ReplicationSink):
         self.secret_key = secret_key
 
     def _key(self, path: str) -> str:
-        key = path.lstrip("/")
-        return f"{self.prefix}/{key}" if self.prefix else key
+        return _prefixed_key(self.prefix, path)
 
     def _headers(self, method: str, url: str, payload: bytes) -> dict:
         if not self.access_key:
@@ -160,9 +166,145 @@ class S3Sink(ReplicationSink):
                         timeout=60)
 
 
+class _RemoteClientSink(ReplicationSink):
+    """Sink over a RemoteStorageClient: GCS and Azure replicate
+    through the same in-tree REST clients the remote-mount tier uses
+    (gcs_storage_client.go / azure_storage_client.go are likewise
+    shared by the reference's sinks)."""
+
+    def __init__(self, client, prefix: str = ""):
+        self._c = client
+        self.prefix = prefix.strip("/")
+
+    def _key(self, path: str) -> str:
+        return _prefixed_key(self.prefix, path)
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        if entry.is_directory:
+            return  # object keys are flat
+        self._c.write_file(self._key(path), read_data())
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        self._c.delete_file(self._key(path))
+
+
+class GcsSink(_RemoteClientSink):
+    """replication/sink/gcssink/gcs_sink.go:18 over the JSON API."""
+
+    name = "gcs"
+
+    def __init__(self, bucket: str, prefix: str = "", **conf):
+        from ..remote_storage.gcs_client import GcsRemoteClient
+
+        super().__init__(GcsRemoteClient(bucket=bucket, **conf), prefix)
+
+
+class AzureSink(_RemoteClientSink):
+    """replication/sink/azuresink/azure_sink.go:20 over Blob REST."""
+
+    name = "azure"
+
+    def __init__(self, container: str, prefix: str = "", **conf):
+        from ..remote_storage.azure_client import AzureRemoteClient
+
+        super().__init__(AzureRemoteClient(container=container, **conf),
+                         prefix)
+
+
+class B2Sink(ReplicationSink):
+    """replication/sink/b2sink/b2_sink.go:17 over the native B2 API
+    (b2_authorize_account / b2_get_upload_url / b2_upload_file /
+    b2_hide_file) — no blazer SDK."""
+
+    name = "b2"
+
+    def __init__(self, bucket: str, key_id: str, application_key: str,
+                 prefix: str = "",
+                 api_base: str = "https://api.backblazeb2.com"):
+        self.bucket_name = bucket
+        self.prefix = prefix.strip("/")
+        self._key_id = key_id
+        self._app_key = application_key
+        self._api_base = api_base.rstrip("/")
+        self._sess = requests.Session()
+        self._authorize()
+        r = self._api("b2_list_buckets",
+                      {"accountId": self.account_id,
+                       "bucketName": bucket})
+        buckets = r.json().get("buckets", [])
+        if not buckets:
+            raise KeyError(f"b2 bucket {bucket!r} not found")
+        self.bucket_id = buckets[0]["bucketId"]
+
+    def _authorize(self) -> None:
+        r = self._sess.get(
+            f"{self._api_base}/b2api/v2/b2_authorize_account",
+            auth=(self._key_id, self._app_key), timeout=30)
+        r.raise_for_status()
+        d = r.json()
+        self.api_url = d["apiUrl"].rstrip("/")
+        self.token = d["authorizationToken"]
+        self.account_id = d["accountId"]
+
+    def _api(self, verb: str, body: dict) -> requests.Response:
+        """POST a b2api call; a 401 re-authorizes once (B2 tokens
+        expire within 24h — a long-running replicator must renew)."""
+        for attempt in (0, 1):
+            r = self._sess.post(
+                f"{self.api_url}/b2api/v2/{verb}", json=body,
+                headers={"Authorization": self.token}, timeout=60)
+            if r.status_code == 401 and attempt == 0:
+                self._authorize()
+                continue
+            return r
+        return r
+
+    def _key(self, path: str) -> str:
+        return _prefixed_key(self.prefix, path)
+
+    def create_entry(self, path: str, entry: Entry,
+                     read_data: DataReader) -> None:
+        if entry.is_directory:
+            return
+        import hashlib
+        import urllib.parse
+
+        data = read_data()
+        r = self._api("b2_get_upload_url",
+                      {"bucketId": self.bucket_id})
+        r.raise_for_status()
+        up = r.json()
+        r = self._sess.post(
+            up["uploadUrl"], data=data, headers={
+                "Authorization": up["authorizationToken"],
+                "X-Bz-File-Name": urllib.parse.quote(self._key(path)),
+                "Content-Type": entry.mime or "b2/x-auto",
+                "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
+            }, timeout=300)
+        r.raise_for_status()
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        r = self._api("b2_hide_file",
+                      {"bucketId": self.bucket_id,
+                       "fileName": self._key(path)})
+        if r.status_code == 200:
+            return
+        try:
+            code = r.json().get("code")
+        except ValueError:  # non-JSON error body (proxy, LB)
+            code = None
+        if code not in ("no_such_file", "already_hidden"):
+            r.raise_for_status()
+
+
 def make_sink(kind: str, **kwargs) -> ReplicationSink:
-    sinks = {"filer": FilerSink, "local": LocalSink, "s3": S3Sink}
+    sinks = {"filer": FilerSink, "local": LocalSink, "s3": S3Sink,
+             "gcs": GcsSink, "azure": AzureSink, "b2": B2Sink}
     if kind not in sinks:
-        raise KeyError(f"unknown sink {kind!r}; have {sorted(sinks)} "
-                       "(gcs/azure/b2 need cloud SDKs absent here)")
+        raise KeyError(f"unknown sink {kind!r}; have {sorted(sinks)}")
     return sinks[kind](**kwargs)

@@ -1,0 +1,342 @@
+"""Mini cloud-storage doubles: fake GCS (JSON API), fake Azure Blob
+(XML REST + SharedKey verification), and fake Backblaze B2 (native
+API) — the fake-gcs-server / Azurite role for the raw-REST remote
+clients and replication sinks, in-process over http.server.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.parse
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Store:
+    def __init__(self):
+        self.buckets: dict[str, dict[str, tuple[bytes, float]]] = {}
+        self.lock = threading.Lock()
+
+
+def _start(handler_cls, store) -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    srv.store = store
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# fake GCS (storage JSON API v1)
+# ---------------------------------------------------------------------------
+class _GcsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _obj_meta(self, bucket, name, data, mtime):
+        return {"name": name, "bucket": bucket,
+                "size": str(len(data)),
+                "updated": time.strftime(
+                    "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(mtime)),
+                "md5Hash": base64.b64encode(
+                    hashlib.md5(data).digest()).decode()}
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        parts = u.path.strip("/").split("/")
+        store = self.server.store
+        with store.lock:
+            if u.path == "/storage/v1/b":  # list buckets
+                return self._json(200, {"items": [
+                    {"name": b} for b in sorted(store.buckets)]})
+            if len(parts) == 4 and parts[:2] == ["storage", "v1"]:
+                # /storage/v1/b/{bucket}/o is len 5; len 4 invalid
+                pass
+            if len(parts) == 5 and parts[4] == "o":  # list objects
+                bucket = parts[3]
+                objs = store.buckets.get(bucket, {})
+                prefix = q.get("prefix", "")
+                items = [self._obj_meta(bucket, k, d, m)
+                         for k, (d, m) in sorted(objs.items())
+                         if k.startswith(prefix)]
+                return self._json(200, {"items": items})
+            if len(parts) == 6 and parts[4] == "o":  # object meta/media
+                bucket, name = parts[3], urllib.parse.unquote(parts[5])
+                obj = store.buckets.get(bucket, {}).get(name)
+                if obj is None:
+                    return self._json(404, {"error": {"code": 404}})
+                data, mtime = obj
+                if q.get("alt") == "media":
+                    rng = self.headers.get("Range", "")
+                    code = 200
+                    if rng.startswith("bytes="):
+                        s, _, e = rng[6:].partition("-")
+                        start = int(s or 0)
+                        end = int(e) if e else len(data) - 1
+                        data = data[start:end + 1]
+                        code = 206
+                    self.send_response(code)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                return self._json(
+                    200, self._obj_meta(bucket, name, data, mtime))
+        self._json(404, {"error": {"code": 404}})
+
+    def do_POST(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        parts = u.path.strip("/").split("/")
+        store = self.server.store
+        if len(parts) == 6 and parts[0] == "upload" and parts[5] == "o":
+            bucket = parts[4]
+            name = q["name"]
+            n = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(n)
+            with store.lock:
+                store.buckets.setdefault(bucket, {})[name] = \
+                    (data, time.time())
+            return self._json(
+                200, self._obj_meta(bucket, name, data, time.time()))
+        self._json(404, {"error": {"code": 404}})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        parts = u.path.strip("/").split("/")
+        store = self.server.store
+        if len(parts) == 6 and parts[4] == "o":
+            bucket, name = parts[3], urllib.parse.unquote(parts[5])
+            with store.lock:
+                existed = store.buckets.get(bucket, {}).pop(name, None)
+            code = 204 if existed is not None else 404
+            self.send_response(code)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._json(404, {"error": {"code": 404}})
+
+
+class MiniGcs:
+    def __init__(self):
+        self.store = _Store()
+        self._srv = _start(_GcsHandler, self.store)
+        self.port = self._srv.server_port
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fake Azure Blob (REST XML + SharedKey check)
+# ---------------------------------------------------------------------------
+class _AzureHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _verify_auth(self) -> bool:
+        from seaweedfs_tpu.remote_storage.azure_client import \
+            shared_key_signature
+
+        got = self.headers.get("Authorization", "")
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        headers = dict(self.headers.items())
+        expect = shared_key_signature(
+            self.server.account, self.server.key,
+            self.command, urllib.parse.unquote(u.path), q, headers)
+        return hmac.compare_digest(got, expect)
+
+    def _respond(self, code: int, body: bytes = b"",
+                 headers: dict | None = None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD" and body:
+            self.wfile.write(body)
+
+    def _route(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        path = urllib.parse.unquote(u.path)
+        store = self.server.store
+        if not self._verify_auth():
+            return self._respond(403, b"<Error>auth</Error>")
+        if self.command == "GET" and path == "/" and \
+                q.get("comp") == "list":
+            xml = "<EnumerationResults><Containers>" + "".join(
+                f"<Container><Name>{c}</Name></Container>"
+                for c in sorted(store.buckets)) + \
+                "</Containers></EnumerationResults>"
+            return self._respond(200, xml.encode())
+        container, _, blob = path.lstrip("/").partition("/")
+        with store.lock:
+            objs = store.buckets.setdefault(container, {})
+            if not blob and q.get("comp") == "list":
+                prefix = q.get("prefix", "")
+                xml = "<EnumerationResults><Blobs>"
+                for k, (d, m) in sorted(objs.items()):
+                    if not k.startswith(prefix):
+                        continue
+                    lm = formatdate(m, usegmt=True)
+                    xml += (f"<Blob><Name>{k}</Name><Properties>"
+                            f"<Content-Length>{len(d)}</Content-Length>"
+                            f"<Last-Modified>{lm}</Last-Modified>"
+                            f"<Etag>0x{hashlib.md5(d).hexdigest()}"
+                            "</Etag></Properties></Blob>")
+                xml += "</Blobs><NextMarker/></EnumerationResults>"
+                return self._respond(200, xml.encode())
+            if self.command == "PUT" and blob:
+                n = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(n)
+                objs[blob] = (data, time.time())
+                return self._respond(
+                    201, headers={"Etag": "0x" +
+                                  hashlib.md5(data).hexdigest()})
+            if self.command in ("GET", "HEAD") and blob:
+                obj = objs.get(blob)
+                if obj is None:
+                    return self._respond(404)
+                data, m = obj
+                rng = self.headers.get("x-ms-range", "")
+                code = 200
+                if rng.startswith("bytes="):
+                    s, _, e = rng[6:].partition("-")
+                    start = int(s or 0)
+                    end = int(e) if e else len(data) - 1
+                    data = data[start:end + 1]
+                    code = 206
+                return self._respond(code, data, {
+                    "Last-Modified": formatdate(m, usegmt=True),
+                    "Etag": "0x" + hashlib.md5(obj[0]).hexdigest()})
+            if self.command == "DELETE" and blob:
+                existed = objs.pop(blob, None)
+                return self._respond(
+                    202 if existed is not None else 404)
+        self._respond(400, b"<Error>bad request</Error>")
+
+    do_GET = do_PUT = do_DELETE = do_HEAD = _route
+
+
+class MiniAzure:
+    def __init__(self, account: str = "devstore",
+                 key: str | None = None):
+        self.account = account
+        self.key = key or base64.b64encode(b"miniazurekey0123").decode()
+        self.store = _Store()
+        self._srv = _start(_AzureHandler, self.store)
+        self._srv.account = self.account
+        self._srv.key = self.key
+        self.port = self._srv.server_port
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fake Backblaze B2 (native API subset)
+# ---------------------------------------------------------------------------
+class _B2Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.endswith("/b2_authorize_account"):
+            auth = self.headers.get("Authorization", "")
+            if not auth.startswith("Basic "):
+                return self._json(401, {"code": "unauthorized"})
+            kid, _, akey = base64.b64decode(
+                auth[6:]).decode().partition(":")
+            if (kid, akey) != (self.server.key_id, self.server.app_key):
+                return self._json(401, {"code": "unauthorized"})
+            base = f"http://127.0.0.1:{self.server.server_port}"
+            return self._json(200, {
+                "accountId": "acct1", "apiUrl": base,
+                "downloadUrl": base, "authorizationToken": "tok-api"})
+        self._json(404, {"code": "not_found"})
+
+    def do_POST(self):
+        store = self.server.store
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.path.endswith("/b2_list_buckets"):
+            req = json.loads(body)
+            name = req.get("bucketName")
+            out = [{"bucketId": f"id-{b}", "bucketName": b}
+                   for b in sorted(store.buckets)
+                   if not name or b == name]
+            return self._json(200, {"buckets": out})
+        if self.path.endswith("/b2_get_upload_url"):
+            req = json.loads(body)
+            bid = req["bucketId"]
+            base = f"http://127.0.0.1:{self.server.server_port}"
+            return self._json(200, {
+                "uploadUrl": f"{base}/upload/{bid}",
+                "authorizationToken": "tok-upload"})
+        if self.path.endswith("/b2_hide_file"):
+            req = json.loads(body)
+            bucket = req["bucketId"][3:]
+            with store.lock:
+                existed = store.buckets.get(bucket, {}).pop(
+                    req["fileName"], None)
+            if existed is None:
+                return self._json(400, {"code": "no_such_file"})
+            return self._json(200, {"fileName": req["fileName"]})
+        if self.path.startswith("/upload/"):
+            if self.headers.get("Authorization") != "tok-upload":
+                return self._json(401, {"code": "unauthorized"})
+            bucket = self.path[len("/upload/id-"):]
+            name = urllib.parse.unquote(
+                self.headers.get("X-Bz-File-Name", ""))
+            if hashlib.sha1(body).hexdigest() != \
+                    self.headers.get("X-Bz-Content-Sha1"):
+                return self._json(400, {"code": "bad_hash"})
+            with store.lock:
+                store.buckets.setdefault(bucket, {})[name] = \
+                    (body, time.time())
+            return self._json(200, {"fileName": name,
+                                    "contentLength": len(body)})
+        self._json(404, {"code": "not_found"})
+
+
+class MiniB2:
+    def __init__(self, key_id: str = "kid", app_key: str = "akey"):
+        self.store = _Store()
+        self._srv = _start(_B2Handler, self.store)
+        self._srv.key_id = key_id
+        self._srv.app_key = app_key
+        self.port = self._srv.server_port
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._srv.shutdown()

@@ -1,0 +1,211 @@
+"""Minimal Kafka broker double: Metadata v1 + Produce v3 server side.
+
+Parses record-batch v2 frames (magic 2) INCLUDING the CRC32C check —
+a framing bug in the producer fails loudly here, not silently. Stores
+records per (topic, partition) for test assertions. The minimongo /
+minicassandra role for the Kafka wire.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import google_crc32c
+
+from seaweedfs_tpu.notification.kafka_lite import API_METADATA, \
+    API_PRODUCE
+
+
+def _read_varint(buf: bytes, at: int) -> tuple[int, int]:
+    shift = 0
+    u = 0
+    while True:
+        b = buf[at]
+        at += 1
+        u |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (u >> 1) ^ -(u & 1), at  # un-zigzag
+
+
+def parse_record_batch(batch: bytes) -> list[tuple[bytes, bytes]]:
+    """-> [(key, value)] after verifying magic + CRC32C."""
+    magic = batch[16]
+    if magic != 2:
+        raise ValueError(f"record batch magic {magic} != 2")
+    (crc,) = struct.unpack_from(">I", batch, 17)
+    after = batch[21:]
+    if google_crc32c.value(after) != crc:
+        raise ValueError("record batch CRC mismatch")
+    (count,) = struct.unpack_from(">i", after, 36)
+    at = 40
+    out = []
+    for _ in range(count):
+        _length, at = _read_varint(after, at)
+        at += 1  # attributes
+        _ts, at = _read_varint(after, at)
+        _off, at = _read_varint(after, at)
+        klen, at = _read_varint(after, at)
+        key = after[at:at + max(0, klen)]
+        at += max(0, klen)
+        vlen, at = _read_varint(after, at)
+        value = after[at:at + max(0, vlen)]
+        at += max(0, vlen)
+        n_headers, at = _read_varint(after, at)
+        for _ in range(n_headers):
+            hk, at = _read_varint(after, at)
+            at += max(0, hk)
+            hv, at = _read_varint(after, at)
+            at += max(0, hv)
+        out.append((key, value))
+    return out
+
+
+class MiniKafka:
+    def __init__(self, topics: dict[str, int] | None = None):
+        """topics: name -> partition count (default: seaweedfs_filer/2)."""
+        self.topics = topics or {"seaweedfs_filer": 2}
+        # (topic, partition) -> list of (key, value)
+        self.records: dict[tuple[str, int], list] = {}
+        self.lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        out = b""
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return out
+
+    @staticmethod
+    def _str(s: str) -> bytes:
+        b = s.encode()
+        return struct.pack(">h", len(b)) + b
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                raw = self._recv_exact(conn, 4)
+                if raw is None:
+                    return
+                (size,) = struct.unpack(">i", raw)
+                req = self._recv_exact(conn, size)
+                if req is None:
+                    return
+                api, ver, corr = struct.unpack_from(">hhi", req)
+                at = 8
+                (cid_len,) = struct.unpack_from(">h", req, at)
+                at += 2 + max(0, cid_len)
+                if api == API_METADATA:
+                    resp = self._metadata(req[at:])
+                elif api == API_PRODUCE and ver == 3:
+                    resp = self._produce(req[at:])
+                else:
+                    return  # unsupported: drop the connection
+                payload = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(payload)) + payload)
+        except (OSError, ValueError, IndexError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    def _metadata(self, body: bytes) -> bytes:
+        (n,) = struct.unpack_from(">i", body)
+        at = 4
+        wanted = []
+        for _ in range(max(0, n)):
+            (ln,) = struct.unpack_from(">h", body, at)
+            at += 2
+            wanted.append(body[at:at + ln].decode())
+            at += ln
+        if not wanted:
+            wanted = sorted(self.topics)
+        out = struct.pack(">i", 1)  # one broker
+        out += struct.pack(">i", 1) + self._str("127.0.0.1") + \
+            struct.pack(">i", self.port) + struct.pack(">h", -1)
+        out += struct.pack(">i", 1)  # controller id
+        out += struct.pack(">i", len(wanted))
+        for t in wanted:
+            known = t in self.topics
+            out += struct.pack(">h", 0 if known else 3)  # 3 = unknown
+            out += self._str(t) + b"\x00"
+            nparts = self.topics.get(t, 0)
+            out += struct.pack(">i", nparts)
+            for pid in range(nparts):
+                out += struct.pack(">hii", 0, pid, 1)
+                out += struct.pack(">ii", 1, 1)   # replicas [1]
+                out += struct.pack(">ii", 1, 1)   # isr [1]
+        return out
+
+    def _produce(self, body: bytes) -> bytes:
+        at = 0
+        (tx_len,) = struct.unpack_from(">h", body, at)
+        at += 2 + max(0, tx_len)
+        _acks, _timeout = struct.unpack_from(">hi", body, at)
+        at += 6
+        (n_topics,) = struct.unpack_from(">i", body, at)
+        at += 4
+        resp_topics = b""
+        for _ in range(n_topics):
+            (tlen,) = struct.unpack_from(">h", body, at)
+            at += 2
+            topic = body[at:at + tlen].decode()
+            at += tlen
+            (n_parts,) = struct.unpack_from(">i", body, at)
+            at += 4
+            part_resp = b""
+            for _ in range(n_parts):
+                (pid,) = struct.unpack_from(">i", body, at)
+                at += 4
+                (blen,) = struct.unpack_from(">i", body, at)
+                at += 4
+                batch = body[at:at + blen]
+                at += blen
+                err = 0
+                base = 0
+                if topic not in self.topics or \
+                        pid >= self.topics[topic]:
+                    err = 3  # unknown topic or partition
+                else:
+                    try:
+                        recs = parse_record_batch(batch)
+                    except ValueError:
+                        err = 2  # corrupt message
+                    else:
+                        with self.lock:
+                            log = self.records.setdefault(
+                                (topic, pid), [])
+                            base = len(log)
+                            log.extend(recs)
+                part_resp += struct.pack(">ihqq", pid, err, base, -1)
+            resp_topics += self._str(topic) + \
+                struct.pack(">i", n_parts) + part_resp
+        return struct.pack(">i", n_topics) + resp_topics + \
+            struct.pack(">i", 0)  # throttle

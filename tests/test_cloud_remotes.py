@@ -1,0 +1,252 @@
+"""GCS / Azure / B2 over raw REST: remote-storage clients, replication
+sinks, and a fake-GCS remote.mount end to end. Reference slots:
+/root/reference/weed/remote_storage/gcs/gcs_storage_client.go:21,
+azure/azure_storage_client.go:23, replication/sink/gcssink/gcs_sink.go:18,
+azuresink/azure_sink.go:20, b2sink/b2_sink.go:17.
+"""
+import json
+import shutil
+import subprocess
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.remote_storage import make_client
+from seaweedfs_tpu.replication.sink import make_sink
+
+from .minicloud import MiniAzure, MiniB2, MiniGcs
+
+
+@pytest.fixture(scope="module")
+def gcs():
+    s = MiniGcs()
+    s.store.buckets["pics"] = {}
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def azure():
+    s = MiniAzure()
+    s.store.buckets["pics"] = {}
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def b2():
+    s = MiniB2()
+    s.store.buckets["pics"] = {}
+    yield s
+    s.close()
+
+
+# -- clients ------------------------------------------------------------
+
+CLIENT_CONFS = {
+    "gcs": lambda s: {"type": "gcs", "bucket": "pics",
+                      "endpoint": s.endpoint},
+    "azure": lambda s: {"type": "azure", "account": s.account,
+                        "key": s.key, "container": "pics",
+                        "endpoint": s.endpoint},
+}
+
+
+@pytest.mark.parametrize("kind", ["gcs", "azure"])
+def test_client_roundtrip(kind, request):
+    server = request.getfixturevalue(kind)
+    server.store.buckets["pics"].clear()
+    c = make_client(CLIENT_CONFS[kind](server))
+    c.write_file("a/b.txt", b"hello-cloud")
+    assert c.read_file("a/b.txt") == b"hello-cloud"
+    assert c.read_file("a/b.txt", offset=6, size=5) == b"cloud"
+    assert c.head("a/b.txt").size == 11
+    assert c.head("missing") is None
+    c.write_file("a/c.txt", b"x")
+    c.write_file("z.txt", b"y")
+    assert [e.key for e in c.traverse()] == ["a/b.txt", "a/c.txt",
+                                             "z.txt"]
+    assert [e.key for e in c.traverse(prefix="a/")] == ["a/b.txt",
+                                                        "a/c.txt"]
+    assert "pics" in c.list_buckets()
+    c.delete_file("a/b.txt")
+    assert c.head("a/b.txt") is None
+    c.delete_file("a/b.txt")  # idempotent
+
+
+def test_azure_bad_key_rejected(azure):
+    import base64
+
+    c = make_client({"type": "azure", "account": azure.account,
+                     "key": base64.b64encode(b"wrongkey").decode(),
+                     "container": "pics", "endpoint": azure.endpoint})
+    with pytest.raises(requests.HTTPError):
+        c.write_file("x", b"y")
+
+
+# -- RS256 (service-account JWT signing) --------------------------------
+
+def test_rs256_matches_openssl(tmp_path):
+    openssl = shutil.which("openssl")
+    if not openssl:
+        pytest.skip("no openssl binary")
+    key_pem = tmp_path / "k.pem"
+    subprocess.run([openssl, "genrsa", "-out", str(key_pem), "2048"],
+                   check=True, capture_output=True)
+    msg = b"header.payload"
+    msg_f = tmp_path / "msg"
+    msg_f.write_bytes(msg)
+    expected = subprocess.run(
+        [openssl, "dgst", "-sha256", "-sign", str(key_pem),
+         str(msg_f)], check=True, capture_output=True).stdout
+
+    from seaweedfs_tpu.utils import rs256
+
+    assert rs256.sign(key_pem.read_text(), msg) == expected
+
+
+# -- sinks --------------------------------------------------------------
+
+def _file_entry(mime=""):
+    return Entry(full_path="/docs/report.bin", mime=mime,
+                 chunks=[])
+
+
+@pytest.mark.parametrize("kind", ["gcs", "azure", "b2"])
+def test_sink_create_update_delete(kind, request):
+    server = request.getfixturevalue(kind)
+    server.store.buckets["pics"].clear()
+    if kind == "gcs":
+        sink = make_sink("gcs", bucket="pics", prefix="backup",
+                         endpoint=server.endpoint)
+    elif kind == "azure":
+        sink = make_sink("azure", container="pics", prefix="backup",
+                         account=server.account, key=server.key,
+                         endpoint=server.endpoint)
+    else:
+        sink = make_sink("b2", bucket="pics", prefix="backup",
+                         key_id="kid", application_key="akey",
+                         api_base=server.endpoint)
+    sink.create_entry("/docs/report.bin", _file_entry(),
+                      lambda: b"v1-bytes")
+    assert server.store.buckets["pics"]["backup/docs/report.bin"][0] \
+        == b"v1-bytes"
+    sink.update_entry("/docs/report.bin", _file_entry(),
+                      lambda: b"v2-bytes")
+    assert server.store.buckets["pics"]["backup/docs/report.bin"][0] \
+        == b"v2-bytes"
+    # directories are flat no-ops
+    sink.create_entry("/docs", Entry(full_path="/docs", mode=0o40755),
+                      lambda: b"")
+    sink.delete_entry("/docs/report.bin", is_directory=False)
+    assert "backup/docs/report.bin" not in server.store.buckets["pics"]
+    sink.delete_entry("/docs/report.bin", is_directory=False)  # gone ok
+
+
+def test_b2_bad_credentials(b2):
+    with pytest.raises(requests.HTTPError):
+        make_sink("b2", bucket="pics", key_id="kid",
+                  application_key="wrong", api_base=b2.endpoint)
+
+
+# -- fake-GCS bucket mounted end to end ---------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from seaweedfs_tpu.server.cluster import Cluster
+
+    c = Cluster(str(tmp_path_factory.mktemp("gcs_mount")),
+                n_volume_servers=1, volume_size_limit=8 << 20,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+def test_remote_mount_fake_gcs(cluster, gcs):
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.repl import run_command
+
+    gcs.store.buckets["pics"] = {}
+    c = make_client({"type": "gcs", "bucket": "pics",
+                     "endpoint": gcs.endpoint})
+    c.write_file("photos/a.jpg", b"JPEG" * 100)
+    c.write_file("readme.txt", b"top-level")
+
+    env = CommandEnv(cluster.master_url, filer_url=cluster.filer_url)
+    env.acquire_lock()
+    try:
+        out = run_command(
+            env, f"remote.configure -name=gcloud -type=gcs "
+                 f"-bucket=pics -endpoint={gcs.endpoint}")
+        assert out == {"gcloud": "gcs"}
+        out = run_command(env, "remote.mount -dir=/gcs -remote=gcloud")
+        assert out["created"] == 2
+
+        # read-through GET serves the cloud bytes via the JSON API
+        r = requests.get(f"{cluster.filer_url}/gcs/photos/a.jpg")
+        assert r.status_code == 200 and r.content == b"JPEG" * 100
+        r = requests.get(f"{cluster.filer_url}/gcs/readme.txt",
+                         headers={"Range": "bytes=4-8"})
+        assert r.status_code == 206 and r.content == b"level"
+
+        # cache then uncache round-trips through cluster chunks
+        out = run_command(env, "remote.cache -dir=/gcs")
+        assert out["cached"] == 2
+        meta = requests.get(f"{cluster.filer_url}/gcs/photos/a.jpg",
+                            params={"meta": "1"}).json()
+        assert meta["chunks"]
+        out = run_command(env, "remote.uncache -dir=/gcs")
+        assert out["uncached"] == 2
+
+        # upstream change picked up by meta sync
+        c.write_file("new.bin", b"fresh")
+        c.delete_file("readme.txt")
+        out = run_command(env, "remote.meta.sync -dir=/gcs")
+        assert out["created"] == 1 and out["removed"] == 1
+        assert requests.get(
+            f"{cluster.filer_url}/gcs/new.bin").content == b"fresh"
+        run_command(env, "remote.unmount -dir=/gcs")
+    finally:
+        env.close()
+
+
+def test_azure_shared_key_string_to_sign_vector():
+    """Non-circular signature check: the string-to-sign for a fixed
+    request is spelled out literally per the published SharedKey
+    scheme (method, 11 standard headers with zero Content-Length
+    blanked, canonicalized x-ms-* headers, /account/path + sorted
+    query lines) and HMAC'd independently of the production code."""
+    import base64 as b64
+    import hashlib as hl
+    import hmac as hm
+
+    from seaweedfs_tpu.remote_storage.azure_client import \
+        shared_key_signature
+
+    key = b64.b64encode(b"0123456789abcdef").decode()
+    headers = {"x-ms-date": "Thu, 30 Jul 2026 12:00:00 GMT",
+               "x-ms-version": "2020-10-02",
+               "x-ms-blob-type": "BlockBlob",
+               "Content-Length": "0",
+               "Range": "bytes=0-99"}
+    query = {"restype": "container", "comp": "list", "prefix": ""}
+    expected_sts = (
+        "GET\n"        # method
+        "\n\n"         # content-encoding, content-language
+        "\n"           # content-length: "0" canonicalizes to empty
+        "\n\n"         # content-md5, content-type
+        "\n"           # date (always empty; x-ms-date rules)
+        "\n\n\n\n"     # if-modified/match/none-match/unmodified
+        "bytes=0-99\n"  # range
+        "x-ms-blob-type:BlockBlob\n"
+        "x-ms-date:Thu, 30 Jul 2026 12:00:00 GMT\n"
+        "x-ms-version:2020-10-02\n"
+        "/myacct/pics/a b.txt"
+        "\ncomp:list\nprefix:\nrestype:container")
+    mac = hm.new(b"0123456789abcdef", expected_sts.encode(),
+                 hl.sha256).digest()
+    expected = f"SharedKey myacct:{b64.b64encode(mac).decode()}"
+    got = shared_key_signature("myacct", key, "GET", "/pics/a b.txt",
+                               query, headers)
+    assert got == expected

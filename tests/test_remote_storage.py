@@ -43,7 +43,13 @@ class TestClients:
         with pytest.raises(KeyError, match="unknown"):
             make_client({"type": "nope"})
         with pytest.raises(KeyError, match="cloud SDK"):
+            make_client({"type": "hdfs"})
+        # gcs/azure are real in-tree REST clients now: they fail on
+        # missing required config, not on a missing SDK
+        with pytest.raises(ValueError, match="bucket"):
             make_client({"type": "gcs"})
+        with pytest.raises(ValueError, match="account"):
+            make_client({"type": "azure"})
 
 
 @pytest.fixture(scope="module")

@@ -209,8 +209,12 @@ class TestGatedQueues:
         import pytest as _pytest
 
         from seaweedfs_tpu.notification.queues import make_queue
-        for kind in ("kafka", "aws_sqs", "google_pub_sub"):
+        for kind in ("aws_sqs", "google_pub_sub"):
             with _pytest.raises(ImportError):
                 make_queue(kind)
+        # kafka is a real in-tree wire producer now: with no broker
+        # listening it fails at connect, not at import
+        with _pytest.raises(OSError):
+            make_queue("kafka", hosts="127.0.0.1:1")
         with _pytest.raises(KeyError):
             make_queue("rabbitmq")
