@@ -40,31 +40,40 @@ def _contig_view(row: np.ndarray):
 
 
 class _AsyncWriter:
-    """Single background thread draining an ordered (file, array) queue.
+    """Background thread pool draining ordered (file, array) queues.
 
-    File writes are the measured bottleneck of the e2e encode (~200-400
-    MB/s page-cache speed on one core vs ~3 GB/s codec); pushing them
+    File writes are the measured bottleneck of the e2e encode
+    (page-cache memcpy + write-back vs ~3 GB/s codec); pushing them
     off the producer thread overlaps write-back with gather + codec
-    dispatch. One thread, one queue: per-file write order is the global
-    enqueue order, which callers already emit correctly."""
+    dispatch. Each output file is pinned to ONE thread (first-seen
+    round-robin), so per-file write order is the enqueue order while
+    different shard files write concurrently — write() drops the GIL,
+    so even one core overlaps the page-cache copies with codec work,
+    and real disks see >1 outstanding stream."""
 
-    def __init__(self, max_pending_bytes: int = 256 << 20):
+    def __init__(self, max_pending_bytes: int = 256 << 20,
+                 threads: int = 4):
         import queue
         import threading
 
-        self._q: "queue.Queue" = queue.Queue()
+        self._qs = [queue.Queue() for _ in range(max(1, threads))]
+        self._affinity: dict[int, int] = {}  # id(file) -> queue index
+        self._next = 0
         self._err: list[BaseException] = []
         # backpressure is byte-denominated, not item-count: a 16-item
         # bound at 32MB rows would pin ~512MB of blocks alive
         self._max = max_pending_bytes
         self._bytes = 0
         self._cond = threading.Condition()
-        self._t = threading.Thread(target=self._run, daemon=True)
-        self._t.start()
+        self._threads = [
+            threading.Thread(target=self._run, args=(q,), daemon=True)
+            for q in self._qs]
+        for t in self._threads:
+            t.start()
 
-    def _run(self) -> None:
+    def _run(self, q) -> None:
         while True:
-            item = self._q.get()
+            item = q.get()
             if item is None:
                 return
             f, arr = item
@@ -90,11 +99,17 @@ class _AsyncWriter:
             while self._bytes >= self._max and not self._err:
                 self._cond.wait()
             self._bytes += arr.nbytes
-        self._q.put((f, arr))
+        qi = self._affinity.get(id(f))
+        if qi is None:
+            qi = self._affinity[id(f)] = self._next % len(self._qs)
+            self._next += 1
+        self._qs[qi].put((f, arr))
 
     def close(self) -> None:
-        self._q.put(None)
-        self._t.join()
+        for q in self._qs:
+            q.put(None)
+        for t in self._threads:
+            t.join()
         if self._err:
             raise self._err[0]
 
@@ -169,11 +184,22 @@ def write_ec_files(base: str, backend: str = "auto",
 
 
 def _region_blocks(dat: np.ndarray, start: int, n_rows: int,
-                   block: int, chunk: int, k: int = geo.DATA_SHARDS):
+                   block: int, chunk: int, k: int = geo.DATA_SHARDS,
+                   wide: bool = True):
     """Yield the (k, w) codec input blocks for `n_rows` stripe rows of
     `block`-sized blocks starting at file offset `start`, in shard-file
-    write order."""
+    write order.
+
+    wide=True packs many rows per dispatch via a transpose gather —
+    right for device codecs, whose per-dispatch cost (relay RTT, jit
+    launch) dwarfs the strided copy. wide=False walks one stripe row
+    at a time: a full row is a CONTIGUOUS window of the .dat, so the
+    codec input is a zero-copy reshape view — no gather at all except
+    the zero-padded tail row. Right for CPU codecs, where the
+    transpose copy was the measured residual between encode speed and
+    the disk ceiling."""
     row_bytes = block * k
+    total = dat.shape[0]
     if block >= chunk:
         # large blocks: walk one row at a time, column-chunked
         for r in range(n_rows):
@@ -182,13 +208,26 @@ def _region_blocks(dat: np.ndarray, start: int, n_rows: int,
                 c1 = min(c0 + chunk, block)
                 yield _gather_columns(dat, row_start, block, c0, c1, k)
         return
-    # small blocks: pack many rows per dispatch
+    if not wide:
+        for r in range(n_rows):
+            row_start = start + r * row_bytes
+            if row_start + row_bytes <= total:
+                yield dat[row_start:row_start + row_bytes] \
+                    .reshape(k, block)
+            else:  # tail row: zero-pad past EOF
+                flat = np.zeros(row_bytes, dtype=np.uint8)
+                avail = max(0, total - row_start)
+                if avail:
+                    flat[:avail] = dat[row_start:row_start + avail]
+                yield flat.reshape(k, block)
+        return
+    # small blocks, wide: pack many rows per dispatch
     rows_per = max(1, chunk // block)
     for r0 in range(0, n_rows, rows_per):
         r1 = min(r0 + rows_per, n_rows)
         span_start = start + r0 * row_bytes
         span_len = (r1 - r0) * row_bytes
-        avail = max(0, min(span_len, dat.shape[0] - span_start))
+        avail = max(0, min(span_len, total - span_start))
         if avail == span_len:
             # full span: transpose straight off the memmap — one
             # strided copy instead of flat-copy + transpose-copy
@@ -212,11 +251,15 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
     on a device codec so H2D, MXU compute, and D2H overlap instead of
     serializing per block."""
     k = rs.k
+    # CPU codecs take narrow zero-copy row views (the transpose gather
+    # was their residual overhead); device codecs get wide packed
+    # dispatches that amortize relay/launch latency
+    wide = getattr(rs.backend, "name", "") not in ("numpy", "native")
     w = _AsyncWriter()
     try:
         def gen():
             for data in _region_blocks(dat, start, n_rows, block, chunk,
-                                       k):
+                                       k, wide=wide):
                 for i in range(k):
                     w.put(outs[i], data[i])
                 yield data

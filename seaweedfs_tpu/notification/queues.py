@@ -94,69 +94,110 @@ class KafkaQueue(NotificationQueue):
     producer (kafka_lite.py: Metadata v1 + Produce v3) — the slot of
     /root/reference/weed/notification/kafka/kafka_queue.go:15, JSON
     payloads instead of protobuf. Events for one path land on one
-    partition (key-hash routing), keeping per-file event order."""
+    partition (key-hash routing), keeping per-file event order; each
+    produce goes to that partition's LEADER broker from metadata, with
+    a refresh + one retry on NOT_LEADER / transport failure.
+
+    Delivery is at-least-once, like the reference's sarama producer: a
+    response lost after the request landed is retried and may
+    duplicate the event; definitive broker rejections (message too
+    large, ...) are never retried."""
 
     name = "kafka"
+
+    NOT_LEADER = 6
+    _RETRIABLE = (3, 5, 6)  # unknown-topic / leader-not-avail / not-leader
 
     def __init__(self, hosts: str = "127.0.0.1:9092",
                  topic: str = "seaweedfs_filer",
                  metadata_retries: int = 5, **_):
-        import time as _time
-
-        from .kafka_lite import KafkaClient
-
         self.topic = topic
         host, _, port = hosts.split(",")[0].partition(":")
         self._bootstrap = (host, int(port or 9092))
-        self._c = KafkaClient(host, int(port or 9092))
-        # the first Metadata for a missing topic TRIGGERS auto-create
-        # on a standard broker but answers UNKNOWN_TOPIC(3) or
-        # LEADER_NOT_AVAILABLE(5); real clients retry until the leader
-        # settles (sarama does the same for the reference)
+        self._clients: dict[tuple[str, int], object] = {}
+        self._brokers: dict[int, tuple[str, int]] = {}
+        self._leaders: dict[int, int] = {}  # partition -> broker node
+        self._lock = threading.Lock()
+        self._refresh_metadata(metadata_retries)
+
+    def _client(self, addr: tuple[str, int]):
+        from .kafka_lite import KafkaClient
+
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = KafkaClient(*addr)
+        return c
+
+    def _drop_client(self, addr: tuple[str, int]) -> None:
+        c = self._clients.pop(addr, None)
+        if c is not None:
+            c.close()
+
+    def _refresh_metadata(self, retries: int = 5) -> None:
+        """Leader discovery. The first Metadata for a missing topic
+        TRIGGERS auto-create on a standard broker but answers
+        UNKNOWN_TOPIC(3) or LEADER_NOT_AVAILABLE(5); real clients
+        retry until the leaders settle (sarama does the same)."""
+        import time as _time
+
         t: dict = {}
-        for attempt in range(max(1, metadata_retries)):
-            md = self._c.metadata([topic])
-            t = md["topics"].get(topic, {})
+        md: dict = {}
+        for attempt in range(max(1, retries)):
+            md = self._client(self._bootstrap).metadata([self.topic])
+            t = md["topics"].get(self.topic, {})
             if t.get("error", 0) == 0 and t.get("partitions"):
                 break
-            if t.get("error") not in (3, 5):
+            if t.get("error") not in self._RETRIABLE:
                 break
             _time.sleep(0.2 * (attempt + 1))
         if t.get("error", 0) != 0 or not t.get("partitions"):
             raise KeyError(
-                f"kafka topic {topic!r} unavailable "
+                f"kafka topic {self.topic!r} unavailable "
                 f"(error {t.get('error')})")
-        self._partitions = sorted(t["partitions"])
-        self._lock = threading.Lock()
+        self._brokers = md["brokers"]
+        self._leaders = dict(t["partitions"])
+
+    def _leader_addr(self, pid: int) -> tuple[str, int]:
+        addr = self._brokers.get(self._leaders.get(pid, -1))
+        return tuple(addr) if addr else self._bootstrap
 
     def send(self, key: str, message: dict) -> None:
         import hashlib
         import time as _time
 
-        from .kafka_lite import KafkaClient, KafkaError
+        from .kafka_lite import KafkaError
 
-        pid = self._partitions[
-            int.from_bytes(hashlib.md5(key.encode()).digest()[:4],
-                           "big") % len(self._partitions)]
         value = json.dumps(message, separators=(",", ":")).encode()
         with self._lock:
-            try:
-                self._c.produce(self.topic, pid, key.encode(), value,
-                                int(_time.time() * 1000))
-            except KafkaError:
-                # a broker-level rejection (message too large, ...) is
-                # definitive; resending over a new connection would
-                # fail identically or double-commit a timed-out write
-                raise
-            except (IOError, OSError):
-                # one-shot reconnect: brokers recycle idle connections
-                self._c.close()
-                self._c = KafkaClient(*self._bootstrap)
-                self._c.produce(self.topic, pid, key.encode(), value,
-                                int(_time.time() * 1000))
+            pids = sorted(self._leaders)
+            pid = pids[int.from_bytes(
+                hashlib.md5(key.encode()).digest()[:4], "big")
+                % len(pids)]
+            for attempt in (0, 1):
+                addr = self._leader_addr(pid)
+                try:
+                    self._client(addr).produce(
+                        self.topic, pid, key.encode(), value,
+                        int(_time.time() * 1000))
+                    return
+                except KafkaError as e:
+                    # leadership moved: refresh and follow it once;
+                    # any other broker answer is definitive
+                    if e.code != self.NOT_LEADER or attempt:
+                        raise
+                    self._refresh_metadata()
+                except (IOError, OSError):
+                    # transport failure: reconnect via fresh metadata
+                    # and retry once (at-least-once — see class doc)
+                    self._drop_client(addr)
+                    if attempt:
+                        raise
+                    self._refresh_metadata()
 
     def close(self) -> None:
-        self._c.close()
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
 
 
 class AwsSqsQueue(_GatedQueue):

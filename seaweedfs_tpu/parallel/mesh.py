@@ -43,3 +43,25 @@ def stripe_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def pad_to_mesh(arr: np.ndarray, mesh: Mesh, batch_axis: int = 0,
+                col_axis: int = 2) -> tuple[np.ndarray, tuple[int, int]]:
+    """Zero-pad (batch, k, cols)-shaped host data so both sharded dims
+    divide the mesh — NamedSharding requires divisibility, and real
+    volumes rarely oblige. Returns (padded, (orig_batch, orig_cols));
+    callers slice outputs back with those. Zero stripes encode to zero
+    parity, so padding never perturbs scrub results."""
+    vol, col = mesh.devices.shape
+    b, c = arr.shape[batch_axis], arr.shape[col_axis]
+    pb = -(-b // vol) * vol
+    pc = -(-c // col) * col
+    if (pb, pc) == (b, c):
+        return arr, (b, c)
+    shape = list(arr.shape)
+    shape[batch_axis], shape[col_axis] = pb, pc
+    out = np.zeros(shape, dtype=arr.dtype)
+    sl = [slice(None)] * arr.ndim
+    sl[batch_axis], sl[col_axis] = slice(0, b), slice(0, c)
+    out[tuple(sl)] = np.asarray(arr)
+    return out, (b, c)

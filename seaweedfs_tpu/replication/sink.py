@@ -273,18 +273,27 @@ class B2Sink(ReplicationSink):
         import urllib.parse
 
         data = read_data()
-        r = self._api("b2_get_upload_url",
-                      {"bucketId": self.bucket_id})
-        r.raise_for_status()
-        up = r.json()
-        r = self._sess.post(
-            up["uploadUrl"], data=data, headers={
-                "Authorization": up["authorizationToken"],
-                "X-Bz-File-Name": urllib.parse.quote(self._key(path)),
-                "Content-Type": entry.mime or "b2/x-auto",
-                "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
-            }, timeout=300)
-        r.raise_for_status()
+        # B2's documented contract: uploads ROUTINELY fail with 503
+        # (pod busy) or 401 (expired upload token) and the client must
+        # fetch a fresh upload URL and retry — blazer, which the
+        # reference uses, does exactly this
+        for attempt in range(3):
+            r = self._api("b2_get_upload_url",
+                          {"bucketId": self.bucket_id})
+            r.raise_for_status()
+            up = r.json()
+            r = self._sess.post(
+                up["uploadUrl"], data=data, headers={
+                    "Authorization": up["authorizationToken"],
+                    "X-Bz-File-Name": urllib.parse.quote(
+                        self._key(path)),
+                    "Content-Type": entry.mime or "b2/x-auto",
+                    "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
+                }, timeout=300)
+            if r.status_code in (401, 503) and attempt < 2:
+                continue
+            r.raise_for_status()
+            return
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         if is_directory:

@@ -474,7 +474,7 @@ class S3ApiServer:
             src = req.headers.get("x-amz-copy-source", "")
             if src:
                 check(ACTION_READ, _src_bucket_of(src))
-                return await self._copy_object(bucket, key, src)
+                return await self._copy_object(bucket, key, src, req)
             return await self._put_object(bucket, key, payload, req)
         if m in ("GET", "HEAD"):
             check(ACTION_READ)
@@ -944,20 +944,52 @@ class S3ApiServer:
         await self._filer("DELETE", self._fpath(bucket, key))
         return web.Response(status=204)
 
-    async def _copy_object(self, bucket: str, key: str,
-                           src: str) -> web.Response:
+    async def _copy_object(self, bucket: str, key: str, src: str,
+                           req: web.Request) -> web.Response:
         await self._require_bucket(bucket)
         src = urllib.parse.unquote(src.lstrip("/"))
         src_bucket, _, src_key = src.partition("/")
+        # x-amz-metadata-directive (CopyObject API): COPY (default)
+        # carries the source's user metadata; REPLACE takes the
+        # request's x-amz-meta-* instead. A self-copy without REPLACE
+        # is rejected exactly like real S3 — it would be a no-op.
+        directive = req.headers.get(
+            "x-amz-metadata-directive", "COPY").upper()
+        if directive not in ("COPY", "REPLACE"):
+            raise S3Error("InvalidArgument",
+                          f"bad metadata directive {directive}", 400)
+        if (src_bucket, src_key) == (bucket, key) and \
+                directive == "COPY":
+            raise S3Error(
+                "InvalidRequest",
+                "This copy request is illegal because it is trying to "
+                "copy an object to itself without changing the "
+                "object's metadata", 400)
         meta = await self._entry_meta(src_bucket, src_key)
         data = await self._filer("GET", self._fpath(src_bucket, src_key))
         if data.status_code != 200:
             raise S3Error(*ERR_NO_SUCH_KEY)
+        headers = {"Content-Type": meta.get(
+            "mime", "application/octet-stream")}
+        if directive == "REPLACE":
+            # REPLACE swaps ALL metadata — including Content-Type,
+            # the field `aws s3 cp --metadata-directive REPLACE
+            # --content-type ...` self-copies exist to fix
+            if req.content_type and req.content_type != \
+                    "application/octet-stream":
+                headers["Content-Type"] = req.content_type
+            for k, v in req.headers.items():
+                if k.lower().startswith("x-amz-meta-"):
+                    name = k.lower()[len("x-amz-meta-"):]
+                    headers[f"x-seaweed-ext-s3_meta_{name}"] = v
+        else:
+            for k, v in (meta.get("extended") or {}).items():
+                if k.startswith("s3_meta_"):
+                    headers[f"x-seaweed-ext-{k}"] = str(v)
         resp = await self._filer(
             "POST", self._fpath(bucket, key),
             params={"collection": bucket}, data=data.content,
-            headers={"Content-Type": meta.get(
-                "mime", "application/octet-stream")})
+            headers=headers)
         if resp.status_code >= 300:
             raise S3Error("InternalError", resp.text, 500)
         etag = resp.json().get("etag", "")

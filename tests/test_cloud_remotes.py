@@ -250,3 +250,30 @@ def test_azure_shared_key_string_to_sign_vector():
     got = shared_key_signature("myacct", key, "GET", "/pics/a b.txt",
                                query, headers)
     assert got == expected
+
+
+def test_b2_upload_retries_on_503(b2, monkeypatch):
+    """B2's contract: uploads routinely 503; the sink must fetch a
+    fresh upload URL and retry (what blazer does for the reference)."""
+    from tests import minicloud
+
+    b2.store.buckets["pics"].clear()
+    sink = make_sink("b2", bucket="pics", key_id="kid",
+                     application_key="akey", api_base=b2.endpoint)
+    # first upload attempt answers 503, then the double recovers
+    orig = minicloud._B2Handler.do_POST
+    state = {"failed": False}
+
+    def flaky(self):
+        if self.path.startswith("/upload/") and not state["failed"]:
+            state["failed"] = True
+            # drain the body or the keep-alive connection desyncs
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            return self._json(503, {"code": "service_unavailable"})
+        return orig(self)
+
+    monkeypatch.setattr(minicloud._B2Handler, "do_POST", flaky)
+    sink.create_entry("/r/x.bin", Entry(full_path="/r/x.bin"),
+                      lambda: b"retried")
+    assert state["failed"]
+    assert b2.store.buckets["pics"]["r/x.bin"][0] == b"retried"

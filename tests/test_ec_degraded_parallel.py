@@ -219,3 +219,32 @@ def test_client_ec_cache_follows_shard_move(cluster):
     finally:
         mc.stop()
         env.close()
+
+
+def test_single_interval_reconstruct_latency_budget():
+    """Degraded-read latency budget (VERDICT r2 item 7): recovering ONE
+    1MB interval from k=10 shards through the Store's synchronous codec
+    must stay in single-digit-milliseconds territory on the CPU path —
+    the p50 the bench records (bench.py bench_degraded_read_p50). The
+    budget is deliberately loose (CI VMs share cores) but tight enough
+    to catch an accidental O(n^2) or a fallen-off fast path."""
+    import time
+
+    import numpy as np
+
+    from seaweedfs_tpu.ec.backend import ReedSolomon
+    from seaweedfs_tpu.ops import rs_matrix
+
+    rs = ReedSolomon(10, 4, backend="auto")
+    present = [i for i in range(14) if i != 0]
+    rows, inputs = rs_matrix.recovery_rows(10, 4, present[:10], [0])
+    shards = np.random.default_rng(0).integers(
+        0, 256, (10, 1 << 20), dtype=np.uint8)
+    rs.backend.coded_matmul(rows, shards)  # warm
+    lats = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        rs.backend.coded_matmul(rows, shards)
+        lats.append(time.perf_counter() - t0)
+    p50_ms = sorted(lats)[len(lats) // 2] * 1000
+    assert p50_ms < 50, f"1MB reconstruct p50 {p50_ms:.1f}ms over budget"

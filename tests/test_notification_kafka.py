@@ -66,7 +66,8 @@ def test_queue_routing_and_reconnect(broker):
                for recs in broker.records.values()]
     assert seqs == [0, 1, 2]
     # broker dropping the connection is survived by a reconnect
-    q._c.close()
+    for c in q._clients.values():
+        c._sock.close()
     q.send("/after/reconnect", {"ok": True})
     q.close()
 
@@ -105,3 +106,21 @@ def test_filer_events_reach_broker(broker):
         t.stop_event.set()
         q.close()
         f.close()
+
+
+def test_not_leader_triggers_refresh_and_follow(broker):
+    from seaweedfs_tpu.notification import queues as qmod
+
+    q = make_queue("kafka", hosts=f"127.0.0.1:{broker.port}")
+    # simulate leadership moving: poison the leader map so the first
+    # produce goes to a dead address, forcing refresh + follow
+    q._brokers[99] = ("127.0.0.1", 1)
+    for pid in q._leaders:
+        q._leaders[pid] = 99
+    broker.records.clear()
+    q.send("/lead/follow", {"ok": 1})
+    total = sum(len(v) for v in broker.records.values())
+    assert total == 1
+    # the refreshed map points at the real broker again
+    assert set(q._leaders.values()) == {1}
+    q.close()

@@ -303,3 +303,288 @@ def test_chunked_tampered_chunk_rejected(cluster, s3):
     t = Tampering(cluster.s3_url, AK, SK)
     r = t.put_chunked("/conf/tampered.bin", [b"x" * 4096])
     assert r.status in (400, 403)
+
+
+# -- copy-object + metadata directive (round-3 sweep growth) -----------
+
+def test_copy_preserves_metadata_by_default(s3):
+    s3.put("/conf/md-src.txt", b"meta-src",
+           headers={"x-amz-meta-color": "teal",
+                    "x-amz-meta-rank": "7"})
+    r = s3.put("/conf/md-dst.txt",
+               headers={"x-amz-copy-source": "/conf/md-src.txt"})
+    assert r.status == 200
+    h = s3.head("/conf/md-dst.txt")
+    assert h.header("x-amz-meta-color") == "teal"
+    assert h.header("x-amz-meta-rank") == "7"
+    assert s3.get("/conf/md-dst.txt").body == b"meta-src"
+
+
+def test_copy_replace_directive(s3):
+    s3.put("/conf/md2-src.txt", b"v", headers={"x-amz-meta-old": "yes"})
+    r = s3.put("/conf/md2-dst.txt",
+               headers={"x-amz-copy-source": "/conf/md2-src.txt",
+                        "x-amz-metadata-directive": "REPLACE",
+                        "x-amz-meta-new": "fresh"})
+    assert r.status == 200
+    h = s3.head("/conf/md2-dst.txt")
+    assert h.header("x-amz-meta-new") == "fresh"
+    assert h.header("x-amz-meta-old") == ""
+
+
+def test_copy_to_self_requires_replace(s3):
+    s3.put("/conf/self.txt", b"self", headers={"x-amz-meta-a": "1"})
+    r = s3.put("/conf/self.txt",
+               headers={"x-amz-copy-source": "/conf/self.txt"})
+    assert r.status == 400
+    assert b"InvalidRequest" in r.body
+    r = s3.put("/conf/self.txt",
+               headers={"x-amz-copy-source": "/conf/self.txt",
+                        "x-amz-metadata-directive": "REPLACE",
+                        "x-amz-meta-a": "2"})
+    assert r.status == 200
+    assert s3.head("/conf/self.txt").header("x-amz-meta-a") == "2"
+
+
+def test_copy_missing_source(s3):
+    r = s3.put("/conf/never.txt",
+               headers={"x-amz-copy-source": "/conf/ghost-src.txt"})
+    assert r.status == 404
+    assert b"NoSuchKey" in r.body
+
+
+# -- multipart edge cases ----------------------------------------------
+
+def _start_upload(s3, key):
+    r = s3.post(key, **{"uploads": ""})
+    assert r.status == 200
+    return _xml(r.body).find(f"{NS}UploadId").text
+
+
+def test_list_multipart_uploads_lifecycle(s3):
+    uid = _start_upload(s3, "/conf/lmu.bin")
+    lst = s3.get("/conf", **{"uploads": ""})
+    assert lst.status == 200
+    ids = [u.text for u in _xml(lst.body).iter(f"{NS}UploadId")]
+    assert uid in ids
+    assert s3.delete("/conf/lmu.bin", **{"uploadId": uid}).status == 204
+    lst = s3.get("/conf", **{"uploads": ""})
+    assert uid not in [u.text
+                       for u in _xml(lst.body).iter(f"{NS}UploadId")]
+
+
+def test_complete_with_missing_part_number(s3):
+    uid = _start_upload(s3, "/conf/badmp.bin")
+    s3.put("/conf/badmp.bin", b"data",
+           **{"partNumber": "1", "uploadId": uid})
+    doc = ("<CompleteMultipartUpload>"
+           "<Part><PartNumber>1</PartNumber><ETag>x</ETag></Part>"
+           "<Part><PartNumber>9</PartNumber><ETag>y</ETag></Part>"
+           "</CompleteMultipartUpload>")
+    r = s3.post("/conf/badmp.bin", doc.encode(), **{"uploadId": uid})
+    assert r.status == 400
+    assert b"InvalidPart" in r.body
+    s3.delete("/conf/badmp.bin", **{"uploadId": uid})
+
+
+def test_operations_on_aborted_upload(s3):
+    uid = _start_upload(s3, "/conf/gone.bin")
+    assert s3.delete("/conf/gone.bin", **{"uploadId": uid}).status == 204
+    # part upload, list-parts, and complete must all answer NoSuchUpload
+    pr = s3.put("/conf/gone.bin", b"x",
+                **{"partNumber": "1", "uploadId": uid})
+    assert pr.status == 404 and b"NoSuchUpload" in pr.body
+    lp = s3.get("/conf/gone.bin", **{"uploadId": uid})
+    assert lp.status == 404
+    doc = b"<CompleteMultipartUpload></CompleteMultipartUpload>"
+    cr = s3.post("/conf/gone.bin", doc, **{"uploadId": uid})
+    assert cr.status == 404
+
+
+def test_list_parts_reports_sizes_and_etags(s3):
+    import hashlib as _hl
+
+    uid = _start_upload(s3, "/conf/lp.bin")
+    p1, p2 = b"a" * 1000, b"b" * 2000
+    s3.put("/conf/lp.bin", p1, **{"partNumber": "1", "uploadId": uid})
+    s3.put("/conf/lp.bin", p2, **{"partNumber": "2", "uploadId": uid})
+    lp = _xml(s3.get("/conf/lp.bin", **{"uploadId": uid}).body)
+    parts = {int(p.find(f"{NS}PartNumber").text):
+             (int(p.find(f"{NS}Size").text),
+              p.find(f"{NS}ETag").text.strip('"'))
+             for p in lp.iter(f"{NS}Part")}
+    assert parts[1] == (1000, _hl.md5(p1).hexdigest())
+    assert parts[2] == (2000, _hl.md5(p2).hexdigest())
+    s3.delete("/conf/lp.bin", **{"uploadId": uid})
+
+
+# -- batch delete -------------------------------------------------------
+
+def test_multi_object_delete(s3):
+    for i in range(3):
+        s3.put(f"/conf/del{i}.txt", b"x")
+    doc = ("<Delete>" +
+           "".join(f"<Object><Key>del{i}.txt</Key></Object>"
+                   for i in range(3)) +
+           "<Object><Key>not-there.txt</Key></Object></Delete>")
+    r = s3.post("/conf", doc.encode(), **{"delete": ""})
+    assert r.status == 200
+    deleted = [k.text for k in _xml(r.body).iter(f"{NS}Key")]
+    assert set(deleted) >= {"del0.txt", "del1.txt", "del2.txt"}
+    for i in range(3):
+        assert s3.get(f"/conf/del{i}.txt").status == 404
+
+
+# -- presigned POST (browser form upload) -------------------------------
+
+def _post_form(url: str, fields: dict, file_bytes: bytes):
+    import urllib.error
+    import urllib.request
+    import uuid
+
+    boundary = uuid.uuid4().hex
+    body = b""
+    for k, v in fields.items():
+        body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                 f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+             f'name="file"; filename="up.bin"\r\n'
+             "Content-Type: application/octet-stream\r\n\r\n").encode()
+    body += file_bytes + f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    try:
+        r = urllib.request.urlopen(req, timeout=10)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _signed_policy_fields(s3, key: str, expires_s: int = 300):
+    import base64
+    import datetime
+    import hashlib as _hl
+    import hmac as _hm
+    import json as _json
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    date = now.strftime("%Y%m%d")
+    cred = f"{s3.access_key}/{s3._scope(date)}"
+    policy = base64.b64encode(_json.dumps({
+        "expiration": (now + datetime.timedelta(seconds=expires_s))
+        .strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "conditions": [{"bucket": "conf"}, ["eq", "$key", key],
+                       ["content-length-range", 0, 10 << 20]],
+    }).encode()).decode()
+    sig = _hm.new(s3._signing_key(date), policy.encode(),
+                  _hl.sha256).hexdigest()
+    return {"key": key, "policy": policy, "x-amz-credential": cred,
+            "x-amz-signature": sig}
+
+
+def test_presigned_post_policy_upload(cluster, s3):
+    fields = _signed_policy_fields(s3, "posted/form.bin")
+    fields["success_action_status"] = "201"
+    code, body = _post_form(f"{cluster.s3_url}/conf", fields,
+                            b"form-bytes")
+    assert code == 201, body
+    assert b"<Key>posted/form.bin</Key>" in body
+    assert s3.get("/conf/posted/form.bin").body == b"form-bytes"
+
+
+def test_presigned_post_bad_signature_rejected(cluster, s3):
+    fields = _signed_policy_fields(s3, "posted/evil.bin")
+    fields["x-amz-signature"] = "0" * 64
+    code, body = _post_form(f"{cluster.s3_url}/conf", fields, b"nope")
+    assert code == 403
+    assert s3.get("/conf/posted/evil.bin").status == 404
+
+
+def test_presigned_post_key_condition_enforced(cluster, s3):
+    fields = _signed_policy_fields(s3, "posted/allowed.bin")
+    fields["key"] = "posted/other.bin"  # violates the eq condition
+    code, _ = _post_form(f"{cluster.s3_url}/conf", fields, b"x")
+    assert code == 403
+
+
+def test_presigned_url_expiry(cluster, s3):
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    s3.put("/conf/exp.txt", b"short-lived")
+    url = s3.presign("GET", "/conf/exp.txt", expires=1)
+    assert urllib.request.urlopen(url, timeout=10).read() == \
+        b"short-lived"
+    _time.sleep(2)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url, timeout=10)
+    assert ei.value.code == 403
+
+
+# -- metadata, overwrite, listing, bucket edges ------------------------
+
+def test_user_metadata_roundtrip(s3):
+    s3.put("/conf/meta.txt", b"m",
+           headers={"x-amz-meta-owner": "conformance",
+                    "Content-Type": "text/x-custom"})
+    h = s3.head("/conf/meta.txt")
+    assert h.header("x-amz-meta-owner") == "conformance"
+    g = s3.get("/conf/meta.txt")
+    assert g.header("x-amz-meta-owner") == "conformance"
+
+
+def test_overwrite_replaces_content_and_etag(s3):
+    import hashlib as _hl
+
+    s3.put("/conf/ow.txt", b"first")
+    e1 = s3.head("/conf/ow.txt").header("etag")
+    s3.put("/conf/ow.txt", b"second!")
+    h = s3.head("/conf/ow.txt")
+    assert h.header("etag") != e1
+    assert h.header("etag").strip('"') == _hl.md5(b"second!").hexdigest()
+    assert s3.get("/conf/ow.txt").body == b"second!"
+
+
+def test_list_v2_start_after(s3, listing_keys):
+    r = _xml(s3.get("/conf", **{"list-type": "2",
+                                "start-after": "list/b/01.txt",
+                                "prefix": "list/"}).body)
+    keys = [k.text for k in r.iter(f"{NS}Key")]
+    assert keys and all(k > "list/b/01.txt" for k in keys)
+    assert "list/b/02.txt" in keys and "list/top.txt" in keys
+
+
+def test_nested_common_prefixes(s3, listing_keys):
+    r = _xml(s3.get("/conf", **{"prefix": "list/b/",
+                                "delimiter": "/"}).body)
+    keys = [k.text for k in r.iter(f"{NS}Key")]
+    assert keys
+    assert all(k.startswith("list/b/") and "/" not in
+               k[len("list/b/"):] for k in keys)
+
+
+def test_delete_nonempty_bucket_rejected(s3):
+    s3.put("/convict", b"")
+    s3.put("/convict/keeper.txt", b"x")
+    r = s3.delete("/convict")
+    assert r.status == 409
+    assert b"BucketNotEmpty" in r.body
+    s3.delete("/convict/keeper.txt")
+    assert s3.delete("/convict").status == 204
+    assert s3.get("/convict").status == 404
+
+
+def test_copy_replace_changes_content_type(s3):
+    s3.put("/conf/ct.bin", b"<h1>hi</h1>",
+           headers={"Content-Type": "application/octet-stream"})
+    r = s3.put("/conf/ct.bin",
+               headers={"x-amz-copy-source": "/conf/ct.bin",
+                        "x-amz-metadata-directive": "REPLACE",
+                        "Content-Type": "text/html"})
+    assert r.status == 200
+    g = s3.get("/conf/ct.bin")
+    assert g.header("content-type").startswith("text/html")
+    assert g.body == b"<h1>hi</h1>"
