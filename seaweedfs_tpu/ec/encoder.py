@@ -253,8 +253,14 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
     k = rs.k
     # CPU codecs take narrow zero-copy row views (the transpose gather
     # was their residual overhead); device codecs get wide packed
-    # dispatches that amortize relay/launch latency
-    wide = getattr(rs.backend, "name", "") not in ("numpy", "native")
+    # dispatches that amortize relay/launch latency. `auto` must be
+    # RESOLVED first or the production default would silently keep the
+    # wide gather on CPU machines — the exact overhead this removes.
+    backend_name = getattr(rs.backend, "name", "")
+    if backend_name == "auto":
+        rs.backend._resolve()
+        backend_name = getattr(rs.backend, "chosen", "") or ""
+    wide = backend_name not in ("numpy", "native")
     w = _AsyncWriter()
     try:
         def gen():

@@ -15,6 +15,7 @@ from .filer import Filer, norm_path
 from . import abstract_sql as _abstract_sql  # registers mysql/postgres
 # (both driven by the in-tree mysql_lite / pg_lite wire clients)
 from . import cassandra_store as _cassandra_store  # registers cassandra
+from . import elastic_store as _elastic_store  # registers elastic (REST)
 from . import etcd_store as _etcd_store      # registers etcd (v3 http)
 from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis

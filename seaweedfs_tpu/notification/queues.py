@@ -1,13 +1,12 @@
 """Metadata-event notification publishing.
 
 Equivalent of /root/reference/weed/notification/ (configuration.go +
-kafka/aws_sqs/google_pub_sub/gocdk adapters, consumed by
-weed/command/filer_notify read side): every filer metadata mutation can
-be published to an external queue. The cloud/kafka SDKs are absent in
-this environment, so the queue registry carries the interface plus the
-two backends that work anywhere — in-memory (tests, in-process
-consumers) and append-only JSONL log files (tailable by any external
-consumer) — and names the unavailable ones explicitly.
+kafka/aws_sqs/google_pub_sub adapters, consumed by
+weed/command/filer_notify read side): every filer metadata mutation
+can be published to an external queue. All five backends are real
+here, SDK-free: in-memory and JSONL log for local consumers, kafka
+over the in-tree wire producer, SQS over the SigV4-signed Query API,
+and Pub/Sub over the JSON REST API with in-tree OAuth.
 """
 from __future__ import annotations
 
@@ -73,22 +72,6 @@ class LogFileQueue(NotificationQueue):
             self._f.close()
 
 
-class _GatedQueue(NotificationQueue):
-    """Placeholder for queue backends whose SDK isn't installed
-    (notification/kafka, aws_sqs, google_pub_sub in the reference).
-    Registered so configs name them uniformly; constructing one
-    explains what to install instead of failing deep in a publish."""
-
-    KIND = ""
-    NEEDS = ""
-
-    def __init__(self, **_):
-        raise ImportError(
-            f"notification queue {self.KIND!r} needs the "
-            f"{self.NEEDS} package, which is not installed; "
-            "use 'memory' or 'log', or install the SDK")
-
-
 class KafkaQueue(NotificationQueue):
     """Publish metadata events to a Kafka topic over the in-tree wire
     producer (kafka_lite.py: Metadata v1 + Produce v3) — the slot of
@@ -143,7 +126,15 @@ class KafkaQueue(NotificationQueue):
         t: dict = {}
         md: dict = {}
         for attempt in range(max(1, retries)):
-            md = self._client(self._bootstrap).metadata([self.topic])
+            try:
+                md = self._client(self._bootstrap) \
+                    .metadata([self.topic])
+            except (IOError, OSError):
+                # the cached bootstrap connection can be just as stale
+                # as the leader's that sent us here — reconnect it once
+                self._drop_client(self._bootstrap)
+                md = self._client(self._bootstrap) \
+                    .metadata([self.topic])
             t = md["topics"].get(self.topic, {})
             if t.get("error", 0) == 0 and t.get("partitions"):
                 break
@@ -200,12 +191,99 @@ class KafkaQueue(NotificationQueue):
         self._clients.clear()
 
 
-class AwsSqsQueue(_GatedQueue):
-    KIND, NEEDS = "aws_sqs", "boto3"
+class AwsSqsQueue(NotificationQueue):
+    """Publish events to an AWS SQS queue over the Query API
+    (SendMessage), signed with the in-tree SigV4 signer — the slot of
+    /root/reference/weed/notification/aws_sqs/aws_sqs_pub.go:16,
+    JSON bodies instead of protobuf. `queue_url` overrides endpoint
+    resolution for emulators (localstack/elasticmq-style)."""
+
+    name = "aws_sqs"
+
+    def __init__(self, queue_url: str = "", region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "", **_):
+        if not queue_url:
+            raise ValueError("aws_sqs notification needs queue_url")
+        import requests
+
+        self.queue_url = queue_url.rstrip("/")
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self._sess = requests.Session()
+
+    def send(self, key: str, message: dict) -> None:
+        import urllib.parse
+
+        from ..s3.sigv4_client import sign_headers
+
+        body = urllib.parse.urlencode({
+            "Action": "SendMessage",
+            "Version": "2012-11-05",
+            "MessageBody": json.dumps({"key": key, "message": message},
+                                      separators=(",", ":")),
+            "MessageAttribute.1.Name": "key",
+            "MessageAttribute.1.Value.DataType": "String",
+            "MessageAttribute.1.Value.StringValue": key,
+        }).encode()
+        headers = {"Content-Type":
+                   "application/x-www-form-urlencoded"}
+        if self.access_key:
+            headers.update(sign_headers(
+                "POST", self.queue_url, self.access_key,
+                self.secret_key, body, region=self.region,
+                service="sqs"))
+        r = self._sess.post(self.queue_url, data=body, headers=headers,
+                            timeout=30)
+        r.raise_for_status()
+
+    def close(self) -> None:
+        self._sess.close()
 
 
-class GooglePubSubQueue(_GatedQueue):
-    KIND, NEEDS = "google_pub_sub", "google-cloud-pubsub"
+class GooglePubSubQueue(NotificationQueue):
+    """Publish events to a GCP Pub/Sub topic over the JSON REST API
+    (topics.publish) with the shared GcpTokenSource (static token /
+    metadata / service-account JWT) — the slot of
+    /root/reference/weed/notification/google_pub_sub/
+    google_pub_sub.go:17. `endpoint` overrides
+    https://pubsub.googleapis.com for emulators."""
+
+    name = "google_pub_sub"
+
+    def __init__(self, project: str = "", topic: str = "",
+                 endpoint: str = "", token: str = "",
+                 token_url: str = "", credentials_file: str = "", **_):
+        if not project or not topic:
+            raise ValueError(
+                "google_pub_sub notification needs project and topic")
+        import requests
+
+        from ..utils.gcp_auth import GcpTokenSource
+
+        self.url = ((endpoint or "https://pubsub.googleapis.com")
+                    .rstrip("/") +
+                    f"/v1/projects/{project}/topics/{topic}:publish")
+        self._sess = requests.Session()
+        self._tokens = GcpTokenSource(
+            self._sess, token=token, token_url=token_url,
+            credentials_file=credentials_file,
+            scope="https://www.googleapis.com/auth/pubsub")
+
+    def send(self, key: str, message: dict) -> None:
+        import base64
+
+        data = base64.b64encode(json.dumps(
+            message, separators=(",", ":")).encode()).decode()
+        r = self._sess.post(
+            self.url,
+            json={"messages": [{"data": data,
+                                "attributes": {"key": key}}]},
+            headers=self._tokens.headers(), timeout=30)
+        r.raise_for_status()
+
+    def close(self) -> None:
+        self._sess.close()
 
 
 def make_queue(kind: str, **kwargs) -> NotificationQueue:

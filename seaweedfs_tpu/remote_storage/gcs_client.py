@@ -18,8 +18,6 @@ Auth modes (pick one in remote.configure):
 """
 from __future__ import annotations
 
-import json
-import time
 import urllib.parse
 from typing import Iterator
 
@@ -28,7 +26,6 @@ import requests
 from .client import RemoteEntry, RemoteStorageClient, register_remote
 
 GCS_ENDPOINT = "https://storage.googleapis.com"
-TOKEN_URL = "https://oauth2.googleapis.com/token"
 SCOPE = "https://www.googleapis.com/auth/devstorage.read_write"
 
 
@@ -49,68 +46,19 @@ class GcsRemoteClient(RemoteStorageClient):
                  credentials_file: str = "", project: str = "", **_):
         if not bucket:
             raise ValueError("gcs remote storage needs -bucket")
+        from ..utils.gcp_auth import GcpTokenSource
+
         self.bucket = bucket
         self.endpoint = (endpoint or GCS_ENDPOINT).rstrip("/")
         self.project = project
-        self._static_token = token
-        self._token_url = token_url
-        self._sa = None
-        if credentials_file:
-            with open(credentials_file) as f:
-                self._sa = json.load(f)
-        self._token = token
-        self._token_exp = float("inf") if token else 0.0
         self._sess = requests.Session()
+        self._tokens = GcpTokenSource(
+            self._sess, token=token, token_url=token_url,
+            credentials_file=credentials_file, scope=SCOPE)
         self._auth()  # fail fast on bad credentials
 
-    # -- auth -----------------------------------------------------------
     def _auth(self) -> dict:
-        if time.time() < self._token_exp - 60:
-            return {"Authorization": f"Bearer {self._token}"} \
-                if self._token else {}
-        if self._token_url:
-            r = self._sess.get(
-                self._token_url,
-                headers={"Metadata-Flavor": "Google"}, timeout=30)
-            r.raise_for_status()
-            d = r.json()
-            self._token = d["access_token"]
-            self._token_exp = time.time() + float(
-                d.get("expires_in", 3600))
-        elif self._sa is not None:
-            self._token, self._token_exp = self._jwt_grant()
-        else:
-            return {}  # anonymous
-        return {"Authorization": f"Bearer {self._token}"}
-
-    def _jwt_grant(self) -> tuple[str, float]:
-        """OAuth2 JWT bearer grant signed with the service account's
-        RSA key (RFC 7523; what google-auth does under the hood)."""
-        import base64
-
-        from ..utils import rs256
-
-        def b64(b: bytes) -> bytes:
-            return base64.urlsafe_b64encode(b).rstrip(b"=")
-
-        now = int(time.time())
-        header = b64(json.dumps(
-            {"alg": "RS256", "typ": "JWT"}).encode())
-        token_uri = self._sa.get("token_uri", TOKEN_URL)
-        claims = b64(json.dumps({
-            "iss": self._sa["client_email"], "scope": SCOPE,
-            "aud": token_uri, "iat": now, "exp": now + 3600,
-        }).encode())
-        signing_input = header + b"." + claims
-        sig = rs256.sign(self._sa["private_key"], signing_input)
-        assertion = (signing_input + b"." + b64(sig)).decode()
-        r = self._sess.post(token_uri, data={
-            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
-            "assertion": assertion}, timeout=30)
-        r.raise_for_status()
-        d = r.json()
-        return d["access_token"], time.time() + float(
-            d.get("expires_in", 3600))
+        return self._tokens.headers()
 
     # -- helpers --------------------------------------------------------
     def _obj_url(self, key: str, media: bool = False) -> str:

@@ -277,9 +277,14 @@ class B2Sink(ReplicationSink):
         # (pod busy) or 401 (expired upload token) and the client must
         # fetch a fresh upload URL and retry — blazer, which the
         # reference uses, does exactly this
+        import time as _time
+
         for attempt in range(3):
             r = self._api("b2_get_upload_url",
                           {"bucketId": self.bucket_id})
+            if r.status_code == 503 and attempt < 2:
+                _time.sleep(0.2 * (attempt + 1))
+                continue
             r.raise_for_status()
             up = r.json()
             r = self._sess.post(
@@ -291,6 +296,7 @@ class B2Sink(ReplicationSink):
                     "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
                 }, timeout=300)
             if r.status_code in (401, 503) and attempt < 2:
+                _time.sleep(0.2 * (attempt + 1))
                 continue
             r.raise_for_status()
             return

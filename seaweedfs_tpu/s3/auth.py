@@ -1,9 +1,11 @@
-"""S3 request authentication: AWS Signature V4 + identity/action model.
+"""S3 request authentication: AWS Signature V4 + legacy V2 + the
+identity/action model.
 
 Equivalent of /root/reference/weed/s3api/auth_signature_v4.go (header
-and presigned-query SigV4 verification) and auth_credentials.go (the
-`IdentityAccessManagement` identity -> credentials -> actions model,
-hot-reloadable config). SigV2 is legacy and intentionally omitted.
+and presigned-query SigV4 verification), auth_signature_v2.go:32
+(legacy header + presigned V2, still emitted by old SDKs), and
+auth_credentials.go (the `IdentityAccessManagement` identity ->
+credentials -> actions model, hot-reloadable config).
 
 Identities config (JSON, same shape idea as s3.configure):
   {"identities": [{"name": "admin",
@@ -22,6 +24,18 @@ from datetime import datetime, timedelta, timezone
 
 ALGORITHM = "AWS4-HMAC-SHA256"
 MAX_CLOCK_SKEW_SECONDS = 15 * 60
+
+# query subresources that participate in the V2 canonicalized
+# resource — EXACTLY auth_signature_v2.go:39 resourceList (notably,
+# no "tagging": adding anything the clients don't sign 403s them)
+V2_SUBRESOURCES = (
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type",
+    "response-expires", "torrent", "uploadId", "uploads",
+    "versionId", "versioning", "versions", "website",
+)
 
 ACTION_ADMIN = "Admin"
 ACTION_READ = "Read"
@@ -135,17 +149,95 @@ class IdentityAccessManagement:
         if "X-Amz-Signature" in query or "X-Amz-Algorithm" in query:
             return self._verify_presigned(method, path, query,
                                           headers), None
+        if "Signature" in query and "AWSAccessKeyId" in query:
+            return self._verify_presigned_v2(method, path, query,
+                                             headers), None
         auth = headers.get("Authorization", "")
         if auth.startswith(ALGORITHM):
             identity, ctx = self._verify_header(
                 method, path, query, headers, payload_hash, auth)
             return identity, ctx
+        if auth.startswith("AWS ") and ":" in auth:
+            return self._verify_header_v2(method, path, query,
+                                          headers, auth), None
         if self.is_open:
             ctx = None
             if declared == STREAMING_UNSIGNED:
                 ctx = StreamingContext(None, "", "", "")
             return None, ctx
         raise S3AuthError("AccessDenied", "no credentials provided")
+
+    # -- Signature V2 (auth_signature_v2.go:32) -------------------------
+    def _string_to_sign_v2(self, method: str, path: str,
+                           query: dict[str, str],
+                           headers: dict[str, str],
+                           expires_or_date: str) -> str:
+        """The legacy V2 string-to-sign, matching
+        auth_signature_v2.go:312 getStringToSignV2 exactly: method,
+        content-md5, content-type, date (Expires for presigned, else
+        the Date header), canonicalized x-amz-* headers (x-amz-date
+        INCLUDED — clients sign it), canonicalized resource (path +
+        the resourceList subresources in list order)."""
+        h = {k.lower(): v for k, v in headers.items()}
+        amz = "\n".join(
+            f"{k}:{h[k].strip()}" for k in sorted(h)
+            if k.startswith("x-amz-"))
+        if amz:
+            amz += "\n"
+        resource = urllib.parse.quote(path, safe="/~._-")
+        parts = [(f"{k}={query[k]}" if query[k] else k)
+                 for k in V2_SUBRESOURCES if k in query]
+        if parts:
+            resource += "?" + "&".join(parts)
+        return "\n".join([
+            method,
+            h.get("content-md5", ""),
+            h.get("content-type", ""),
+            expires_or_date,
+            amz,
+        ]) + resource
+
+    @staticmethod
+    def _sig_v2(secret: str, sts: str) -> str:
+        import base64
+
+        return base64.b64encode(hmac.new(
+            secret.encode(), sts.encode(), hashlib.sha1).digest()
+        ).decode()
+
+    def _verify_header_v2(self, method, path, query, headers,
+                          auth) -> Identity:
+        """`Authorization: AWS <accessKey>:<base64 hmac-sha1>`."""
+        access_key, _, got = auth[len("AWS "):].partition(":")
+        identity, secret = self.lookup(access_key)
+        h = {k.lower(): v for k, v in headers.items()}
+        # the date line is always the Date header; a client's
+        # x-amz-date rides the canonicalized amz headers instead
+        sts = self._string_to_sign_v2(method, path, query, headers,
+                                      h.get("date", ""))
+        if not hmac.compare_digest(self._sig_v2(secret, sts), got):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "v2 signature mismatch")
+        return identity
+
+    def _verify_presigned_v2(self, method, path, query,
+                             headers) -> Identity:
+        """?AWSAccessKeyId=..&Expires=<unix>&Signature=<b64>."""
+        identity, secret = self.lookup(query["AWSAccessKeyId"])
+        expires = query.get("Expires", "")
+        try:
+            if datetime.now(timezone.utc).timestamp() > float(expires):
+                raise S3AuthError("AccessDenied",
+                                  "presigned V2 request has expired")
+        except ValueError:
+            raise S3AuthError("AccessDenied", "bad Expires") from None
+        sts = self._string_to_sign_v2(method, path, query, headers,
+                                      expires)
+        if not hmac.compare_digest(self._sig_v2(secret, sts),
+                                   query.get("Signature", "")):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "v2 signature mismatch")
+        return identity
 
     def _verify_header(self, method, path, query, headers, payload_hash,
                        auth) -> tuple[Identity, "StreamingContext | None"]:

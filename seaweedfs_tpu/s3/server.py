@@ -974,10 +974,12 @@ class S3ApiServer:
         if directive == "REPLACE":
             # REPLACE swaps ALL metadata — including Content-Type,
             # the field `aws s3 cp --metadata-directive REPLACE
-            # --content-type ...` self-copies exist to fix
-            if req.content_type and req.content_type != \
-                    "application/octet-stream":
-                headers["Content-Type"] = req.content_type
+            # --content-type ...` self-copies exist to fix. Header
+            # PRESENCE decides (req.content_type defaults to
+            # octet-stream and can't distinguish "explicitly
+            # octet-stream" from "absent")
+            if "Content-Type" in req.headers:
+                headers["Content-Type"] = req.headers["Content-Type"]
             for k, v in req.headers.items():
                 if k.lower().startswith("x-amz-meta-"):
                     name = k.lower()[len("x-amz-meta-"):]

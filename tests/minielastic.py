@@ -1,0 +1,138 @@
+"""Mini Elasticsearch 7 double: the REST subset the elastic filer
+store issues — index create/delete/HEAD, _doc CRUD with refresh,
+_search with bool-filter (term / range / prefix on Name) + sort +
+size, and basic auth. The fake-gcs / minimongo role for the ES wire.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MiniElastic:
+    def __init__(self, username: str = "", password: str = ""):
+        self.username = username
+        self.password = password
+        # index -> {doc_id: source_dict}
+        self.indexes: dict[str, dict[str, dict]] = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _auth_ok(self) -> bool:
+                if not outer.username:
+                    return True
+                got = self.headers.get("Authorization", "")
+                want = "Basic " + base64.b64encode(
+                    f"{outer.username}:{outer.password}".encode()
+                ).decode()
+                return got == want
+
+            def _route(self):
+                if not self._auth_ok():
+                    return self._json(401, {"error": "unauthorized"})
+                u = urllib.parse.urlsplit(self.path)
+                parts = [urllib.parse.unquote(p)
+                         for p in u.path.strip("/").split("/")]
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                with outer.lock:
+                    return self._dispatch(parts, body)
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _route
+
+            def _dispatch(self, parts, body):
+                ix = outer.indexes
+                if len(parts) == 1:  # index-level
+                    name = parts[0]
+                    if self.command == "HEAD":
+                        return self._json(
+                            200 if name in ix else 404, {})
+                    if self.command == "PUT":
+                        ix.setdefault(name, {})
+                        return self._json(200, {"acknowledged": True})
+                    if self.command == "DELETE":
+                        if ix.pop(name, None) is None:
+                            return self._json(404, {"error": "no index"})
+                        return self._json(200, {"acknowledged": True})
+                if len(parts) == 2 and parts[1] == "_search":
+                    return self._search(ix.get(parts[0]), body)
+                if len(parts) == 3 and parts[1] == "_doc":
+                    index, _, doc_id = parts
+                    if self.command == "PUT":
+                        ix.setdefault(index, {})[doc_id] = body
+                        return self._json(201, {"result": "created"})
+                    docs = ix.get(index, {})
+                    if self.command == "GET":
+                        if doc_id not in docs:
+                            return self._json(404, {"found": False})
+                        return self._json(200, {"found": True,
+                                                "_id": doc_id,
+                                                "_source": docs[doc_id]})
+                    if self.command == "DELETE":
+                        if docs.pop(doc_id, None) is None:
+                            return self._json(404,
+                                              {"result": "not_found"})
+                        return self._json(200, {"result": "deleted"})
+                return self._json(400, {"error": f"bad route {parts}"})
+
+            def _search(self, docs, body):
+                if docs is None:
+                    return self._json(404, {"error": "no such index"})
+                filt = body.get("query", {}).get("bool", {}) \
+                    .get("filter", [])
+                out = []
+                for doc_id, src in docs.items():
+                    ok = True
+                    for f in filt:
+                        if "term" in f:
+                            ((k, v),) = f["term"].items()
+                            ok &= src.get(k) == v
+                        elif "range" in f:
+                            ((k, cond),) = f["range"].items()
+                            val = src.get(k, "")
+                            for op, rhs in cond.items():
+                                ok &= {"gt": val > rhs,
+                                       "gte": val >= rhs,
+                                       "lt": val < rhs,
+                                       "lte": val <= rhs}[op]
+                        elif "prefix" in f:
+                            ((k, v),) = f["prefix"].items()
+                            ok &= str(src.get(k, "")).startswith(v)
+                        else:
+                            return self._json(
+                                400, {"error": f"bad filter {f}"})
+                    if ok:
+                        out.append({"_id": doc_id, "_source": src})
+                for s in reversed(body.get("sort", [])):
+                    ((k, order),) = s.items() if isinstance(s, dict) \
+                        else ((s, "asc"),)
+                    out.sort(key=lambda h: h["_source"].get(k, ""),
+                             reverse=order == "desc")
+                out = out[:body.get("size", 10)]
+                return self._json(200, {"hits": {"hits": out}})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_port
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()

@@ -205,15 +205,16 @@ class TestNotifications:
 
 
 class TestGatedQueues:
-    def test_gated_backends_explain_missing_sdk(self):
+    def test_all_queue_backends_are_real(self):
         import pytest as _pytest
 
         from seaweedfs_tpu.notification.queues import make_queue
+        # every reference queue family is a real in-tree wire/REST
+        # client now: misconfiguration fails with a config error and
+        # a dead broker fails at connect — never at import
         for kind in ("aws_sqs", "google_pub_sub"):
-            with _pytest.raises(ImportError):
+            with _pytest.raises(ValueError):
                 make_queue(kind)
-        # kafka is a real in-tree wire producer now: with no broker
-        # listening it fails at connect, not at import
         with _pytest.raises(OSError):
             make_queue("kafka", hosts="127.0.0.1:1")
         with _pytest.raises(KeyError):
