@@ -14,6 +14,7 @@ from .filechunks import (ChunkView, VisibleInterval, compact_file_chunks,
 from .filer import Filer, norm_path
 from . import abstract_sql as _abstract_sql  # registers mysql/postgres
 # (both driven by the in-tree mysql_lite / pg_lite wire clients)
+from . import arangodb_store as _arangodb_store  # registers arangodb
 from . import cassandra_store as _cassandra_store  # registers cassandra
 from . import elastic_store as _elastic_store  # registers elastic (REST)
 from . import etcd_store as _etcd_store      # registers etcd (v3 http)

@@ -395,11 +395,6 @@ class YdbStore(_GatedStore):
     KIND, NEEDS = "ydb", "ydb"
 
 
-@register_store("arangodb")
-class ArangodbStore(_GatedStore):
-    KIND, NEEDS = "arangodb", "python-arango"
-
-
 @register_store("hbase")
 class HbaseStore(_GatedStore):
     KIND, NEEDS = "hbase", "happybase"

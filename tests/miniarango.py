@@ -1,0 +1,188 @@
+"""Mini ArangoDB double: the HTTP API subset the arangodb filer store
+issues — collection create/list, document CRUD with overwriteMode,
+and the /_api/cursor AQL shapes (directory filter + name range/prefix
++ sort + limit + subtree REMOVE), with batched cursors and basic
+auth. The minielastic sibling for the arango wire.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+LIST_RE = re.compile(
+    r"FOR d IN `(?P<coll>[\w\-]+)` FILTER d\.directory == @dir"
+    r"(?P<start> FILTER d\.name (?P<op>>=|>) @start)?"
+    r"(?P<pfx> FILTER STARTS_WITH\(d\.name, @prefix\))?"
+    r" SORT d\.name ASC LIMIT @limit RETURN d")
+REMOVE_RE = re.compile(
+    r"FOR d IN `(?P<coll>[\w\-]+)` FILTER d\.directory == @dir OR "
+    r"STARTS_WITH\(d\.directory, @pfx\) REMOVE d IN `(?P=coll)`")
+
+
+class MiniArango:
+    def __init__(self, username: str = "", password: str = "",
+                 batch: int = 1000):
+        self.username = username
+        self.password = password
+        self.batch = batch
+        self.collections: dict[str, dict[str, dict]] = {}
+        self.cursors: dict[str, list] = {}
+        self.lock = threading.Lock()
+        self._next_cursor = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                if outer.username:
+                    want = "Basic " + base64.b64encode(
+                        f"{outer.username}:{outer.password}".encode()
+                    ).decode()
+                    if self.headers.get("Authorization") != want:
+                        return self._json(401, {"error": True,
+                                                "code": 401})
+                u = urllib.parse.urlsplit(self.path)
+                parts = u.path.strip("/").split("/")
+                # /_db/<name>/_api/...
+                if parts[:1] != ["_db"] or parts[2] != "_api":
+                    return self._json(404, {"error": True, "code": 404})
+                api = parts[3]
+                rest = parts[4:]
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                with outer.lock:
+                    if api == "collection":
+                        return self._collection(rest, body)
+                    if api == "document":
+                        return self._document(rest, body, u)
+                    if api == "cursor":
+                        return self._cursor(rest, body)
+                return self._json(404, {"error": True, "code": 404})
+
+            do_GET = do_POST = do_PUT = do_DELETE = _route
+
+            def _collection(self, rest, body):
+                if self.command == "POST":
+                    name = body.get("name", "")
+                    if name in outer.collections:
+                        return self._json(409, {"error": True,
+                                                "code": 409})
+                    outer.collections[name] = {}
+                    return self._json(200, {"name": name})
+                if self.command == "GET" and not rest:
+                    return self._json(200, {"result": [
+                        {"name": c} for c in outer.collections]})
+                if self.command == "DELETE" and rest:
+                    if outer.collections.pop(rest[0], None) is None:
+                        return self._json(404, {"error": True,
+                                                "code": 404})
+                    return self._json(200, {"id": rest[0]})
+                return self._json(404, {"error": True, "code": 404})
+
+            def _document(self, rest, body, u):
+                coll = outer.collections.get(rest[0])
+                if coll is None:
+                    return self._json(404, {"error": True, "code": 404})
+                if self.command == "POST":
+                    key = body.get("_key", "")
+                    q = dict(urllib.parse.parse_qsl(u.query))
+                    if key in coll and \
+                            q.get("overwriteMode") != "replace":
+                        return self._json(409, {"error": True,
+                                                "code": 1210})
+                    coll[key] = body
+                    return self._json(201, {"_key": key})
+                key = rest[1] if len(rest) > 1 else ""
+                if self.command == "GET":
+                    if key not in coll:
+                        return self._json(404, {"error": True,
+                                                "code": 1202})
+                    return self._json(200, coll[key])
+                if self.command == "DELETE":
+                    if coll.pop(key, None) is None:
+                        return self._json(404, {"error": True,
+                                                "code": 1202})
+                    return self._json(200, {"_key": key})
+                return self._json(405, {"error": True, "code": 405})
+
+            def _cursor(self, rest, body):
+                if self.command == "PUT" and rest:
+                    batch = outer.cursors.get(rest[0])
+                    if batch is None:
+                        return self._json(404, {"error": True,
+                                                "code": 1600})
+                    return self._respond_batch(rest[0], batch)
+                q = " ".join(body.get("query", "").split())
+                bind = body.get("bindVars", {})
+                m = REMOVE_RE.fullmatch(q)
+                if m:
+                    coll = outer.collections.get(m.group("coll"), {})
+                    doomed = [k for k, d in coll.items()
+                              if d.get("directory") == bind["dir"] or
+                              str(d.get("directory", "")).startswith(
+                                  bind["pfx"])]
+                    for k in doomed:
+                        del coll[k]
+                    return self._json(201, {"result": [],
+                                            "hasMore": False})
+                m = LIST_RE.fullmatch(q)
+                if m:
+                    coll = outer.collections.get(m.group("coll"))
+                    if coll is None:
+                        return self._json(404, {"error": True,
+                                                "code": 1203})
+                    rows = [d for d in coll.values()
+                            if d.get("directory") == bind["dir"]]
+                    if m.group("start"):
+                        op = m.group("op")
+                        rows = [d for d in rows
+                                if (d["name"] >= bind["start"]
+                                    if op == ">=" else
+                                    d["name"] > bind["start"])]
+                    if m.group("pfx"):
+                        rows = [d for d in rows if
+                                d["name"].startswith(bind["prefix"])]
+                    rows.sort(key=lambda d: d["name"])
+                    rows = rows[:bind["limit"]]
+                    cid = f"c{outer._next_cursor}"
+                    outer._next_cursor += 1
+                    outer.cursors[cid] = rows
+                    return self._respond_batch(cid, rows)
+                return self._json(400, {"error": True, "code": 1501,
+                                        "errorMessage": f"bad AQL {q}"})
+
+            def _respond_batch(self, cid, remaining):
+                batch = remaining[:outer.batch]
+                rest = remaining[outer.batch:]
+                if rest:
+                    outer.cursors[cid] = rest
+                    return self._json(201, {"result": batch,
+                                            "hasMore": True,
+                                            "id": cid})
+                outer.cursors.pop(cid, None)
+                return self._json(201, {"result": batch,
+                                        "hasMore": False})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._srv.server_port
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()

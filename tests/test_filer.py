@@ -188,7 +188,7 @@ class TestGatedStores:
         import pytest as _pytest
 
         from seaweedfs_tpu.filer.filerstore import STORES, make_store
-        for kind in ("tikv", "ydb", "arangodb", "hbase"):
+        for kind in ("tikv", "ydb", "hbase"):
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
@@ -197,7 +197,7 @@ class TestGatedStores:
         # postgres (protocol v3) are fully implemented wire protocols:
         # with no server listening they fail at connect, not at import
         for kind in ("redis", "etcd", "mongodb", "cassandra",
-                     "mysql", "postgres", "elastic"):
+                     "mysql", "postgres", "elastic", "arangodb"):
             assert kind in STORES
         for kind in ("redis", "cassandra", "mysql", "postgres"):
             with _pytest.raises(OSError):
