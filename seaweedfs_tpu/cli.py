@@ -120,6 +120,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="object hot-path server: native = C++ epoll "
                         "front (GET/POST by fid), python = asyncio "
                         "only, auto = native when the library builds")
+    p.add_argument("-jwt.secret", dest="jwt_secret", default="",
+                   help="HS256 secret for write authorization; must "
+                        "match the master's -jwt.secret")
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -357,6 +360,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-size", type=int, default=1024)
     p.add_argument("-c", dest="concurrency", type=int, default=16)
     p.add_argument("-collection", default="benchmark")
+    p.add_argument("-replication", default="",
+                   help="replica placement for the benchmark volumes "
+                        "(e.g. 001); empty = master default")
 
     p = sub.add_parser("scaffold", help="print a starter config "
                                         "template")
@@ -800,6 +806,7 @@ def _run_volume(args) -> int:
     # scheme normalization for each master happens inside VolumeServer
     vs = VolumeServer(store, args.mserver, data_center=args.dataCenter,
                       rack=args.rack, disk_type=args.disk,
+                      jwt_secret=args.jwt_secret,
                       concurrent_upload_limit=args.upload_limit_mb << 20,
                       concurrent_download_limit=args.download_limit_mb
                       << 20)
@@ -1105,24 +1112,58 @@ def _run_benchmark_native(args) -> int:
     from .native import dataplane as dpmod
     from .operation import verbs
 
+    import time
+
     n, size, conc = args.n, args.size, args.concurrency
-    by_url: dict[str, list[str]] = {}
+    if getattr(args, "replication", ""):
+        # replicated volumes fan out natively only after the control
+        # plane pushes peer lists (~2s refresh): wait for a warmup
+        # write to land on the native path BEFORE minting the measured
+        # fids — their 10s jwt window must not be spent waiting here.
+        # repl_post is a lifetime counter: gate on its DELTA, not its
+        # value, or a previous run's fan-outs would satisfy the check
+        import requests as _rq
+
+        def _repl_post(url):
+            st = _rq.get(f"http://{url}/status", timeout=5).json()
+            nd = st.get("native_dataplane")
+            return None if nd is None else nd.get("repl_post", 0)
+
+        base: dict[str, int | None] = {}
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            a = verbs.assign(args.master, collection=args.collection,
+                             replication=args.replication)
+            if a.url not in base:
+                base[a.url] = _repl_post(a.url)
+            verbs.upload(a, b"warmup")
+            now_ct = _repl_post(a.url)
+            if now_ct is None or now_ct > (base[a.url] or 0):
+                break  # native fan-out live (or pure-python server)
+            time.sleep(0.5)
+
+    by_url: dict[str, tuple[list[str], list[str]]] = {}
     left = n
     while left > 0:
         batch = min(1000, left)
         a = verbs.assign(args.master, count=batch,
-                         collection=args.collection)
-        fids = by_url.setdefault(a.url, [])
+                         collection=args.collection,
+                         replication=getattr(args, "replication", ""))
+        fids, auths = by_url.setdefault(a.url, ([], []))
         fids.append(a.fid)
         fids.extend(f"{a.fid}_{i}" for i in range(1, batch))
+        # batch slots share the base fid's token
+        # (volume_server_handlers.go:181 strips the _N suffix)
+        auths.extend([a.auth] * batch)
         left -= batch
 
     def run(mode: str) -> tuple[float, list, int, int]:
         total_wall, lats, errs, count = 0.0, [], 0, 0
-        for url, fids in by_url.items():
+        for url, (fids, auths) in by_url.items():
             host, _, port = url.partition(":")
-            wall, lat, err = dpmod.bench(host, int(port), mode, fids,
-                                         size, conc)
+            wall, lat, err = dpmod.bench(
+                host, int(port), mode, fids, size, conc,
+                auths=auths if any(auths) else None)
             total_wall += wall
             lats.append(lat[lat > 0])
             errs += err

@@ -380,9 +380,10 @@ class _GatedStore(FilerStore):
             "available everywhere: memory, sqlite, leveldb")
 
 
-# redis / cassandra / mysql / postgres have real implementations now —
-# see redis_store.py (RESP), cassandra_store.py (CQL v4 via
-# cql_lite.py), and abstract_sql.py (shared SQL layer).
+# redis / cassandra / mysql / postgres / elastic / arango have real
+# implementations now — see redis_store.py (RESP), cassandra_store.py
+# (CQL v4 via cql_lite.py), abstract_sql.py (shared SQL layer),
+# elastic_store.py (ES7 REST), arango_store.py (HTTP docs + AQL).
 # The remaining reference store families stay gated placeholders:
 
 @register_store("tikv")
@@ -398,8 +399,3 @@ class YdbStore(_GatedStore):
 @register_store("hbase")
 class HbaseStore(_GatedStore):
     KIND, NEEDS = "hbase", "happybase"
-
-
-@register_store("elastic")
-class ElasticStore(_GatedStore):
-    KIND, NEEDS = "elastic", "elasticsearch"

@@ -50,8 +50,15 @@ def _load() -> ctypes.CDLL:
         lib.dp_start.restype = ctypes.c_int
         lib.dp_stop.argtypes = []
         lib.dp_stop.restype = None
-        lib.dp_config.argtypes = [ctypes.c_int]
+        lib.dp_config.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.dp_config.restype = None
+        lib.dp_set_peers.argtypes = [ctypes.c_uint32, ctypes.c_char_p]
+        lib.dp_set_peers.restype = ctypes.c_int
+        lib.dp_peers_stale.argtypes = [ctypes.c_uint32]
+        lib.dp_peers_stale.restype = ctypes.c_int
+        lib.dp_hmac_sha256.argtypes = [u8p, ctypes.c_int64, u8p,
+                                       ctypes.c_int64, u8p]
+        lib.dp_hmac_sha256.restype = None
         lib.dp_attach.argtypes = [
             ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int64,
@@ -82,27 +89,57 @@ def _load() -> ctypes.CDLL:
         lib.dp_http_stats.restype = None
         lib.dp_bench.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
                                  ctypes.c_int, ctypes.c_char_p,
-                                 ctypes.c_int64, ctypes.c_int64,
-                                 ctypes.c_int, i64p, i64p]
+                                 ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.c_int64, ctypes.c_int, i64p, i64p]
         lib.dp_bench.restype = ctypes.c_int64
+        lib.dp_bench_raw.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                     u8p, i64p, ctypes.c_int64,
+                                     ctypes.c_int, i64p, i64p]
+        lib.dp_bench_raw.restype = ctypes.c_int64
         _lib = lib
         return lib
 
 
 def bench(host: str, port: int, mode: str, fids: list[str],
-          payload_size: int, concurrency: int
+          payload_size: int, concurrency: int,
+          auths: list[str] | None = None
           ) -> tuple[float, np.ndarray, int]:
     """Native load generator (no server needed on this side): drives
     GETs/POSTs over keep-alive connections from C++ worker threads.
+    `auths`: optional per-fid bearer tokens for jwt-guarded rows.
     -> (wall seconds, per-request latency seconds — negative entries
     are failures, error count)."""
     lib = _load()
     blob = "\n".join(fids).encode()
+    ablob = "\n".join(auths).encode() if auths else None
     lats = np.empty(len(fids), np.int64)
     errs = ctypes.c_int64(0)
     wall = lib.dp_bench(
-        host.encode(), port, 1 if mode == "post" else 0, blob,
+        host.encode(), port, 1 if mode == "post" else 0, blob, ablob,
         len(fids), payload_size, concurrency,
+        lats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(errs))
+    if wall < 0:
+        raise OSError(-wall, os.strerror(-wall))
+    return wall / 1e9, lats.astype(np.float64) / 1e9, int(errs.value)
+
+
+def bench_raw(host: str, port: int, requests: list[bytes],
+              concurrency: int) -> tuple[float, np.ndarray, int]:
+    """Replay prebuilt HTTP request bytes (already signed/framed by the
+    caller) over native keep-alive connections — the S3/filer gateway
+    benchmark client. -> (wall seconds, latency seconds with failures
+    negative, error count)."""
+    lib = _load()
+    blob = b"".join(requests)
+    offs = np.zeros(len(requests) + 1, np.int64)
+    np.cumsum([len(r) for r in requests], out=offs[1:])
+    lats = np.empty(len(requests), np.int64)
+    errs = ctypes.c_int64(0)
+    wall = lib.dp_bench_raw(
+        host.encode(), port, _u8p(blob),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(requests), concurrency,
         lats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         ctypes.byref(errs))
     if wall < 0:
@@ -140,8 +177,10 @@ class DataPlane:
     def stop(self) -> None:
         self._lib.dp_stop()
 
-    def config(self, jwt_required: bool) -> None:
-        self._lib.dp_config(1 if jwt_required else 0)
+    def config(self, jwt_required: bool, secret: str = "") -> None:
+        """jwt_required + the HS256 secret so the front verifies write
+        tokens in-process instead of relaying every guarded write."""
+        self._lib.dp_config(1 if jwt_required else 0, secret.encode())
 
     # -- volumes --------------------------------------------------------
     def attach(self, vid: int, dat_path: str, idx_path: str, version: int,
@@ -186,6 +225,29 @@ class DataPlane:
 
     def set_replicas(self, vid: int, has: bool) -> None:
         self._lib.dp_set_replicas(vid, 1 if has else 0)
+
+    def set_peers(self, vid: int, peers: list[str]) -> None:
+        """Push the replica peer list ("host:port", self excluded) so
+        the front fans primary writes out natively; clears the stale
+        flag. Raises KeyError when the volume is not attached."""
+        rc = self._lib.dp_set_peers(vid, ",".join(peers).encode())
+        if rc != 0:
+            raise KeyError(f"volume {vid} not attached")
+
+    def peers_stale(self, vid: int) -> bool:
+        """True when a fan-out failure invalidated the peer list (writes
+        relay to Python until set_peers pushes a fresh one)."""
+        rc = self._lib.dp_peers_stale(vid)
+        if rc < 0:
+            raise KeyError(f"volume {vid} not attached")
+        return rc == 1
+
+    def hmac_sha256(self, key: bytes, msg: bytes) -> bytes:
+        """Test hook: the native HMAC-SHA256 (JWT verification core)."""
+        out = (ctypes.c_uint8 * 32)()
+        self._lib.dp_hmac_sha256(_u8p(key), len(key), _u8p(msg), len(msg),
+                                 out)
+        return bytes(out)
 
     # -- needle ops (Python-side delegation) ----------------------------
     def append(self, vid: int, rec: bytes, key: int, size: int,
@@ -244,10 +306,12 @@ class DataPlane:
             return keys[:n], offs[:n], sizes[:n]
 
     def http_stats(self) -> dict:
-        out = (ctypes.c_int64 * 4)()
+        out = (ctypes.c_int64 * 8)()
         self._lib.dp_http_stats(out)
         return {"fast_get": out[0], "fast_post": out[1],
-                "proxied": out[2], "errors": out[3]}
+                "proxied": out[2], "errors": out[3],
+                "fast_delete": out[4], "repl_post": out[5],
+                "jwt_reject": out[6], "fanout_fail": out[7]}
 
 
 class NativeNeedleMap:

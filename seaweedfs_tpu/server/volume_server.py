@@ -197,7 +197,7 @@ class VolumeServer:
         dp = DataPlane()
         port = dp.start(public_port, backend_port, workers,
                         listen_ip=listen_ip)
-        dp.config(self.guard.enabled)
+        dp.config(self.guard.enabled, self.guard.secret)
         self.dp = dp
         for loc in self.store.locations:
             for v in loc.volumes.values():
@@ -260,6 +260,52 @@ class VolumeServer:
 
     async def _on_startup(self, app) -> None:
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        self._peer_task = asyncio.create_task(self._peer_refresh_loop())
+
+    PEER_REFRESH_SECONDS = 2.0
+
+    async def _peer_refresh_loop(self) -> None:
+        """Keep the native front's replica peer lists fresh so primary
+        writes to replicated volumes fan out in C++ (the analogue of the
+        reference masterClient vidMap feeding store_replicate.go:191).
+        A fan-out failure marks the list stale — the front relays those
+        writes to this Python path until the next push here."""
+        while True:
+            try:
+                await asyncio.sleep(self.PEER_REFRESH_SECONDS)
+                if self.dp is None:
+                    continue
+                me = f"{self.store.ip}:{self.store.port}"
+                for loc in self.store.locations:
+                    for v in list(loc.volumes.values()):
+                        if getattr(v, "delegate", None) is None:
+                            continue
+                        copies = \
+                            v.super_block.replica_placement.copy_count
+                        if copies <= 1:
+                            continue
+                        try:
+                            if self.dp.peers_stale(v.vid):
+                                # a peer died or moved: force a fresh
+                                # master lookup instead of the TTL cache
+                                self._invalidate_lookup(v.vid)
+                        except KeyError:
+                            continue  # detached meanwhile
+                        urls = await self._lookup_volume_all(v.vid)
+                        peers = [u for u in urls if u != me]
+                        # only a COMPLETE placement may fan out natively;
+                        # anything short relays to Python, which fails
+                        # the write rather than under-replicate
+                        if len(peers) == copies - 1:
+                            try:
+                                self.dp.set_peers(v.vid, peers)
+                            except KeyError:
+                                pass
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                glog.v(1, "native peer refresh failed: %s", e)
+                await asyncio.sleep(1)
 
     async def handle_leave(self, req: web.Request) -> web.Response:
         """volume.server.leave (command_volume_server_leave.go →
@@ -280,6 +326,13 @@ class VolumeServer:
             self._hb_task.cancel()
             try:
                 await self._hb_task
+            except asyncio.CancelledError:
+                pass
+        peer_task = getattr(self, "_peer_task", None)
+        if peer_task is not None:
+            peer_task.cancel()
+            try:
+                await peer_task
             except asyncio.CancelledError:
                 pass
         sess = getattr(self, "_client_sess", None)
@@ -655,6 +708,13 @@ class VolumeServer:
             return f"volume {vid}: no replica peers resolvable"
         params = {"type": "replicate"}
         headers = {}
+        # the secondary ALSO guards writes: forward the client's token
+        # (same fid claim, still inside its validity window — the
+        # reference forwards the jwt through ReplicatedWrite the same
+        # way). Without this, JWT + replication could never coexist.
+        auth = req.headers.get("Authorization")
+        if auth:
+            headers["Authorization"] = auth
         if needle is not None:
             if needle.name:
                 # latin-1 maps bytes 1:1 so non-UTF-8 names survive
@@ -694,7 +754,7 @@ class VolumeServer:
                             return (f"replicate to {peer}: "
                                     f"{resp.status}")
                 else:
-                    async with sess.delete(url) as resp:
+                    async with sess.delete(url, headers=headers) as resp:
                         if resp.status >= 300 and resp.status != 404:
                             self._invalidate_lookup(vid)
                             return (f"replicate delete {peer}: "

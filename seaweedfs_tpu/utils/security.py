@@ -67,6 +67,11 @@ class Guard:
             return
         if not auth_header or not auth_header.startswith("Bearer "):
             raise PermissionError("missing jwt")
+        if fid is not None and "_" in fid:
+            # batch-assign slots ("fid_N") share the base fid's token —
+            # the reference strips the suffix before comparing the claim
+            # (volume_server_handlers.go:181)
+            fid = fid[:fid.rfind("_")]
         verify_jwt(self.secret, auth_header[len("Bearer "):], fid)
 
     def sign(self, fid: str) -> str:

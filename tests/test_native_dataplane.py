@@ -195,20 +195,26 @@ def test_routing_to_proxy(tmp_path, dp):
     v = Volume(str(tmp_path), "", 8, create=True)
     v.attach_native(dp)
     _post(dp.port, "8,1deadbeef", b"hello")
-    # query string, Range, Authorization, and DELETE must all proxy
+    # query strings, seaweed-* metadata headers, and non-fid paths proxy
     for path, headers, method in [
         ("8,1deadbeef?width=10", {}, "GET"),
-        ("8,1deadbeef", {"Range": "bytes=0-1"}, "GET"),
-        ("8,1deadbeef", {"Authorization": "Bearer x"}, "GET"),
-        ("8,1deadbeef", {}, "DELETE"),
+        ("8,1deadbeef?readDeleted=true", {}, "GET"),
+        ("8,2deadbeef?name=a.txt", {}, "POST"),
         ("status", {}, "GET"),
     ]:
         req = urllib.request.Request(
             f"http://127.0.0.1:{dp.port}/{path}", headers=headers,
-            method=method)
+            method=method, data=b"x" if method == "POST" else None)
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req, timeout=5)
         assert ei.value.code == 502, path
+    # formerly-proxied verbs now served natively (round-4: range,
+    # authorization passthrough on reads, DELETE)
+    assert _get(dp.port, "8,1deadbeef",
+                headers={"Authorization": "Bearer x"})[0] == 200
+    code, body, hdrs = _get(dp.port, "8,1deadbeef",
+                            headers={"Range": "bytes=0-1"})
+    assert (code, body) == (206, b"he")
     # fast path still alive afterwards
     assert _get(dp.port, "8,1deadbeef")[1] == b"hello"
     v.detach_native()
@@ -272,6 +278,285 @@ def test_proxy_relay_roundtrip(tmp_path):
     finally:
         d.stop()
         srv.shutdown()
+
+
+def _delete(port, fid, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/{fid}",
+                                 method="DELETE", headers=headers or {})
+    try:
+        r = urllib.request.urlopen(req, timeout=5)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post_auth(port, fid, body, token):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{fid}", data=body, method="POST",
+        headers={"Content-Type": "application/octet-stream",
+                 **({"Authorization": f"Bearer {token}"} if token else {})})
+    try:
+        r = urllib.request.urlopen(req, timeout=5)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_native_delete(tmp_path, dp):
+    """DELETE by fid is served natively: tombstone + 202 {"size": N}
+    (volume_server_handlers_write.go DeleteHandler / _delete_fid)."""
+    v = Volume(str(tmp_path), "", 11, create=True)
+    v.attach_native(dp)
+    _post(dp.port, "11,1deadbeef", b"doomed-bytes")
+    code, body = _delete(dp.port, "11,1deadbeef")
+    assert code == 202
+    assert json.loads(body)["size"] == len(b"doomed-bytes") + 5
+    assert _get(dp.port, "11,1deadbeef")[0] == 404
+    # absent needle: 202 {"size": 0}, nothing written (dp_delete rules)
+    code, body = _delete(dp.port, "11,9900000000")
+    assert (code, json.loads(body)["size"]) == (202, 0)
+    assert dp.http_stats()["fast_delete"] >= 2
+    # python reload agrees (tombstone + idx entry hit the files)
+    v.detach_native()
+    v.close()
+    v2 = Volume(str(tmp_path), "", 11)
+    assert v2.nm.file_count == 0 and v2.nm.deleted_count == 1
+    v2.close()
+
+
+def test_native_range_get(tmp_path, dp):
+    """Single-range reads mirror _read_fid:494-512 exactly: a-b / a- /
+    -n forms, 206 + Content-Range, 416 on anything unsatisfiable."""
+    v = Volume(str(tmp_path), "", 12, create=True)
+    v.attach_native(dp)
+    payload = bytes(range(200))
+    _post(dp.port, "12,1deadbeef", payload)
+
+    code, body, hdrs = _get(dp.port, "12,1deadbeef",
+                            headers={"Range": "bytes=10-19"})
+    assert (code, body) == (206, payload[10:20])
+    assert hdrs["Content-Range"] == "bytes 10-19/200"
+    # open-ended + clamped end
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "bytes=190-"})[1] == payload[190:]
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "bytes=150-9999"})[1] == payload[150:]
+    # suffix form: last N bytes (bigger than the body = whole body)
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "bytes=-5"})[1] == payload[-5:]
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "bytes=-500"})[1] == payload
+    # unsatisfiable / malformed bytes= specs -> 416 like the python path
+    for bad in ["bytes=200-", "bytes=10-5", "bytes=abc-",
+                "bytes=0-1,3-4"]:
+        assert _get(dp.port, "12,1deadbeef",
+                    headers={"Range": bad})[0] == 416, bad
+    # unknown range UNITS are ignored (full 200), matching python's
+    # startswith("bytes=") gate and RFC 7233
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "items=0-1"}) [:2] == (200, payload)
+    # dash-less spec: python's partition("-") yields an open range
+    assert _get(dp.port, "12,1deadbeef",
+                headers={"Range": "bytes=190"})[1] == payload[190:]
+    # HEAD ignores Range (python returns full-length 200 first)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dp.port}/12,1deadbeef", method="HEAD",
+        headers={"Range": "bytes=0-1"})
+    r = urllib.request.urlopen(req, timeout=5)
+    assert r.status == 200 and r.headers["Content-Length"] == "200"
+    v.detach_native()
+    v.close()
+
+
+def test_jwt_guarded_native(tmp_path, dp):
+    """With a write secret configured, the front verifies HS256 tokens
+    in-process (security/guard.go:41, volume_server_handlers.go:145):
+    valid -> 201 served natively, missing/bad/expired/mismatched -> 401,
+    reads stay unguarded, batch slots share the base fid's token."""
+    from seaweedfs_tpu.utils.security import sign_jwt
+
+    secret = "native-test-secret"
+    dp.config(True, secret)
+    try:
+        v = Volume(str(tmp_path), "", 13, create=True)
+        v.attach_native(dp)
+        proxied_before = dp.http_stats()["proxied"]
+
+        tok = sign_jwt(secret, "13,1deadbeef")
+        assert _post_auth(dp.port, "13,1deadbeef", b"guarded", tok)[0] == 201
+        # served natively, not relayed (backend is a dead port anyway)
+        assert dp.http_stats()["proxied"] == proxied_before
+        # reads are unguarded (no ReadSigningKey analogue configured)
+        assert _get(dp.port, "13,1deadbeef")[1] == b"guarded"
+
+        assert _post_auth(dp.port, "13,2deadbeef", b"x", "")[0] == 401
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          tok[:-4] + "AAAA")[0] == 401
+        # token for a DIFFERENT fid
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          sign_jwt(secret, "13,9deadbeef"))[0] == 401
+        # expired
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          sign_jwt(secret, "13,2deadbeef",
+                                   expires_seconds=-5))[0] == 401
+        # wrong secret
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          sign_jwt("other", "13,2deadbeef"))[0] == 401
+        # batch slot _N authorized by the base fid's token
+        # (volume_server_handlers.go:181 strips the suffix)
+        assert _post_auth(dp.port, "13,1deadbeef_2", b"slot", tok)[0] == 201
+        # delete guarded the same way
+        assert _delete(dp.port, "13,1deadbeef")[0] == 401
+        assert _delete(dp.port, "13,1deadbeef",
+                       headers={"Authorization": f"Bearer {tok}"})[0] == 202
+        assert dp.http_stats()["jwt_reject"] >= 5
+        v.detach_native()
+        v.close()
+    finally:
+        dp.config(False, "")  # the C library is a process singleton
+
+
+class _ReplicaDouble:
+    """Records replicate requests and answers 201/202 (or a forced
+    error) — stands in for the peer volume server."""
+
+    def __init__(self, fail=False):
+        # threading: every C++ proxy worker holds its own keep-alive
+        # conn to the peer; a single-threaded server would strand the
+        # second worker's connect in the backlog forever
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        double = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self, code):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                double.requests.append(
+                    (self.command, self.path,
+                     self.headers.get("Authorization"), body))
+                if double.fail:
+                    code = 500
+                out = b"{}"
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_POST(self):
+                self._handle(201)
+
+            def do_DELETE(self):
+                self._handle(202)
+
+            def log_message(self, *a):
+                pass
+
+        self.requests = []
+        self.fail = fail
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_port
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.srv.shutdown()
+
+
+def test_replicated_write_fans_out_natively(tmp_path, dp):
+    """A primary write to a replicated volume appends locally and ships
+    the body to every peer as ?type=replicate from the worker pool
+    (store_replicate.go:24 ReplicatedWrite)."""
+    double = _ReplicaDouble()
+    try:
+        v = Volume(str(tmp_path), "", 14, create=True)
+        v.attach_native(dp)
+        dp.set_replicas(14, True)
+        dp.set_peers(14, [f"127.0.0.1:{double.port}"])
+
+        code, resp = _post(dp.port, "14,1deadbeef", b"fan-out-bytes")
+        assert code == 201 and json.loads(resp)["size"] == 13
+        assert v.read_needle(0x1, 0xDEADBEEF).data == b"fan-out-bytes"
+        assert double.requests == [
+            ("POST", "/14,1deadbeef?type=replicate", None,
+             b"fan-out-bytes")]
+        assert dp.http_stats()["repl_post"] >= 1
+
+        # DELETE fans out too, 404 from a peer is fine
+        code, resp = _delete(dp.port, "14,1deadbeef")
+        assert code == 202 and json.loads(resp)["size"] > 0
+        assert double.requests[-1][:2] == (
+            "DELETE", "/14,1deadbeef?type=replicate")
+
+        # incoming secondary write (?type=replicate) appends WITHOUT
+        # fanning out again (store_replicate.go:30 masks the loop)
+        n_before = len(double.requests)
+        code, _ = _post(dp.port, "14,2deadbeef?type=replicate", b"sec")
+        assert code == 201
+        assert len(double.requests) == n_before
+        assert v.read_needle(0x2, 0xDEADBEEF).data == b"sec"
+        v.detach_native()
+        v.close()
+    finally:
+        double.stop()
+
+
+def test_replicated_write_failure_marks_stale(tmp_path, dp):
+    """A failing peer fails the write (500) and flips peers_stale:
+    writes relay to Python until the control plane pushes a fresh
+    list — never a silent under-replicated ack."""
+    double = _ReplicaDouble(fail=True)
+    try:
+        v = Volume(str(tmp_path), "", 15, create=True)
+        v.attach_native(dp)
+        dp.set_replicas(15, True)
+        dp.set_peers(15, [f"127.0.0.1:{double.port}"])
+
+        code, body = _post(dp.port, "15,1deadbeef", b"doomed")
+        assert code == 500 and b"replicate" in body
+        assert dp.peers_stale(15)
+        assert dp.http_stats()["fanout_fail"] >= 1
+        # stale -> next write relays (backend down here -> 502)
+        assert _post(dp.port, "15,2deadbeef", b"x")[0] == 502
+        # a fresh peer push reactivates the native fan-out
+        double.fail = False
+        dp.set_peers(15, [f"127.0.0.1:{double.port}"])
+        assert not dp.peers_stale(15)
+        assert _post(dp.port, "15,3deadbeef", b"ok")[0] == 201
+        v.detach_native()
+        v.close()
+    finally:
+        double.stop()
+
+
+def test_jwt_forwarded_on_fanout(tmp_path, dp):
+    """The primary forwards the client's bearer token to secondaries —
+    the peer guards ?type=replicate writes with the same fid claim."""
+    from seaweedfs_tpu.utils.security import sign_jwt
+
+    secret = "fanout-secret"
+    dp.config(True, secret)
+    double = _ReplicaDouble()
+    try:
+        v = Volume(str(tmp_path), "", 16, create=True)
+        v.attach_native(dp)
+        dp.set_replicas(16, True)
+        dp.set_peers(16, [f"127.0.0.1:{double.port}"])
+        tok = sign_jwt(secret, "16,1deadbeef")
+        assert _post_auth(dp.port, "16,1deadbeef", b"sec", tok)[0] == 201
+        method, path, auth, body = double.requests[0]
+        assert (method, path) == ("POST", "/16,1deadbeef?type=replicate")
+        assert auth == f"Bearer {tok}"
+        # and a bad token is rejected BEFORE any local write or fan-out
+        assert _post_auth(dp.port, "16,2deadbeef", b"x", "junk")[0] == 401
+        assert len(double.requests) == 1
+        v.detach_native()
+        v.close()
+    finally:
+        dp.config(False, "")
+        double.stop()
 
 
 def test_export_matches_python_map(tmp_path, dp):

@@ -1,0 +1,169 @@
+"""End-to-end: JWT + replication=001 served by the NATIVE data plane.
+
+Two real volume-server processes with C++ fronts (-dataplane=native)
+and a jwt-guarded master. The production config the reference serves
+from compiled code (volume_server_handlers.go:145 jwt check,
+store_replicate.go:24 ReplicatedWrite) must stay on the native fast
+path here too: the test polls the primary's /status until the native
+`repl_post` counter proves writes fanned out from C++, not from the
+Python relay.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.native import dataplane as dpmod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SECRET = "e2e-native-secret"
+
+pytestmark = pytest.mark.skipif(
+    not dpmod.available(), reason="no g++ / prebuilt dataplane library")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_http(url, timeout=30):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            requests.get(url, timeout=1)
+            return
+        except requests.RequestException as e:
+            last = e
+            time.sleep(0.15)
+    raise TimeoutError(f"{url} never came up: {last}")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("native_repl")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+
+    def spawn(*argv):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *argv],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    mport = free_port()
+    vports = [free_port(), free_port()]
+    master = f"http://127.0.0.1:{mport}"
+    spawn("master", "-port", str(mport), "-volumeSizeLimitMB", "64",
+          "-jwt.secret", SECRET)
+    wait_http(f"{master}/cluster/status")
+    for i, vp in enumerate(vports):
+        d = base / f"vol{i}"
+        d.mkdir()
+        spawn("volume", "-port", str(vp), "-dir", str(d),
+              "-mserver", f"127.0.0.1:{mport}",
+              "-dataplane", "native", "-jwt.secret", SECRET)
+        wait_http(f"http://127.0.0.1:{vp}/status")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        topo = requests.get(f"{master}/cluster/status").json()["Topology"]
+        n = sum(len(r["nodes"]) for dc in topo["datacenters"]
+                for r in dc["racks"])
+        if n >= 2:
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("volume servers never registered")
+    yield {"master": master, "vports": vports}
+    for p in reversed(procs):
+        if p.poll() is None:
+            p.send_signal(signal.SIGINT)
+    for p in reversed(procs):
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _native_stats(port):
+    return requests.get(f"http://127.0.0.1:{port}/status",
+                        timeout=5).json().get("native_dataplane", {})
+
+
+def test_jwt_replicated_write_on_native_path(cluster):
+    m = cluster["master"]
+    # until the peer-refresh loop pushes placements (~2s) writes relay
+    # through Python — also correct, but the point of this test is that
+    # the native path takes over: keep writing until repl_post moves
+    deadline = time.time() + 25
+    fid = url = auth = None
+    while time.time() < deadline:
+        a = requests.get(f"{m}/dir/assign",
+                         params={"replication": "001"}).json()
+        assert "fid" in a, a
+        fid, url, auth = a["fid"], a["url"], a["auth"]
+        assert auth, "jwt-enabled master must mint write tokens"
+        r = requests.post(
+            f"http://{url}/{fid}", data=b"native-replicated",
+            headers={"Authorization": f"Bearer {auth}",
+                     "Content-Type": "application/octet-stream"},
+            timeout=10)
+        assert r.status_code == 201, r.text
+        port = int(url.rsplit(":", 1)[1])
+        if _native_stats(port).get("repl_post", 0) > 0:
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("native fan-out never engaged (repl_post stayed 0): "
+                    f"stats={_native_stats(int(url.rsplit(':', 1)[1]))}")
+
+    # the object is on BOTH servers (read each directly, no redirect)
+    locs = requests.get(f"{m}/dir/lookup",
+                        params={"volumeId": fid.split(",")[0]}).json()
+    urls = [l["url"] for l in locs["locations"]]
+    assert len(urls) == 2
+    for u in urls:
+        got = requests.get(f"http://{u}/{fid}", timeout=5)
+        assert got.status_code == 200, u
+        assert got.content == b"native-replicated"
+
+    # writes without (or with a bad) token are refused at the front
+    bad = requests.post(f"http://{url}/{fid}", data=b"x",
+                        headers={"Content-Type":
+                                 "application/octet-stream"}, timeout=5)
+    assert bad.status_code == 401
+    bad = requests.post(
+        f"http://{url}/{fid}", data=b"x",
+        headers={"Authorization": "Bearer junk.junk.junk",
+                 "Content-Type": "application/octet-stream"}, timeout=5)
+    assert bad.status_code == 401
+
+    # guarded replicated DELETE: tombstones everywhere
+    a = requests.get(f"{m}/dir/assign",
+                     params={"replication": "001"}).json()
+    requests.post(f"http://{a['url']}/{a['fid']}", data=b"doomed",
+                  headers={"Authorization": f"Bearer {a['auth']}",
+                           "Content-Type": "application/octet-stream"},
+                  timeout=10)
+    # deletes need a token for the same fid; the master mints them at
+    # assign time, so reuse it inside its validity window
+    r = requests.delete(f"http://{a['url']}/{a['fid']}",
+                        headers={"Authorization": f"Bearer {a['auth']}"},
+                        timeout=10)
+    assert r.status_code in (200, 202), r.text
+    locs = requests.get(f"{m}/dir/lookup",
+                        params={"volumeId": a["fid"].split(",")[0]}).json()
+    for l in locs["locations"]:
+        assert requests.get(f"http://{l['url']}/{a['fid']}",
+                            timeout=5).status_code == 404

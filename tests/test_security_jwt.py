@@ -1,0 +1,100 @@
+"""JWT write authorization (utils/security.py — the HS256 equivalent of
+security/jwt.go:30 + guard.go:41) and its interaction with replication:
+the primary must forward the client's token to secondaries or guarded
+replicated writes can never succeed (the reference threads the jwt
+through ReplicatedWrite the same way).
+"""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.utils.security import Guard, sign_jwt, verify_jwt
+
+SECRET = "unit-test-secret"
+
+
+class TestVerify:
+    def test_roundtrip(self):
+        tok = sign_jwt(SECRET, "3,01abcd")
+        payload = verify_jwt(SECRET, tok, "3,01abcd")
+        assert payload["fid"] == "3,01abcd"
+        assert payload["exp"] > time.time()
+
+    def test_wrong_secret_and_tamper(self):
+        tok = sign_jwt(SECRET, "3,01abcd")
+        with pytest.raises(PermissionError):
+            verify_jwt("other", tok)
+        h, p, s = tok.split(".")
+        with pytest.raises(PermissionError):
+            verify_jwt(SECRET, f"{h}.{p}.AAAA{s[4:]}")
+        with pytest.raises(PermissionError):
+            verify_jwt(SECRET, "not-a-jwt")
+
+    def test_expiry_and_fid_claim(self):
+        with pytest.raises(PermissionError, match="expired"):
+            verify_jwt(SECRET, sign_jwt(SECRET, "3,01", -5), "3,01")
+        with pytest.raises(PermissionError, match="fid"):
+            verify_jwt(SECRET, sign_jwt(SECRET, "3,01"), "3,02")
+
+    def test_guard_strips_batch_slot_suffix(self):
+        """`fid_N` batch slots share the base fid's token — the
+        reference strips the suffix before the claim comparison
+        (volume_server_handlers.go:181)."""
+        g = Guard(SECRET)
+        tok = f"Bearer {sign_jwt(SECRET, '3,01abcd')}"
+        g.check(tok, "3,01abcd")
+        g.check(tok, "3,01abcd_7")  # slot addressed by the base token
+        with pytest.raises(PermissionError):
+            g.check(tok, "3,02abcd_7")
+        g_off = Guard("")
+        g_off.check(None)  # disabled guard accepts anything
+
+
+@pytest.fixture(scope="module")
+def jwt_cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("jwtc")),
+                n_volume_servers=2, volume_size_limit=8 << 20,
+                jwt_secret=SECRET)
+    yield c
+    c.stop()
+
+
+class TestGuardedCluster:
+    def test_write_requires_token(self, jwt_cluster):
+        a = verbs.assign(jwt_cluster.master_url)
+        assert a.auth, "guarded master must mint tokens at assign"
+        r = requests.post(f"http://{a.url}/{a.fid}", data=b"x")
+        assert r.status_code == 401
+        verbs.upload(a, b"guarded payload")  # sends Bearer a.auth
+        assert verbs.download(f"http://{a.url}/{a.fid}") \
+            == b"guarded payload"
+
+    def test_replicated_guarded_write_and_delete(self, jwt_cluster):
+        """The fan-out forwards the client's token: without that, the
+        secondary's guard 401s and the whole write fails 500."""
+        a = verbs.assign(jwt_cluster.master_url, replication="001")
+        verbs.upload(a, b"guarded replicated")
+        vid = int(a.fid.split(",")[0])
+        nodes = jwt_cluster.master.topo.lookup(vid)
+        assert len(nodes) == 2
+        for node in nodes:
+            got = requests.get(f"http://{node.url}/{a.fid}")
+            assert got.status_code == 200, node.url
+            assert got.content == b"guarded replicated"
+        verbs.delete(f"http://{nodes[0].url}/{a.fid}", auth=a.auth)
+        for node in nodes:
+            assert requests.get(
+                f"http://{node.url}/{a.fid}").status_code == 404
+
+    def test_batch_slots_under_guard(self, jwt_cluster):
+        a = verbs.assign(jwt_cluster.master_url, count=3)
+        for i in range(1, 3):
+            fid = f"{a.fid}_{i}"
+            r = requests.post(
+                f"http://{a.url}/{fid}", data=b"slot",
+                headers={"Authorization": f"Bearer {a.auth}",
+                         "Content-Type": "application/octet-stream"})
+            assert r.status_code == 201, (fid, r.text)
