@@ -363,6 +363,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-replication", default="",
                    help="replica placement for the benchmark volumes "
                         "(e.g. 001); empty = master default")
+    p.add_argument("-target", default="fid",
+                   choices=["fid", "s3", "filer"],
+                   help="fid = raw volume path (default); s3 = the "
+                        "gateway path (SigV4 auth -> filer autochunk "
+                        "-> assign -> volume); filer = the filer HTTP "
+                        "path without S3 auth")
+    p.add_argument("-s3.url", dest="s3_url",
+                   default="http://127.0.0.1:8333")
+    p.add_argument("-s3.access", dest="s3_access", default="")
+    p.add_argument("-s3.secret", dest="s3_secret", default="")
+    p.add_argument("-filer.url", dest="filer_url",
+                   default="http://127.0.0.1:8888")
+    p.add_argument("-bucket", default="benchbucket")
 
     p = sub.add_parser("scaffold", help="print a starter config "
                                         "template")
@@ -1015,6 +1028,8 @@ def _run_benchmark(args) -> int:
 
     from .operation import verbs
 
+    if getattr(args, "target", "fid") in ("s3", "filer"):
+        return _run_benchmark_gateway(args)
     n, size, conc = args.n, args.size, args.concurrency
     if getattr(args, "client", "python") == "native":
         return _run_benchmark_native(args)
@@ -1099,6 +1114,86 @@ def _run_benchmark(args) -> int:
         "read_p50_ms": round(pct(read_lat, 50), 2),
         "read_p99_ms": round(pct(read_lat, 99), 2),
         "errors": err[0],
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _run_benchmark_gateway(args) -> int:
+    """Gateway-path benchmark: PUT+GET through the S3 server (SigV4
+    auth -> filer autochunk -> assign -> volume) or the bare filer
+    HTTP path. Requests are pre-built (and pre-signed) in Python, then
+    replayed by the native keep-alive client (dp_bench_raw) so the
+    measurement is the SERVER, not a GIL-bound load generator.
+    Reference path: s3api_object_handlers_put.go ->
+    filer_server_handlers_write_autochunk.go:25."""
+    import time
+    import urllib.parse
+
+    import numpy as np
+    import requests
+
+    from .native import dataplane as dpmod
+
+    if not dpmod.available():
+        raise SystemExit("gateway benchmark needs the native client "
+                         "(g++ / prebuilt libseaweed_dataplane.so)")
+    n, size, conc = args.n, args.size, args.concurrency
+    payload = bytes(ord("a") + (i * 31 + 7) % 26 for i in range(size))
+    is_s3 = args.target == "s3"
+    base = (args.s3_url if is_s3 else args.filer_url).rstrip("/")
+    parts = urllib.parse.urlsplit(base)
+    host, _, port = parts.netloc.partition(":")
+
+    def build(method: str, path: str, body: bytes) -> bytes:
+        url = f"{base}{path}"
+        headers = {"Host": parts.netloc,
+                   "Content-Length": str(len(body))}
+        if body:
+            headers["Content-Type"] = "application/octet-stream"
+        if is_s3 and args.s3_access:
+            from .s3.sigv4_client import sign_headers
+            headers.update(sign_headers(method, url, args.s3_access,
+                                        args.s3_secret, body))
+        head = f"{method} {path} HTTP/1.1\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in headers.items()) + "\r\n"
+        return head.encode() + body
+
+    prefix = f"/{args.bucket}/bench" if is_s3 else "/bench"
+    if is_s3:
+        # the bucket must exist before objects land in it
+        from .s3.sigv4_client import sign_headers
+        h = {}
+        if args.s3_access:
+            h = sign_headers("PUT", f"{base}/{args.bucket}",
+                             args.s3_access, args.s3_secret)
+        requests.put(f"{base}/{args.bucket}", headers=h, timeout=10)
+
+    t0 = time.perf_counter()
+    puts = [build("PUT", f"{prefix}/{i:07d}", payload) for i in range(n)]
+    gets = [build("GET", f"{prefix}/{i:07d}", b"") for i in range(n)]
+    sign_s = time.perf_counter() - t0
+
+    def pct(lat, p):
+        return float(np.percentile(lat, p)) * 1000 if len(lat) else 0
+
+    wwall, wlat, werr = dpmod.bench_raw(host, int(port or 80), puts, conc)
+    rwall, rlat, rerr = dpmod.bench_raw(host, int(port or 80), gets, conc)
+    wlat, rlat = wlat[wlat > 0], rlat[rlat > 0]
+    out = {
+        "target": args.target,
+        "client": "native-raw",
+        "signing": bool(is_s3 and args.s3_access),
+        "sign_build_s": round(sign_s, 2),
+        "write_rps": round((n - werr) / wwall, 1),
+        "write_mbps": round((n - werr) * size / wwall / 1e6, 2),
+        "write_p50_ms": round(pct(wlat, 50), 2),
+        "write_p99_ms": round(pct(wlat, 99), 2),
+        "read_rps": round((n - rerr) / rwall, 1),
+        "read_mbps": round((n - rerr) * size / rwall / 1e6, 2),
+        "read_p50_ms": round(pct(rlat, 50), 2),
+        "read_p99_ms": round(pct(rlat, 99), 2),
+        "errors": werr + rerr,
     }
     print(json.dumps(out, indent=2))
     return 0

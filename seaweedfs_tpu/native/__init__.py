@@ -63,6 +63,11 @@ def _load_locked() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), i64p,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, i64p]
     lib.dat_scan.restype = ctypes.c_int64
+    lib.ec_encode_file.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int]
+    lib.ec_encode_file.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -108,6 +113,27 @@ def crc32c_batch(rows: np.ndarray) -> np.ndarray:
 def simd_level() -> int:
     """0=scalar, 1=SSSE3, 2=SSSE3+SSE4.2, 3=AVX2."""
     return int(load().native_simd_level())
+
+
+def ec_encode_file(dat_path: str, shard_paths: list[str],
+                   coef: np.ndarray, k: int, m: int,
+                   large_block: int, small_block: int,
+                   chunk: int = 2 << 20, n_threads: int = 4) -> None:
+    """Whole-file EC encode with no GIL anywhere: worker threads do
+    pread -> GF(256) parity -> pwrite per stripe row (the
+    ec_encoder.go:198-235 loop as one native call). Shard bytes are
+    identical to every other backend (same ops/rs_matrix coefficients)."""
+    lib = load()
+    coef = np.ascontiguousarray(coef, dtype=np.uint8)
+    assert coef.shape == (m, k), (coef.shape, k, m)
+    arr = (ctypes.c_char_p * len(shard_paths))(
+        *[p.encode() for p in shard_paths])
+    rc = lib.ec_encode_file(
+        dat_path.encode(), arr, len(shard_paths), _u8p(coef), k, m,
+        ctypes.c_int64(large_block), ctypes.c_int64(small_block),
+        ctypes.c_int64(chunk), n_threads)
+    if rc != 0:
+        raise IOError(f"native ec_encode_file: {os.strerror(-rc)}")
 
 
 def dat_scan(dat: np.ndarray, start: int, version: int

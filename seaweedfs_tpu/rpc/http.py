@@ -65,8 +65,12 @@ class ServerThread:
             app = await app()
         self.app = app
         # bound shutdown: a lingering client connection (e.g. a
-        # subscriber websocket) must not stall process exit
-        self._runner = web.AppRunner(app, shutdown_timeout=2.0)
+        # subscriber websocket) must not stall process exit.
+        # access_log=None: even a level-suppressed access logger costs
+        # a logging call per request — glog -v is the observability
+        # path here, like the reference's glog
+        self._runner = web.AppRunner(app, shutdown_timeout=2.0,
+                                     access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
                            ssl_context=self.ssl_context)

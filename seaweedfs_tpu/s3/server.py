@@ -231,6 +231,9 @@ class S3ApiServer:
                     await self._reload_task
                 except (asyncio.CancelledError, Exception):
                     pass
+            pool = getattr(self, "_fast_pool", None)
+            if pool is not None:
+                await pool.close()
 
         self.app.on_startup.append(_start)
         self.app.on_cleanup.append(_stop)
@@ -491,27 +494,61 @@ class S3ApiServer:
             p += "/" + urllib.parse.quote(key)
         return p
 
-    async def _filer(self, method: str, url: str, **kw):
-        def call():
-            return httpclient.session().request(method, url,
-                                                timeout=120, **kw)
+    def _http(self):
+        """Shared keep-alive pool to the filer (rpc/fastclient). The
+        previous per-call `asyncio.to_thread(requests...)` paid a
+        thread hop + sync-client overhead on EVERY internal round
+        trip — measured ~2x the whole gateway latency on a one-core
+        box; fastclient's Response keeps the .status_code / .json() /
+        .text idiom all forty call sites use."""
+        pool = getattr(self, "_fast_pool", None)
+        if pool is None:
+            from ..rpc.fastclient import HttpPool
 
-        return await asyncio.to_thread(call)
+            pool = self._fast_pool = HttpPool()
+        return pool
+
+    async def _filer(self, method: str, url: str, **kw):
+        return await self._http().request(method, url, **kw)
 
     async def _bucket_is_public_read(self, bucket: str) -> bool:
-        resp = await self._filer("GET", self._fpath(bucket),
-                                 params={"meta": "1"})
-        if resp.status_code != 200:
+        try:
+            meta = await self._require_bucket(bucket)
+        except S3Error:
             return False
-        ext = resp.json().get("extended", {}) or {}
+        ext = meta.get("extended", {}) or {}
         return ext.get("s3_acl") == "public-read"
 
+    # Bucket metadata cache. The reference keeps an in-memory bucket
+    # registry fed by a metadata subscription (s3api_bucket_registry);
+    # this build's analogue is a short TTL + invalidation on local
+    # bucket mutations — without it every object op pays a full filer
+    # ?meta=1 round trip just to learn the bucket still exists.
+    _BUCKET_TTL = 2.0
+
+    def _bucket_cache(self) -> dict:
+        cache = getattr(self, "_bucket_meta_cache", None)
+        if cache is None:
+            cache = self._bucket_meta_cache = {}
+        return cache
+
+    def _invalidate_bucket(self, bucket: str) -> None:
+        self._bucket_cache().pop(bucket, None)
+
     async def _require_bucket(self, bucket: str) -> dict:
+        cache = self._bucket_cache()
+        hit = cache.get(bucket)
+        now = time.monotonic()
+        if hit is not None and now - hit[1] < self._BUCKET_TTL:
+            return hit[0]
         resp = await self._filer("GET", self._fpath(bucket),
                                  params={"meta": "1"})
         if resp.status_code != 200:
+            cache.pop(bucket, None)  # only EXISTENCE is cached
             raise S3Error(*ERR_NO_SUCH_BUCKET)
-        return resp.json()
+        meta = resp.json()
+        cache[bucket] = (meta, now)
+        return meta
 
     async def _entry_meta(self, bucket: str, key: str) -> dict:
         resp = await self._filer("GET", self._fpath(bucket, key),
@@ -545,6 +582,7 @@ class S3ApiServer:
             raise S3Error(*ERR_BUCKET_EXISTS)
         await self._filer("POST", self._fpath(bucket) + "/",
                           params={"mkdir": "1"})
+        self._invalidate_bucket(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     async def _delete_bucket(self, bucket: str) -> web.Response:
@@ -559,6 +597,7 @@ class S3ApiServer:
             raise S3Error(*ERR_BUCKET_NOT_EMPTY)
         await self._filer("DELETE", self._fpath(bucket),
                           params={"recursive": "true"})
+        self._invalidate_bucket(bucket)
         return web.Response(status=204)
 
     async def _delete_objects(self, bucket: str,
@@ -595,6 +634,10 @@ class S3ApiServer:
         """Read-modify-write the bucket directory entry's extended
         attributes (the reference keeps bucket metadata on the bucket
         entry too, s3api/bucket_metadata.go)."""
+        # read-modify-write must start from a FRESH entry, never the
+        # TTL cache — a stale snapshot would silently drop a concurrent
+        # metadata update
+        self._invalidate_bucket(bucket)
         meta = await self._require_bucket(bucket)
         ext = dict(meta.get("extended", {}))
         mutate(ext)
@@ -602,6 +645,7 @@ class S3ApiServer:
         meta.pop("full_path", None)
         resp = await self._filer("PUT", self._fpath(bucket) + "?meta=1",
                                  json=meta)
+        self._invalidate_bucket(bucket)
         if resp.status_code >= 300:
             raise S3Error("AccessDenied" if resp.status_code == 403
                           else "InternalError", resp.text,
@@ -894,17 +938,12 @@ class S3ApiServer:
 
     async def _get_object(self, bucket: str, key: str,
                           req: web.Request) -> web.Response:
-        # a key that exists only as a directory/prefix is NoSuchKey in
-        # S3 — without this, the filer's JSON dir listing would leak
-        # out as the object body
-        try:
-            meta = await self._entry_meta(bucket, key)
-        except S3Error:
-            # S3 distinguishes a missing BUCKET from a missing KEY
-            await self._require_bucket(bucket)
-            raise
-        if meta.get("mode", 0) & 0o40000:
-            raise S3Error(*ERR_NO_SUCH_KEY)
+        # ONE filer round trip: the data response carries the entry
+        # kind (X-Seaweed-Entry) and the s3_* extended attributes as
+        # headers, so the old ?meta=1 pre-flight — a full extra filer
+        # round trip per GET — is gone. A key that exists only as a
+        # directory/prefix is NoSuchKey in S3 (the dir response is
+        # flagged, never leaked as the object body).
         headers = {}
         if "Range" in req.headers:
             headers["Range"] = req.headers["Range"]
@@ -912,6 +951,8 @@ class S3ApiServer:
             "GET" if req.method == "GET" else "HEAD",
             self._fpath(bucket, key), headers=headers)
         if resp.status_code == 404:
+            # S3 distinguishes a missing BUCKET from a missing KEY
+            await self._require_bucket(bucket)
             raise S3Error(*ERR_NO_SUCH_KEY)
         if resp.status_code == 416:
             # range past EOF is a client condition, not a server error
@@ -920,14 +961,17 @@ class S3ApiServer:
                           "the requested range is not satisfiable", 416)
         if resp.status_code >= 400:
             raise S3Error("InternalError", resp.text, 500)
+        if resp.headers.get("X-Seaweed-Entry") == "dir":
+            raise S3Error(*ERR_NO_SUCH_KEY)
         out_headers = {"ETag": resp.headers.get("ETag", "")}
         for h in ("Content-Range", "Accept-Ranges", "Last-Modified",
                   "Content-Length"):
             if h in resp.headers:
                 out_headers[h] = resp.headers[h]
-        for k, v in meta.get("extended", {}).items():
-            if k.startswith("s3_meta_"):
-                out_headers[f"x-amz-meta-{k[len('s3_meta_'):]}"] = str(v)
+        pfx = "x-seaweed-ext-s3_meta_"
+        for k, v in resp.headers.items():
+            if k.lower().startswith(pfx):
+                out_headers[f"x-amz-meta-{k[len(pfx):]}"] = v
         body = resp.content if req.method == "GET" else b""
         if req.method == "HEAD":
             return web.Response(

@@ -76,6 +76,18 @@ class MasterClient:
             raise LookupError(f"volume {vid} has no locations")
         return f"http://{locs[0]['url']}/{fid}"
 
+    def lookup_file_id_cached(self, fid: str,
+                              max_age: float = 600.0) -> str | None:
+        """Cache-only probe: the url when the vid is fresh in the map,
+        else None — NO network, safe to call on an event loop."""
+        vid = int(fid.split(",")[0])
+        with self._lock:
+            locs = self._vid_cache.get(vid)
+            if not locs or time.monotonic() - \
+                    self._cache_time.get(vid, 0) >= max_age:
+                return None
+        return f"http://{locs[0]['url']}/{fid}"
+
     def lookup_ec(self, vid: int,
                   max_age: float = 600.0) -> dict[int, list[str]]:
         """-> {shard_id: [urls]} for an EC volume, cached; refreshed by

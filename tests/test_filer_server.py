@@ -5,6 +5,7 @@ master + volume servers + filer (SURVEY.md section 3.4 call stack).
 import json
 import queue
 import threading
+import time
 
 import pytest
 import requests
@@ -115,10 +116,16 @@ class TestFilerNamespace:
         fid = meta["chunks"][0]["fid"]
         assert requests.delete(url).status_code == 204
         assert requests.get(url).status_code == 404
-        # chunk deleted on the volume server too
+        # chunk deleted on the volume server too — via the background
+        # deletion queue (filer_deletion.go analogue), so poll briefly
         locs = requests.get(f"{cluster.master_url}/dir/lookup",
                             params={"volumeId": fid.split(",")[0]}).json()
         vol_url = f"http://{locs['locations'][0]['url']}/{fid}"
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if requests.get(vol_url).status_code == 404:
+                break
+            time.sleep(0.1)
         assert requests.get(vol_url).status_code == 404
 
     def test_recursive_delete(self, cluster):
