@@ -18,6 +18,9 @@ from . import arangodb_store as _arangodb_store  # registers arangodb
 from . import cassandra_store as _cassandra_store  # registers cassandra
 from . import elastic_store as _elastic_store  # registers elastic (REST)
 from . import etcd_store as _etcd_store      # registers etcd (v3 http)
+from . import hbase_store as _hbase_store    # registers hbase (thrift)
+from . import tikv_store as _tikv_store      # registers tikv (grpc)
+from . import rocksdb_store as _rocksdb_store  # registers rocksdb (C API)
 from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis
 from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,

@@ -102,6 +102,19 @@ def _split(path: str) -> tuple[str, str]:
     return (d or "/", n)
 
 
+def _list_filter(name: str, prefix: str, start_from: str,
+                 inclusive: bool) -> str:
+    """Shared pagination gate for sorted child scans: 'keep' | 'skip' |
+    'stop'. Used by every scan-based store so the prefix-window and
+    start_from/inclusive edges have exactly ONE implementation."""
+    if prefix and not name.startswith(prefix):
+        return "stop" if name > prefix else "skip"
+    if start_from and (name < start_from or
+                       (name == start_from and not inclusive)):
+        return "skip"
+    return "keep"
+
+
 @register_store("memory")
 class MemoryStore(FilerStore):
     def __init__(self, **_):
@@ -380,22 +393,15 @@ class _GatedStore(FilerStore):
             "available everywhere: memory, sqlite, leveldb")
 
 
-# redis / cassandra / mysql / postgres / elastic / arango have real
-# implementations now — see redis_store.py (RESP), cassandra_store.py
-# (CQL v4 via cql_lite.py), abstract_sql.py (shared SQL layer),
-# elastic_store.py (ES7 REST), arango_store.py (HTTP docs + AQL).
-# The remaining reference store families stay gated placeholders:
-
-@register_store("tikv")
-class TikvStore(_GatedStore):
-    KIND, NEEDS = "tikv", "tikv-client"
-
+# redis / cassandra / mysql / postgres / elastic / arango / hbase /
+# tikv have real implementations now — see redis_store.py (RESP),
+# cassandra_store.py (CQL v4 via cql_lite.py), abstract_sql.py (shared
+# SQL layer), elastic_store.py (ES7 REST), arango_store.py (HTTP docs +
+# AQL), hbase_store.py (Thrift1 via thrift_lite.py), tikv_store.py
+# (RawKV gRPC via utils/grpc_lite.py). The one remaining reference
+# store family stays a gated placeholder (ydb's API needs its full
+# table.proto surface — the gRPC substrate itself is in-tree now):
 
 @register_store("ydb")
 class YdbStore(_GatedStore):
     KIND, NEEDS = "ydb", "ydb"
-
-
-@register_store("hbase")
-class HbaseStore(_GatedStore):
-    KIND, NEEDS = "hbase", "happybase"

@@ -151,15 +151,22 @@ class RedisStore(FilerStore):
         hi = b"[" + prefix.encode() + b"\xff" if prefix else b"+"
         names = self._r.cmd("ZRANGEBYLEX", key, lo, hi,
                             "LIMIT", "0", str(limit)) or []
-        out: list[Entry] = []
         base = _norm(dirpath).rstrip("/")
-        for nb in names:
-            name = nb.decode()
-            if prefix and not name.startswith(prefix):
-                continue
-            e = self.find_entry(f"{base}/{name}")
-            if e is not None:
-                out.append(e)
+        wanted = [nb.decode() for nb in names
+                  if not prefix or nb.decode().startswith(prefix)]
+        if not wanted:
+            return []
+        # one MGET for the whole page instead of a GET per child — on a
+        # 100k-entry directory the per-name round trips were the cost,
+        # not redis (whose sorted sets are already skiplists; the
+        # reference's redis3 chunked ItemList solves a cluster-slot
+        # concern this single-keyspace store doesn't have)
+        raws = self._r.cmd("MGET",
+                           *[f"{base}/{n}" for n in wanted]) or []
+        out: list[Entry] = []
+        for raw in raws:
+            if raw is not None:
+                out.append(Entry.from_dict(json.loads(raw)))
         return out
 
     def kv_put(self, key: str, value: bytes) -> None:

@@ -20,6 +20,8 @@ store's directory scans rely on). Values are bytes.
 from __future__ import annotations
 
 import base64
+import bisect
+import heapq
 import json
 import os
 import threading
@@ -79,8 +81,6 @@ class _Segment:
 
     def get(self, key: bytes) -> tuple[bool, bytes | None]:
         """-> (found, value-or-tombstone)."""
-        import bisect
-
         i = bisect.bisect_left(self.keys, key)
         if i < len(self.keys) and self.keys[i] == key:
             return True, self.values[i]
@@ -103,6 +103,12 @@ class WeedKV:
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
         self._mem: dict[bytes, bytes | None] = {}
+        # sorted view of _mem's keys, maintained on write: a scan page
+        # must cost O(log mem + page), not a full-memtable filter+sort
+        # per page (the redis3 chunked-skiplist concern — a million-
+        # entry directory pages through MANY scans while its inserts
+        # keep landing in the memtable)
+        self._mem_keys: list[bytes] = []
         self._mem_bytes = 0
         self._segments: list[_Segment] = []  # oldest .. newest
         self._next_seg = 0
@@ -114,6 +120,7 @@ class WeedKV:
                                      int(name[:-4]) + 1)
         self._wal_path = os.path.join(dirpath, "wal.log")
         self._replay_wal()
+        self._mem_keys = sorted(self._mem)
         self._wal = open(self._wal_path, "a")
 
     # -- WAL ------------------------------------------------------------
@@ -146,6 +153,8 @@ class WeedKV:
     def put(self, key: bytes, value: bytes) -> None:
         with self._lock:
             self._wal_append(key, value)
+            if key not in self._mem:
+                bisect.insort(self._mem_keys, key)
             self._mem[key] = value
             self._mem_bytes += len(key) + len(value)
             self._maybe_flush()
@@ -153,6 +162,8 @@ class WeedKV:
     def delete(self, key: bytes) -> None:
         with self._lock:
             self._wal_append(key, None)
+            if key not in self._mem:
+                bisect.insort(self._mem_keys, key)
             self._mem[key] = TOMBSTONE
             self._mem_bytes += len(key)
             self._maybe_flush()
@@ -173,8 +184,6 @@ class WeedKV:
         `limit` rows when given. Lazily k-way-merges the sorted sources
         so a paged directory listing doesn't materialize the whole
         range (the role of leveldb's iterator)."""
-        import bisect
-        import heapq
 
         with self._lock:
             def seg_rows(seg: _Segment, rank: int):
@@ -185,9 +194,16 @@ class WeedKV:
 
             sources = [seg_rows(seg, rank)
                        for rank, seg in enumerate(self._segments)]
-            sources.append(iter(sorted(
-                (k, len(self._segments), v)
-                for k, v in self._mem.items() if start <= k < end)))
+
+            def mem_rows():
+                lo = bisect.bisect_left(self._mem_keys, start)
+                hi = bisect.bisect_left(self._mem_keys, end)
+                rank = len(self._segments)
+                for i in range(lo, hi):
+                    k = self._mem_keys[i]
+                    yield k, rank, self._mem[k]
+
+            sources.append(mem_rows())
             out: list[tuple[bytes, bytes]] = []
             cur_key: bytes | None = None
             cur_rank, cur_val = -1, None
@@ -215,12 +231,13 @@ class WeedKV:
         with self._lock:
             if not self._mem:
                 return
-            items = sorted(self._mem.items())
+            items = [(k, self._mem[k]) for k in self._mem_keys]
             path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
             _Segment.write(path, items)
             self._segments.append(_Segment(path, items=items))
             self._next_seg += 1
             self._mem = {}
+            self._mem_keys = []
             self._mem_bytes = 0
             self._wal.close()
             self._wal = open(self._wal_path, "w")

@@ -1,0 +1,294 @@
+"""In-process HBase Thrift1 gateway double for hbase_store tests.
+
+Implements the Thrift binary protocol (unframed, strict) and the
+handful of Hbase.thrift verbs the store speaks: createTable,
+mutateRow, getRowWithColumns, deleteAllRow, scannerOpenWithScan,
+scannerGetList, scannerClose. The wire handling here is written
+directly from the Thrift spec, independent of seaweedfs_tpu's client,
+so the two sides cross-check each other.
+
+State: {table: {row: {column: value}}}, scans over sorted row keys.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+STOP, BOOL, BYTE, DOUBLE = 0, 2, 3, 4
+I16, I32, I64, STRING, STRUCT, MAP, SET, LIST = 6, 8, 10, 11, 12, 13, 14, 15
+REPLY, EXCEPTION = 2, 3
+
+
+class _In:
+    def __init__(self, sock):
+        self.sock = sock
+        self.buf = b""
+        self.pos = 0
+
+    def take(self, n):
+        while len(self.buf) - self.pos < n:
+            got = self.sock.recv(64 << 10)
+            if not got:
+                raise ConnectionError("closed")
+            self.buf = self.buf[self.pos:] + got
+            self.pos = 0
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u8(self):
+        return self.take(1)[0]
+
+    def i16(self):
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self):
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self):
+        return struct.unpack(">q", self.take(8))[0]
+
+    def binary(self):
+        return self.take(self.i32())
+
+    def value(self, t):
+        if t == BOOL:
+            return self.u8() != 0
+        if t == BYTE:
+            return self.u8()
+        if t == DOUBLE:
+            return struct.unpack(">d", self.take(8))[0]
+        if t == I16:
+            return self.i16()
+        if t == I32:
+            return self.i32()
+        if t == I64:
+            return self.i64()
+        if t == STRING:
+            return self.binary()
+        if t == STRUCT:
+            return self.struct()
+        if t == MAP:
+            kt, vt, n = self.u8(), self.u8(), self.i32()
+            return {self.value(kt): self.value(vt) for _ in range(n)}
+        if t in (SET, LIST):
+            et, n = self.u8(), self.i32()
+            return [self.value(et) for _ in range(n)]
+        raise ValueError(f"type {t}")
+
+    def struct(self):
+        out = {}
+        while True:
+            t = self.u8()
+            if t == STOP:
+                return out
+            fid = self.i16()
+            out[fid] = self.value(t)
+
+
+class _Out:
+    def __init__(self):
+        self.b = bytearray()
+
+    def u8(self, v):
+        self.b.append(v)
+        return self
+
+    def i16(self, v):
+        self.b += struct.pack(">h", v)
+        return self
+
+    def i32(self, v):
+        self.b += struct.pack(">i", v)
+        return self
+
+    def i64(self, v):
+        self.b += struct.pack(">q", v)
+        return self
+
+    def binary(self, v):
+        self.i32(len(v))
+        self.b += v
+        return self
+
+    def field(self, t, fid):
+        return self.u8(t).i16(fid)
+
+
+def _encode_value(o: _Out, v) -> int:
+    """Write `v`, returning its thrift type code. Only the shapes the
+    replies need: bytes, bool, ints (i32), lists of structs, maps of
+    bytes->struct, dict-of-field-id structs."""
+    if isinstance(v, bool):
+        o.u8(1 if v else 0)
+        return BOOL
+    if isinstance(v, int):
+        o.i32(v)
+        return I32
+    if isinstance(v, (bytes, bytearray)):
+        o.binary(bytes(v))
+        return STRING
+    raise TypeError(type(v))
+
+
+def _encode_struct(o: _Out, fields: dict) -> None:
+    for fid, v in fields.items():
+        if isinstance(v, dict) and all(
+                isinstance(k, int) for k in v) and v:
+            o.field(STRUCT, fid)
+            _encode_struct(o, v)
+        elif isinstance(v, dict):  # bytes->struct map (TRowResult cols)
+            o.field(MAP, fid).u8(STRING).u8(STRUCT).i32(len(v))
+            for k, sub in v.items():
+                o.binary(k)
+                _encode_struct(o, sub)
+        elif isinstance(v, list):  # list<struct>
+            o.field(LIST, fid).u8(STRUCT).i32(len(v))
+            for sub in v:
+                _encode_struct(o, sub)
+        else:
+            pos = len(o.b)
+            o.u8(0).i16(fid)  # placeholder type, patched below
+            t = _encode_value(o, v)
+            o.b[pos] = t
+    o.u8(STOP)
+
+
+class MiniHbase:
+    def __init__(self):
+        self.tables: dict[bytes, dict[bytes, dict[bytes, bytes]]] = {}
+        self.scanners: dict[int, list] = {}
+        self._next_scanner = 1
+        self._lock = threading.Lock()
+        self.calls: list[str] = []
+
+    def start(self) -> "MiniHbase":
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(8)
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        inp = _In(conn)
+        try:
+            while True:
+                ver = inp.i32()
+                name = inp.binary().decode()
+                seq = inp.i32()
+                args = inp.struct()
+                # strict binary protocol: high 16 bits are 0x8001
+                assert ((ver & 0xFFFFFFFF) >> 16) == 0x8001, hex(ver)
+                self.calls.append(name)
+                try:
+                    with self._lock:
+                        result = self._dispatch(name, args)
+                    self._reply(conn, name, seq, result)
+                except _HbaseError as e:
+                    self._reply(conn, name, seq, None,
+                                error={1: str(e).encode()})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _reply(self, conn, name, seq, result, error=None):
+        o = _Out()
+        o.i32(struct.unpack(
+            ">i", struct.pack(">I", 0x80010000 | REPLY))[0])
+        o.binary(name.encode())
+        o.i32(seq)
+        if error is not None:
+            _encode_struct(o, {1: error})
+        elif result is None:
+            o.u8(STOP)  # void success
+        else:
+            _encode_struct(o, {0: result})
+        conn.sendall(bytes(o.b))
+
+    # -- verbs -----------------------------------------------------------
+    def _dispatch(self, name, a):
+        if name == "createTable":
+            table = a[1]
+            if table in self.tables:
+                raise _HbaseError(f"table {table!r} already exists")
+            self.tables[table] = {}
+            return None
+        if name == "mutateRow":
+            rows = self.tables.setdefault(a[1], {})
+            row = rows.setdefault(a[2], {})
+            for mut in a[3]:
+                col = mut.get(2)
+                if mut.get(1):  # isDelete
+                    row.pop(col, None)
+                else:
+                    row[col] = mut.get(3, b"")
+            if not row:
+                rows.pop(a[2], None)
+            return None
+        if name == "deleteAllRow":
+            self.tables.setdefault(a[1], {}).pop(a[2], None)
+            return None
+        if name == "getRowWithColumns":
+            row = self.tables.get(a[1], {}).get(a[2])
+            if row is None:
+                return []
+            cols = {c: {1: row[c], 2: 0}
+                    for c in a[3] if c in row}
+            if not cols:
+                return []
+            return [{1: a[2], 2: cols}]
+        if name == "scannerOpenWithScan":
+            scan = a[2]
+            start = scan.get(1, b"")
+            stop_row = scan.get(2, b"")
+            want = scan.get(4) or []
+            rows = self.tables.get(a[1], {})
+            snap = []
+            for rk in sorted(rows):
+                if rk < start or (stop_row and rk >= stop_row):
+                    continue
+                cols = {c: {1: rows[rk][c], 2: 0}
+                        for c in want if c in rows[rk]} if want else {
+                    c: {1: v, 2: 0} for c, v in rows[rk].items()}
+                if cols:
+                    snap.append({1: rk, 2: cols})
+            sid = self._next_scanner
+            self._next_scanner += 1
+            self.scanners[sid] = snap
+            return sid
+        if name == "scannerGetList":
+            snap = self.scanners.get(a[1])
+            if snap is None:
+                raise _HbaseError(f"invalid scanner {a[1]}")
+            n = a.get(2, 1)
+            out, self.scanners[a[1]] = snap[:n], snap[n:]
+            return out
+        if name == "scannerClose":
+            self.scanners.pop(a[1], None)
+            return None
+        raise _HbaseError(f"unknown method {name}")
+
+
+class _HbaseError(Exception):
+    pass

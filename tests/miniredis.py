@@ -112,6 +112,11 @@ class MiniRedis:
                 return b"+OK\r\n"
             if cmd == b"GET":
                 return self._bulk(self.kv.get(args[1]))
+            if cmd == b"MGET":
+                out = b"*%d\r\n" % (len(args) - 1)
+                for k in args[1:]:
+                    out += self._bulk(self.kv.get(k))
+                return out
             if cmd == b"DEL":
                 n = 0
                 for k in args[1:]:

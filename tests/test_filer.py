@@ -188,16 +188,25 @@ class TestGatedStores:
         import pytest as _pytest
 
         from seaweedfs_tpu.filer.filerstore import STORES, make_store
-        for kind in ("tikv", "ydb", "hbase"):
+        for kind in ("ydb",):  # the one remaining gated family
             assert kind in STORES
             with _pytest.raises(ImportError):
                 make_store(kind)
+        # rocksdb is runtime-gated on librocksdb (the reference gates
+        # the same store behind its cgo build tag)
+        import ctypes.util
+        assert "rocksdb" in STORES
+        if not ctypes.util.find_library("rocksdb"):
+            with _pytest.raises(ImportError):
+                make_store("rocksdb")
         # redis (RESP), etcd (v3 HTTP gateway), mongodb (OP_MSG/BSON),
-        # cassandra (CQL v4), mysql (client/server protocol), and
-        # postgres (protocol v3) are fully implemented wire protocols:
-        # with no server listening they fail at connect, not at import
-        for kind in ("redis", "etcd", "mongodb", "cassandra",
-                     "mysql", "postgres", "elastic", "arangodb"):
+        # cassandra (CQL v4), mysql (client/server protocol), postgres
+        # (protocol v3), hbase (thrift1), and tikv (RawKV gRPC) are
+        # fully implemented wire protocols: with no server listening
+        # they fail at connect, not at import
+        for kind in ("redis", "etcd", "mongodb", "cassandra", "mysql",
+                     "postgres", "elastic", "arangodb", "hbase",
+                     "tikv"):
             assert kind in STORES
         for kind in ("redis", "cassandra", "mysql", "postgres"):
             with _pytest.raises(OSError):

@@ -1,0 +1,167 @@
+"""HBase filer store over the real Thrift1 binary-protocol wire,
+against the in-process mini-hbase (tests/minihbase.py) — the same
+in-tree-wire-protocol strategy as the redis/etcd/cassandra store
+tests. Reference slot: /root/reference/weed/filer/hbase/
+hbase_store.go:20-108 (gohbase there; the Thrift gateway here).
+"""
+import struct
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.hbase_store import HbaseStore
+from seaweedfs_tpu.filer import thrift_lite as tl
+
+from .minihbase import MiniHbase
+
+
+@pytest.fixture(scope="module")
+def hbase_server():
+    s = MiniHbase().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def store(hbase_server):
+    hbase_server.tables.clear()
+    hbase_server.scanners.clear()
+    s = HbaseStore(port=hbase_server.port)
+    yield s
+    s.close()
+
+
+def ent(path, size=0):
+    chunks = [FileChunk(fid="1,ab", offset=0, size=size,
+                        mtime_ns=time.time_ns())] if size else []
+    return Entry(full_path=path, chunks=chunks)
+
+
+def test_golden_wire_bytes():
+    """thrift_lite against hand-derived spec bytes — the client is not
+    validated only by the double (which shares no code but could share
+    a misreading)."""
+    w = tl.Writer().message("ping", 7)
+    w.field(tl.STRING, 1).string(b"hi")
+    w.field(tl.I32, 2).i32(-1)
+    w.stop()
+    want = (
+        b"\x80\x01\x00\x01"          # strict version | CALL
+        b"\x00\x00\x00\x04ping"      # method name
+        b"\x00\x00\x00\x07"          # seqid
+        b"\x0b\x00\x01\x00\x00\x00\x02hi"  # field 1: STRING "hi"
+        b"\x08\x00\x02\xff\xff\xff\xff"    # field 2: I32 -1
+        b"\x00"                      # STOP
+    )
+    assert bytes(w.buf) == want
+    # and the reader round-trips a reply built to spec
+    reply = (b"\x80\x01\x00\x02" + b"\x00\x00\x00\x04ping"
+             + b"\x00\x00\x00\x07"
+             + b"\x0f\x00\x00\x0c\x00\x00\x00\x01"  # field0: list<struct>[1]
+             + b"\x0b\x00\x01\x00\x00\x00\x01x\x00"  # struct {1: "x"}
+             + b"\x00")
+    r = tl.Reader(reply)
+    assert struct.unpack(">I", reply[:4])[0] == 0x80010002
+    r.i32(); r.string(); r.i32()
+    out = r.struct()
+    assert out == {0: [{1: b"x"}]}
+
+
+def test_insert_find_update_delete(store):
+    store.insert_entry(ent("/a/b.txt", 10))
+    got = store.find_entry("/a/b.txt")
+    assert got is not None and got.file_size == 10
+    store.update_entry(ent("/a/b.txt", 20))
+    assert store.find_entry("/a/b.txt").file_size == 20
+    store.delete_entry("/a/b.txt")
+    assert store.find_entry("/a/b.txt") is None
+
+
+def test_listing_order_pagination_prefix(store):
+    for n in ("zeta", "alpha", "beta", "beta2", "gamma"):
+        store.insert_entry(ent(f"/dir/{n}"))
+    # nested entries must NOT leak into the parent listing
+    # (hbase_store.go:155 parent-dir check in the scan loop)
+    store.insert_entry(ent("/dir/beta/child"))
+    names = [e.name for e in store.list_directory_entries("/dir")]
+    assert names == ["alpha", "beta", "beta2", "gamma", "zeta"]
+    page = store.list_directory_entries("/dir", limit=2)
+    assert [e.name for e in page] == ["alpha", "beta"]
+    page = store.list_directory_entries("/dir", start_from="beta",
+                                        inclusive=False, limit=2)
+    assert [e.name for e in page] == ["beta2", "gamma"]
+    page = store.list_directory_entries("/dir", start_from="beta",
+                                        inclusive=True, limit=2)
+    assert [e.name for e in page] == ["beta", "beta2"]
+    pref = store.list_directory_entries("/dir", prefix="beta")
+    assert [e.name for e in pref] == ["beta", "beta2"]
+
+
+def test_delete_folder_children_subtree(store):
+    for p in ("/t/a", "/t/sub/x", "/t/sub/deep/y", "/tother/z"):
+        store.insert_entry(ent(p))
+    store.delete_folder_children("/t")
+    assert store.find_entry("/t/a") is None
+    assert store.find_entry("/t/sub/x") is None
+    assert store.find_entry("/t/sub/deep/y") is None
+    # sibling directory with a shared name prefix must survive
+    assert store.find_entry("/tother/z") is not None
+
+
+def test_kv(store):
+    store.kv_put("conf", b"\x00\x01binary")
+    assert store.kv_get("conf") == b"\x00\x01binary"
+    store.kv_delete("conf")
+    assert store.kv_get("conf") is None
+    assert store.kv_get("never") is None
+    # kv and meta share the row keyspace but not the column family:
+    # a kv value must never surface as an entry
+    store.kv_put("/dirx/clash", b"kv-bytes")
+    assert store.find_entry("/dirx/clash") is None
+    assert store.list_directory_entries("/dirx") == []
+
+
+def test_scan_batching(store):
+    # more children than one scannerGetList batch
+    n = 3 * 256 + 17
+    for i in range(n):
+        store.insert_entry(ent(f"/big/f{i:05d}"))
+    names = [e.name for e in
+             store.list_directory_entries("/big", limit=n)]
+    assert names == [f"f{i:05d}" for i in range(n)]
+
+
+def test_create_table_exists_is_fine(hbase_server):
+    HbaseStore(port=hbase_server.port).close()
+    # second store against the same table must not fail on AlreadyExists
+    s = HbaseStore(port=hbase_server.port)
+    s.insert_entry(ent("/x"))
+    assert s.find_entry("/x") is not None
+    s.close()
+
+
+def test_reconnect_after_dead_connection(store, hbase_server):
+    import socket as _s
+
+    store.insert_entry(ent("/r/a.txt", 3))
+    # kill the TCP stream under the client (both directions): the next
+    # call sees a dead keep-alive conn and must reconnect + retry
+    store.h.c._sock.shutdown(_s.SHUT_RDWR)
+    assert store.find_entry("/r/a.txt").file_size == 3
+
+
+def test_full_filer_stack(hbase_server):
+    hbase_server.tables.clear()
+    f = Filer("hbase", port=hbase_server.port)
+    try:
+        f.create_entry(ent("/docs/readme.md", 5))
+        assert f.find_entry("/docs/readme.md").file_size == 5
+        assert f.find_entry("/docs").is_directory
+        names = [e.name for e in f.list_entries("/docs")]
+        assert names == ["readme.md"]
+        f.delete_entry("/docs", recursive=True)
+        assert f.find_entry("/docs/readme.md") is None
+    finally:
+        f.close()

@@ -1,0 +1,139 @@
+"""grpc_lite (in-tree HTTP/2 + HPACK + gRPC unary client) against a
+REAL grpc-core server — the framing, HPACK dynamic table, Huffman
+strings, flow control and trailers come from the canonical C
+implementation, so the client is validated against the same stack the
+reference's gRPC services run on, not a hand-rolled double.
+"""
+import struct
+from concurrent import futures
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.utils import grpc_lite as g
+
+LONG_MSG = "the requested entity was not found anywhere at all"
+
+
+class _Handlers(grpc.GenericRpcHandler):
+    """Raw-bytes services (identity serializers)."""
+
+    def service(self, details):
+        m = details.method
+        if m == "/test.Echo/Unary":
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: b"echo:" + req)
+        if m == "/test.Echo/Meta":
+            def meta(req, ctx):
+                md = dict(ctx.invocation_metadata())
+                return md.get("x-tag", "").encode()
+            return grpc.unary_unary_rpc_method_handler(meta)
+        if m == "/test.Echo/Fail":
+            def fail(req, ctx):
+                ctx.abort(grpc.StatusCode.NOT_FOUND, LONG_MSG)
+            return grpc.unary_unary_rpc_method_handler(fail)
+        if m == "/test.Echo/Big":
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: req[::-1])
+        return None
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    srv.add_generic_rpc_handlers((_Handlers(),))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield port
+    srv.stop(0)
+
+
+@pytest.fixture()
+def ch(server):
+    c = g.GrpcChannel("127.0.0.1", server)
+    yield c
+    c.close()
+
+
+def test_unary_roundtrip(ch):
+    assert ch.unary("/test.Echo/Unary", b"hello") == b"echo:hello"
+    assert ch.unary("/test.Echo/Unary", b"") == b"echo:"
+
+
+def test_sequential_calls_one_connection(ch):
+    for i in range(20):
+        body = f"msg{i}".encode()
+        assert ch.unary("/test.Echo/Unary", body) == b"echo:" + body
+    assert ch._next_stream == 41  # 20 streams: ids 1,3,...,39
+
+
+def test_metadata(ch):
+    assert ch.unary("/test.Echo/Meta", b"",
+                    metadata=[("x-tag", "v-123")]) == b"v-123"
+
+
+def test_error_status_and_huffman_message(ch):
+    """NOT_FOUND with a long ASCII message: grpc-core Huffman-encodes
+    compressible header values, so this exercises the RFC 7541
+    Appendix B decode end to end."""
+    with pytest.raises(g.GrpcError) as ei:
+        ch.unary("/test.Echo/Fail", b"x")
+    assert ei.value.code == 5  # NOT_FOUND
+    assert LONG_MSG in ei.value.message
+
+
+def test_large_messages_flow_control(ch):
+    """1MB each way: many DATA frames, our WINDOW_UPDATEs on receive,
+    the server's on send — both beyond the 65535 initial windows."""
+    blob = bytes(range(256)) * 4096  # 1MB
+    got = ch.unary("/test.Echo/Big", blob)
+    assert got == blob[::-1]
+
+
+def test_reconnect_after_dead_connection(ch):
+    import socket as _s
+
+    assert ch.unary("/test.Echo/Unary", b"a") == b"echo:a"
+    ch._sock.shutdown(_s.SHUT_RDWR)
+    assert ch.unary("/test.Echo/Unary", b"b") == b"echo:b"
+
+
+def test_protobuf_golden_bytes():
+    """The wire helpers against hand-derived spec bytes (protobuf
+    encoding docs), independent of any server."""
+    assert g.pb_varint(0) == b"\x00"
+    assert g.pb_varint(300) == b"\xac\x02"
+    assert g.pb_varint(-1) == b"\xff" * 9 + b"\x01"
+    assert g.pb_bytes(2, b"hi") == b"\x12\x02hi"
+    assert g.pb_uint(3, 150) == b"\x18\x96\x01"
+    assert g.pb_uint(1, 0) == b""
+    msg = g.pb_bytes(1, b"ab") + g.pb_uint(2, 7) + g.pb_bytes(1, b"c")
+    dec = g.pb_decode(msg)
+    assert dec == {1: [b"ab", b"c"], 2: [7]}
+    assert g.pb_first(dec, 2) == 7
+    with pytest.raises(ValueError):
+        g.pb_decode(b"\x0a\x05ab")  # truncated length-delimited
+
+
+def test_huffman_golden():
+    """RFC 7541 Appendix C.4.1 example: 'www.example.com' huffman
+    encodes to f1e3 c2e5 f23a 6ba0 ab90 f4ff."""
+    enc = bytes.fromhex("f1e3c2e5f23a6ba0ab90f4ff")
+    assert g.huffman_decode(enc) == b"www.example.com"
+    # C.6.1: response value "private"
+    assert g.huffman_decode(bytes.fromhex("aec3771a4b")) == b"private"
+
+
+def test_hpack_decoder_rfc_examples():
+    """RFC 7541 C.3.1: first request, full literal-with-indexing set."""
+    d = g.HpackDecoder()
+    block = bytes.fromhex("828684410f7777772e6578616d706c652e636f6d")
+    assert d.decode(block) == [
+        (":method", "GET"), (":scheme", "http"), (":path", "/"),
+        (":authority", "www.example.com")]
+    # C.3.2 second request: indexed dynamic entry (62) + new literal
+    block2 = bytes.fromhex("828684be58086e6f2d6361636865")
+    assert d.decode(block2) == [
+        (":method", "GET"), (":scheme", "http"), (":path", "/"),
+        (":authority", "www.example.com"),
+        ("cache-control", "no-cache")]
