@@ -18,6 +18,39 @@ from .filechunks import resolve_chunk_manifest, view_from_chunks
 LookupFn = Callable[[str], str]  # fid -> full http url
 
 
+class ReaderPattern:
+    """Sequential-vs-random read classifier (reader_pattern.go:17):
+    a read resuming exactly where the last one stopped bumps a
+    saturating counter, anything else decrements it; negative =
+    random mode, where whole-chunk caching and readahead are pure
+    amplification (a 4KB random read must not fetch an 8MB chunk)."""
+
+    MODE_CHANGE_LIMIT = 3
+
+    def __init__(self):
+        self._counter = 0
+        self._last_stop = 0
+
+    def monitor(self, offset: int, size: int) -> None:
+        last, self._last_stop = self._last_stop, offset + size
+        if last == offset:
+            if self._counter < self.MODE_CHANGE_LIMIT:
+                self._counter += 1
+        elif self._counter > -self.MODE_CHANGE_LIMIT:
+            self._counter -= 1
+
+    @property
+    def is_random(self) -> bool:
+        return self._counter < 0
+
+    @property
+    def is_streaming(self) -> bool:
+        """Saturated-sequential: enough consecutive reads to justify
+        whole-chunk caching for SUB-chunk views (a one-shot ranged
+        read never warms up, so it never pays 8MB for 64KB)."""
+        return self._counter >= self.MODE_CHANGE_LIMIT
+
+
 def read_fid(lookup: LookupFn, fid: str, offset: int = 0,
              size: int | None = None) -> bytes:
     url = lookup(fid)
@@ -38,21 +71,42 @@ class ChunkStreamReader:
     sequential readers)."""
 
     def __init__(self, lookup: LookupFn, chunks: list[FileChunk],
-                 cache_chunks: int = 8):
+                 cache_chunks: int = 8, readahead: bool = True):
         self.lookup = lookup
         self.chunks = resolve_chunk_manifest(
             lambda fid: read_fid(lookup, fid), chunks)
         self._cache: dict[str, bytes] = {}
         self._cache_order: list[str] = []
         self._cache_chunks = cache_chunks
+        self.pattern = ReaderPattern()
+        self._readahead = readahead
+        self._prefetch = {}  # fid -> Future[bytes] (plaintext chunks)
+        self._pool = None
+        # offset-ordered plain chunks, for next-chunk readahead
+        self._seq = sorted(
+            (c for c in self.chunks if not c.is_chunk_manifest),
+            key=lambda c: c.offset)
 
     @property
     def size(self) -> int:
         return max((c.offset + c.size for c in self.chunks), default=0)
 
+    def _cache_put(self, fid: str, data: bytes) -> bytes:
+        self._cache[fid] = data
+        self._cache_order.append(fid)
+        if len(self._cache_order) > self._cache_chunks:
+            self._cache.pop(self._cache_order.pop(0), None)
+        return data
+
     def _chunk_bytes(self, fid: str, cipher_key: bytes = b"") -> bytes:
         if fid in self._cache:
             return self._cache[fid]
+        fut = self._prefetch.pop(fid, None)
+        if fut is not None and not cipher_key:
+            try:
+                return self._cache_put(fid, fut.result(timeout=60))
+            except Exception:
+                pass  # readahead is best-effort; fall through
         data = read_fid(self.lookup, fid)
         if cipher_key:
             # stored bytes are nonce||AES-GCM ciphertext; the cache
@@ -60,12 +114,32 @@ class ChunkStreamReader:
             from ..utils import cipher as _cipher
 
             data = _cipher.decrypt(data, cipher_key)
-        self._cache[fid] = data
-        self._cache_order.append(fid)
-        if len(self._cache_order) > self._cache_chunks:
-            evict = self._cache_order.pop(0)
-            self._cache.pop(evict, None)
-        return data
+        return self._cache_put(fid, data)
+
+    def _maybe_readahead(self, cur_fid: str, limit_off: int) -> None:
+        """Sequential mode: start fetching the chunk AFTER `cur_fid`
+        on a background thread so network and assembly overlap
+        (reader_cache.go MaybeCache). One chunk ahead, best-effort,
+        plain chunks only (ciphered ones must decrypt whole anyway).
+        `limit_off` bounds the prefetch to chunks this read actually
+        touches — a per-request reader must never fetch a chunk past
+        its range just to throw it away on close()."""
+        if not self._readahead or self.pattern.is_random:
+            return
+        nxt = None
+        for i, c in enumerate(self._seq):
+            if c.fid == cur_fid and i + 1 < len(self._seq):
+                nxt = self._seq[i + 1]
+                break
+        if nxt is None or nxt.offset >= limit_off or nxt.cipher_key \
+                or nxt.fid in self._cache or nxt.fid in self._prefetch:
+            return
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        self._prefetch[nxt.fid] = self._pool.submit(
+            read_fid, self.lookup, nxt.fid)
 
     def read(self, offset: int = 0, size: int | None = None) -> bytes:
         if size is None:
@@ -73,26 +147,43 @@ class ChunkStreamReader:
         size = max(0, min(size, self.size - offset))
         if size == 0:
             return b""
+        self.pattern.monitor(offset, size)
         chunk_sizes = {c.fid: c.size for c in self.chunks}
         out = bytearray(size)  # sparse gaps read as zeros
-        for v in view_from_chunks(self.chunks, offset, size):
-            if v.cipher_key or v.fid in self._cache or \
-                    v.view_size >= chunk_sizes.get(v.fid, 0):
-                # ciphered chunks must always come back whole: a ranged
-                # read of GCM ciphertext cannot be decrypted
+        views = view_from_chunks(self.chunks, offset, size)
+        streaming = self.pattern.is_streaming
+        for v in views:
+            full = v.view_size >= chunk_sizes.get(v.fid, 0)
+            whole = (v.cipher_key or v.fid in self._cache or full or
+                     v.fid in self._prefetch or streaming)
+            if whole:
+                self._maybe_readahead(v.fid, offset + size)
+                # ciphered chunks must always come back whole (a ranged
+                # read of GCM ciphertext cannot decrypt); warmed-up
+                # sequential readers take whole chunks too so the NEXT
+                # sub-chunk reads hit the cache instead of the network
                 data = self._chunk_bytes(v.fid, v.cipher_key)
                 piece = data[v.offset_in_chunk:
                              v.offset_in_chunk + v.view_size]
             else:
-                # partial view of an uncached chunk: ranged read, no
-                # whole-chunk amplification
+                # partial view of an uncached chunk on a cold or random
+                # reader: ranged read, no whole-chunk amplification
                 piece = read_fid(self.lookup, v.fid, v.offset_in_chunk,
                                  v.view_size)
             at = v.view_offset - offset
             out[at:at + len(piece)] = piece
         return bytes(out)
 
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
 
 def stream_content(lookup: LookupFn, chunks: list[FileChunk],
                    offset: int = 0, size: int | None = None) -> bytes:
-    return ChunkStreamReader(lookup, chunks).read(offset, size)
+    r = ChunkStreamReader(lookup, chunks)
+    try:
+        return r.read(offset, size)
+    finally:
+        r.close()

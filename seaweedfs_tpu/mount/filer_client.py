@@ -136,6 +136,15 @@ class FilerClient:
             data = cip.decrypt(data, cipher_key)
         return data
 
+    def read_chunk_range(self, fid: str, offset: int,
+                         size: int) -> bytes:
+        """Exactly [offset, offset+size) of one plain chunk — the
+        random-read path, no whole-chunk amplification (the volume
+        front serves ranges natively)."""
+        from ..filer.stream import read_fid
+
+        return read_fid(self.masters.lookup_file_id, fid, offset, size)
+
     # -- metadata subscription (meta_cache_subscribe.go) ----------------
     def subscribe_meta(self, prefix: str, on_event) -> None:
         """Start a background thread feeding filer metadata events

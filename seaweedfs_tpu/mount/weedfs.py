@@ -45,6 +45,11 @@ class FileHandle:
         self.dirty = dirty
         self.refs = 1
         self.lock = threading.Lock()
+        # per-handle sequential/random classifier (reader_pattern.go):
+        # drives whole-chunk caching + readahead vs ranged fetches
+        from ..filer.stream import ReaderPattern
+
+        self.pattern = ReaderPattern()
 
 
 class WeedFS:
@@ -68,6 +73,16 @@ class WeedFS:
         self.meta = MetaCache(ttl=meta_ttl)
         self.chunks = TieredChunkCache(cache_mem_bytes, cache_dir,
                                        cache_disk_bytes)
+        # readahead machinery (created HERE, not lazily under per-
+        # handle locks — two handles racing a lazy init would each
+        # build a pool and dedup against different in-flight sets)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ra_pool = ThreadPoolExecutor(max_workers=1)
+        self._ra_inflight: set[str] = set()
+        # per-chunk-list next-chunk maps (memo[0] keeps the list alive
+        # so an id() reuse after GC can never alias a stale map)
+        self._ra_memos: dict[int, tuple] = {}
         # dirty-write RAM cap per handle; spill goes next to the read
         # cache when one is configured (page_writer.go swap file)
         self.write_memory_limit = write_memory_limit
@@ -420,13 +435,15 @@ class WeedFS:
         # window returns zeros, and a concurrent kernel READAHEAD
         # hitting it poisons the page cache with them
         with h.lock:
+            h.pattern.monitor(offset, size)
             committed_size = total_size(h.entry.chunks)
             out = bytearray(size)
             # committed chunks first
             n_committed = 0
             if offset < committed_size:
                 want = min(size, committed_size - offset)
-                data = self._read_chunks(h.entry.chunks, offset, want)
+                data = self._read_chunks(h.entry.chunks, offset, want,
+                                         h.pattern)
                 out[:len(data)] = data
                 n_committed = len(data)
             # dirty overlay wins over committed bytes
@@ -442,23 +459,75 @@ class WeedFS:
             return bytes(out[:min(size, max(max_extent, 0))])
 
     def _read_chunks(self, chunks: list[FileChunk], offset: int,
-                     size: int) -> bytes:
-        """Assemble [offset, offset+size) from visible chunk views,
-        whole chunks riding the tiered cache (reader_cache.go)."""
+                     size: int, pattern=None) -> bytes:
+        """Assemble [offset, offset+size) from visible chunk views.
+        Sequential handles ride the tiered whole-chunk cache with
+        one-chunk readahead (reader_cache.go MaybeCache); random
+        handles fetch exactly the requested ranges — a 4KB random
+        read must not pull an 8MB chunk into the cache
+        (reader_pattern.go's whole point)."""
         views = view_from_chunks(chunks, offset, size)
+        random_mode = pattern is not None and pattern.is_random
+        chunk_sizes = {c.fid: c.size for c in chunks}
         out = bytearray(size)
         for v in views:
             data = self.chunks.get(v.fid)
+            if data is None and random_mode and not v.cipher_key and \
+                    v.view_size < chunk_sizes.get(v.fid, 0):
+                piece = self.client.read_chunk_range(
+                    v.fid, v.offset_in_chunk, v.view_size)
+                out[v.view_offset - offset:
+                    v.view_offset - offset + len(piece)] = piece
+                continue
             if data is None:
                 # read_chunk decrypts ciphered chunks; the tiered
                 # cache holds plaintext (keys live in entry metadata,
                 # the cache dir is as trusted as the mount itself)
                 data = self.client.read_chunk(v.fid, v.cipher_key)
                 self.chunks.put(v.fid, data)
+            if not random_mode:
+                self._maybe_readahead(chunks, v.fid)
             piece = data[v.offset_in_chunk:v.offset_in_chunk + v.view_size]
             pos = v.view_offset - offset
             out[pos:pos + len(piece)] = piece
         return bytes(out)
+
+    def _maybe_readahead(self, chunks: list[FileChunk],
+                         cur_fid: str) -> None:
+        """Prefetch the next chunk after `cur_fid` into the tiered
+        cache on a background thread (bounded to one in flight). The
+        next-chunk map is memoized per chunk LIST (flush installs a
+        new list object) — the FUSE read hot path must not re-sort
+        1000+ chunks per 128KB kernel read."""
+        memo = self._ra_memos.get(id(chunks))
+        if memo is None or memo[0] is not chunks:
+            ordered = sorted(
+                (c for c in chunks if not c.is_chunk_manifest),
+                key=lambda c: c.offset)
+            nxt_map = {ordered[i].fid: ordered[i + 1]
+                       for i in range(len(ordered) - 1)}
+            if len(self._ra_memos) > 64:  # open-file working set cap
+                self._ra_memos.clear()
+            memo = self._ra_memos[id(chunks)] = (chunks, nxt_map)
+        nxt = memo[1].get(cur_fid)
+        if nxt is None or nxt.cipher_key or \
+                self.chunks.get(nxt.fid) is not None:
+            return
+        inflight = self._ra_inflight
+        if nxt.fid in inflight or len(inflight) >= 2:
+            return
+        inflight.add(nxt.fid)
+
+        def fetch(fid=nxt.fid):
+            try:
+                data = self.client.read_chunk(fid)
+                self.chunks.put(fid, data)
+            except Exception:
+                pass  # readahead is best-effort
+            finally:
+                inflight.discard(fid)
+
+        self._ra_pool.submit(fetch)
 
     def flush(self, fh: int) -> None:
         """Commit dirty pages: upload remainders, merge new chunks into
@@ -535,3 +604,6 @@ class WeedFS:
                 pass
         self.client.stop_subscription()
         self.pipeline.shutdown(wait=True)
+        # don't wait: an in-flight readahead may sit in a 60s HTTP
+        # read; its best-effort cache put after teardown is harmless
+        self._ra_pool.shutdown(wait=False, cancel_futures=True)
