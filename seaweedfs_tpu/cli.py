@@ -151,7 +151,7 @@ def main(argv: list[str] | None = None) -> int:
                    help="metadata store: memory | sqlite | leveldb | "
                         "redis | etcd | mongodb | cassandra | mysql | "
                         "postgres | elastic | arangodb | hbase | tikv "
-                        "| rocksdb (needs librocksdb)")
+                        "| ydb | rocksdb (needs librocksdb)")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
     p.add_argument("-store.host", dest="store_host", default="")
     p.add_argument("-store.port", dest="store_port", type=int, default=0)

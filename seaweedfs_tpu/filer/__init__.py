@@ -20,6 +20,7 @@ from . import elastic_store as _elastic_store  # registers elastic (REST)
 from . import etcd_store as _etcd_store      # registers etcd (v3 http)
 from . import hbase_store as _hbase_store    # registers hbase (thrift)
 from . import tikv_store as _tikv_store      # registers tikv (grpc)
+from . import ydb_store as _ydb_store        # registers ydb (grpc+yql)
 from . import rocksdb_store as _rocksdb_store  # registers rocksdb (C API)
 from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis

@@ -30,7 +30,8 @@ import threading
 
 from .cql_lite import CqlClient, CqlError
 from .entry import Entry
-from .filerstore import FilerStore, _norm, _split, register_store
+from .filerstore import (FilerStore, _delete_subtree_by_walk, _norm,
+                         _split, register_store)
 
 
 @register_store("cassandra")
@@ -129,32 +130,10 @@ class CassandraStore(FilerStore):
         (cassandra_store.go:173-183) and leaves grandchildren to gocql
         users' recursive delete; the filer contract in this tree is
         subtree semantics, matching every other store here."""
-        path = _norm(path)
-        stack = [path]
-        seen = set()
-        while stack:
-            d = stack.pop()
-            if d in seen:
-                continue
-            seen.add(d)
-            cursor = ""
-            while True:
-                batch = self._exec(
-                    "SELECT name, meta FROM filemeta WHERE "
-                    "directory=? AND name>? LIMIT ?",
-                    (d, cursor, 1024))
-                if not batch:
-                    break
-                for name_b, meta_b in batch:
-                    cursor = (name_b or b"").decode()
-                    if not meta_b:
-                        continue
-                    e = Entry.from_dict(json.loads(meta_b))
-                    if e.is_directory:
-                        stack.append(d.rstrip("/") + "/" + cursor)
-                if len(batch) < 1024:
-                    break
-            self._exec("DELETE FROM filemeta WHERE directory=?", (d,))
+        _delete_subtree_by_walk(self, path)
+
+    def delete_directory_range(self, d: str) -> None:
+        self._exec("DELETE FROM filemeta WHERE directory=?", (d,))
 
     def list_directory_entries(self, dirpath: str, start_from: str = "",
                                inclusive: bool = False,

@@ -27,7 +27,8 @@ import urllib.parse
 import requests
 
 from .entry import Entry
-from .filerstore import FilerStore, _norm, _split, register_store
+from .filerstore import (FilerStore, _delete_subtree_by_walk, _norm,
+                         _split, register_store)
 
 INDEX_PREFIX = ".seaweedfs_"
 KV_INDEX = ".seaweedfs_kv_entries"
@@ -122,18 +123,20 @@ class ElasticStore(FilerStore):
             r.raise_for_status()
 
     def delete_folder_children(self, path: str) -> None:
-        # ParentId-walk the subtree bottom-up (the reference lists and
-        # deletes one level, leaving recursion to its filer; this
-        # tree's store contract is whole-subtree)
-        stack = [_norm(path)]
-        while stack:
-            d = stack.pop()
-            for e in self.list_directory_entries(d,
-                                                 limit=self.max_page):
-                child = d.rstrip("/") + "/" + e.name
-                if e.is_directory:
-                    stack.append(child)
-                self.delete_entry(child)
+        # ParentId-walk the subtree via the shared helper (the
+        # reference lists and deletes one level, leaving recursion to
+        # its filer; this tree's store contract is whole-subtree)
+        _delete_subtree_by_walk(self, path, page=self.max_page)
+
+    def delete_directory_range(self, d: str) -> None:
+        # writes use refresh=true (read-your-writes), so re-listing
+        # after a deleted page always converges
+        while True:
+            batch = self.list_directory_entries(d, limit=self.max_page)
+            if not batch:
+                return
+            for e in batch:
+                self.delete_entry(d.rstrip("/") + "/" + e.name)
 
     def list_directory_entries(self, dirpath: str, start_from: str = "",
                                inclusive: bool = False,

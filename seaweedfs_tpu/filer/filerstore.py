@@ -102,6 +102,35 @@ def _split(path: str) -> tuple[str, str]:
     return (d or "/", n)
 
 
+def _delete_subtree_by_walk(store: "FilerStore", path: str,
+                            page: int = 1024) -> None:
+    """Shared subtree delete for stores whose keyspace scatters
+    directories (hash partitions): walk directory entries recursively,
+    then drop each directory's own children range via the store's
+    delete_directory_range hook. ONE copy of the stack/seen/cursor
+    pagination — four stores used to carry private variants."""
+    stack = [_norm(path)]
+    seen: set[str] = set()
+    while stack:
+        d = stack.pop()
+        if d in seen:
+            continue
+        seen.add(d)
+        cursor = ""
+        while True:
+            batch = store.list_directory_entries(d, start_from=cursor,
+                                                 limit=page)
+            for e in batch:
+                if e.is_directory:
+                    stack.append(e.full_path)
+            if not batch:
+                break
+            cursor = batch[-1].name
+            if len(batch) < page:
+                break
+        store.delete_directory_range(d)
+
+
 def _list_filter(name: str, prefix: str, start_from: str,
                  inclusive: bool) -> str:
     """Shared pagination gate for sorted child scans: 'keep' | 'skip' |
@@ -393,15 +422,12 @@ class _GatedStore(FilerStore):
             "available everywhere: memory, sqlite, leveldb")
 
 
-# redis / cassandra / mysql / postgres / elastic / arango / hbase /
-# tikv have real implementations now — see redis_store.py (RESP),
-# cassandra_store.py (CQL v4 via cql_lite.py), abstract_sql.py (shared
-# SQL layer), elastic_store.py (ES7 REST), arango_store.py (HTTP docs +
-# AQL), hbase_store.py (Thrift1 via thrift_lite.py), tikv_store.py
-# (RawKV gRPC via utils/grpc_lite.py). The one remaining reference
-# store family stays a gated placeholder (ydb's API needs its full
-# table.proto surface — the gRPC substrate itself is in-tree now):
-
-@register_store("ydb")
-class YdbStore(_GatedStore):
-    KIND, NEEDS = "ydb", "ydb"
+# Every reference store family now has a real implementation — see
+# redis_store.py (RESP), cassandra_store.py (CQL v4 via cql_lite.py),
+# abstract_sql.py (shared SQL layer for mysql/postgres),
+# elastic_store.py (ES7 REST), arango_store.py (HTTP docs + AQL),
+# hbase_store.py (Thrift1 via thrift_lite.py), tikv_store.py and
+# ydb_store.py (gRPC via utils/grpc_lite.py), rocksdb_store.py
+# (ctypes on librocksdb, runtime-gated like the reference's build
+# tag). _GatedStore remains for stores whose native library is absent
+# at runtime.

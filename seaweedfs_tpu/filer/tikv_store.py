@@ -26,8 +26,8 @@ import json
 
 from ..utils import grpc_lite as g
 from .entry import Entry
-from .filerstore import (FilerStore, _list_filter, _norm, _split,
-                         register_store)
+from .filerstore import (FilerStore, _delete_subtree_by_walk,
+                         _list_filter, _norm, _split, register_store)
 
 SVC = "/tikvpb.Tikv"
 
@@ -143,33 +143,13 @@ class TikvStore(FilerStore):
             self._raw_delete(_entry_key(d, n))
 
     def delete_folder_children(self, path: str) -> None:
-        """Subtree delete. Directory hashes scatter the keyspace, so
-        nested directories are walked explicitly (same recursion the
-        cassandra store does over its partitions) and each directory's
-        contiguous range is dropped with one RawDeleteRange."""
-        stack = [_norm(path)]
-        seen = set()
-        while stack:
-            d = stack.pop()
-            if d in seen:
-                continue
-            seen.add(d)
-            base = b"m" + _dir_hash(d)
-            cursor = base
-            while True:
-                batch = self._raw_scan(cursor, _prefix_end(base),
-                                       self.SCAN_LIMIT)
-                for key, val in batch:
-                    try:
-                        ent = Entry.from_dict(json.loads(val))
-                    except (ValueError, KeyError):
-                        continue
-                    if ent.is_directory:
-                        stack.append(ent.full_path)
-                if len(batch) < self.SCAN_LIMIT:
-                    break
-                cursor = batch[-1][0] + b"\x00"
-            self._raw_delete_range(base, _prefix_end(base))
+        # directory hashes scatter the keyspace: shared recursive walk,
+        # then one contiguous RawDeleteRange per directory
+        _delete_subtree_by_walk(self, path)
+
+    def delete_directory_range(self, d: str) -> None:
+        base = b"m" + _dir_hash(d)
+        self._raw_delete_range(base, _prefix_end(base))
 
     def list_directory_entries(self, dirpath: str, start_from: str = "",
                                inclusive: bool = False,

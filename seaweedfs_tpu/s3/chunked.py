@@ -35,12 +35,27 @@ class ChunkSignatureError(Exception):
     pass
 
 
+_KEY_CACHE: dict[tuple[str, str, str, str], bytes] = {}
+
+
 def signing_key(secret: str, datestamp: str, region: str,
                 service: str) -> bytes:
+    """Derived AWS4 signing key, memoized: the derivation chain is 4
+    HMACs but its inputs only change once per DAY per identity —
+    re-deriving per request was ~half the gateway's SigV4 verify cost.
+    The cache stays tiny (identities x days) and clears itself on
+    rollover."""
+    ck = (secret, datestamp, region, service)
+    hit = _KEY_CACHE.get(ck)
+    if hit is not None:
+        return hit
     k = hmac.new(("AWS4" + secret).encode(), datestamp.encode(),
                  hashlib.sha256).digest()
     for msg in (region, service, "aws4_request"):
         k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
+    if len(_KEY_CACHE) > 1024:  # datestamp rollover / identity churn
+        _KEY_CACHE.clear()
+    _KEY_CACHE[ck] = k
     return k
 
 

@@ -1,0 +1,184 @@
+"""In-process YDB TableService double: a REAL grpc-core server (same
+wire class a ydb endpoint exposes) implementing the CreateSession /
+ExecuteSchemeQuery / ExecuteDataQuery subset over an in-memory
+filemeta/kv model. YQL is dispatched by statement shape (the five
+query templates the store emits), parameters decoded with the
+independent protobuf helpers from minitikv — client and double
+cross-check each other.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+
+import grpc
+
+from .minitikv import _by, _decode, _one, _u, _vi
+
+SUCCESS = 400000
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _like_unescape(s: str) -> str:
+    """Reverse the store's _like_escape: backslash-prefixed wildcards
+    become literals (the double then matches with plain startswith)."""
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _param_value(tv_raw: bytes):
+    """TypedValue bytes -> python scalar."""
+    tv = _decode(tv_raw)
+    val = _decode(_one(tv, 2, b""))
+    if 4 in val:
+        return _signed(val[4][0])
+    if 5 in val:
+        return val[5][0]
+    if 8 in val:
+        return bytes(val[8][0])
+    if 9 in val:
+        return bytes(val[9][0]).decode()
+    raise ValueError(f"unsupported value fields {sorted(val)}")
+
+
+def _scalar(v) -> bytes:
+    """python scalar -> Ydb.Value bytes."""
+    if isinstance(v, bytes):
+        return _by(8, v)
+    if isinstance(v, str):
+        return _by(9, v.encode())
+    raise TypeError(type(v))
+
+
+def _operation(result_msg: bytes | None, type_url: str) -> bytes:
+    op = _u(2, 1) + _u(3, SUCCESS)  # ready, status
+    if result_msg is not None:
+        any_msg = _by(1, type_url.encode()) + _by(2, result_msg)
+        op += _by(5, any_msg)
+    return _by(1, op)
+
+
+class MiniYdb(grpc.GenericRpcHandler):
+    def __init__(self):
+        # filemeta: {(dir_hash, name): (directory, meta)}; kv: {k: v}
+        self.filemeta: dict[tuple[int, str], tuple[str, bytes]] = {}
+        self.kv: dict[str, bytes] = {}
+        self.sessions = 0
+        # simulate real YDB's 1000-row result-set cap (truncated=true)
+        self.result_cap: int | None = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "MiniYdb":
+        self.server = grpc.server(futures.ThreadPoolExecutor(4))
+        self.server.add_generic_rpc_handlers((self,))
+        self.port = self.server.add_insecure_port("127.0.0.1:0")
+        self.server.start()
+        return self
+
+    def stop(self):
+        self.server.stop(0)
+
+    def service(self, details):
+        if not details.method.startswith("/Ydb.Table.V1.TableService/"):
+            return None
+        name = details.method.rsplit("/", 1)[-1]
+        fn = getattr(self, f"_{name}", None)
+        if fn is None:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx, fn=fn: fn(_decode(req) if req else {}))
+
+    def _CreateSession(self, req):
+        with self._lock:
+            self.sessions += 1
+            sid = f"session-{self.sessions}"
+        return _operation(
+            _by(1, sid.encode()),
+            "type.googleapis.com/Ydb.Table.CreateSessionResult")
+
+    def _ExecuteSchemeQuery(self, req):
+        assert b"CREATE TABLE" in bytes(_one(req, 2, b""))
+        return _operation(None, "")
+
+    def _ExecuteDataQuery(self, req):
+        yql = bytes(_one(_decode(_one(req, 3, b"")), 1, b"")).decode()
+        params = {}
+        for entry_raw in req.get(4, []):
+            e = _decode(bytes(entry_raw))
+            params[bytes(_one(e, 1, b"")).decode()] = \
+                _param_value(bytes(_one(e, 2, b"")))
+        with self._lock:
+            rows = self._run(yql, params)
+        truncated = False
+        if self.result_cap is not None and len(rows) > self.result_cap:
+            rows = rows[:self.result_cap]
+            truncated = True
+        # ExecuteQueryResult { result_sets=1 }
+        out_rows = b""
+        for row in rows:
+            items = b"".join(_by(12, _scalar(cell)) for cell in row)
+            out_rows += _by(2, items)  # ResultSet.rows (Value)
+        rs = out_rows + (_u(3, 1) if truncated else b"")
+        result = _by(1, rs) if rows or "SELECT" in yql else b""
+        return _operation(
+            result,
+            "type.googleapis.com/Ydb.Table.ExecuteQueryResult")
+
+    def _run(self, yql: str, p: dict) -> list[list]:
+        if "UPSERT INTO filemeta" in yql:
+            self.filemeta[(p["$dir_hash"], p["$name"])] = \
+                (p["$directory"], p["$meta"])
+            return []
+        if "UPSERT INTO kv" in yql:
+            self.kv[p["$k"]] = p["$v"]
+            return []
+        if "SELECT meta FROM filemeta" in yql:
+            hit = self.filemeta.get((p["$dir_hash"], p["$name"]))
+            return [[hit[1]]] if hit else []
+        if "SELECT v FROM kv" in yql:
+            return [[self.kv[p["$k"]]]] if p["$k"] in self.kv else []
+        if "DELETE FROM kv" in yql:
+            self.kv.pop(p["$k"], None)
+            return []
+        if "DELETE FROM filemeta" in yql and "$directory" in p:
+            doomed = [k for k, (d, _m) in self.filemeta.items()
+                      if k[0] == p["$dir_hash"] and d == p["$directory"]]
+            for k in doomed:
+                del self.filemeta[k]
+            return []
+        if "DELETE FROM filemeta" in yql:
+            self.filemeta.pop((p["$dir_hash"], p["$name"]), None)
+            return []
+        if "SELECT name, meta FROM filemeta" in yql:
+            inclusive = "name >= $start_name" in yql
+            assert "ESCAPE" in yql  # the store must escape wildcards
+            pfx = p["$prefix"]
+            assert pfx.endswith("%"), pfx
+            pfx = _like_unescape(pfx[:-1])
+            out = []
+            for (dh, name), (d, meta) in sorted(self.filemeta.items(),
+                                                key=lambda kv: kv[0][1]):
+                if dh != p["$dir_hash"] or d != p["$directory"]:
+                    continue
+                if inclusive and name < p["$start_name"]:
+                    continue
+                if not inclusive and name <= p["$start_name"]:
+                    continue
+                if not name.startswith(pfx):
+                    continue
+                out.append([name, meta])
+                if len(out) >= p["$limit"]:
+                    break
+            return out
+        raise AssertionError(f"unrecognized YQL: {yql[:80]}")

@@ -188,10 +188,6 @@ class TestGatedStores:
         import pytest as _pytest
 
         from seaweedfs_tpu.filer.filerstore import STORES, make_store
-        for kind in ("ydb",):  # the one remaining gated family
-            assert kind in STORES
-            with _pytest.raises(ImportError):
-                make_store(kind)
         # rocksdb is runtime-gated on librocksdb (the reference gates
         # the same store behind its cgo build tag)
         import ctypes.util
@@ -201,12 +197,13 @@ class TestGatedStores:
                 make_store("rocksdb")
         # redis (RESP), etcd (v3 HTTP gateway), mongodb (OP_MSG/BSON),
         # cassandra (CQL v4), mysql (client/server protocol), postgres
-        # (protocol v3), hbase (thrift1), and tikv (RawKV gRPC) are
-        # fully implemented wire protocols: with no server listening
-        # they fail at connect, not at import
+        # (protocol v3), hbase (thrift1), tikv (RawKV gRPC) and ydb
+        # (TableService gRPC + YQL) are fully implemented wire
+        # protocols: with no server listening they fail at connect,
+        # not at import — every reference store family is covered
         for kind in ("redis", "etcd", "mongodb", "cassandra", "mysql",
                      "postgres", "elastic", "arangodb", "hbase",
-                     "tikv"):
+                     "tikv", "ydb"):
             assert kind in STORES
         for kind in ("redis", "cassandra", "mysql", "postgres"):
             with _pytest.raises(OSError):

@@ -1,0 +1,152 @@
+"""YDB filer store over the TableService gRPC wire against the
+mini-ydb double (a REAL grpc-core server, tests/miniydb.py) — the last
+reference store family, which the reference itself ships only behind
+`//go:build ydb`. Reference slot:
+/root/reference/weed/filer/ydb/ydb_store.go + ydb_queries.go.
+"""
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.ydb_store import YdbStore
+
+from .miniydb import MiniYdb
+
+
+@pytest.fixture(scope="module")
+def ydb_server():
+    s = MiniYdb().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def store(ydb_server):
+    ydb_server.filemeta.clear()
+    ydb_server.kv.clear()
+    s = YdbStore(port=ydb_server.port)
+    yield s
+    s.close()
+
+
+def ent(path, size=0):
+    chunks = [FileChunk(fid="1,ab", offset=0, size=size,
+                        mtime_ns=time.time_ns())] if size else []
+    return Entry(full_path=path, chunks=chunks)
+
+
+def test_session_and_scheme(ydb_server, store):
+    assert ydb_server.sessions >= 1  # CreateSession happened
+
+
+def test_insert_find_update_delete(store):
+    store.insert_entry(ent("/a/b.txt", 10))
+    got = store.find_entry("/a/b.txt")
+    assert got is not None and got.file_size == 10
+    store.update_entry(ent("/a/b.txt", 20))
+    assert store.find_entry("/a/b.txt").file_size == 20
+    store.delete_entry("/a/b.txt")
+    assert store.find_entry("/a/b.txt") is None
+
+
+def test_listing_order_pagination_prefix(store):
+    for n in ("zeta", "alpha", "beta", "beta2", "gamma"):
+        store.insert_entry(ent(f"/dir/{n}"))
+    store.insert_entry(ent("/dir/beta/child"))  # other dirhash
+    names = [e.name for e in store.list_directory_entries("/dir")]
+    assert names == ["alpha", "beta", "beta2", "gamma", "zeta"]
+    page = store.list_directory_entries("/dir", limit=2)
+    assert [e.name for e in page] == ["alpha", "beta"]
+    page = store.list_directory_entries("/dir", start_from="beta",
+                                        inclusive=False, limit=2)
+    assert [e.name for e in page] == ["beta2", "gamma"]
+    page = store.list_directory_entries("/dir", start_from="beta",
+                                        inclusive=True, limit=2)
+    assert [e.name for e in page] == ["beta", "beta2"]
+    pref = store.list_directory_entries("/dir", prefix="beta")
+    assert [e.name for e in pref] == ["beta", "beta2"]
+
+
+def test_delete_folder_children_subtree(store):
+    for p in ("/t/a", "/t/sub/x", "/t/sub/deep/y", "/tother/z"):
+        store.insert_entry(ent(p))
+    store.insert_entry(Entry(full_path="/t/sub", mode=0o40755))
+    store.insert_entry(Entry(full_path="/t/sub/deep", mode=0o40755))
+    store.delete_folder_children("/t")
+    for p in ("/t/a", "/t/sub/x", "/t/sub/deep/y"):
+        assert store.find_entry(p) is None, p
+    assert store.find_entry("/tother/z") is not None
+
+
+def test_kv(store):
+    store.kv_put("conf", b"\x00\x01binary")
+    assert store.kv_get("conf") == b"\x00\x01binary"
+    store.kv_delete("conf")
+    assert store.kv_get("conf") is None
+    assert store.kv_get("never") is None
+
+
+def test_negative_dirhash_int64(store):
+    """dir_hash is a SIGNED int64 (util.HashStringToLong); directories
+    hashing negative must round-trip through the varint encoding."""
+    from seaweedfs_tpu.filer.abstract_sql import dir_hash
+
+    # find a directory whose hash is negative
+    d = next(f"/neg{i}" for i in range(100) if dir_hash(f"/neg{i}") < 0)
+    store.insert_entry(ent(f"{d}/file.bin", 7))
+    assert store.find_entry(f"{d}/file.bin").file_size == 7
+    assert [e.name for e in store.list_directory_entries(d)] \
+        == ["file.bin"]
+
+
+def test_truncated_result_sets_are_paged_through(ydb_server, store):
+    """Real YDB caps a result set at 1000 rows (truncated=true); the
+    store must LOOP from the last name, and the subtree delete must
+    see every subdirectory past the cap (the reference re-queries the
+    same way, ydb_store.go truncated loop)."""
+    ydb_server.result_cap = 10
+    try:
+        for i in range(35):
+            store.insert_entry(ent(f"/cap/f{i:03d}"))
+        names = [e.name for e in store.list_directory_entries("/cap")]
+        assert names == [f"f{i:03d}" for i in range(35)]
+        page = store.list_directory_entries("/cap", start_from="f005",
+                                            inclusive=True, limit=25)
+        assert len(page) == 25 and page[0].name == "f005"
+        # subtree delete with >cap children incl. nested dirs
+        store.insert_entry(Entry(full_path="/cap/zdir", mode=0o40755))
+        store.insert_entry(ent("/cap/zdir/inner"))
+        store.delete_folder_children("/cap")
+        assert store.find_entry("/cap/zdir/inner") is None
+        assert store.find_entry("/cap/f034") is None
+    finally:
+        ydb_server.result_cap = None
+
+
+def test_wildcard_names_list_literally(store):
+    """'%' and '_' in names/prefixes are literals, not LIKE wildcards
+    (_like_escape + ESCAPE, like every other store)."""
+    for n in ("my_file.txt", "myXfile.txt", "100%.done", "100x.done"):
+        store.insert_entry(ent(f"/wild/{n}"))
+    got = [e.name for e in
+           store.list_directory_entries("/wild", prefix="my_")]
+    assert got == ["my_file.txt"]
+    got = [e.name for e in
+           store.list_directory_entries("/wild", prefix="100%")]
+    assert got == ["100%.done"]
+
+
+def test_full_filer_stack(ydb_server):
+    ydb_server.filemeta.clear()
+    f = Filer("ydb", port=ydb_server.port)
+    try:
+        f.create_entry(ent("/docs/readme.md", 5))
+        assert f.find_entry("/docs/readme.md").file_size == 5
+        assert f.find_entry("/docs").is_directory
+        assert [e.name for e in f.list_entries("/docs")] == ["readme.md"]
+        f.delete_entry("/docs", recursive=True)
+        assert f.find_entry("/docs/readme.md") is None
+    finally:
+        f.close()
