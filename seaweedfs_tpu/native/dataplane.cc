@@ -783,6 +783,70 @@ uint64_t now_ns() {
   return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
 }
 
+// Flat {"Seaweed-K": "v", ...} JSON -> "Seaweed-K: v\r\n" header
+// lines, Seaweed-prefixed keys only (python _read_fid:445-451).
+// Returns false on anything beyond simple unescaped string:string
+// members (the caller relays those to python) or on control chars
+// (header-injection guard — python's header validation rejects them
+// there too).
+bool pairs_to_headers(const char* js, size_t n, std::string* out) {
+  size_t i = 0;
+  auto skip_ws = [&] {
+    while (i < n && (js[i] == ' ' || js[i] == '\t' || js[i] == '\n' ||
+                     js[i] == '\r'))
+      i++;
+  };
+  auto parse_str = [&](std::string* s) -> bool {
+    if (i >= n || js[i] != '"') return false;
+    i++;
+    s->clear();
+    while (i < n && js[i] != '"') {
+      unsigned char ch = js[i];
+      if (ch == '\\' || ch < 0x20) return false;  // escapes/control: python
+      s->push_back(js[i++]);
+    }
+    if (i >= n) return false;
+    i++;  // closing quote
+    return true;
+  };
+  skip_ws();
+  if (i >= n || js[i] != '{') return false;
+  i++;
+  skip_ws();
+  if (i < n && js[i] == '}') {  // empty object (+ nothing after)
+    i++;
+    skip_ws();
+    return i == n;
+  }
+  while (true) {
+    std::string k, v;
+    skip_ws();
+    if (!parse_str(&k)) return false;
+    skip_ws();
+    if (i >= n || js[i] != ':') return false;
+    i++;
+    skip_ws();
+    if (!parse_str(&v)) return false;  // non-string values: python
+    if (k.size() >= 8 && strncasecmp(k.c_str(), "seaweed-", 8) == 0) {
+      out->append(k);
+      out->append(": ");
+      out->append(v);
+      out->append("\r\n");
+    }
+    skip_ws();
+    if (i < n && js[i] == ',') {
+      i++;
+      continue;
+    }
+    if (i < n && js[i] == '}') {
+      i++;
+      skip_ws();
+      return i == n;  // trailing garbage = not valid JSON: python
+    }
+    return false;
+  }
+}
+
 // GET/HEAD fast path. Returns false when the request must be proxied.
 bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
                 uint32_t cookie, bool is_head) {
@@ -835,7 +899,6 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   const uint8_t* data = p + HEADER + 4;
   const uint8_t* cur = data + data_size;
   uint8_t flags = *cur++;
-  if (flags & FLAG_HAS_PAIRS) return false;  // python emits pair headers
   bool compressed = flags & FLAG_IS_COMPRESSED;
   // python inflates; ranges address ORIGINAL bytes, so a compressed
   // needle with a Range header must inflate there too
@@ -853,6 +916,22 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   if (flags & FLAG_HAS_LAST_MODIFIED && cur + 5 <= body_end) {
     for (int i = 0; i < 5; i++) last_modified = last_modified << 8 | cur[i];
     cur += 5;
+  }
+  if (flags & FLAG_HAS_TTL && cur + 2 <= body_end) cur += 2;
+  // Seaweed-* metadata pairs ride the needle as flat JSON
+  // (needle_parse_upload.go parsePairs); emit them as response
+  // headers like the python read path. Anything beyond simple
+  // string:string JSON (escapes, nesting, non-string values) relays
+  // to python, which renders it exactly.
+  std::string pair_headers;
+  if (flags & FLAG_HAS_PAIRS) {
+    if (cur + 2 > body_end) return false;
+    size_t plen = (size_t)cur[0] << 8 | cur[1];
+    cur += 2;
+    if (cur + plen > body_end) return false;
+    if (!pairs_to_headers((const char*)cur, plen, &pair_headers))
+      return false;
+    cur += plen;
   }
   if (cur > body_end) {
     n_errors++;
@@ -940,6 +1019,7 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
              "Last-Modified: %a, %d %b %Y %H:%M:%S GMT\r\n", &tmv);
     c->out.append(datebuf);
   }
+  c->out.append(pair_headers);
   if (!r.keep_alive) {
     c->out.append("Connection: close\r\n");
     c->want_close = true;

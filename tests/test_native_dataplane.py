@@ -559,6 +559,29 @@ def test_jwt_forwarded_on_fanout(tmp_path, dp):
         double.stop()
 
 
+def test_pairs_served_natively(tmp_path, dp):
+    """Seaweed-* metadata pairs ride needle JSON; the front emits them
+    as headers like the python read path (needle_parse_upload.go
+    parsePairs / _read_fid:445-451) instead of relaying."""
+    v = Volume(str(tmp_path), "", 17, create=True)
+    n = ndl.Needle(id=0x5, cookie=0xABCD0123, data=b"with-pairs")
+    n.pairs = json.dumps({"Seaweed-Owner": "alice",
+                          "Seaweed-Rev": "7",
+                          "X-Other": "dropped"}).encode()
+    n.flags |= ndl.FLAG_HAS_PAIRS
+    v.append_needle(n)
+    v.attach_native(dp)
+    proxied_before = dp.http_stats()["proxied"]
+    code, body, hdrs = _get(dp.port, "17,5abcd0123")
+    assert (code, body) == (200, b"with-pairs")
+    assert hdrs["Seaweed-Owner"] == "alice"
+    assert hdrs["Seaweed-Rev"] == "7"
+    assert "X-Other" not in hdrs  # non-seaweed keys never leak
+    assert dp.http_stats()["proxied"] == proxied_before  # served native
+    v.detach_native()
+    v.close()
+
+
 def test_export_matches_python_map(tmp_path, dp):
     v = Volume(str(tmp_path), "", 9, create=True)
     expected = {}
