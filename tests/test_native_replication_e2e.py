@@ -149,6 +149,8 @@ def test_jwt_replicated_write_on_native_path(cluster):
                  "Content-Type": "application/octet-stream"}, timeout=5)
     assert bad.status_code == 401
 
+def test_guarded_replicated_delete(cluster):
+    m = cluster["master"]
     # guarded replicated DELETE: tombstones everywhere
     a = requests.get(f"{m}/dir/assign",
                      params={"replication": "001"}).json()
@@ -167,3 +169,48 @@ def test_jwt_replicated_write_on_native_path(cluster):
     for l in locs["locations"]:
         assert requests.get(f"http://{l['url']}/{a['fid']}",
                             timeout=5).status_code == 404
+
+
+def test_z_dead_peer_fails_writes_loudly(cluster):
+    """SAFETY: with the replica peer DEAD, guarded writes must FAIL
+    (5xx) — a silent single-copy ack would be data loss in waiting
+    (store_replicate fails the write the same way). Named test_z_* to
+    run LAST: it kills a server the other tests need."""
+    import subprocess
+
+    m = cluster["master"]
+    a = requests.get(f"{m}/dir/assign",
+                     params={"replication": "001"}).json()
+    primary_port = int(a["url"].rsplit(":", 1)[1])
+    peer_port = next(p for p in cluster["vports"] if p != primary_port)
+    # find and kill the PEER volume server process by its exact port
+    out = subprocess.run(["pgrep", "-f",
+                          f"seaweedfs_tpu volume -port {peer_port}"],
+                         capture_output=True, text=True)
+    pids = [int(x) for x in out.stdout.split()]
+    assert pids, "peer process not found"
+    for pid in pids:
+        subprocess.run(["kill", "-9", str(pid)])
+    time.sleep(0.5)
+    codes = set()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        a2 = requests.get(f"{m}/dir/assign",
+                          params={"replication": "001"}).json()
+        if "fid" not in a2:
+            codes.add("assign-refused")  # master already dropped peer
+            break
+        if int(a2["url"].rsplit(":", 1)[1]) != primary_port:
+            time.sleep(0.3)
+            continue  # want a write through the SURVIVING server
+        r = requests.post(
+            f"http://{a2['url']}/{a2['fid']}", data=b"under-replicated?",
+            headers={"Authorization": f"Bearer {a2['auth']}",
+                     "Content-Type": "application/octet-stream"},
+            timeout=15)
+        codes.add(r.status_code)
+        if r.status_code >= 500:
+            break
+        time.sleep(0.3)
+    assert any(c == "assign-refused" or (isinstance(c, int) and c >= 500)
+               for c in codes), codes
