@@ -508,6 +508,11 @@ JwtRes jwt_check(const char* auth, size_t auth_len, const char* fid,
       return JwtRes::REJECT;
   } else if (malformed) {
     return JwtRes::UNSURE;
+  } else {
+    // missing/empty fid claim: the reference requires an exact claim match
+    // (volume_server_handlers.go:183) — a fid-less signed token is not a
+    // universal write token
+    return JwtRes::REJECT;
   }
   return JwtRes::OK;
 }
@@ -655,6 +660,8 @@ ssize_t parse_head(const char* buf, size_t len, Request* r) {
       } else if (ieq(p, klen, "range")) {
         r->range = v;
         r->range_len = vlen;
+      } else if (ieq(p, klen, "content-encoding")) {
+        r->proxy_only = true;  // pre-compressed body: python sets the needle flag
       } else if (klen >= 8 && ieq(p, 8, "seaweed-")) {
         r->proxy_only = true;  // metadata pairs: python builds the needle
       }

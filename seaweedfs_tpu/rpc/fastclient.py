@@ -92,9 +92,11 @@ class HttpPool:
                       headers: dict | None = None,
                       data: bytes | None = None,
                       json=None) -> Response:
-        """One round trip. Retries once on a dead keep-alive conn
-        (only before any response byte arrives — requests are assumed
-        idempotent-or-retriable the way the sync clients treated them)."""
+        """One round trip. Retries on a dead keep-alive conn only when
+        no response byte arrived AND the failure was connection-level —
+        once bytes show up (or on a timeout, where we can't prove they
+        didn't) the server may have executed the request, so retrying a
+        non-idempotent internal call could apply it twice."""
         parts = urllib.parse.urlsplit(url)
         host = parts.hostname or "127.0.0.1"
         port = parts.port or 80
@@ -125,25 +127,38 @@ class HttpPool:
             pool = self._idle.get(key)
             fresh = not pool
             conn = pool.pop() if pool else await self._connect(host, port)
+            progress = [False]  # set once any response byte is read
             try:
                 return await asyncio.wait_for(
-                    self._roundtrip(conn, key, blob, method), self.timeout)
+                    self._roundtrip(conn, key, blob, method, progress),
+                    self.timeout)
             except (OSError, asyncio.IncompleteReadError,
                     asyncio.LimitOverrunError, asyncio.TimeoutError,
                     ValueError) as e:
                 conn[1].close()
                 last = e
+                if progress[0] or isinstance(
+                        e, (asyncio.TimeoutError,
+                            # an oversized head means bytes DID arrive
+                            asyncio.LimitOverrunError)):
+                    break  # server may have executed it — never re-send
                 if fresh:
                     break  # a brand-new conn failing is a real error
         raise OSError(f"fastclient {method} {url}: {last}")
 
     async def _roundtrip(self, conn, key, blob: bytes,
-                         method: str) -> Response:
+                         method: str, progress: list) -> Response:
         reader, writer = conn
         writer.write(blob)
         await writer.drain()
         # response head
-        raw = await reader.readuntil(b"\r\n\r\n")
+        try:
+            raw = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as e:
+            if e.partial:
+                progress[0] = True
+            raise
+        progress[0] = True
         lines = raw.decode("latin-1").split("\r\n")
         status = int(lines[0].split(" ", 2)[1])
         headers: dict[str, str] = {}

@@ -30,7 +30,7 @@ from ..rpc import httpclient
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
-from ..utils import metrics
+from ..utils import extheaders, metrics
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, IdentityAccessManagement, S3AuthError)
 
@@ -926,7 +926,8 @@ class S3ApiServer:
         for k, v in req.headers.items():
             if k.lower().startswith("x-amz-meta-"):
                 name = k.lower()[len("x-amz-meta-"):]
-                headers[f"x-seaweed-ext-s3_meta_{name}"] = v
+                headers[f"x-seaweed-ext-s3_meta_{name}"] = \
+                    extheaders.armor(v)
         resp = await self._filer("POST", self._fpath(bucket, key),
                                  params=params, data=payload,
                                  headers=headers)
@@ -971,7 +972,8 @@ class S3ApiServer:
         pfx = "x-seaweed-ext-s3_meta_"
         for k, v in resp.headers.items():
             if k.lower().startswith(pfx):
-                out_headers[f"x-amz-meta-{k[len(pfx):]}"] = v
+                out_headers[f"x-amz-meta-{k[len(pfx):]}"] = \
+                    extheaders.unarmor(v)
         body = resp.content if req.method == "GET" else b""
         if req.method == "HEAD":
             return web.Response(
@@ -1027,11 +1029,12 @@ class S3ApiServer:
             for k, v in req.headers.items():
                 if k.lower().startswith("x-amz-meta-"):
                     name = k.lower()[len("x-amz-meta-"):]
-                    headers[f"x-seaweed-ext-s3_meta_{name}"] = v
+                    headers[f"x-seaweed-ext-s3_meta_{name}"] = \
+                        extheaders.armor(v)
         else:
             for k, v in (meta.get("extended") or {}).items():
                 if k.startswith("s3_meta_"):
-                    headers[f"x-seaweed-ext-{k}"] = str(v)
+                    headers[f"x-seaweed-ext-{k}"] = extheaders.armor(v)
         resp = await self._filer(
             "POST", self._fpath(bucket, key),
             params={"collection": bucket}, data=data.content,

@@ -23,7 +23,7 @@ import time
 import aiohttp
 from aiohttp import web
 
-from ..utils import compression
+from ..utils import compression, extheaders
 from ..filer import (Entry, FileChunk, Filer, etag_chunks,
                      maybe_manifestize, norm_path, read_fid,
                      resolve_chunk_manifest, stream_content)
@@ -511,8 +511,7 @@ class FilerServer:
                    "X-Seaweed-Entry": "file"}
         for k, v in entry.extended.items():
             if k.startswith("s3_"):
-                headers[f"x-seaweed-ext-{k}"] = \
-                    str(v).replace("\r", "").replace("\n", "")
+                headers[f"x-seaweed-ext-{k}"] = extheaders.armor(v)
         if req.headers.get("If-None-Match") == f'"{etag}"':
             return web.Response(status=304, headers=headers)
         offset, length, status = 0, size, 200
@@ -787,7 +786,7 @@ class FilerServer:
         # extended attributes carried on the upload itself (atomic
         # with the entry create — no read-modify-write race): the S3
         # gateway ships x-amz-meta-* through these
-        extended = {k.lower()[len("x-seaweed-ext-"):]: v
+        extended = {k.lower()[len("x-seaweed-ext-"):]: extheaders.unarmor(v)
                     for k, v in req.headers.items()
                     if k.lower().startswith("x-seaweed-ext-")}
         entry = Entry(full_path=path, mime=mime,

@@ -1,0 +1,27 @@
+"""ASCII armor for extended-attribute values riding HTTP headers.
+
+The filer and the S3 gateway exchange entry extended attributes (the
+x-amz-meta-* user metadata among them) as `x-seaweed-ext-*` headers so
+a GET costs one round trip. Header bytes cross aiohttp (which encodes
+str values as UTF-8) and fastclient (which decodes the head as
+latin-1), so a non-ASCII value would round-trip corrupted unless it is
+armored to pure ASCII on the wire. Percent-encoding keeps the stored
+value exact: armor on emit, unarmor on parse, store the true bytes.
+
+The reference carries the same metadata inside protobuf entries
+(filer_pb Entry.Extended, /root/reference/weed/filer/filer.go) so it
+never faces the issue; this is the header-wire equivalent.
+"""
+from __future__ import annotations
+
+import urllib.parse
+
+
+def armor(value: str) -> str:
+    """-> pure-ASCII form safe for an HTTP header value (no CR/LF/%,
+    no leading/trailing whitespace ambiguity, no non-ASCII)."""
+    return urllib.parse.quote(str(value), safe="/")
+
+
+def unarmor(value: str) -> str:
+    return urllib.parse.unquote(value)

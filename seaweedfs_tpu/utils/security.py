@@ -46,7 +46,9 @@ def verify_jwt(secret: str, token: str, fid: str | None = None) -> dict:
     payload = json.loads(_unb64(payload_b64))
     if payload.get("exp", 0) < time.time():
         raise PermissionError("jwt expired")
-    if fid is not None and payload.get("fid") not in (None, "", fid):
+    if fid is not None and payload.get("fid") != fid:
+        # exact claim match, like volume_server_handlers.go:183 — a signed
+        # token with a missing/empty fid must NOT authorize arbitrary fids
         raise PermissionError("jwt fid mismatch")
     return payload
 

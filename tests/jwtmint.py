@@ -1,0 +1,17 @@
+"""Raw HS256 compact-JWS minting for tests that need tokens
+sign_jwt refuses to produce (missing/empty fid claims, exotic
+payloads) — the negative fixtures for the exact-claim-match rule
+(volume_server_handlers.go:183)."""
+import base64
+import hashlib
+import hmac
+import json
+
+
+def mint_jwt(secret: str, payload: dict) -> str:
+    b64 = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+    h = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    p = b64(json.dumps(payload).encode())
+    sig = hmac.new(secret.encode(), f"{h}.{p}".encode(),
+                   hashlib.sha256).digest()
+    return f"{h}.{p}.{b64(sig)}"

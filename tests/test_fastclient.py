@@ -1,0 +1,121 @@
+"""fastclient retry safety: the internal keep-alive pool re-sends a
+request only when it can prove the server never started responding —
+once any response byte arrives (or on a timeout), a resend could apply
+a non-idempotent internal call (filer chunk POST, mkdir) twice.
+"""
+import asyncio
+
+import pytest
+
+from seaweedfs_tpu.rpc.fastclient import HttpPool
+
+
+class _Server:
+    """asyncio test double; each handler decides the connection's fate."""
+
+    def __init__(self):
+        self.hits = 0
+        self.mode = "ok"
+        self._srv = None
+        self.port = 0
+
+    async def start(self):
+        self._srv = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.port = self._srv.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self._srv.close()
+        await self._srv.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                cl = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        cl = int(line.split(b":")[1])
+                if cl:
+                    await reader.readexactly(cl)
+                self.hits += 1
+                if self.mode == "ok":
+                    writer.write(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 2\r\n\r\nok")
+                    await writer.drain()
+                elif self.mode == "partial_then_die":
+                    # the server HAS started executing: half a status
+                    # line, then the connection drops
+                    writer.write(b"HTTP/1.1 2")
+                    await writer.drain()
+                    writer.close()
+                    return
+                elif self.mode == "close_silently":
+                    self.mode = "ok"  # one silent close, then recover
+                    writer.close()
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+@pytest.fixture()
+def loop_run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.close()
+
+
+def test_roundtrip_and_keepalive(loop_run):
+    async def go():
+        srv = _Server()
+        await srv.start()
+        pool = HttpPool()
+        url = f"http://127.0.0.1:{srv.port}/x"
+        for _ in range(3):
+            r = await pool.request("GET", url)
+            assert (r.status_code, r.content) == (200, b"ok")
+        assert srv.hits == 3
+        assert len(pool._idle[("127.0.0.1", srv.port)]) == 1  # reused
+        await pool.close()
+        await srv.stop()
+    loop_run(go())
+
+
+def test_no_resend_after_response_bytes(loop_run):
+    """Half a status line arrived before the drop: the server may have
+    executed the POST — fastclient must raise, not silently re-send."""
+    async def go():
+        srv = _Server()
+        await srv.start()
+        srv.mode = "partial_then_die"
+        pool = HttpPool()
+        with pytest.raises(OSError):
+            await pool.request(
+                "POST", f"http://127.0.0.1:{srv.port}/create",
+                data=b"payload")
+        assert srv.hits == 1, "a partial response must never be retried"
+        await pool.close()
+        await srv.stop()
+    loop_run(go())
+
+
+def test_stale_pooled_conn_redials_once(loop_run):
+    """A pooled conn the server already closed fails with ZERO response
+    bytes — that IS safely retriable, on a fresh dial, exactly once."""
+    async def go():
+        srv = _Server()
+        await srv.start()
+        pool = HttpPool()
+        url = f"http://127.0.0.1:{srv.port}/x"
+        r = await pool.request("GET", url)
+        assert r.status_code == 200
+        # kill the pooled conn server-side: its next use sees a clean
+        # EOF (zero response bytes), and the redial finds mode=ok again
+        srv.mode = "close_silently"
+        r2 = await pool.request("GET", url)
+        assert (r2.status_code, r2.content) == (200, b"ok")
+        await pool.close()
+        await srv.stop()
+    loop_run(go())

@@ -34,6 +34,27 @@ class TestFilerReadWrite:
         assert got.headers["Content-Type"].startswith("text/plain")
         assert got.headers["ETag"]
 
+    def test_extended_attr_header_armor_roundtrip(self, cluster):
+        """Non-ASCII and %-containing extended values survive the
+        x-seaweed-ext-* header wire: the value is percent-armored to
+        pure ASCII on emit and unarmored on parse, so the stored value
+        is exact (the ?meta=1 JSON shows the truth) and the GET
+        response header carries the armored ASCII form."""
+        from seaweedfs_tpu.utils.extheaders import armor, unarmor
+
+        url = f"{cluster.filer_url}/docs/armored.txt"
+        val = "café ☕ 50% off"
+        r = requests.post(url, data=b"armored",
+                          headers={"x-seaweed-ext-s3_meta_note":
+                                   armor(val)})
+        assert r.status_code == 201, r.text
+        meta = requests.get(url, params={"meta": "1"}).json()
+        assert meta["extended"]["s3_meta_note"] == val
+        got = requests.get(url)
+        hdr = got.headers["x-seaweed-ext-s3_meta_note"]
+        assert hdr.isascii() and "\r" not in hdr and "\n" not in hdr
+        assert unarmor(hdr) == val
+
     def test_multipart_form_upload(self, cluster):
         url = f"{cluster.filer_url}/docs/form.bin"
         r = requests.post(url, files={"file": ("form.bin", b"\x00\x01ab")})

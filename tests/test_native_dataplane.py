@@ -200,6 +200,11 @@ def test_routing_to_proxy(tmp_path, dp):
         ("8,1deadbeef?width=10", {}, "GET"),
         ("8,1deadbeef?readDeleted=true", {}, "GET"),
         ("8,2deadbeef?name=a.txt", {}, "POST"),
+        # pre-compressed body: python must set FLAG_IS_COMPRESSED on the
+        # needle, so the fast path declines it (same shape as seaweed-*)
+        ("8,3deadbeef", {"Content-Encoding": "gzip",
+                         "Content-Type": "application/octet-stream"},
+         "POST"),
         ("status", {}, "GET"),
     ]:
         req = urllib.request.Request(
@@ -402,6 +407,19 @@ def test_jwt_guarded_native(tmp_path, dp):
         # wrong secret
         assert _post_auth(dp.port, "13,2deadbeef", b"x",
                           sign_jwt("other", "13,2deadbeef"))[0] == 401
+        # a signed token with a missing or empty fid claim is NOT a
+        # universal write token (volume_server_handlers.go:183 requires
+        # an exact claim match)
+        import time as _tm
+
+        from tests.jwtmint import mint_jwt
+
+        exp = int(_tm.time()) + 60
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          mint_jwt(secret, {"exp": exp}))[0] == 401
+        assert _post_auth(dp.port, "13,2deadbeef", b"x",
+                          mint_jwt(secret, {"exp": exp,
+                                            "fid": ""}))[0] == 401
         # batch slot _N authorized by the base fid's token
         # (volume_server_handlers.go:181 strips the suffix)
         assert _post_auth(dp.port, "13,1deadbeef_2", b"slot", tok)[0] == 201

@@ -69,13 +69,17 @@ def test_put_get_head_delete_roundtrip(s3):
     body = b"conformance payload \x00\x01\xff" * 100
     r = s3.put("/conf/obj1.bin", body,
                headers={"Content-Type": "application/x-conf",
-                        "x-amz-meta-color": "teal"})
+                        "x-amz-meta-color": "teal",
+                        # % and + stress the internal header armor —
+                        # a double-encode or missed decode corrupts them
+                        "x-amz-meta-promo": "50% off + tax"})
     assert r.status == 200
     assert r.header("etag")
     g = s3.get("/conf/obj1.bin")
     assert g.status == 200 and g.body == body
     assert g.header("content-type") == "application/x-conf"
     assert g.header("x-amz-meta-color") == "teal"
+    assert g.header("x-amz-meta-promo") == "50% off + tax"
     h = s3.head("/conf/obj1.bin")
     assert h.status == 200
     assert int(h.header("content-length")) == len(body)

@@ -39,6 +39,19 @@ class TestVerify:
         with pytest.raises(PermissionError, match="fid"):
             verify_jwt(SECRET, sign_jwt(SECRET, "3,01"), "3,02")
 
+    def test_fidless_token_is_not_universal(self):
+        """A correctly-signed token whose fid claim is missing or empty
+        must NOT authorize arbitrary fids — the reference compares the
+        claim exactly (volume_server_handlers.go:183)."""
+        from tests.jwtmint import mint_jwt
+
+        exp = int(time.time()) + 60
+        for payload in ({"exp": exp}, {"exp": exp, "fid": ""}):
+            with pytest.raises(PermissionError, match="fid"):
+                verify_jwt(SECRET, mint_jwt(SECRET, payload), "3,01abcd")
+        # without a fid to check (read-style verify) the token stands
+        verify_jwt(SECRET, mint_jwt(SECRET, {"exp": exp}))
+
     def test_guard_strips_batch_slot_suffix(self):
         """`fid_N` batch slots share the base fid's token — the
         reference strips the suffix before the claim comparison
