@@ -9,6 +9,7 @@ appended to the metadata event log (filer_notify.go).
 """
 from __future__ import annotations
 
+import fnmatch
 import json
 import threading
 import time
@@ -30,6 +31,15 @@ class DirectoryNotEmptyError(OSError):
 def norm_path(path: str) -> str:
     out = "/" + "/".join(p for p in path.split("/") if p and p != ".")
     return out
+
+
+def _split_pattern(pattern: str) -> tuple[str, str]:
+    """Literal head / glob tail of a name pattern (filer_search.go:11):
+    the head feeds the store's prefix index, the tail is fnmatch'd."""
+    for i, ch in enumerate(pattern):
+        if ch in "*?[":
+            return pattern[:i], pattern[i:]
+    return pattern, ""  # wildcard-less: pure literal, exact match
 
 
 class _TrackedRLock:
@@ -221,8 +231,20 @@ class Filer:
 
     def list_entries(self, dirpath: str, start_from: str = "",
                      inclusive: bool = False, limit: int = LIST_BATCH,
-                     prefix: str = "") -> list[Entry]:
+                     prefix: str = "", name_pattern: str = "",
+                     name_pattern_exclude: str = "") -> list[Entry]:
+        """`name_pattern`/`name_pattern_exclude` are shell globs applied
+        over the page stream (filer_search.go:24 ListDirectoryEntries):
+        the literal head of the pattern becomes the store prefix filter
+        (splitPattern, filer_search.go:11) and the wildcard tail is
+        glob-matched against the remainder, paging past misses so a
+        page of non-matches can't be misread as end-of-directory.
+        Divergence from the reference: a wildcard-less pattern is an
+        exact-name filter here (the reference silently ignores it)."""
         dirpath = norm_path(dirpath)
+        pat_prefix, rest = _split_pattern(name_pattern)
+        if pat_prefix:
+            prefix = pat_prefix
         out, now = [], time.time()
         # TTL-expired entries are filtered AFTER the raw page, so keep
         # paging until `limit` live entries are in hand or the raw
@@ -237,6 +259,13 @@ class Filer:
             for e in batch:
                 if e.is_expired(now):
                     self._expire(e)
+                    continue
+                name = e.name
+                if name_pattern_exclude and fnmatch.fnmatchcase(
+                        name, name_pattern_exclude):
+                    continue
+                if name_pattern and not fnmatch.fnmatchcase(
+                        name[len(pat_prefix):], rest):
                     continue
                 out.append(self._resolve_hardlink(e))
             if len(batch) < want:

@@ -596,16 +596,12 @@ class FilerServer:
         limit = int(req.query.get("limit", "1024"))
         last = req.query.get("lastFileName", "")
         prefix = req.query.get("prefix", "")
+        # shell-glob name filters (filer_server_handlers_read_dir.go:34)
+        pattern = req.query.get("namePattern", "")
+        pattern_exclude = req.query.get("namePatternExclude", "")
         entries = self.filer.list_entries(
-            path, start_from=last, limit=limit, prefix=prefix)
-        # a short page proves end-of-directory (list_entries pages
-        # past expired entries internally); only a FULL page needs the
-        # one-entry probe to drive the more-flag honestly
-        more = False
-        if entries and len(entries) == limit:
-            more = bool(self.filer.list_entries(
-                path, start_from=entries[-1].name, limit=1,
-                prefix=prefix))
+            path, start_from=last, limit=limit, prefix=prefix,
+            name_pattern=pattern, name_pattern_exclude=pattern_exclude)
         accept = req.headers.get("Accept", "")
         if "text/html" in accept and "application/json" not in accept:
             # browser view (server/filer_ui/ equivalent); API clients
@@ -629,9 +625,16 @@ class FilerServer:
                     f"<td>{size}</td><td>{mtime}</td></tr>")
             up = path.rstrip("/").rsplit("/", 1)[0] or "/"
             more = ""
-            if len(entries) == limit:  # browser pagination
-                nxt = _up.quote(entries[-1].name, safe="")
-                more = (f'<p><a href="?lastFileName={nxt}">'
+            if len(entries) == limit:  # browser pagination — keep the
+                # listing filters on the next-page link
+                qs = {"lastFileName": entries[-1].name,
+                      "limit": str(limit)}
+                for k, v in (("prefix", prefix),
+                             ("namePattern", pattern),
+                             ("namePatternExclude", pattern_exclude)):
+                    if v:
+                        qs[k] = v
+                more = (f'<p><a href="?{_up.urlencode(qs)}">'
                         f"next page &raquo;</a></p>")
             return web.Response(
                 text=f"<html><body><h1>seaweedfs-tpu filer</h1>"
@@ -643,6 +646,15 @@ class FilerServer:
                      f"{''.join(rows)}</table>{more}</body></html>",
                 content_type="text/html",
                 headers={"X-Seaweed-Entry": "dir"})
+        # a short page proves end-of-directory (list_entries pages
+        # past expired/filtered entries internally); only a FULL page
+        # needs the one-entry probe to drive the more-flag honestly
+        more = False
+        if entries and len(entries) == limit:
+            more = bool(self.filer.list_entries(
+                path, start_from=entries[-1].name, limit=1,
+                prefix=prefix, name_pattern=pattern,
+                name_pattern_exclude=pattern_exclude))
         return web.json_response({
             "path": path,
             "entries": [e.to_dict() for e in entries],

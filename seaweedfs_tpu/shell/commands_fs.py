@@ -11,6 +11,7 @@ mutations).
 from __future__ import annotations
 
 import json
+import urllib.parse
 from typing import Iterator
 
 import requests
@@ -41,12 +42,16 @@ def _size(e: dict) -> int:
     return entry_size(e)
 
 
-def _list(env: CommandEnv, path: str) -> list[dict]:
+def _list(env: CommandEnv, path: str,
+          name_pattern: str = "") -> list[dict]:
     out: list[dict] = []
     last = ""
     while True:
+        params = {"limit": "1024", "lastFileName": last}
+        if name_pattern:
+            params["namePattern"] = name_pattern
         resp = requests.get(f"{_filer(env)}{path}",
-                            params={"limit": "1024", "lastFileName": last},
+                            params=params,
                             headers={"Accept": "application/json"},
                             timeout=60)
         if resp.status_code == 404:
@@ -59,6 +64,15 @@ def _list(env: CommandEnv, path: str) -> list[dict]:
         last = body.get("lastFileName", "")
         if not last:
             return out
+
+
+def _exists(env: CommandEnv, path: str) -> bool:
+    # percent-encode: glob chars like ? must stay PATH bytes here, not
+    # start a query string
+    quoted = urllib.parse.quote(path, safe="/")
+    resp = requests.get(f"{_filer(env)}{quoted}", params={"meta": "1"},
+                        timeout=60)
+    return resp.status_code == 200
 
 
 def _stat(env: CommandEnv, path: str) -> dict:
@@ -79,8 +93,16 @@ def _walk(env: CommandEnv, path: str) -> Iterator[dict]:
 
 
 def fs_ls(env: CommandEnv, path: str = "/", long: bool = False) -> list:
-    """fs.ls [-l] <dir> (command_fs_ls.go)."""
-    entries = _list(env, path)
+    """fs.ls [-l] <dir>[/glob] (command_fs_ls.go) — a wildcard in the
+    LAST path segment becomes a server-side namePattern filter
+    (filer_search.go), so `fs.ls /logs/*.gz` pages only matches."""
+    pattern = ""
+    head, _, tail = path.rstrip("/").rpartition("/")
+    if any(ch in tail for ch in "*?[") and not _exists(env, path):
+        # glob chars in the tail — but a literal directory of that
+        # exact name (checked first) still wins over the glob reading
+        path, pattern = head or "/", tail
+    entries = _list(env, path, name_pattern=pattern)
     if not long:
         return [_name(e) + ("/" if _is_dir(e) else "") for e in entries]
     return [{"name": _name(e), "is_directory": _is_dir(e),

@@ -52,6 +52,42 @@ class TestCrud:
         pfx = filer.list_entries("/docs", prefix="b")
         assert [e.name for e in pfx] == ["b"]
 
+    def test_list_name_pattern(self, filer):
+        """Glob name filters (filer_search.go ListDirectoryEntries):
+        literal pattern head feeds the store prefix, wildcard tail is
+        matched per name, exclusion patterns drop matches — and the
+        filter keeps paging PAST a full page of non-matches."""
+        for i in range(10):
+            touch(filer, f"/pat/data-{i:02d}.bin")
+            touch(filer, f"/pat/log-{i:02d}.txt")
+        got = [e.name for e in filer.list_entries(
+            "/pat", name_pattern="log-*.txt")]
+        assert got == [f"log-{i:02d}.txt" for i in range(10)]
+        # wildcard tail with a char class
+        got = [e.name for e in filer.list_entries(
+            "/pat", name_pattern="data-0[0-2]*")]
+        assert got == ["data-00.bin", "data-01.bin", "data-02.bin"]
+        # exclusion
+        got = [e.name for e in filer.list_entries(
+            "/pat", name_pattern_exclude="*.txt")]
+        assert got == [f"data-{i:02d}.bin" for i in range(10)]
+        # wildcard-less pattern = exact name (divergence from the
+        # reference, which silently ignores it)
+        got = [e.name for e in filer.list_entries(
+            "/pat", name_pattern="log-03.txt")]
+        assert got == ["log-03.txt"]
+        # pattern match PAST the page boundary: 10 data-* names sort
+        # before the log-* block; a limit-2 listing must page through
+        # them rather than return empty
+        got = [e.name for e in filer.list_entries(
+            "/pat", name_pattern="*.txt", limit=2)]
+        assert got == ["log-00.txt", "log-01.txt"]
+        # resume from lastFileName preserves the filter
+        got = [e.name for e in filer.list_entries(
+            "/pat", start_from="log-01.txt", name_pattern="*.txt",
+            limit=2)]
+        assert got == ["log-02.txt", "log-03.txt"]
+
     def test_delete_file_reports_chunks(self, tmp_path):
         dead = []
         f = Filer("memory", on_delete_chunks=dead.extend)

@@ -130,6 +130,37 @@ class TestFilerNamespace:
         assert requests.get(
             f"{cluster.filer_url}/mv2/dst.txt").content == b"move me"
 
+    def test_list_name_pattern_params(self, cluster):
+        """namePattern / namePatternExclude listing filters
+        (filer_server_handlers_read_dir.go:34), incl. a more-flag that
+        honors the filter across page boundaries."""
+        for i in range(6):
+            requests.post(f"{cluster.filer_url}/patdir/img-{i}.png",
+                          data=b"p")
+            requests.post(f"{cluster.filer_url}/patdir/note-{i}.md",
+                          data=b"n")
+        j = requests.get(f"{cluster.filer_url}/patdir",
+                         params={"namePattern": "*.md"},
+                         headers={"Accept": "application/json"}).json()
+        assert [e["full_path"].rsplit("/", 1)[1]
+                for e in j["entries"]] == \
+            [f"note-{i}.md" for i in range(6)]
+        j = requests.get(f"{cluster.filer_url}/patdir",
+                         params={"namePatternExclude": "img-*",
+                                 "limit": "4"},
+                         headers={"Accept": "application/json"}).json()
+        names = [e["full_path"].rsplit("/", 1)[1] for e in j["entries"]]
+        assert names == [f"note-{i}.md" for i in range(4)]
+        assert j["shouldDisplayLoadMore"] is True
+        j2 = requests.get(f"{cluster.filer_url}/patdir",
+                          params={"namePatternExclude": "img-*",
+                                  "limit": "4",
+                                  "lastFileName": names[-1]},
+                          headers={"Accept": "application/json"}).json()
+        assert [e["full_path"].rsplit("/", 1)[1]
+                for e in j2["entries"]] == ["note-4.md", "note-5.md"]
+        assert j2["shouldDisplayLoadMore"] is False
+
     def test_delete_cleans_volume_data(self, cluster):
         url = f"{cluster.filer_url}/del/gone.bin"
         requests.post(url, data=b"bye" * 1000)

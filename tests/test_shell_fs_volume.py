@@ -53,6 +53,19 @@ class TestFsCommands:
         tree = commands_fs.fs_tree(env, "/shop")
         assert "sub/" in tree and "  c.txt" in tree
 
+    def test_ls_glob(self, cluster, env):
+        put(cluster, "/globz/a.txt", b"a")
+        put(cluster, "/globz/b.log", b"b")
+        put(cluster, "/globz/c.txt", b"c")
+        assert commands_fs.fs_ls(env, "/globz/*.txt") == \
+            ["a.txt", "c.txt"]
+        assert commands_fs.fs_ls(env, "/globz/?.log") == ["b.log"]
+        # a literal directory whose name contains glob chars wins over
+        # the glob reading of the same string
+        put(cluster, "/globz/tmp[old]/inner.txt", b"i")
+        assert commands_fs.fs_ls(env, "/globz/tmp[old]") == \
+            ["inner.txt"]
+
     def test_mkdir_mv_rm(self, cluster, env):
         commands_fs.fs_mkdir(env, "/mv_zone")
         put(cluster, "/mv_zone/orig.txt", b"move me")
