@@ -270,6 +270,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", dest="mount_options", default="",
                    help="extra comma-separated fuse options "
                         "(allow_other, ro, ...)")
+    p.add_argument("-disableXAttr", dest="disable_xattr",
+                   action="store_true",
+                   help="disable extended attribute support "
+                        "(get/set/list/remove return ENOTSUP)")
 
     p = sub.add_parser(
         "fuse",
@@ -719,13 +723,15 @@ def _dispatch(args) -> int:
               cache_dir=args.cache_dir or None,
               collection=args.collection, replication=args.replication,
               write_memory_limit=(args.write_memory_limit_mb
-                                  or 64) << 20)
+                                  or 64) << 20,
+              disable_xattr=args.disable_xattr)
         return 0
     if args.cmd == "fuse":
         from .mount.fuse_adapter import mount
 
         known = {"filer": "http://127.0.0.1:8888", "filer.path": "/",
-                 "collection": "", "replication": "", "cacheDir": ""}
+                 "collection": "", "replication": "", "cacheDir": "",
+                 "disableXAttr": ""}
         passthrough = []
         for opt in (args.fuse_options or "").split(","):
             if not opt:
@@ -739,7 +745,8 @@ def _dispatch(args) -> int:
               options=",".join(passthrough) or None,
               cache_dir=known["cacheDir"] or None,
               collection=known["collection"],
-              replication=known["replication"])
+              replication=known["replication"],
+              disable_xattr=known["disableXAttr"] == "true")
         return 0
     if args.cmd == "shell":
         from .shell.repl import run_shell

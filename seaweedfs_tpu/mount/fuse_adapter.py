@@ -100,6 +100,19 @@ class WeedFuseOps(Operations):  # pragma: no cover - needs kernel fuse
     def statfs(self, path):
         return self.fs.statfs()
 
+    # xattr (weedfs_xattr.go; fusepy handles the size/ERANGE protocol)
+    def getxattr(self, path, name, position=0):
+        return self._wrap(self.fs.getxattr, path, name)
+
+    def listxattr(self, path):
+        return self._wrap(self.fs.listxattr, path)
+
+    def setxattr(self, path, name, value, options, position=0):
+        self._wrap(self.fs.setxattr, path, name, value, options)
+
+    def removexattr(self, path, name):
+        self._wrap(self.fs.removexattr, path, name)
+
     def destroy(self, path):
         self.fs.destroy()
 

@@ -138,6 +138,10 @@ CREATE_T = CB(c_int, c_char_p, mode_t, FFIP)
 FTRUNCATE_T = CB(c_int, c_char_p, off_t, FFIP)
 FGETATTR_T = CB(c_int, c_char_p, StatP, FFIP)
 UTIMENS_T = CB(c_int, c_char_p, TimespecP)
+SETXATTR_T = CB(c_int, c_char_p, c_char_p, c_void_p, c_size_t, c_int)
+GETXATTR_T = CB(c_int, c_char_p, c_char_p, c_void_p, c_size_t)
+LISTXATTR_T = CB(c_int, c_char_p, c_void_p, c_size_t)
+REMOVEXATTR_T = CB(c_int, c_char_p, c_char_p)
 
 
 class FuseOperations(ctypes.Structure):
@@ -166,10 +170,10 @@ class FuseOperations(ctypes.Structure):
         ("flush", OPEN_T),
         ("release", OPEN_T),
         ("fsync", FSYNC_T),
-        ("setxattr", c_void_p),
-        ("getxattr", c_void_p),
-        ("listxattr", c_void_p),
-        ("removexattr", c_void_p),
+        ("setxattr", SETXATTR_T),
+        ("getxattr", GETXATTR_T),
+        ("listxattr", LISTXATTR_T),
+        ("removexattr", REMOVEXATTR_T),
         ("opendir", c_void_p),
         ("readdir", READDIR_T),
         ("releasedir", c_void_p),
@@ -272,6 +276,10 @@ class FuseSession:
         ops.readdir = READDIR_T(self._readdir)
         ops.destroy = DESTROY_T(self._destroy)
         ops.utimens = UTIMENS_T(self._utimens)
+        ops.setxattr = SETXATTR_T(self._setxattr)
+        ops.getxattr = GETXATTR_T(self._getxattr)
+        ops.listxattr = LISTXATTR_T(self._listxattr)
+        ops.removexattr = REMOVEXATTR_T(self._removexattr)
         self.ops = ops
 
     # every handler: exceptions become -errno, success >= 0
@@ -409,6 +417,45 @@ class FuseSession:
             self.fs.destroy()
         except Exception:
             pass
+
+    # xattr protocol (xattr(7)): a zero-size probe returns the needed
+    # byte count; a too-small buffer is -ERANGE with nothing written
+    def _setxattr(self, path, name, value, size, flags):
+        def go():
+            data = ctypes.string_at(value, size) if size else b""
+            self.fs.setxattr(self._path(path), self._path(name),
+                             data, flags)
+        return self._guard(go)
+
+    def _getxattr(self, path, name, buf, size):
+        def go():
+            data = self.fs.getxattr(self._path(path), self._path(name))
+            if size == 0:
+                return len(data)
+            if size < len(data):
+                raise FuseError(errno.ERANGE)
+            ctypes.memmove(buf, data, len(data))
+            return len(data)
+        return self._guard(go)
+
+    def _listxattr(self, path, buf, size):
+        def go():
+            names = self.fs.listxattr(self._path(path))
+            blob = b"".join(
+                n.encode("utf-8", "surrogateescape") + b"\0"
+                for n in names)
+            if size == 0:
+                return len(blob)
+            if size < len(blob):
+                raise FuseError(errno.ERANGE)
+            if blob:
+                ctypes.memmove(buf, blob, len(blob))
+            return len(blob)
+        return self._guard(go)
+
+    def _removexattr(self, path, name):
+        return self._guard(self.fs.removexattr, self._path(path),
+                           self._path(name))
 
     def _utimens(self, path, tvp):
         def go():

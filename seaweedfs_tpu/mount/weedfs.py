@@ -15,6 +15,7 @@ writer observes its own writes before flush.
 """
 from __future__ import annotations
 
+import base64
 import errno
 import os
 import stat
@@ -34,6 +35,16 @@ from .page_writer import DirtyPages
 class FuseError(OSError):
     def __init__(self, errno_: int, msg: str = ""):
         super().__init__(errno_, msg or os.strerror(errno_))
+
+
+# extended-attribute limits (weedfs_xattr.go:14-16; the VFS caps from
+# xattr(7)) and the filer storage prefix shared with the reference
+XATTR_PREFIX = "xattr-"
+MAX_XATTR_NAME_SIZE = 255
+MAX_XATTR_VALUE_SIZE = 65536
+# <sys/xattr.h> setxattr(2) flags
+XATTR_CREATE = 1
+XATTR_REPLACE = 2
 
 
 class FileHandle:
@@ -62,7 +73,8 @@ class WeedFS:
                  collection: str = "", replication: str = "",
                  subscribe: bool = True,
                  meta_ttl: float = 60.0,
-                 write_memory_limit: int = 64 << 20):
+                 write_memory_limit: int = 64 << 20,
+                 disable_xattr: bool = False):
         """root: the filer directory this mount exposes as '/'."""
         self.client = FilerClient(filer_url, master_url,
                                   collection=collection,
@@ -87,6 +99,7 @@ class WeedFS:
         # cache when one is configured (page_writer.go swap file)
         self.write_memory_limit = write_memory_limit
         self.swap_dir = cache_dir
+        self.disable_xattr = disable_xattr
         self.pipeline = ThreadPoolExecutor(max_workers=upload_workers)
         self._handles: dict[int, FileHandle] = {}
         self._next_fh = 1
@@ -298,6 +311,105 @@ class WeedFS:
         for k, v in fields.items():
             setattr(entry, k, v)
         entry.mode |= dir_bit
+        self.client.save_entry(entry)
+        self.meta.put(entry.full_path, entry)
+
+    # ------------------------------------------------------------------
+    # extended attributes (weedfs_xattr.go:22-181): stored as
+    # `xattr-`-prefixed entry extended attributes on the filer, values
+    # base64-armored so arbitrary xattr BYTES survive the JSON entry
+    # encoding every filer store shares (the reference's protobuf
+    # entries carry raw []byte and don't need the armor).
+    # ------------------------------------------------------------------
+    def _xattr_check(self, name: str | None) -> None:
+        """Pre-lookup validation, in the reference's order
+        (weedfs_xattr.go: DisableXAttr first, then the name cap)."""
+        if self.disable_xattr:
+            raise FuseError(errno.ENOTSUP)
+        if name is not None:
+            if not name:
+                raise FuseError(errno.EINVAL)
+            if len(name) > MAX_XATTR_NAME_SIZE:
+                raise FuseError(errno.ERANGE)
+
+    def _xattr_entry(self, path: str, name: str | None) -> Entry:
+        self._xattr_check(name)
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        return entry
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        entry = self._xattr_entry(path, name)
+        v = entry.extended.get(XATTR_PREFIX + name)
+        if v is None:
+            raise FuseError(errno.ENODATA)  # == ENOATTR on linux
+        return base64.b64decode(v)
+
+    def setxattr(self, path: str, name: str, value: bytes,
+                 flags: int = 0) -> None:
+        """Proper setxattr(2) flag semantics (XATTR_CREATE on an
+        existing name is EEXIST, XATTR_REPLACE on a missing one is
+        ENODATA) — the reference silently no-ops the first case
+        (weedfs_xattr.go:123-133). Too-large values are ERANGE, the
+        reference's linux arm (weedfs_xattr.go:99-104)."""
+        self._xattr_check(name)
+        if len(value) > MAX_XATTR_VALUE_SIZE:
+            raise FuseError(errno.ERANGE)
+        self._check_quota(len(value))
+        key = XATTR_PREFIX + name
+
+        def mutate(extended: dict) -> None:
+            exists = key in extended
+            if flags == XATTR_CREATE and exists:
+                raise FuseError(errno.EEXIST)
+            if flags == XATTR_REPLACE and not exists:
+                raise FuseError(errno.ENODATA)
+            extended[key] = base64.b64encode(value).decode()
+
+        self._mutate_xattrs(path, mutate)
+
+    def listxattr(self, path: str) -> list[str]:
+        entry = self._xattr_entry(path, None)
+        return [k[len(XATTR_PREFIX):] for k in entry.extended
+                if k.startswith(XATTR_PREFIX)]
+
+    def removexattr(self, path: str, name: str) -> None:
+        self._xattr_check(name)
+        key = XATTR_PREFIX + name
+
+        def mutate(extended: dict) -> None:
+            if key not in extended:
+                raise FuseError(errno.ENODATA)
+            del extended[key]
+
+        self._mutate_xattrs(path, mutate)
+
+    def _mutate_xattrs(self, path: str,
+                       mutate: "Callable[[dict], None]") -> None:
+        """Apply an extended-attributes mutation and persist it. When
+        the path has an open write handle, the mutation runs on the
+        HANDLE's entry under its lock — that object owns the freshest
+        chunk list, so saving it cannot revert a concurrent flush's
+        chunks (the reference reaches the same safety via
+        fh.dirtyMetadata deferral, weedfs_xattr.go:135-138)."""
+        with self._lock:
+            handles = [h for h in self._handles.values()
+                       if h.path == path]
+        if handles:
+            h = handles[0]
+            with h.lock:
+                mutate(h.entry.extended)
+                self.client.save_entry(h.entry)
+                self.meta.put(h.entry.full_path, h.entry)
+                for other in handles[1:]:
+                    if other.entry is not h.entry:
+                        other.entry.extended = dict(h.entry.extended)
+            return
+        entry = self._entry(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        mutate(entry.extended)
         self.client.save_entry(entry)
         self.meta.put(entry.full_path, entry)
 

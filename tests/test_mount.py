@@ -307,6 +307,97 @@ class TestWeedFS:
         fs.release(fh)
         assert fs.getattr("/trunc-dirty")["st_size"] == 10
 
+    def test_xattr_roundtrip_and_flags(self, mount_fs):
+        """get/set/list/remove xattr stored as xattr- entry extras
+        (weedfs_xattr.go:22-181), with proper setxattr(2) flag
+        semantics and the VFS size caps."""
+        import errno
+
+        from seaweedfs_tpu.mount.weedfs import (
+            MAX_XATTR_NAME_SIZE, MAX_XATTR_VALUE_SIZE, XATTR_CREATE,
+            XATTR_REPLACE, FuseError)
+
+        fs = mount_fs
+        fh = fs.create("/xa.txt")
+        fs.release(fh)
+        fs.setxattr("/xa.txt", "user.color", b"teal")
+        fs.setxattr("/xa.txt", "user.blob", bytes(range(256)))
+        assert fs.getxattr("/xa.txt", "user.color") == b"teal"
+        assert fs.getxattr("/xa.txt", "user.blob") == bytes(range(256))
+        assert sorted(fs.listxattr("/xa.txt")) == \
+            ["user.blob", "user.color"]
+        # flags: CREATE on existing = EEXIST, REPLACE on missing = ENODATA
+        with pytest.raises(FuseError) as ei:
+            fs.setxattr("/xa.txt", "user.color", b"x", XATTR_CREATE)
+        assert ei.value.errno == errno.EEXIST
+        with pytest.raises(FuseError) as ei:
+            fs.setxattr("/xa.txt", "user.nope", b"x", XATTR_REPLACE)
+        assert ei.value.errno == errno.ENODATA
+        fs.setxattr("/xa.txt", "user.color", b"red", XATTR_REPLACE)
+        assert fs.getxattr("/xa.txt", "user.color") == b"red"
+        # missing attr / removed attr = ENODATA
+        fs.removexattr("/xa.txt", "user.blob")
+        for op in (lambda: fs.getxattr("/xa.txt", "user.blob"),
+                   lambda: fs.removexattr("/xa.txt", "user.blob")):
+            with pytest.raises(FuseError) as ei:
+                op()
+            assert ei.value.errno == errno.ENODATA
+        # size caps -> ERANGE; empty name -> EINVAL
+        with pytest.raises(FuseError) as ei:
+            fs.setxattr("/xa.txt", "n" * (MAX_XATTR_NAME_SIZE + 1), b"v")
+        assert ei.value.errno == errno.ERANGE
+        with pytest.raises(FuseError) as ei:
+            fs.setxattr("/xa.txt", "user.big",
+                        b"v" * (MAX_XATTR_VALUE_SIZE + 1))
+        assert ei.value.errno == errno.ERANGE
+        with pytest.raises(FuseError) as ei:
+            fs.getxattr("/xa.txt", "")
+        assert ei.value.errno == errno.EINVAL
+        # persists through the filer (fresh core, no shared caches)
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+
+        fs2 = WeedFS(fs.client.filer_url, root="/mnt-root",
+                     subscribe=False)
+        try:
+            assert fs2.getxattr("/xa.txt", "user.color") == b"red"
+            assert fs2.listxattr("/xa.txt") == ["user.color"]
+        finally:
+            fs2.destroy()
+
+    def test_xattr_disabled(self, mount_fs):
+        import errno
+
+        from seaweedfs_tpu.mount.weedfs import FuseError, WeedFS
+        fs = WeedFS(mount_fs.client.filer_url, root="/mnt-root",
+                    subscribe=False, disable_xattr=True)
+        try:
+            with pytest.raises(FuseError) as ei:
+                fs.getxattr("/any", "user.x")
+            assert ei.value.errno == errno.ENOTSUP
+            with pytest.raises(FuseError):
+                fs.setxattr("/any", "user.x", b"v")
+            with pytest.raises(FuseError):
+                fs.listxattr("/any")
+            with pytest.raises(FuseError):
+                fs.removexattr("/any", "user.x")
+        finally:
+            fs.destroy()
+
+    def test_xattr_survives_open_handle_flush(self, mount_fs):
+        """A set on a path with an open write handle must not be
+        clobbered when that handle flushes its own entry object."""
+        fs = mount_fs
+        fh = fs.create("/xa-open.txt")
+        fs.write(fh, 0, b"before")
+        fs.flush(fh)
+        fs.setxattr("/xa-open.txt", "user.tag", b"keep")
+        fs.write(fh, 6, b" after")
+        fs.release(fh)  # flush saves the handle's entry
+        assert fs.getxattr("/xa-open.txt", "user.tag") == b"keep"
+        fh = fs.open("/xa-open.txt")
+        assert fs.read(fh, 0, 100) == b"before after"
+        fs.release(fh)
+
     def test_fio_style_verified_randwrite(self, mount_fs):
         """Random-offset writes then full verify — the library-level
         equivalent of the reference's fio randwrite + crc32c gate."""
