@@ -1,0 +1,298 @@
+"""Real-protobuf goldens for the gRPC-wire filer stores.
+
+The ydb/tikv stores hand-roll their protobuf bytes through grpc_lite;
+until now those bytes were validated only against the in-repo mini
+servers, written by the same hand from the same public protos — a
+misread encoding rule would pass both sides. Here the REAL protobuf
+runtime (via protoc-compiled mirrors of the public message subsets,
+tests/protos/*.proto) produces the goldens:
+
+- every request the stores emit must match the runtime's encoding
+  byte for byte, and
+- runtime-encoded responses must decode through the stores' own
+  parsing into the right Python values.
+
+This breaks the encoder/decoder circularity. The residual assumption
+is the transcription of FIELD NUMBERS from the public protos into the
+mirrors — reviewable by diffing tests/protos/ against ydb-api-protos
+and kvproto — recorded in PARITY.md alongside the live-server caveat.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+PROTOC = shutil.which("protoc")
+pytestmark = pytest.mark.skipif(PROTOC is None, reason="no protoc")
+pytest.importorskip("google.protobuf", minversion="4.21")
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROTO_DIR = os.path.join(HERE, "protos")
+
+
+@pytest.fixture(scope="module")
+def msgs(tmp_path_factory):
+    """protoc-compile the mirrors, load them into a fresh descriptor
+    pool, return a name -> message-class resolver."""
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    out = tmp_path_factory.mktemp("pb") / "mirror.desc"
+    proc = subprocess.run(
+        [PROTOC, f"-I{PROTO_DIR}", f"--descriptor_set_out={out}",
+         "ydb_value_mirror.proto", "ydb_table_mirror.proto",
+         "kvrpcpb_mirror.proto"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, f"protoc failed:\n{proc.stderr}"
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(out.read_bytes())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+
+    def resolve(name: str):
+        return message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(name))
+
+    return resolve
+
+
+class _CaptureChannel:
+    """GrpcChannel double: records each unary request's raw bytes and
+    replays runtime-encoded response bytes."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, bytes]] = []
+        self.responses: list[bytes] = []
+
+    def unary(self, method: str, req: bytes, metadata=None) -> bytes:
+        self.calls.append((method, req))
+        return self.responses.pop(0)
+
+    def close(self) -> None:
+        pass
+
+
+# -- ydb ----------------------------------------------------------------
+
+SUCCESS = 400000
+
+
+def _op_response(msgs, wrapper: str, result_msg=None) -> bytes:
+    Any = msgs("Ydb.Table.AnyMirror")
+    Op = msgs("Ydb.Table.Operation")
+    W = msgs(wrapper)
+    op = Op(ready=True, status=SUCCESS)
+    if result_msg is not None:
+        op.result.CopyFrom(Any(
+            type_url="type.googleapis.com/" + result_msg.DESCRIPTOR.full_name,
+            value=result_msg.SerializeToString()))
+    return W(operation=op).SerializeToString()
+
+
+class TestYdbGoldens:
+    def test_typed_value_params(self, msgs):
+        """p_int64/p_uint64/p_utf8/p_string vs the runtime's
+        TypedValue encoding — incl. the negative-int64 10-byte varint
+        (dir_hash IS frequently negative) and zero inside a oneof
+        (which proto3 still serializes)."""
+        from seaweedfs_tpu.filer.ydb_store import (T_INT64, T_STRING,
+                                                   T_UINT64, T_UTF8,
+                                                   p_int64, p_string,
+                                                   p_uint64, p_utf8)
+        TV = msgs("Ydb.TypedValue")
+
+        def golden(type_id, **value_fields):
+            tv = TV()
+            tv.type.type_id = type_id
+            for k, v in value_fields.items():
+                setattr(tv.value, k, v)
+            return tv.SerializeToString(deterministic=True)
+
+        for v in (0, 1, 127, 128, 2**31, 2**63 - 1, -1, -2**63,
+                  -123456789):
+            assert p_int64(v) == golden(T_INT64, int64_value=v), v
+        for v in (0, 1, 2**64 - 1, 2**63):
+            assert p_uint64(v) == golden(T_UINT64, uint64_value=v), v
+        for s in ("", "name.txt", "café ☕", "a" * 300):
+            assert p_utf8(s) == golden(T_UTF8, text_value=s), s
+        for b in (b"", b"\x00\xff" * 10, bytes(range(256))):
+            assert p_string(b) == golden(T_STRING, bytes_value=b)
+
+    def test_execute_request_bytes_and_response_decode(self, msgs):
+        """The full ExecuteDataQueryRequest a FIND emits matches the
+        runtime encoding; a runtime-encoded response decodes through
+        the store's generic parser into the right rows."""
+        from seaweedfs_tpu.filer.ydb_store import _Ydb, p_int64, p_utf8
+
+        Req = msgs("Ydb.Table.ExecuteDataQueryRequest")
+        TV = msgs("Ydb.TypedValue")
+        RS = msgs("Ydb.ResultSet")
+        Val = msgs("Ydb.Value")
+        ExecResult = msgs("Ydb.Table.ExecuteQueryResult")
+        SessResult = msgs("Ydb.Table.CreateSessionResult")
+
+        ch = _CaptureChannel()
+        db = _Ydb.__new__(_Ydb)
+        db.ch, db.meta, db.database, db.session = ch, [], "/local", ""
+
+        yql = "SELECT meta FROM filemeta WHERE dir_hash = $a;"
+        # session mint + data query (params in sorted order so the
+        # deterministic map serialization lines up)
+        ch.responses.append(_op_response(
+            msgs, "Ydb.Table.CreateSessionResponse",
+            SessResult(session_id="sess-7")))
+        rs = RS(truncated=True)
+        row = rs.rows.add()
+        row.items.add().text_value = "doc.txt"
+        row.items.add().bytes_value = b'{"full_path": "/d/doc.txt"}'
+        ch.responses.append(_op_response(
+            msgs, "Ydb.Table.ExecuteDataQueryResponse",
+            ExecResult(result_sets=[rs])))
+
+        rows, truncated = db.execute(yql, {
+            "$dir_hash": p_int64(-5187234712),
+            "$name": p_utf8("doc.txt"),
+        })
+
+        # request golden
+        golden = Req(session_id="sess-7")
+        golden.tx_control.begin_tx.serializable_read_write.SetInParent()
+        golden.tx_control.commit_tx = True
+        golden.query.yql_text = yql
+        golden.parameters["$dir_hash"].CopyFrom(
+            TV.FromString(p_int64(-5187234712)))
+        golden.parameters["$name"].CopyFrom(
+            TV.FromString(p_utf8("doc.txt")))
+        method, req = ch.calls[1]
+        assert method.endswith("/ExecuteDataQuery")
+        assert req == golden.SerializeToString(deterministic=True)
+        # response decoded through the store's own parser
+        assert truncated is True
+        assert len(rows) == 1 and len(rows[0]) == 2
+        from seaweedfs_tpu.filer.ydb_store import _cell_bytes
+        assert _cell_bytes(rows[0][0]) == b"doc.txt"
+        assert _cell_bytes(rows[0][1]) == b'{"full_path": "/d/doc.txt"}'
+
+    def test_scheme_request_bytes(self, msgs):
+        from seaweedfs_tpu.filer.ydb_store import SCHEME, _Ydb
+
+        Req = msgs("Ydb.Table.ExecuteSchemeQueryRequest")
+        SessResult = msgs("Ydb.Table.CreateSessionResult")
+        ch = _CaptureChannel()
+        db = _Ydb.__new__(_Ydb)
+        db.ch, db.meta, db.database, db.session = ch, [], "/local", ""
+        ch.responses.append(_op_response(
+            msgs, "Ydb.Table.CreateSessionResponse",
+            SessResult(session_id="s")))
+        # ExecuteSchemeQueryResponse has the same {operation=1} wire
+        # shape as every Ydb response wrapper
+        ch.responses.append(_op_response(
+            msgs, "Ydb.Table.CreateSessionResponse"))
+        db.scheme(SCHEME)
+        _, req = ch.calls[1]
+        assert req == Req(session_id="s", yql_text=SCHEME
+                          ).SerializeToString(deterministic=True)
+
+
+# -- tikv ---------------------------------------------------------------
+
+class TestTikvGoldens:
+    def _store(self, msgs):
+        from seaweedfs_tpu.filer.tikv_store import TikvStore
+
+        ch = _CaptureChannel()
+        store = TikvStore.__new__(TikvStore)
+        store.ch = ch
+        return store, ch
+
+    def test_raw_verbs_request_bytes(self, msgs):
+        store, ch = self._store(msgs)
+        GetReq = msgs("kvrpcpb.RawGetRequest")
+        GetResp = msgs("kvrpcpb.RawGetResponse")
+        PutReq = msgs("kvrpcpb.RawPutRequest")
+        DelReq = msgs("kvrpcpb.RawDeleteRequest")
+        DelRangeReq = msgs("kvrpcpb.RawDeleteRangeRequest")
+        ScanReq = msgs("kvrpcpb.RawScanRequest")
+        Empty = msgs("kvrpcpb.RawPutResponse")
+
+        key = b"m" + bytes(range(20)) + "naïve.txt".encode()
+        ch.responses = [GetResp(not_found=True).SerializeToString(),
+                        Empty().SerializeToString(),
+                        Empty().SerializeToString(),
+                        Empty().SerializeToString(),
+                        msgs("kvrpcpb.RawScanResponse")()
+                        .SerializeToString()]
+        assert store._raw_get(key) is None
+        store._raw_put(key, b"\x00\xffvalue")
+        store._raw_delete(key)
+        store._raw_delete_range(b"m\x01", b"m\x02")
+        assert store._raw_scan(b"maa", b"mzz", 7) == []
+
+        goldens = [
+            GetReq(key=key),
+            PutReq(key=key, value=b"\x00\xffvalue"),
+            DelReq(key=key),
+            DelRangeReq(start_key=b"m\x01", end_key=b"m\x02"),
+            ScanReq(start_key=b"maa", limit=7, end_key=b"mzz"),
+        ]
+        for (method, req), g in zip(ch.calls, goldens, strict=True):
+            assert req == g.SerializeToString(deterministic=True), method
+
+    def test_response_decoding_and_errors(self, msgs):
+        store, ch = self._store(msgs)
+        GetResp = msgs("kvrpcpb.RawGetResponse")
+        ScanResp = msgs("kvrpcpb.RawScanResponse")
+
+        # value present / empty-but-existing / region error / error
+        ch.responses = [GetResp(value=b"data").SerializeToString()]
+        assert store._raw_get(b"k1") == b"data"
+        # proto3 omits empty bytes: existing key with b"" value is
+        # signalled only by not_found staying false
+        ch.responses = [GetResp().SerializeToString()]
+        assert store._raw_get(b"k2") == b""
+        region = GetResp()
+        region.region_error.message = "epoch_not_match"
+        ch.responses = [region.SerializeToString()]
+        with pytest.raises(IOError, match="region error"):
+            store._raw_get(b"k3")
+        ch.responses = [GetResp(error="key error").SerializeToString()]
+        with pytest.raises(IOError, match="key error"):
+            store._raw_get(b"k4")
+
+        scan = ScanResp()
+        for i in range(3):
+            kv = scan.kvs.add()
+            kv.key = b"mkey%d" % i
+            kv.value = b"val%d" % i
+        ch.responses = [scan.SerializeToString()]
+        assert store._raw_scan(b"m", b"", 10) == [
+            (b"mkey0", b"val0"), (b"mkey1", b"val1"),
+            (b"mkey2", b"val2")]
+
+    def test_entry_roundtrip_through_runtime_wire(self, msgs):
+        """insert_entry/find_entry end to end over runtime-encoded
+        responses: the key layout and the JSON meta both survive."""
+        from seaweedfs_tpu.filer.entry import Entry
+        from seaweedfs_tpu.filer.tikv_store import _entry_key
+
+        store, ch = self._store(msgs)
+        GetResp = msgs("kvrpcpb.RawGetResponse")
+        PutReq = msgs("kvrpcpb.RawPutRequest")
+        Empty = msgs("kvrpcpb.RawPutResponse")
+
+        e = Entry(full_path="/photos/cat.jpg", mode=0o644)
+        ch.responses = [Empty().SerializeToString()]
+        store.insert_entry(e)
+        _, raw_req = ch.calls[0]
+        put = PutReq.FromString(raw_req)
+        assert put.key == _entry_key("/photos", "cat.jpg")
+        ch.responses = [GetResp(value=put.value).SerializeToString()]
+        got = store.find_entry("/photos/cat.jpg")
+        assert got is not None and got.full_path == "/photos/cat.jpg"
+        assert got.mode == 0o644
