@@ -52,6 +52,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #if defined(__SSE4_2__)
@@ -276,6 +277,32 @@ bool const_time_eq(const uint8_t* a, const uint8_t* b, size_t n) {
   uint8_t d = 0;
   for (size_t i = 0; i < n; i++) d |= a[i] ^ b[i];
   return d == 0;
+}
+
+// base64url encode, unpadded (the JWT segment alphabet).
+void b64url_encode(const uint8_t* d, size_t n, std::string* out) {
+  static const char T[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+  out->clear();
+  out->reserve((n + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= n; i += 3) {
+    uint32_t v = (uint32_t)d[i] << 16 | (uint32_t)d[i + 1] << 8 | d[i + 2];
+    out->push_back(T[v >> 18]);
+    out->push_back(T[v >> 12 & 63]);
+    out->push_back(T[v >> 6 & 63]);
+    out->push_back(T[v & 63]);
+  }
+  if (n - i == 1) {
+    uint32_t v = (uint32_t)d[i] << 16;
+    out->push_back(T[v >> 18]);
+    out->push_back(T[v >> 12 & 63]);
+  } else if (n - i == 2) {
+    uint32_t v = (uint32_t)d[i] << 16 | (uint32_t)d[i + 1] << 8;
+    out->push_back(T[v >> 18]);
+    out->push_back(T[v >> 12 & 63]);
+    out->push_back(T[v >> 6 & 63]);
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -517,6 +544,31 @@ JwtRes jwt_check(const char* auth, size_t auth_len, const char* fid,
   return JwtRes::OK;
 }
 
+// Mint the replication-channel handshake token: HS256 over the shared
+// cluster secret with the reserved claim fid ".swrp" (a name no data
+// fid can take — parse_fid_path rejects it). Only secret holders can
+// mint it, and it is NOT a data-write token: jwt_check never matches
+// ".swrp" against a real fid. Channel auth replaces the reference's
+// per-replicate JWT forwarding (security/guard.go:41) — same trust
+// root, one verification per connection instead of per write.
+std::string mint_swrp_token() {
+  std::shared_lock<std::shared_mutex> lk(jwt_mu);
+  if (jwt_secret.empty()) return "";
+  // {"alg":"HS256","typ":"JWT"} pre-encoded
+  std::string signing = "eyJhbGciOiJIUzI1NiIsInR5cCI6IkpXVCJ9.";
+  char pl[96];
+  int n = snprintf(pl, sizeof pl, "{\"exp\": %lld, \"fid\": \".swrp\"}",
+                   (long long)time(nullptr) + 300);
+  std::string seg;
+  b64url_encode((const uint8_t*)pl, (size_t)n, &seg);
+  signing += seg;
+  uint8_t mac[32];
+  hmac_sha256((const uint8_t*)jwt_secret.data(), jwt_secret.size(),
+              (const uint8_t*)signing.data(), signing.size(), mac);
+  b64url_encode(mac, 32, &seg);
+  return signing + "." + seg;
+}
+
 // ---------------------------------------------------------------------------
 // HTTP front
 // ---------------------------------------------------------------------------
@@ -544,7 +596,14 @@ struct Request {
   size_t range_len = 0;
 };
 
+// epoll data.ptr discrimination: Conn and PeerConn both lead with an
+// int kind so the IO loop can tell them apart (both standard-layout,
+// first-member address == struct address)
+constexpr int KIND_CLIENT = 1;
+constexpr int KIND_PEER = 2;
+
 struct Conn {
+  int kind = KIND_CLIENT;
   int fd = -1;
   std::string in;        // buffered request bytes
   size_t in_off = 0;     // consumed prefix
@@ -553,9 +612,19 @@ struct Conn {
   bool want_close = false;
   bool in_epoll = false;
   bool sent_100 = false;  // 100-continue sent for the current request
+  // async replica fan-out state: while an op is in flight the conn's
+  // pump is gated (response ordering) and a client disconnect turns
+  // the conn into a zombie freed when the op concludes
+  bool repl_pending = false;
+  bool zombie = false;
+  // conn upgraded to the binary replication protocol (SWRP): the
+  // buffer carries frames, not HTTP, from the upgrade on
+  bool swrp = false;
   time_t last_active = 0;
   int backend_fd = -1;  // persistent backend conn for this client conn
 };
+
+struct PeerConn;
 
 struct Server {
   uint16_t backend_port = 0;
@@ -572,6 +641,16 @@ struct Server {
   std::mutex ret_mu;
   std::deque<Conn*> returned;
   std::unordered_map<int, Conn*> conns;
+  // replica-peer keep-alive conns, IO-thread-only (async fan-out)
+  std::unordered_map<std::string, PeerConn*> peer_conns;
+  // peers with freshly queued wires: flushed once per epoll batch so a
+  // burst of client writes rides ONE writev per peer (syscall collapse
+  // on this side; one recv + one coalesced ack burst on the replica)
+  std::vector<PeerConn*> dirty_peers;
+  time_t last_peer_sweep = 0;
+  // conn currently inside pump(): a synchronous fan-out failure must
+  // not re-enter that conn's pump from finalize_repl
+  Conn* pumping = nullptr;
 };
 
 Server* g_srv = nullptr;
@@ -1104,7 +1183,7 @@ int delete_tomb(const std::shared_ptr<Vol>& v, uint64_t key,
   return 202;
 }
 
-void respond_post_ok(Conn* c, const Request& r, int64_t body_len,
+void respond_post_ok(Conn* c, bool keep_alive, int64_t body_len,
                      uint32_t crc) {
   char resp[256];
   char jbody[128];
@@ -1114,13 +1193,13 @@ void respond_post_ok(Conn* c, const Request& r, int64_t body_len,
   int n = snprintf(resp, sizeof resp,
                    "HTTP/1.1 201 Created\r\nContent-Length: %d\r\n"
                    "Content-Type: application/json\r\n%s\r\n",
-                   bl, r.keep_alive ? "" : "Connection: close\r\n");
+                   bl, keep_alive ? "" : "Connection: close\r\n");
   c->out.append(resp, n);
   c->out.append(jbody, bl);
-  if (!r.keep_alive) c->want_close = true;
+  if (!keep_alive) c->want_close = true;
 }
 
-void respond_delete_ok(Conn* c, const Request& r, int64_t reclaimed) {
+void respond_delete_ok(Conn* c, bool keep_alive, int64_t reclaimed) {
   char resp[256];
   char jbody[64];
   int bl = snprintf(jbody, sizeof jbody, "{\"size\": %lld}",
@@ -1128,10 +1207,10 @@ void respond_delete_ok(Conn* c, const Request& r, int64_t reclaimed) {
   int n = snprintf(resp, sizeof resp,
                    "HTTP/1.1 202 Accepted\r\nContent-Length: %d\r\n"
                    "Content-Type: application/json\r\n%s\r\n",
-                   bl, r.keep_alive ? "" : "Connection: close\r\n");
+                   bl, keep_alive ? "" : "Connection: close\r\n");
   c->out.append(resp, n);
   c->out.append(jbody, bl);
-  if (!r.keep_alive) c->want_close = true;
+  if (!keep_alive) c->want_close = true;
 }
 
 // POST fast path: plain body, no metadata, writable local volume.
@@ -1146,7 +1225,7 @@ bool handle_post(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   if (body_len <= 0 || body_len > (8 << 20)) return false;
   std::shared_ptr<Vol> v = find_vol(vid);
   if (!v) return false;
-  if (v->has_replicas && !r.is_replicate) return false;  // worker fans out
+  if (v->has_replicas && !r.is_replicate) return false;  // async fan-out
   JwtRes jr = jwt_check(r.auth, r.auth_len, fid, fid_len);
   if (jr == JwtRes::UNSURE) return false;  // python gives the verdict
   if (jr == JwtRes::REJECT) {
@@ -1166,7 +1245,7 @@ bool handle_post(Conn* c, const Request& r, uint32_t vid, uint64_t key,
     simple_response(c, 500, "write failed", r.keep_alive);
     return true;
   }
-  respond_post_ok(c, r, body_len, crc);
+  respond_post_ok(c, r.keep_alive, body_len, crc);
   n_fast_post++;
   return true;
 }
@@ -1180,7 +1259,7 @@ bool handle_delete(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   if (r.proxy_only || r.chunked || r.content_len != 0) return false;
   std::shared_ptr<Vol> v = find_vol(vid);
   if (!v) return false;
-  if (v->has_replicas && !r.is_replicate) return false;  // worker fans out
+  if (v->has_replicas && !r.is_replicate) return false;  // async fan-out
   JwtRes jr = jwt_check(r.auth, r.auth_len, fid, fid_len);
   if (jr == JwtRes::UNSURE) return false;
   if (jr == JwtRes::REJECT) {
@@ -1200,7 +1279,7 @@ bool handle_delete(Conn* c, const Request& r, uint32_t vid, uint64_t key,
     simple_response(c, 500, "delete failed", r.keep_alive);
     return true;
   }
-  respond_delete_ok(c, r, reclaimed);
+  respond_delete_ok(c, r.keep_alive, reclaimed);
   n_fast_delete++;
   return true;
 }
@@ -1237,13 +1316,9 @@ bool send_all(int fd, const char* p, size_t n) {
 }
 
 // ---------------------------------------------------------------------------
-// Replica fan-out (store_replicate.go:24 ReplicatedWrite redesigned for
-// the native front): each worker thread keeps its own keep-alive
-// connection per peer; the primary appends locally, then ships the body
-// to every peer as POST/DELETE /<fid>?type=replicate with the client's
-// JWT forwarded. Any peer failure fails the write (500) and marks the
-// volume's peer list stale so writes relay to Python (which re-resolves
-// placement) until the control plane pushes a fresh list.
+// Replica fan-out plumbing shared with the benchmark clients. The
+// fan-out itself is the ASYNC state machine further down (submit_repl
+// and friends, on the IO thread).
 // ---------------------------------------------------------------------------
 int connect_hostport(const std::string& hostport) {
   size_t colon = hostport.rfind(':');
@@ -1270,26 +1345,45 @@ int connect_hostport(const std::string& hostport) {
   return fd;
 }
 
-struct PeerPool {
-  std::unordered_map<std::string, int> fds;
-  ~PeerPool() {
-    for (auto& [hp, fd] : fds) close(fd);
+// Non-blocking variant for the IO thread: a SYN that goes unanswered
+// (peer power loss / partition) must never stall epoll_wait — the
+// connect completes (or fails) as an EPOLLOUT/ERR event instead.
+// *in_progress reports EINPROGRESS. Numeric peer addresses resolve
+// without blocking (AI_NUMERICHOST); hostname peers fall back to a
+// regular lookup — same trade the reference's dialer makes.
+int connect_hostport_nb(const std::string& hostport, bool* in_progress) {
+  *in_progress = false;
+  size_t colon = hostport.rfind(':');
+  if (colon == std::string::npos) return -1;
+  std::string host = hostport.substr(0, colon);
+  std::string port = hostport.substr(colon + 1);
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_NUMERICHOST;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) {
+    hints.ai_flags = 0;  // hostname peer: blocking DNS, rare
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0)
+      return -1;
   }
-  int get(const std::string& hp) {
-    auto it = fds.find(hp);
-    if (it != fds.end()) return it->second;
-    int fd = connect_hostport(hp);
-    if (fd >= 0) fds[hp] = fd;
-    return fd;
-  }
-  void drop(const std::string& hp) {
-    auto it = fds.find(hp);
-    if (it != fds.end()) {
-      close(it->second);
-      fds.erase(it);
+  if (!res) return -1;
+  int fd = socket(res->ai_family,
+                  res->ai_socktype | SOCK_NONBLOCK, res->ai_protocol);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      if (errno == EINPROGRESS) {
+        *in_progress = true;
+      } else {
+        close(fd);
+        fd = -1;
+      }
     }
   }
-};
+  freeaddrinfo(res);
+  return fd;
+}
 
 // Read one HTTP response off `fd` (head + Content-Length body, or —
 // when allow_chunked — a chunked body to its terminator). Returns the
@@ -1336,57 +1430,6 @@ int read_framed_response(int fd, std::string* resp, size_t limit,
   }
   if (resp->size() < 12) return -1;
   return atoi(resp->c_str() + 9);
-}
-
-// One replicate round-trip on a pooled conn. 404 is success for
-// deletes (the peer never had the copy — python _replicate:698 accepts
-// it the same way).
-bool peer_replicate_once(int fd, const std::string& peer, bool is_delete,
-                         const char* fid, size_t fid_len, const char* auth,
-                         size_t auth_len, const uint8_t* body,
-                         int64_t body_len) {
-  std::string head;
-  head.reserve(256 + auth_len);
-  head.append(is_delete ? "DELETE /" : "POST /");
-  head.append(fid, fid_len);
-  head.append("?type=replicate HTTP/1.1\r\nHost: ");
-  head.append(peer);
-  head.append("\r\nContent-Type: application/octet-stream\r\n"
-              "Content-Length: ");
-  head.append(std::to_string(is_delete ? 0ll : (long long)body_len));
-  head.append("\r\n");
-  if (auth && auth_len) {
-    // forward the client's token: same fid claim, still inside its
-    // validity window (the reference forwards the jwt the same way)
-    head.append("Authorization: ");
-    head.append(auth, auth_len);
-    head.append("\r\n");
-  }
-  head.append("\r\n");
-  if (!send_all(fd, head.data(), head.size())) return false;
-  if (!is_delete && body_len > 0 &&
-      !send_all(fd, (const char*)body, body_len))
-    return false;
-  std::string resp;
-  int code = read_framed_response(fd, &resp, 1 << 20, false);
-  if (code < 0) return false;
-  return code < 300 || (is_delete && code == 404);
-}
-
-bool peer_replicate(PeerPool* pool, const std::string& peer, bool is_delete,
-                    const char* fid, size_t fid_len, const char* auth,
-                    size_t auth_len, const uint8_t* body, int64_t body_len) {
-  for (int attempt = 0; attempt < 2; attempt++) {
-    int fd = pool->get(peer);
-    if (fd < 0) return false;
-    if (peer_replicate_once(fd, peer, is_delete, fid, fid_len, auth,
-                            auth_len, body, body_len))
-      return true;
-    // a dead keep-alive conn looks identical to a peer error: retry
-    // exactly once on a fresh connection
-    pool->drop(peer);
-  }
-  return false;
 }
 
 // Incremental chunked-transfer scanner: feed() consumes any byte
@@ -1600,6 +1643,15 @@ void close_conn(Server* s, Conn* c) {
   s->conns.erase(c->fd);
   if (c->backend_fd >= 0) close(c->backend_fd);
   close(c->fd);
+  if (c->repl_pending) {
+    // a replica fan-out still references this conn: defer the free
+    // until the op concludes (finalize_repl deletes zombies)
+    c->fd = -1;
+    c->backend_fd = -1;
+    c->in_epoll = false;
+    c->zombie = true;
+    return;
+  }
   delete c;
 }
 
@@ -1615,9 +1667,87 @@ void arm(Server* s, Conn* c, uint32_t events) {
   }
 }
 
+// Async replica fan-out entry (defined after flush_out): primary
+// append + pipelined peer ship on the IO thread. Returns true when the
+// request was taken (response arrives when every peer acks).
+bool submit_repl(Server* s, Conn* c, const Request& r, uint32_t vid,
+                 uint64_t key, uint32_t cookie, const uint8_t* body,
+                 int64_t body_len, const char* fid, size_t fid_len,
+                 bool is_delete);
+
+// ---------------------------------------------------------------------------
+// SWRP — the binary replication wire (native peer -> native peer).
+// The reference replicates via full HTTP POSTs with a per-write JWT
+// re-verified by the peer (topology/store_replicate.go:24 + guard).
+// Between two native fronts that costs an HTTP parse + HMAC per write
+// on the replica; SWRP replaces it with a one-time authenticated
+// upgrade (POST /.swrp carrying a ".swrp"-claim token minted from the
+// same shared secret) followed by fixed 21-byte frames:
+//   u8 op (1=append, 2=delete) | u32 vid | u64 key | u32 cookie |
+//   u32 body_len | body          (little-endian, x86 fleet)
+// each answered in order by a fixed 14-byte ack:
+//   u16 http-ish code | u32 crc | u64 size
+// Primaries fall back to HTTP replicate when the peer answers the
+// upgrade with anything but 101 (python-only peer, old build, or a
+// jwt verdict the native side can't give).
+// ---------------------------------------------------------------------------
+constexpr size_t SWRP_HDR = 21;
+constexpr size_t SWRP_ACK = 14;
+
+int swrp_pump(Conn* c) {
+  while (true) {
+    size_t avail = c->in.size() - c->in_off;
+    if (avail < SWRP_HDR) break;
+    const uint8_t* p = (const uint8_t*)c->in.data() + c->in_off;
+    uint8_t op = p[0];
+    uint32_t vid, cookie, blen;
+    uint64_t key;
+    memcpy(&vid, p + 1, 4);
+    memcpy(&key, p + 5, 8);
+    memcpy(&cookie, p + 13, 4);
+    memcpy(&blen, p + 17, 4);
+    if ((op != 1 && op != 2) || blen > (8u << 20) || (op == 2 && blen != 0))
+      return -1;  // poisoned channel: close, primary retries over HTTP
+    if (avail < SWRP_HDR + blen) break;
+    uint16_t code;
+    uint32_t crc = 0;
+    int64_t size = 0;
+    std::shared_ptr<Vol> v = find_vol(vid);
+    if (!v) {
+      code = 404;
+    } else if (op == 1) {
+      int st = append_plain(v, key, cookie, p + SWRP_HDR, blen, &crc);
+      code = st == 0 ? 503 : (uint16_t)st;  // 0 = python-only volume
+      size = blen;
+      if (st == 201) n_fast_post++;
+    } else {
+      int64_t reclaimed = 0;
+      int st = delete_tomb(v, key, &reclaimed);
+      code = st == 0 ? 503 : (uint16_t)st;
+      size = reclaimed;
+      if (st == 202) n_fast_delete++;
+    }
+    uint8_t ack[SWRP_ACK];
+    memcpy(ack, &code, 2);
+    memcpy(ack + 2, &crc, 4);
+    memcpy(ack + 6, &size, 8);
+    c->out.append((const char*)ack, SWRP_ACK);
+    c->in_off += SWRP_HDR + blen;
+  }
+  if (c->in_off == c->in.size()) {
+    c->in.clear();
+    c->in_off = 0;
+  }
+  return 0;
+}
+
 // Try to serve buffered requests. Returns: 0 keep reading, 1 handed to
 // proxy workers, -1 close.
-int pump(Server* s, Conn* c) {
+int pump_inner(Server* s, Conn* c) {
+  // a replicated op is in flight: hold further pipelined requests
+  // until its response is written (HTTP responses must stay ordered)
+  if (c->repl_pending) return 0;
+  if (c->swrp) return swrp_pump(c);
   while (true) {
     if (c->in_off > 0 && c->in_off == c->in.size()) {
       c->in.clear();
@@ -1634,6 +1764,27 @@ int pump(Server* s, Conn* c) {
     bool is_post =
         ieq(r.method, r.method_len, "POST") || ieq(r.method, r.method_len, "PUT");
     bool is_del = ieq(r.method, r.method_len, "DELETE");
+    // SWRP upgrade: authenticate the replication channel once, then
+    // switch this conn to binary frames (see the block above swrp_pump)
+    if (is_post && r.path_len == 6 && memcmp(r.path, "/.swrp", 6) == 0 &&
+        !r.chunked && r.content_len == 0) {
+      JwtRes jr = jwt_check(r.auth, r.auth_len, ".swrp", 5);
+      c->in_off += r.head_len;
+      c->sent_100 = false;
+      if (jr == JwtRes::OK) {
+        c->out.append(
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: swrp\r\nConnection: Upgrade\r\n\r\n");
+        c->swrp = true;
+        return swrp_pump(c);
+      }
+      // REJECT and UNSURE both refuse the upgrade — the primary falls
+      // back to HTTP replicate, where per-write tokens get the full
+      // (python-assisted) verdict
+      simple_response(c, jr == JwtRes::REJECT ? 401 : 400,
+                      "swrp upgrade refused", r.keep_alive);
+      continue;
+    }
     uint32_t vid;
     uint64_t key;
     uint32_t cookie;
@@ -1663,11 +1814,19 @@ int pump(Server* s, Conn* c) {
         c->sent_100 = true;
       }
       if (avail - r.head_len < (size_t)r.content_len) break;  // need body
-      if (handle_post(c, r, vid, key, cookie,
-                      (const uint8_t*)c->in.data() + c->in_off + r.head_len,
-                      r.content_len, fid, fid_len)) {
+      const uint8_t* body =
+          (const uint8_t*)c->in.data() + c->in_off + r.head_len;
+      if (handle_post(c, r, vid, key, cookie, body, r.content_len, fid,
+                      fid_len)) {
         c->in_off += r.head_len + r.content_len;
         c->sent_100 = false;
+        continue;
+      }
+      if (submit_repl(s, c, r, vid, key, cookie, body, r.content_len,
+                      fid, fid_len, false)) {
+        c->in_off += r.head_len + r.content_len;
+        c->sent_100 = false;
+        if (c->repl_pending) return 0;  // response arrives on peer ack
         continue;
       }
       // fall through to proxy
@@ -1676,6 +1835,13 @@ int pump(Server* s, Conn* c) {
       if (handle_delete(c, r, vid, key, fid, fid_len)) {
         c->in_off += r.head_len;
         c->sent_100 = false;
+        continue;
+      }
+      if (submit_repl(s, c, r, vid, key, cookie, nullptr, 0, fid,
+                      fid_len, true)) {
+        c->in_off += r.head_len;
+        c->sent_100 = false;
+        if (c->repl_pending) return 0;
         continue;
       }
       // fall through to proxy
@@ -1718,6 +1884,14 @@ int pump(Server* s, Conn* c) {
   return 0;
 }
 
+int pump(Server* s, Conn* c) {
+  Conn* prev = s->pumping;
+  s->pumping = c;
+  int st = pump_inner(s, c);
+  s->pumping = prev;
+  return st;
+}
+
 // Returns false when the Conn was closed AND FREED — the caller must
 // not touch `c` again after a false return.
 bool flush_out(Server* s, Conn* c) {
@@ -1745,10 +1919,598 @@ bool flush_out(Server* s, Conn* c) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Async replica fan-out (store_replicate.go:24 ReplicatedWrite +
+// :171 DistributedOperation, redesigned as an IO-thread state
+// machine): the primary appends locally, then ships the body to every
+// peer as POST/DELETE /<fid>?type=replicate with the client's JWT
+// forwarded — WITHOUT blocking a thread per round trip. Requests to
+// one peer ride ONE pipelined keep-alive connection; the client's 200
+// waits for every peer ack (both copies must exist, like the
+// reference), but many client writes keep their replicates in flight
+// concurrently, so the peer RTT amortizes instead of serializing.
+// Any peer failure fails that write (500) and marks the volume's peer
+// list stale so writes relay to Python (which re-resolves placement)
+// until the control plane pushes a fresh list.
+// ---------------------------------------------------------------------------
+struct ReplOp {
+  Conn* client;  // zombie-aware: finalize checks before responding
+  std::shared_ptr<Vol> v;
+  bool is_delete = false;
+  bool keep_alive = true;
+  int64_t size = 0;  // body_len (post) / reclaimed (delete)
+  uint32_t crc = 0;
+  int waiting = 0;  // peer acks outstanding
+  bool failed = false;
+  std::string failed_peer;
+};
+
+struct ReplWire {
+  // raw op params — encoded for the peer conn's negotiated wire
+  // (SWRP frame or HTTP request) at flush time, and re-encoded when a
+  // reconnect renegotiates the protocol
+  uint32_t vid = 0;
+  uint32_t cookie = 0;
+  uint64_t key = 0;
+  std::string body;  // copied out of the client buffer (it advances)
+  std::string auth;  // client token, forwarded on the HTTP wire
+  std::string fid;   // path fid (no slash, no extension)
+  std::string head;  // encoded header bytes (frame or HTTP head)
+  int enc_mode = -1;  // PeerConn mode `head` was built for
+  size_t sent = 0;    // bytes of head+body already on the socket
+  time_t enq = 0;     // hang-sweep clock
+  ReplOp* op = nullptr;
+  bool is_delete = false;
+};
+
+// Peer wire protocol states: the first use of a conn sends the SWRP
+// upgrade; 101 switches to binary frames, anything else falls back to
+// per-request HTTP replicate on the same conn.
+constexpr int PEER_HS = -1;
+constexpr int PEER_HTTP = 0;
+constexpr int PEER_BIN = 1;
+
+struct PeerConn {
+  int kind = KIND_PEER;
+  std::string hostport;
+  int fd = -1;
+  bool in_epoll = false;
+  int mode = PEER_HS;
+  std::string hs_buf;  // upgrade request bytes
+  size_t hs_off = 0;
+  std::string in;  // response bytes
+  size_t in_off = 0;
+  std::deque<ReplWire*> sendq;  // not yet fully written
+  std::deque<ReplWire*> await;  // written, awaiting response (FIFO)
+  bool retried = false;     // one reconnect per failure burst
+  bool dirty = false;       // queued in Server::dirty_peers this batch
+  bool connecting = false;  // non-blocking connect still in flight
+};
+
+size_t wire_total(const ReplWire* w) {
+  return w->head.size() + (w->is_delete ? 0 : w->body.size());
+}
+
+void encode_wire(ReplWire* w, int mode) {
+  w->head.clear();
+  w->sent = 0;
+  w->enc_mode = mode;
+  if (mode == PEER_BIN) {
+    uint8_t h[21];
+    h[0] = w->is_delete ? 2 : 1;
+    memcpy(h + 1, &w->vid, 4);
+    memcpy(h + 5, &w->key, 8);
+    memcpy(h + 13, &w->cookie, 4);
+    uint32_t blen = w->is_delete ? 0 : (uint32_t)w->body.size();
+    memcpy(h + 17, &blen, 4);
+    w->head.append((const char*)h, sizeof h);
+    return;
+  }
+  w->head.append(w->is_delete ? "DELETE /" : "POST /");
+  w->head.append(w->fid);
+  w->head.append("?type=replicate HTTP/1.1\r\nHost: x\r\n"
+                 "Content-Type: application/octet-stream\r\n"
+                 "Content-Length: ");
+  w->head.append(
+      std::to_string(w->is_delete ? 0 : (long long)w->body.size()));
+  w->head.append("\r\n");
+  if (!w->auth.empty()) {
+    // forward the client's token: same fid claim, still inside its
+    // validity window (the reference forwards the jwt the same way)
+    w->head.append("Authorization: ");
+    w->head.append(w->auth);
+    w->head.append("\r\n");
+  }
+  w->head.append("\r\n");
+}
+
+void arm_peer(Server* s, PeerConn* pc, uint32_t events) {
+  struct epoll_event ev = {};
+  ev.events = events;
+  ev.data.ptr = pc;
+  if (pc->in_epoll) {
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, pc->fd, &ev);
+  } else {
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, pc->fd, &ev);
+    pc->in_epoll = true;
+  }
+}
+
+// Fresh conn: queue the SWRP upgrade. Op wires wait for the verdict —
+// pipelining frames behind the upgrade would garble an HTTP-only peer.
+void start_handshake(PeerConn* pc) {
+  pc->mode = PEER_HS;
+  pc->hs_off = 0;
+  pc->hs_buf = "POST /.swrp HTTP/1.1\r\nHost: ";
+  pc->hs_buf += pc->hostport;
+  pc->hs_buf += "\r\nUpgrade: swrp\r\nConnection: Upgrade\r\n"
+                "Content-Length: 0\r\n";
+  std::string tok = mint_swrp_token();
+  if (!tok.empty()) {
+    pc->hs_buf += "Authorization: Bearer ";
+    pc->hs_buf += tok;
+    pc->hs_buf += "\r\n";
+  }
+  pc->hs_buf += "\r\n";
+}
+
+PeerConn* get_peer(Server* s, const std::string& hostport) {
+  PeerConn*& pc = s->peer_conns[hostport];
+  if (!pc) {
+    pc = new PeerConn();
+    pc->hostport = hostport;
+  }
+  if (pc->fd < 0) {
+    bool in_progress = false;
+    int fd = connect_hostport_nb(hostport, &in_progress);
+    if (fd < 0) return nullptr;
+    pc->fd = fd;
+    pc->connecting = in_progress;
+    pc->in_epoll = false;
+    pc->in.clear();
+    pc->in_off = 0;
+    start_handshake(pc);
+  }
+  return pc;
+}
+
+void finalize_repl(Server* s, ReplOp* op);
+void peer_fail(Server* s, PeerConn* pc);
+
+void peer_flush(Server* s, PeerConn* pc) {
+  if (pc->fd < 0) return;
+  if (pc->connecting) {
+    // wait for the connect verdict (EPOLLOUT / EPOLLERR)
+    arm_peer(s, pc, EPOLLIN | EPOLLOUT);
+    return;
+  }
+  if (pc->mode == PEER_HS) {
+    // only the upgrade goes out until the peer picks the protocol
+    while (pc->hs_off < pc->hs_buf.size()) {
+      ssize_t n = send(pc->fd, pc->hs_buf.data() + pc->hs_off,
+                       pc->hs_buf.size() - pc->hs_off, MSG_NOSIGNAL);
+      if (n > 0) {
+        pc->hs_off += n;
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      peer_fail(s, pc);
+      return;
+    }
+    arm_peer(s, pc,
+             EPOLLIN | (pc->hs_off < pc->hs_buf.size() ? EPOLLOUT : 0));
+    return;
+  }
+  for (ReplWire* w : pc->sendq)
+    if (w->enc_mode != pc->mode) encode_wire(w, pc->mode);
+  while (!pc->sendq.empty()) {
+    // one writev per burst: every queued wire's remaining head+body
+    struct iovec iov[64];
+    int nv = 0;
+    for (ReplWire* w : pc->sendq) {
+      if (nv >= 62) break;
+      size_t hs = w->head.size();
+      if (w->sent < hs)
+        iov[nv++] = {(void*)(w->head.data() + w->sent), hs - w->sent};
+      if (!w->is_delete) {
+        size_t boff = w->sent > hs ? w->sent - hs : 0;
+        if (boff < w->body.size())
+          iov[nv++] = {(void*)(w->body.data() + boff),
+                       w->body.size() - boff};
+      }
+    }
+    ssize_t n = writev(pc->fd, iov, nv);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      peer_fail(s, pc);
+      return;
+    }
+    if (n == 0) break;
+    size_t left = (size_t)n;
+    while (left > 0) {
+      ReplWire* w = pc->sendq.front();
+      size_t total = wire_total(w);
+      size_t take = std::min(left, total - w->sent);
+      w->sent += take;
+      left -= take;
+      if (w->sent == total) {
+        pc->sendq.pop_front();
+        pc->await.push_back(w);
+      }
+    }
+  }
+  // EPOLLIN always: responses, or the peer closing an idle conn
+  arm_peer(s, pc, EPOLLIN | (pc->sendq.empty() ? 0 : EPOLLOUT));
+}
+
+// Conclude one op: stats, stale marking, client response, resume the
+// client's (gated) pipeline.
+void finalize_repl(Server* s, ReplOp* op) {
+  if (op->failed) {
+    n_fanout_fail++;
+    std::lock_guard<std::mutex> lk(op->v->mu);
+    op->v->peers_stale = true;  // relay until the next peer refresh
+  } else if (op->is_delete) {
+    n_fast_delete++;
+  } else {
+    n_repl_post++;
+  }
+  Conn* c = op->client;
+  c->repl_pending = false;
+  if (c->zombie) {
+    delete c;
+    delete op;
+    return;
+  }
+  if (op->failed) {
+    std::string msg = (op->is_delete ? "replicate delete to "
+                                     : "replicate to ") +
+                      op->failed_peer + " failed";
+    simple_response(c, 500, msg.c_str(), op->keep_alive);
+  } else if (op->is_delete) {
+    respond_delete_ok(c, op->keep_alive, op->size);
+  } else {
+    respond_post_ok(c, op->keep_alive, op->size, op->crc);
+  }
+  c->sent_100 = false;
+  delete op;
+  if (s->pumping == c) return;  // synchronous failure inside this
+  // conn's own pump: the pump loop continues and its caller flushes
+  if (!flush_out(s, c)) return;  // conn freed on write error / close
+  int st = pump(s, c);  // requests buffered while the op was in flight
+  if (st == -1)
+    close_conn(s, c);
+  else if (st == 0)
+    flush_out(s, c);
+  // st == 1: handed to proxy workers
+}
+
+// Peer conn died (or responded unframed): retry the unacked tail once
+// on a fresh connection — a dead keep-alive conn looks identical to a
+// peer error (same contract as the old blocking fan-out; the replicate
+// append is same-key-same-bytes idempotent, so a duplicate delivery on
+// the retry is harmless). A second death without an intervening
+// response fails every queued op.
+void peer_fail(Server* s, PeerConn* pc) {
+  if (pc->fd >= 0) {
+    if (pc->in_epoll)
+      epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, pc->fd, nullptr);
+    close(pc->fd);
+    pc->fd = -1;
+    pc->in_epoll = false;
+    pc->connecting = false;
+  }
+  pc->in.clear();
+  pc->in_off = 0;
+  std::deque<ReplWire*> items;
+  items.swap(pc->await);
+  for (ReplWire* w : pc->sendq) items.push_back(w);
+  pc->sendq.clear();
+  if (items.empty()) {
+    pc->retried = false;  // idle server-side close: nothing lost
+    return;
+  }
+  if (!pc->retried) {
+    pc->retried = true;
+    bool in_progress = false;
+    int fd = connect_hostport_nb(pc->hostport, &in_progress);
+    if (fd >= 0) {
+      pc->fd = fd;
+      pc->connecting = in_progress;
+      start_handshake(pc);  // the fresh conn renegotiates the protocol
+      time_t now = time(nullptr);
+      for (ReplWire* w : items) {
+        w->sent = 0;
+        w->enq = now;  // the retry earns a fresh hang window
+        pc->sendq.push_back(w);
+      }
+      peer_flush(s, pc);
+      return;
+    }
+  }
+  pc->retried = false;
+  for (ReplWire* w : items) {
+    ReplOp* op = w->op;
+    op->waiting--;
+    if (!op->failed) {
+      op->failed = true;
+      op->failed_peer = pc->hostport;
+    }
+    delete w;
+    if (op->waiting == 0) finalize_repl(s, op);
+  }
+}
+
+void peer_read(Server* s, PeerConn* pc) {
+  char buf[16 << 10];
+  while (true) {
+    ssize_t got = recv(pc->fd, buf, sizeof buf, 0);
+    if (got > 0) {
+      pc->in.append(buf, got);
+      if (pc->in.size() - pc->in_off > (size_t)(16 << 20)) {
+        peer_fail(s, pc);  // runaway response
+        return;
+      }
+      continue;
+    }
+    if (got == 0 || (errno != EAGAIN && errno != EWOULDBLOCK)) {
+      peer_fail(s, pc);
+      return;
+    }
+    break;  // EAGAIN: parsed what we have
+  }
+  if (pc->mode == PEER_HS) {
+    // upgrade verdict: 101 = binary frames; any other framed response
+    // = HTTP fallback (python-only peer / jwt verdict refused)
+    const char* base = pc->in.data() + pc->in_off;
+    size_t avail = pc->in.size() - pc->in_off;
+    const char* he = (const char*)memmem(base, avail, "\r\n\r\n", 4);
+    if (!he) return;  // need more bytes
+    size_t head_len = he - base + 4;
+    int code = avail >= 12 ? atoi(base + 9) : 0;
+    if (code == 101) {
+      pc->in_off += head_len;
+      pc->mode = PEER_BIN;
+    } else {
+      const char* clh =
+          (const char*)memmem(base, head_len, "Content-Length:", 15);
+      if (!clh)
+        clh = (const char*)memmem(base, head_len, "content-length:", 15);
+      if (!clh) {
+        peer_fail(s, pc);  // unframed refusal: the conn can't be reused
+        return;
+      }
+      int64_t cl = strtoll(clh + 15, nullptr, 10);
+      if ((int64_t)avail < (int64_t)head_len + cl) return;  // need body
+      pc->in_off += head_len + cl;
+      pc->mode = PEER_HTTP;
+    }
+    // NOTE: the handshake verdict does NOT reset the retry budget —
+    // only a completed op response does. A peer that refuses the
+    // upgrade and then closes would otherwise reconnect forever
+    // (refuse -> close -> retry -> refuse ...) instead of failing the
+    // queued ops over to the Python relay after one retry.
+    peer_flush(s, pc);  // encode + ship everything queued
+    if (pc->fd < 0) return;
+  }
+  if (pc->mode == PEER_BIN) {
+    while (!pc->await.empty() &&
+           pc->in.size() - pc->in_off >= SWRP_ACK) {
+      const uint8_t* a = (const uint8_t*)pc->in.data() + pc->in_off;
+      uint16_t code;
+      uint32_t crc;
+      int64_t size;
+      memcpy(&code, a, 2);
+      memcpy(&crc, a + 2, 4);
+      memcpy(&size, a + 6, 8);
+      pc->in_off += SWRP_ACK;
+      ReplWire* w = pc->await.front();
+      pc->await.pop_front();
+      pc->retried = false;
+      ReplOp* op = w->op;
+      bool ok = (code >= 200 && code < 300) ||
+                (w->is_delete && code == 404);  // peer never had the copy
+      delete w;
+      op->waiting--;
+      if (!ok && !op->failed) {
+        op->failed = true;
+        op->failed_peer = pc->hostport;
+      }
+      if (op->waiting == 0) finalize_repl(s, op);
+    }
+    if (pc->in_off == pc->in.size()) {
+      pc->in.clear();
+      pc->in_off = 0;
+    }
+    return;
+  }
+  while (!pc->await.empty()) {
+    const char* base = pc->in.data() + pc->in_off;
+    size_t avail = pc->in.size() - pc->in_off;
+    const char* he = (const char*)memmem(base, avail, "\r\n\r\n", 4);
+    if (!he) break;
+    size_t head_len = he - base + 4;
+    const char* clh =
+        (const char*)memmem(base, head_len, "Content-Length:", 15);
+    if (!clh)
+      clh = (const char*)memmem(base, head_len, "content-length:", 15);
+    if (!clh) {
+      peer_fail(s, pc);  // unframed: the conn can't be trusted
+      return;
+    }
+    int64_t cl = strtoll(clh + 15, nullptr, 10);
+    if ((int64_t)avail < (int64_t)head_len + cl) break;
+    int code = avail >= 12 ? atoi(base + 9) : 0;
+    bool close_hint =
+        memmem(base, head_len, "Connection: close", 17) ||
+        memmem(base, head_len, "connection: close", 17);
+    pc->in_off += head_len + cl;
+    ReplWire* w = pc->await.front();
+    pc->await.pop_front();
+    pc->retried = false;  // a live response resets the retry budget
+    ReplOp* op = w->op;
+    bool ok = (code >= 200 && code < 300) ||
+              (w->is_delete && code == 404);  // peer never had the copy
+    delete w;
+    op->waiting--;
+    if (!ok && !op->failed) {
+      op->failed = true;
+      op->failed_peer = pc->hostport;
+    }
+    if (op->waiting == 0) finalize_repl(s, op);
+    if (close_hint) {
+      peer_fail(s, pc);
+      return;
+    }
+  }
+  if (pc->in_off == pc->in.size()) {
+    pc->in.clear();
+    pc->in_off = 0;
+  }
+}
+
+void peer_event(Server* s, PeerConn* pc, uint32_t events) {
+  if (events & (EPOLLHUP | EPOLLERR)) {
+    peer_fail(s, pc);
+    return;
+  }
+  if (pc->connecting && (events & EPOLLOUT)) {
+    int err = 0;
+    socklen_t len = sizeof err;
+    getsockopt(pc->fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      peer_fail(s, pc);
+      return;
+    }
+    pc->connecting = false;  // connected: fall through to the flush
+  }
+  if (events & EPOLLOUT) {
+    peer_flush(s, pc);
+    if (pc->fd < 0) return;  // flush hit a dead conn
+  }
+  if (events & EPOLLIN) peer_read(s, pc);
+}
+
+// Ops stuck past the window (peer accepted the conn but never
+// responds) get the same treatment as a dead conn: one retry burst,
+// then failure. 30s matches the old blocking path's SO_RCVTIMEO.
+void peer_sweep(Server* s) {
+  time_t now = time(nullptr);
+  if (now == s->last_peer_sweep) return;
+  s->last_peer_sweep = now;
+  // snapshot first: peer_fail -> finalize -> pump can submit new ops
+  // whose get_peer inserts into peer_conns, invalidating a live
+  // iterator (PeerConn objects themselves live until dp_stop)
+  std::vector<PeerConn*> snap;
+  snap.reserve(s->peer_conns.size());
+  for (auto& [hp, pc] : s->peer_conns) snap.push_back(pc);
+  for (PeerConn* pc : snap) {
+    ReplWire* oldest = !pc->await.empty() ? pc->await.front()
+                       : !pc->sendq.empty() ? pc->sendq.front()
+                                            : nullptr;
+    if (oldest && now - oldest->enq > 30) peer_fail(s, pc);
+  }
+}
+
+bool submit_repl(Server* s, Conn* c, const Request& r, uint32_t vid,
+                 uint64_t key, uint32_t cookie, const uint8_t* body,
+                 int64_t body_len, const char* fid, size_t fid_len,
+                 bool is_delete) {
+  // multipart/form and metadata uploads need Python's form decoding —
+  // appending the raw envelope would corrupt the needle on every
+  // replica (same guard as handle_post)
+  if (!is_delete && !r.plain_upload) return false;
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return false;
+  std::vector<std::string> peers;
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached || !v->has_replicas || v->peers_stale ||
+        v->peers.empty())
+      return false;  // python resolves placement and fans out
+    peers = v->peers;
+  }
+  JwtRes jr = jwt_check(r.auth, r.auth_len, fid, fid_len);
+  if (jr == JwtRes::UNSURE) return false;  // python gives the verdict
+  if (jr == JwtRes::REJECT) {
+    n_jwt_reject++;
+    simple_response(c, 401, "jwt rejected", r.keep_alive);
+    return true;
+  }
+  uint32_t crc = 0;
+  int64_t reclaimed = 0;
+  int st = is_delete ? delete_tomb(v, key, &reclaimed)
+                     : append_plain(v, key, cookie, body, body_len, &crc);
+  if (st == 0) return false;
+  if (st == 409) {
+    simple_response(c, 409, "volume is read only", r.keep_alive);
+    return true;
+  }
+  if (st == 500) {
+    n_errors++;
+    simple_response(c, 500, is_delete ? "delete failed" : "write failed",
+                    r.keep_alive);
+    return true;
+  }
+  ReplOp* op = new ReplOp();
+  op->client = c;
+  op->v = v;
+  op->is_delete = is_delete;
+  op->keep_alive = r.keep_alive;
+  op->size = is_delete ? reclaimed : body_len;
+  op->crc = crc;
+  c->repl_pending = true;
+  time_t now = time(nullptr);
+  for (const auto& peer : peers) {
+    PeerConn* pc = get_peer(s, peer);
+    if (!pc) {
+      if (!op->failed) {
+        op->failed = true;
+        op->failed_peer = peer;
+      }
+      continue;  // still await peers already queued
+    }
+    ReplWire* w = new ReplWire();
+    w->op = op;
+    w->is_delete = is_delete;
+    w->enq = now;
+    w->vid = vid;
+    w->key = key;
+    w->cookie = cookie;
+    w->fid.assign(fid, fid_len);
+    if (r.auth && r.auth_len) w->auth.assign(r.auth, r.auth_len);
+    if (!is_delete && body_len > 0)
+      w->body.assign((const char*)body, body_len);
+    pc->sendq.push_back(w);
+    op->waiting++;
+    if (!pc->dirty) {  // flushed once per epoll batch (writev burst)
+      pc->dirty = true;
+      s->dirty_peers.push_back(pc);
+    }
+  }
+  if (op->waiting == 0) finalize_repl(s, op);
+  return true;
+}
+
+// End-of-batch peer flush: every client write handled in this epoll
+// round queued its replicates; ship each peer's burst with one writev.
+void flush_dirty_peers(Server* s) {
+  for (size_t i = 0; i < s->dirty_peers.size(); i++) {
+    PeerConn* pc = s->dirty_peers[i];
+    pc->dirty = false;
+    if (pc->fd >= 0)
+      peer_flush(s, pc);
+    else if (!pc->sendq.empty())
+      peer_fail(s, pc);  // conn died between queue and flush: retry path
+  }
+  s->dirty_peers.clear();
+}
+
 void io_loop(Server* s) {
   struct epoll_event evs[128];
   while (!s->stop.load()) {
     int n = epoll_wait(s->epoll_fd, evs, 128, 1000);
+    peer_sweep(s);  // hung-replicate watchdog, 1Hz
     for (int i = 0; i < n; i++) {
       if (evs[i].data.ptr == nullptr) {  // listen fd
         while (true) {
@@ -1782,6 +2544,10 @@ void io_loop(Server* s) {
             flush_out(s, c);
           // st == 1: handed off again
         }
+        continue;
+      }
+      if (*(int*)evs[i].data.ptr == KIND_PEER) {  // replica peer conn
+        peer_event(s, (PeerConn*)evs[i].data.ptr, evs[i].events);
         continue;
       }
       Conn* c = (Conn*)evs[i].data.ptr;
@@ -1818,143 +2584,11 @@ void io_loop(Server* s) {
         flush_out(s, c);
       }
     }
+    flush_dirty_peers(s);  // one writev per peer for this whole batch
   }
-}
-
-// Native replicated write/delete on a worker thread (the blocking peer
-// round-trips must never run on the IO thread). Returns 0 = not ours
-// (relay to python), 1 = handled and the conn survives, -1 = handled
-// but the conn must close.
-int native_worker_op(Server* s, Conn* c, PeerPool* pool) {
-  Request r;
-  ssize_t hl =
-      parse_head(c->in.data() + c->in_off, c->in.size() - c->in_off, &r);
-  if (hl <= 0) return 0;
-  bool is_post = ieq(r.method, r.method_len, "POST") ||
-                 ieq(r.method, r.method_len, "PUT");
-  bool is_delete = ieq(r.method, r.method_len, "DELETE");
-  if (!is_post && !is_delete) return 0;
-  if (r.has_query || r.proxy_only || r.chunked) return 0;
-  if (is_post && (!r.plain_upload || r.content_len <= 0 ||
-                  r.content_len > (8 << 20)))
-    return 0;
-  if (is_delete && r.content_len != 0) return 0;
-  uint32_t vid;
-  uint64_t key;
-  uint32_t cookie;
-  if (!parse_fid_path(r.path, r.path_len, &vid, &key, &cookie)) return 0;
-  std::shared_ptr<Vol> v = find_vol(vid);
-  if (!v) return 0;
-  std::vector<std::string> peers;
-  {
-    std::lock_guard<std::mutex> lk(v->mu);
-    if (v->detached || !v->has_replicas || v->peers_stale ||
-        v->peers.empty())
-      return 0;  // python resolves placement and fans out
-    peers = v->peers;
-  }
-  // complete the body BEFORE taking any view we keep: appending can
-  // reallocate c->in and dangle every Request pointer
-  while (is_post &&
-         (int64_t)(c->in.size() - c->in_off - r.head_len) < r.content_len) {
-    char buf[64 << 10];
-    int64_t missing =
-        r.content_len - (int64_t)(c->in.size() - c->in_off - r.head_len);
-    ssize_t got = recv(c->fd, buf,
-                       (size_t)std::min<int64_t>(missing, sizeof buf), 0);
-    if (got <= 0) return -1;
-    c->in.append(buf, got);
-  }
-  hl = parse_head(c->in.data() + c->in_off, c->in.size() - c->in_off, &r);
-  if (hl <= 0) return -1;  // cannot happen: same bytes as above
-  const char* fid = r.path + 1;
-  size_t fid_len = r.path_len - 1;
-  const char* dot = (const char*)memchr(fid, '.', fid_len);
-  if (dot) fid_len = dot - fid;
-  JwtRes jr = jwt_check(r.auth, r.auth_len, fid, fid_len);
-  if (jr == JwtRes::UNSURE) return 0;
-  const uint8_t* body =
-      (const uint8_t*)c->in.data() + c->in_off + r.head_len;
-  if (jr == JwtRes::REJECT) {
-    n_jwt_reject++;
-    simple_response(c, 401, "jwt rejected", r.keep_alive);
-  } else if (is_post) {
-    uint32_t crc = 0;
-    int st = append_plain(v, key, cookie, body, r.content_len, &crc);
-    if (st == 0) return 0;
-    if (st == 409) {
-      simple_response(c, 409, "volume is read only", r.keep_alive);
-    } else if (st == 500) {
-      n_errors++;
-      simple_response(c, 500, "write failed", r.keep_alive);
-    } else {
-      const std::string* failed = nullptr;
-      for (const auto& peer : peers) {
-        if (!peer_replicate(pool, peer, false, fid, fid_len, r.auth,
-                            r.auth_len, body, r.content_len)) {
-          failed = &peer;
-          break;
-        }
-      }
-      if (failed) {
-        n_fanout_fail++;
-        {
-          std::lock_guard<std::mutex> lk(v->mu);
-          v->peers_stale = true;  // relay until the next peer refresh
-        }
-        std::string msg = "replicate to " + *failed + " failed";
-        simple_response(c, 500, msg.c_str(), r.keep_alive);
-      } else {
-        respond_post_ok(c, r, r.content_len, crc);
-        n_repl_post++;
-      }
-    }
-  } else {  // replicated DELETE: tombstone locally, fan out regardless
-    // of local presence (a peer may hold a copy this server never saw —
-    // python _delete_fid:620 fans out the same way)
-    int64_t reclaimed = 0;
-    int st = delete_tomb(v, key, &reclaimed);
-    if (st == 0) return 0;
-    if (st == 409) {
-      simple_response(c, 409, "volume is read only", r.keep_alive);
-    } else if (st == 500) {
-      n_errors++;
-      simple_response(c, 500, "delete failed", r.keep_alive);
-    } else {
-      const std::string* failed = nullptr;
-      for (const auto& peer : peers) {
-        if (!peer_replicate(pool, peer, true, fid, fid_len, r.auth,
-                            r.auth_len, nullptr, 0)) {
-          failed = &peer;
-          break;
-        }
-      }
-      if (failed) {
-        n_fanout_fail++;
-        {
-          std::lock_guard<std::mutex> lk(v->mu);
-          v->peers_stale = true;
-        }
-        std::string msg = "replicate delete to " + *failed + " failed";
-        simple_response(c, 500, msg.c_str(), r.keep_alive);
-      } else {
-        respond_delete_ok(c, r, reclaimed);
-        n_fast_delete++;
-      }
-    }
-  }
-  // flush and consume (conn is blocking here)
-  if (!send_all(c->fd, c->out.data() + c->out_off,
-                c->out.size() - c->out_off))
-    return -1;
-  c->out.clear();
-  c->out_off = 0;
-  c->in_off += r.head_len + (is_post ? r.content_len : 0);
-  return c->want_close ? -1 : 1;
 }
 
 void worker_loop(Server* s) {
-  PeerPool pool;  // per-thread keep-alive conns to replica peers
   while (true) {
     Conn* c;
     {
@@ -1965,20 +2599,13 @@ void worker_loop(Server* s) {
       s->proxy_q.pop_front();
     }
     set_nonblock(c->fd, false);
-    // replicated-volume writes are served natively here (local append +
-    // peer fan-out); everything else relays to the python backend. The
-    // head is re-parsed per attempt: Request views must point into this
-    // thread's view of the buffer.
-    bool ok;
-    int st = native_worker_op(s, c, &pool);
-    if (st == 0) {
-      Request r;
-      ssize_t hl =
-          parse_head(c->in.data() + c->in_off, c->in.size() - c->in_off, &r);
-      ok = hl > 0 && proxy_one(s, c, r);
-    } else {
-      ok = st == 1;
-    }
+    // pure relay to the python backend (replicated-volume writes are
+    // the IO thread's async fan-out now). The head is re-parsed here:
+    // Request views must point into this thread's view of the buffer.
+    Request r;
+    ssize_t hl =
+        parse_head(c->in.data() + c->in_off, c->in.size() - c->in_off, &r);
+    bool ok = hl > 0 && proxy_one(s, c, r);
     if (!ok) {
       if (c->backend_fd >= 0) close(c->backend_fd);
       close(c->fd);
@@ -2066,12 +2693,39 @@ void dp_stop(void) {
   for (auto& [fd, c] : s->conns) {
     if (c->backend_fd >= 0) close(c->backend_fd);
     close(fd);
+    if (c->repl_pending) {
+      // an in-flight fan-out op still references this conn: freed via
+      // its op in the sweep below, not here (double-free otherwise)
+      c->zombie = true;
+      continue;
+    }
     delete c;
   }
   for (Conn* c : s->returned) {
     if (c->backend_fd >= 0) close(c->backend_fd);
     close(c->fd);
     delete c;
+  }
+  // in-flight fan-out state: free wires once, ops once, and the client
+  // conns the ops still reference (marked zombie above / by disconnect)
+  {
+    std::unordered_set<ReplOp*> ops;
+    for (auto& [hp, pc] : s->peer_conns) {
+      for (ReplWire* w : pc->sendq) {
+        ops.insert(w->op);
+        delete w;
+      }
+      for (ReplWire* w : pc->await) {
+        ops.insert(w->op);
+        delete w;
+      }
+      if (pc->fd >= 0) close(pc->fd);
+      delete pc;
+    }
+    for (ReplOp* op : ops) {
+      if (op->client && op->client->zombie) delete op->client;
+      delete op;
+    }
   }
   close(s->listen_fd);
   close(s->epoll_fd);

@@ -184,6 +184,20 @@ def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
 
 
+def _etag_from_chunks(meta: dict) -> str:
+    """ETagChunks fallback (weed/filer/filechunks.go) for entries the
+    filer stored without a whole-stream md5 (multi-chunk autochunked
+    writes made outside the S3 gateway)."""
+    chunks = meta.get("chunks") or []
+    if len(chunks) == 1:
+        return chunks[0].get("etag", "")
+    joined = b"".join(bytes.fromhex(c["etag"])
+                      for c in chunks if c.get("etag"))
+    if not joined:
+        return ""
+    return f"{hashlib.md5(joined).hexdigest()}-{len(chunks)}"
+
+
 class S3ApiServer:
     def __init__(self, filer_url: str, iam_config: dict | None = None,
                  region: str = "us-east-1",
@@ -916,7 +930,10 @@ class S3ApiServer:
             await self._filer("POST", self._fpath(bucket, key),
                               params={"mkdir": "1"})
             return web.Response(status=200)
-        params = {"collection": bucket}
+        # fullmd5: AWS-exact single-PUT ETag (md5 of the whole body)
+        # even when the filer autochunks a large payload — the filer
+        # otherwise stores the cheaper ETagChunks form for multi-chunk
+        params = {"collection": bucket, "fullmd5": "1"}
         mime = req.headers.get("Content-Type", "")
         headers = {"Content-Type": mime} if mime else {}
         # x-amz-meta-* rides the SAME filer create as the chunks
@@ -925,6 +942,14 @@ class S3ApiServer:
         # (SaveAmzMetaData, s3api_object_handlers_put.go)
         for k, v in req.headers.items():
             if k.lower().startswith("x-amz-meta-"):
+                # AWS requires US-ASCII metadata values; raw non-ASCII
+                # header bytes (latin-1 clients) arrive as surrogates
+                # and get a clean 400, not a codec traceback
+                if not v.isascii():
+                    raise S3Error(
+                        "InvalidArgument",
+                        f"x-amz-meta-* values must be US-ASCII ({k})",
+                        400)
                 name = k.lower()[len("x-amz-meta-"):]
                 headers[f"x-seaweed-ext-s3_meta_{name}"] = \
                     extheaders.armor(v)
@@ -1092,7 +1117,7 @@ class S3ApiServer:
             c = ET.Element("Contents")
             c.append(_leaf("Key", _enc(name)))
             c.append(_leaf("LastModified", _iso(meta.get("mtime", 0))))
-            etag = meta.get("md5", "")
+            etag = meta.get("md5", "") or _etag_from_chunks(meta)
             c.append(_leaf("ETag", f'"{etag}"'))
             # max(offset+size), NOT the chunk-size sum: overlapping
             # rewrites keep superseded chunks in the list
@@ -1251,8 +1276,12 @@ class S3ApiServer:
         await self._upload_marker(bucket, upload_id)
         part_path = f"{self._upload_dir(bucket, upload_id)}/" \
             f"{part_number:05d}.part"
+        # fullmd5: the part entry's md5 must be the md5 of the PART
+        # bytes — CompleteMultipartUpload composes the final "-N" etag
+        # from them, exactly as AWS does
         resp = await self._filer("POST", self._fpath(bucket, part_path),
-                                 params={"collection": bucket},
+                                 params={"collection": bucket,
+                                         "fullmd5": "1"},
                                  data=payload)
         if resp.status_code >= 300:
             raise S3Error("InternalError", resp.text, 500)

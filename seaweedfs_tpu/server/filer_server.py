@@ -34,6 +34,7 @@ from ..utils import metrics
 from ..wdclient.client import MasterClient
 
 DEFAULT_CHUNK_SIZE = 8 << 20  # autochunk default (`-maxMB=8` upstream)
+UPLOAD_WINDOW = 3  # streamed-PUT chunk uploads in flight (≤24MB held)
 
 
 class FilerServer:
@@ -760,31 +761,88 @@ class FilerServer:
             mime = content_type
             reader = req.content
 
-        chunks, md5_all, total = [], hashlib.md5(), 0
-        offset = 0
-        while True:
-            piece = await _read_exactly(reader, chunk_size)
-            if not piece:
-                break
-            if len(piece) <= (256 << 10):
-                # small chunks stay on the event loop: keep-alive
-                # aiohttp + batched assigns, no thread hop
-                fid, etag, ckey = await self._upload_chunk_async(
-                    piece, filename, collection, replication, ttl,
-                    disk_type)
-            else:
-                fid, etag, ckey = await asyncio.to_thread(
-                    self._upload_chunk, piece, filename, collection,
-                    replication, ttl, disk_type)
-            md5_all.update(piece)
-            chunks.append(FileChunk(fid=fid, offset=offset,
-                                    size=len(piece),
+        # Streamed autochunk with a bounded upload window: body reads
+        # overlap chunk uploads (UPLOAD_WINDOW in flight on the event
+        # loop), so a 1GB PUT is bounded by max(ingest, volume write)
+        # instead of their sum — the reference pipelines the same way
+        # (filer_server_handlers_write_autochunk.go:67 +
+        # mount/page_writer/upload_pipeline.go). Every size rides the
+        # loop: a to_thread hop here measured WORSE (81->73 MB/s on
+        # one core — worker threads fight the loop for the GIL) while
+        # the async path overlaps with the volume server's off-GIL
+        # native work. Hashing is ONE md5 pass per byte: the per-chunk
+        # etag. The whole-stream md5 is computed only when the client
+        # sent Content-MD5 (verified below) or asked via ?fullmd5=1
+        # (the S3 gateway does, for AWS-exact object ETags); otherwise
+        # multi-chunk ETags use the reference's own ETagChunks
+        # fallback (filer/filechunks.go) and single-chunk entries
+        # inherit their chunk's md5 for free.
+        content_md5 = req.headers.get("Content-MD5", "")
+        md5_want = b""
+        if content_md5:
+            import base64
+            import binascii
+
+            try:  # validated BEFORE the body is read: a bad header
+                # must 400 up front, not 500 after chunks uploaded
+                md5_want = base64.b64decode(content_md5, validate=True)
+            except binascii.Error:
+                md5_want = b""
+            if len(md5_want) != 16:
+                return web.json_response(
+                    {"error": "malformed Content-MD5 header"},
+                    status=400)
+        md5_all = hashlib.md5() if content_md5 \
+            or "fullmd5" in req.query else None
+        chunks, total, offset = [], 0, 0
+        pending: list[tuple[int, int, asyncio.Task]] = []
+
+        async def _collect_oldest():
+            poff, psize, ptask = pending.pop(0)
+            fid, etag, ckey = await ptask
+            chunks.append(FileChunk(fid=fid, offset=poff, size=psize,
                                     mtime_ns=time.time_ns(), etag=etag,
                                     cipher_key=ckey))
-            offset += len(piece)
-            total += len(piece)
-            if len(piece) < chunk_size:
-                break
+
+        try:
+            while True:
+                piece = await _read_exactly(reader, chunk_size)
+                if not piece:
+                    break
+                if md5_all is not None:
+                    md5_all.update(piece)
+                task = asyncio.ensure_future(self._upload_chunk_async(
+                    piece, filename, collection, replication, ttl,
+                    disk_type))
+                pending.append((offset, len(piece), task))
+                offset += len(piece)
+                total += len(piece)
+                while len(pending) >= UPLOAD_WINDOW:
+                    await _collect_oldest()
+                if len(piece) < chunk_size:
+                    break
+            while pending:
+                await _collect_oldest()
+        except BaseException:
+            # chunks already uploaded for the failed PUT are orphans:
+            # queue them for the background deletion loop — including
+            # in-flight uploads that finished but were never collected
+            orphans = [c for c in chunks if c.fid]
+            for poff, psize, t in pending:
+                if t.done() and not t.cancelled() and not t.exception():
+                    fid, _etag, _ckey = t.result()
+                    orphans.append(FileChunk(fid=fid, offset=poff,
+                                             size=psize, mtime_ns=0))
+                else:
+                    t.cancel()
+            if orphans:
+                self._delete_chunks(orphans)
+            raise
+
+        if content_md5 and md5_want != md5_all.digest():
+            self._delete_chunks([c for c in chunks if c.fid])
+            return web.json_response(
+                {"error": "Content-MD5 mismatch"}, status=400)
 
         if len(chunks) >= MANIFEST_BATCH:
             def _save_manifest(b: bytes):
@@ -801,9 +859,15 @@ class FilerServer:
         extended = {k.lower()[len("x-seaweed-ext-"):]: extheaders.unarmor(v)
                     for k, v in req.headers.items()
                     if k.lower().startswith("x-seaweed-ext-")}
+        if md5_all is not None:
+            md5_hex = md5_all.hexdigest()
+        elif len(chunks) == 1 and not chunks[0].is_chunk_manifest:
+            md5_hex = chunks[0].etag  # the chunk md5 IS the file md5
+        else:
+            md5_hex = ""  # readers fall back to ETagChunks
         entry = Entry(full_path=path, mime=mime,
                       ttl_sec=_ttl_seconds(ttl),
-                      md5=md5_all.hexdigest(), collection=collection,
+                      md5=md5_hex, collection=collection,
                       replication=replication, chunks=chunks,
                       extended=extended)
         await asyncio.to_thread(
@@ -812,7 +876,7 @@ class FilerServer:
         metrics.counter_add("filer_write_bytes", total)
         return web.json_response(
             {"name": filename, "size": total,
-             "etag": entry.md5}, status=201)
+             "etag": entry.md5 or etag_chunks(chunks)}, status=201)
 
     async def _cache_remote(self, path: str,
                             signatures: list[int]) -> web.Response:

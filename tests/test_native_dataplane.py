@@ -497,7 +497,10 @@ def test_replicated_write_fans_out_natively(tmp_path, dp):
         code, resp = _post(dp.port, "14,1deadbeef", b"fan-out-bytes")
         assert code == 201 and json.loads(resp)["size"] == 13
         assert v.read_needle(0x1, 0xDEADBEEF).data == b"fan-out-bytes"
-        assert double.requests == [
+        # an HTTP-only peer first sees the SWRP upgrade offer, refuses
+        # it (non-101), and replication falls back to per-request HTTP
+        assert double.requests[0][:2] == ("POST", "/.swrp")
+        assert double.requests[1:] == [
             ("POST", "/14,1deadbeef?type=replicate", None,
              b"fan-out-bytes")]
         assert dp.http_stats()["repl_post"] >= 1
@@ -564,12 +567,17 @@ def test_jwt_forwarded_on_fanout(tmp_path, dp):
         dp.set_peers(16, [f"127.0.0.1:{double.port}"])
         tok = sign_jwt(secret, "16,1deadbeef")
         assert _post_auth(dp.port, "16,1deadbeef", b"sec", tok)[0] == 201
-        method, path, auth, body = double.requests[0]
+        # the upgrade offer authenticates the CHANNEL with a minted
+        # ".swrp"-claim token (never the client's fid token)
+        hs_method, hs_path, hs_auth, _ = double.requests[0]
+        assert (hs_method, hs_path) == ("POST", "/.swrp")
+        assert hs_auth and hs_auth.startswith("Bearer ") and hs_auth != tok
+        method, path, auth, body = double.requests[1]
         assert (method, path) == ("POST", "/16,1deadbeef?type=replicate")
         assert auth == f"Bearer {tok}"
         # and a bad token is rejected BEFORE any local write or fan-out
         assert _post_auth(dp.port, "16,2deadbeef", b"x", "junk")[0] == 401
-        assert len(double.requests) == 1
+        assert len(double.requests) == 2
         v.detach_native()
         v.close()
     finally:
