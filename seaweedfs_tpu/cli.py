@@ -136,6 +136,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-s3.config", dest="s3_config", default="",
                    help="json file with s3 identities")
+    p.add_argument("-s3.native", dest="s3_native", default="auto",
+                   choices=["auto", "native", "python"],
+                   help="native C++ S3 front for small-object PUT/GET "
+                        "(needs -dataplane native; everything else "
+                        "relays to the python S3 app)")
+    p.add_argument("-dataplane", default="auto",
+                   choices=["auto", "native", "python"],
+                   help="C++ front for the volume hot path")
+    p.add_argument("-filer.store", dest="filer_store", default="sqlite")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="auto")
@@ -990,18 +999,34 @@ def _run_server(args) -> int:
                   ec_backend=args.ec_backend,
                   needle_map_kind=args.index)
     vs = VolumeServer(store, mt.url)
-    vt = ServerThread(vs.app, host=args.ip, port=args.volume_port).start()
-    store.port = vt.port
-    store.public_url = vt.address
+
+    class _VolArgs:  # reuse the standalone volume front resolution
+        dataplane = args.dataplane
+        port = args.volume_port
+        ip = args.ip
+
+    public = _start_volume_front(vs, _VolArgs, [vol_dir])
+    native_volume = public is not None
+    if native_volume:
+        vt = vs._backend_thread
+        store.port = public
+        store.public_url = f"{args.ip}:{public}"
+        print(f"volume server listening on http://{args.ip}:{public} "
+              f"(native data plane; python backend :{vt.port})")
+    else:
+        vt = ServerThread(vs.app, host=args.ip,
+                          port=args.volume_port).start()
+        store.port = vt.port
+        store.public_url = vt.address
+        print(f"volume server listening on {vt.url}")
     threads.append(vt)
-    print(f"volume server listening on {vt.url}")
 
     if args.filer or args.s3:
         from .server.filer_server import FilerServer
 
         filer_dir = os.path.join(args.dir, "filer")
         os.makedirs(filer_dir, exist_ok=True)
-        fs = FilerServer(mt.url, store="sqlite",
+        fs = FilerServer(mt.url, store=args.filer_store,
                          store_path=os.path.join(filer_dir, "filer.db"))
         ft = ServerThread(fs.app, host=args.ip, port=args.filer_port).start()
         fs.address = ft.address
@@ -1017,10 +1042,29 @@ def _run_server(args) -> int:
                 with open(args.s3_config) as f:
                     iam_cfg = _json.load(f)
             s3 = S3ApiServer(ft.url, iam_config=iam_cfg)
-            st = ServerThread(s3.app, host=args.ip,
-                              port=args.s3_port).start()
-            threads.append(st)
-            print(f"s3 gateway listening on {st.url}")
+            want_native_s3 = args.s3_native != "python" and native_volume
+            if args.s3_native == "native" and not native_volume:
+                raise SystemExit("-s3.native=native needs the native "
+                                 "volume data plane in-process "
+                                 "(-dataplane native)")
+            if want_native_s3:
+                from .s3.native_front import NativeS3Front
+
+                st = ServerThread(s3.app, host="127.0.0.1",
+                                  port=0).start()
+                threads.append(st)
+                front = NativeS3Front(s3, fs.filer, mt.url,
+                                      args.s3_port, st.port,
+                                      listen_ip=args.ip)
+                s3._native_front = front  # keeps the threads alive
+                print(f"s3 gateway listening on "
+                      f"http://{args.ip}:{front.port} (native front; "
+                      f"python backend :{st.port})")
+            else:
+                st = ServerThread(s3.app, host=args.ip,
+                                  port=args.s3_port).start()
+                threads.append(st)
+                print(f"s3 gateway listening on {st.url}")
     run_apps_forever(threads)
     return 0
 

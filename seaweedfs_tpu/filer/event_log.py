@@ -34,6 +34,14 @@ class MetaEventLog:
         self._subs: dict[int, queue.Queue] = {}
         self._sub_ids = itertools.count()
         self._last_ts_ns = 0
+        # called INSIDE append, under the log lock (which is inside the
+        # filer mutation lock): consumers that must observe mutations
+        # in exact store order with no queue delay — e.g. the native S3
+        # front's read cache, whose staleness window must be zero for
+        # read-after-write consistency. Keep these callbacks tiny and
+        # lock-free; exceptions are swallowed (a cache maintainer must
+        # never fail a filer write).
+        self.sync_listeners: list[Callable[[dict], None]] = []
 
     def append(self, directory: str, old_entry: Entry | None,
                new_entry: Entry | None,
@@ -50,6 +58,11 @@ class MetaEventLog:
             self._buf.append(ev)
             for q in self._subs.values():
                 q.put(ev)
+            for fn in self.sync_listeners:
+                try:
+                    fn(ev)
+                except Exception:
+                    pass
             return ev
 
     def subscribe(self, since_ts_ns: int = 0) -> tuple[int, queue.Queue]:

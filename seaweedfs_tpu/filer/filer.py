@@ -99,6 +99,9 @@ class Filer:
         # here and drained once the locks are released
         self._free_lock = threading.Lock()
         self._free_queue: list[FileChunk] = []
+        # known-directory cache for _ensure_parents (mutation-lock
+        # protected; see _invalidate_dir)
+        self._parent_cache: set[str] = set()
 
     # -- hard links (filerstore_hardlink.go) ----------------------------
     # Linked entries share one content record in the store's KV space:
@@ -200,6 +203,10 @@ class Filer:
         record reference or the shared chunks leak forever. Frees are
         queued — this can run inside a locked mutation's read."""
         self.store.delete_entry(e.full_path)
+        if e.is_directory:
+            # a cached parent that expired must be re-created by the
+            # next write under it
+            self._invalidate_dir(e.full_path)
         if e.hard_link_id and not e.is_directory:
             freed = self._hardlink_unref(e)
             if freed:
@@ -400,15 +407,34 @@ class Filer:
             signatures=signatures)
 
     def _ensure_parents(self, path: str) -> None:
+        # known-directory cache: bulk ingest repeats the same parent
+        # chain for every entry (S3 keys under one bucket), and the
+        # store round trips measured as a third of create_entry's cost.
+        # Only positive knowledge is cached, under the mutation lock;
+        # directory deletes/renames invalidate in _invalidate_dir.
+        cache = self._parent_cache
         parts = path.strip("/").split("/")[:-1]
         cur = ""
         for p in parts:
             cur += "/" + p
+            if cur in cache:
+                continue
             if self.store.find_entry(cur) is None:
                 ent = Entry(full_path=cur, mode=0o775 | DIR_MODE_FLAG)
                 self.store.insert_entry(ent)
                 d, _ = ent.dir_and_name
                 self.meta_log.append(d, None, ent)
+            if len(cache) >= 65536:
+                cache.clear()
+            cache.add(cur)
+
+    def _invalidate_dir(self, path: str) -> None:
+        """Drop `path` and everything under it from the known-directory
+        cache (a deleted dir must be re-created by the next write)."""
+        cache = self._parent_cache
+        sub = path + "/"
+        for p in [p for p in cache if p == path or p.startswith(sub)]:
+            cache.discard(p)
 
     def delete_entry(self, path: str, recursive: bool = False,
                      delete_chunks: bool = True,
@@ -452,6 +478,8 @@ class Filer:
         else:
             dead_chunks.extend(e.chunks)
         self.store.delete_entry(path)
+        if e.is_directory:
+            self._invalidate_dir(path)
         d, _ = e.dir_and_name
         self.meta_log.append(d, e, None, signatures)
         return dead_chunks
@@ -499,6 +527,7 @@ class Filer:
                 d, _ = sub.dir_and_name
                 self.meta_log.append(d, sub, None, signatures)
             self.store.delete_folder_children(old_path)
+            self._invalidate_dir(old_path)
         self.store.delete_entry(old_path)
         d, _ = e.dir_and_name
         self.meta_log.append(d, e, None, signatures)

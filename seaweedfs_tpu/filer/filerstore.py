@@ -61,6 +61,18 @@ class FilerStore:
     def kv_delete(self, key: str) -> None:
         raise NotImplementedError
 
+    # group-commit window (BeginTransaction/CommitTransaction in
+    # filerstore.go, reduced to its durability essence): between begin
+    # and end the store may defer per-write log flushing; end_batch
+    # makes everything since begin durable. Bulk ingest (the native S3
+    # applier) wraps each record batch so N inserts pay ONE flush.
+    # Default: no-op (stores that flush per write are already durable).
+    def begin_batch(self) -> None:
+        pass
+
+    def end_batch(self) -> None:
+        pass
+
     def close(self) -> None:
         pass
 
@@ -336,6 +348,12 @@ class WeedKvStore(FilerStore):
                     json.dumps(entry.to_dict()).encode())
 
     update_entry = insert_entry
+
+    def begin_batch(self) -> None:
+        self.db.defer_flush(True)
+
+    def end_batch(self) -> None:
+        self.db.defer_flush(False)  # flushes the deferred WAL tail
 
     def find_entry(self, path: str) -> Entry | None:
         d, n = _split(path)

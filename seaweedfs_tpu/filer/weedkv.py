@@ -119,9 +119,12 @@ class WeedKV:
                 self._next_seg = max(self._next_seg,
                                      int(name[:-4]) + 1)
         self._wal_path = os.path.join(dirpath, "wal.log")
+        self._flush_local = threading.local()
         self._replay_wal()
         self._mem_keys = sorted(self._mem)
-        self._wal = open(self._wal_path, "a")
+        # binary + buffered: the hot path writes pre-encoded bytes
+        # (a TextIOWrapper re-encodes every record on this path)
+        self._wal = open(self._wal_path, "ab")
 
     # -- WAL ------------------------------------------------------------
     def _replay_wal(self) -> None:
@@ -146,8 +149,23 @@ class WeedKV:
                 f.truncate(good)
 
     def _wal_append(self, key: bytes, value: bytes | None) -> None:
-        self._wal.write(_encode_record(key, value))
-        self._wal.flush()
+        self._wal.write(_encode_record(key, value).encode())
+        if not getattr(self._flush_local, "deferred", False):
+            self._wal.flush()
+
+    def defer_flush(self, deferred: bool) -> None:
+        """Group-commit window for THE CALLING THREAD only: while
+        deferred, its puts skip the per-record WAL flush; turning
+        deferral off flushes the accumulated tail. Thread-local on
+        purpose — other writers sharing the store keep their
+        flush-before-ack durability (their flush also carries any
+        deferred records ahead of them in the sequential WAL, which is
+        harmless over-flushing). The deferring caller must not ack its
+        own batch until the window closes."""
+        self._flush_local.deferred = deferred
+        if not deferred:
+            with self._lock:
+                self._wal.flush()
 
     # -- core ops -------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> None:
@@ -240,7 +258,7 @@ class WeedKV:
             self._mem_keys = []
             self._mem_bytes = 0
             self._wal.close()
-            self._wal = open(self._wal_path, "w")
+            self._wal = open(self._wal_path, "wb")
             if len(self._segments) >= COMPACT_SEGMENT_COUNT:
                 self.compact()
 

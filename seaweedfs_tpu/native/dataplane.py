@@ -96,8 +96,44 @@ def _load() -> ctypes.CDLL:
                                      u8p, i64p, ctypes.c_int64,
                                      ctypes.c_int, i64p, i64p]
         lib.dp_bench_raw.restype = ctypes.c_int64
+        # -- native S3 front ------------------------------------------
+        lib.dp_s3_start.argtypes = [ctypes.c_uint16, ctypes.c_uint16,
+                                    ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_uint16),
+                                    ctypes.c_char_p, ctypes.c_int]
+        lib.dp_s3_start.restype = ctypes.c_int
+        lib.dp_s3_stop.argtypes = []
+        lib.dp_s3_stop.restype = None
+        lib.dp_s3_set_identities.argtypes = [ctypes.c_char_p]
+        lib.dp_s3_set_identities.restype = None
+        lib.dp_s3_set_buckets.argtypes = [ctypes.c_char_p]
+        lib.dp_s3_set_buckets.restype = None
+        lib.dp_s3_push_fids.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.dp_s3_push_fids.restype = ctypes.c_int
+        lib.dp_s3_pool_level.argtypes = [ctypes.c_char_p]
+        lib.dp_s3_pool_level.restype = ctypes.c_int
+        lib.dp_s3_cache_put.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_int64, ctypes.c_char_p,
+                                        ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_int64]
+        lib.dp_s3_cache_put.restype = ctypes.c_int
+        lib.dp_s3_invalidate.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dp_s3_invalidate.restype = None
+        lib.dp_s3_stats.argtypes = [i64p]
+        lib.dp_s3_stats.restype = None
+        lib.dp_md5_hex.argtypes = [u8p, ctypes.c_int64, ctypes.c_char_p]
+        lib.dp_md5_hex.restype = None
         _lib = lib
         return lib
+
+
+def md5_hex(data: bytes) -> str:
+    """Test hook for the in-tree C++ MD5 (the S3 front's ETag hash)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(33)
+    lib.dp_md5_hex(_u8p(data), len(data), out)
+    return out.value.decode()
 
 
 def bench(host: str, port: int, mode: str, fids: list[str],
@@ -377,3 +413,65 @@ class NativeNeedleMap:
 
     def close(self) -> None:
         pass  # lifetime is the attach window; detach owns cleanup
+
+
+class S3Front:
+    """The native S3 gateway front (one per process, combined-server
+    mode): owns the public S3 port, serves SigV4 small-object PUT/GET
+    natively against the LOCAL volume store, and relays everything
+    else to the python S3 app on `backend_port`. Entry metadata flows
+    to the in-process filer over `chan_sock` (a socketpair created by
+    the caller); identities/buckets/fid-pools/cache are pushed through
+    the setters. See the S3-front block in dataplane.cc."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self.port = 0
+
+    def start(self, listen_port: int, backend_port: int, chan_fd: int,
+              workers: int = 2, listen_ip: str = "") -> int:
+        actual = ctypes.c_uint16(0)
+        rc = self._lib.dp_s3_start(listen_port, backend_port, workers,
+                                   ctypes.byref(actual),
+                                   listen_ip.encode(), chan_fd)
+        if rc != 0:
+            raise OSError(-rc, f"dp_s3_start failed: {os.strerror(-rc)}")
+        self.port = int(actual.value)
+        return self.port
+
+    def stop(self) -> None:
+        self._lib.dp_s3_stop()
+
+    def set_identities(self, rows: list[tuple[str, str, str, str, str]]
+                       ) -> None:
+        """rows: (access_key, secret, flags 'AWR', wr_csv, rd_csv)."""
+        tsv = "\n".join("\t".join(r) for r in rows)
+        self._lib.dp_s3_set_identities(tsv.encode())
+
+    def set_buckets(self, buckets: list[str]) -> None:
+        self._lib.dp_s3_set_buckets(",".join(buckets).encode())
+
+    def push_fids(self, bucket: str, fid: str, count: int) -> None:
+        rc = self._lib.dp_s3_push_fids(bucket.encode(), fid.encode(),
+                                       count)
+        if rc != 0:
+            raise ValueError(f"bad fid {fid!r}")
+
+    def pool_level(self, bucket: str) -> int:
+        return int(self._lib.dp_s3_pool_level(bucket.encode()))
+
+    def cache_put(self, path: str, fid: str, size: int, etag: str,
+                  mime: str, meta_block: str, mtime: int) -> None:
+        self._lib.dp_s3_cache_put(path.encode(), fid.encode(), size,
+                                  etag.encode(), mime.encode(),
+                                  meta_block.encode(), mtime)
+
+    def invalidate(self, path: str, prefix: bool = False) -> None:
+        self._lib.dp_s3_invalidate(path.encode(), 1 if prefix else 0)
+
+    def stats(self) -> dict:
+        out = np.zeros(4, np.int64)
+        self._lib.dp_s3_stats(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return {"fast_put": int(out[0]), "fast_get": int(out[1]),
+                "rejected": int(out[2]), "chan_fail": int(out[3])}

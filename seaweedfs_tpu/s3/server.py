@@ -293,8 +293,11 @@ class S3ApiServer:
         return app
 
     async def handle_status(self, req: web.Request) -> web.Response:
-        return web.json_response({"filer": self.filer_url,
-                                  "open": self.iam.is_open})
+        out = {"filer": self.filer_url, "open": self.iam.is_open}
+        front = getattr(self, "_native_front", None)
+        if front is not None:
+            out["native_s3_front"] = front.stats()
+        return web.json_response(out)
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),

@@ -33,6 +33,7 @@ class Cluster:
                  filer_store: str = "memory",
                  filer_cipher: bool = False,
                  with_s3: bool = False,
+                 s3_native: bool = False,
                  s3_config: dict | None = None,
                  tier_backends: dict[str, dict] | None = None,
                  admin_scripts: list[str] | None = None,
@@ -89,10 +90,26 @@ class Cluster:
             self.filer.address = self.filer_thread.address
         self.s3 = None
         self.s3_thread: ServerThread | None = None
+        self.s3_front = None
         if with_s3:
             from ..s3.server import S3ApiServer
             self.s3 = S3ApiServer(self.filer_url, iam_config=s3_config)
             self.s3_thread = ServerThread(self.s3.app).start()
+            if s3_native:
+                # native volume front on server 0 (the S3 front appends
+                # to process-local vols) + the native S3 front owning
+                # the public port, python app demoted to relay backend
+                from ..s3.native_front import NativeS3Front
+
+                backend = self.volume_threads[0]
+                public = self.volume_servers[0].enable_native(
+                    0, backend.port)
+                self.stores[0].port = public
+                self.stores[0].public_url = f"127.0.0.1:{public}"
+                self.s3_front = NativeS3Front(
+                    self.s3, self.filer.filer, self.master_url, 0,
+                    self.s3_thread.port)
+                self.s3._native_front = self.s3_front
         self.broker = None
         self.broker_thread: ServerThread | None = None
         self.wait_for_nodes(n_volume_servers)
@@ -148,6 +165,8 @@ class Cluster:
 
     @property
     def s3_url(self) -> str:
+        if self.s3_front is not None:
+            return f"http://127.0.0.1:{self.s3_front.port}"
         if self.s3_thread is None:
             raise RuntimeError("cluster started without s3")
         return self.s3_thread.url
@@ -155,6 +174,8 @@ class Cluster:
     def stop(self) -> None:
         if self.broker_thread is not None:
             self.broker_thread.stop()
+        if self.s3_front is not None:
+            self.s3_front.stop()
         if self.s3_thread is not None:
             self.s3_thread.stop()
         if self.filer_thread is not None:

@@ -20,13 +20,28 @@ NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 AK, SK = "CONFAK", "CONFSECRET"
 
 
-@pytest.fixture(scope="module")
-def cluster(tmp_path_factory):
+def _native_available():
+    from seaweedfs_tpu.native import dataplane as dpmod
+
+    return dpmod.available()
+
+
+@pytest.fixture(scope="module",
+                params=["python",
+                        pytest.param("native", marks=pytest.mark.skipif(
+                            not _native_available(),
+                            reason="native dataplane unavailable"))])
+def cluster(request, tmp_path_factory):
+    """The whole sweep runs twice: against the pure-python gateway and
+    against the native C++ S3 front (fast paths + relay) — conformance
+    must be indistinguishable between the two."""
     cfg = {"identities": [{"name": "conf", "credentials": [
         {"accessKey": AK, "secretKey": SK}], "actions": ["Admin"]}]}
+    native = request.param == "native"
     c = Cluster(str(tmp_path_factory.mktemp("s3conf")),
-                n_volume_servers=2, volume_size_limit=16 << 20,
-                with_s3=True, s3_config=cfg)
+                n_volume_servers=1 if native else 2,
+                volume_size_limit=16 << 20,
+                with_s3=True, s3_native=native, s3_config=cfg)
     yield c
     c.stop()
 

@@ -1,0 +1,170 @@
+"""Native S3 front (dataplane.cc ROLE_S3 + s3/native_front.py).
+
+The conformance sweep (test_s3_conformance.py) runs identically against
+this front; here we prove the NATIVE paths actually engage (counters),
+that the in-C++ SigV4/MD5 agree with the python implementations, and
+the cache-coherency contract: any mutation path — native PUT, relayed
+python write, delete, rename — leaves reads correct immediately
+(read-after-write, like AWS). Reference:
+s3api_object_handlers_put.go, auth_signature_v4.go.
+"""
+import hashlib
+import time
+
+import pytest
+
+from seaweedfs_tpu.native import dataplane as dpmod
+from seaweedfs_tpu.server.cluster import Cluster
+from tests.s3v4client import S3V4Client
+
+pytestmark = pytest.mark.skipif(not dpmod.available(),
+                                reason="native dataplane unavailable")
+
+AK, SK = "NFAK", "NFSECRET"
+RAK, RSK = "NFRD", "NFRDSECRET"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    cfg = {"identities": [
+        {"name": "admin", "credentials": [
+            {"accessKey": AK, "secretKey": SK}], "actions": ["Admin"]},
+        {"name": "scoped", "credentials": [
+            {"accessKey": RAK, "secretKey": RSK}],
+         "actions": ["Read:nf", "Write:nf"]},
+    ]}
+    c = Cluster(str(tmp_path_factory.mktemp("s3native")),
+                n_volume_servers=1, volume_size_limit=64 << 20,
+                with_s3=True, s3_native=True, s3_config=cfg)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(cluster) -> S3V4Client:
+    c = S3V4Client(cluster.s3_url, AK, SK)
+    assert c.put("/nf").status in (200, 409)
+    # wait for the refill thread to pool fids for the new bucket —
+    # until then PUTs relay (correct, but these tests assert the
+    # native counters move)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if cluster.s3_front.front.pool_level("nf") > 0:
+            break
+        time.sleep(0.05)
+    return c
+
+
+def test_md5_matches_hashlib():
+    """The C++ MD5 (ETag hash) against hashlib across block-boundary
+    sizes (55/56/57 straddle the length-padding edge)."""
+    for n in (0, 1, 55, 56, 57, 63, 64, 65, 1000, 1 << 16):
+        blob = bytes((i * 131 + 7) % 256 for i in range(n))
+        assert dpmod.md5_hex(blob) == hashlib.md5(blob).hexdigest(), n
+
+
+def test_native_put_get_counters(cluster, s3):
+    before = cluster.s3_front.stats()
+    body = b"native front payload" * 10
+    r = s3.put("/nf/counter.bin", body)
+    assert r.status == 200
+    assert r.header("etag") == f'"{hashlib.md5(body).hexdigest()}"'
+    g = s3.get("/nf/counter.bin")
+    assert g.status == 200 and g.body == body
+    after = cluster.s3_front.stats()
+    assert after["fast_put"] == before["fast_put"] + 1
+    assert after["fast_get"] == before["fast_get"] + 1
+    assert after["chan_fail"] == 0
+
+
+def test_meta_roundtrip_native(cluster, s3):
+    r = s3.put("/nf/meta.bin", b"m",
+               headers={"Content-Type": "text/weird",
+                        "x-amz-meta-kind": "native-test",
+                        "x-amz-meta-promo": "50% off + tax"})
+    assert r.status == 200
+    g = s3.get("/nf/meta.bin")
+    assert g.header("content-type") == "text/weird"
+    assert g.header("x-amz-meta-kind") == "native-test"
+    assert g.header("x-amz-meta-promo") == "50% off + tax"
+    h = s3.head("/nf/meta.bin")
+    assert h.status == 200 and h.body == b""
+    assert h.header("x-amz-meta-kind") == "native-test"
+    assert int(h.header("content-length")) == 1
+
+
+def test_overwrite_read_after_write(cluster, s3):
+    for i in range(5):
+        body = f"version {i}".encode()
+        assert s3.put("/nf/rw.bin", body).status == 200
+        g = s3.get("/nf/rw.bin")  # immediately: zero staleness window
+        assert g.body == body, i
+
+
+def test_delete_invalidates_native_cache(cluster, s3):
+    assert s3.put("/nf/gone.bin", b"x").status == 200
+    assert s3.get("/nf/gone.bin").status == 200
+    assert s3.delete("/nf/gone.bin").status == 204  # relayed
+    assert s3.get("/nf/gone.bin").status == 404  # no stale cache hit
+
+
+def test_python_path_write_updates_cache(cluster, s3):
+    """A write through the RELAY path (python filer) must be served
+    correctly by subsequent native GETs — the meta-event listener is
+    the single cache maintainer for every mutation source."""
+    import requests
+
+    # write through the filer HTTP API directly (not the S3 front)
+    url = f"{cluster.filer_url}/buckets/nf/via-python.bin"
+    r = requests.post(url, data=b"python wrote this",
+                      headers={"Content-Type":
+                               "application/octet-stream"})
+    assert r.status_code == 201
+    g = s3.get("/nf/via-python.bin")
+    assert g.status == 200 and g.body == b"python wrote this"
+
+
+def test_tampered_signature_rejected_natively(cluster, s3):
+    before = cluster.s3_front.stats()["rejected"]
+    bad = S3V4Client(cluster.s3_url, AK, "WRONG")
+    r = bad.put("/nf/bad.bin", b"x")
+    assert r.status == 403 and b"SignatureDoesNotMatch" in r.body
+    assert cluster.s3_front.stats()["rejected"] >= before + 1
+    assert s3.get("/nf/bad.bin").status == 404
+
+
+def test_scoped_identity_native(cluster, s3):
+    scoped = S3V4Client(cluster.s3_url, RAK, RSK)
+    assert scoped.put("/nf/scoped.bin", b"ok").status == 200
+    assert scoped.get("/nf/scoped.bin").status == 200
+    # same identity against another bucket: denied (Write:nf only)
+    assert s3.put("/other").status in (200, 409)
+    r = scoped.put("/other/x.bin", b"no")
+    assert r.status == 403 and b"AccessDenied" in r.body
+
+
+def test_pool_dry_relays_correctly(cluster, s3):
+    """An empty fid pool must not fail writes — they relay through the
+    python path and read back fine."""
+    front = cluster.s3_front.front
+    # drain the pool by force: push nothing and consume what's there
+    lvl = front.pool_level("nf")
+    drained = 0
+    while front.pool_level("nf") > 0 and drained < lvl + 10:
+        s3.put(f"/nf/drain-{drained:05d}", b"d")
+        drained += 1
+    assert s3.put("/nf/after-dry.bin", b"still works").status == 200
+    assert s3.get("/nf/after-dry.bin").body == b"still works"
+
+
+def test_rename_through_filer_invalidates(cluster, s3):
+    assert s3.put("/nf/old-name.bin", b"renamed").status == 200
+    assert s3.get("/nf/old-name.bin").status == 200  # cached
+    import requests
+
+    r = requests.put(
+        f"{cluster.filer_url}/buckets/nf/new-name.bin"
+        f"?mv.from=/buckets/nf/old-name.bin")
+    assert r.status_code == 200
+    assert s3.get("/nf/old-name.bin").status == 404
+    assert s3.get("/nf/new-name.bin").body == b"renamed"
