@@ -158,9 +158,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-store", default="memory",
                    help="metadata store: memory | sqlite | leveldb | "
-                        "redis | etcd | mongodb | cassandra | mysql | "
-                        "postgres | elastic | arangodb | hbase | tikv "
-                        "| ydb | rocksdb (needs librocksdb)")
+                        "redis | redis_cluster (seed list in "
+                        "-store.host) | etcd | mongodb | cassandra | "
+                        "mysql | mysql2 | postgres | postgres2 "
+                        "(per-bucket tables, O(1) bucket drop) | "
+                        "elastic | arangodb | hbase | tikv | ydb | "
+                        "rocksdb (needs librocksdb)")
     p.add_argument("-store.path", dest="store_path", default=":memory:")
     p.add_argument("-store.host", dest="store_host", default="")
     p.add_argument("-store.port", dest="store_port", type=int, default=0)

@@ -24,6 +24,7 @@ from . import ydb_store as _ydb_store        # registers ydb (grpc+yql)
 from . import rocksdb_store as _rocksdb_store  # registers rocksdb (C API)
 from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis
+from . import redis_cluster_store as _redis_cluster  # registers redis_cluster
 from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,
                          make_store, register_store)
 from .stream import ChunkStreamReader, read_fid, stream_content

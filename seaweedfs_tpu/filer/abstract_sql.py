@@ -43,13 +43,14 @@ class Dialect:
     create_meta: str
     create_kv: str
     like_escape_clause: str = r" ESCAPE '\'"
+    quote: str = "`"  # identifier quote (backtick mysql, " postgres)
 
 
 MYSQL_DIALECT = Dialect(
     # schema mirrors the reference's scaffold (filer.toml [mysql],
     # mysql/mysql_sql_gen.go:24-49)
     placeholder="%s",
-    create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
+    create_meta="""CREATE TABLE IF NOT EXISTS {table}(
         dirhash BIGINT NOT NULL, name VARCHAR(766) NOT NULL,
         directory TEXT NOT NULL, meta LONGBLOB,
         PRIMARY KEY(dirhash, name))
@@ -57,7 +58,7 @@ MYSQL_DIALECT = Dialect(
     create_kv="""CREATE TABLE IF NOT EXISTS kv(
         k VARCHAR(766) PRIMARY KEY, v LONGBLOB NOT NULL)
         DEFAULT CHARSET=utf8mb4 COLLATE=utf8mb4_bin""",
-    upsert_meta="""INSERT INTO filemeta(dirhash,name,directory,meta)
+    upsert_meta="""INSERT INTO {table}(dirhash,name,directory,meta)
         VALUES(%s,%s,%s,%s)
         ON DUPLICATE KEY UPDATE meta=VALUES(meta)""",
     upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
@@ -67,17 +68,18 @@ MYSQL_DIALECT = Dialect(
 
 POSTGRES_DIALECT = Dialect(
     placeholder="%s",
-    create_meta="""CREATE TABLE IF NOT EXISTS filemeta(
+    create_meta="""CREATE TABLE IF NOT EXISTS {table}(
         dirhash BIGINT NOT NULL, name TEXT NOT NULL,
         directory TEXT NOT NULL, meta BYTEA,
         PRIMARY KEY(dirhash, name))""",
     create_kv="""CREATE TABLE IF NOT EXISTS kv(
         k TEXT PRIMARY KEY, v BYTEA NOT NULL)""",
-    upsert_meta="""INSERT INTO filemeta(dirhash,name,directory,meta)
+    upsert_meta="""INSERT INTO {table}(dirhash,name,directory,meta)
         VALUES(%s,%s,%s,%s)
         ON CONFLICT(dirhash,name) DO UPDATE SET meta=EXCLUDED.meta""",
     upsert_kv="""INSERT INTO kv(k,v) VALUES(%s,%s)
         ON CONFLICT(k) DO UPDATE SET v=EXCLUDED.v""",
+    quote='"',
 )
 
 
@@ -99,12 +101,69 @@ class AbstractSqlStore(FilerStore):
     # set by subclasses to their wire client's error class
     server_errors: tuple = ()
 
-    def __init__(self, conn, dialect: Dialect):
+    BUCKETS_DIR = "/buckets"
+
+    def __init__(self, conn, dialect: Dialect, bucket_tables: bool = False):
         self._conn = conn
         self._d = dialect
         self._lock = threading.RLock()
-        self._exec(dialect.create_meta)
+        # mysql2/postgres2 layout (mysql2_store.go:60,88): entries
+        # under /buckets/<bucket>/ live in a per-bucket table, so
+        # deleting a bucket is one DROP TABLE instead of a scan of
+        # every row. Tables are created lazily on first touch (the
+        # reference creates them on the bucket-creation event;
+        # CREATE IF NOT EXISTS makes both orders correct) and cached.
+        self._bucket_tables = bucket_tables
+        self._known_tables: set[str] = set()
+        self._exec(dialect.create_meta.format(table="filemeta"))
         self._exec(dialect.create_kv)
+        self._known_tables.add("filemeta")
+
+    def _table_for(self, directory: str, create: bool = False) -> str:
+        """The quoted table holding entries of `directory`. Default
+        layout: always filemeta. Bucket layout: /buckets/<b>/... maps
+        to table bucket_<b> (the bucket DIR ENTRY itself lives in
+        /buckets, i.e. the default table). Tables are created only on
+        WRITE paths (create=True) — reads on never-written buckets
+        must not run DDL (unauthenticated probes would grow the
+        catalog unboundedly, and a read racing a bucket drop could
+        resurrect the dropped table)."""
+        if self._bucket_tables and \
+                directory.startswith(self.BUCKETS_DIR + "/"):
+            bucket = directory[len(self.BUCKETS_DIR) + 1:].split("/")[0]
+            table = self._bucket_table(bucket)
+            if create and table not in self._known_tables:
+                self._exec(self._d.create_meta.format(table=table))
+                self._known_tables.add(table)
+            return table
+        return "filemeta"
+
+    _BUCKET_NAME_OK = frozenset(
+        "abcdefghijklmnopqrstuvwxyz"
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+    def _bucket_table(self, bucket: str) -> str:
+        # strict charset: the name lands inside a quoted SQL
+        # identifier AND the drivers' printf-style parameter
+        # substitution ('%' would shift every placeholder)
+        if not bucket or any(c not in self._BUCKET_NAME_OK
+                             for c in bucket):
+            raise ValueError(f"invalid bucket name {bucket!r}")
+        q = self._d.quote
+        return f"{q}bucket_{bucket}{q}"
+
+    def _read(self, table: str, sql: str, args: tuple) -> list:
+        """Execute a read/point-delete against a possibly-nonexistent
+        bucket table: a server error on a table THIS process never
+        created reads as 'no such table' -> empty (the bucket was
+        never written or was dropped); errors on known tables are
+        real and re-raised."""
+        try:
+            return self._exec(sql, args)
+        except self.server_errors:
+            if table != "filemeta" and table not in self._known_tables:
+                return []
+            raise
 
     def _connect(self):
         """Build a replacement connection after a transport failure;
@@ -139,7 +198,8 @@ class AbstractSqlStore(FilerStore):
     def insert_entry(self, entry: Entry) -> None:
         d, n = entry.dir_and_name
         d = _norm(d)
-        self._exec(self._d.upsert_meta,
+        table = self._table_for(d, create=True)
+        self._exec(self._d.upsert_meta.format(table=table),
                    (dir_hash(d), n, d,
                     json.dumps(entry.to_dict()).encode()))
 
@@ -150,29 +210,55 @@ class AbstractSqlStore(FilerStore):
         if not n:
             return None
         ph = self._d.placeholder
-        rows = self._exec(
-            f"SELECT meta FROM filemeta WHERE dirhash={ph} AND "
-            f"name={ph} AND directory={ph}", (dir_hash(d), n, d))
+        table = self._table_for(d)
+        rows = self._read(
+            table,
+            f"SELECT meta FROM {table} WHERE dirhash={ph} "
+            f"AND name={ph} AND directory={ph}", (dir_hash(d), n, d))
         return Entry.from_dict(json.loads(rows[0][0])) if rows else None
 
     def delete_entry(self, path: str) -> None:
         d, n = _split(path)
         ph = self._d.placeholder
-        self._exec(
-            f"DELETE FROM filemeta WHERE dirhash={ph} AND name={ph} "
-            f"AND directory={ph}", (dir_hash(d), n, d))
+        table = self._table_for(d)
+        self._read(
+            table,
+            f"DELETE FROM {table} WHERE dirhash={ph} AND "
+            f"name={ph} AND directory={ph}", (dir_hash(d), n, d))
 
     def delete_folder_children(self, path: str) -> None:
         path = _norm(path)
+        if self._bucket_tables and \
+                path.startswith(self.BUCKETS_DIR + "/"):
+            rel = path[len(self.BUCKETS_DIR) + 1:]
+            if "/" not in rel:
+                # the whole bucket: one DROP TABLE reclaims everything
+                # (mysql2_store.go:88 OnBucketDeletion) — the O(1)
+                # delete this layout exists for
+                table = self._bucket_table(rel)
+                self._exec(f"DROP TABLE IF EXISTS {table}")
+                self._known_tables.discard(table)
+                return
+        if self._bucket_tables and path in ("/", self.BUCKETS_DIR):
+            # the subtree spans every bucket table: drop the ones this
+            # process knows about (tables created by other processes
+            # need their own bucket-level deletes, same multi-writer
+            # caveat as the reference's event-driven table lifecycle)
+            for table in list(self._known_tables - {"filemeta"}):
+                self._exec(f"DROP TABLE IF EXISTS {table}")
+                self._known_tables.discard(table)
         like = _like_escape(
             path if path.endswith("/") else path + "/") + "%"
         ph = self._d.placeholder
         # whole-subtree delete (the directory LIKE arm walks nested
         # dirs; the reference deletes one level and recurses in the
         # filer — same end state, fewer round trips here)
-        self._exec(
-            f"DELETE FROM filemeta WHERE directory={ph} OR directory "
-            f"LIKE {ph}{self._d.like_escape_clause}", (path, like))
+        table = self._table_for(path)
+        self._read(
+            table,
+            f"DELETE FROM {table} WHERE directory={ph} "
+            f"OR directory LIKE {ph}{self._d.like_escape_clause}",
+            (path, like))
 
     def list_directory_entries(self, dirpath: str, start_from: str = "",
                                inclusive: bool = False,
@@ -181,8 +267,9 @@ class AbstractSqlStore(FilerStore):
         dirpath = _norm(dirpath)
         ph = self._d.placeholder
         cmp = ">=" if inclusive else ">"
-        q = (f"SELECT meta FROM filemeta WHERE dirhash={ph} AND "
-             f"directory={ph}")
+        table = self._table_for(dirpath)
+        q = (f"SELECT meta FROM {table} WHERE "
+             f"dirhash={ph} AND directory={ph}")
         args: list = [dir_hash(dirpath), dirpath]
         if start_from:
             q += f" AND name {cmp} {ph}"
@@ -192,7 +279,7 @@ class AbstractSqlStore(FilerStore):
             args.append(_like_escape(prefix) + "%")
         q += f" ORDER BY name LIMIT {ph}"
         args.append(limit)
-        rows = self._exec(q, tuple(args))
+        rows = self._read(table, q, tuple(args))
         return [Entry.from_dict(json.loads(r[0])) for r in rows]
 
     def kv_put(self, key: str, value: bytes) -> None:
@@ -220,6 +307,8 @@ class MysqlStore(AbstractSqlStore):
     mysql_native_password + COM_QUERY text protocol), so the mysql
     dialect is a first-class store, not SDK-gated."""
 
+    bucket_tables = False
+
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  user: str = "root", password: str = "",
                  database: str = "seaweedfs", **_):
@@ -227,7 +316,8 @@ class MysqlStore(AbstractSqlStore):
 
         self._args = (host, int(port), user, password, database)
         self.server_errors = (MysqlError,)
-        super().__init__(self._connect(), MYSQL_DIALECT)
+        super().__init__(self._connect(), MYSQL_DIALECT,
+                         bucket_tables=self.bucket_tables)
 
     def _connect(self):
         from .mysql_lite import MysqlConnection
@@ -237,12 +327,25 @@ class MysqlStore(AbstractSqlStore):
                                database=database)
 
 
+@register_store("mysql2")
+class Mysql2Store(MysqlStore):
+    """weed/filer/mysql2 equivalent
+    (/root/reference/weed/filer/mysql2/mysql2_store.go:60,88): the
+    same wire and schema, but entries under /buckets/<bucket>/ live in
+    a table per bucket, so dropping a bucket is one DROP TABLE instead
+    of a row scan."""
+
+    bucket_tables = True
+
+
 @register_store("postgres")
 class PostgresStore(AbstractSqlStore):
     """weed/filer/postgres equivalent
     (/root/reference/weed/filer/postgres/postgres_store.go:14). The
     driver is the in-tree wire client (pg_lite.py: StartupMessage,
     cleartext/md5 auth, simple Query protocol, bytea hex codec)."""
+
+    bucket_tables = False
 
     def __init__(self, host: str = "127.0.0.1", port: int = 5432,
                  user: str = "postgres", password: str = "",
@@ -251,7 +354,8 @@ class PostgresStore(AbstractSqlStore):
 
         self._args = (host, int(port), user, password, database)
         self.server_errors = (PgError,)
-        super().__init__(self._connect(), POSTGRES_DIALECT)
+        super().__init__(self._connect(), POSTGRES_DIALECT,
+                         bucket_tables=self.bucket_tables)
 
     def _connect(self):
         from .pg_lite import PgConnection
@@ -259,3 +363,13 @@ class PostgresStore(AbstractSqlStore):
         host, port, user, password, database = self._args
         return PgConnection(host, port, user=user, password=password,
                             database=database)
+
+
+@register_store("postgres2")
+class Postgres2Store(PostgresStore):
+    """weed/filer/postgres2 equivalent
+    (/root/reference/weed/filer/postgres2/postgres2_store.go): the
+    per-bucket-table layout over the postgres wire — bucket deletion
+    is one DROP TABLE."""
+
+    bucket_tables = True

@@ -93,6 +93,30 @@ class RespClient:
                 [self._read_reply() for _ in range(n)]
         raise RespError(f"bad reply type {line!r}")
 
+    def pipeline(self, cmds: list[tuple]) -> list:
+        """Send every command in one write, read every reply in order.
+        Error replies come back as RespError VALUES (not raised) so
+        one redirected key doesn't mask the rest of the batch — the
+        cluster client retries those individually."""
+        out = bytearray()
+        for args in cmds:
+            out += f"*{len(args)}\r\n".encode()
+            for a in args:
+                b = a if isinstance(a, bytes) else str(a).encode()
+                out += f"${len(b)}\r\n".encode() + b + b"\r\n"
+        with self._lock:
+            self._sock.sendall(out)
+            replies = []
+            for _ in cmds:
+                try:
+                    replies.append(self._read_reply())
+                except RespError as e:
+                    replies.append(e)
+            return replies
+
+    def mget(self, keys: list[str]) -> list:
+        return self.cmd("MGET", *keys) or []
+
 
 @register_store("redis")
 class RedisStore(FilerStore):
@@ -161,8 +185,7 @@ class RedisStore(FilerStore):
         # not redis (whose sorted sets are already skiplists; the
         # reference's redis3 chunked ItemList solves a cluster-slot
         # concern this single-keyspace store doesn't have)
-        raws = self._r.cmd("MGET",
-                           *[f"{base}/{n}" for n in wanted]) or []
+        raws = self._r.mget([f"{base}/{n}" for n in wanted])
         out: list[Entry] = []
         for raw in raws:
             if raw is not None:

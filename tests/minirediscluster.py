@@ -1,0 +1,119 @@
+"""In-process mini Redis Cluster: N MiniRedis nodes + slot ownership,
+-MOVED / -ASK redirects, CLUSTER SLOTS, and live slot migration — the
+test double for the redis_cluster filer store (the same spirit as the
+reference's docker-compose redis cluster, minus the containers)."""
+from __future__ import annotations
+
+import threading
+
+from seaweedfs_tpu.filer.redis_cluster_store import SLOTS, key_slot
+from tests.miniredis import MiniRedis
+
+_KEYED = {b"SET": 1, b"GET": 1, b"DEL": 1, b"ZADD": 1, b"ZREM": 1,
+          b"ZRANGE": 1, b"ZRANGEBYLEX": 1, b"MGET": 1}
+
+
+class _ClusterNode(MiniRedis):
+    def __init__(self, cluster: "MiniRedisCluster", index: int):
+        self.cluster = cluster
+        self.index = index
+        self._asking = threading.local()
+        super().__init__()
+
+    def _dispatch(self, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        if cmd == b"CLUSTER" and len(args) > 1 \
+                and args[1].upper() == b"SLOTS":
+            return self.cluster.slots_reply()
+        if cmd == b"ASKING":
+            self._asking.flag = True
+            return b"+OK\r\n"
+        ki = _KEYED.get(cmd)
+        if ki is not None and len(args) > ki:
+            slot = key_slot(args[ki])
+            owner = self.cluster.owner[slot]
+            asking = getattr(self._asking, "flag", False)
+            self._asking.flag = False
+            if owner != self.index and not (
+                    asking and self.cluster.importing.get(slot)
+                    == self.index):
+                port = self.cluster.nodes[owner].port
+                self.cluster.redirects += 1
+                if self.cluster.migrating.get(slot) == owner:
+                    return b"-ASK %d 127.0.0.1:%d\r\n" % (slot, port)
+                return b"-MOVED %d 127.0.0.1:%d\r\n" % (slot, port)
+        return super()._dispatch(args)
+
+
+class MiniRedisCluster:
+    def __init__(self, n: int = 3):
+        self.nodes: list[_ClusterNode] = []
+        self.owner = [0] * SLOTS
+        # slot -> node index that answers ASK during a migration window
+        self.migrating: dict[int, int] = {}
+        self.importing: dict[int, int] = {}
+        self.redirects = 0
+        for i in range(n):
+            self.nodes.append(_ClusterNode(self, i))
+        per = SLOTS // n
+        for s in range(SLOTS):
+            self.owner[s] = min(s // per, n - 1)
+
+    @property
+    def seeds(self) -> str:
+        return ",".join(f"127.0.0.1:{nd.port}" for nd in self.nodes)
+
+    def slots_reply(self) -> bytes:
+        # contiguous runs of the owner array -> CLUSTER SLOTS rows
+        rows = []
+        start = 0
+        for s in range(1, SLOTS + 1):
+            if s == SLOTS or self.owner[s] != self.owner[start]:
+                nd = self.nodes[self.owner[start]]
+                rows.append(
+                    b"*3\r\n:%d\r\n:%d\r\n*2\r\n$9\r\n127.0.0.1\r\n"
+                    b":%d\r\n" % (start, s - 1, nd.port))
+                start = s
+        return b"*%d\r\n%s" % (len(rows), b"".join(rows))
+
+    def migrate(self, lo: int, hi: int, dst: int) -> None:
+        """Move slots [lo, hi] to node `dst`, copying the backing data
+        — afterwards the old owners answer -MOVED (stale-map clients
+        must refresh and follow)."""
+        dstn = self.nodes[dst]
+        for src in {self.owner[s] for s in range(lo, hi + 1)}:
+            if src == dst:
+                continue
+            srcn = self.nodes[src]
+            with srcn.lock:
+                move_kv = [k for k in srcn.kv
+                           if lo <= key_slot(k) <= hi]
+                move_z = [k for k in srcn.zsets
+                          if lo <= key_slot(k) <= hi]
+                moved_kv = {k: srcn.kv.pop(k) for k in move_kv}
+                moved_z = {k: srcn.zsets.pop(k) for k in move_z}
+            with dstn.lock:
+                for k, v in moved_kv.items():
+                    # writes that landed on dst during an ASK window
+                    # are NEWER than the source's leftovers
+                    dstn.kv.setdefault(k, v)
+                for k, z in moved_z.items():
+                    dstn.zsets.setdefault(k, set()).update(z)
+        for s in range(lo, hi + 1):
+            self.owner[s] = dst
+
+    def start_ask_window(self, slot: int, dst: int) -> None:
+        """Mark `slot` as mid-migration: the current owner answers
+        -ASK (one-shot redirect, no map refresh) and `dst` accepts the
+        key only behind ASKING."""
+        self.migrating[slot] = self.owner[slot]
+        self.importing[slot] = dst
+
+    def end_ask_window(self, slot: int, dst: int) -> None:
+        self.migrating.pop(slot, None)
+        self.importing.pop(slot, None)
+        self.migrate(slot, slot, dst)
+
+    def close(self) -> None:
+        for nd in self.nodes:
+            nd.close()

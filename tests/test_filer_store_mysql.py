@@ -166,3 +166,73 @@ def test_large_packet_continuation(mysqld, store):
     store.kv_put("big", blob)
     assert store.kv_get("big") == blob
     store.kv_delete("big")
+
+
+# -- mysql2: per-bucket tables (mysql2_store.go:60,88) -----------------
+
+@pytest.fixture()
+def store2(mysqld):
+    from seaweedfs_tpu.filer.abstract_sql import Mysql2Store
+
+    with mysqld.lock:
+        for (name,) in mysqld.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'"
+        ).fetchall():
+            mysqld.db.execute(f"DROP TABLE IF EXISTS `{name}`")
+    s = Mysql2Store(port=mysqld.port, user="weed", password="s3cret",
+                    database="")
+    yield s
+    s.close()
+
+
+def test_mysql2_bucket_rows_land_in_bucket_table(mysqld, store2):
+    store2.insert_entry(ent("/buckets/photos/a.jpg", size=1))
+    store2.insert_entry(ent("/buckets/photos/sub/b.jpg", size=1))
+    store2.insert_entry(ent("/topics/other.txt", size=1))
+    with mysqld.lock:
+        tables = {r[0] for r in mysqld.db.execute(
+            "SELECT name FROM sqlite_master WHERE type='table'")}
+        n_bucket = mysqld.db.execute(
+            "SELECT COUNT(*) FROM `bucket_photos`").fetchone()[0]
+        n_default = mysqld.db.execute(
+            "SELECT COUNT(*) FROM filemeta").fetchone()[0]
+    assert "bucket_photos" in tables
+    assert n_bucket == 2          # both bucket entries, nested incl.
+    assert n_default == 1         # the non-bucket entry only
+    # reads route to the right table
+    assert store2.find_entry("/buckets/photos/a.jpg") is not None
+    assert store2.find_entry("/topics/other.txt") is not None
+    names = [e.name for e in
+             store2.list_directory_entries("/buckets/photos")]
+    assert names == ["a.jpg"]
+
+
+def test_mysql2_bucket_delete_is_drop_table(mysqld, store2):
+    for i in range(50):
+        store2.insert_entry(ent(f"/buckets/big/k{i:03d}", size=1))
+    q_before = len(mysqld.queries)
+    store2.delete_folder_children("/buckets/big")
+    drops = [q for q in mysqld.queries[q_before:]
+             if q.upper().startswith("DROP TABLE")]
+    assert drops, "bucket delete must be DROP TABLE, not a row scan"
+    assert store2.find_entry("/buckets/big/k000") is None
+    # the bucket can be recreated afterwards (lazy table recreation)
+    store2.insert_entry(ent("/buckets/big/reborn", size=1))
+    assert store2.find_entry("/buckets/big/reborn") is not None
+
+
+def test_mysql2_fresh_store_sees_existing_bucket_tables(mysqld, store2):
+    from seaweedfs_tpu.filer.abstract_sql import Mysql2Store
+
+    store2.insert_entry(ent("/buckets/persist/x", size=1))
+    again = Mysql2Store(port=mysqld.port, user="weed",
+                        password="s3cret", database="")
+    try:
+        assert again.find_entry("/buckets/persist/x") is not None
+    finally:
+        again.close()
+
+
+def test_mysql2_quote_injection_rejected(store2):
+    with pytest.raises(ValueError):
+        store2.insert_entry(ent("/buckets/evil`name/x", size=1))

@@ -113,3 +113,33 @@ def test_full_filer_stack(pg):
         assert f.find_entry("/docs/readme.md") is None
     finally:
         f.close()
+
+
+# -- postgres2: per-bucket tables (postgres2_store.go) -----------------
+
+def test_postgres2_bucket_tables_and_drop(pg):
+    from seaweedfs_tpu.filer.abstract_sql import Postgres2Store
+
+    with pg.lock:
+        for (name,) in pg.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'"
+        ).fetchall():
+            pg.db.execute(f'DROP TABLE IF EXISTS "{name}"')
+    s = Postgres2Store(port=pg.port, user="weed", password="s3cret",
+                       database="weeddb")
+    try:
+        s.insert_entry(ent("/buckets/pics/a.png", size=1))
+        s.insert_entry(ent("/outside.txt", size=1))
+        with pg.lock:
+            tables = {r[0] for r in pg.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'")}
+        assert "bucket_pics" in tables
+        assert s.find_entry("/buckets/pics/a.png") is not None
+        s.delete_folder_children("/buckets/pics")
+        with pg.lock:
+            tables = {r[0] for r in pg.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'")}
+        assert "bucket_pics" not in tables  # dropped, not scanned
+        assert s.find_entry("/outside.txt") is not None
+    finally:
+        s.close()
