@@ -1,0 +1,279 @@
+"""Race/recovery hardening for the round-5 native surfaces.
+
+1. S3 front cache coherency under CONCURRENT mixed-path mutations:
+   native PUTs racing python-path overwrites and deletes of the same
+   keys must never serve stale or torn reads (the sync meta-listener
+   contract of s3/native_front.py).
+2. SWRP replica-channel recovery: a peer volume server killed and
+   RESTARTED mid-load — the fan-out must fail loudly while the peer
+   is down, then return to the native path (fresh connection, fresh
+   upgrade handshake) once the control plane re-pushes peers.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.native import dataplane as dpmod
+from seaweedfs_tpu.server.cluster import Cluster
+from tests.s3v4client import S3V4Client
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not dpmod.available(), reason="no g++ / prebuilt dataplane library")
+
+AK, SK = "RACEAK", "RACESECRET"
+
+
+def test_s3_front_concurrent_mixed_path_mutations(tmp_path):
+    cfg = {"identities": [{"name": "race", "credentials": [
+        {"accessKey": AK, "secretKey": SK}], "actions": ["Admin"]}]}
+    c = Cluster(str(tmp_path), n_volume_servers=1,
+                volume_size_limit=64 << 20, with_s3=True,
+                s3_native=True, s3_config=cfg)
+    try:
+        s3 = S3V4Client(c.s3_url, AK, SK)
+        assert s3.put("/race").status in (200, 409)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                c.s3_front.front.pool_level("race") == 0:
+            time.sleep(0.05)
+        assert c.s3_front.front.pool_level("race") > 0, \
+            "fid pool never filled — warm-up/setup problem, not a race"
+        errors: list[str] = []
+        stop = threading.Event()
+        KEYS = 8
+
+        def s3_writer(tid):
+            cli = S3V4Client(c.s3_url, AK, SK)
+            i = 0
+            while not stop.is_set():
+                k = i % KEYS
+                body = f"s3-{tid}-{i}".encode()
+                try:
+                    r = cli.put(f"/race/k{k}", body)
+                except Exception as e:  # a dead thread = vacuous pass
+                    errors.append(f"put exc {e!r}")
+                    return
+                if r.status != 200:
+                    errors.append(f"put {r.status}")
+                i += 1
+
+        def filer_mutator():
+            # overwrites + deletes through the PYTHON filer path: the
+            # meta listener is the only thing keeping the C++ cache
+            # honest about these. Statuses are CHECKED — if this arm
+            # silently 4xx'd, the test would stress nothing
+            sess = requests.Session()
+            i = 0
+            while not stop.is_set():
+                k = i % KEYS
+                try:
+                    if i % 3 == 2:
+                        r = sess.delete(
+                            f"{c.filer_url}/buckets/race/k{k}",
+                            timeout=20)
+                        if r.status_code not in (200, 204, 404):
+                            errors.append(
+                                f"filer delete {r.status_code}")
+                    else:
+                        r = sess.post(
+                            f"{c.filer_url}/buckets/race/k{k}",
+                            data=f"py-{i}".encode(),
+                            headers={"Content-Type":
+                                     "application/octet-stream"},
+                            timeout=20)
+                        if r.status_code != 201:
+                            errors.append(f"filer post {r.status_code}")
+                except Exception as e:
+                    errors.append(f"filer exc {e!r}")
+                    return
+                i += 1
+
+        def reader():
+            cli = S3V4Client(c.s3_url, AK, SK)
+            while not stop.is_set():
+                k = int(time.time() * 997) % KEYS
+                try:
+                    r = cli.get(f"/race/k{k}")
+                except Exception as e:
+                    errors.append(f"get exc {e!r}")
+                    return
+                if r.status == 200:
+                    body = r.body
+                    # every observable value must be a COMPLETE write
+                    # from one of the two paths — torn/garbage bytes
+                    # mean the cache served something no writer wrote
+                    if not (body.startswith(b"s3-")
+                            or body.startswith(b"py-")):
+                        errors.append(f"torn read: {body[:40]!r}")
+                elif r.status != 404:
+                    errors.append(f"get {r.status}")
+
+        threads = [threading.Thread(target=s3_writer, args=(t,))
+                   for t in range(2)]
+        threads += [threading.Thread(target=filer_mutator),
+                    threading.Thread(target=reader),
+                    threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        time.sleep(6)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+        assert not errors, errors[:5]
+        # quiesce, then FINAL COHERENCY: for every key the native GET
+        # must agree byte-for-byte with the filer (the store of record)
+        time.sleep(0.5)
+        for k in range(KEYS):
+            f = requests.get(f"{c.filer_url}/buckets/race/k{k}")
+            g = s3.get(f"/race/k{k}")
+            if f.status_code == 404:
+                assert g.status == 404, f"stale cache hit on k{k}"
+            else:
+                assert g.status == 200 and g.body == f.content, \
+                    f"k{k}: cache {g.body[:30]!r} != filer " \
+                    f"{f.content[:30]!r}"
+        st = c.s3_front.stats()
+        assert st["fast_put"] > 0 and st["fast_get"] > 0
+        assert st["chan_fail"] == 0
+    finally:
+        c.stop()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(url, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            requests.get(url, timeout=1)
+            return
+        except requests.RequestException:
+            time.sleep(0.15)
+    raise TimeoutError(url)
+
+
+def test_swrp_peer_restart_recovers_native_path(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = []
+
+    def spawn(*argv):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *argv], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        procs.append(p)
+        return p
+
+    try:
+        mport, v1, v2 = _free_port(), _free_port(), _free_port()
+        master = f"http://127.0.0.1:{mport}"
+        (tmp_path / "v1").mkdir()
+        (tmp_path / "v2").mkdir()
+        spawn("master", "-port", str(mport), "-volumeSizeLimitMB", "64",
+              "-defaultReplication", "001")
+        _wait_http(f"{master}/cluster/status")
+        spawn("volume", "-port", str(v1), "-dir", str(tmp_path / "v1"),
+              "-mserver", f"127.0.0.1:{mport}", "-dataplane", "native")
+        peer = spawn("volume", "-port", str(v2), "-dir",
+                     str(tmp_path / "v2"),
+                     "-mserver", f"127.0.0.1:{mport}",
+                     "-dataplane", "native")
+        _wait_http(f"http://127.0.0.1:{v1}/status")
+        _wait_http(f"http://127.0.0.1:{v2}/status")
+
+        def stats(port):
+            return requests.get(f"http://127.0.0.1:{port}/status",
+                                timeout=5).json()["native_dataplane"]
+
+        def write_one(payload):
+            a = requests.get(f"{master}/dir/assign?replication=001",
+                             timeout=5).json()
+            if "fid" not in a:
+                return None, None  # master refused (peer fenced)
+            try:
+                r = requests.post(f"http://{a['url']}/{a['fid']}",
+                                  data=payload, timeout=10)
+            except requests.RequestException:
+                return a, None  # primary itself unreachable
+            return a, r
+
+        # phase 1: wait until the native fan-out engages (SWRP upgrade)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            write_one(b"warm")
+            if stats(v1)["repl_post"] + stats(v2)["repl_post"] > 0:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("native fan-out never engaged")
+
+        # phase 2: kill the peer hard mid-load. The contract: NO write
+        # may be acked 2xx while its replica target is down — every
+        # outcome must be loud (5xx from the primary's failed fan-out,
+        # an unreachable primary, or the master fencing the dead node
+        # and refusing the assign). Which one depends on how fast the
+        # heartbeat notices; all are correct, a 201 is never.
+        peer.kill()
+        peer.wait(timeout=10)
+        time.sleep(0.3)
+        outcomes = set()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            a, r = write_one(b"doomed")
+            if a is None:
+                outcomes.add("assign-refused")
+            elif r is None:
+                outcomes.add("primary-unreachable")
+            elif r.status_code >= 500:
+                outcomes.add("fanout-5xx")
+            else:
+                assert r.status_code != 201, \
+                    "write acked 201 with its replica peer dead"
+            time.sleep(0.2)
+        assert outcomes, "no writes attempted while the peer was down"
+
+        # phase 3: restart the peer on the SAME port+dir; the channel
+        # must renegotiate (fresh conn + fresh .swrp upgrade) and the
+        # native path must take over again
+        spawn("volume", "-port", str(v2), "-dir", str(tmp_path / "v2"),
+              "-mserver", f"127.0.0.1:{mport}", "-dataplane", "native")
+        _wait_http(f"http://127.0.0.1:{v2}/status")
+        base = stats(v1)["repl_post"] + stats(v2)["repl_post"]
+        recovered = None
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            a, r = write_one(b"recovered-bytes")
+            if r is not None and r.status_code == 201 and \
+                    stats(v1)["repl_post"] + stats(v2)["repl_post"] > base:
+                recovered = a
+                break
+            time.sleep(0.3)
+        assert recovered, "native fan-out never re-engaged after restart"
+        # both copies of the post-recovery write are readable
+        for port in (v1, v2):
+            g = requests.get(f"http://127.0.0.1:{port}/{recovered['fid']}",
+                             timeout=5)
+            assert g.status_code == 200 and g.content == b"recovered-bytes"
+    finally:
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in reversed(procs):
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
