@@ -1088,6 +1088,58 @@ bool pairs_to_headers(const char* js, size_t n, std::string* out) {
   }
 }
 
+// Parse a single "bytes=..." Range spec against `size` bytes — the
+// ONE range parser for the volume and S3 fast paths (python
+// _read_fid:494-512 semantics: unknown units are ignored, huge
+// numbers SATURATE like python's unbounded ints and then the bounds
+// rules decide, a missing dash means an open end, multi-range and
+// non-numeric specs are malformed). Returns 0 = serve full (no/
+// ignored range), 1 = partial (start/end set), -1 = malformed,
+// -2 = unsatisfiable.
+int parse_byte_range(const char* range, size_t range_len, int64_t size,
+                     int64_t* start, int64_t* end) {
+  if (!range) return 0;
+  if (range_len <= 6 || memcmp(range, "bytes=", 6) != 0)
+    return 0;  // unknown unit: ignored per RFC 7233
+  const char* spec = range + 6;
+  size_t spec_len = range_len - 6;
+  const char* dash = (const char*)memchr(spec, '-', spec_len);
+  const char* s_end = dash ? dash : spec + spec_len;
+  const char* e_begin = dash ? dash + 1 : spec + spec_len;
+  auto parse_num = [](const char* p, const char* e, int64_t* out) {
+    if (p == e) return false;
+    int64_t v = 0;
+    for (; p < e; p++) {
+      if (*p < '0' || *p > '9') return false;  // incl. ',' multi-range
+      // saturate instead of overflowing: python ints are unbounded,
+      // and a wrapped-negative start once slipped past the bounds
+      // checks into an out-of-bounds buffer read
+      if (v > (INT64_MAX - 9) / 10)
+        v = INT64_MAX;
+      else
+        v = v * 10 + (*p - '0');
+    }
+    *out = v;
+    return true;
+  };
+  *start = 0;
+  *end = size - 1;
+  bool ok;
+  if (s_end == spec) {  // suffix form bytes=-N: the LAST N bytes
+    int64_t n_last = 0;
+    ok = parse_num(e_begin, spec + spec_len, &n_last);
+    if (ok) *start = std::max<int64_t>(0, size - n_last);
+  } else {
+    ok = parse_num(spec, s_end, start);
+    if (ok && e_begin < spec + spec_len)
+      ok = parse_num(e_begin, spec + spec_len, end);
+  }
+  if (!ok) return -1;
+  *end = std::min<int64_t>(*end, size - 1);
+  if (*start > *end || *start >= size) return -2;
+  return 1;
+}
+
 // GET/HEAD fast path. Returns false when the request must be proxied.
 bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
                 uint32_t cookie, bool is_head) {
@@ -1187,50 +1239,19 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
     simple_response(c, 500, "CRC error: data on disk corrupted", r.keep_alive);
     return true;
   }
-  // single-range GET (handlers_read.go writeResponseContent; python
-  // _read_fid:494-512): bytes=a-b / a- / -n. Anything unparsable or
+  // single-range GET (handlers_read.go writeResponseContent): one
+  // shared parser (parse_byte_range above); anything malformed or
   // unsatisfiable is 416 exactly like the python path.
   int64_t start_i = 0, end_i = (int64_t)data_size - 1;
   bool partial = false;
-  // a Range header that doesn't start with "bytes=" is IGNORED (full
-  // 200), matching python's `rng.startswith("bytes=")` gate and RFC
-  // 7233's unknown-unit rule; only bytes= specs that fail to parse or
-  // are unsatisfiable get 416
-  if (r.range && !is_head && r.range_len > 6 &&
-      memcmp(r.range, "bytes=", 6) == 0) {
-    const char* spec = r.range + 6;
-    size_t spec_len = r.range_len - 6;
-    // python: s, _, e = spec.partition("-") — a missing dash means an
-    // empty end (open range), not a malformed one
-    const char* dash = (const char*)memchr(spec, '-', spec_len);
-    const char* s_end = dash ? dash : spec + spec_len;
-    const char* e_begin = dash ? dash + 1 : spec + spec_len;
-    auto parse_num = [](const char* p, const char* e, int64_t* out) {
-      if (p == e) return false;
-      int64_t v = 0;
-      for (; p < e; p++) {
-        if (*p < '0' || *p > '9') return false;
-        v = v * 10 + (*p - '0');
-      }
-      *out = v;
-      return true;
-    };
-    bool ok;
-    if (s_end == spec) {  // suffix form bytes=-N: the LAST N bytes
-      int64_t n_last = 0;
-      ok = parse_num(e_begin, spec + spec_len, &n_last);
-      if (ok) start_i = std::max<int64_t>(0, (int64_t)data_size - n_last);
-    } else {
-      ok = parse_num(spec, s_end, &start_i);
-      if (ok && e_begin < spec + spec_len)
-        ok = parse_num(e_begin, spec + spec_len, &end_i);
-    }
-    end_i = std::min<int64_t>(end_i, (int64_t)data_size - 1);
-    if (!ok || start_i > end_i || start_i >= (int64_t)data_size) {
+  if (r.range && !is_head) {
+    int rc = parse_byte_range(r.range, r.range_len, (int64_t)data_size,
+                              &start_i, &end_i);
+    if (rc < 0) {
       simple_response(c, 416, "", r.keep_alive);
       return true;
     }
-    partial = true;
+    partial = rc == 1;
   }
   char head[512];
   int n = snprintf(head, sizeof head,
@@ -2739,7 +2760,7 @@ std::unordered_map<std::string, S3Ent> s3_cache;  // "/bucket/key"
 constexpr size_t S3_CACHE_CAP = 200000;
 
 std::atomic<int64_t> n_s3_put{0}, n_s3_get{0}, n_s3_reject{0},
-    n_s3_chan_fail{0};
+    n_s3_chan_fail{0}, n_s3_del{0};
 
 // scan the raw request head for one header (case-insensitive name)
 bool find_header(const char* head, size_t head_len, const char* name,
@@ -3016,6 +3037,7 @@ S3Auth s3_auth(Conn* c, const Request& r, const char* head,
 struct S3Op {
   Conn* client;
   bool keep_alive = true;
+  bool is_delete = false;
   std::string etag;
 };
 
@@ -3061,16 +3083,25 @@ void s3_finalize(Server* s, S3Op* op, int status) {
   }
   if (status >= 200 && status < 300) {
     char head[256];
-    int hl = snprintf(head, sizeof head,
-                      "HTTP/1.1 200 OK\r\nETag: \"%s\"\r\n"
-                      "Content-Length: 0\r\n%s\r\n",
-                      op->etag.c_str(),
-                      op->keep_alive ? "" : "Connection: close\r\n");
+    int hl;
+    if (op->is_delete) {
+      // S3 DeleteObject: 204 whether or not the key existed
+      hl = snprintf(head, sizeof head,
+                    "HTTP/1.1 204 No Content\r\n%s\r\n",
+                    op->keep_alive ? "" : "Connection: close\r\n");
+      n_s3_del++;
+    } else {
+      hl = snprintf(head, sizeof head,
+                    "HTTP/1.1 200 OK\r\nETag: \"%s\"\r\n"
+                    "Content-Length: 0\r\n%s\r\n",
+                    op->etag.c_str(),
+                    op->keep_alive ? "" : "Connection: close\r\n");
+      n_s3_put++;
+    }
     c->out.append(head, hl);
     if (!op->keep_alive) c->want_close = true;
-    n_s3_put++;
   } else {
-    s3_error(c, 500, "InternalError", "metadata insert failed", "", 0,
+    s3_error(c, 500, "InternalError", "metadata mutation failed", "", 0,
              op->keep_alive);
   }
   c->sent_100 = false;
@@ -3120,8 +3151,39 @@ void chan_read(Server* s) {
   }
 }
 
+// DELETE fast path: the metadata delete rides the channel (the python
+// applier's filer.delete_entry carries chunk reclamation and the meta
+// event that invalidates our cache); the front only skips the HTTP
+// relay. Returns 0 to relay (query/multipart abort, unknown bucket).
+int s3_handle_delete(Server* s, Conn* c, const Request& r,
+                     const char* head, const std::string& bucket,
+                     const char* key, size_t key_len) {
+  S3Auth a = s3_auth(c, r, head, "DELETE", true, bucket, nullptr, 0);
+  if (a == S3Auth::RELAY) return 0;
+  if (a == S3Auth::REJECTED) return 1;
+  uint64_t id = s->next_op_id++;
+  std::string rec;
+  rec.reserve(64 + key_len);
+  char nbuf[48];
+  snprintf(nbuf, sizeof nbuf, "%llu\tdel\t", (unsigned long long)id);
+  rec += nbuf;
+  rec += bucket;
+  rec += '\t';
+  rec.append(key, key_len);
+  rec += '\n';
+  S3Op* op = new S3Op();
+  op->client = c;
+  op->keep_alive = r.keep_alive;
+  op->is_delete = true;
+  s->s3_pending[id] = op;
+  c->repl_pending = true;
+  s->chan_out += rec;  // flushed once per epoll batch
+  return 1;
+}
+
 // Serve a GET/HEAD from the cache entry's local needle. false = relay
-// (volume gone/detached, compressed needle, or on-disk surprises).
+// (volume gone/detached, compressed needle, unusual Range forms, or
+// on-disk surprises).
 bool s3_serve_cached(Conn* c, const Request& r, const S3Ent& ent,
                      bool is_head) {
   std::shared_ptr<Vol> v = find_vol(ent.vid);
@@ -3155,24 +3217,51 @@ bool s3_serve_cached(Conn* c, const Request& r, const S3Ent& ent,
   if (data_size && stored_crc != actual &&
       stored_crc != legacy_crc_value(actual))
     return false;  // corrupt: python's read path reports it properly
+  // single-range GET (S3 GetObject with Range): the shared parser
+  // serves well-formed satisfiable slices; malformed or unsatisfiable
+  // specs RELAY so the python path's 416 XML / ignore semantics apply
+  // verbatim (HEAD with a Range never reaches here — the pump gate
+  // relays it, since AWS honors Range on HeadObject)
+  int64_t start = 0, end = (int64_t)data_size - 1;
+  bool partial = false;
+  if (r.range && !is_head) {
+    int rc = parse_byte_range(r.range, r.range_len, (int64_t)data_size,
+                              &start, &end);
+    if (rc < 0) return false;
+    partial = rc == 1;
+  }
+  int64_t body_len = end - start + 1;
   char lm[40] = "";
   struct tm tmv;
   time_t mt = (time_t)ent.mtime;
   gmtime_r(&mt, &tmv);
   strftime(lm, sizeof lm, "%a, %d %b %Y %H:%M:%S GMT", &tmv);
-  char head[512];
-  int hl = snprintf(
-      head, sizeof head,
-      "HTTP/1.1 200 OK\r\nContent-Type: %s\r\nContent-Length: %u\r\n"
-      "ETag: \"%s\"\r\nLast-Modified: %s\r\nAccept-Ranges: bytes\r\n",
-      ent.mime.empty() ? "application/octet-stream" : ent.mime.c_str(),
-      data_size, ent.etag.c_str(), lm);
+  char head[576];
+  int hl;
+  if (partial) {
+    hl = snprintf(
+        head, sizeof head,
+        "HTTP/1.1 206 Partial Content\r\nContent-Type: %s\r\n"
+        "Content-Length: %lld\r\n"
+        "Content-Range: bytes %lld-%lld/%u\r\n"
+        "ETag: \"%s\"\r\nLast-Modified: %s\r\nAccept-Ranges: bytes\r\n",
+        ent.mime.empty() ? "application/octet-stream" : ent.mime.c_str(),
+        (long long)body_len, (long long)start, (long long)end,
+        data_size, ent.etag.c_str(), lm);
+  } else {
+    hl = snprintf(
+        head, sizeof head,
+        "HTTP/1.1 200 OK\r\nContent-Type: %s\r\nContent-Length: %u\r\n"
+        "ETag: \"%s\"\r\nLast-Modified: %s\r\nAccept-Ranges: bytes\r\n",
+        ent.mime.empty() ? "application/octet-stream" : ent.mime.c_str(),
+        data_size, ent.etag.c_str(), lm);
+  }
   if (hl >= (int)sizeof head) return false;
   c->out.append(head, hl);
   c->out.append(ent.meta);
   if (!r.keep_alive) c->out.append("Connection: close\r\n");
   c->out.append("\r\n");
-  if (!is_head) c->out.append((const char*)data, data_size);
+  if (!is_head) c->out.append((const char*)data + start, body_len);
   if (!r.keep_alive) c->want_close = true;
   return true;
 }
@@ -3268,14 +3357,15 @@ int s3_handle_put(Server* s, Conn* c, const Request& r, const char* head,
                     (unsigned long long)slot.key, slot.cookie);
   // TSV channel record (cheap to build here, cheap to split there —
   // a json round trip measured ~5us/op of applier GIL time):
-  //   id \t bucket \t key \t fid \t size \t etag \t mime [\t k=v]...\n
+  //   id \t put \t bucket \t key \t fid \t size \t etag \t mime
+  //   [\t k=v]...\n          (deletes: id \t del \t bucket \t key\n)
   // every field is gated printable-ASCII-no-tab above; keys passed
   // s3_canonical_path (unreserved bytes only)
   uint64_t id = s->next_op_id++;
   std::string rec;
   rec.reserve(160 + key_len);
   char nbuf[48];
-  snprintf(nbuf, sizeof nbuf, "%llu\t", (unsigned long long)id);
+  snprintf(nbuf, sizeof nbuf, "%llu\tput\t", (unsigned long long)id);
   rec += nbuf;
   rec += bucket;
   rec += '\t';
@@ -3343,7 +3433,8 @@ int s3_pump_inner(Server* s, Conn* c) {
       bucket_known = s3_buckets.count(bucket) > 0;
     }
     if ((is_get || is_head) && bucket_known && !r.has_query &&
-        !r.proxy_only && r.content_len == 0 && !r.chunked && !r.range) {
+        !r.proxy_only && r.content_len == 0 && !r.chunked &&
+        !(is_head && r.range)) {  // AWS honors Range on HEAD: relay
       S3Auth a = s3_auth(c, r, head, is_head ? "HEAD" : "GET", false,
                          bucket, nullptr, 0);
       if (a == S3Auth::REJECTED) {
@@ -3384,6 +3475,17 @@ int s3_pump_inner(Server* s, Conn* c) {
                                r.content_len);
       if (took) {
         c->in_off += r.head_len + r.content_len;
+        c->sent_100 = false;
+        if (c->repl_pending) return 0;  // awaiting the applier's ack
+        continue;
+      }
+      // fall through to relay
+    } else if (ieq(r.method, r.method_len, "DELETE") && bucket_known &&
+               key_len && !r.has_query && !r.proxy_only && !r.chunked &&
+               r.content_len == 0) {
+      int took = s3_handle_delete(s, c, r, head, bucket, key, key_len);
+      if (took) {
+        c->in_off += r.head_len;
         c->sent_100 = false;
         if (c->repl_pending) return 0;  // awaiting the applier's ack
         continue;
@@ -3817,6 +3919,7 @@ void dp_s3_stats(int64_t* out) {
   out[1] = n_s3_get.load();
   out[2] = n_s3_reject.load();
   out[3] = n_s3_chan_fail.load();
+  out[4] = n_s3_del.load();
 }
 
 // test hook: md5 hex of a buffer (validates the in-tree MD5)

@@ -470,8 +470,9 @@ class S3Front:
         self._lib.dp_s3_invalidate(path.encode(), 1 if prefix else 0)
 
     def stats(self) -> dict:
-        out = np.zeros(4, np.int64)
+        out = np.zeros(5, np.int64)
         self._lib.dp_s3_stats(
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return {"fast_put": int(out[0]), "fast_get": int(out[1]),
-                "rejected": int(out[2]), "chan_fail": int(out[3])}
+                "rejected": int(out[2]), "chan_fail": int(out[3]),
+                "fast_del": int(out[4])}

@@ -223,24 +223,32 @@ class NativeS3Front:
                     break
 
     def _apply_one(self, line: bytes) -> str:
-        # TSV record from the front (see s3_handle_put):
-        #   id \t bucket \t key \t fid \t size \t etag \t mime [\t k=v]...
+        # TSV record from the front (see s3_handle_put/_delete):
+        #   id \t put \t bucket \t key \t fid \t size \t etag \t mime
+        #   [\t k=v]...          |  id \t del \t bucket \t key
         rec_id = b"0"
         try:
             cols = line.split(b"\t")
             rec_id = cols[0]
-            bucket = cols[1].decode()
-            key = cols[2].decode()
-            etag = cols[5].decode()
+            op = cols[1]
+            bucket = cols[2].decode()
+            key = cols[3].decode()
+            path = f"{BUCKETS_DIR}/{bucket}/{key}"
+            if op == b"del":
+                # delete_entry of a missing path is a no-op — S3
+                # DeleteObject answers 204 either way
+                self.filer.delete_entry(path)
+                return f"{rec_id.decode()} 200\n"
+            etag = cols[6].decode()
             extended = {}
-            for pair in cols[7:]:
+            for pair in cols[8:]:
                 k, _, v = pair.partition(b"=")
                 extended[f"s3_meta_{k.decode()}"] = v.decode()
             entry = Entry(
-                full_path=f"{BUCKETS_DIR}/{bucket}/{key}",
-                mime=cols[6].decode(), md5=etag, collection=bucket,
-                chunks=[FileChunk(fid=cols[3].decode(), offset=0,
-                                  size=int(cols[4]),
+                full_path=path,
+                mime=cols[7].decode(), md5=etag, collection=bucket,
+                chunks=[FileChunk(fid=cols[4].decode(), offset=0,
+                                  size=int(cols[5]),
                                   mtime_ns=time.time_ns(), etag=etag)],
                 extended=extended)
             self.filer.create_entry(entry, gc_old_chunks=True)

@@ -518,13 +518,19 @@ class FilerServer:
         offset, length, status = 0, size, 200
         rng = req.headers.get("Range", "")
         if rng.startswith("bytes="):
-            start_s, _, end_s = rng[6:].partition("-")
-            if start_s:
-                offset = int(start_s)
-                end = int(end_s) if end_s else size - 1
-            else:  # suffix range: last N bytes
-                offset = max(0, size - int(end_s))
-                end = size - 1
+            try:
+                start_s, _, end_s = rng[6:].partition("-")
+                if start_s:
+                    offset = int(start_s)
+                    end = int(end_s) if end_s else size - 1
+                else:  # suffix range: last N bytes
+                    offset = max(0, size - int(end_s))
+                    end = size - 1
+            except ValueError:
+                # malformed spec (multi-range, junk): 416 like the
+                # volume path, not a 500 from the bare int()
+                return web.Response(
+                    status=416, headers={"Content-Range": f"bytes */{size}"})
             end = min(end, size - 1)
             if offset > end:
                 return web.Response(
