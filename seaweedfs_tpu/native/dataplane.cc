@@ -1589,6 +1589,10 @@ int read_framed_response(int fd, std::string* resp, size_t limit,
       }
     }
     if (head_end >= 0) {
+      // 204/304 are body-less by status (RFC 7230 §3.3.3) and carry
+      // no Content-Length — headers complete the response
+      int code0 = head_end >= 12 ? atoi(resp->c_str() + 9) : 0;
+      if (code0 == 204 || code0 == 304) break;
       if (chunked) {
         if (memmem(resp->data() + head_end, resp->size() - head_end,
                    "0\r\n\r\n", 5))
