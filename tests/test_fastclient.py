@@ -119,3 +119,41 @@ def test_stale_pooled_conn_redials_once(loop_run):
         await pool.close()
         await srv.stop()
     loop_run(go())
+
+
+def test_large_body_split_write_roundtrips(loop_run):
+    """Bodies over 256KB ship as a separate socket write (no head+body
+    concat copy): the bytes on the wire must be identical to the
+    single-blob path — length, content, and framing."""
+    import hashlib
+
+    async def go():
+        got = {}
+
+        async def handle(reader, writer):
+            head = await reader.readuntil(b"\r\n\r\n")
+            cl = 0
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    cl = int(line.split(b":")[1])
+            body = await reader.readexactly(cl)
+            got["sha"] = hashlib.sha256(body).hexdigest()
+            got["len"] = len(body)
+            writer.write(b"HTTP/1.1 201 Created\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        body = bytes(range(256)) * 4096 + b"tail"  # 1MB+4: splits
+        pool = HttpPool()
+        r = await pool.request(
+            "POST", f"http://127.0.0.1:{port}/big", data=body)
+        assert r.status_code == 201
+        assert got["len"] == len(body)
+        assert got["sha"] == hashlib.sha256(body).hexdigest()
+        await pool.close()
+        srv.close()
+        await srv.wait_closed()
+    loop_run(go())
