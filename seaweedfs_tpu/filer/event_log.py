@@ -45,15 +45,21 @@ class MetaEventLog:
 
     def append(self, directory: str, old_entry: Entry | None,
                new_entry: Entry | None,
-               signatures: list[int] | None = None) -> dict:
+               signatures: list[int] | None = None,
+               new_dict: dict | None = None) -> dict:
+        """new_dict: the caller's already-built new_entry.to_dict(),
+        when it has one (the filer shares one dict between the store
+        encode and this event on the hot path)."""
         with self._lock:
             ts = time.time_ns()
             if ts <= self._last_ts_ns:  # keep strictly ordered
                 ts = self._last_ts_ns + 1
             self._last_ts_ns = ts
+            if new_dict is None and new_entry is not None:
+                new_dict = new_entry.to_dict()
             ev = {"ts_ns": ts, "directory": directory,
                   "old_entry": old_entry.to_dict() if old_entry else None,
-                  "new_entry": new_entry.to_dict() if new_entry else None,
+                  "new_entry": new_dict,
                   "signatures": list(signatures or []) + [self.signature]}
             self._buf.append(ev)
             for q in self._subs.values():

@@ -378,11 +378,13 @@ class Filer:
                 keep = {c.fid for c in logged.chunks}
                 freed.extend(c for c in old.chunks
                              if c.fid not in keep)
-            self.store.insert_entry(entry)
+            ed = entry.to_dict()  # built once: store encode + event
+            self.store.insert_entry_encoded(entry, ed)
             d, _ = entry.dir_and_name
             # the event carries the RESOLVED shape (real chunks):
             # subscribers must not see hardlinked files as empty
-            self.meta_log.append(d, old, logged, signatures)
+            self.meta_log.append(d, old, logged, signatures,
+                                 new_dict=ed if logged is entry else None)
         if freed:
             # chunk deletion does volume-server round trips: never
             # under the metadata locks

@@ -33,6 +33,17 @@ class FilerStore:
     def insert_entry(self, entry: Entry) -> None:
         raise NotImplementedError
 
+    def insert_entry_encoded(self, entry: Entry, entry_dict: dict) -> None:
+        """Insert with the caller's already-built entry.to_dict() —
+        the filer builds that dict once per mutation for the event log
+        and serializing stores reuse it instead of re-walking the
+        entry (a measured slice of the S3 applier's per-op budget).
+        Default: ignore the dict. NOTE the filer's hot path calls
+        THIS method, so a store that overrides it (weedkv, sqlite)
+        must treat it as the primitive — overriding only insert_entry
+        on such a subclass would be bypassed."""
+        self.insert_entry(entry)
+
     def update_entry(self, entry: Entry) -> None:
         raise NotImplementedError
 
@@ -243,11 +254,14 @@ class SqliteStore(FilerStore):
             self._conn.commit()
 
     def insert_entry(self, entry: Entry) -> None:
+        self.insert_entry_encoded(entry, entry.to_dict())
+
+    def insert_entry_encoded(self, entry: Entry, entry_dict: dict) -> None:
         d, n = entry.dir_and_name
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO filemeta(dir,name,meta) "
-                "VALUES(?,?,?)", (d, n, json.dumps(entry.to_dict())))
+                "VALUES(?,?,?)", (d, n, json.dumps(entry_dict)))
             self._conn.commit()
 
     update_entry = insert_entry
@@ -343,9 +357,13 @@ class WeedKvStore(FilerStore):
         return self.ENTRY_PREFIX + d.encode() + self.SEP + n.encode()
 
     def insert_entry(self, entry: Entry) -> None:
+        self.insert_entry_encoded(entry, entry.to_dict())
+
+    def insert_entry_encoded(self, entry: Entry, entry_dict: dict) -> None:
         d, n = entry.dir_and_name
         self.db.put(self._ekey(d, n),
-                    json.dumps(entry.to_dict()).encode())
+                    json.dumps(entry_dict, separators=(",", ":"),
+                               ensure_ascii=False).encode())
 
     update_entry = insert_entry
 

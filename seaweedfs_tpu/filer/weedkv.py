@@ -24,7 +24,9 @@ import bisect
 import heapq
 import json
 import os
+import struct
 import threading
+import zlib
 
 TOMBSTONE = None  # in-memory marker
 
@@ -42,14 +44,36 @@ def _dec(s: str) -> bytes:
 
 
 def _encode_record(key: bytes, value: bytes | None) -> str:
-    """One WAL/segment line: {"k": ..} + either "t" (tombstone) or
-    "v". The single place the on-disk record format lives."""
+    """One segment line: {"k": ..} + either "t" (tombstone) or
+    "v". The single place the SEGMENT record format lives (segments
+    are written at flush time, off the hot path; the WAL uses the
+    binary v2 framing below)."""
     rec = {"k": _enc(key)}
     if value is None:
         rec["t"] = 1
     else:
         rec["v"] = _enc(value)
     return json.dumps(rec, separators=(",", ":")) + "\n"
+
+
+# -- WAL v2 binary framing ----------------------------------------------
+# The original WAL shared the segment's JSON-lines format; base64 +
+# json.dumps per record measured as the single largest slice of the S3
+# applier's create_entry budget. v2 frames are binary:
+#   [u8 tag 0=tombstone 1=put][u32le klen][u32le vlen][key][value]
+#   [u32le crc32(frame minus crc)]
+# The trailing CRC gives the same torn-tail detection the JSON parse
+# failure used to provide. Legacy (JSON) WALs are still replayed and
+# are rewritten as v2 on open — see _replay_wal.
+WAL2_MAGIC = b"WKV2\n"
+_WAL2_HDR = struct.Struct("<BII")
+
+
+def _encode_wal2(key: bytes, value: bytes | None) -> bytes:
+    frame = _WAL2_HDR.pack(0 if value is None else 1,
+                           len(key), len(value or b"")) + key + (
+                               value or b"")
+    return frame + struct.pack("<I", zlib.crc32(frame))
 
 
 def _decode_record(d: dict) -> tuple[bytes, bytes | None]:
@@ -124,32 +148,80 @@ class WeedKV:
         self._mem_keys = sorted(self._mem)
         # binary + buffered: the hot path writes pre-encoded bytes
         # (a TextIOWrapper re-encodes every record on this path)
-        self._wal = open(self._wal_path, "ab")
+        self._open_wal(fresh=False)
 
     # -- WAL ------------------------------------------------------------
+    def _open_wal(self, fresh: bool) -> None:
+        """(Re)open self._wal for appending; a fresh/empty file gets
+        the v2 magic so replay never misreads it as legacy JSON. The
+        one place the 'start a v2 WAL' ritual lives."""
+        self._wal = open(self._wal_path, "wb" if fresh else "ab")
+        if self._wal.tell() == 0:
+            self._wal.write(WAL2_MAGIC)
+            self._wal.flush()
+
     def _replay_wal(self) -> None:
         if not os.path.exists(self._wal_path):
             return
-        good = 0
         with open(self._wal_path, "rb") as f:
-            for line in f:
-                try:
-                    k, v = _decode_record(json.loads(line))
-                except (json.JSONDecodeError, UnicodeDecodeError,
-                        KeyError, ValueError):
-                    break  # torn tail from a crash mid-append
-                self._mem[k] = v
-                self._mem_bytes += len(k) + len(v or b"")
-                good += len(line)
-        if good < os.path.getsize(self._wal_path):
+            raw = f.read()
+        legacy = not raw.startswith(WAL2_MAGIC)
+        good = self._replay_legacy(raw) if legacy \
+            else self._replay_v2(raw)
+        if legacy and raw:
+            # migrate in place: rewrite the replayed records as v2 via
+            # tmp+rename so a crash mid-rewrite still leaves the old
+            # acknowledged WAL intact
+            tmp = self._wal_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(WAL2_MAGIC)
+                for k, v in self._mem.items():
+                    f.write(_encode_wal2(k, v))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._wal_path)
+        elif good < len(raw):
             # drop the torn tail NOW: appending new records after the
             # garbage would make every later replay stop at the same
             # spot and silently lose those acknowledged writes
             with open(self._wal_path, "r+b") as f:
                 f.truncate(good)
 
+    def _replay_legacy(self, raw: bytes) -> int:
+        good = 0
+        for line in raw.splitlines(keepends=True):
+            try:
+                k, v = _decode_record(json.loads(line))
+            except (json.JSONDecodeError, UnicodeDecodeError,
+                    KeyError, ValueError):
+                break  # torn tail from a crash mid-append
+            self._mem[k] = v
+            self._mem_bytes += len(k) + len(v or b"")
+            good += len(line)
+        return good
+
+    def _replay_v2(self, raw: bytes) -> int:
+        off = len(WAL2_MAGIC)
+        hdr = _WAL2_HDR.size
+        while True:
+            if off + hdr > len(raw):
+                break
+            tag, klen, vlen = _WAL2_HDR.unpack_from(raw, off)
+            end = off + hdr + klen + vlen + 4
+            if tag > 1 or end > len(raw):
+                break  # torn/garbage tail
+            (crc,) = struct.unpack_from("<I", raw, end - 4)
+            if zlib.crc32(raw[off:end - 4]) != crc:
+                break
+            k = raw[off + hdr:off + hdr + klen]
+            v = raw[off + hdr + klen:end - 4] if tag else None
+            self._mem[k] = v
+            self._mem_bytes += len(k) + len(v or b"")
+            off = end
+        return off
+
     def _wal_append(self, key: bytes, value: bytes | None) -> None:
-        self._wal.write(_encode_record(key, value).encode())
+        self._wal.write(_encode_wal2(key, value))
         if not getattr(self._flush_local, "deferred", False):
             self._wal.flush()
 
@@ -258,7 +330,7 @@ class WeedKV:
             self._mem_keys = []
             self._mem_bytes = 0
             self._wal.close()
-            self._wal = open(self._wal_path, "wb")
+            self._open_wal(fresh=True)
             if len(self._segments) >= COMPACT_SEGMENT_COUNT:
                 self.compact()
 

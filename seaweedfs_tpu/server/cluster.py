@@ -81,8 +81,14 @@ class Cluster:
         self.filer: FilerServer | None = None
         self.filer_thread: ServerThread | None = None
         if with_filer or with_s3:
-            store_path = os.path.join(base_dir, "filer.db") \
-                if filer_store == "sqlite" else ":memory:"
+            # distinct path per kind: sqlite wants a FILE, weedkv a
+            # DIRECTORY — sharing one name would wedge a base_dir that
+            # switches store kinds across restarts
+            store_path = ":memory:"
+            if filer_store == "sqlite":
+                store_path = os.path.join(base_dir, "filer.db")
+            elif filer_store == "leveldb":
+                store_path = os.path.join(base_dir, "filerdb")
             self.filer = FilerServer(self.master_url, store=filer_store,
                                      store_path=store_path,
                                      cipher=filer_cipher)

@@ -169,3 +169,58 @@ class TestWalTruncation:
         assert [k for k, _ in out] == [b"k01", b"k02", b"k03",
                                        b"k04", b"k05"]
         kv.close()
+
+
+class TestWalV2Format:
+    def test_legacy_json_wal_migrates_on_open(self, tmp_path):
+        """A pre-v2 (JSON lines, no magic) WAL replays fully and is
+        rewritten in the binary framing, so later appends don't mix
+        formats in one file."""
+        import base64 as b64
+        import json as j
+
+        from seaweedfs_tpu.filer.weedkv import WAL2_MAGIC
+
+        d = tmp_path / "db"
+        d.mkdir()
+        recs = [(b"alpha", b"1"), (b"beta", b"payload \xff\x00 bytes")]
+        with open(d / "wal.log", "w") as f:
+            for k, v in recs:
+                f.write(j.dumps({"k": b64.b64encode(k).decode(),
+                                 "v": b64.b64encode(v).decode()}) + "\n")
+            f.write(j.dumps({"k": b64.b64encode(b"gone").decode(),
+                             "t": 1}) + "\n")
+        kv = WeedKV(str(d))
+        assert kv.get(b"alpha") == b"1"
+        assert kv.get(b"beta") == recs[1][1]
+        assert kv.get(b"gone") is None
+        with open(kv._wal_path, "rb") as f:
+            assert f.read(len(WAL2_MAGIC)) == WAL2_MAGIC
+        kv.put(b"gamma", b"3")
+        kv._wal.flush()
+        kv2 = WeedKV(str(d))  # reopen without clean close
+        assert kv2.get(b"alpha") == b"1"
+        assert kv2.get(b"gamma") == b"3"
+        kv2.close()
+
+    def test_torn_v2_record_truncated_by_crc(self, tmp_path):
+        """A crash mid-binary-append leaves a partial frame (or a
+        frame with a bad checksum): replay must stop at the last good
+        record and truncate, and new writes must then survive."""
+        d = str(tmp_path / "db")
+        kv = WeedKV(d)
+        kv.put(b"good", b"kept")
+        kv._wal.flush()
+        with open(kv._wal_path, "ab") as f:
+            from seaweedfs_tpu.filer.weedkv import _encode_wal2
+            full = _encode_wal2(b"torn-key", b"torn-value")
+            f.write(full[:-6])  # lose part of the value + crc
+        kv2 = WeedKV(d)
+        assert kv2.get(b"good") == b"kept"
+        assert kv2.get(b"torn-key") is None
+        kv2.put(b"after", b"ok")
+        kv2._wal.flush()
+        kv3 = WeedKV(d)
+        assert kv3.get(b"after") == b"ok"
+        assert kv3.get(b"torn-key") is None
+        kv3.close()
