@@ -1240,15 +1240,29 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
     return true;
   }
   // single-range GET (handlers_read.go writeResponseContent): one
-  // shared parser (parse_byte_range above); anything malformed or
-  // unsatisfiable is 416 exactly like the python path.
+  // shared parser (parse_byte_range above); malformed specs RELAY so
+  // the python path decides — multi-range answers as
+  // multipart/byteranges there (common.go:348), and a garbage spec
+  // gets python's 416 with its Content-Range: bytes */N header.
   int64_t start_i = 0, end_i = (int64_t)data_size - 1;
   bool partial = false;
   if (r.range && !is_head) {
     int rc = parse_byte_range(r.range, r.range_len, (int64_t)data_size,
                               &start_i, &end_i);
-    if (rc < 0) {
-      simple_response(c, 416, "", r.keep_alive);
+    if (rc == -1) return false;  // multi-range/junk: python path
+    if (rc == -2) {
+      // RFC 7233: a 416 SHOULD say the actual size — clients read
+      // the total from "bytes */N" to retry with a valid range, and
+      // the python paths send the same header
+      char h416[160];
+      int hn = snprintf(h416, sizeof h416,
+                        "HTTP/1.1 416 Requested Range Not Satisfiable"
+                        "\r\nContent-Length: 0\r\n"
+                        "Content-Range: bytes */%lld\r\n%s\r\n",
+                        (long long)data_size,
+                        r.keep_alive ? "" : "Connection: close\r\n");
+      c->out.append(h416, hn);
+      if (!r.keep_alive) c->want_close = true;
       return true;
     }
     partial = rc == 1;

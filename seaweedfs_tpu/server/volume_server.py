@@ -28,7 +28,7 @@ from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
-from ..utils import glog, metrics
+from ..utils import glog, httprange, metrics
 from ..utils.security import Guard
 
 
@@ -543,26 +543,28 @@ class VolumeServer:
         if req.method == "HEAD":
             headers["Content-Length"] = str(len(body))
             return web.Response(status=200, headers=headers)
-        # range support (handlers_read.go writeResponseContent)
-        if rng and rng.startswith("bytes="):
-            try:
-                s, _, e = rng[len("bytes="):].partition("-")
-                if not s:  # suffix form bytes=-N: the LAST N bytes
-                    start_i = max(0, len(body) - int(e))
-                    end_i = len(body) - 1
-                else:
-                    start_i = int(s)
-                    end_i = int(e) if e else len(body) - 1
-                end_i = min(end_i, len(body) - 1)
-                if start_i > end_i or start_i >= len(body):
-                    raise ValueError
-                part = body[start_i:end_i + 1]
-                headers["Content-Range"] = \
-                    f"bytes {start_i}-{end_i}/{len(body)}"
-                return web.Response(status=206, body=part,
-                                    content_type=ct, headers=headers)
-            except ValueError:
-                return web.Response(status=416)
+        # range support, incl. multi-range multipart/byteranges
+        # (common.go processRangeRequest:306-383)
+        if rng:
+            ranges = httprange.parse_range_header(rng, len(body))
+            if ranges in (httprange.MALFORMED, httprange.UNSATISFIABLE):
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{len(body)}"})
+            if ranges and ranges is not httprange.IGNORE:
+                if len(ranges) == 1:
+                    start_i, length = ranges[0]
+                    headers["Content-Range"] = httprange.content_range(
+                        start_i, length, len(body))
+                    return web.Response(
+                        status=206, body=body[start_i:start_i + length],
+                        content_type=ct, headers=headers)
+                parts = [(s, ln, body[s:s + ln]) for s, ln in ranges]
+                mbody, mct = httprange.multipart_byteranges(
+                    parts, ct, len(body))
+                headers["Content-Type"] = mct  # carries the boundary
+                return web.Response(status=206, body=mbody,
+                                    headers=headers)
         return web.Response(body=body, content_type=ct, headers=headers)
 
     async def _write_fid(self, req, fid, vid, key, cookie) -> web.Response:

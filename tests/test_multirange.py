@@ -1,0 +1,214 @@
+"""Multi-range GETs: multipart/byteranges on the filer and volume read
+paths (reference: weed/server/common.go processRangeRequest:306-383 +
+volume_server_handlers_helper.go parseRange). The native volume front
+fast-paths single ranges and RELAYS multi-range/garbage specs to the
+python path, so one implementation answers everywhere.
+"""
+import re
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.utils import httprange
+
+
+class TestParser:
+    SIZE = 100
+
+    def test_single_forms(self):
+        p = httprange.parse_range_header
+        assert p("bytes=0-9", self.SIZE) == [(0, 10)]
+        assert p("bytes=90-", self.SIZE) == [(90, 10)]
+        assert p("bytes=-7", self.SIZE) == [(93, 7)]
+        assert p("bytes=0-1000", self.SIZE) == [(0, 100)]
+        assert p("", self.SIZE) == []
+        assert p("items=0-5", self.SIZE) == []  # foreign unit: ignored
+
+    def test_multi(self):
+        got = httprange.parse_range_header("bytes=0-4, 10-14, -5", 100)
+        assert got == [(0, 5), (10, 5), (95, 5)]
+
+    def test_malformed(self):
+        p = httprange.parse_range_header
+        for spec in ("bytes=abc", "bytes=5-2", "bytes=0-x",
+                     "bytes=--3", "bytes=2--4"):
+            assert p(spec, 100) == httprange.MALFORMED, spec
+
+    def test_unsatisfiable_and_ignore(self):
+        p = httprange.parse_range_header
+        assert p("bytes=200-300", 100) == httprange.UNSATISFIABLE
+        assert p("bytes=-0", 100) == httprange.UNSATISFIABLE
+        # satisfiable subset survives an unsatisfiable member
+        assert p("bytes=200-300,0-4", 100) == [(0, 5)]
+        # ranges summing past the object: ignore the header (200 full)
+        assert p("bytes=0-99,0-99", 100) == httprange.IGNORE
+
+
+def _parse_multipart(body: bytes, content_type: str):
+    m = re.search(r'boundary=([0-9a-f]+)', content_type)
+    assert m, content_type
+    boundary = m.group(1).encode()
+    parts = []
+    for raw in body.split(b"--" + boundary)[1:-1]:
+        head, _, data = raw.lstrip(b"\r\n").partition(b"\r\n\r\n")
+        headers = dict(
+            line.split(b": ", 1) for line in head.split(b"\r\n") if line)
+        parts.append((headers, data[:-2]))  # strip trailing CRLF
+    assert body.split(b"--" + boundary)[-1] == b"--\r\n"
+    return parts
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("mrange")),
+                n_volume_servers=1, volume_size_limit=64 << 20,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+BLOB = bytes((i * 37 + 11) % 256 for i in range(3 << 20))  # 3MB, 2 chunks
+
+
+@pytest.fixture(scope="module")
+def filer_file(cluster):
+    url = f"{cluster.filer_url}/mr/blob.bin"
+    r = requests.post(url, data=BLOB,
+                      headers={"Content-Type": "application/octet-stream"},
+                      params={"maxMB": "1"})
+    assert r.status_code == 201, r.text
+    return url
+
+class TestFilerMultiRange:
+    def test_multipart_byteranges(self, cluster, filer_file):
+        spans = [(0, 10), (1 << 20, 16), (len(BLOB) - 5, 5)]
+        spec = "bytes=" + ",".join(f"{s}-{s + ln - 1}" for s, ln in spans)
+        r = requests.get(filer_file, headers={"Range": spec})
+        assert r.status_code == 206
+        assert r.headers["Content-Type"].startswith(
+            "multipart/byteranges; boundary=")
+        parts = _parse_multipart(r.content, r.headers["Content-Type"])
+        assert len(parts) == 3
+        for (hdrs, data), (s, ln) in zip(parts, spans):
+            assert data == BLOB[s:s + ln]
+            assert hdrs[b"Content-Range"] == \
+                f"bytes {s}-{s + ln - 1}/{len(BLOB)}".encode()
+
+    def test_overlapping_sum_ignored(self, cluster, filer_file):
+        r = requests.get(filer_file,
+                         headers={"Range": "bytes=0-,0-"})
+        assert r.status_code == 200
+        assert len(r.content) == len(BLOB)
+
+    def test_single_range_still_plain_206(self, cluster, filer_file):
+        r = requests.get(filer_file, headers={"Range": "bytes=5-9"})
+        assert r.status_code == 206
+        assert r.content == BLOB[5:10]
+        assert r.headers["Content-Range"] == f"bytes 5-9/{len(BLOB)}"
+
+    def test_head_with_multi_range_answers_whole(self, cluster,
+                                                 filer_file):
+        r = requests.head(filer_file,
+                          headers={"Range": "bytes=0-4,10-14"})
+        assert r.status_code == 200
+        assert r.headers["Content-Length"] == str(len(BLOB))
+
+
+class TestVolumeMultiRange:
+    def test_python_volume_path(self, cluster):
+        a = requests.get(
+            f"{cluster.master_url}/dir/assign").json()
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        body = bytes(range(256)) * 4
+        r = requests.post(url, data=body, headers={
+            "Content-Type": "application/octet-stream"})
+        assert r.status_code == 201, r.text
+        g = requests.get(url, headers={"Range": "bytes=0-3,256-259"})
+        assert g.status_code == 206
+        parts = _parse_multipart(g.content, g.headers["Content-Type"])
+        assert [d for _, d in parts] == [body[0:4], body[256:260]]
+
+    def test_native_front_relays_multirange(self, cluster):
+        from seaweedfs_tpu.native import dataplane as dpmod
+        if not dpmod.available():
+            pytest.skip("native dataplane unavailable")
+        backend_port = cluster.volume_threads[0].port
+        public = cluster.volume_servers[0].enable_native(0, backend_port)
+        try:
+            a = requests.get(f"{cluster.master_url}/dir/assign").json()
+            body = bytes((i * 13 + 5) % 256 for i in range(1024))
+            url = f"http://127.0.0.1:{public}/{a['fid']}"
+            r = requests.post(url, data=body, headers={
+                "Content-Type": "application/octet-stream"})
+            assert r.status_code == 201, r.text
+            # single range: served natively
+            g1 = requests.get(url, headers={"Range": "bytes=10-19"})
+            assert g1.status_code == 206 and g1.content == body[10:20]
+            # multi range: relayed to python, multipart/byteranges back
+            g2 = requests.get(url,
+                              headers={"Range": "bytes=0-9,100-109"})
+            assert g2.status_code == 206
+            parts = _parse_multipart(g2.content,
+                                     g2.headers["Content-Type"])
+            assert [d for _, d in parts] == [body[0:10], body[100:110]]
+            # garbage spec: python's 416 with the */N header
+            g3 = requests.get(url, headers={"Range": "bytes=zz"})
+            assert g3.status_code == 416
+        finally:
+            cluster.volume_servers[0].disable_native()
+
+
+class TestS3MultiRange:
+    def test_s3_gateway_inherits_multipart(self, tmp_path_factory):
+        """The reference's S3 GET proxies ranges to the filer verbatim
+        and so serves multipart/byteranges; ours must too."""
+        c = Cluster(str(tmp_path_factory.mktemp("s3mr")),
+                    n_volume_servers=1, volume_size_limit=64 << 20,
+                    with_s3=True)
+        try:
+            base = c.s3_url.rstrip("/")
+            assert requests.put(f"{base}/mrb").status_code == 200
+            body = bytes((i * 7 + 3) % 256 for i in range(2048))
+            r = requests.put(f"{base}/mrb/obj.bin", data=body, headers={
+                "Content-Type": "application/octet-stream"})
+            assert r.status_code == 200, r.text
+            g = requests.get(f"{base}/mrb/obj.bin",
+                             headers={"Range": "bytes=0-7,1000-1015"})
+            assert g.status_code == 206, (g.status_code, g.text)
+            parts = _parse_multipart(g.content,
+                                     g.headers["Content-Type"])
+            assert [d for _, d in parts] == [body[0:8], body[1000:1016]]
+        finally:
+            c.stop()
+
+
+class TestRangeEdges:
+    def test_suffix_on_empty_object_is_416(self, cluster):
+        url = f"{cluster.filer_url}/mr/empty.bin"
+        r = requests.post(url, data=b"", headers={
+            "Content-Type": "application/octet-stream"})
+        assert r.status_code == 201, r.text
+        g = requests.get(url, headers={"Range": "bytes=-5"})
+        assert g.status_code == 416
+        assert g.headers["Content-Range"] == "bytes */0"
+
+    def test_native_416_carries_total_size(self, cluster):
+        from seaweedfs_tpu.native import dataplane as dpmod
+        if not dpmod.available():
+            pytest.skip("native dataplane unavailable")
+        backend_port = cluster.volume_threads[0].port
+        public = cluster.volume_servers[0].enable_native(0, backend_port)
+        try:
+            a = requests.get(f"{cluster.master_url}/dir/assign").json()
+            body = b"x" * 100
+            url = f"http://127.0.0.1:{public}/{a['fid']}"
+            assert requests.post(url, data=body, headers={
+                "Content-Type": "application/octet-stream"}
+            ).status_code == 201
+            g = requests.get(url, headers={"Range": "bytes=200-"})
+            assert g.status_code == 416
+            # RFC 7233: the 416 names the actual size for client retry
+            assert g.headers["Content-Range"] == "bytes */100"
+        finally:
+            cluster.volume_servers[0].disable_native()
