@@ -308,6 +308,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
+    p.add_argument("-maxMB", dest="max_mb", type=int, default=0,
+                   help="split files larger than this into chunk "
+                        "needles + a manifest (submit.go maxMB)")
     p.add_argument("files", nargs="+")
 
     p = sub.add_parser("download", help="download a fid")
@@ -768,6 +771,33 @@ def _dispatch(args) -> int:
         from .operation import verbs
 
         for path in args.files:
+            size = os.path.getsize(path)
+            limit = args.max_mb << 20
+            if limit and size > limit:
+                # chunked submit (submit.go:134): one needle per
+                # -maxMB span + a ?cm=true manifest needle
+                import mimetypes
+
+                from .operation.chunked_file import upload_chunked
+
+                name = os.path.basename(path)
+
+                def pieces(p=path, lim=limit):
+                    with open(p, "rb") as f:
+                        while True:
+                            piece = f.read(lim)
+                            if not piece:
+                                return
+                            yield piece
+
+                fid, stored = upload_chunked(
+                    args.master, pieces(), size, name,
+                    mimetypes.guess_type(name)[0] or "",
+                    limit, collection=args.collection,
+                    replication=args.replication)
+                print(json.dumps({"file": path, "fid": fid,
+                                  "size": stored, "chunked": True}))
+                continue
             with open(path, "rb") as f:
                 data = f.read()
             fid = verbs.upload_data(

@@ -444,6 +444,7 @@ constexpr int PADDING = 8;
 constexpr int CHECKSUM = 4;
 constexpr int TS = 8;  // append_at_ns, version 3 only
 constexpr uint8_t FLAG_IS_COMPRESSED = 0x01;
+constexpr uint8_t FLAG_IS_CHUNK_MANIFEST = 0x80;
 constexpr uint8_t FLAG_HAS_NAME = 0x02;
 constexpr uint8_t FLAG_HAS_MIME = 0x04;
 constexpr uint8_t FLAG_HAS_LAST_MODIFIED = 0x08;
@@ -1196,6 +1197,9 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   // python inflates; ranges address ORIGINAL bytes, so a compressed
   // needle with a Range header must inflate there too
   if (compressed && (!r.accept_gzip || r.range)) return false;
+  // chunk-manifest needles reassemble server-side from sub-fids
+  // (tryHandleChunkedFile) — python owns that path
+  if (flags & FLAG_IS_CHUNK_MANIFEST) return false;
   const uint8_t* mime = nullptr;
   size_t mime_len = 0;
   const uint8_t* body_end = p + HEADER + size;
@@ -1456,6 +1460,36 @@ bool handle_delete(Conn* c, const Request& r, uint32_t vid, uint64_t key,
     n_jwt_reject++;
     simple_response(c, 401, "jwt rejected", r.keep_alive);
     return true;
+  }
+  if (!r.is_replicate) {
+    // chunk-manifest needles cascade their chunk deletes in python
+    // (_delete_fid -> delete_chunks); tombstoning one natively would
+    // orphan every chunk forever. Probe the stored flag byte — two
+    // preads, and only on the client-facing delete path.
+    int64_t probe_off = -1;
+    int32_t probe_sz = 0;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      auto it = v->map.find(key);
+      if (it != v->map.end() && it->second.size > 0) {
+        probe_off = it->second.offset;
+        probe_sz = it->second.size;
+      }
+    }
+    if (probe_off >= 0) {
+      uint8_t hdr[20];
+      if (pread(v->dat_fd, hdr, sizeof hdr, probe_off) ==
+          (ssize_t)sizeof hdr) {
+        uint32_t data_size = be32(hdr + 16);
+        if ((int64_t)data_size + 5 <= probe_sz) {
+          uint8_t flag = 0;
+          if (pread(v->dat_fd, &flag, 1,
+                    probe_off + 20 + (int64_t)data_size) == 1 &&
+              (flag & FLAG_IS_CHUNK_MANIFEST))
+            return false;  // relay: python cascades
+        }
+      }
+    }
   }
   int64_t reclaimed = 0;
   int st = delete_tomb(v, key, &reclaimed);
@@ -4090,6 +4124,21 @@ int dp_lookup(uint32_t vid, uint64_t key, int64_t* out_byte_off,
   std::lock_guard<std::mutex> lk(v->mu);
   auto it = v->map.find(key);
   if (it == v->map.end() || it->second.size <= 0) return 0;
+  *out_byte_off = it->second.offset;
+  *out_size = it->second.size;
+  return 1;
+}
+
+// Raw entry including tombstones (size < 0, original offset kept) —
+// the python ?readDeleted=true path needs the offset of a deleted
+// needle whose record still sits in the .dat.
+int dp_lookup_any(uint32_t vid, uint64_t key, int64_t* out_byte_off,
+                  int32_t* out_size) {
+  std::shared_ptr<Vol> v = find_vol(vid);
+  if (!v) return -ENOENT;
+  std::lock_guard<std::mutex> lk(v->mu);
+  auto it = v->map.find(key);
+  if (it == v->map.end()) return 0;
   *out_byte_off = it->second.offset;
   *out_size = it->second.size;
   return 1;

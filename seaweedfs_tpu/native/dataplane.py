@@ -80,6 +80,9 @@ def _load() -> ctypes.CDLL:
         lib.dp_lookup.argtypes = [ctypes.c_uint32, ctypes.c_uint64, i64p,
                                   i32p]
         lib.dp_lookup.restype = ctypes.c_int
+        lib.dp_lookup_any.argtypes = [ctypes.c_uint32, ctypes.c_uint64,
+                                      i64p, i32p]
+        lib.dp_lookup_any.restype = ctypes.c_int
         lib.dp_stats.argtypes = [ctypes.c_uint32, i64p]
         lib.dp_stats.restype = ctypes.c_int
         lib.dp_export.argtypes = [ctypes.c_uint32, u64p, i64p, i32p,
@@ -311,6 +314,16 @@ class DataPlane:
             return int(off.value), int(size.value)
         return None
 
+    def lookup_any(self, vid: int, key: int) -> tuple[int, int] | None:
+        """Raw map entry incl. tombstones (size<0) — readDeleted."""
+        off = ctypes.c_int64(0)
+        size = ctypes.c_int32(0)
+        rc = self._lib.dp_lookup_any(vid, key, ctypes.byref(off),
+                                     ctypes.byref(size))
+        if rc == 1:
+            return int(off.value), int(size.value)
+        return None
+
     def stats(self, vid: int) -> dict:
         out = (ctypes.c_int64 * 9)()
         rc = self._lib.dp_stats(vid, out)
@@ -361,6 +374,14 @@ class NativeNeedleMap:
 
     def get(self, key: int) -> tuple[int, int] | None:
         hit = self._dp.lookup(self._vid, key)
+        if hit is None:
+            return None
+        byte_off, size = hit
+        return byte_off // t.NEEDLE_PADDING, size
+
+    def get_any(self, key: int) -> tuple[int, int] | None:
+        """Raw entry incl. tombstones (readDeleted path)."""
+        hit = self._dp.lookup_any(self._vid, key)
         if hit is None:
             return None
         byte_off, size = hit

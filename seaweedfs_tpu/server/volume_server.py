@@ -447,7 +447,8 @@ class VolumeServer:
             return await self._delete_fid(req, fid, vid, key)
         return web.Response(status=405)
 
-    async def _inline_or_thread(self, v, inline_ok: bool, fn, *args):
+    async def _inline_or_thread(self, v, inline_ok: bool, fn, *args,
+                                **kwargs):
         """Run `fn` inline on the event loop only when it is cheap
         (caller's `inline_ok`) AND the volume's write_lock is free —
         a vacuum commit holds it across the .dat/.idx swap (seconds
@@ -457,10 +458,90 @@ class VolumeServer:
         if inline_ok and v is not None and \
                 v.write_lock.acquire(blocking=False):
             try:
-                return fn(*args)
+                return fn(*args, **kwargs)
             finally:
                 v.write_lock.release()
-        return await asyncio.to_thread(fn, *args)
+        return await asyncio.to_thread(fn, *args, **kwargs)
+
+    async def _serve_chunked_manifest(self, req, manifest_body: bytes,
+                                      is_gzip: bool,
+                                      headers: dict) -> web.Response:
+        """GET/HEAD of a chunk-manifest needle: fetch ONLY the bytes
+        the request asks for — a HEAD reads nothing, a ranged read
+        fetches its spans, and a full GET streams span by span so a
+        multi-GB legacy chunked file never materializes in memory
+        (the reference streams through ChunkedFileReader the same
+        way, chunked_file.go:42)."""
+        from ..filer.stream import stream_content
+        from ..operation.chunked_file import load_chunk_manifest
+
+        cm = load_chunk_manifest(manifest_body, is_gzip)
+        chunks = cm.as_file_chunks()
+        total = cm.size
+        headers["X-File-Store"] = "chunked"
+        ct = "application/octet-stream"
+        if cm.mime and not cm.mime.startswith(
+                "application/octet-stream"):
+            ct = cm.mime
+        elif cm.name:
+            import mimetypes
+
+            ct = mimetypes.guess_type(cm.name)[0] \
+                or "application/octet-stream"
+        if req.method == "HEAD":
+            headers["Content-Length"] = str(total)
+            return web.Response(status=200, headers=headers,
+                                content_type=ct)
+
+        def _span(off: int, ln: int):
+            return asyncio.to_thread(stream_content,
+                                     self._lookup_fid_url, chunks,
+                                     off, ln)
+
+        rng = req.headers.get("Range")
+        if rng:
+            ranges = httprange.parse_range_header(rng, total)
+            if ranges in (httprange.MALFORMED, httprange.UNSATISFIABLE):
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{total}"})
+            if ranges and ranges is not httprange.IGNORE:
+                if len(ranges) == 1:
+                    s, ln = ranges[0]
+                    headers["Content-Range"] = httprange.content_range(
+                        s, ln, total)
+                    return web.Response(status=206,
+                                        body=await _span(s, ln),
+                                        content_type=ct,
+                                        headers=headers)
+                spans = await asyncio.gather(
+                    *(_span(s, ln) for s, ln in ranges))
+                mbody, mct = httprange.multipart_byteranges(
+                    [(s, ln, d)
+                     for (s, ln), d in zip(ranges, spans)], ct, total)
+                headers["Content-Type"] = mct
+                return web.Response(status=206, body=mbody,
+                                    headers=headers)
+        # full GET: stream in bounded windows (O(window) memory)
+        headers["Content-Length"] = str(total)
+        headers["Content-Type"] = ct
+        resp = web.StreamResponse(status=200, headers=headers)
+        await resp.prepare(req)
+        window = 8 << 20
+        for off in range(0, total, window):
+            await resp.write(await _span(off, min(window, total - off)))
+        await resp.write_eof()
+        return resp
+
+    def _lookup_fid_url(self, fid: str) -> str:
+        """fid -> url via a lazily-built master client (chunk-manifest
+        reassembly + cascade delete need cross-volume lookups)."""
+        mc = getattr(self, "_mc", None)
+        if mc is None:
+            from ..wdclient.client import MasterClient
+
+            mc = self._mc = MasterClient(self.masters)
+        return mc.lookup_file_id(fid)
 
     async def _read_fid(self, req, vid, key, cookie) -> web.Response:
         start = time.perf_counter()
@@ -479,13 +560,16 @@ class VolumeServer:
             # a network call that would block the event loop — and can
             # deadlock outright when the tier bucket lives on this same
             # cluster (s3 gateway -> filer -> this very server)
+            read_deleted = req.query.get("readDeleted") == "true"
             v = self.store.find_volume(vid)
             inline_ok = (
-                v is not None and not getattr(v.dat, "remote", True)
+                not read_deleted
+                and v is not None and not getattr(v.dat, "remote", True)
                 and self.store.needle_size(vid, key) <= (64 << 10)
                 and vid not in self.store.ec_volumes)
             n = await self._inline_or_thread(
-                v, inline_ok, self.store.read_needle, vid, key, cookie)
+                v, inline_ok, self.store.read_needle, vid, key, cookie,
+                read_deleted=read_deleted)
         except KeyError:
             return web.Response(status=404)
         except PermissionError:
@@ -508,6 +592,17 @@ class VolumeServer:
         body = n.data
         is_gzip = n.is_compressed
         ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if n.is_chunk_manifest and req.query.get("cm") != "false":
+            # legacy chunked file: the needle body is a manifest of
+            # sub-fids; reassemble server-side
+            # (volume_server_handlers_read.go:254 tryHandleChunkedFile;
+            # ?cm=false serves the raw manifest JSON)
+            try:
+                return await self._serve_chunked_manifest(
+                    req, body, is_gzip, headers)
+            except (ValueError, KeyError, LookupError, OSError) as e:
+                return web.Response(
+                    status=500, text=f"chunked manifest: {e}")
         # image renditions (volume_server_handlers_read.go:294-353);
         # a compressed image must be inflated before PIL sees it
         if ("width" in req.query or "height" in req.query):
@@ -606,6 +701,11 @@ class VolumeServer:
             n.mime = req.query["mime"].encode("latin-1", "replace")
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
+        if req.query.get("cm") in ("true", "1"):
+            # the body is a chunk manifest of sub-fids
+            # (needle_parse_upload.go:186 IsChunkedFile); reads
+            # reassemble, deletes cascade
+            n.flags |= ndl.FLAG_IS_CHUNK_MANIFEST
         # custom metadata pairs: Seaweed-* headers stored as JSON in
         # the needle (needle_parse_upload.go parsePairs)
         pairs = {k: v for k, v in req.headers.items()
@@ -667,11 +767,45 @@ class VolumeServer:
             self.guard.check(req.headers.get("Authorization"), fid)
         except PermissionError as e:
             return web.Response(status=401, text=str(e))
+        manifest_size = 0
+        # deleting a chunk manifest deletes its chunks FIRST
+        # (volume_server_handlers_write.go:112-124) so the data can't
+        # be orphaned by a manifest-only delete. Only the PRIMARY
+        # cascades: a ?type=replicate delete is the fan-out of a
+        # primary that already did (re-running it per replica would
+        # re-delete chunks N times and fail replication on a lookup
+        # hiccup)
+        if req.query.get("type") != "replicate":
+            try:
+                n = await asyncio.to_thread(
+                    self.store.read_needle, vid, key)
+            except (KeyError, PermissionError):
+                n = None  # absent needle: plain delete decides
+            except (ValueError, IOError):
+                n = None  # unreadable: still allow the tombstone
+            if n is not None and n.is_chunk_manifest:
+                from ..operation.chunked_file import (delete_chunks,
+                                                      load_chunk_manifest)
+
+                try:
+                    cm = load_chunk_manifest(n.data, n.is_compressed)
+                except ValueError as e:
+                    return web.json_response(
+                        {"error": f"load chunks manifest: {e}"},
+                        status=500)
+                failed = await asyncio.to_thread(
+                    delete_chunks, self._lookup_fid_url, cm)
+                if failed:
+                    return web.json_response(
+                        {"error": f"delete chunks failed: {failed}"},
+                        status=500)
+                manifest_size = cm.size
         try:
             size = await asyncio.to_thread(
                 self.store.delete_needle, vid, key)
         except KeyError:
             return web.Response(status=404)
+        size = manifest_size or size
         if req.query.get("type") != "replicate":
             err = await self._replicate(req, fid, b"", "DELETE")
             if err:

@@ -46,6 +46,11 @@ class NeedleMap:
             return None
         return v
 
+    def get_any(self, key: int) -> tuple[int, int] | None:
+        """Raw entry including tombstones (size<0) — the
+        ?readDeleted=true read path (volume_read.go:29)."""
+        return self._m.get(key)
+
     def put(self, key: int, offset: int, size: int) -> None:
         old = self._m.get(key)
         if old is not None and t.size_is_valid(old[1]):
@@ -232,6 +237,10 @@ class CompactNeedleMap:
         if v is None or t.size_is_deleted(v[1]):
             return None
         return v
+
+    def get_any(self, key: int) -> tuple[int, int] | None:
+        """Raw entry including tombstones (readDeleted path)."""
+        return self._lookup(key)
 
     def put(self, key: int, offset: int, size: int) -> None:
         old = self._lookup(key)
@@ -450,6 +459,11 @@ class BtreeNeedleMap:
         if v is None or t.size_is_deleted(v[1]):
             return None
         return v
+
+    def get_any(self, key: int) -> tuple[int, int] | None:
+        """Raw row including tombstones (readDeleted path)."""
+        with self._lock:
+            return self._lookup(key)
 
     def _bump(self) -> None:
         self._dirty += 1

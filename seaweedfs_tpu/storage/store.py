@@ -174,10 +174,12 @@ class Store:
         return v.append_needle(n)
 
     def read_needle(self, vid: int, needle_id: int,
-                    cookie: int | None = None) -> Needle:
+                    cookie: int | None = None,
+                    read_deleted: bool = False) -> Needle:
         v = self.find_volume(vid)
         if v is not None:
-            return v.read_needle(needle_id, cookie)
+            return v.read_needle(needle_id, cookie,
+                                 read_deleted=read_deleted)
         if vid in self.ec_volumes:
             return self.read_ec_needle(vid, needle_id, cookie)
         raise KeyError(f"volume {vid} not found")

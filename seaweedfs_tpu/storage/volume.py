@@ -233,9 +233,10 @@ class Volume:
             return reclaimed
 
     # -- read path -----------------------------------------------------
-    def read_needle(self, needle_id: int, cookie: int | None = None) -> ndl.Needle:
+    def read_needle(self, needle_id: int, cookie: int | None = None,
+                    read_deleted: bool = False) -> ndl.Needle:
         try:
-            return self._read_needle_once(needle_id, cookie)
+            return self._read_needle_once(needle_id, cookie, read_deleted)
         except PermissionError:
             raise  # cookie mismatch is definitive, never retry-worthy
         except (ValueError, OSError, struct.error):
@@ -245,11 +246,32 @@ class Volume:
             # one retry serialized behind it reads consistent state;
             # a repeat failure is real corruption and propagates.
             with self.write_lock:
-                return self._read_needle_once(needle_id, cookie)
+                return self._read_needle_once(needle_id, cookie,
+                                              read_deleted)
 
     def _read_needle_once(self, needle_id: int,
-                          cookie: int | None = None) -> ndl.Needle:
+                          cookie: int | None = None,
+                          read_deleted: bool = False) -> ndl.Needle:
         loc = self.nm.get(needle_id)
+        if loc is None and read_deleted:
+            # ?readDeleted=true (volume_read.go:29): the tombstoned
+            # map entry keeps the ORIGINAL offset until vacuum/reload;
+            # the magnitude lives in the needle's own header on disk
+            raw = getattr(self.nm, "get_any", lambda _k: None)(needle_id)
+            # offset 0 = superblock, never needle data: a tombstone
+            # REloaded from .idx carries offset 0 (append_entry writes
+            # it that way), so post-restart the original offset is
+            # genuinely unknown and the read must 404, not decode the
+            # superblock as a needle header
+            if raw is not None and raw[0] != 0 \
+                    and t.size_is_deleted(raw[1]):
+                hdr_off = t.offset_to_actual(raw[0])
+                hdr = self.dat.read_at(t.NEEDLE_HEADER_SIZE, hdr_off)
+                if len(hdr) == t.NEEDLE_HEADER_SIZE:
+                    disk_sz = t.u32_to_size(
+                        struct.unpack_from(">I", hdr, 12)[0])
+                    if t.size_is_valid(disk_sz):
+                        loc = (raw[0], disk_sz)
         if loc is None:
             raise KeyError(f"needle {needle_id} not found")
         stored_offset, size = loc
