@@ -25,6 +25,41 @@ def is_image_mime(mime: str) -> bool:
     return mime.split(";")[0].strip().lower() in _FORMATS
 
 
+def cropped(data: bytes, mime: str, x1: int, y1: int,
+            x2: int, y2: int) -> bytes:
+    """Crop to the (x1,y1)-(x2,y2) rectangle — the ?crop_x1=… GET
+    params (volume_server_handlers_read.go:336 shouldCropImages +
+    images/cropping.go Cropped; applied BEFORE any resize, like the
+    reference). The reference crops png/jpeg/gif only and serves the
+    original when the rectangle falls outside the image or the bytes
+    don't decode; same here."""
+    kind = mime.split(";")[0].strip().lower()
+    if kind not in ("image/png", "image/jpeg", "image/gif"):
+        return data
+    try:
+        from PIL import Image
+    except ImportError:
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        img.load()
+    except Exception:
+        return data
+    w, h = img.size
+    if x2 > w or y2 > h:  # cropping.go:24 out-of-bounds -> original
+        return data
+    # clamp the origin into bounds: PIL pads negative coordinates
+    # with black, the reference's crop intersects with the image
+    x1, y1 = max(0, x1), max(0, y1)
+    out = img.crop((x1, y1, x2, y2))
+    fmt = _FORMATS[kind]
+    if fmt == "JPEG" and out.mode not in ("RGB", "L"):
+        out = out.convert("RGB")
+    buf = io.BytesIO()
+    out.save(buf, format=fmt)
+    return buf.getvalue()
+
+
 def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
             mode: str = "") -> bytes:
     """Return a resized rendition of `data`, or the original bytes when

@@ -140,12 +140,26 @@ class VolumeLayout:
             if len(nodes) < rp.copy_count:
                 self.writable.discard(vid)
 
-    def pick_for_write(self, rng: random.Random) -> tuple[int, list[DataNode]]:
+    def pick_for_write(self, rng: random.Random,
+                       preferred_dc: str = "") -> tuple[int, list[DataNode]]:
         if not self.writable:
             raise NoWritableVolume(
                 f"no writable volumes for {self.key.collection!r} "
                 f"rp={self.key.replication}")
-        vid = rng.choice(sorted(self.writable))
+        candidates = sorted(self.writable)
+        if preferred_dc:
+            # ?dataCenter= assign affinity (volume_layout.go
+            # PickForWrite's option.DataCenter filter). A HARD filter,
+            # like the reference: no writable volume in the dc raises,
+            # and the master's grow path then creates one THERE
+            candidates = [vid for vid in candidates
+                          if any(n.rack.dc.id == preferred_dc
+                                 for n in self.locations.get(vid, []))]
+            if not candidates:
+                raise NoWritableVolume(
+                    f"no writable volumes in dc {preferred_dc!r} for "
+                    f"{self.key.collection!r}")
+        vid = rng.choice(candidates)
         return vid, self.locations[vid]
 
 
@@ -339,11 +353,12 @@ class Topology:
     def pick_for_write(self, collection: str = "", replication: str = "000",
                        ttl: tuple[int, int] = (0, 0),
                        count: int = 1,
-                       disk_type: str = "") -> tuple[int, list[DataNode]]:
+                       disk_type: str = "",
+                       preferred_dc: str = "") -> tuple[int, list[DataNode]]:
         with self.lock:
             layout = self._layout(collection, replication, ttl,
                                   disk_type)
-            return layout.pick_for_write(self.rng)
+            return layout.pick_for_write(self.rng, preferred_dc)
 
     def next_volume_id(self) -> int:
         with self.lock:

@@ -140,11 +140,12 @@ class FilerServer:
 
     async def _assign_async(self, collection: str, replication: str,
                             ttl: str, disk_type: str,
-                            fresh: bool = False) -> tuple[str, str, str]:
+                            fresh: bool = False,
+                            data_center: str = "") -> tuple[str, str, str]:
         """-> (volume url, fid, auth) from the batched allocator.
         `fresh` bypasses the pool after an upload failure (the pooled
         placement may have gone read-only/full)."""
-        key = (collection, replication, ttl, disk_type)
+        key = (collection, replication, ttl, disk_type, data_center)
         pools = getattr(self, "_fid_pools", None)
         if pools is None:
             pools = self._fid_pools = {}
@@ -166,6 +167,8 @@ class FilerServer:
             params["ttl"] = ttl
         if disk_type:
             params["disk"] = disk_type
+        if data_center:
+            params["dataCenter"] = data_center
         resp = await self._http().request(
             "GET", f"{self.master_url}/dir/assign", params=params)
         body = resp.json()
@@ -183,7 +186,9 @@ class FilerServer:
 
     async def _upload_chunk_async(self, data: bytes, name: str,
                                   collection: str, replication: str,
-                                  ttl: str, disk_type: str
+                                  ttl: str, disk_type: str,
+                                  fsync: bool = False,
+                                  data_center: str = ""
                                   ) -> tuple[str, str, bytes]:
         """Event-loop twin of _upload_chunk. Compressible payloads
         still ship the filename (the volume server's gzip heuristic
@@ -197,6 +202,8 @@ class FilerServer:
             ckey = cip.gen_cipher_key()
             data = cip.encrypt(data, ckey)
         params = {}
+        if fsync:  # ?fsync=true / filer.conf rule: durable before ack
+            params["fsync"] = "true"
         if not self.cipher and name and compression.is_compressible(
                 mimetypes.guess_type(name)[0] or "", name):
             params["name"] = name
@@ -204,7 +211,7 @@ class FilerServer:
         for attempt in range(3):
             url, fid, auth = await self._assign_async(
                 collection, replication, ttl, disk_type,
-                fresh=attempt > 0)
+                fresh=attempt > 0, data_center=data_center)
             headers = {"Content-Type": "application/octet-stream"}
             if auth:
                 headers["Authorization"] = f"Bearer {auth}"
@@ -486,9 +493,28 @@ class FilerServer:
         if entry is None:
             return web.json_response(
                 {"error": f"not found: {path}"}, status=404)
-        if "meta" in req.query:  # before the dir branch: directory
-            return web.json_response(entry.to_dict())  # entries have
-        if entry.is_directory:                         # metadata too
+        # ?metadata=true is the reference's param name
+        # (filer_server_handlers_read.go:118); ?meta=1 is the older
+        # local spelling, kept for compatibility. Checked before the
+        # dir branch: directory entries have metadata too.
+        if "meta" in req.query or req.query.get("metadata") == "true":
+            d = entry.to_dict()
+            if req.query.get("resolveManifest") == "true" \
+                    and entry.chunks:
+                # expand manifest chunks into their data chunks
+                # (handlers_read.go:137 ResolveChunkManifest)
+                try:
+                    resolved = await asyncio.to_thread(
+                        resolve_chunk_manifest,
+                        lambda fid: read_fid(self._lookup_fid, fid),
+                        entry.chunks)
+                except Exception as e:
+                    return web.json_response(
+                        {"error": f"failed to resolve chunk "
+                                  f"manifest: {e}"}, status=500)
+                d["chunks"] = [c.to_dict() for c in resolved]
+            return web.json_response(d)
+        if entry.is_directory:
             return await self._list_dir(req, path)
         # uncached remote entry: metadata only, bytes still in the
         # cloud — read through (filer_server_handlers_read.go remote
@@ -765,6 +791,10 @@ class FilerServer:
             or rule.replication or self.replication
         ttl = req.query.get("ttl", "") or rule.ttl
         disk_type = req.query.get("disk", "") or rule.disk_type
+        # durable-before-ack chunk writes: the query param or a
+        # filer.conf path rule (detectStorageOption, handlers_write.go:86)
+        fsync = req.query.get("fsync") == "true" or rule.fsync
+        data_center = req.query.get("dataCenter", "")
         chunk_size = int(req.query.get("maxMB", "0")) << 20 or \
             self.chunk_size
 
@@ -838,7 +868,7 @@ class FilerServer:
                     md5_all.update(piece)
                 task = asyncio.ensure_future(self._upload_chunk_async(
                     piece, filename, collection, replication, ttl,
-                    disk_type))
+                    disk_type, fsync=fsync, data_center=data_center))
                 pending.append((offset, len(piece), task))
                 offset += len(piece)
                 total += len(piece)
@@ -872,7 +902,8 @@ class FilerServer:
         if len(chunks) >= MANIFEST_BATCH:
             def _save_manifest(b: bytes):
                 fid, _etag, ckey = self._upload_chunk(
-                    b, filename, collection, replication, ttl, disk_type)
+                    b, filename, collection, replication, ttl, disk_type,
+                    fsync=fsync, data_center=data_center)
                 return fid, ckey
 
             chunks = await asyncio.to_thread(
@@ -971,7 +1002,9 @@ class FilerServer:
 
     def _upload_chunk(self, data: bytes, name: str, collection: str,
                       replication: str, ttl: str,
-                      disk_type: str = "") -> tuple[str, str, bytes]:
+                      disk_type: str = "",
+                      fsync: bool = False,
+                      data_center: str = "") -> tuple[str, str, bytes]:
         """-> (fid, etag, cipher_key). With -encryptVolumeData the
         volume server receives only ciphertext; the etag stays the md5
         of the PLAINTEXT so content addressing (S3 ETag, sync
@@ -985,8 +1018,11 @@ class FilerServer:
             data = cip.encrypt(data, ckey)
         a = verbs.assign(self.master_url, collection=collection,
                          replication=replication, ttl=ttl,
-                         disk_type=disk_type)
-        verbs.upload(a, data, name=name)
+                         disk_type=disk_type, data_center=data_center)
+        url = f"http://{a.url}/{a.fid}"
+        if fsync:
+            url += "?fsync=true"
+        verbs.upload(url, data, name=name, auth=a.auth)
         return a.fid, etag, ckey
 
     async def handle_delete(self, req: web.Request) -> web.Response:
@@ -998,11 +1034,19 @@ class FilerServer:
         recursive = req.query.get("recursive", "") in ("true", "1")
         delete_chunks = req.query.get("skipChunkDeletion", "") \
             not in ("true", "1")
-        await asyncio.to_thread(
-            self.filer.delete_entry,
-            path, recursive=recursive, delete_chunks=delete_chunks,
-            signatures=_parse_signatures(
-                req.query.get("signatures", "")))
+        try:
+            await asyncio.to_thread(
+                self.filer.delete_entry,
+                path, recursive=recursive, delete_chunks=delete_chunks,
+                signatures=_parse_signatures(
+                    req.query.get("signatures", "")))
+        except OSError:
+            # mid-walk failure on a recursive delete: the reference's
+            # ?ignoreRecursiveError=true tolerates it and keeps what
+            # was already deleted (handlers_write.go:195)
+            if not (recursive and req.query.get(
+                    "ignoreRecursiveError") == "true"):
+                raise
         return web.json_response({}, status=204)
 
     # -- KV -------------------------------------------------------------

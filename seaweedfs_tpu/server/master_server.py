@@ -239,7 +239,8 @@ class MasterServer:
         disk = q.get("disk", "")
         try:
             vid, nodes = self.topo.pick_for_write(collection, replication,
-                                                  ttl, disk_type=disk)
+                                                  ttl, disk_type=disk,
+                                                  preferred_dc=dc or "")
         except NoWritableVolume:
             try:
                 await self._grow(collection, replication, ttl, dc,
@@ -248,11 +249,20 @@ class MasterServer:
                 return json_error(str(e), status=500)
             try:
                 vid, nodes = self.topo.pick_for_write(
-                    collection, replication, ttl, disk_type=disk)
+                    collection, replication, ttl, disk_type=disk,
+                    preferred_dc=dc or "")
             except NoWritableVolume as e:
                 return json_error(str(e), status=500)
         key = self.seq.next_ids(count)
         node = nodes[0]
+        if dc:
+            # the returned upload target must be IN the requested dc,
+            # not merely a volume that has some replica there — the
+            # point of the param is dc-local ingest
+            for cand in nodes:
+                if cand.rack.dc.id == dc:
+                    node = cand
+                    break
         fid = t.format_file_id(vid, key, _new_cookie())
         return json_ok({
             "fid": fid,
@@ -310,8 +320,13 @@ class MasterServer:
         async with self._grow_lock:
             if not force:
                 try:
+                    # the contention check must honor the same dc
+                    # constraint as the assign that failed, or a
+                    # writable volume ELSEWHERE suppresses the growth
+                    # the dc-pinned assign is waiting for
                     self.topo.pick_for_write(collection, replication,
-                                             ttl, disk_type=disk_type)
+                                             ttl, disk_type=disk_type,
+                                             preferred_dc=dc or "")
                     return 0
                 except NoWritableVolume:
                     pass

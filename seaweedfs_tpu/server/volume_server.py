@@ -604,7 +604,30 @@ class VolumeServer:
                 return web.Response(
                     status=500, text=f"chunked manifest: {e}")
         # image renditions (volume_server_handlers_read.go:294-353);
-        # a compressed image must be inflated before PIL sees it
+        # a compressed image must be inflated before PIL sees it.
+        # Crop runs BEFORE resize, exactly like the reference's
+        # conditionallyCropImages -> conditionallyResizeImages chain
+        if "crop_x2" in req.query or "crop_y2" in req.query:
+            from .. import images
+
+            try:
+                x1 = int(req.query.get("crop_x1", "0") or 0)
+                y1 = int(req.query.get("crop_y1", "0") or 0)
+                x2 = int(req.query.get("crop_x2", "0") or 0)
+                y2 = int(req.query.get("crop_y2", "0") or 0)
+            except ValueError:
+                x1 = y1 = x2 = y2 = 0
+            croppable = ct.split(";")[0].strip().lower() in (
+                "image/png", "image/jpeg", "image/gif")
+            if x2 > x1 and y2 > y1 and croppable:
+                if is_gzip:
+                    from ..utils import compression
+
+                    body = await asyncio.to_thread(
+                        compression.ungzip, body)
+                    is_gzip = False
+                body = await asyncio.to_thread(
+                    images.cropped, body, ct, x1, y1, x2, y2)
         if ("width" in req.query or "height" in req.query):
             from .. import images
 
@@ -743,6 +766,15 @@ class VolumeServer:
                     self.store.find_volume(vid),
                     len(n.data) <= (64 << 10),
                     self.store.write_needle, vid, n)
+                if req.query.get("fsync") in ("true", "1"):
+                    # ?fsync=true: durable before the ack (the filer
+                    # forwards its own ?fsync / filer.conf fsync rule
+                    # here; volume_server_handlers_write.go honors the
+                    # same param). fsync is per-inode, so the python
+                    # handle syncs appends made by the native front too.
+                    v_f = self.store.find_volume(vid)
+                    if v_f is not None and hasattr(v_f.dat, "sync"):
+                        await asyncio.to_thread(v_f.dat.sync)
             except KeyError:
                 return web.Response(status=404)
             except PermissionError as e:
@@ -843,6 +875,11 @@ class VolumeServer:
             self._invalidate_lookup(vid)
             return f"volume {vid}: no replica peers resolvable"
         params = {"type": "replicate"}
+        if req.query.get("fsync") in ("true", "1"):
+            # an fsync'd write must be durable on EVERY copy before
+            # the ack, not just the primary (ReplicatedWrite forwards
+            # the same param)
+            params["fsync"] = "true"
         headers = {}
         # the secondary ALSO guards writes: forward the client's token
         # (same fid claim, still inside its validity window — the

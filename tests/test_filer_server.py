@@ -225,3 +225,65 @@ class TestMetaSubscription:
         ev = got.get(timeout=5)
         assert ev["directory"].startswith("/watched")
         assert ev["new_entry"]["full_path"] == "/watched/new.txt"
+
+
+class TestReferenceParams:
+    """The reference's filer HTTP param names (handlers_read.go:118,
+    handlers_write.go:86, :195): ?metadata=true / resolveManifest,
+    ?fsync=true forwarded to the volume POST, ?ignoreRecursiveError,
+    ?dataCenter assign affinity."""
+
+    def test_metadata_true_alias(self, cluster):
+        url = f"{cluster.filer_url}/params/m.txt"
+        assert requests.post(url, data=b"meta body").status_code == 201
+        r = requests.get(url, params={"metadata": "true"})
+        assert r.status_code == 200
+        d = r.json()
+        assert d["full_path"] == "/params/m.txt"
+        assert d["chunks"][0]["size"] == len(b"meta body")
+        # resolveManifest on a plain (non-manifest) file: unchanged
+        r2 = requests.get(url, params={"metadata": "true",
+                                       "resolveManifest": "true"})
+        assert r2.json()["chunks"] == d["chunks"]
+
+    def test_fsync_write_roundtrip(self, cluster):
+        url = f"{cluster.filer_url}/params/durable.bin"
+        r = requests.post(url, data=b"must hit the platter",
+                          params={"fsync": "true"})
+        assert r.status_code == 201, r.text
+        assert requests.get(url).content == b"must hit the platter"
+
+    def test_ignore_recursive_error_param_accepted(self, cluster):
+        requests.post(f"{cluster.filer_url}/params/tree/a.txt",
+                      data=b"a")
+        r = requests.delete(
+            f"{cluster.filer_url}/params/tree",
+            params={"recursive": "true",
+                    "ignoreRecursiveError": "true"})
+        assert r.status_code == 204
+        assert requests.get(
+            f"{cluster.filer_url}/params/tree/a.txt").status_code == 404
+
+
+def test_assign_datacenter_affinity(tmp_path_factory):
+    """?dataCenter steers assigns onto volumes with a copy in that dc
+    (volume_layout.go PickForWrite dc filter)."""
+    c = Cluster(str(tmp_path_factory.mktemp("dcaff")),
+                n_volume_servers=2, volume_size_limit=16 << 20,
+                topology=[("dc1", "r1"), ("dc2", "r1")])
+    try:
+        # force volumes to exist in both dcs
+        for dc in ("dc1", "dc2"):
+            a = requests.get(f"{c.master_url}/dir/assign",
+                             params={"dataCenter": dc}).json()
+            assert "fid" in a, a
+        node_by_dc = {}
+        for s, (dc, _r) in zip(c.stores, [("dc1", "r1"), ("dc2", "r1")]):
+            node_by_dc[dc] = s.public_url
+        for dc in ("dc1", "dc2"):
+            for _ in range(6):
+                a = requests.get(f"{c.master_url}/dir/assign",
+                                 params={"dataCenter": dc}).json()
+                assert a["publicUrl"] == node_by_dc[dc], (dc, a)
+    finally:
+        c.stop()

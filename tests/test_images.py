@@ -73,3 +73,36 @@ class TestReadHook:
         # original untouched
         r2 = requests.get(f"http://{a.url}/{a.fid}")
         assert Image.open(io.BytesIO(r2.content)).size == (120, 80)
+
+
+class TestCrop:
+    def test_cropped_unit(self):
+        data = png_bytes(40, 30)
+        out = images.cropped(data, "image/png", 5, 5, 25, 20)
+        assert Image.open(io.BytesIO(out)).size == (20, 15)
+        # out-of-bounds rectangle: original bytes (cropping.go:24)
+        assert images.cropped(data, "image/png", 0, 0, 400, 300) is data
+        # non-croppable mime (reference crops png/jpeg/gif only)
+        assert images.cropped(data, "image/webp", 0, 0, 10, 10) is data
+
+    def test_crop_then_resize_on_get(self, cluster):
+        a = verbs.assign(cluster.master_url)
+        verbs.upload(a, png_bytes(100, 60), name="crop.png",
+                     mime="image/png")
+        r = requests.get(f"http://{a.url}/{a.fid}",
+                         params={"crop_x1": 10, "crop_y1": 10,
+                                 "crop_x2": 50, "crop_y2": 40})
+        assert r.status_code == 200
+        assert Image.open(io.BytesIO(r.content)).size == (40, 30)
+        # chained with resize: crop first, then scale (reference order)
+        r2 = requests.get(f"http://{a.url}/{a.fid}",
+                          params={"crop_x1": 0, "crop_y1": 0,
+                                  "crop_x2": 50, "crop_y2": 30,
+                                  "width": 25, "height": 15})
+        assert Image.open(io.BytesIO(r2.content)).size == (25, 15)
+
+    def test_negative_origin_clamped(self):
+        data = png_bytes(40, 30)
+        out = images.cropped(data, "image/png", -10, -5, 20, 20)
+        # origin clamps to (0,0): no black padding is fabricated
+        assert Image.open(io.BytesIO(out)).size == (20, 20)
