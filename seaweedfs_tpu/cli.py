@@ -177,6 +177,12 @@ def main(argv: list[str] | None = None) -> int:
                    action="store_true",
                    help="encrypt chunk data on volume servers "
                         "(AES-256-GCM, per-chunk keys in filer metadata)")
+    p.add_argument("-saveToFilerLimit", dest="save_to_filer_limit",
+                   type=int, default=0,
+                   help="files smaller than this many bytes are stored "
+                        "inside the filer metadata entry (no volume "
+                        "round trip); per-request ?saveInside=true "
+                        "forces it")
 
     p = sub.add_parser("s3", help="start an S3 gateway")
     p.add_argument("-port", type=int, default=8333)
@@ -987,7 +993,8 @@ def _run_filer(args) -> int:
                      collection=args.collection,
                      replication=args.replication,
                      store_options=store_options,
-                     cipher=args.encrypt_volume_data)
+                     cipher=args.encrypt_volume_data,
+                     save_to_filer_limit=args.save_to_filer_limit)
     t = ServerThread(fs.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     fs.address = t.address

@@ -7,6 +7,7 @@ are entries with no chunks and the directory mode bit set.
 """
 from __future__ import annotations
 
+import base64
 import os
 import time
 from dataclasses import dataclass, field
@@ -73,6 +74,10 @@ class Entry:
     extended: dict[str, str] = field(default_factory=dict)
     hard_link_id: str = ""
     symlink_target: str = ""
+    # small-file bytes stored INSIDE the metadata entry instead of a
+    # volume chunk (filer_pb Entry.Content — the -saveToFilerLimit /
+    # ?saveInside=true path, filer_server_handlers_write_upload.go:83)
+    content: bytes = b""
 
     def __post_init__(self):
         if not self.mtime:
@@ -95,7 +100,7 @@ class Entry:
 
     @property
     def file_size(self) -> int:
-        return total_size(self.chunks)
+        return max(total_size(self.chunks), len(self.content))
 
     def is_expired(self, now: float | None = None) -> bool:
         if self.ttl_sec <= 0:
@@ -116,6 +121,8 @@ class Entry:
             d["chunks"] = [c.to_dict() for c in self.chunks]
         if self.extended:
             d["extended"] = dict(self.extended)
+        if self.content:
+            d["content"] = base64.b64encode(self.content).decode()
         return d
 
     @classmethod
@@ -130,7 +137,9 @@ class Entry:
             chunks=[FileChunk.from_dict(c) for c in d.get("chunks", [])],
             extended=d.get("extended", {}),
             hard_link_id=d.get("hard_link_id", ""),
-            symlink_target=d.get("symlink_target", ""))
+            symlink_target=d.get("symlink_target", ""),
+            content=base64.b64decode(d["content"])
+            if d.get("content") else b"")
 
 
 def total_size(chunks: list[FileChunk]) -> int:
@@ -144,6 +153,14 @@ def total_size(chunks: list[FileChunk]) -> int:
 def entry_size(entry: dict | None) -> int:
     """total_size for a JSON entry dict (the gateways' wire shape).
     File size is max(offset+size) over chunks, NOT the chunk-size sum —
-    overlapping rewrites keep superseded chunks in the list."""
-    return max((c.get("offset", 0) + c["size"]
-                for c in (entry or {}).get("chunks", [])), default=0)
+    overlapping rewrites keep superseded chunks in the list. Inline
+    small files carry their bytes in `content` (base64) instead."""
+    d = entry or {}
+    chunk_max = max((c.get("offset", 0) + c["size"]
+                     for c in d.get("chunks", [])), default=0)
+    if d.get("content"):
+        # 4 base64 chars encode 3 bytes; padding '=' trims the tail
+        raw = d["content"]
+        inline = len(raw) * 3 // 4 - raw.count("=")
+        return max(chunk_max, inline)
+    return chunk_max

@@ -125,6 +125,10 @@ class Filer:
             if rec is not None:
                 e.chunks = [FileChunk.from_dict(c)
                             for c in rec.get("chunks", [])]
+                if rec.get("content"):
+                    import base64 as _b64
+
+                    e.content = _b64.b64decode(rec["content"])
                 # version stamp: a later save of this entry proves it
                 # saw THIS content (guards metadata-only saves built
                 # from a stale read from clobbering newer writes)
@@ -149,12 +153,20 @@ class Filer:
                 raise FileExistsError(dst_path)
             if not src.hard_link_id:
                 hid = uuid.uuid4().hex
-                self._put_hardlink_record(
-                    hid, {"count": 1,
-                          "chunks": [c.to_dict() for c in src.chunks]})
+                rec0 = {"count": 1,
+                        "chunks": [c.to_dict() for c in src.chunks]}
+                if src.content:
+                    # inline small file: its bytes live in the shared
+                    # record so every NAME serves them
+                    import base64 as _b64
+
+                    rec0["content"] = _b64.b64encode(
+                        src.content).decode()
+                self._put_hardlink_record(hid, rec0)
                 old_src = replace(src)
                 src.hard_link_id = hid
-                self.store.insert_entry(replace(src, chunks=[]))
+                self.store.insert_entry(
+                    replace(src, chunks=[], content=b""))
                 # src changed shape: event consumers (meta backups,
                 # other mounts) must learn its hard_link_id
                 d, _ = src.dir_and_name
@@ -354,6 +366,17 @@ class Filer:
                             if c.get("fid") not in keep)
                         rec["chunks"] = [c.to_dict()
                                          for c in entry.chunks]
+                        # the record holds EITHER chunks or inline
+                        # content — a chunked rewrite must not leave
+                        # stale inline bytes shadowing it (reads
+                        # prefer content)
+                        if entry.content:
+                            import base64 as _b64
+
+                            rec["content"] = _b64.b64encode(
+                                entry.content).decode()
+                        else:
+                            rec.pop("content", None)
                         rec["ver"] = current + 1
                         self._put_hardlink_record(entry.hard_link_id,
                                                   rec)
@@ -366,11 +389,15 @@ class Filer:
                         # (left for volume.fsck's orphan sweep). The
                         # event log must carry what the record ACTUALLY
                         # contains, not the discarded list.
+                        import base64 as _b64
+
                         logged = replace(
                             logged,
                             chunks=[FileChunk.from_dict(c)
-                                    for c in rec.get("chunks", [])])
-                entry = replace(entry, chunks=[])
+                                    for c in rec.get("chunks", [])],
+                            content=_b64.b64decode(rec["content"])
+                            if rec.get("content") else b"")
+                entry = replace(entry, chunks=[], content=b"")
             if gc_old_chunks and old is not None and \
                     not old.is_directory and not old.hard_link_id:
                 # logged always carries the REAL new content (even for

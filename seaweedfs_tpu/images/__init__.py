@@ -51,6 +51,8 @@ def cropped(data: bytes, mime: str, x1: int, y1: int,
     # clamp the origin into bounds: PIL pads negative coordinates
     # with black, the reference's crop intersects with the image
     x1, y1 = max(0, x1), max(0, y1)
+    if x1 >= x2 or y1 >= y2:  # clamping emptied the box
+        return data
     out = img.crop((x1, y1, x2, y2))
     fmt = _FORMATS[kind]
     if fmt == "JPEG" and out.mode not in ("RGB", "L"):

@@ -536,7 +536,14 @@ class WeedFS:
     def write(self, fh: int, offset: int, data: bytes) -> int:
         h = self._handle(fh)
         self._check_quota(len(data))
-        h.dirty.write(offset, data)
+        with h.lock:
+            if h.entry.content and not h.entry.chunks:
+                # inline small file (entry.Content): its bytes become
+                # dirty pages so the flush rewrites the whole file as
+                # chunks — the saved entry then carries no content
+                h.dirty.write(0, h.entry.content)
+                h.entry.content = b""
+            h.dirty.write(offset, data)
         return len(data)
 
     def read(self, fh: int, offset: int, size: int) -> bytes:
@@ -548,14 +555,20 @@ class WeedFS:
         # hitting it poisons the page cache with them
         with h.lock:
             h.pattern.monitor(offset, size)
-            committed_size = total_size(h.entry.chunks)
+            # inline small files carry their bytes in the entry
+            # (entry.Content) — no chunks to fetch
+            inline = h.entry.content if not h.entry.chunks else b""
+            committed_size = total_size(h.entry.chunks) or len(inline)
             out = bytearray(size)
             # committed chunks first
             n_committed = 0
             if offset < committed_size:
                 want = min(size, committed_size - offset)
-                data = self._read_chunks(h.entry.chunks, offset, want,
-                                         h.pattern)
+                if inline:
+                    data = inline[offset:offset + want]
+                else:
+                    data = self._read_chunks(h.entry.chunks, offset,
+                                             want, h.pattern)
                 out[:len(data)] = data
                 n_committed = len(data)
             # dirty overlay wins over committed bytes
@@ -563,8 +576,7 @@ class WeedFS:
             # the readable extent includes unflushed HOLES: a write at
             # offset 1000 makes bytes 0..999 real zeros now, not EOF —
             # pre- and post-flush reads of a sparse file must agree
-            file_size = max(total_size(h.entry.chunks),
-                            self._dirty_extent(h))
+            file_size = max(committed_size, self._dirty_extent(h))
             max_extent = max(
                 [offset + n_committed, min(offset + size, file_size)]
                 + [e for _, e in covered]) - offset
@@ -679,7 +691,11 @@ class WeedFS:
         entry = self._entry(path)
         if entry is None:
             raise FuseError(2)
-        if length == 0:
+        if entry.content and not entry.chunks:
+            # inline file: POSIX truncate semantics on the bytes
+            # themselves (extend pads zeros)
+            entry.content = entry.content[:length].ljust(length, b"\0")
+        elif length == 0:
             entry.chunks = []
         else:
             kept = []

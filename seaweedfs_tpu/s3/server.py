@@ -1282,9 +1282,13 @@ class S3ApiServer:
         # fullmd5: the part entry's md5 must be the md5 of the PART
         # bytes — CompleteMultipartUpload composes the final "-N" etag
         # from them, exactly as AWS does
+        # saveInside=false: complete-multipart assembles the object
+        # from the parts' CHUNKS — a part inlined by -saveToFilerLimit
+        # would contribute nothing and silently truncate the object
         resp = await self._filer("POST", self._fpath(bucket, part_path),
                                  params={"collection": bucket,
-                                         "fullmd5": "1"},
+                                         "fullmd5": "1",
+                                         "saveInside": "false"},
                                  data=payload)
         if resp.status_code >= 300:
             raise S3Error("InternalError", resp.text, 500)
@@ -1320,7 +1324,8 @@ class S3ApiServer:
         part_path = f"{self._upload_dir(bucket, upload_id)}/" \
             f"{part_number:05d}.part"
         resp = await self._filer("POST", self._fpath(bucket, part_path),
-                                 params={"collection": bucket},
+                                 params={"collection": bucket,
+                                         "saveInside": "false"},
                                  data=data.content)
         if resp.status_code >= 300:
             raise S3Error("InternalError", resp.text, 500)

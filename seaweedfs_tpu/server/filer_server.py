@@ -45,12 +45,17 @@ class FilerServer:
                  signature: int = 0,
                  announce_pulse: float = 3.0,
                  store_options: dict | None = None,
-                 cipher: bool = False):
+                 cipher: bool = False,
+                 save_to_filer_limit: int = 0):
         self.master_url = master_url.rstrip("/")
         self.masters = MasterClient(self.master_url)
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        # -saveToFilerLimit: bodies under this many bytes live INSIDE
+        # the metadata entry (entry.content) — zero volume round trips
+        # for tiny files (command/filer.go:85, uploadReaderToChunks:83)
+        self.save_to_filer_limit = save_to_filer_limit
         # -encryptVolumeData: every chunk this filer writes is AES-GCM
         # ciphertext under a per-chunk key kept in the entry metadata
         # (filer_server_handlers_write_cipher.go; util/cipher.go)
@@ -564,6 +569,20 @@ class FilerServer:
             headers["Content-Length"] = str(size if multi else length)
             return web.Response(status=200 if multi else status,
                                 headers=headers, content_type=mime)
+        if entry.content and not entry.chunks and remote_meta is None:
+            # inline small file (entry.Content, filer/stream.go:28):
+            # the bytes live in the metadata entry — no volume trip
+            if multi is not None:
+                parts = [(s, ln, entry.content[s:s + ln])
+                         for s, ln in multi]
+                mbody, mct = httprange.multipart_byteranges(
+                    parts, mime, size)
+                headers["Content-Type"] = mct
+                return web.Response(status=206, body=mbody,
+                                    headers=headers)
+            return web.Response(
+                body=entry.content[offset:offset + length],
+                status=status, headers=headers, content_type=mime)
         client = None
         if remote_meta is not None:
             found = self._remote_client_for(path)
@@ -850,6 +869,23 @@ class FilerServer:
         md5_all = hashlib.md5() if content_md5 \
             or "fullmd5" in req.query else None
         chunks, total, offset = [], 0, 0
+        small_content = b""
+        # inline threshold: the per-request ?saveInside=true or the
+        # filer-wide -saveToFilerLimit; never under -encryptVolumeData
+        # (inline bytes would bypass the cipher)
+        inline_limit = 0
+        if not self.cipher:
+            save_inside = req.query.get("saveInside", "")
+            if save_inside == "true":
+                inline_limit = self.chunk_size
+            elif save_inside == "false":
+                # explicit opt-out overrides -saveToFilerLimit:
+                # internal writers whose readers assemble from chunks
+                # (S3 multipart parts) must never be inlined
+                inline_limit = 0
+            elif self.save_to_filer_limit > 0:
+                inline_limit = min(self.save_to_filer_limit,
+                                   self.chunk_size)
         pending: list[tuple[int, int, asyncio.Task]] = []
 
         async def _collect_oldest():
@@ -866,6 +902,14 @@ class FilerServer:
                     break
                 if md5_all is not None:
                     md5_all.update(piece)
+                if offset == 0 and 0 < len(piece) < chunk_size \
+                        and len(piece) < inline_limit:
+                    # the WHOLE body, under the inline limit: store it
+                    # in the entry, zero volume round trips
+                    # (uploadReaderToChunks:83 smallContent)
+                    small_content = piece
+                    total = len(piece)
+                    break
                 task = asyncio.ensure_future(self._upload_chunk_async(
                     piece, filename, collection, replication, ttl,
                     disk_type, fsync=fsync, data_center=data_center))
@@ -917,6 +961,8 @@ class FilerServer:
                     if k.lower().startswith("x-seaweed-ext-")}
         if md5_all is not None:
             md5_hex = md5_all.hexdigest()
+        elif small_content:
+            md5_hex = hashlib.md5(small_content).hexdigest()
         elif len(chunks) == 1 and not chunks[0].is_chunk_manifest:
             md5_hex = chunks[0].etag  # the chunk md5 IS the file md5
         else:
@@ -925,7 +971,7 @@ class FilerServer:
                       ttl_sec=_ttl_seconds(ttl),
                       md5=md5_hex, collection=collection,
                       replication=replication, chunks=chunks,
-                      extended=extended)
+                      extended=extended, content=small_content)
         await asyncio.to_thread(
             self.filer.create_entry, entry, signatures=signatures,
             gc_old_chunks=True)

@@ -287,3 +287,79 @@ def test_assign_datacenter_affinity(tmp_path_factory):
                 assert a["publicUrl"] == node_by_dc[dc], (dc, a)
     finally:
         c.stop()
+
+
+class TestSaveInside:
+    """Inline small-file storage (entry.Content): ?saveInside=true or
+    -saveToFilerLimit stores the body in the metadata entry —
+    filer_server_handlers_write_upload.go:83, filer/stream.go:28."""
+
+    def test_save_inside_roundtrip(self, cluster):
+        url = f"{cluster.filer_url}/inline/tiny.txt"
+        r = requests.post(url, data=b"lives in metadata",
+                          params={"saveInside": "true"})
+        assert r.status_code == 201, r.text
+        g = requests.get(url)
+        assert g.status_code == 200 and g.content == b"lives in metadata"
+        # ranged read over inline content
+        rr = requests.get(url, headers={"Range": "bytes=9-16"})
+        assert rr.status_code == 206 and rr.content == b"metadata"
+        # the entry really is chunkless with inline content
+        m = requests.get(url, params={"metadata": "true"}).json()
+        assert m.get("content") and not m.get("chunks")
+
+    def test_filer_limit_applies(self, cluster):
+        cluster.filer.save_to_filer_limit = 1024
+        try:
+            url = f"{cluster.filer_url}/inline/auto.txt"
+            assert requests.post(url, data=b"x" * 100).status_code == 201
+            m = requests.get(url, params={"metadata": "true"}).json()
+            assert m.get("content") and not m.get("chunks")
+            # and a body over the limit still goes to volumes
+            url2 = f"{cluster.filer_url}/inline/big.txt"
+            assert requests.post(url2,
+                                 data=b"y" * 4096).status_code == 201
+            m2 = requests.get(url2, params={"metadata": "true"}).json()
+            assert m2.get("chunks") and not m2.get("content")
+        finally:
+            cluster.filer.save_to_filer_limit = 0
+
+    def test_overwrite_between_modes_gcs_chunks(self, cluster):
+        url = f"{cluster.filer_url}/inline/swap.txt"
+        assert requests.post(url, data=b"c" * 2048).status_code == 201
+        chunked = requests.get(url, params={"metadata": "true"}).json()
+        assert chunked["chunks"]
+        # overwrite with inline: old chunks must be GC'd, reads serve
+        # the new bytes immediately
+        assert requests.post(url, data=b"now inline",
+                             params={"saveInside": "true"}
+                             ).status_code == 201
+        assert requests.get(url).content == b"now inline"
+        # overwrite back with chunked
+        assert requests.post(url, data=b"d" * 2048).status_code == 201
+        assert requests.get(url).content == b"d" * 2048
+
+    def test_inline_hardlink_and_multipart_guard(self, cluster):
+        # hard link of an inline file: both names serve the bytes
+        url = f"{cluster.filer_url}/inline/orig.txt"
+        assert requests.post(url, data=b"shared inline",
+                             params={"saveInside": "true"}
+                             ).status_code == 201
+        r = requests.post(f"{cluster.filer_url}/inline/alias.txt",
+                          params={"link.from": "/inline/orig.txt"})
+        assert r.status_code == 201, r.text
+        assert requests.get(
+            f"{cluster.filer_url}/inline/alias.txt"
+        ).content == b"shared inline"
+        assert requests.get(url).content == b"shared inline"
+        # saveInside=false opt-out beats the filer-wide limit
+        cluster.filer.save_to_filer_limit = 1 << 20
+        try:
+            url2 = f"{cluster.filer_url}/inline/optout.bin"
+            assert requests.post(url2, data=b"z" * 64,
+                                 params={"saveInside": "false"}
+                                 ).status_code == 201
+            m = requests.get(url2, params={"metadata": "true"}).json()
+            assert m.get("chunks") and not m.get("content")
+        finally:
+            cluster.filer.save_to_filer_limit = 0
