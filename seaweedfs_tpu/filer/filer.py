@@ -9,6 +9,7 @@ appended to the metadata event log (filer_notify.go).
 """
 from __future__ import annotations
 
+import base64
 import fnmatch
 import json
 import threading
@@ -126,9 +127,7 @@ class Filer:
                 e.chunks = [FileChunk.from_dict(c)
                             for c in rec.get("chunks", [])]
                 if rec.get("content"):
-                    import base64 as _b64
-
-                    e.content = _b64.b64decode(rec["content"])
+                    e.content = base64.b64decode(rec["content"])
                 # version stamp: a later save of this entry proves it
                 # saw THIS content (guards metadata-only saves built
                 # from a stale read from clobbering newer writes)
@@ -158,9 +157,7 @@ class Filer:
                 if src.content:
                     # inline small file: its bytes live in the shared
                     # record so every NAME serves them
-                    import base64 as _b64
-
-                    rec0["content"] = _b64.b64encode(
+                    rec0["content"] = base64.b64encode(
                         src.content).decode()
                 self._put_hardlink_record(hid, rec0)
                 old_src = replace(src)
@@ -371,9 +368,7 @@ class Filer:
                         # stale inline bytes shadowing it (reads
                         # prefer content)
                         if entry.content:
-                            import base64 as _b64
-
-                            rec["content"] = _b64.b64encode(
+                            rec["content"] = base64.b64encode(
                                 entry.content).decode()
                         else:
                             rec.pop("content", None)
@@ -389,13 +384,11 @@ class Filer:
                         # (left for volume.fsck's orphan sweep). The
                         # event log must carry what the record ACTUALLY
                         # contains, not the discarded list.
-                        import base64 as _b64
-
                         logged = replace(
                             logged,
                             chunks=[FileChunk.from_dict(c)
                                     for c in rec.get("chunks", [])],
-                            content=_b64.b64decode(rec["content"])
+                            content=base64.b64decode(rec["content"])
                             if rec.get("content") else b"")
                 entry = replace(entry, chunks=[], content=b"")
             if gc_old_chunks and old is not None and \

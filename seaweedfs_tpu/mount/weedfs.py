@@ -693,8 +693,20 @@ class WeedFS:
             raise FuseError(2)
         if entry.content and not entry.chunks:
             # inline file: POSIX truncate semantics on the bytes
-            # themselves (extend pads zeros)
-            entry.content = entry.content[:length].ljust(length, b"\0")
+            # themselves (extend pads zeros). A LARGE extend must not
+            # balloon the metadata store — convert to a chunk instead
+            # (the same inline->chunks conversion write() does)
+            padded = entry.content[:length].ljust(length, b"\0")
+            if length > (64 << 10):
+                fid, etag, ckey = self.client.upload_chunk(
+                    padded, name=entry.name)
+                entry.chunks = [FileChunk(
+                    fid=fid, offset=0, size=length,
+                    mtime_ns=time.time_ns(), etag=etag,
+                    cipher_key=ckey)]
+                entry.content = b""
+            else:
+                entry.content = padded
         elif length == 0:
             entry.chunks = []
         else:

@@ -543,6 +543,106 @@ class VolumeServer:
             mc = self._mc = MasterClient(self.masters)
         return mc.lookup_file_id(fid)
 
+    STREAM_READ_LIMIT = 1 << 20  # PagedReadLimit (volume_read.go:15)
+
+    @staticmethod
+    def _needle_headers(n) -> dict:
+        """Response headers a needle read always carries: ETag,
+        Seaweed-* metadata pairs, Last-Modified — one assembly shared
+        by the materialized and streamed read paths."""
+        headers = {"Etag": f'"{n.etag()}"'}
+        if n.pairs:
+            try:
+                for k, v in json.loads(n.pairs).items():
+                    if k.lower().startswith("seaweed-"):
+                        headers[k] = str(v)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+        if n.last_modified:
+            headers["Last-Modified"] = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT",
+                time.gmtime(n.last_modified))
+        return headers
+
+    async def _maybe_stream_big_needle(self, req, vid, key,
+                                       cookie) -> web.Response | None:
+        """Serve a large plain needle in pread windows instead of
+        materializing it (the reference pages needles past
+        PagedReadLimit through streamWriteResponseContent). None =
+        not eligible, take the normal path. Compressed/manifest
+        needles, image transforms, multi-range, readDeleted and
+        remote-backed volumes all fall through — their handling needs
+        the whole body or different machinery."""
+        if req.method != "GET":
+            return None
+        if set(req.query) & {"width", "height", "mode", "crop_x1",
+                             "crop_y1", "crop_x2", "crop_y2",
+                             "readDeleted", "cm"}:
+            return None
+        v = self.store.find_volume(vid)
+        if v is None or getattr(v.dat, "remote", True) \
+                or vid in self.store.ec_volumes:
+            return None
+        try:
+            if self.store.needle_size(vid, key) <= self.STREAM_READ_LIMIT:
+                return None
+        except KeyError:
+            return None
+        try:
+            n, data_size, reader = await asyncio.to_thread(
+                v.read_needle_streamed, key, cookie)
+        except KeyError:
+            return web.Response(status=404)
+        except PermissionError:
+            return web.Response(status=403)
+        except (ValueError, IOError):
+            return None  # surprises re-run through the checked path
+        if n.is_compressed or n.is_chunk_manifest:
+            return None  # needs inflation / reassembly: whole-body path
+        headers = self._needle_headers(n)
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        start_i, length = 0, data_size
+        rng = req.headers.get("Range")
+        status = 200
+        if rng:
+            ranges = httprange.parse_range_header(rng, data_size)
+            if ranges in (httprange.MALFORMED, httprange.UNSATISFIABLE):
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{data_size}"})
+            if ranges and ranges is not httprange.IGNORE:
+                if len(ranges) > 1:
+                    return None  # multipart assembly: whole-body path
+                start_i, length = ranges[0]
+                status = 206
+                headers["Content-Range"] = httprange.content_range(
+                    start_i, length, data_size)
+        headers["Content-Length"] = str(length)
+        headers["Content-Type"] = ct
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(req)
+        t0 = time.perf_counter()
+        window = 4 << 20
+        sent = 0
+        while sent < length:
+            try:
+                piece = await asyncio.to_thread(
+                    reader, start_i + sent, min(window, length - sent))
+            except (ValueError, OSError):
+                # vacuum commit closed the captured handle mid-stream:
+                # close short (the client sees a truncated body, not a
+                # server stack trace) — rare, and a retry reads the
+                # compacted volume cleanly
+                piece = b""
+            if not piece:
+                break
+            await resp.write(piece)
+            sent += len(piece)
+        await resp.write_eof()
+        metrics.histogram_observe("volume_server_read_seconds",
+                                  time.perf_counter() - t0)
+        return resp
+
     async def _read_fid(self, req, vid, key, cookie) -> web.Response:
         start = time.perf_counter()
         if not self.store.has_volume(vid) and \
@@ -553,6 +653,10 @@ class VolumeServer:
                 raise web.HTTPMovedPermanently(
                     f"http://{url}/{req.match_info['fid']}")
             return web.Response(status=404, text=f"volume {vid} not found")
+        streamed = await self._maybe_stream_big_needle(req, vid, key,
+                                                       cookie)
+        if streamed is not None:
+            return streamed
         try:
             # the needle map gives the size in O(1): small reads are a
             # page-cache pread, cheaper inline than a to_thread hop.
@@ -578,17 +682,7 @@ class VolumeServer:
             return web.Response(status=500, text=str(e))
         metrics.histogram_observe("volume_server_read_seconds",
                                   time.perf_counter() - start)
-        headers = {"Etag": f'"{n.etag()}"'}
-        if n.pairs:
-            try:
-                for k, v in json.loads(n.pairs).items():
-                    if k.lower().startswith("seaweed-"):
-                        headers[k] = str(v)
-            except (json.JSONDecodeError, AttributeError):
-                pass
-        if n.last_modified:
-            headers["Last-Modified"] = time.strftime(
-                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+        headers = self._needle_headers(n)
         body = n.data
         is_gzip = n.is_compressed
         ct = n.mime.decode() if n.mime else "application/octet-stream"

@@ -211,7 +211,13 @@ class Needle:
         (data_size,) = struct.unpack_from(">I", body, 0)
         idx = 4
         self.data = body[idx:idx + data_size]
-        idx += data_size
+        self._parse_meta(body, idx + data_size)
+
+    def _parse_meta(self, body: bytes, idx: int) -> None:
+        """Parse the post-data fields ([flags][name][mime][lm][ttl]
+        [pairs]) starting at `idx`. Split out so the streaming read
+        path can parse metadata from a small tail pread without the
+        data bytes in memory."""
         self.flags = body[idx]
         idx += 1
         if self.flags & FLAG_HAS_NAME:

@@ -291,6 +291,60 @@ class Volume:
             raise PermissionError("cookie mismatch")
         return n
 
+    def read_needle_streamed(self, needle_id: int,
+                             cookie: int | None = None):
+        """Open a big needle for WINDOWED serving without materializing
+        its data (the reference's streamed read path — PagedReadLimit,
+        volume_read.go:41 AttemptMetaOnly + paged ReadNeedleDataInto):
+        two small preads fetch the header and the post-data metadata;
+        -> (meta_needle_with_empty_data, data_size, reader) where
+        reader(off, ln) preads the data span [off, off+ln).
+
+        The reader captures THIS DiskFile handle: a concurrent vacuum
+        commit swaps in a new file but the old fd keeps serving a
+        consistent snapshot until it is closed.
+        """
+        loc = self.nm.get(needle_id)
+        if loc is None:
+            raise KeyError(f"needle {needle_id} not found")
+        stored_offset, size = loc
+        offset = t.offset_to_actual(stored_offset)
+        dat = self.dat
+        head = dat.read_at(t.NEEDLE_HEADER_SIZE + 4, offset)
+        if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+            raise ValueError("needle header truncated")
+        ck, nid, size_u32, data_size = struct.unpack(">IQII", head)
+        if nid != needle_id:
+            raise ValueError(
+                f"needle id mismatch: want {needle_id} got {nid}")
+        if t.u32_to_size(size_u32) != size:
+            raise ValueError(f"size mismatch: index {size} vs "
+                             f"disk {t.u32_to_size(size_u32)}")
+        if cookie is not None and ck != cookie:
+            raise PermissionError("cookie mismatch")
+        if data_size + 5 > size:
+            raise ValueError("corrupt needle: data_size exceeds body")
+        n = ndl.Needle(id=nid, cookie=ck)
+        n.size = size
+        data_off = offset + t.NEEDLE_HEADER_SIZE + 4
+        # post-data tail: [flags][name][mime][lm][ttl][pairs][crc]...
+        tail_len = size - 4 - data_size + 4  # meta + stored crc
+        tail = dat.read_at(tail_len, data_off + data_size)
+        try:
+            n._parse_meta(tail, 0)
+        except (IndexError, struct.error) as e:
+            raise ValueError(f"corrupt needle meta: {e}") from e
+        # the stored crc IS the etag; streaming can't re-verify the
+        # payload before bytes go out (the reference's paged path
+        # accepts the same)
+        if len(tail) >= 4:
+            n.checksum = struct.unpack_from(">I", tail, len(tail) - 4)[0]
+
+        def reader(off: int, ln: int) -> bytes:
+            return dat.read_at(ln, data_off + off)
+
+        return n, data_size, reader
+
     # -- maintenance ---------------------------------------------------
     @property
     def version(self) -> int:
