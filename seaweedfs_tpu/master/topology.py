@@ -368,12 +368,16 @@ class Topology:
     # -- growth placement -------------------------------------------------
     def find_empty_slots(self, replication: str = "000",
                          preferred_dc: str | None = None,
-                         disk_type: str = "") -> list[DataNode]:
+                         disk_type: str = "",
+                         preferred_rack: str | None = None,
+                         preferred_node: str | None = None
+                         ) -> list[DataNode]:
         """Choose servers for one volume + replicas honoring the xyz
         placement (volume_growth.go:134-230): randomized main-node pick
         among candidates with enough free slots in the required
         dc/rack/server spread. `disk_type` restricts candidates to
-        servers of that disk class."""
+        servers of that disk class; preferred_rack/preferred_node pin
+        the MAIN copy (the /vol/grow rack/dataNode params)."""
         rp = ReplicaPlacement.parse(replication)
         disk = norm_disk(disk_type)
         with self.lock:
@@ -381,28 +385,41 @@ class Topology:
                    if preferred_dc is None or d.id == preferred_dc]
             self.rng.shuffle(dcs)
             for dc in dcs:
-                result = self._pick_in_dc(dc, rp, disk)
+                result = self._pick_in_dc(dc, rp, disk,
+                                          preferred_rack,
+                                          preferred_node)
                 if result is not None:
                     return result
             raise NoFreeSlots(
                 f"no free slots for replication {replication} "
                 f"on disk type {disk!r}")
 
-    def _pick_in_dc(self, dc: DataCenter, rp,
-                    disk: str) -> list[DataNode] | None:
+    def _pick_in_dc(self, dc: DataCenter, rp, disk: str,
+                    preferred_rack: str | None = None,
+                    preferred_node: str | None = None
+                    ) -> list[DataNode] | None:
         def fits(n: DataNode) -> bool:
             return n.free_slots() > 0 and n.disk_type == disk
 
         def rack_fits(r: Rack) -> bool:
             return any(fits(n) for n in r.nodes.values())
 
-        racks = [r for r in dc.racks.values() if rack_fits(r)]
+        racks = [r for r in dc.racks.values()
+                 if rack_fits(r) and (preferred_rack is None
+                                      or r.id == preferred_rack)]
         self.rng.shuffle(racks)
         for rack in racks:
             nodes = [n for n in rack.nodes.values() if fits(n)]
             if len(nodes) < rp.same_rack + 1:
                 continue
             self.rng.shuffle(nodes)
+            if preferred_node is not None:
+                # the MAIN copy is pinned; replicas spread normally
+                mains = [n for n in nodes if n.id == preferred_node]
+                if not mains:
+                    continue
+                nodes.remove(mains[0])
+                nodes.insert(0, mains[0])
             main, same_rack = nodes[0], nodes[1:rp.same_rack + 1]
             # replicas on other racks in this dc
             other_racks: list[DataNode] = []

@@ -186,6 +186,8 @@ class MasterServer:
             web.get("/cluster/ec_shards", self.handle_ec_shards),
             web.get("/ws/heartbeat", self.handle_heartbeat_ws),
             web.get("/ws/keepconnected", self.handle_keepconnected_ws),
+            web.get("/vol/vacuum", self.handle_vacuum_now),
+            web.post("/vol/vacuum", self.handle_vacuum_now),
             web.post("/vol/vacuum/disable", self.handle_vacuum_toggle),
             web.post("/vol/vacuum/enable", self.handle_vacuum_toggle),
             web.post("/cluster/raft/add", self.handle_raft_membership),
@@ -304,7 +306,9 @@ class MasterServer:
             for _ in range(count):
                 await self._grow(collection, replication, ttl,
                                  q.get("dataCenter") or None, force=True,
-                                 disk_type=q.get("disk", ""))
+                                 disk_type=q.get("disk", ""),
+                                 rack=q.get("rack") or None,
+                                 data_node=q.get("dataNode") or None)
                 grown += 1
         except NoFreeSlots as e:
             return json_error(str(e), status=500)
@@ -312,7 +316,9 @@ class MasterServer:
 
     async def _grow(self, collection: str, replication: str,
                     ttl: tuple[int, int], dc: str | None = None,
-                    force: bool = False, disk_type: str = "") -> int:
+                    force: bool = False, disk_type: str = "",
+                    rack: str | None = None,
+                    data_node: str | None = None) -> int:
         """findAndGrow (volume_growth.go:107): pick servers, allocate the
         volume on each over its admin API, let heartbeats register it.
         Without `force`, skips when another waiter already grew the
@@ -331,7 +337,9 @@ class MasterServer:
                 except NoWritableVolume:
                     pass
             nodes = self.topo.find_empty_slots(replication, dc,
-                                               disk_type=disk_type)
+                                               disk_type=disk_type,
+                                               preferred_rack=rack,
+                                               preferred_node=data_node)
             if self.raft is not None:
                 # a fresh leader must apply prior terms' committed
                 # high-water marks before minting a new volume id, or a
@@ -525,6 +533,42 @@ class MasterServer:
             "VacuumDisabled": self.vacuum_disabled,
             "Topology": self.topo.to_dict(),
         })
+
+    async def handle_vacuum_now(self, req: web.Request) -> web.Response:
+        """/vol/vacuum?garbageThreshold=0.3 — the on-demand cluster
+        vacuum trigger (master_server.go:141 volumeVacuumHandler):
+        same driver the shell verb and the maintenance cron use."""
+        redirect = self._leader_redirect(req)
+        if redirect is not None:
+            return redirect
+        if self.vacuum_disabled:
+            return json_error("vacuum disabled", status=409)
+        gc = req.query.get("garbageThreshold", "")
+        try:
+            threshold = float(gc) if gc else 0.3
+        except ValueError:
+            return json_error(
+                f"garbageThreshold {gc!r} is not a valid float",
+                status=406)
+        from ..shell.commands_volume import volume_vacuum
+        from ..shell.env import CommandEnv, ShellError
+
+        def run():
+            env = CommandEnv(self.admin_scripts_url)
+            try:
+                return volume_vacuum(env, garbage_threshold=threshold)
+            finally:
+                env.close()
+
+        try:
+            results = await asyncio.to_thread(run)
+        except ShellError as e:
+            # e.g. vacuum_disabled raft-applied between our check and
+            # the verb's own re-check, or a leader change mid-scan —
+            # keep the master's JSON error contract
+            return json_error(str(e), status=409)
+        return json_ok({"garbageThreshold": threshold,
+                        "results": results})
 
     async def handle_vacuum_toggle(self, req: web.Request) -> web.Response:
         """volume.vacuum.disable / enable (command_volume_vacuum_disable

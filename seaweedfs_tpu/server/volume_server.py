@@ -588,6 +588,14 @@ class VolumeServer:
                 return None
         except KeyError:
             return None
+        # flags live AFTER the data on disk, so eligibility is only
+        # known post-open: remember big compressed/manifest needles so
+        # their repeat GETs skip the wasted probe preads
+        no_stream = getattr(self, "_no_stream", None)
+        if no_stream is None:
+            no_stream = self._no_stream = set()
+        if (vid, key) in no_stream:
+            return None
         try:
             n, data_size, reader = await asyncio.to_thread(
                 v.read_needle_streamed, key, cookie)
@@ -598,6 +606,9 @@ class VolumeServer:
         except (ValueError, IOError):
             return None  # surprises re-run through the checked path
         if n.is_compressed or n.is_chunk_manifest:
+            if len(no_stream) >= 4096:
+                no_stream.clear()
+            no_stream.add((vid, key))
             return None  # needs inflation / reassembly: whole-body path
         headers = self._needle_headers(n)
         ct = n.mime.decode() if n.mime else "application/octet-stream"

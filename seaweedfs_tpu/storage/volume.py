@@ -335,8 +335,11 @@ class Volume:
         except (IndexError, struct.error) as e:
             raise ValueError(f"corrupt needle meta: {e}") from e
         # the stored crc IS the etag; streaming can't re-verify the
-        # payload before bytes go out (the reference's paged path
-        # accepts the same)
+        # payload before bytes go out, and the reference's paged path
+        # does exactly this (needle_read_page.go:75 sets Checksum to
+        # the RAW stored value, while the materialized read normalizes
+        # to the computed crc) — so a legacy-transform .dat shows the
+        # same streamed-vs-small etag split there too
         if len(tail) >= 4:
             n.checksum = struct.unpack_from(">I", tail, len(tail) - 4)[0]
 

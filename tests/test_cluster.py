@@ -244,3 +244,100 @@ class TestAssignCountBatch:
         for i, payload in enumerate((b"zero", b"one", b"two")):
             fid = a.fid if i == 0 else f"{a.fid}_{i}"
             assert verbs.download(f"http://{a.url}/{fid}") == payload
+
+
+def test_master_vacuum_endpoint(tmp_path_factory):
+    """/vol/vacuum?garbageThreshold= triggers the on-demand cluster
+    vacuum over HTTP (master_server.go:141 volumeVacuumHandler)."""
+    import requests
+
+    c = Cluster(str(tmp_path_factory.mktemp("vacnow")),
+                n_volume_servers=1, volume_size_limit=32 << 20)
+    try:
+        a = requests.get(f"{c.master_url}/dir/assign").json()
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        body = b"g" * 4096
+        assert requests.post(url, data=body, headers={
+            "Content-Type": "application/octet-stream"}
+        ).status_code == 201
+        assert requests.delete(url).status_code == 202  # 100% garbage
+        r = requests.post(f"{c.master_url}/vol/vacuum",
+                          params={"garbageThreshold": "0.0"})
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert out["garbageThreshold"] == 0.0
+        # the deleted needle's volume was compacted
+        vid = int(a["fid"].split(",")[0])
+        assert any(d.get("volume") == vid and d.get("replicas")
+                   for d in out["results"]), out
+        assert requests.get(url).status_code == 404
+        # bad threshold -> 406 like the reference
+        assert requests.post(f"{c.master_url}/vol/vacuum",
+                             params={"garbageThreshold": "zz"}
+                             ).status_code == 406
+    finally:
+        c.stop()
+
+
+def test_grow_rack_and_node_pins(tmp_path_factory):
+    """/vol/grow?rack= / ?dataNode= pin where the main copy lands
+    (volume_growth.go option.Rack/DataNode)."""
+    import requests
+
+    c = Cluster(str(tmp_path_factory.mktemp("growpin")),
+                n_volume_servers=2, volume_size_limit=16 << 20,
+                topology=[("dc1", "rA"), ("dc1", "rB")])
+    try:
+        node_b = None
+        for s, (_dc, r) in zip(c.stores, [("dc1", "rA"), ("dc1", "rB")]):
+            if r == "rB":
+                node_b = s
+        g = requests.post(f"{c.master_url}/vol/grow",
+                          params={"rack": "rB", "count": "1"})
+        assert g.status_code == 200, g.text
+        # the new volume exists on the rB node (heartbeat registers it)
+        deadline = time.monotonic() + 5
+        found = []
+        while time.monotonic() < deadline and not found:
+            st = requests.get(f"{c.master_url}/dir/status").json()
+            for dc in st["Topology"]["datacenters"]:
+                for rk in dc["racks"]:
+                    if rk["id"] != "rB":
+                        continue
+                    for n in rk["nodes"]:
+                        if n["volumes"]:
+                            found.append(n)
+            time.sleep(0.1)
+        assert found, st
+        assert node_b is not None
+        # unknown rack: no free slots -> error, not silent misplace
+        bad = requests.post(f"{c.master_url}/vol/grow",
+                            params={"rack": "nope", "count": "1"})
+        assert bad.status_code == 500
+        # dataNode pin: the main copy lands on the NAMED server
+        st0 = requests.get(f"{c.master_url}/dir/status").json()
+        all_nodes = [n for dc in st0["Topology"]["datacenters"]
+                     for rk in dc["racks"] for n in rk["nodes"]]
+        target = all_nodes[0]["id"]
+        vols_before = set(all_nodes[0]["volumes"])
+        g2 = requests.post(f"{c.master_url}/vol/grow",
+                           params={"dataNode": target, "count": "1"})
+        assert g2.status_code == 200, g2.text
+        deadline = time.monotonic() + 5
+        new_vols = set()
+        while time.monotonic() < deadline and not new_vols:
+            st1 = requests.get(f"{c.master_url}/dir/status").json()
+            for dc in st1["Topology"]["datacenters"]:
+                for rk in dc["racks"]:
+                    for n in rk["nodes"]:
+                        if n["id"] == target:
+                            new_vols = set(n["volumes"]) - vols_before
+            time.sleep(0.1)
+        assert new_vols, st1
+        # unknown node: loud error
+        assert requests.post(
+            f"{c.master_url}/vol/grow",
+            params={"dataNode": "nosuch:1", "count": "1"}
+        ).status_code == 500
+    finally:
+        c.stop()
