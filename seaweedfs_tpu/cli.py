@@ -57,6 +57,17 @@ def main(argv: list[str] | None = None) -> int:
         "-metrics.intervalSec", dest="metrics_interval", type=float,
         default=15.0)
     parser.add_argument(
+        "-trace.slowThreshold", dest="trace_slow_threshold", type=float,
+        default=1.0,
+        help="emit one structured glog line with the full span tree "
+             "for root requests slower than this many seconds "
+             "(<= 0 disables); place BEFORE the subcommand")
+    parser.add_argument(
+        "-trace.bufferSize", dest="trace_buffer_size", type=int,
+        default=1024,
+        help="spans kept in the in-process ring served at "
+             "/debug/traces; place BEFORE the subcommand")
+    parser.add_argument(
         "-security", default="",
         help="path to a security config JSON (scaffold "
              "-config=security): enables HTTPS (+ optional mutual "
@@ -438,6 +449,10 @@ def main(argv: list[str] | None = None) -> int:
 
         _metrics.start_push(args.metrics_address, job=args.cmd,
                             interval_seconds=args.metrics_interval)
+    from .utils import tracing as _tracing
+
+    _tracing.configure(slow_threshold=args.trace_slow_threshold,
+                       buffer_size=args.trace_buffer_size)
     if args.memprofile:
         import tracemalloc
 

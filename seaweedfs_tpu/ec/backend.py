@@ -17,11 +17,37 @@ matrices from ops.rs_matrix.
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Callable, Protocol
 
 import numpy as np
 
 from ..ops import rs_matrix
+from ..utils import metrics
+
+
+def _codec_label(backend) -> str:
+    """Metrics label for a backend; AutoCodec reports what it resolved
+    to (or "auto" before first use)."""
+    name = getattr(backend, "name", "") or "unknown"
+    if name == "auto":
+        name = getattr(backend, "chosen", None) or "auto"
+    return name
+
+
+def observe_codec(op: str, backend, seconds: float | None = None,
+                  nbytes: int = 0) -> None:
+    """Record one codec operation into ec_codec_seconds{op,backend}
+    / ec_codec_bytes_total (bytes = input data processed). Either part
+    may be skipped (seconds=None / nbytes=0) so streaming paths can
+    count bytes at consumption and time at yield without double
+    observations."""
+    lab = {"op": op, "backend": backend if isinstance(backend, str)
+           else _codec_label(backend)}
+    if seconds is not None:
+        metrics.histogram_observe("ec_codec_seconds", seconds, lab)
+    if nbytes:
+        metrics.counter_add("ec_codec_bytes_total", nbytes, lab)
 
 
 class CodecBackend(Protocol):
@@ -143,6 +169,8 @@ def choose_auto_backend() -> str:
         # validate at selection time, not deep inside the first EC op
         try:
             get_backend(env)
+            metrics.gauge_set("ec_codec_chosen_backend", 1,
+                              {"backend": env})
             return env
         except KeyError as e:
             try:
@@ -204,6 +232,7 @@ def choose_auto_backend() -> str:
     _auto_choice = choice
     probe["chosen"] = choice
     _auto_probe = probe
+    metrics.gauge_set("ec_codec_chosen_backend", 1, {"backend": choice})
     try:
         from ..utils import glog
 
@@ -276,7 +305,12 @@ class ReedSolomon:
         """(k, n) data shards -> (m, n) parity shards."""
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[0] == self.k, data.shape
-        return self.backend.coded_matmul(self._parity_rows, data)
+        t0 = _time.perf_counter()
+        out = self.backend.coded_matmul(self._parity_rows, data)
+        # label after the call: AutoCodec resolves during its first op
+        observe_codec("encode", self.backend,
+                      _time.perf_counter() - t0, data.nbytes)
+        return out
 
     def reconstruct(self, shards: dict[int, np.ndarray],
                     missing: list[int] | None = None) -> dict[int, np.ndarray]:
@@ -293,7 +327,10 @@ class ReedSolomon:
         rows, inputs = rs_matrix.recovery_rows(self.k, self.m, present, missing)
         stack = np.stack([np.asarray(shards[i], dtype=np.uint8)
                           for i in inputs])
+        t0 = _time.perf_counter()
         out = self.backend.coded_matmul(rows, stack)
+        observe_codec("reconstruct", self.backend,
+                      _time.perf_counter() - t0, stack.nbytes)
         return {sid: out[i] for i, sid in enumerate(missing)}
 
     def reconstruct_data(self, shards: dict[int, np.ndarray]) -> dict[int, np.ndarray]:
@@ -308,21 +345,38 @@ class ReedSolomon:
         codecs overlapping H2D / compute / D2H)."""
         return hasattr(self.backend, "coded_matmul_stream")
 
-    def matmul_stream(self, coef: np.ndarray, blocks, depth: int = 2):
+    def matmul_stream(self, coef: np.ndarray, blocks, depth: int = 2,
+                      op: str = "encode"):
         """Yield coded_matmul(coef, block) per block, pipelined when the
         backend supports it (device in-flight depth `depth`), else
-        computed synchronously block-by-block."""
+        computed synchronously block-by-block. Each block is recorded
+        into ec_codec_seconds{op,backend} (steady-state inter-yield time
+        for pipelined backends) and ec_codec_bytes_total."""
+        def counted(src):
+            for block in src:
+                observe_codec(op, self.backend,
+                              nbytes=getattr(block, "nbytes", 0))
+                yield block
+
         stream = getattr(self.backend, "coded_matmul_stream", None)
         if stream is not None:
-            yield from stream(coef, blocks, depth=depth)
+            it = stream(coef, counted(blocks), depth=depth)
         else:
-            for block in blocks:
-                yield self.backend.coded_matmul(coef, block)
+            it = (self.backend.coded_matmul(coef, block)
+                  for block in counted(blocks))
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            observe_codec(op, self.backend, _time.perf_counter() - t0)
+            yield out
 
     def encode_stream(self, blocks, depth: int = 2):
         """Streaming encode: yields (m, w) parity per (k, w) data block."""
         yield from self.matmul_stream(self._parity_rows, blocks,
-                                      depth=depth)
+                                      depth=depth, op="encode")
 
     def verify(self, shards: np.ndarray) -> bool:
         """(k+m, n) full shard stack -> parity consistency check."""

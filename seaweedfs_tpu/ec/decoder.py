@@ -14,6 +14,7 @@ import numpy as np
 from ..storage import idx as idxmod
 from ..storage import needle_map
 from ..storage import types as t
+from ..utils import tracing
 from . import geometry as geo
 from .encoder import rebuild_ec_files
 
@@ -22,7 +23,10 @@ def write_dat_file(base: str, dat_size: int,
                    large_block: int = geo.LARGE_BLOCK,
                    small_block: int = geo.SMALL_BLOCK,
                    backend: str = "auto") -> None:
-    """Reassemble `base`.dat from the volume's data shards."""
+    """Reassemble `base`.dat from the volume's data shards. The codec
+    work (regenerating missing data shards) is metered by
+    rebuild_ec_files; the span ties decode time into the request trace
+    when this runs under a server handler."""
     from .encoder import codec_of
 
     k, _m = codec_of(base)
@@ -31,7 +35,9 @@ def write_dat_file(base: str, dat_size: int,
     if missing_data:
         # only data shards are read below — don't waste compute/disk
         # regenerating absent parity files (reference ReconstructData)
-        rebuild_ec_files(base, backend=backend, only_shards=missing_data)
+        with tracing.span("ec.rebuild_missing_data", kind="internal"):
+            rebuild_ec_files(base, backend=backend,
+                             only_shards=missing_data)
 
     n_large, n_small = geo.row_layout(dat_size, large_block, small_block,
                                       data_shards=k)

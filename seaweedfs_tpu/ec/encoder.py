@@ -21,10 +21,12 @@ Shard-file byte layout is identical to the reference's, so geometry
 from __future__ import annotations
 
 import os
+import time as _time
 
 import numpy as np
 
 from ..storage import needle_map
+from ..utils import tracing
 from . import geometry as geo
 from .backend import ReedSolomon, get_backend
 
@@ -178,10 +180,16 @@ def write_ec_files(base: str, backend: str = "auto",
         # output — same ops/rs_matrix coefficients as rs.encode().
         from .. import native as nat
         from ..ops import rs_matrix
+        from .backend import observe_codec
 
+        t0 = _time.perf_counter()
         nat.ec_encode_file(
             dat_path, [base + geo.shard_ext(i) for i in range(k + m)],
             rs_matrix.parity_rows(k, m), k, m, large_block, small_block)
+        # the bypass skips rs.encode entirely — record it here or the
+        # fastest path would be the only uninstrumented one
+        observe_codec("encode", "native", _time.perf_counter() - t0,
+                      dat_size)
         return
 
     dat = np.memmap(dat_path, dtype=np.uint8, mode="r") if dat_size else \
@@ -191,9 +199,11 @@ def write_ec_files(base: str, backend: str = "auto",
     outs = [open(base + geo.shard_ext(i), "wb", buffering=0)
             for i in range(k + m)]
     try:
-        _encode_region(rs, dat, 0, n_large, large_block, chunk, outs)
-        _encode_region(rs, dat, n_large * large_block * k,
-                       n_small, small_block, chunk, outs)
+        with tracing.span("ec.write_ec_files", kind="internal",
+                          peer=backend_name):
+            _encode_region(rs, dat, 0, n_large, large_block, chunk, outs)
+            _encode_region(rs, dat, n_large * large_block * k,
+                           n_small, small_block, chunk, outs)
     finally:
         for f in outs:
             f.close()
@@ -354,7 +364,7 @@ def rebuild_ec_files(base: str, backend: str = "auto",
 
         w = _AsyncWriter()
         try:
-            for rec in rs.matmul_stream(rows, gen()):
+            for rec in rs.matmul_stream(rows, gen(), op="reconstruct"):
                 for j, i in enumerate(missing):
                     w.put(outs[i], rec[j])
         finally:

@@ -21,6 +21,8 @@ import asyncio
 import json as _json
 import urllib.parse
 
+from ..utils import tracing
+
 
 class Response:
     """requests-shaped view: .status_code / .content / .text / .json()
@@ -92,7 +94,29 @@ class HttpPool:
                       headers: dict | None = None,
                       data: bytes | None = None,
                       json=None) -> Response:
-        """One round trip. Retries on a dead keep-alive conn only when
+        """One round trip, recorded as a client span (and carrying the
+        traceparent header) when called under an active trace."""
+        if tracing.current() is None:
+            return await self._request(method, url, params=params,
+                                       headers=headers, data=data,
+                                       json=json)
+        peer = urllib.parse.urlsplit(url).netloc
+        with tracing.span(f"{method} {peer}", kind="client",
+                          peer=peer) as rec:
+            hdrs = dict(headers or {})
+            tracing.inject(hdrs)
+            resp = await self._request(method, url, params=params,
+                                       headers=hdrs, data=data,
+                                       json=json)
+            rec["status"] = str(resp.status_code)
+            return resp
+
+    async def _request(self, method: str, url: str, *,
+                       params: dict | None = None,
+                       headers: dict | None = None,
+                       data: bytes | None = None,
+                       json=None) -> Response:
+        """Retries on a dead keep-alive conn only when
         no response byte arrived AND the failure was connection-level —
         once bytes show up (or on a timeout, where we can't prove they
         didn't) the server may have executed the request, so retrying a

@@ -11,16 +11,40 @@ from __future__ import annotations
 
 import os
 import threading
+import urllib.parse
 
 import requests
 
+from ..utils import tracing
+
 _local = threading.local()
+
+
+class TracingSession(requests.Session):
+    """Session that joins the active trace: when a trace context is set
+    (contextvars survive the sync call sites in operation/verbs.py and
+    the servers' thread-pool hops via asyncio.to_thread), each request
+    records a client span and carries its traceparent downstream.
+    Outside a trace this adds nothing — no header, no span."""
+
+    def request(self, method, url, **kw):  # type: ignore[override]
+        if tracing.current() is None:
+            return super().request(method, url, **kw)
+        peer = urllib.parse.urlsplit(url).netloc
+        with tracing.span(f"{method} {peer}", kind="client",
+                          peer=peer) as rec:
+            headers = dict(kw.get("headers") or {})
+            tracing.inject(headers)
+            kw["headers"] = headers
+            resp = super().request(method, url, **kw)
+            rec["status"] = str(resp.status_code)
+            return resp
 
 
 def session() -> requests.Session:
     s = getattr(_local, "session", None)
     if s is None:
-        s = requests.Session()
+        s = TracingSession()
         # cluster-internal traffic: skip the per-request proxy-env
         # scan (getproxies_environment walked os.environ on EVERY
         # call — ~15% of client CPU in the write benchmark).

@@ -30,7 +30,7 @@ from ..rpc import httpclient
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
-from ..utils import extheaders, metrics
+from ..utils import extheaders, metrics, tracing
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, IdentityAccessManagement, S3AuthError)
 
@@ -283,11 +283,13 @@ class S3ApiServer:
 
         # bodies are buffered for SigV4 payload hashing; 1GB caps the
         # blowup — larger objects go through multipart parts
-        app = web.Application(client_max_size=1 << 30,
-                              middlewares=[error_mw])
+        app = web.Application(
+            client_max_size=1 << 30,
+            middlewares=[tracing.aiohttp_middleware("s3"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            web.get("/debug/traces", tracing.handle_debug_traces),
             web.route("*", "/{tail:.*}", self.dispatch),
         ])
         return app

@@ -30,7 +30,7 @@ from ..filer import (Entry, FileChunk, Filer, etag_chunks,
 from ..filer.filechunks import MANIFEST_BATCH
 from ..filer.filer import DirectoryNotEmptyError
 from ..operation import verbs
-from ..utils import httprange, metrics
+from ..utils import httprange, metrics, tracing
 from ..wdclient.client import MasterClient
 
 DEFAULT_CHUNK_SIZE = 8 << 20  # autochunk default (`-maxMB=8` upstream)
@@ -315,11 +315,13 @@ class FilerServer:
                     time.perf_counter() - start,
                     labels={"method": request.method})
 
-        app = web.Application(client_max_size=1 << 40,
-                              middlewares=[error_mw])
+        app = web.Application(
+            client_max_size=1 << 40,
+            middlewares=[tracing.aiohttp_middleware("filer"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
             web.post("/dlm/lock", self.handle_dlm_lock),
             web.post("/dlm/unlock", self.handle_dlm_unlock),

@@ -26,6 +26,7 @@ from ..master.topology import (NoFreeSlots, NoWritableVolume, Topology,
                                VolumeInfo)
 from ..rpc.http import json_error, json_ok
 from ..storage import types as t
+from ..utils import tracing
 from ..utils.security import Guard
 
 
@@ -170,8 +171,11 @@ class MasterServer:
         return web.Response(status=307, headers={"Location": url})
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1 << 20)
+        app = web.Application(
+            client_max_size=1 << 20,
+            middlewares=[tracing.aiohttp_middleware("master")])
         app.add_routes([
+            web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),

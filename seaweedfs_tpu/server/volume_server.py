@@ -28,7 +28,7 @@ from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
-from ..utils import glog, httprange, metrics
+from ..utils import glog, httprange, metrics, tracing
 from ..utils.security import Guard
 
 
@@ -134,13 +134,15 @@ class VolumeServer:
                 return web.json_response(
                     {"error": f"bad request: {e}"}, status=400)
 
-        app = web.Application(client_max_size=256 << 20,
-                              middlewares=[error_mw])
+        app = web.Application(
+            client_max_size=256 << 20,
+            middlewares=[tracing.aiohttp_middleware("volume"), error_mw])
         app.add_routes([
             web.get("/", self.handle_ui),
             web.get("/ui/index.html", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            web.get("/debug/traces", tracing.handle_debug_traces),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/delete_volume", self.handle_delete_volume),
             web.post("/admin/mark_readonly", self.handle_mark_readonly),

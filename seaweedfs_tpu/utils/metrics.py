@@ -49,21 +49,50 @@ def histogram_observe(name: str, seconds: float,
         _counters[_key(name + "_count", labels)] += 1
 
 
+def _escape_label_value(v) -> str:
+    # text exposition format: \ " and newline must be escaped in values
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
 def render() -> str:
+    """Text exposition format: one `# TYPE` line per family, histogram
+    `_sum`/`_count` kept adjacent to their `_bucket` series."""
     lines = []
     with _lock:
+        hist_names = {name for name, _ in _histograms}
+
+        def is_hist_component(name: str) -> bool:
+            return ((name.endswith("_sum") and name[:-4] in hist_names)
+                    or (name.endswith("_count")
+                        and name[:-6] in hist_names))
+
+        last_family = None
         for (name, labels), v in sorted(_counters.items()):
+            if is_hist_component(name):
+                continue  # rendered with its histogram below
+            if name != last_family:
+                lines.append(f"# TYPE {name} counter")
+                last_family = name
             lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        last_family = None
         for (name, labels), v in sorted(_gauges.items()):
+            if name != last_family:
+                lines.append(f"# TYPE {name} gauge")
+                last_family = name
             lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        last_family = None
         for (name, labels), buckets in sorted(_histograms.items()):
+            if name != last_family:
+                lines.append(f"# TYPE {name} histogram")
+                last_family = name
             cum = 0
             for i, ub in enumerate(_HIST_BUCKETS):
                 cum += buckets[i]
@@ -78,6 +107,10 @@ def render() -> str:
             lines.append(
                 f"{name}_bucket{_fmt_labels(tuple(sorted(lab.items())))}"
                 f" {cum}")
+            s = _counters.get((name + "_sum", labels), 0.0)
+            c = _counters.get((name + "_count", labels), 0.0)
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {s}")
+            lines.append(f"{name}_count{_fmt_labels(labels)} {c}")
     return "\n".join(lines) + "\n"
 
 
@@ -101,7 +134,7 @@ def start_push(gateway_url: str, job: str,
                interval_seconds: float = 15.0,
                instance: str = "") -> None:
     global _push_thread, _push_stop
-    if _push_thread is not None:
+    if _push_thread is not None and _push_thread.is_alive():
         return
     import threading as _th
 
@@ -113,10 +146,11 @@ def start_push(gateway_url: str, job: str,
     url += f"/metrics/job/{job}"
     if instance:
         url += f"/instance/{instance}"
-    _push_stop = _th.Event()
+    stop = _th.Event()  # captured locally: stop_push nulling the global
+                        # must not crash a loop mid-iteration
 
     def loop():
-        while not _push_stop.wait(interval_seconds):
+        while not stop.wait(interval_seconds):
             try:
                 _rq.put(url, data=render().encode(),
                         headers={"Content-Type": "text/plain"},
@@ -124,13 +158,18 @@ def start_push(gateway_url: str, job: str,
             except _rq.RequestException:
                 pass  # gateway outages must never hurt the server
 
+    _push_stop = stop
     _push_thread = _th.Thread(target=loop, daemon=True)
     _push_thread.start()
 
 
-def stop_push() -> None:
+def stop_push(timeout: float = 5.0) -> None:
+    """Signal the pusher and join it (bounded); safe to start_push again."""
     global _push_thread, _push_stop
-    if _push_stop is not None:
-        _push_stop.set()
+    thread, stop = _push_thread, _push_stop
     _push_thread = None
     _push_stop = None
+    if stop is not None:
+        stop.set()
+    if thread is not None:
+        thread.join(timeout)

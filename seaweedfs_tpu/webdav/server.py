@@ -16,6 +16,8 @@ from xml.sax.saxutils import escape
 import aiohttp
 from aiohttp import web
 
+from ..utils import tracing
+
 DAV_NS = "DAV:"
 
 
@@ -70,11 +72,20 @@ class WebDavServer:
         return token not in presented
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1 << 40)
+        app = web.Application(
+            client_max_size=1 << 40,
+            middlewares=[tracing.aiohttp_middleware("webdav")])
         app.add_routes([
+            web.get("/debug/traces", tracing.handle_debug_traces),
             web.route("*", "/{path:.*}", self.dispatch),
         ])
         return app
+
+    @staticmethod
+    def _sess() -> aiohttp.ClientSession:
+        """Filer-bound session carrying the active trace context, so
+        WebDAV-originated filer hops chain to the gateway's root span."""
+        return aiohttp.ClientSession(headers=tracing.inject({}))
 
     def _abs(self, path: str) -> str:
         return (self.root + "/" + path.strip("/")).rstrip("/") or "/"
@@ -131,7 +142,7 @@ class WebDavServer:
         path = "/" + req.match_info["path"]
         full = self._abs(path)
         depth = req.headers.get("Depth", "1")
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             entry = await self._entry(sess, full)
             if entry is None and full != "/":
                 return web.Response(status=404)
@@ -173,7 +184,7 @@ class WebDavServer:
 
     async def do_mkcol(self, req: web.Request) -> web.Response:
         full = self._abs("/" + req.match_info["path"])
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             if await self._entry(sess, full) is not None:
                 return web.Response(status=405)  # exists
             async with sess.put(f"{self.filer_url}{full}",
@@ -186,7 +197,7 @@ class WebDavServer:
         headers = {}
         if "Range" in req.headers:
             headers["Range"] = req.headers["Range"]
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             entry = await self._entry(sess, full)
             if entry is None:
                 return web.Response(status=404)
@@ -227,7 +238,7 @@ class WebDavServer:
             params["collection"] = self.collection
         if self.replication:
             params["replication"] = self.replication
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             async with sess.put(f"{self.filer_url}{full}", data=data,
                                 params=params,
                                 headers={"Content-Type":
@@ -241,7 +252,7 @@ class WebDavServer:
         if self._lock_conflict(req, path):
             return web.Response(status=423)
         full = self._abs(path)
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             if await self._entry(sess, full) is None:
                 return web.Response(status=404)
             async with sess.delete(f"{self.filer_url}{full}",
@@ -272,7 +283,7 @@ class WebDavServer:
             return web.Response(status=423)
         dest = self._abs(dest_rel)
         overwrite = req.headers.get("Overwrite", "T") != "F"
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             if await self._entry(sess, src) is None:
                 return web.Response(status=404)
             existed = await self._entry(sess, dest) is not None
@@ -297,7 +308,7 @@ class WebDavServer:
             return web.Response(status=423)
         dest = self._abs(dest_rel)
         overwrite = req.headers.get("Overwrite", "T") != "F"
-        async with aiohttp.ClientSession() as sess:
+        async with self._sess() as sess:
             entry = await self._entry(sess, src)
             if entry is None:
                 return web.Response(status=404)

@@ -1,0 +1,141 @@
+"""utils/metrics.py renders valid Prometheus text exposition format:
+`# TYPE` lines per family, escaped label values, histogram _sum/_count
+adjacent to their _bucket series, and a push loop that stops cleanly
+and can restart."""
+import threading
+
+import pytest
+
+from seaweedfs_tpu.utils import metrics
+
+
+@pytest.fixture
+def clean_registry():
+    """Run against an empty registry, restoring whatever other tests
+    accumulated (the registry is process-global)."""
+    with metrics._lock:
+        counters = dict(metrics._counters)
+        gauges = dict(metrics._gauges)
+        hists = {k: list(v) for k, v in metrics._histograms.items()}
+    metrics.reset()
+    yield
+    with metrics._lock:
+        metrics._counters.clear()
+        metrics._counters.update(counters)
+        metrics._gauges.clear()
+        metrics._gauges.update(gauges)
+        metrics._histograms.clear()
+        metrics._histograms.update(hists)
+
+
+class TestExpositionFormat:
+    def test_golden_render(self, clean_registry):
+        metrics.counter_add("demo_requests_total", 2,
+                            {"method": "GET"})
+        metrics.counter_add("demo_requests_total", 1,
+                            {"method": "PUT"})
+        metrics.gauge_set("demo_temperature", 36.6)
+        metrics.histogram_observe("demo_seconds", 0.0005)
+        metrics.histogram_observe("demo_seconds", 0.75)
+        metrics.histogram_observe("demo_seconds", 99.0)
+        out = metrics.render()
+        assert out == (
+            "# TYPE demo_requests_total counter\n"
+            'demo_requests_total{method="GET"} 2.0\n'
+            'demo_requests_total{method="PUT"} 1.0\n'
+            "# TYPE demo_temperature gauge\n"
+            "demo_temperature 36.6\n"
+            "# TYPE demo_seconds histogram\n"
+            'demo_seconds_bucket{le="0.001"} 1\n'
+            'demo_seconds_bucket{le="0.005"} 1\n'
+            'demo_seconds_bucket{le="0.01"} 1\n'
+            'demo_seconds_bucket{le="0.05"} 1\n'
+            'demo_seconds_bucket{le="0.1"} 1\n'
+            'demo_seconds_bucket{le="0.5"} 1\n'
+            'demo_seconds_bucket{le="1"} 2\n'
+            'demo_seconds_bucket{le="5"} 2\n'
+            'demo_seconds_bucket{le="10"} 2\n'
+            'demo_seconds_bucket{le="+Inf"} 3\n'
+            "demo_seconds_sum 99.7505\n"
+            "demo_seconds_count 3.0\n")
+
+    def test_label_value_escaping(self, clean_registry):
+        metrics.counter_add("esc_total", 1,
+                            {"path": 'a"quoted"\\back\nnl'})
+        out = metrics.render()
+        assert ('esc_total{path="a\\"quoted\\"\\\\back\\nnl"} 1.0'
+                in out)
+
+    def test_type_line_precedes_every_family(self, clean_registry):
+        metrics.counter_add("aa_total", 1)
+        metrics.gauge_set("bb_gauge", 5)
+        metrics.histogram_observe("cc_seconds", 0.2)
+        lines = metrics.render().splitlines()
+        for family, kind in (("aa_total", "counter"),
+                             ("bb_gauge", "gauge"),
+                             ("cc_seconds", "histogram")):
+            first = min(i for i, ln in enumerate(lines)
+                        if ln.startswith(family))
+            assert lines[first - 1] == f"# TYPE {family} {kind}"
+
+    def test_histogram_sum_count_adjacent(self, clean_registry):
+        # interleaving regression: a counter sorting between
+        # "<name>_bucket" and "<name>_sum" must not split the family
+        metrics.histogram_observe("h_seconds", 0.002,
+                                  {"method": "GET"})
+        metrics.histogram_observe("h_seconds", 0.002,
+                                  {"method": "PUT"})
+        metrics.counter_add("h_seconds_extra_total", 1)
+        lines = metrics.render().splitlines()
+        for method in ("GET", "PUT"):
+            inf = lines.index(
+                f'h_seconds_bucket{{le="+Inf",method="{method}"}} 1')
+            assert lines[inf + 1].startswith(
+                f'h_seconds_sum{{method="{method}"}}')
+            assert lines[inf + 2] == \
+                f'h_seconds_count{{method="{method}"}} 1.0'
+        # the histogram's own _sum/_count never also render as
+        # standalone counter families
+        assert "# TYPE h_seconds_sum" not in "\n".join(lines)
+        assert "# TYPE h_seconds_count" not in "\n".join(lines)
+
+    def test_existing_metric_shapes_survive(self, clean_registry):
+        # the substrings the rest of the test-suite greps for
+        metrics.counter_add("s3_requests_total", 1,
+                            {"method": "PUT", "code": "200"})
+        metrics.histogram_observe("s3_request_seconds", 0.01,
+                                  {"method": "PUT"})
+        out = metrics.render()
+        assert 's3_requests_total{code="200",method="PUT"}' in out
+        assert "s3_request_seconds_count" in out
+
+
+class TestPushLifecycle:
+    def test_stop_joins_and_restart_works(self):
+        before = threading.active_count()
+        # unroutable port: the loop's PUT fails fast and is swallowed
+        metrics.start_push("127.0.0.1:1", job="t",
+                           interval_seconds=0.05)
+        t1 = metrics._push_thread
+        assert t1 is not None and t1.is_alive()
+        metrics.stop_push()
+        assert metrics._push_thread is None
+        assert not t1.is_alive()  # joined, not leaked
+        # a second start after stop must spin up a fresh pusher
+        metrics.start_push("127.0.0.1:1", job="t",
+                           interval_seconds=0.05)
+        t2 = metrics._push_thread
+        assert t2 is not None and t2.is_alive() and t2 is not t1
+        metrics.stop_push()
+        assert not t2.is_alive()
+        assert threading.active_count() <= before + 1
+
+    def test_double_start_is_noop_while_running(self):
+        metrics.start_push("127.0.0.1:1", job="t",
+                           interval_seconds=0.05)
+        t1 = metrics._push_thread
+        metrics.start_push("127.0.0.1:1", job="t",
+                           interval_seconds=0.05)
+        assert metrics._push_thread is t1
+        metrics.stop_push()
+        assert not t1.is_alive()

@@ -1,0 +1,160 @@
+"""Distributed tracing: traceparent round-trip, ring-buffer eviction,
+span parentage, slow-request logging, and end-to-end propagation
+S3 PUT -> filer -> volume inside one trace id (utils/tracing.py)."""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.utils import glog, metrics, tracing
+
+
+@pytest.fixture
+def trace_config():
+    """Snapshot/restore tracing knobs + ring so tests don't leak."""
+    slow, size = tracing._slow_threshold, tracing._buffer_size
+    yield
+    tracing.configure(slow_threshold=slow, buffer_size=size)
+    tracing.reset()
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        parsed = tracing.parse_traceparent(tracing.format_traceparent(ctx))
+        assert parsed is not None
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+        assert parsed.flags == ctx.flags
+
+    def test_parse_valid_header(self):
+        h = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+        ctx = tracing.parse_traceparent(h)
+        assert ctx is not None
+        assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert ctx.span_id == "b7ad6b7169203331"
+        assert tracing.format_traceparent(ctx) == h
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "00-abc-def-01",                                    # wrong lengths
+        "00-" + "0" * 32 + "-b7ad6b7169203331-01",          # zero trace
+        "00-0af7651916cd43dd8448eb211c80319c-" + "0" * 16 + "-01",
+        "ff-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+        "00-0af7651916cd43dd8448eb211c80319X-b7ad6b7169203331-01",
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01-extra",
+    ])
+    def test_parse_rejects_malformed(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_parse_accepts_future_version_extra_fields(self):
+        # per W3C, unknown (non-ff) versions may append fields
+        h = "01-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01-x"
+        assert tracing.parse_traceparent(h) is not None
+
+
+class TestSpans:
+    def test_nesting_and_remote_parent(self, trace_config):
+        remote = tracing.TraceContext(tracing.new_trace_id(),
+                                      tracing.new_span_id())
+        with tracing.span("srv", service="s3", kind="server",
+                          remote=remote) as root:
+            assert tracing.current_traceparent() != ""
+            with tracing.span("hop", kind="client") as child:
+                pass
+        assert root["trace_id"] == remote.trace_id
+        assert root["parent_id"] == remote.span_id
+        assert child["trace_id"] == remote.trace_id
+        assert child["parent_id"] == root["span_id"]
+        # context is restored after the with-block
+        assert tracing.current() is None
+
+    def test_ring_eviction(self, trace_config):
+        tracing.reset()
+        tracing.configure(buffer_size=4)
+        for n in range(10):
+            with tracing.span(f"s{n}"):
+                pass
+        names = [s["name"] for s in tracing._spans]
+        assert names == ["s6", "s7", "s8", "s9"]
+        # growing the buffer keeps what survived
+        tracing.configure(buffer_size=8)
+        assert [s["name"] for s in tracing._spans] == names
+
+    def test_error_status_and_server_histogram(self, trace_config):
+        with pytest.raises(RuntimeError):
+            with tracing.span("boom", service="t", kind="server"):
+                raise RuntimeError("x")
+        rec = list(tracing._spans)[-1]
+        assert rec["status"] == "error"
+        m = metrics.render()
+        assert 'request_trace_seconds_count{handler="boom",service="t"}' \
+            in m
+
+    def test_slow_root_emits_span_tree_log(self, trace_config,
+                                           monkeypatch):
+        lines = []
+        monkeypatch.setattr(
+            glog, "warning", lambda msg, *a: lines.append(msg % a))
+        tracing.configure(slow_threshold=0.001)
+        with tracing.span("slowone", service="s3", kind="server"):
+            with tracing.span("inner", kind="client"):
+                time.sleep(0.01)
+        slow = [ln for ln in lines if "slow request" in ln]
+        assert len(slow) == 1, lines
+        assert "slowone" in slow[0] and "inner" in slow[0]
+        assert "trace_id=" in slow[0]
+
+    def test_fast_root_does_not_log(self, trace_config, monkeypatch):
+        lines = []
+        monkeypatch.setattr(tracing.glog, "warning",
+                            lambda msg, *a: lines.append(msg % a))
+        tracing.configure(slow_threshold=10.0)
+        with tracing.span("quick", service="s3", kind="server"):
+            pass
+        assert not lines
+
+
+class TestClusterPropagation:
+    def test_one_trace_spans_s3_filer_volume(self, tmp_path_factory,
+                                             trace_config):
+        c = Cluster(str(tmp_path_factory.mktemp("trace")),
+                    n_volume_servers=1, volume_size_limit=16 << 20,
+                    with_filer=True, with_s3=True)
+        try:
+            requests.put(f"{c.s3_url}/tb")
+            requests.put(f"{c.s3_url}/tb/k", data=b"trace me" * 64)
+            requests.get(f"{c.s3_url}/tb/k")
+            traces = requests.get(f"{c.s3_url}/debug/traces",
+                                  params={"limit": 50}).json()
+            assert isinstance(traces, list) and traces
+            hit = None
+            for t in traces:
+                services = {s["service"] for s in t["spans"]}
+                if {"s3", "filer", "volume"} <= services:
+                    hit = t
+                    break
+            assert hit is not None, traces
+            # every span shares the gateway's trace id, and the filer /
+            # volume server hops chain to a parent inside the trace
+            ids = {s["span_id"] for s in hit["spans"]}
+            for s in hit["spans"]:
+                assert s["trace_id"] == hit["trace_id"]
+                if s["service"] in ("filer", "volume") and \
+                        s["kind"] == "server":
+                    assert s["parent_id"] in ids
+            # the trace endpoint exists on every server
+            for url in (c.master_url, c.filer_url, c.volume_url(0)):
+                r = requests.get(url + "/debug/traces?limit=1")
+                assert r.status_code == 200
+                assert isinstance(r.json(), list)
+            # and request_trace_seconds is exported with service labels
+            m = requests.get(f"{c.s3_url}/metrics").text
+            assert 'request_trace_seconds_count{handler="dispatch"' \
+                   ',service="s3"}' in m
+        finally:
+            c.stop()
