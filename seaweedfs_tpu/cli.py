@@ -12,6 +12,7 @@ import argparse
 import json
 import os
 import sys
+from .rpc.httpclient import session
 
 
 def _ssl_ctx(args):
@@ -67,6 +68,53 @@ def main(argv: list[str] | None = None) -> int:
         default=1024,
         help="spans kept in the in-process ring served at "
              "/debug/traces; place BEFORE the subcommand")
+    parser.add_argument(
+        "-fault.spec", dest="fault_spec", default="",
+        help="deterministic fault injection for internal hops, e.g. "
+             "'volume:read:error=0.05,filer:*:delay=30ms' "
+             "(service:op:kind=value, comma-separated; also via "
+             "SEAWEEDFS_TPU_FAULT_SPEC); place BEFORE the subcommand")
+    parser.add_argument(
+        "-fault.seed", dest="fault_seed", type=int, default=0,
+        help="RNG seed for -fault.spec error draws (same seed + same "
+             "request sequence = same chaos); place BEFORE the "
+             "subcommand")
+    parser.add_argument(
+        "-retry.maxAttempts", dest="retry_max_attempts", type=int,
+        default=None,
+        help="attempts per internal hop (default 3); place BEFORE "
+             "the subcommand")
+    parser.add_argument(
+        "-retry.baseDelay", dest="retry_base_delay", type=float,
+        default=None,
+        help="first-retry backoff cap in seconds (full jitter, "
+             "default 0.02); place BEFORE the subcommand")
+    parser.add_argument(
+        "-retry.maxDelay", dest="retry_max_delay", type=float,
+        default=None,
+        help="backoff cap in seconds (default 1.0); place BEFORE the "
+             "subcommand")
+    parser.add_argument(
+        "-retry.edgeBudget", dest="retry_edge_budget", type=float,
+        default=None,
+        help="overall deadline in seconds minted at the s3/filer edge "
+             "when the client sent no X-Sw-Deadline (default 300); "
+             "place BEFORE the subcommand")
+    parser.add_argument(
+        "-breaker.failures", dest="breaker_failures", type=int,
+        default=None,
+        help="consecutive connection failures that open a peer's "
+             "circuit breaker (default 5); place BEFORE the subcommand")
+    parser.add_argument(
+        "-breaker.reset", dest="breaker_reset", type=float,
+        default=None,
+        help="seconds an open breaker waits before its half-open "
+             "probe (default 5); place BEFORE the subcommand")
+    parser.add_argument(
+        "-hedge.delay", dest="hedge_delay", type=float, default=None,
+        help="seconds a replica read waits before hedging to an "
+             "alternate location (default 0.35); place BEFORE the "
+             "subcommand")
     parser.add_argument(
         "-security", default="",
         help="path to a security config JSON (scaffold "
@@ -453,6 +501,18 @@ def main(argv: list[str] | None = None) -> int:
 
     _tracing.configure(slow_threshold=args.trace_slow_threshold,
                        buffer_size=args.trace_buffer_size)
+    from .utils import faults as _faults
+    from .utils import retry as _retry
+
+    _faults.configure(spec=args.fault_spec or None,
+                      seed=args.fault_seed or None)
+    _retry.configure(max_attempts=args.retry_max_attempts,
+                     base_delay=args.retry_base_delay,
+                     max_delay=args.retry_max_delay,
+                     edge_budget=args.retry_edge_budget,
+                     breaker_failures=args.breaker_failures,
+                     breaker_reset=args.breaker_reset,
+                     hedge_delay=args.hedge_delay)
     if args.memprofile:
         import tracemalloc
 
@@ -546,8 +606,7 @@ def _dispatch(args) -> int:
     if args.cmd == "filer.cat":
         import sys as _sys
 
-        import requests as _rq
-        r = _rq.get(f"{args.filer.rstrip('/')}/"
+        r = session().get(f"{args.filer.rstrip('/')}/"
                     f"{args.path.lstrip('/')}", stream=True,
                     timeout=600)
         if r.status_code >= 300:
@@ -1274,7 +1333,7 @@ def _run_benchmark_gateway(args) -> int:
         if args.s3_access:
             h = sign_headers("PUT", f"{base}/{args.bucket}",
                              args.s3_access, args.s3_secret)
-        requests.put(f"{base}/{args.bucket}", headers=h, timeout=10)
+        session().put(f"{base}/{args.bucket}", headers=h, timeout=10)
 
     t0 = time.perf_counter()
     puts = [build("PUT", f"{prefix}/{i:07d}", payload) for i in range(n)]
@@ -1324,10 +1383,8 @@ def _run_benchmark_native(args) -> int:
         # fids — their 10s jwt window must not be spent waiting here.
         # repl_post is a lifetime counter: gate on its DELTA, not its
         # value, or a previous run's fan-outs would satisfy the check
-        import requests as _rq
-
         def _repl_post(url):
-            st = _rq.get(f"http://{url}/status", timeout=5).json()
+            st = session().get(f"http://{url}/status", timeout=5).json()
             nd = st.get("native_dataplane")
             return None if nd is None else nd.get("repl_post", 0)
 
@@ -1424,7 +1481,7 @@ def _run_filer_copy(args) -> int:
                         p for p in (dest, base,
                                     "" if rel == "." else rel, f) if p)
                     with open(os.path.join(dirpath, f), "rb") as fh:
-                        r = requests.post(f"{filer}/{target.lstrip('/')}",
+                        r = session().post(f"{filer}/{target.lstrip('/')}",
                                           params=params, data=fh,
                                           timeout=600)
                     if r.status_code >= 300:
@@ -1435,7 +1492,7 @@ def _run_filer_copy(args) -> int:
         else:
             target = f"{dest}/{os.path.basename(src)}"
             with open(src, "rb") as fh:
-                r = requests.post(f"{filer}{target}", params=params,
+                r = session().post(f"{filer}{target}", params=params,
                                   data=fh, timeout=600)
             if r.status_code >= 300:
                 print(f"{target}: {r.text}")

@@ -176,9 +176,9 @@ class DlmClient:
 
     # one lock request against one filer; returns (ok, moved_to, err)
     def _try(self, filer: str, path: str, body: dict):
-        import requests
+        from ..rpc.httpclient import session
 
-        resp = requests.post(f"{filer}{path}", json=body, timeout=10)
+        resp = session().post(f"{filer}{path}", json=body, timeout=10)
         d = resp.json()
         if resp.status_code == 200:
             return d, None, None

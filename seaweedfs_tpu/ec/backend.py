@@ -147,6 +147,14 @@ def _probe_cpu_backend() -> str:
         return "numpy"
 
 
+def cpu_backend_name() -> str:
+    """Public alias of the CPU-codec probe: the backend latency-
+    sensitive paths (single-needle degraded reads) must use no matter
+    what -ec.backend configured — a device dispatch (compile + DMA)
+    can put >1s in a GET that reconstructs a few KB."""
+    return _probe_cpu_backend()
+
+
 def choose_auto_backend() -> str:
     """Pick the production codec backend from measurement, not faith.
 

@@ -7,15 +7,78 @@ with manifest resolution and a small per-reader chunk cache
 """
 from __future__ import annotations
 
+import contextvars
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable
 
-import requests
 from ..rpc.httpclient import session
+from ..utils import metrics, retry
 
 from .entry import FileChunk
 from .filechunks import resolve_chunk_manifest, view_from_chunks
 
 LookupFn = Callable[[str], str]  # fid -> full http url
+
+# shared hedge pool for sync replica reads; sized small on purpose —
+# a hedge is the exception (one slow replica), not the common path
+_hedge_pool: ThreadPoolExecutor | None = None
+
+
+def _hedge_pool_get() -> ThreadPoolExecutor:
+    global _hedge_pool
+    if _hedge_pool is None:
+        _hedge_pool = ThreadPoolExecutor(max_workers=8,
+                                         thread_name_prefix="hedge")
+    return _hedge_pool
+
+
+def _replica_urls(lookup: LookupFn, fid: str) -> list[str]:
+    """All replica urls for a fid when the lookup's owner can list
+    them (MasterClient / FilerServer expose lookup_file_id_urls),
+    else the single url the plain lookup returns."""
+    owner = getattr(lookup, "__self__", None)
+    fn = getattr(owner, "lookup_file_id_urls", None)
+    if fn is not None:
+        return fn(fid)
+    return [lookup(fid)]
+
+
+def _hedged_fetch(fetch: Callable[[str], bytes], urls: list[str],
+                  hedge_delay: float) -> bytes:
+    """First-success-wins across the primary and (after hedge_delay,
+    or immediately on primary failure) one alternate replica.  The
+    tail-latency move from "The Tail at Scale": a replica that is
+    slow — sick disk, GC pause, injected 30ms delay — costs at most
+    hedge_delay extra, not its whole timeout."""
+    pool = _hedge_pool_get()
+    futs = {pool.submit(contextvars.copy_context().run, fetch, urls[0])}
+    errors: list[BaseException] = []
+    # phase 1: give the primary hedge_delay to answer
+    done, _ = wait(futs, timeout=hedge_delay, return_when=FIRST_COMPLETED)
+    for fut in done:
+        exc = fut.exception()
+        if exc is None:
+            return fut.result()
+        errors.append(exc)
+        futs.discard(fut)
+    # primary slow (or failed fast): fire one alternate replica
+    if len(urls) > 1:
+        metrics.counter_add("replica_read_hedges", 1)
+        futs.add(pool.submit(
+            contextvars.copy_context().run, fetch, urls[1]))
+    # phase 2: first success wins, losers are cancelled best-effort
+    while futs:
+        done, _ = wait(futs, return_when=FIRST_COMPLETED)
+        for fut in done:
+            exc = fut.exception()
+            if exc is None:
+                for p in futs:
+                    if p is not fut:
+                        p.cancel()
+                return fut.result()
+            errors.append(exc)
+            futs.discard(fut)
+    raise errors[-1]
 
 
 class ReaderPattern:
@@ -53,16 +116,22 @@ class ReaderPattern:
 
 def read_fid(lookup: LookupFn, fid: str, offset: int = 0,
              size: int | None = None) -> bytes:
-    url = lookup(fid)
     headers = {}
     if size is not None:
         headers["Range"] = f"bytes={offset}-{offset + size - 1}"
     elif offset:
         headers["Range"] = f"bytes={offset}-"
-    resp = session().get(url, headers=headers, timeout=60)
-    if resp.status_code not in (200, 206):
-        raise IOError(f"read {fid}: http {resp.status_code}")
-    return resp.content
+
+    def fetch(url: str) -> bytes:
+        resp = session().get(url, headers=headers, timeout=60)
+        if resp.status_code not in (200, 206):
+            raise IOError(f"read {fid}: http {resp.status_code}")
+        return resp.content
+
+    urls = _replica_urls(lookup, fid)
+    if len(urls) == 1:
+        return fetch(urls[0])
+    return _hedged_fetch(fetch, urls, retry.HEDGE_DELAY)
 
 
 class ChunkStreamReader:
@@ -138,8 +207,11 @@ class ChunkStreamReader:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(max_workers=1)
+        # copy_context: keep the reader's trace/deadline on the
+        # prefetch thread (pool.submit drops contextvars)
         self._prefetch[nxt.fid] = self._pool.submit(
-            read_fid, self.lookup, nxt.fid)
+            contextvars.copy_context().run, read_fid, self.lookup,
+            nxt.fid)
 
     def read(self, offset: int = 0, size: int | None = None) -> bytes:
         if size is None:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 
 from ..ec import geometry as geo
 from ..storage.super_block import ReplicaPlacement
+from ..utils import retry as _retry
 
 
 @dataclass
@@ -481,6 +482,9 @@ class Topology:
                                            n.ec_shards.items()},
                             "max_volumes": n.max_volumes,
                             "disk_type": n.disk_type,
+                            # this process's circuit-breaker view of
+                            # the node (closed/open/half-open)
+                            "breaker": _retry.breaker_for(n.url).state,
                         } for n in r.nodes.values()],
                     } for r in dc.racks.values()],
                 } for dc in self.dcs.values()],

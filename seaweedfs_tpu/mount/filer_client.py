@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import threading
 
-import requests
 from ..rpc.httpclient import session
 
 from ..filer.entry import Entry

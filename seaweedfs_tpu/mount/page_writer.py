@@ -25,6 +25,7 @@ mount.
 """
 from __future__ import annotations
 
+import contextvars
 import os
 import tempfile
 import threading
@@ -218,7 +219,10 @@ class DirtyPages:
             else:
                 ref = payload
                 self._ram_payload_bytes += len(payload)
-            fut = self._pipeline.submit(self._upload_ref, ref)
+            # copy_context: keep the writer's trace/deadline on the
+            # upload thread (pool.submit drops contextvars)
+            fut = self._pipeline.submit(
+                contextvars.copy_context().run, self._upload_ref, ref)
             self._uploads.append((fut, base + s, e - s,
                                   self._next_mtime_ns(), ref))
 
@@ -293,7 +297,9 @@ class DirtyPages:
             restored = []
             for fut, file_off, size, mtime_ns, ref in uploads:
                 if fut.done() and fut.exception() is not None:
-                    fut = self._pipeline.submit(self._upload_ref, ref)
+                    fut = self._pipeline.submit(
+                        contextvars.copy_context().run,
+                        self._upload_ref, ref)
                 restored.append((fut, file_off, size, mtime_ns, ref))
             with self._lock:
                 self._uploads = restored + self._uploads

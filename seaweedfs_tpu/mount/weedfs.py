@@ -651,7 +651,11 @@ class WeedFS:
             finally:
                 inflight.discard(fid)
 
-        self._ra_pool.submit(fetch)
+        # copy_context: keep the caller's trace/deadline on the
+        # readahead thread (pool.submit drops contextvars)
+        import contextvars as _cv
+
+        self._ra_pool.submit(_cv.copy_context().run, fetch)
 
     def flush(self, fh: int) -> None:
         """Commit dirty pages: upload remainders, merge new chunks into

@@ -726,6 +726,8 @@ struct Request {
   size_t auth_len = 0;
   const char* range = nullptr;  // Range header value
   size_t range_len = 0;
+  const char* traceparent = nullptr;  // W3C trace context, relayed as-is
+  size_t traceparent_len = 0;
 };
 
 // epoll data.ptr discrimination: Conn and PeerConn both lead with an
@@ -895,6 +897,9 @@ ssize_t parse_head(const char* buf, size_t len, Request* r) {
       } else if (ieq(p, klen, "range")) {
         r->range = v;
         r->range_len = vlen;
+      } else if (ieq(p, klen, "traceparent")) {
+        r->traceparent = v;
+        r->traceparent_len = vlen;
       } else if (ieq(p, klen, "content-encoding")) {
         r->proxy_only = true;  // pre-compressed body: python sets the needle flag
       } else if (klen >= 8 && ieq(p, 8, "seaweed-")) {
@@ -2197,6 +2202,7 @@ struct ReplWire {
   uint64_t key = 0;
   std::string body;  // copied out of the client buffer (it advances)
   std::string auth;  // client token, forwarded on the HTTP wire
+  std::string traceparent;  // trace context, forwarded on the HTTP wire
   std::string fid;   // path fid (no slash, no extension)
   std::string head;  // encoded header bytes (frame or HTTP head)
   int enc_mode = -1;  // PeerConn mode `head` was built for
@@ -2262,6 +2268,13 @@ void encode_wire(ReplWire* w, int mode) {
     // validity window (the reference forwards the jwt the same way)
     w->head.append("Authorization: ");
     w->head.append(w->auth);
+    w->head.append("\r\n");
+  }
+  if (!w->traceparent.empty()) {
+    // pass-through only: the dataplane never records spans, it just
+    // keeps the python-side trace stitched across the replicate hop
+    w->head.append("traceparent: ");
+    w->head.append(w->traceparent);
     w->head.append("\r\n");
   }
   w->head.append("\r\n");
@@ -2729,6 +2742,8 @@ bool submit_repl(Server* s, Conn* c, const Request& r, uint32_t vid,
     w->cookie = cookie;
     w->fid.assign(fid, fid_len);
     if (r.auth && r.auth_len) w->auth.assign(r.auth, r.auth_len);
+    if (r.traceparent && r.traceparent_len)
+      w->traceparent.assign(r.traceparent, r.traceparent_len);
     if (!is_delete && body_len > 0)
       w->body.assign((const char*)body, body_len);
     pc->sendq.push_back(w);

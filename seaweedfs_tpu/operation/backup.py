@@ -11,9 +11,8 @@ from __future__ import annotations
 
 import os
 
-import requests
-
 from ..storage.volume import Volume
+from ..rpc.httpclient import session
 
 
 class BackupError(Exception):
@@ -21,7 +20,7 @@ class BackupError(Exception):
 
 
 def _locate(master_url: str, vid: int) -> str:
-    r = requests.get(f"{master_url}/dir/lookup",
+    r = session().get(f"{master_url}/dir/lookup",
                      params={"volumeId": vid}, timeout=30)
     try:
         body = r.json()
@@ -43,7 +42,7 @@ def backup_volume(master_url: str, vid: int, dest_dir: str,
     if not master_url.startswith("http"):
         master_url = f"http://{master_url}"
     source = _locate(master_url, vid)
-    st = requests.get(f"http://{source}/admin/volume_sync_status",
+    st = session().get(f"http://{source}/admin/volume_sync_status",
                       params={"volume": vid}, timeout=60)
     if st.status_code >= 300:
         raise BackupError(f"sync status from {source}: {st.text}")
@@ -93,7 +92,7 @@ def backup_volume(master_url: str, vid: int, dest_dir: str,
 def _full_copy(source: str, vid: int, collection: str, dest_dir: str,
                name: str) -> None:
     for ext in (".dat", ".idx"):
-        with requests.get(f"http://{source}/admin/copy_file",
+        with session().get(f"http://{source}/admin/copy_file",
                           params={"volume": vid, "collection": collection,
                                   "ext": ext},
                           stream=True, timeout=600) as r:
@@ -113,7 +112,7 @@ def _incremental_copy(source: str, vid: int, local: Volume) -> int:
 
     applied = 0
     buf = bytearray()
-    with requests.get(f"http://{source}/admin/volume_incremental_copy",
+    with session().get(f"http://{source}/admin/volume_incremental_copy",
                       params={"volume": vid,
                               "since_ns": local.last_append_at_ns},
                       stream=True, timeout=600) as r:

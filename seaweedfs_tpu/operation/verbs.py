@@ -11,6 +11,7 @@ from dataclasses import dataclass, field
 import requests
 
 from ..rpc.httpclient import session
+from ..utils import retry
 
 
 @dataclass
@@ -95,8 +96,14 @@ def delete(url: str, auth: str = "") -> None:
 def upload_data(master_url: str, data: bytes, name: str = "",
                 collection: str = "", replication: str = "",
                 ttl: str = "", mime: str = "") -> str:
-    """assign + upload in one call; returns the fid."""
-    a = assign(master_url, collection=collection, replication=replication,
-               ttl=ttl)
-    upload(a, data, name=name, mime=mime)
+    """assign + upload in one call; returns the fid.
+
+    Mints an overall deadline covering both hops (the SDK is its own
+    gateway edge), so a slow assign eats into the upload's budget
+    instead of each hop getting a fresh clock.
+    """
+    with retry.deadline_scope(budget=retry.EDGE_BUDGET):
+        a = assign(master_url, collection=collection,
+                   replication=replication, ttl=ttl)
+        upload(a, data, name=name, mime=mime)
     return a.fid

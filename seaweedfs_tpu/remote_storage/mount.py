@@ -12,7 +12,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
-import requests
+from ..rpc.httpclient import session
 
 CONF_KEY = "etc/remote.conf"
 
@@ -47,7 +47,7 @@ class RemoteConf:
 
 
 def load_conf(filer_url: str) -> RemoteConf:
-    r = requests.get(f"{filer_url.rstrip('/')}/kv/{CONF_KEY}", timeout=30)
+    r = session().get(f"{filer_url.rstrip('/')}/kv/{CONF_KEY}", timeout=30)
     if r.status_code == 404:
         return RemoteConf()
     r.raise_for_status()
@@ -55,7 +55,7 @@ def load_conf(filer_url: str) -> RemoteConf:
 
 
 def save_conf(filer_url: str, conf: RemoteConf) -> None:
-    r = requests.put(f"{filer_url.rstrip('/')}/kv/{CONF_KEY}",
+    r = session().put(f"{filer_url.rstrip('/')}/kv/{CONF_KEY}",
                      data=conf.to_json().encode(), timeout=30)
     r.raise_for_status()
 

@@ -20,6 +20,7 @@ import requests
 from ..filer.entry import Entry
 from ..rpc.meta_subscriber import MetaSubscriber
 from .mount import find_mount, load_conf, remote_key_for
+from ..rpc.httpclient import session
 
 
 class RemoteSyncWorker:
@@ -45,7 +46,7 @@ class RemoteSyncWorker:
     # reference's remote_storage/track_sync_offset.go)
     def _load_offset(self) -> int:
         try:
-            r = requests.get(f"{self.filer}/kv/{self.offset_key}",
+            r = session().get(f"{self.filer}/kv/{self.offset_key}",
                              timeout=5)
             if r.status_code == 200:
                 return int(r.content)
@@ -55,7 +56,7 @@ class RemoteSyncWorker:
 
     def _save_offset(self, ts_ns: int) -> None:
         try:
-            requests.put(f"{self.filer}/kv/{self.offset_key}",
+            session().put(f"{self.filer}/kv/{self.offset_key}",
                          data=str(ts_ns).encode(), timeout=5)
         except requests.RequestException:
             pass
@@ -143,7 +144,7 @@ class RemoteSyncWorker:
             # for an uncached placeholder the old object is the only
             # copy of the bytes
             if new.chunks:
-                r = requests.get(f"{self.filer}{new.full_path}",
+                r = session().get(f"{self.filer}{new.full_path}",
                                  timeout=600)
                 r.raise_for_status()
                 data = r.content
@@ -166,7 +167,7 @@ class RemoteSyncWorker:
             # remote object — pushing them back would be a no-op write
             self.skipped += 1
             return
-        r = requests.get(f"{self.filer}{new.full_path}", timeout=600)
+        r = session().get(f"{self.filer}{new.full_path}", timeout=600)
         r.raise_for_status()
         data = r.content
         re_ = self.client.write_file(expected_key, data)
@@ -181,7 +182,7 @@ class RemoteSyncWorker:
         posting it back verbatim would revert a concurrent newer write
         (and delete its chunks). Re-fetch the live entry and only attach
         the remote metadata if it is still the version we pushed."""
-        r = requests.get(f"{self.filer}{entry.full_path}",
+        r = session().get(f"{self.filer}{entry.full_path}",
                          params={"meta": "1"}, timeout=60)
         if r.status_code == 404:
             return  # deleted meanwhile; the delete event will mirror it
@@ -193,7 +194,7 @@ class RemoteSyncWorker:
         ent.setdefault("extended", {})["remote"] = json.dumps(
             {"key": re_.key, "size": re_.size, "mtime": re_.mtime,
              "etag": entry.md5 or re_.etag})
-        requests.post(f"{self.filer}{entry.full_path}",
+        session().post(f"{self.filer}{entry.full_path}",
                       params={"meta": "1"}, data=json.dumps(ent),
                       timeout=60).raise_for_status()
 

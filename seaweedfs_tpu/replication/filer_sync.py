@@ -9,16 +9,15 @@ filer_sync.go's clientId/signature dance).
 """
 from __future__ import annotations
 
-import requests
-
 from .replicator import Replicator
 from .sink import FilerSink
+from ..rpc.httpclient import session
 
 
 def _signature_of(filer_url: str) -> int:
     url = filer_url.rstrip("/") if filer_url.startswith("http") \
         else f"http://{filer_url}"
-    return int(requests.get(f"{url}/status",
+    return int(session().get(f"{url}/status",
                             timeout=10).json()["signature"])
 
 

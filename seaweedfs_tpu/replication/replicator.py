@@ -17,6 +17,7 @@ import requests
 from ..filer.entry import Entry
 from ..rpc.meta_subscriber import MetaSubscriber
 from .sink import ReplicationSink
+from ..rpc.httpclient import session
 
 
 class Replicator:
@@ -41,7 +42,7 @@ class Replicator:
     # -- offsets --------------------------------------------------------
     def _load_offset(self) -> int:
         try:
-            r = requests.get(f"{self.source}/kv/{self.offset_key}",
+            r = session().get(f"{self.source}/kv/{self.offset_key}",
                              timeout=5)
             if r.status_code == 200:
                 return int(r.content)
@@ -51,7 +52,7 @@ class Replicator:
 
     def _save_offset(self, ts_ns: int) -> None:
         try:
-            requests.put(f"{self.source}/kv/{self.offset_key}",
+            session().put(f"{self.source}/kv/{self.offset_key}",
                          data=str(ts_ns).encode(), timeout=5)
         except requests.RequestException:
             pass
@@ -90,7 +91,7 @@ class Replicator:
         src = self.source
 
         def read() -> bytes:
-            r = requests.get(f"{src}{full_path}", timeout=300)
+            r = session().get(f"{src}{full_path}", timeout=300)
             r.raise_for_status()
             return r.content
 

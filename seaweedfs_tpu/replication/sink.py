@@ -15,6 +15,7 @@ from typing import Callable
 import requests
 
 from ..filer.entry import Entry
+from ..rpc.httpclient import session
 
 DataReader = Callable[[], bytes]
 
@@ -66,12 +67,12 @@ class FilerSink(ReplicationSink):
     def create_entry(self, path: str, entry: Entry,
                      read_data: DataReader) -> None:
         if entry.is_directory:
-            requests.put(self._url(path),
+            session().put(self._url(path),
                          params={"mkdir": "1", **self._params()},
                          timeout=30).raise_for_status()
             return
         params = self._params()
-        r = requests.put(self._url(path), data=read_data(),
+        r = session().put(self._url(path), data=read_data(),
                          params=params,
                          headers={"Content-Type": entry.mime or
                                   "application/octet-stream"},
@@ -80,7 +81,7 @@ class FilerSink(ReplicationSink):
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         params = {"recursive": "true", **self._params()}
-        requests.delete(self._url(path), params=params, timeout=60)
+        session().delete(self._url(path), params=params, timeout=60)
 
 
 class LocalSink(ReplicationSink):
@@ -153,7 +154,7 @@ class S3Sink(ReplicationSink):
             return  # keys are flat
         url = f"{self.endpoint}/{self.bucket}/{self._key(path)}"
         data = read_data()
-        r = requests.put(url, data=data,
+        r = session().put(url, data=data,
                          headers=self._headers("PUT", url, data),
                          timeout=300)
         r.raise_for_status()
@@ -162,7 +163,7 @@ class S3Sink(ReplicationSink):
         if is_directory:
             return
         url = f"{self.endpoint}/{self.bucket}/{self._key(path)}"
-        requests.delete(url, headers=self._headers("DELETE", url, b""),
+        session().delete(url, headers=self._headers("DELETE", url, b""),
                         timeout=60)
 
 

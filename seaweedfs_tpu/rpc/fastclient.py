@@ -21,7 +21,28 @@ import asyncio
 import json as _json
 import urllib.parse
 
-from ..utils import tracing
+from ..utils import faults, retry, tracing
+
+
+class RequestError(OSError):
+    """Transport failure with enough context for the retry layer.
+
+    ``progress`` — at least one response byte arrived (the server may
+    have executed the request; never blind-replay).  ``timed_out`` —
+    the attempt hit its timeout (same "can't prove it didn't run"
+    reasoning).  A failure with neither flag is connection-level: the
+    request provably never ran and is safe to replay.
+    """
+
+    def __init__(self, msg: str, *, progress: bool = False,
+                 timed_out: bool = False):
+        super().__init__(msg)
+        self.progress = progress
+        self.timed_out = timed_out
+
+    @property
+    def conn_failure(self) -> bool:
+        return not self.progress and not self.timed_out
 
 
 class Response:
@@ -93,17 +114,73 @@ class HttpPool:
                       params: dict | None = None,
                       headers: dict | None = None,
                       data: bytes | None = None,
-                      json=None) -> Response:
-        """One round trip, recorded as a client span (and carrying the
-        traceparent header) when called under an active trace."""
+                      json=None,
+                      idempotent: bool | None = None) -> Response:
+        """One logical call: RetryPolicy loop (capped exp backoff, full
+        jitter) around single attempts, consulting the peer's circuit
+        breaker, carrying the ambient deadline on X-Sw-Deadline, and
+        recorded as a client span when called under an active trace.
+
+        ``idempotent`` marks non-GET internal calls that are safe to
+        replay (e.g. an assign, a lookup POST); unmarked writes only
+        retry when the failure proves the request never ran (connection
+        -level error with zero response bytes, or a 503 carrying
+        X-Sw-Retryable)."""
+        peer = urllib.parse.urlsplit(url).netloc
+        breaker = retry.breaker_for(peer)
+        pol = retry.policy()
+        last_exc: Exception | None = None
+        resp: Response | None = None
+        for attempt in range(pol.max_attempts):
+            if attempt:
+                await asyncio.sleep(pol.backoff(attempt))
+            retry.check_deadline()
+            if not breaker.allow():
+                raise retry.BreakerOpenError(peer, breaker.retry_after())
+            try:
+                await faults.async_hook("fastclient", method)
+                resp = await self._traced(method, url, peer,
+                                          params=params, headers=headers,
+                                          data=data, json=json)
+            except faults.FaultInjected as e:
+                # injected before any bytes moved: replayable by design,
+                # but NOT a real peer failure — don't poison the breaker
+                last_exc = e
+                if pol.should_retry(attempt, method, idempotent=idempotent,
+                                    conn_failure=True):
+                    continue
+                raise
+            except RequestError as e:
+                last_exc = e
+                if e.conn_failure:
+                    breaker.record_failure()
+                if pol.should_retry(attempt, method, idempotent=idempotent,
+                                    conn_failure=e.conn_failure):
+                    continue
+                raise
+            breaker.record_success()
+            retryable = (resp.status_code == 503 and
+                         retry.RETRYABLE_HEADER.lower() in resp._headers)
+            if retryable or resp.status_code in (502, 503, 504):
+                if pol.should_retry(attempt, method, idempotent=idempotent,
+                                    status=resp.status_code,
+                                    retryable_response=retryable):
+                    continue
+            return resp
+        if resp is not None:
+            return resp
+        raise last_exc  # type: ignore[misc]
+
+    async def _traced(self, method: str, url: str, peer: str, *,
+                      params, headers, data, json) -> Response:
+        hdrs = dict(headers or {})
+        retry.inject(hdrs)
         if tracing.current() is None:
             return await self._request(method, url, params=params,
-                                       headers=headers, data=data,
+                                       headers=hdrs, data=data,
                                        json=json)
-        peer = urllib.parse.urlsplit(url).netloc
         with tracing.span(f"{method} {peer}", kind="client",
                           peer=peer) as rec:
-            hdrs = dict(headers or {})
             tracing.inject(hdrs)
             resp = await self._request(method, url, params=params,
                                        headers=hdrs, data=data,
@@ -150,24 +227,47 @@ class HttpPool:
         else:
             blob = (head.encode() + b"\r\n" + body,)
         key = (host, port)
+        # one attempt's wire budget: the pool timeout clipped to what
+        # is left of the overall deadline the edge minted
+        timeout = self.timeout
+        rem = retry.remaining()
+        if rem is not None:
+            if rem <= 0:
+                raise retry.DeadlineExceeded(f"{method} {url}")
+            timeout = min(timeout, rem)
         last: Exception | None = None
+        saw_progress = False
+        timed_out = False
         # every pooled conn may be stale after an idle gap longer than
         # the server keepalive: drain through them and ALWAYS end on a
         # freshly-dialed attempt before declaring failure
         for _ in range(self.per_host + 1):
             pool = self._idle.get(key)
             fresh = not pool
-            conn = pool.pop() if pool else await self._connect(host, port)
+            if pool:
+                conn = pool.pop()
+            else:
+                # a refused/timed-out dial is the canonical replayable
+                # failure (zero request bytes sent) AND the breaker's
+                # trip signal — surface it as such, not as a raw OSError
+                try:
+                    conn = await asyncio.wait_for(
+                        self._connect(host, port), timeout)
+                except (OSError, asyncio.TimeoutError) as e:
+                    raise RequestError(
+                        f"fastclient {method} {url}: connect: {e!r}") from e
             progress = [False]  # set once any response byte is read
             try:
                 return await asyncio.wait_for(
                     self._roundtrip(conn, key, blob, method, progress),
-                    self.timeout)
+                    timeout)
             except (OSError, asyncio.IncompleteReadError,
                     asyncio.LimitOverrunError, asyncio.TimeoutError,
                     ValueError) as e:
                 conn[1].close()
                 last = e
+                saw_progress = progress[0]
+                timed_out = isinstance(e, asyncio.TimeoutError)
                 if progress[0] or isinstance(
                         e, (asyncio.TimeoutError,
                             # an oversized head means bytes DID arrive
@@ -175,7 +275,10 @@ class HttpPool:
                     break  # server may have executed it — never re-send
                 if fresh:
                     break  # a brand-new conn failing is a real error
-        raise OSError(f"fastclient {method} {url}: {last}")
+        raise RequestError(f"fastclient {method} {url}: {last}",
+                           progress=saw_progress or isinstance(
+                               last, asyncio.LimitOverrunError),
+                           timed_out=timed_out)
 
     async def _roundtrip(self, conn, key, blob: tuple,
                          method: str, progress: list) -> Response:

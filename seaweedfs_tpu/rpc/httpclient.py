@@ -6,6 +6,14 @@ TCP connection per call, which dominated the data-plane benchmark
 (assign+upload+read all paid a fresh handshake). One Session per
 thread (requests Sessions aren't documented thread-safe) with a wide
 urllib3 pool gives keep-alive across all client verbs.
+
+Failure handling routes through utils/retry.py: connect failures obey
+the shared RetryPolicy (full-jitter backoff) instead of urllib3's bare
+``max_retries=1`` int, every request carries the ambient deadline on
+X-Sw-Deadline, every peer consults its circuit breaker, and every call
+gets an explicit timeout (DEFAULT_TIMEOUT unless the caller passes
+one) — an untimed sync call in a server thread pool is how one dead
+peer wedges the whole pool.
 """
 from __future__ import annotations
 
@@ -15,30 +23,126 @@ import urllib.parse
 
 import requests
 
-from ..utils import tracing
+from ..utils import faults, retry, tracing
 
 _local = threading.local()
 
+# applied when a call site passes no timeout; (connect, read) so a
+# black-holed peer fails in seconds while long reads still stream
+DEFAULT_TIMEOUT = (5.0, 60.0)
+
+
+def _is_connect_failure(exc: Exception) -> bool:
+    """Did this requests.ConnectionError happen before any request
+    byte left (dial refused / unreachable / connect timeout)?  urllib3
+    folds both connect-phase and mid-stream failures into the same
+    requests exception type, so classify by the wrapped reason."""
+    seen = set()
+    e: BaseException | None = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        name = type(e).__name__
+        if name in ("NewConnectionError", "ConnectTimeoutError"):
+            return True
+        if isinstance(e, ConnectionRefusedError):
+            return True
+        # MaxRetryError keeps the reason as an attribute, not a cause
+        nxt = getattr(e, "reason", None)
+        e = nxt if isinstance(nxt, BaseException) else \
+            (e.__cause__ or e.__context__)
+    return "Connection refused" in str(exc)
+
 
 class TracingSession(requests.Session):
-    """Session that joins the active trace: when a trace context is set
-    (contextvars survive the sync call sites in operation/verbs.py and
-    the servers' thread-pool hops via asyncio.to_thread), each request
-    records a client span and carries its traceparent downstream.
-    Outside a trace this adds nothing — no header, no span."""
+    """Session that joins the active trace and the fault-tolerance
+    layer: each request records a client span (when a trace context is
+    set — contextvars survive the sync call sites in
+    operation/verbs.py and the servers' thread-pool hops via
+    asyncio.to_thread), carries traceparent + X-Sw-Deadline
+    downstream, consults the peer's circuit breaker, and retries
+    connection-level failures per the shared RetryPolicy."""
 
     def request(self, method, url, **kw):  # type: ignore[override]
+        if kw.get("timeout") is None:
+            kw["timeout"] = DEFAULT_TIMEOUT
+        rem = retry.remaining()
+        if rem is not None:
+            if rem <= 0:
+                raise retry.DeadlineExceeded(f"{method} {url}")
+            to = kw["timeout"]
+            if isinstance(to, tuple):
+                kw["timeout"] = (min(to[0], rem), min(to[1], rem))
+            else:
+                kw["timeout"] = min(to, rem)
+        headers = dict(kw.get("headers") or {})
+        retry.inject(headers)
+        kw["headers"] = headers
         if tracing.current() is None:
-            return super().request(method, url, **kw)
+            return self._retrying(method, url, **kw)
         peer = urllib.parse.urlsplit(url).netloc
         with tracing.span(f"{method} {peer}", kind="client",
                           peer=peer) as rec:
-            headers = dict(kw.get("headers") or {})
             tracing.inject(headers)
-            kw["headers"] = headers
-            resp = super().request(method, url, **kw)
+            resp = self._retrying(method, url, **kw)
             rec["status"] = str(resp.status_code)
             return resp
+
+    def _retrying(self, method, url, **kw):
+        """RetryPolicy loop around single sends.  Only provably-unsent
+        requests replay: requests.ConnectionError from urllib3 means
+        the transport failed before a response line (urllib3 raises
+        ProtocolError for mid-response drops, which surfaces the same
+        way — so non-idempotent methods additionally require the
+        breaker-style 503 + X-Sw-Retryable attestation to replay)."""
+        import time as _time
+
+        peer = urllib.parse.urlsplit(url).netloc
+        breaker = retry.breaker_for(peer)
+        pol = retry.policy()
+        last_exc: Exception | None = None
+        resp = None
+        for attempt in range(pol.max_attempts):
+            if attempt:
+                _time.sleep(pol.backoff(attempt))
+            retry.check_deadline()
+            if not breaker.allow():
+                raise retry.BreakerOpenError(peer, breaker.retry_after())
+            try:
+                faults.sync_hook("httpclient", method)
+                resp = super().request(method, url, **kw)
+            except faults.FaultInjected as e:
+                last_exc = e
+                if pol.should_retry(attempt, method, conn_failure=True):
+                    continue
+                raise
+            except requests.exceptions.ConnectionError as e:
+                # connect-phase failures (refused/unreachable/connect
+                # timeout) provably never sent the request — replayable
+                # and the breaker's trip signal; a mid-stream drop is
+                # neither (the server may have executed the request)
+                connect_phase = _is_connect_failure(e)
+                if connect_phase:
+                    breaker.record_failure()
+                last_exc = e
+                if pol.should_retry(attempt, method,
+                                    conn_failure=connect_phase):
+                    continue
+                raise
+            except requests.exceptions.Timeout:
+                # can't prove the server didn't execute it: no replay
+                raise
+            breaker.record_success()
+            retryable = (resp.status_code == 503 and
+                         retry.RETRYABLE_HEADER in resp.headers)
+            if retryable or resp.status_code in (502, 503, 504):
+                if pol.should_retry(attempt, method,
+                                    status=resp.status_code,
+                                    retryable_response=retryable):
+                    continue
+            return resp
+        if resp is not None:
+            return resp
+        raise last_exc  # type: ignore[misc]
 
 
 def session() -> requests.Session:
@@ -55,11 +159,11 @@ def session() -> requests.Session:
             os.environ.get("CURL_CA_BUNDLE")
         if ca:
             s.verify = ca
-        # max_retries as an int retries CONNECT failures only (requests
-        # builds Retry(n, read=False)), so a request is never sent
-        # twice; it papers over transient refused/reset on dial.
+        # connect-retry now lives in TracingSession._retrying (shared
+        # RetryPolicy, jittered); urllib3's own Retry stays disabled so
+        # a request is never re-sent below the policy's visibility
         adapter = requests.adapters.HTTPAdapter(
-            pool_connections=32, pool_maxsize=32, max_retries=1)
+            pool_connections=32, pool_maxsize=32, max_retries=0)
         s.mount("http://", adapter)
         s.mount("https://", adapter)
         _local.session = s

@@ -16,6 +16,7 @@ import xml.etree.ElementTree as ET
 from dataclasses import dataclass
 from typing import Iterator
 from urllib.parse import quote
+from ..rpc.httpclient import session
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 
@@ -82,14 +83,14 @@ class S3Client:
         if offset or size > 0:
             end = "" if size < 0 else str(offset + size - 1)
             h["Range"] = f"bytes={offset}-{end}"
-        r = requests.get(url, headers=h, timeout=600)
+        r = session().get(url, headers=h, timeout=600)
         r.raise_for_status()
         return r.content
 
     def put_object(self, key: str, data: bytes) -> ObjectInfo:
         import requests
         url = self.url(key)
-        r = requests.put(url, data=data,
+        r = session().put(url, data=data,
                          headers=self.headers("PUT", url, payload=data),
                          timeout=600)
         r.raise_for_status()
@@ -123,7 +124,7 @@ class S3Client:
                 return blob
 
         url = self.url(key)
-        r = requests.put(
+        r = session().put(
             url, data=_Body(),
             headers=self.headers("PUT", url, unsigned_payload=True),
             timeout=3600)
@@ -133,7 +134,7 @@ class S3Client:
     def head_object(self, key: str) -> ObjectInfo | None:
         import requests
         url = self.url(key)
-        r = requests.head(url, headers=self.headers("HEAD", url),
+        r = session().head(url, headers=self.headers("HEAD", url),
                           timeout=60)
         if r.status_code == 404:
             return None
@@ -146,7 +147,7 @@ class S3Client:
     def delete_object(self, key: str) -> None:
         import requests
         url = self.url(key)
-        r = requests.delete(url, headers=self.headers("DELETE", url),
+        r = session().delete(url, headers=self.headers("DELETE", url),
                             timeout=300)
         if r.status_code >= 300 and r.status_code != 404:
             r.raise_for_status()
@@ -154,7 +155,7 @@ class S3Client:
     def download_to(self, key: str, dest_path: str) -> int:
         import requests
         url = self.url(key)
-        r = requests.get(url, headers=self.headers("GET", url),
+        r = session().get(url, headers=self.headers("GET", url),
                          stream=True, timeout=3600)
         r.raise_for_status()
         n = 0
@@ -170,7 +171,7 @@ class S3Client:
         scope) — remote.mount.buckets discovery."""
         import requests
         url = f"{self.endpoint}/"
-        r = requests.get(url, headers=self.headers("GET", url),
+        r = session().get(url, headers=self.headers("GET", url),
                          timeout=300)
         r.raise_for_status()
         root = ET.fromstring(r.text)
@@ -188,7 +189,7 @@ class S3Client:
                 q += "&continuation-token=" + \
                     quote(token, safe="~._-")
             url = self.url(query=q)
-            r = requests.get(url, headers=self.headers("GET", url),
+            r = session().get(url, headers=self.headers("GET", url),
                              timeout=300)
             r.raise_for_status()
             root = ET.fromstring(r.text)

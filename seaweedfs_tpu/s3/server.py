@@ -30,7 +30,7 @@ from ..rpc import httpclient
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
-from ..utils import extheaders, metrics, tracing
+from ..utils import extheaders, faults, metrics, retry, tracing
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, IdentityAccessManagement, S3AuthError)
 
@@ -263,6 +263,9 @@ class S3ApiServer:
                 except S3Error as e:
                     resp = _error_response(e.code, str(e), e.status,
                                            request.path)
+                    if e.status == 503:
+                        resp.headers["Retry-After"] = str(
+                            max(1, int(getattr(e, "retry_after", 1))))
                 except S3AuthError as e:
                     resp = _error_response(e.code, str(e), e.status,
                                            request.path)
@@ -285,11 +288,15 @@ class S3ApiServer:
         # blowup — larger objects go through multipart parts
         app = web.Application(
             client_max_size=1 << 30,
-            middlewares=[tracing.aiohttp_middleware("s3"), error_mw])
+            middlewares=[tracing.aiohttp_middleware("s3"),
+                         retry.aiohttp_middleware("s3", edge=True),
+                         faults.aiohttp_middleware("s3"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/traces", tracing.handle_debug_traces),
+            web.get("/debug/breakers",
+                    retry.handle_debug_breakers_factory()),
             web.route("*", "/{tail:.*}", self.dispatch),
         ])
         return app
@@ -528,7 +535,17 @@ class S3ApiServer:
         return pool
 
     async def _filer(self, method: str, url: str, **kw):
-        return await self._http().request(method, url, **kw)
+        try:
+            return await self._http().request(method, url, **kw)
+        except retry.BreakerOpenError as e:
+            # the filer's breaker is open and there is no alternate
+            # filer to fail over to: shed the request instead of
+            # stacking timeouts (503 + Retry-After)
+            err = S3Error("ServiceUnavailable",
+                          f"filer unavailable (retry in "
+                          f"{e.retry_after:.1f}s)", 503)
+            err.retry_after = e.retry_after
+            raise err from e
 
     async def _bucket_is_public_read(self, bucket: str) -> bool:
         try:

@@ -11,13 +11,12 @@ from __future__ import annotations
 import os
 import time
 
-import requests
-
 from ..rpc.http import ServerThread
 from ..storage.store import Store
 from .filer_server import FilerServer
 from .master_server import MasterServer
 from .volume_server import VolumeServer
+from ..rpc.httpclient import session
 
 
 class Cluster:
@@ -162,7 +161,7 @@ class Cluster:
         raise TimeoutError(f"ec shards of {vid} not fully registered")
 
     def admin(self, server_i: int, path: str, body: dict) -> dict:
-        resp = requests.post(f"{self.volume_url(server_i)}{path}",
+        resp = session().post(f"{self.volume_url(server_i)}{path}",
                              json=body, timeout=120)
         out = resp.json()
         if resp.status_code >= 300:

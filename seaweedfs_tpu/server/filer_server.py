@@ -30,7 +30,7 @@ from ..filer import (Entry, FileChunk, Filer, etag_chunks,
 from ..filer.filechunks import MANIFEST_BATCH
 from ..filer.filer import DirectoryNotEmptyError
 from ..operation import verbs
-from ..utils import httprange, metrics, tracing
+from ..utils import faults, httprange, metrics, retry, tracing
 from ..wdclient.client import MasterClient
 
 DEFAULT_CHUNK_SIZE = 8 << 20  # autochunk default (`-maxMB=8` upstream)
@@ -317,11 +317,15 @@ class FilerServer:
 
         app = web.Application(
             client_max_size=1 << 40,
-            middlewares=[tracing.aiohttp_middleware("filer"), error_mw])
+            middlewares=[tracing.aiohttp_middleware("filer"),
+                         retry.aiohttp_middleware("filer", edge=True),
+                         faults.aiohttp_middleware("filer"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/traces", tracing.handle_debug_traces),
+            web.get("/debug/breakers",
+                    retry.handle_debug_breakers_factory()),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
             web.post("/dlm/lock", self.handle_dlm_lock),
             web.post("/dlm/unlock", self.handle_dlm_unlock),
@@ -378,6 +382,11 @@ class FilerServer:
 
     def _lookup_fid(self, fid: str) -> str:
         return self.masters.lookup_file_id(fid)
+
+    def lookup_file_id_urls(self, fid: str) -> list[str]:
+        """Replica urls, breaker-healthy first — lets stream.read_fid
+        hedge/fail over when `self._lookup_fid` is the lookup fn."""
+        return self.masters.lookup_file_id_urls(fid)
 
     # -- async chunk deletion (weed/filer/filer_deletion.go) ------------
     # Overwrites and deletes reclaim their dead chunks from a
@@ -647,22 +656,47 @@ class FilerServer:
             return None
         # cache-only probe: a vid-map miss does sync master HTTP with
         # retries — that belongs on a worker thread, never the loop
-        url = self.masters.lookup_file_id_cached(c.fid)
-        if url is None:
+        urls = self.masters.lookup_urls_cached(c.fid)
+        if urls is None:
             try:
-                url = await asyncio.to_thread(self._lookup_fid, c.fid)
+                urls = await asyncio.to_thread(
+                    self.lookup_file_id_urls, c.fid)
             except Exception:
                 return None
         headers = {}
         if not (offset == 0 and length >= c.size):
             headers["Range"] = f"bytes={offset}-{offset + length - 1}"
-        try:
+
+        async def fetch(url):
             resp = await self._http().request("GET", url,
                                               headers=headers)
             if resp.status_code not in (200, 206):
-                return None
+                raise IOError(f"read {c.fid}: http {resp.status_code}")
             return resp.content
-        except OSError:
+
+        try:
+            if len(urls) == 1:
+                return await fetch(urls[0])
+            # hedged replica read: fire the alternate location when the
+            # primary hasn't answered within the hedge delay
+            primary = asyncio.ensure_future(fetch(urls[0]))
+            done, _ = await asyncio.wait({primary},
+                                         timeout=retry.HEDGE_DELAY)
+            if done:
+                return primary.result()
+            metrics.counter_add("replica_read_hedges", 1)
+            secondary = asyncio.ensure_future(fetch(urls[1]))
+            racers = {primary, secondary}
+            while racers:
+                done, racers = await asyncio.wait(
+                    racers, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    if t.exception() is None:
+                        for p in racers:
+                            p.cancel()
+                        return t.result()
+            raise IOError(f"read {c.fid}: all replicas failed")
+        except (OSError, retry.DeadlineExceeded):
             return None
 
     async def _list_dir(self, req: web.Request, path: str) -> web.Response:

@@ -26,7 +26,7 @@ from ..master.topology import (NoFreeSlots, NoWritableVolume, Topology,
                                VolumeInfo)
 from ..rpc.http import json_error, json_ok
 from ..storage import types as t
-from ..utils import tracing
+from ..utils import faults, retry, tracing
 from ..utils.security import Guard
 
 
@@ -173,9 +173,13 @@ class MasterServer:
     def _build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1 << 20,
-            middlewares=[tracing.aiohttp_middleware("master")])
+            middlewares=[tracing.aiohttp_middleware("master"),
+                         retry.aiohttp_middleware("master"),
+                         faults.aiohttp_middleware("master")])
         app.add_routes([
             web.get("/debug/traces", tracing.handle_debug_traces),
+            web.get("/debug/breakers",
+                    retry.handle_debug_breakers_factory()),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -536,6 +540,7 @@ class MasterServer:
             "Peers": self.raft.peers if self.raft else [],
             "VacuumDisabled": self.vacuum_disabled,
             "Topology": self.topo.to_dict(),
+            "Breakers": retry.breakers_snapshot(),
         })
 
     async def handle_vacuum_now(self, req: web.Request) -> web.Response:

@@ -15,6 +15,7 @@ writes.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import os
 import time
@@ -28,8 +29,13 @@ from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
-from ..utils import glog, httprange, metrics, tracing
+from ..utils import faults, glog, httprange, metrics, retry, tracing
 from ..utils.security import Guard
+
+
+# per-peer cap for replica fan-out writes; clipped further by the
+# request's remaining X-Sw-Deadline budget
+REPLICATE_TIMEOUT = 30.0
 
 
 class InFlightLimiter:
@@ -136,13 +142,17 @@ class VolumeServer:
 
         app = web.Application(
             client_max_size=256 << 20,
-            middlewares=[tracing.aiohttp_middleware("volume"), error_mw])
+            middlewares=[tracing.aiohttp_middleware("volume"),
+                         retry.aiohttp_middleware("volume"),
+                         faults.aiohttp_middleware("volume"), error_mw])
         app.add_routes([
             web.get("/", self.handle_ui),
             web.get("/ui/index.html", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/traces", tracing.handle_debug_traces),
+            web.get("/debug/breakers",
+                    retry.handle_debug_breakers_factory()),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/delete_volume", self.handle_delete_volume),
             web.post("/admin/mark_readonly", self.handle_mark_readonly),
@@ -1021,34 +1031,60 @@ class VolumeServer:
                 params["compressed"] = "1"
         import urllib.parse
 
+        tracing.inject(headers)
+        retry.inject(headers)
         qs = urllib.parse.urlencode(params)
         sess = self._client()
+        # replica writes must land on EVERY peer before the ack: bound
+        # each hop (deadline-aware) so one dead peer can't hold the
+        # client for the session default, and fail fast on a peer whose
+        # breaker is already open instead of re-proving it down
+        budget = retry.remaining(default=REPLICATE_TIMEOUT) or \
+            REPLICATE_TIMEOUT
+        timeout = aiohttp.ClientTimeout(
+            total=max(0.1, min(REPLICATE_TIMEOUT, budget)), connect=5.0)
         for peer in peers:
+            breaker = retry.breaker_for(peer)
+            if not breaker.allow():
+                self._invalidate_lookup(vid)
+                return f"replicate to {peer}: circuit open"
             url = f"http://{peer}/{fid}?{qs}"
             try:
                 if method == "POST":
-                    async with sess.post(url, data=data,
-                                         headers=headers) as resp:
+                    async with sess.post(url, data=data, headers=headers,
+                                         timeout=timeout) as resp:
                         if resp.status >= 300:
                             self._invalidate_lookup(vid)
                             return (f"replicate to {peer}: "
                                     f"{resp.status}")
                 else:
-                    async with sess.delete(url, headers=headers) as resp:
+                    async with sess.delete(url, headers=headers,
+                                           timeout=timeout) as resp:
                         if resp.status >= 300 and resp.status != 404:
                             self._invalidate_lookup(vid)
                             return (f"replicate delete {peer}: "
                                     f"{resp.status}")
-            except aiohttp.ClientError as e:
+            except aiohttp.ClientConnectorError as e:
+                # connect-phase failure: the breaker's trip signal
+                breaker.record_failure()
+                self._invalidate_lookup(vid)
+                return f"replicate to {peer}: {e}"
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 # the cached peer may be dead or moved — re-resolve on
                 # the next write instead of failing for the whole TTL
                 self._invalidate_lookup(vid)
-                return f"replicate to {peer}: {e}"
+                return f"replicate to {peer}: {e!r}"
+            breaker.record_success()
         return None
 
     async def _lookup_volume(self, vid: int) -> str | None:
         urls = await self._lookup_volume_all(vid)
-        return urls[0] if urls else None
+        if not urls:
+            return None
+        # redirect clients away from a replica whose breaker is open
+        healthy = [u for u in urls
+                   if retry.breaker_for(u).state != retry.OPEN]
+        return (healthy or urls)[0]
 
     def _client(self) -> aiohttp.ClientSession:
         """Shared keep-alive client session, bound to the serving loop
@@ -1771,12 +1807,14 @@ class VolumeServer:
                                   deadline_t: float) -> bytes | None:
         import requests
 
+        from ..rpc.httpclient import session
+
         for holder in holders:
             remaining = deadline_t - time.monotonic()
             if remaining <= 0:
                 return None
             try:
-                r = requests.get(
+                r = session().get(
                     f"http://{holder}/admin/ec/shard_read",
                     params={"volume": vid, "shard": sid,
                             "offset": offset, "size": size},
@@ -1819,7 +1857,11 @@ class VolumeServer:
         for sid in sids:
             holders = [h for h in holders_map.get(str(sid), []) if h != me]
             if holders:
+                # copy_context: pool.submit (unlike asyncio.to_thread)
+                # drops contextvars, which would orphan the fetch spans
+                # from the request trace and lose the deadline
                 futs[pool.submit(
+                    contextvars.copy_context().run,
                     self._fetch_shard_from_holders, vid, sid, holders,
                     offset, size, deadline_t)] = sid
         out: dict[int, bytes] = {}

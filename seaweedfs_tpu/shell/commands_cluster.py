@@ -9,6 +9,7 @@ from __future__ import annotations
 import requests
 
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 
 def cluster_ps(env: CommandEnv) -> dict:
@@ -45,7 +46,7 @@ def cluster_raft_change(env: CommandEnv, peer: str,
         raise ShellError("needs -peer=host:port")
     verb = "add" if add else "remove"
     # followers 307 to the leader; requests re-POSTs on 307
-    resp = requests.post(
+    resp = session().post(
         f"{env.master_url}/cluster/raft/{verb}",
         params={"peer": peer}, timeout=30)
     if resp.status_code >= 300:
@@ -68,7 +69,7 @@ def cluster_raft_ps(env: CommandEnv) -> dict:
     for p in peers:
         url = p if p.startswith("http") else f"http://{p}"
         try:
-            d = requests.get(f"{url}/cluster/leader", timeout=3).json()
+            d = session().get(f"{url}/cluster/leader", timeout=3).json()
             out.append({"address": p, "leader": d.get("IsLeader", False),
                         "reachable": True})
         except requests.RequestException:

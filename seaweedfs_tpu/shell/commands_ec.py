@@ -231,9 +231,9 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
     itself a valid codeword set, so `sample_mb` bounds IO while still
     exercising every shard end-to-end; 0 means full shards."""
     import numpy as np
-    import requests
 
     from ..ec.backend import ReedSolomon
+    from ..rpc.httpclient import session
 
     _col, (k, m), locs = env.ec_info(volume_id)
     missing = [sid for sid in range(k + m) if sid not in locs]
@@ -248,7 +248,7 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
                   "offset": "0"}
         if sample:
             params["size"] = str(sample)
-        resp = requests.get(f"http://{url}/admin/ec/shard_read",
+        resp = session().get(f"http://{url}/admin/ec/shard_read",
                             params=params, timeout=600)
         if resp.status_code != 200:
             return {"volume": volume_id, "verified": False,

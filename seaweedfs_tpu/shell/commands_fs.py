@@ -18,6 +18,7 @@ import requests
 
 from ..filer.entry import entry_size
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 
 DIR_MODE_FLAG = 0o40000
@@ -50,7 +51,7 @@ def _list(env: CommandEnv, path: str,
         params = {"limit": "1024", "lastFileName": last}
         if name_pattern:
             params["namePattern"] = name_pattern
-        resp = requests.get(f"{_filer(env)}{path}",
+        resp = session().get(f"{_filer(env)}{path}",
                             params=params,
                             headers={"Accept": "application/json"},
                             timeout=60)
@@ -70,13 +71,13 @@ def _exists(env: CommandEnv, path: str) -> bool:
     # percent-encode: glob chars like ? must stay PATH bytes here, not
     # start a query string
     quoted = urllib.parse.quote(path, safe="/")
-    resp = requests.get(f"{_filer(env)}{quoted}", params={"meta": "1"},
+    resp = session().get(f"{_filer(env)}{quoted}", params={"meta": "1"},
                         timeout=60)
     return resp.status_code == 200
 
 
 def _stat(env: CommandEnv, path: str) -> dict:
-    resp = requests.get(f"{_filer(env)}{path}", params={"meta": "1"},
+    resp = session().get(f"{_filer(env)}{path}", params={"meta": "1"},
                         timeout=60)
     if resp.status_code == 404:
         raise ShellError(f"not found: {path}")
@@ -111,14 +112,14 @@ def fs_ls(env: CommandEnv, path: str = "/", long: bool = False) -> list:
 
 
 def fs_cat(env: CommandEnv, path: str) -> bytes:
-    resp = requests.get(f"{_filer(env)}{path}", timeout=300)
+    resp = session().get(f"{_filer(env)}{path}", timeout=300)
     if resp.status_code >= 300:
         raise ShellError(f"cat {path}: {resp.status_code}")
     return resp.content
 
 
 def fs_mkdir(env: CommandEnv, path: str) -> dict:
-    resp = requests.post(f"{_filer(env)}{path}", params={"mkdir": "1"},
+    resp = session().post(f"{_filer(env)}{path}", params={"mkdir": "1"},
                          timeout=60)
     if resp.status_code >= 300:
         raise ShellError(f"mkdir {path}: {resp.status_code}")
@@ -126,7 +127,7 @@ def fs_mkdir(env: CommandEnv, path: str) -> dict:
 
 
 def fs_rm(env: CommandEnv, path: str, recursive: bool = False) -> None:
-    resp = requests.delete(
+    resp = session().delete(
         f"{_filer(env)}{path}",
         params={"recursive": "true"} if recursive else {}, timeout=300)
     if resp.status_code >= 300:
@@ -134,7 +135,7 @@ def fs_rm(env: CommandEnv, path: str, recursive: bool = False) -> None:
 
 
 def fs_mv(env: CommandEnv, src: str, dst: str) -> None:
-    resp = requests.put(f"{_filer(env)}{dst}", params={"mv.from": src},
+    resp = session().put(f"{_filer(env)}{dst}", params={"mv.from": src},
                         timeout=300)
     if resp.status_code >= 300:
         raise ShellError(f"mv {src} {dst}: {resp.text}")
@@ -217,7 +218,7 @@ def fs_meta_change_volume_id(env: CommandEnv, path: str,
             if apply:
                 full = e["full_path"]
                 e.pop("full_path", None)
-                resp = requests.put(f"{_filer(env)}{full}?meta=1",
+                resp = session().put(f"{_filer(env)}{full}?meta=1",
                                     json=e, timeout=60)
                 if resp.status_code >= 300:
                     raise ShellError(f"update {full}: {resp.text}")
@@ -231,7 +232,7 @@ def fs_meta_notify(env: CommandEnv, path: str = "/") -> dict:
     prime a fresh downstream consumer."""
     from ..notification.queues import queue_from_config
 
-    conf = requests.get(f"{_filer(env)}/kv/notification.conf",
+    conf = session().get(f"{_filer(env)}/kv/notification.conf",
                         timeout=30)
     if conf.status_code != 200:
         raise ShellError("no notification.conf configured in the filer "
@@ -253,7 +254,7 @@ def mount_configure(env: CommandEnv, dir: str = "",
     (command_mount_configure.go): FUSE mounts read it at start and on
     metadata events. -quotaMB=0 clears the quota."""
     key = "mount.conf"
-    resp = requests.get(f"{_filer(env)}/kv/{key}", timeout=30)
+    resp = session().get(f"{_filer(env)}/kv/{key}", timeout=30)
     if resp.status_code == 200:
         conf = json.loads(resp.content)
     elif resp.status_code == 404:
@@ -272,7 +273,7 @@ def mount_configure(env: CommandEnv, dir: str = "",
         conf.pop(dir, None)
     else:
         conf[dir] = {"quota_bytes": quota_mb << 20}
-    r = requests.put(f"{_filer(env)}/kv/{key}",
+    r = session().put(f"{_filer(env)}/kv/{key}",
                      data=json.dumps(conf).encode(), timeout=30)
     if r.status_code >= 300:
         raise ShellError(f"mount.configure: {r.text}")
@@ -302,7 +303,7 @@ def fs_meta_load(env: CommandEnv, in_file: str) -> int:
             if _is_dir(e):
                 fs_mkdir(env, path)
             else:
-                resp = requests.put(
+                resp = session().put(
                     f"{_filer(env)}{path}",
                     params={"meta": "1", "skipChunkDeletion": "true"},
                     data=json.dumps(e), timeout=60)
@@ -325,7 +326,7 @@ def fs_verify(env: CommandEnv, path: str = "/") -> list[dict]:
             ok = False
             for url in env.volume_locations(int(vid)):
                 try:
-                    r = requests.head(f"http://{url}/{fid}", timeout=30)
+                    r = session().head(f"http://{url}/{fid}", timeout=30)
                     if r.status_code == 200:
                         ok = True
                         break
@@ -347,7 +348,7 @@ def fs_configure(env: CommandEnv, location_prefix: str = "",
     """
     from ..filer.filer_conf import CONF_KEY, FilerConf, PathConf
 
-    resp = requests.get(f"{_filer(env)}/kv/{CONF_KEY}", timeout=60)
+    resp = session().get(f"{_filer(env)}/kv/{CONF_KEY}", timeout=60)
     conf = FilerConf.from_json(resp.content) \
         if resp.status_code == 200 else FilerConf()
     if not location_prefix:
@@ -367,7 +368,7 @@ def fs_configure(env: CommandEnv, location_prefix: str = "",
                             fields.get("maxFileNameLength", "0")))
         conf.set_rule(rule)
     if apply:
-        r = requests.put(f"{_filer(env)}/kv/{CONF_KEY}",
+        r = session().put(f"{_filer(env)}/kv/{CONF_KEY}",
                          data=conf.to_json().encode(), timeout=60)
         if r.status_code >= 300:
             raise ShellError(f"fs.configure: {r.text}")

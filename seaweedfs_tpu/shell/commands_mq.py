@@ -9,6 +9,7 @@ from __future__ import annotations
 import requests
 
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 
 def _broker(env: CommandEnv) -> str:
@@ -25,7 +26,7 @@ def _call(method: str, url: str, what: str, **kw):
     its membership-TTL window must read as a ShellError, not a
     traceback."""
     try:
-        r = requests.request(method, url, timeout=30, **kw)
+        r = session().request(method, url, timeout=30, **kw)
     except requests.RequestException as e:
         raise ShellError(f"{what}: broker unreachable: {e}")
     if r.status_code >= 300:

@@ -17,6 +17,7 @@ from ..remote_storage import (RemoteMount, find_mount, load_conf,
                               make_client, remote_key_for, save_conf)
 from .commands_fs import _filer, _walk
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 
 def remote_configure(env: CommandEnv, name: str = "",
@@ -67,7 +68,7 @@ def remote_mount(env: CommandEnv, dir: str = "",
                                  remote_path=prefix)
     save_conf(_filer(env), rc)
     # make sure the mount dir exists, then pull metadata
-    requests.post(f"{_filer(env)}{dir}", params={"mkdir": "1"},
+    session().post(f"{_filer(env)}{dir}", params={"mkdir": "1"},
                   timeout=30)
     synced = remote_meta_sync(env, dir)
     return {"mounted": dir, **synced}
@@ -155,7 +156,7 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
         if ent is None:
             entry = {"full_path": path, "mtime": re_.mtime or None,
                      "extended": {"remote": json.dumps(meta)}}
-            requests.post(f"{_filer(env)}{path}",
+            session().post(f"{_filer(env)}{path}",
                           params={"meta": "1"},
                           data=json.dumps(entry), timeout=60
                           ).raise_for_status()
@@ -167,7 +168,7 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
             continue  # unchanged
         ent.setdefault("extended", {})["remote"] = json.dumps(meta)
         ent["chunks"] = []  # changed upstream: drop the stale copy
-        requests.post(f"{_filer(env)}{path}", params={"meta": "1"},
+        session().post(f"{_filer(env)}{path}", params={"meta": "1"},
                       data=json.dumps(ent), timeout=60).raise_for_status()
         updated += 1
     # prune placeholders whose remote object is gone (uncached only —
@@ -182,7 +183,7 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
         # the snapshot is minutes old for big buckets: re-check the
         # LIVE entry so a placeholder that gained chunks (remote.cache
         # or a local write) mid-sync is never deleted with its bytes
-        live = requests.get(f"{_filer(env)}{path}",
+        live = session().get(f"{_filer(env)}{path}",
                             params={"meta": "1"}, timeout=30)
         if live.status_code != 200:
             continue
@@ -190,7 +191,7 @@ def remote_meta_sync(env: CommandEnv, dir: str) -> dict:
         if le.get("chunks") or \
                 not le.get("extended", {}).get("remote"):
             continue
-        requests.delete(f"{_filer(env)}{path}", timeout=30)
+        session().delete(f"{_filer(env)}{path}", timeout=30)
         removed += 1
     return {"created": created, "updated": updated, "removed": removed}
 
@@ -204,7 +205,7 @@ def remote_cache(env: CommandEnv, dir: str) -> dict:
     for e in _walk(env, dir):
         if e.get("chunks") or not e.get("extended", {}).get("remote"):
             continue
-        r = requests.post(f"{_filer(env)}{e['full_path']}",
+        r = session().post(f"{_filer(env)}{e['full_path']}",
                           params={"cacheRemote": "1"}, timeout=3600)
         if r.status_code != 200:
             raise ShellError(f"cache {e['full_path']}: {r.text}")
@@ -222,7 +223,7 @@ def remote_uncache(env: CommandEnv, dir: str) -> dict:
         if not e.get("chunks") or \
                 not e.get("extended", {}).get("remote"):
             continue
-        r = requests.post(f"{_filer(env)}{e['full_path']}",
+        r = session().post(f"{_filer(env)}{e['full_path']}",
                           params={"uncacheRemote": "1"}, timeout=600)
         if r.status_code != 200:
             raise ShellError(f"uncache {e['full_path']}: {r.text}")

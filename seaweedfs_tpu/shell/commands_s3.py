@@ -12,10 +12,9 @@ from __future__ import annotations
 
 import json
 
-import requests
-
 from .commands_fs import _filer, _is_dir, _list, _name
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 IDENTITIES_KEY = "s3/identities"
 CIRCUIT_BREAKER_KEY = "s3/circuit_breaker"
@@ -23,7 +22,7 @@ BUCKETS_DIR = "/buckets"
 
 
 def _kv_get(env: CommandEnv, key: str) -> dict:
-    r = requests.get(f"{_filer(env)}/kv/{key}", timeout=30)
+    r = session().get(f"{_filer(env)}/kv/{key}", timeout=30)
     if r.status_code == 404:
         return {}
     if r.status_code >= 300:
@@ -32,7 +31,7 @@ def _kv_get(env: CommandEnv, key: str) -> dict:
 
 
 def _kv_put(env: CommandEnv, key: str, value: dict) -> None:
-    r = requests.put(f"{_filer(env)}/kv/{key}",
+    r = session().put(f"{_filer(env)}/kv/{key}",
                      data=json.dumps(value, indent=1).encode(),
                      timeout=30)
     if r.status_code >= 300:
@@ -94,7 +93,7 @@ def s3_bucket_list(env: CommandEnv) -> list[dict]:
 def s3_bucket_create(env: CommandEnv, name: str) -> dict:
     if not name:
         raise ShellError("s3.bucket.create needs -name")
-    r = requests.post(f"{_filer(env)}{BUCKETS_DIR}/{name}/",
+    r = session().post(f"{_filer(env)}{BUCKETS_DIR}/{name}/",
                       params={"mkdir": "1"}, timeout=30)
     if r.status_code >= 300:
         raise ShellError(f"s3.bucket.create: {r.text}")
@@ -106,7 +105,7 @@ def s3_bucket_delete(env: CommandEnv, name: str,
     if not name:
         raise ShellError("s3.bucket.delete needs -name")
     params = {"recursive": "true"} if include_objects else {}
-    r = requests.delete(f"{_filer(env)}{BUCKETS_DIR}/{name}",
+    r = session().delete(f"{_filer(env)}{BUCKETS_DIR}/{name}",
                         params=params, timeout=60)
     if r.status_code == 409:
         raise ShellError(f"bucket {name} is not empty "
@@ -149,7 +148,7 @@ def s3_bucket_quota(env: CommandEnv, name: str,
         ext["s3_quota_bytes"] = str(quota_mb << 20)
     meta["extended"] = ext
     meta.pop("full_path", None)
-    r = requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
+    r = session().put(f"{_filer(env)}{path}?meta=1", json=meta,
                      timeout=30)
     if r.status_code >= 300:
         raise ShellError(f"s3.bucket.quota: {r.text}")
@@ -203,7 +202,7 @@ def s3_bucket_quota_enforce(env: CommandEnv) -> list[dict]:
                 ext.pop("s3_quota_enforced", None)
             meta["extended"] = ext
             meta.pop("full_path", None)
-            r = requests.put(f"{_filer(env)}{path}?meta=1", json=meta,
+            r = session().put(f"{_filer(env)}{path}?meta=1", json=meta,
                              timeout=30)
             if r.status_code >= 300:
                 # a lost latch write would leave the volumes read-only
@@ -235,7 +234,7 @@ def s3_clean_uploads(env: CommandEnv,
         for u in uploads:
             if u.get("mtime", 0) < cutoff:
                 full = u["full_path"]
-                requests.delete(f"{_filer(env)}{full}",
+                session().delete(f"{_filer(env)}{full}",
                                 params={"recursive": "true"},
                                 timeout=60)
                 removed.append(full)

@@ -9,10 +9,9 @@ from __future__ import annotations
 
 from collections import defaultdict
 
-import requests
-
 from ..storage.super_block import ReplicaPlacement
 from .env import CommandEnv, ShellError
+from ..rpc.httpclient import session
 
 
 def volume_list(env: CommandEnv) -> list[dict]:
@@ -359,8 +358,6 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
       record with the newest append_at_ns wins and force-overwrites the
       rest.
     """
-    import requests
-
     from ..storage import needle as ndl
 
     env.confirm_locked()
@@ -370,7 +367,7 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
     live: dict[str, dict[int, int]] = {}     # url -> {key: size}
     deleted: dict[str, set[int]] = {}        # url -> tombstoned keys
     for url in urls:
-        body = requests.get(f"http://{url}/admin/needle_ids",
+        body = session().get(f"http://{url}/admin/needle_ids",
                             params={"volume": vid}, timeout=120).json()
         live[url] = {p[0]: p[1] for p in body["needles"]}
         deleted[url] = set(body.get("deleted", []))
@@ -379,7 +376,7 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
     repaired = []
 
     def read_raw(src: str, key: int) -> bytes:
-        r = requests.get(f"http://{src}/admin/needle_read",
+        r = session().get(f"http://{src}/admin/needle_read",
                          params={"volume": vid, "key": key}, timeout=120)
         if r.status_code != 200:
             raise ShellError(f"read needle {key} of volume {vid} from "
@@ -387,7 +384,7 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
         return r.content
 
     def write_raw(dst: str, blob: bytes, force: bool = False) -> None:
-        r = requests.post(f"http://{dst}/admin/needle_write",
+        r = session().post(f"http://{dst}/admin/needle_write",
                           params={"volume": vid,
                                   **({"force": "1"} if force else {})},
                           data=blob, timeout=120)
@@ -399,7 +396,7 @@ def volume_check_disk(env: CommandEnv, vid: int) -> dict:
             # tombstone wins: delete wherever it is still live
             for url in urls:
                 if key in live[url]:
-                    r = requests.post(
+                    r = session().post(
                         f"http://{url}/admin/needle_delete",
                         json={"volume": vid, "key": key}, timeout=120)
                     if r.status_code != 200:
@@ -438,8 +435,6 @@ def volume_fsck(env: CommandEnv) -> dict:
     """Cross-check filer chunk fids against volume-server needle ids
     (command_volume_fsck.go): orphans = needles no filer entry points
     at; missing = chunks whose needle is gone."""
-    import requests
-
     from ..storage.types import parse_file_id
     from . import commands_fs
 
@@ -457,7 +452,7 @@ def volume_fsck(env: CommandEnv) -> dict:
         for vid in list(n["volumes"]) + \
                 [int(v) for v in n["ec_volumes"]]:
             try:
-                resp = requests.get(f"http://{n['url']}/admin/needle_ids",
+                resp = session().get(f"http://{n['url']}/admin/needle_ids",
                                     params={"volume": vid}, timeout=120)
                 if resp.status_code != 200:
                     continue
@@ -488,7 +483,7 @@ def volume_tier_upload(env: CommandEnv, vid: int,
     # restore them instead of leaving the volume wedged read-only
     was_writable = []
     for url in urls:
-        info = requests.get(f"http://{url}/admin/volume_info",
+        info = session().get(f"http://{url}/admin/volume_info",
                             params={"volume": vid}, timeout=60).json()
         if not info.get("read_only"):
             was_writable.append(url)
@@ -560,7 +555,7 @@ def volume_delete_empty(env: CommandEnv,
     for n in env.data_nodes():
         # live counts come from the server's status report (the
         # topology snapshot doesn't carry file counts)
-        resp = requests.get(f"http://{n['url']}/status", timeout=30)
+        resp = session().get(f"http://{n['url']}/status", timeout=30)
         vols = {v["id"]: v for v in resp.json().get("volumes", [])}
         for vid in n["volumes"]:
             v = vols.get(vid)
@@ -634,7 +629,7 @@ def volume_vacuum_toggle(env: CommandEnv, disable: bool) -> dict:
     the maintenance cron and the manual vacuum command."""
     env.confirm_locked()
     path = "/vol/vacuum/disable" if disable else "/vol/vacuum/enable"
-    resp = requests.post(f"{env.master_url}{path}", timeout=30)
+    resp = session().post(f"{env.master_url}{path}", timeout=30)
     if resp.status_code >= 300:
         raise ShellError(f"{path}: {resp.text}")
     return resp.json()

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 
-import requests
+from ..rpc.httpclient import session
 
 
 class ShellError(Exception):
@@ -39,7 +39,7 @@ class CommandEnv:
 
     # -- master helpers -------------------------------------------------
     def master_get(self, path: str, **params) -> dict:
-        resp = requests.get(f"{self.master_url}{path}", params=params,
+        resp = session().get(f"{self.master_url}{path}", params=params,
                             timeout=60)
         # status first: a 502/500 from a proxy carries an HTML body
         # that would raise JSONDecodeError past ShellError-only callers
@@ -110,7 +110,7 @@ class CommandEnv:
     # -- volume server admin -------------------------------------------
     def vs_post(self, server: str, path: str, body: dict,
                 timeout: float = 600) -> dict:
-        resp = requests.post(f"http://{server}{path}", json=body,
+        resp = session().post(f"http://{server}{path}", json=body,
                              timeout=timeout)
         try:
             out = resp.json()

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..ec import geometry as geo
 from ..ec.backend import ReedSolomon
+from ..ec.backend import cpu_backend_name as ec_cpu_backend
 from ..ec.encoder import rebuild_ec_files, write_ec_files, write_sorted_ecx
 from ..ec.volume import EcVolume
 from . import needle as ndl
@@ -345,21 +346,33 @@ class Store:
             raise IOError(
                 f"cannot reconstruct shard {missing_sid} of volume "
                 f"{ecv.vid}: only {len(rows)} shards reachable")
-        rec = self._rs_for(ecv).reconstruct(rows, [missing_sid])
+        rec = self._rs_for(ecv, interval=True).reconstruct(
+            rows, [missing_sid])
         return rec[missing_sid].tobytes()
 
-    def _rs_for(self, ecv: EcVolume) -> ReedSolomon:
+    def _rs_for(self, ecv: EcVolume, *,
+                interval: bool = False) -> ReedSolomon:
         """Per-codec ReedSolomon, cached — wide-code volumes carry their
-        own (k, m) from the .vif sidecar."""
-        if (ecv.k, ecv.m) == (geo.DATA_SHARDS, geo.PARITY_SHARDS):
+        own (k, m) from the .vif sidecar.
+
+        interval=True pins the CPU codec (native/numpy) regardless of
+        the configured device backend: a single-needle degraded read
+        reconstructs a few KB on a GET's critical path, where a device
+        dispatch (jit compile + host<->device DMA, measured ~1.6s cold)
+        is pure latency with zero throughput payoff.  Whole-volume
+        encode/rebuild keeps the configured backend — that's where the
+        device's bandwidth actually wins."""
+        backend = ec_cpu_backend() if interval else self.ec_backend
+        if not interval and \
+                (ecv.k, ecv.m) == (geo.DATA_SHARDS, geo.PARITY_SHARDS):
             return self._rs
         cache = getattr(self, "_rs_cache", None)
         if cache is None:
             cache = self._rs_cache = {}
-        rs = cache.get((ecv.k, ecv.m))
+        rs = cache.get((ecv.k, ecv.m, backend))
         if rs is None:
-            rs = cache[(ecv.k, ecv.m)] = ReedSolomon(
-                ecv.k, ecv.m, backend=self.ec_backend)
+            rs = cache[(ecv.k, ecv.m, backend)] = ReedSolomon(
+                ecv.k, ecv.m, backend=backend)
         return rs
 
     # -- heartbeat -------------------------------------------------------

@@ -1,0 +1,490 @@
+"""Unified retry / deadline / circuit-breaker layer for internal hops.
+
+Three cooperating pieces, shared by both rpc clients and all four
+servers (the same role filer.backoff + wdclient/exclusive_locks play in
+the reference, folded into one policy object):
+
+* ``RetryPolicy`` — capped exponential backoff with **full jitter**
+  (AWS architecture-blog style: ``sleep = uniform(0, min(cap, base *
+  2**attempt))``), a per-attempt timeout, and an overall deadline.
+  Retries are idempotency-aware: GET/HEAD and explicitly-marked
+  idempotent calls retry; non-idempotent requests are replayed only
+  when the far end attests it never started the work (see
+  ``RETRYABLE_HEADER``).
+
+* **Deadlines** — a budget minted once at the gateway edge (S3/filer
+  request middleware) and carried downstream on every internal hop via
+  the ``X-Sw-Deadline`` header (absolute unix epoch seconds).  Servers
+  reject work whose deadline already passed instead of computing a
+  response nobody is waiting for.  The ambient deadline lives in a
+  contextvar so it flows through ``asyncio`` tasks and
+  ``asyncio.to_thread`` the same way trace context does.
+
+* ``CircuitBreaker`` — per-peer consecutive connection-failure breaker
+  with a half-open probe.  Callers fail fast to the next replica (or
+  503 + Retry-After when there is nowhere else to go) instead of
+  re-timing-out against a dead peer on every request.
+
+Stdlib-only on purpose — both the sync ``requests`` client and the
+asyncio fastclient import this.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import random
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Iterator
+
+# absolute unix-epoch seconds, decimal string, minted at the gateway
+DEADLINE_HEADER = "X-Sw-Deadline"
+# a 503 carrying this header attests the server rejected the request
+# BEFORE doing any work (fault injection, breaker shed, deadline check)
+# — safe to replay even for non-idempotent methods
+RETRYABLE_HEADER = "X-Sw-Retryable"
+
+_IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "OPTIONS"})
+
+
+class DeadlineExceeded(Exception):
+    """The request's overall deadline passed before the work finished."""
+
+
+class BreakerOpenError(ConnectionError):
+    """Fail-fast refusal: the peer's circuit breaker is open.
+
+    Subclasses ConnectionError so existing replica-failover paths that
+    catch OSError treat it as "this peer is down, try the next one".
+    """
+
+    def __init__(self, peer: str, retry_after: float = 0.0):
+        super().__init__(f"circuit open for peer {peer}")
+        self.peer = peer
+        self.retry_after = retry_after
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation
+# ---------------------------------------------------------------------------
+
+_deadline: contextvars.ContextVar[float | None] = contextvars.ContextVar(
+    "sw_deadline", default=None)
+
+
+def current_deadline() -> float | None:
+    """Absolute epoch deadline for the ambient request, or None."""
+    return _deadline.get()
+
+
+def remaining(default: float | None = None) -> float | None:
+    """Seconds left on the ambient deadline (may be <= 0), or default."""
+    dl = _deadline.get()
+    if dl is None:
+        return default
+    return dl - time.time()
+
+
+def expired() -> bool:
+    dl = _deadline.get()
+    return dl is not None and dl <= time.time()
+
+
+def check_deadline() -> None:
+    """Raise DeadlineExceeded if the ambient deadline already passed."""
+    if expired():
+        raise DeadlineExceeded(
+            f"deadline passed {time.time() - (_deadline.get() or 0):.3f}s ago")
+
+
+@contextlib.contextmanager
+def deadline_scope(budget: float | None = None,
+                   absolute: float | None = None) -> Iterator[float | None]:
+    """Bind a deadline for the duration of the with-block.
+
+    ``budget`` is relative seconds from now, ``absolute`` an epoch
+    timestamp (e.g. parsed from ``X-Sw-Deadline``).  An inner scope can
+    only tighten an outer one — a downstream hop never outlives the
+    budget the edge minted.
+    """
+    dl = absolute if absolute is not None else (
+        time.time() + budget if budget is not None else None)
+    outer = _deadline.get()
+    if dl is None or (outer is not None and outer < dl):
+        dl = outer
+    token = _deadline.set(dl)
+    try:
+        yield dl
+    finally:
+        _deadline.reset(token)
+
+
+def parse_deadline(value: str | None) -> float | None:
+    """Parse an X-Sw-Deadline header value; garbage parses as None."""
+    if not value:
+        return None
+    try:
+        dl = float(value)
+    except ValueError:
+        return None
+    # sanity: refuse deadlines more than a day out (clock-skew garbage)
+    if dl - time.time() > 86400:
+        return None
+    return dl
+
+
+def inject(headers: dict) -> dict:
+    """Add X-Sw-Deadline to outgoing request headers (tracing.inject
+    idiom).  No-op when no ambient deadline is set."""
+    dl = _deadline.get()
+    if dl is not None and DEADLINE_HEADER not in headers:
+        headers[DEADLINE_HEADER] = f"{dl:.6f}"
+    return headers
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with full jitter + deadline awareness.
+
+    One instance describes one hop class; ``DEFAULT`` (module level,
+    tunable via ``configure`` / ``-retry.*`` CLI flags) covers ordinary
+    internal calls.
+    """
+    max_attempts: int = 3
+    base_delay: float = 0.02     # seconds; first backoff ∈ [0, base)
+    max_delay: float = 1.0       # backoff cap
+    attempt_timeout: float = 20.0  # per-attempt budget when no deadline
+
+    def backoff(self, attempt: int,
+                rng: random.Random | None = None) -> float:
+        """Full-jitter sleep before attempt ``attempt`` (1-based retry
+        index: first retry ⇒ attempt=1)."""
+        cap = min(self.max_delay, self.base_delay * (2 ** max(0, attempt)))
+        draw = (rng or random).uniform(0, cap)
+        rem = remaining()
+        if rem is not None:
+            draw = min(draw, max(0.0, rem))
+        return draw
+
+    def attempt_budget(self) -> float:
+        """Timeout for the next attempt: per-attempt cap, clipped to
+        whatever is left of the overall deadline."""
+        rem = remaining()
+        if rem is None:
+            return self.attempt_timeout
+        if rem <= 0:
+            raise DeadlineExceeded("no budget left for another attempt")
+        return min(self.attempt_timeout, rem)
+
+    @staticmethod
+    def idempotent(method: str, marked: bool | None = None) -> bool:
+        if marked is not None:
+            return marked
+        return method.upper() in _IDEMPOTENT_METHODS
+
+    def should_retry(self, attempt: int, method: str, *,
+                     idempotent: bool | None = None,
+                     conn_failure: bool = False,
+                     status: int | None = None,
+                     retryable_response: bool = False) -> bool:
+        """Decide whether attempt ``attempt`` (0-based, just failed)
+        may be retried.
+
+        * ``conn_failure`` — the request never reached the peer (connect
+          refused / reset with zero response bytes): always replayable.
+        * ``retryable_response`` — the response carried
+          ``X-Sw-Retryable`` (server attests no work was done).
+        * otherwise only idempotent methods retry, and only on
+          connection-ish statuses (502/503/504).
+        """
+        if attempt + 1 >= self.max_attempts:
+            return False
+        if expired():
+            return False
+        if conn_failure or retryable_response:
+            return True
+        if not self.idempotent(method, idempotent):
+            return False
+        return status in (502, 503, 504)
+
+    def call(self, fn: Callable, method: str = "GET", *,
+             idempotent: bool | None = None,
+             classify: Callable | None = None,
+             rng: random.Random | None = None):
+        """Sync retry loop: ``fn(timeout)`` is invoked up to
+        ``max_attempts`` times.  ``classify(exc_or_result)`` returns a
+        dict of should_retry kwargs (conn_failure/status/
+        retryable_response); default treats OSError as conn failure.
+        """
+        last_exc: BaseException | None = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                time.sleep(self.backoff(attempt, rng))
+            check_deadline()
+            try:
+                result = fn(self.attempt_budget())
+            except DeadlineExceeded:
+                raise
+            except Exception as exc:  # noqa: BLE001 — classified below
+                last_exc = exc
+                kw = (classify(exc) if classify is not None
+                      else {"conn_failure": isinstance(exc, OSError)})
+                if not self.should_retry(attempt, method,
+                                         idempotent=idempotent, **kw):
+                    raise
+                continue
+            if classify is not None:
+                kw = classify(result)
+                if kw and self.should_retry(attempt, method,
+                                            idempotent=idempotent, **kw):
+                    last_exc = None
+                    continue
+            return result
+        if last_exc is not None:
+            raise last_exc
+        raise DeadlineExceeded("retry budget exhausted")
+
+
+# ---------------------------------------------------------------------------
+# Per-peer circuit breaker
+# ---------------------------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+@dataclass
+class _BreakerConfig:
+    failure_threshold: int = 5   # consecutive conn failures to trip
+    reset_timeout: float = 5.0   # seconds open before the probe
+
+
+class CircuitBreaker:
+    """Connection-failure breaker for one peer (host:port).
+
+    Only *connection-level* failures count — an HTTP error status means
+    the peer is alive and must reset the streak.  Thread-safe: the sync
+    requests client and the asyncio fastclient share instances.
+    """
+
+    def __init__(self, peer: str, config: _BreakerConfig):
+        self.peer = peer
+        self._cfg = config
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        self.trips = 0  # lifetime trip count (metric)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (self._state == OPEN and
+                time.time() - self._opened_at >= self._cfg.reset_timeout):
+            self._state = HALF_OPEN
+            self._probing = False
+
+    def allow(self) -> bool:
+        """May a request go to this peer right now?  In half-open state
+        exactly one probe is admitted; the rest fail fast until the
+        probe reports back."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def retry_after(self) -> float:
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._cfg.reset_timeout -
+                       (time.time() - self._opened_at))
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = CLOSED
+            self._probing = False
+
+    def record_failure(self) -> None:
+        """Record one connection-level failure."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to open, timer restarts
+                self._state = OPEN
+                self._opened_at = time.time()
+                self._probing = False
+                return
+            self._failures += 1
+            if (self._state == CLOSED and
+                    self._failures >= self._cfg.failure_threshold):
+                self._state = OPEN
+                self._opened_at = time.time()
+                self.trips += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open()
+            return {"peer": self.peer, "state": self._state,
+                    "consecutive_failures": self._failures,
+                    "trips": self.trips,
+                    "retry_after": round(max(0.0, self._cfg.reset_timeout -
+                                             (time.time() - self._opened_at))
+                                         if self._state == OPEN else 0.0, 3)}
+
+
+class BreakerRegistry:
+    """Process-wide peer → breaker map (all clients share one view of
+    peer health, like wdclient's vidMap is shared)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self.config = _BreakerConfig()
+
+    def for_peer(self, peer: str) -> CircuitBreaker:
+        peer = peer.strip().removeprefix("http://").removeprefix("https://")
+        peer = peer.split("/", 1)[0]
+        with self._lock:
+            br = self._breakers.get(peer)
+            if br is None:
+                br = self._breakers[peer] = CircuitBreaker(peer, self.config)
+            return br
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            brs = list(self._breakers.values())
+        return [b.snapshot() for b in sorted(brs, key=lambda b: b.peer)]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._breakers.clear()
+
+
+_registry = BreakerRegistry()
+
+
+def breaker_for(peer: str) -> CircuitBreaker:
+    return _registry.for_peer(peer)
+
+
+def breakers_snapshot() -> list[dict]:
+    return _registry.snapshot()
+
+
+def reset_breakers() -> None:
+    """Test hook: forget all peer state."""
+    _registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide defaults (tuned by cli.py global flags)
+# ---------------------------------------------------------------------------
+
+DEFAULT = RetryPolicy()
+# budget minted at the gateway edge when the client sent no deadline;
+# generous on purpose — it exists to bound runaway work (a dead peer
+# chain), not to police ordinary large uploads
+EDGE_BUDGET = 300.0
+# hedged replica reads: fire the alternate after this many seconds
+HEDGE_DELAY = 0.35
+
+
+def configure(max_attempts: int | None = None,
+              base_delay: float | None = None,
+              max_delay: float | None = None,
+              attempt_timeout: float | None = None,
+              edge_budget: float | None = None,
+              breaker_failures: int | None = None,
+              breaker_reset: float | None = None,
+              hedge_delay: float | None = None) -> None:
+    """Apply -retry.* / -breaker.* / -hedge.* CLI flags."""
+    global DEFAULT, EDGE_BUDGET, HEDGE_DELAY
+    kw = {}
+    if max_attempts is not None:
+        kw["max_attempts"] = max(1, int(max_attempts))
+    if base_delay is not None:
+        kw["base_delay"] = float(base_delay)
+    if max_delay is not None:
+        kw["max_delay"] = float(max_delay)
+    if attempt_timeout is not None:
+        kw["attempt_timeout"] = float(attempt_timeout)
+    if kw:
+        DEFAULT = replace(DEFAULT, **kw)
+    if edge_budget is not None:
+        EDGE_BUDGET = float(edge_budget)
+    if breaker_failures is not None:
+        _registry.config.failure_threshold = max(1, int(breaker_failures))
+    if breaker_reset is not None:
+        _registry.config.reset_timeout = float(breaker_reset)
+    if hedge_delay is not None:
+        HEDGE_DELAY = float(hedge_delay)
+
+
+def policy() -> RetryPolicy:
+    return DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# Server-side deadline middleware
+# ---------------------------------------------------------------------------
+
+def aiohttp_middleware(service: str, edge: bool = False):
+    """Bind the request's deadline for the handler's context.
+
+    Internal servers (``edge=False``) honour the X-Sw-Deadline header a
+    caller sent and reject already-dead work with 504 before the
+    handler runs.  Gateway-edge servers (``edge=True``: s3, filer) mint
+    a fresh EDGE_BUDGET deadline when the client sent none, so every
+    downstream hop inherits a bound.
+    """
+    from aiohttp import web
+
+    _SKIP_PATHS = {"/metrics", "/debug/traces", "/debug/breakers",
+                   "/status", "/healthz"}
+
+    @web.middleware
+    async def middleware(request, handler):
+        if request.path in _SKIP_PATHS:
+            return await handler(request)
+        dl = parse_deadline(request.headers.get(DEADLINE_HEADER))
+        if dl is not None and dl <= time.time():
+            # nobody is waiting for this response any more
+            return web.Response(status=504, text="deadline exceeded\n")
+        if dl is None and edge:
+            dl = time.time() + EDGE_BUDGET
+        if dl is None:
+            return await handler(request)
+        token = _deadline.set(dl)
+        try:
+            return await handler(request)
+        except DeadlineExceeded:
+            return web.Response(status=504, text="deadline exceeded\n")
+        finally:
+            _deadline.reset(token)
+    return middleware
+
+
+def handle_debug_breakers_factory():
+    """aiohttp handler for GET /debug/breakers (tracing's
+    handle_debug_traces idiom)."""
+    from aiohttp import web
+
+    async def handle(request):
+        return web.json_response({"breakers": breakers_snapshot()})
+    return handle

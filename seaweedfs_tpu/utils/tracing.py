@@ -266,11 +266,20 @@ def aiohttp_middleware(service: str):
 
 
 async def handle_debug_traces(request):
-    """GET /debug/traces?limit=N — shared route handler for all servers."""
+    """GET /debug/traces?limit=N — shared route handler for all servers.
+    Also carries this process's circuit-breaker view (one stop for
+    "why is this hop slow/failing"): {"traces": [...], "breakers":
+    [...]}; plain list requests keep working via ?format=spans."""
     from aiohttp import web
+
+    from . import retry as _retry
 
     try:
         limit = int(request.query.get("limit", "20"))
     except ValueError:
         limit = 20
-    return web.json_response(traces_json(limit=limit))
+    traces = traces_json(limit=limit)
+    if request.query.get("format") == "spans":
+        return web.json_response(traces)
+    return web.json_response({"traces": traces,
+                              "breakers": _retry.breakers_snapshot()})

@@ -14,6 +14,20 @@ import time
 
 import requests
 from ..rpc.httpclient import session
+from ..utils import retry
+
+
+def _order_by_breaker(urls: list[str]) -> list[str]:
+    """Peers whose circuit breaker is closed/half-open first, open-
+    breaker peers last (still listed — when every replica is open the
+    caller should try anyway rather than fail without a request).
+    Reads breaker state without consuming the half-open probe slot."""
+    good, bad = [], []
+    for u in urls:
+        peer = u.split("//", 1)[-1].split("/", 1)[0]
+        (bad if retry.breaker_for(peer).state == retry.OPEN
+         else good).append(u)
+    return good + bad
 
 
 class MasterClient:
@@ -69,12 +83,20 @@ class MasterClient:
         return []
 
     def lookup_file_id(self, fid: str) -> str:
-        """fid -> full url (GetLookupFileIdFunction equivalent)."""
+        """fid -> full url (GetLookupFileIdFunction equivalent).
+        Replica-aware: a location whose circuit breaker is open is
+        skipped while an alternative replica exists."""
+        return self.lookup_file_id_urls(fid)[0]
+
+    def lookup_file_id_urls(self, fid: str) -> list[str]:
+        """All replica urls for a fid, healthiest (breaker-closed)
+        first — callers iterate for failover, or hedge the second."""
         vid = int(fid.split(",")[0])
         locs = self.lookup(vid)
         if not locs:
             raise LookupError(f"volume {vid} has no locations")
-        return f"http://{locs[0]['url']}/{fid}"
+        return _order_by_breaker(
+            [f"http://{loc['url']}/{fid}" for loc in locs])
 
     def lookup_file_id_cached(self, fid: str,
                               max_age: float = 600.0) -> str | None:
@@ -86,7 +108,21 @@ class MasterClient:
             if not locs or time.monotonic() - \
                     self._cache_time.get(vid, 0) >= max_age:
                 return None
-        return f"http://{locs[0]['url']}/{fid}"
+        return _order_by_breaker(
+            [f"http://{loc['url']}/{fid}" for loc in locs])[0]
+
+    def lookup_urls_cached(self, fid: str,
+                           max_age: float = 600.0) -> list[str] | None:
+        """Cache-only replica list (breaker-healthy first), None on a
+        cold/stale vid — NO network, safe on an event loop."""
+        vid = int(fid.split(",")[0])
+        with self._lock:
+            locs = self._vid_cache.get(vid)
+            if not locs or time.monotonic() - \
+                    self._cache_time.get(vid, 0) >= max_age:
+                return None
+        return _order_by_breaker(
+            [f"http://{loc['url']}/{fid}" for loc in locs])
 
     def lookup_ec(self, vid: int,
                   max_age: float = 600.0) -> dict[int, list[str]]:

@@ -1,0 +1,126 @@
+"""Circuit-breaker state machine tests: trip on consecutive connection
+failures, timed transition to half-open, single-probe admission, and
+the process-wide registry shared by both rpc clients."""
+import threading
+
+from seaweedfs_tpu.utils import retry
+
+
+def _breaker(threshold=3, reset=300.0):
+    return retry.CircuitBreaker(
+        "127.0.0.1:9999",
+        retry._BreakerConfig(failure_threshold=threshold,
+                             reset_timeout=reset))
+
+
+def _rewind(br):
+    """Age the open timer past reset_timeout without sleeping."""
+    br._opened_at -= br._cfg.reset_timeout + 1.0
+
+
+class TestStateMachine:
+    def test_trips_after_threshold_consecutive_failures(self):
+        br = _breaker(threshold=3)
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == retry.CLOSED
+        assert br.allow()
+        br.record_failure()
+        assert br.state == retry.OPEN
+        assert not br.allow()
+        assert br.trips == 1
+        assert br.retry_after() > 0
+
+    def test_success_resets_the_streak(self):
+        """An HTTP error status means the peer is alive — the caller
+        records success at the connection level and the streak resets."""
+        br = _breaker(threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == retry.CLOSED
+
+    def test_half_open_after_reset_timeout(self):
+        br = _breaker(threshold=1)
+        br.record_failure()
+        assert br.state == retry.OPEN
+        _rewind(br)
+        assert br.state == retry.HALF_OPEN
+
+    def test_half_open_admits_exactly_one_probe(self):
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()        # the probe
+        assert not br.allow()    # everyone else still fails fast
+        assert not br.allow()
+
+    def test_probe_failure_reopens(self):
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == retry.OPEN
+        assert not br.allow()
+
+    def test_probe_success_closes(self):
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()
+        br.record_success()
+        assert br.state == retry.CLOSED
+        assert br.allow()
+
+    def test_snapshot_shape(self):
+        br = _breaker(threshold=1)
+        br.record_failure()
+        snap = br.snapshot()
+        assert snap["peer"] == "127.0.0.1:9999"
+        assert snap["state"] == retry.OPEN
+        assert snap["trips"] == 1
+        assert snap["retry_after"] > 0
+
+    def test_thread_safety_smoke(self):
+        br = _breaker(threshold=1000000)
+        threads = [threading.Thread(
+            target=lambda: [br.record_failure() for _ in range(1000)])
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert br.snapshot()["consecutive_failures"] == 8000
+
+
+class TestRegistry:
+    def setup_method(self):
+        retry.reset_breakers()
+
+    def teardown_method(self):
+        retry.reset_breakers()
+
+    def test_peer_key_normalised(self):
+        """A url and a bare host:port resolve to one breaker — the sync
+        client passes urls, the fastclient passes host:port."""
+        a = retry.breaker_for("http://10.0.0.1:8080/path/x")
+        b = retry.breaker_for("10.0.0.1:8080")
+        c = retry.breaker_for("https://10.0.0.1:8080")
+        assert a is b is c
+
+    def test_snapshot_sorted_and_exposed(self):
+        retry.breaker_for("hostb:1").record_failure()
+        retry.breaker_for("hosta:1")
+        peers = [s["peer"] for s in retry.breakers_snapshot()]
+        assert peers == ["hosta:1", "hostb:1"]
+
+    def test_breaker_open_error_is_connection_error(self):
+        """Replica-failover paths catch OSError; a breaker refusal must
+        ride the same path to the next replica."""
+        err = retry.BreakerOpenError("p:1", retry_after=2.5)
+        assert isinstance(err, ConnectionError)
+        assert err.peer == "p:1"
+        assert err.retry_after == 2.5

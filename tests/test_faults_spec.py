@@ -1,0 +1,111 @@
+"""Fault-injection spec tests: parser round-trips, loud failures on
+malformed specs, and seeded determinism of the injection decisions."""
+import pytest
+
+from seaweedfs_tpu.utils import faults
+
+
+class TestParse:
+    def test_basic_spec(self):
+        rules = faults.parse_spec("volume:read:error=0.05,filer:*:delay=30ms")
+        assert rules == [
+            faults.Rule("volume", "read", "error", 0.05),
+            faults.Rule("filer", "*", "delay", 0.03),
+        ]
+
+    def test_durations(self):
+        assert faults.parse_spec("a:*:delay=500us")[0].value == 5e-4
+        assert faults.parse_spec("a:*:delay=30ms")[0].value == 0.03
+        assert faults.parse_spec("a:*:delay=2s")[0].value == 2.0
+        assert faults.parse_spec("a:*:delay=0.25")[0].value == 0.25
+
+    def test_whitespace_and_empty_parts_tolerated(self):
+        rules = faults.parse_spec(" volume:read:error=0.1 , ,")
+        assert len(rules) == 1
+
+    @pytest.mark.parametrize("bad", [
+        "volume:read",                 # missing kind=value
+        "volume:read:error",           # no '='
+        "volume:launch:error=0.1",     # bad op
+        "volume:read:explode=0.1",     # bad kind
+        "volume:read:error=1.5",       # probability out of range
+        "volume:read:error=0",         # zero probability is a typo
+        "volume:read:error=abc",       # not a number
+        "volume:read:delay=-5ms",      # negative delay
+    ])
+    def test_malformed_specs_fail_loudly(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_round_trip(self):
+        spec = "volume:read:error=0.05,filer:*:delay=30ms,s3:write:delay=2s"
+        rules = faults.parse_spec(spec)
+        assert faults.parse_spec(faults.format_spec(rules)) == rules
+
+    def test_op_of(self):
+        assert faults.op_of("GET") == "read"
+        assert faults.op_of("head") == "read"
+        assert faults.op_of("POST") == "write"
+        assert faults.op_of("DELETE") == "write"
+
+
+class TestRegistry:
+    def test_deterministic_for_fixed_seed(self):
+        a = faults.FaultRegistry()
+        b = faults.FaultRegistry()
+        a.configure("volume:*:error=0.3", seed=1234)
+        b.configure("volume:*:error=0.3", seed=1234)
+        seq_a = [a.decide("volume", "read") for _ in range(200)]
+        seq_b = [b.decide("volume", "read") for _ in range(200)]
+        assert seq_a == seq_b
+        assert any(err for _d, err in seq_a)      # some fire
+        assert not all(err for _d, err in seq_a)  # some don't
+
+    def test_different_seed_different_sequence(self):
+        a = faults.FaultRegistry()
+        b = faults.FaultRegistry()
+        a.configure("volume:*:error=0.5", seed=1)
+        b.configure("volume:*:error=0.5", seed=2)
+        seq_a = [a.decide("volume", "read")[1] for _ in range(100)]
+        seq_b = [b.decide("volume", "read")[1] for _ in range(100)]
+        assert seq_a != seq_b
+
+    def test_rules_scoped_to_service_and_op(self):
+        r = faults.FaultRegistry()
+        r.configure("volume:read:error=1.0,filer:*:delay=30ms", seed=0)
+        assert r.decide("volume", "read") == (0.0, True)
+        assert r.decide("volume", "write") == (0.0, False)
+        assert r.decide("filer", "write") == (0.03, False)
+        assert r.decide("master", "read") == (0.0, False)
+
+    def test_unconfigured_is_disabled_and_free(self):
+        r = faults.FaultRegistry()
+        assert not r.enabled
+        assert r.decide("volume", "read") == (0.0, False)
+
+
+class TestHooks:
+    def teardown_method(self):
+        faults.configure(spec=None)
+
+    def test_sync_hook_raises_and_counts(self):
+        faults.configure("httpclient:*:error=1.0", seed=0)
+        assert faults.enabled()
+        with pytest.raises(faults.FaultInjected):
+            faults.sync_hook("httpclient", "GET")
+        assert faults.counts().get("httpclient:error", 0) == 1
+        # FaultInjected models a connection that never carried the
+        # request — the retry layer must treat it as replayable
+        assert issubclass(faults.FaultInjected, ConnectionError)
+
+    def test_disabled_hook_is_noop(self):
+        faults.configure(spec=None)
+        assert not faults.enabled()
+        faults.sync_hook("httpclient", "GET")  # no raise
+
+    def test_configure_resets_counters(self):
+        faults.configure("httpclient:*:error=1.0", seed=0)
+        with pytest.raises(faults.FaultInjected):
+            faults.sync_hook("httpclient", "GET")
+        faults.configure("httpclient:*:error=1.0", seed=0)
+        assert faults.counts() == {}

@@ -1,0 +1,211 @@
+"""Unit tests for the unified retry/deadline layer (utils/retry.py):
+full-jitter backoff bounds, deadline scoping/propagation, idempotency
+decisions, and the server-side deadline middleware."""
+import random
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.rpc.http import ServerThread
+from seaweedfs_tpu.utils import retry
+
+
+class TestBackoff:
+    def test_full_jitter_bounds(self):
+        """Every draw lands in [0, min(cap, base * 2**attempt)] —
+        the AWS full-jitter contract."""
+        p = retry.RetryPolicy(base_delay=0.05, max_delay=1.0)
+        rng = random.Random(42)
+        for attempt in range(1, 8):
+            cap = min(p.max_delay, p.base_delay * (2 ** attempt))
+            for _ in range(200):
+                d = p.backoff(attempt, rng)
+                assert 0.0 <= d <= cap, (attempt, d, cap)
+
+    def test_jitter_actually_spreads(self):
+        p = retry.RetryPolicy(base_delay=0.5, max_delay=10.0)
+        rng = random.Random(7)
+        draws = {round(p.backoff(3, rng), 6) for _ in range(50)}
+        assert len(draws) > 40  # not a fixed schedule
+
+    def test_backoff_clipped_to_deadline(self):
+        p = retry.RetryPolicy(base_delay=10.0, max_delay=100.0)
+        rng = random.Random(1)
+        with retry.deadline_scope(budget=0.05):
+            for _ in range(50):
+                assert p.backoff(4, rng) <= 0.05 + 1e-6
+
+    def test_deterministic_given_seed(self):
+        p = retry.RetryPolicy()
+        a = [p.backoff(i, random.Random(99)) for i in range(1, 5)]
+        b = [p.backoff(i, random.Random(99)) for i in range(1, 5)]
+        assert a == b
+
+
+class TestDeadline:
+    def test_scope_binds_and_restores(self):
+        assert retry.current_deadline() is None
+        with retry.deadline_scope(budget=5.0) as dl:
+            assert dl is not None
+            assert retry.current_deadline() == dl
+            assert 0 < retry.remaining() <= 5.0
+        assert retry.current_deadline() is None
+        assert retry.remaining(default=3.0) == 3.0
+
+    def test_inner_scope_only_tightens(self):
+        with retry.deadline_scope(budget=1.0) as outer:
+            with retry.deadline_scope(budget=100.0) as inner:
+                assert inner == outer  # cannot extend the edge budget
+            with retry.deadline_scope(budget=0.1) as tight:
+                assert tight < outer
+
+    def test_check_deadline_raises_after_expiry(self):
+        with retry.deadline_scope(absolute=time.time() - 1.0):
+            assert retry.expired()
+            with pytest.raises(retry.DeadlineExceeded):
+                retry.check_deadline()
+
+    def test_attempt_budget_clips_and_raises(self):
+        p = retry.RetryPolicy(attempt_timeout=20.0)
+        assert p.attempt_budget() == 20.0
+        with retry.deadline_scope(budget=0.5):
+            assert p.attempt_budget() <= 0.5
+        with retry.deadline_scope(absolute=time.time() - 1.0):
+            with pytest.raises(retry.DeadlineExceeded):
+                p.attempt_budget()
+
+    def test_parse_and_inject_round_trip(self):
+        with retry.deadline_scope(budget=30.0) as dl:
+            hdrs = retry.inject({})
+            assert retry.DEADLINE_HEADER in hdrs
+            assert abs(retry.parse_deadline(
+                hdrs[retry.DEADLINE_HEADER]) - dl) < 1e-3
+
+    def test_parse_deadline_rejects_garbage(self):
+        assert retry.parse_deadline(None) is None
+        assert retry.parse_deadline("") is None
+        assert retry.parse_deadline("not-a-number") is None
+        # clock-skew garbage: more than a day out
+        assert retry.parse_deadline(str(time.time() + 200000)) is None
+
+
+class TestRetryDecisions:
+    def test_idempotent_methods(self):
+        assert retry.RetryPolicy.idempotent("GET")
+        assert retry.RetryPolicy.idempotent("head")
+        assert not retry.RetryPolicy.idempotent("POST")
+        assert not retry.RetryPolicy.idempotent("PUT")
+        # explicit marking overrides the method heuristic
+        assert retry.RetryPolicy.idempotent("POST", marked=True)
+        assert not retry.RetryPolicy.idempotent("GET", marked=False)
+
+    def test_conn_failure_replayable_even_for_writes(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        assert p.should_retry(0, "POST", conn_failure=True)
+        assert p.should_retry(0, "PUT", conn_failure=True)
+
+    def test_attested_retryable_response_replayable(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        assert p.should_retry(0, "POST", status=503,
+                              retryable_response=True)
+
+    def test_write_status_errors_not_replayed(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        assert not p.should_retry(0, "POST", status=503)
+        assert not p.should_retry(0, "DELETE", status=502)
+
+    def test_idempotent_gateway_statuses_replayed(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        for status in (502, 503, 504):
+            assert p.should_retry(0, "GET", status=status)
+        assert not p.should_retry(0, "GET", status=500)
+        assert not p.should_retry(0, "GET", status=404)
+
+    def test_attempts_exhausted(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        assert not p.should_retry(2, "GET", conn_failure=True)
+
+    def test_expired_deadline_stops_retries(self):
+        p = retry.RetryPolicy(max_attempts=5)
+        with retry.deadline_scope(absolute=time.time() - 1.0):
+            assert not p.should_retry(0, "GET", conn_failure=True)
+
+    def test_call_retries_conn_failures_then_succeeds(self):
+        p = retry.RetryPolicy(max_attempts=3, base_delay=0.001,
+                              max_delay=0.002)
+        calls = []
+
+        def fn(timeout):
+            calls.append(timeout)
+            if len(calls) < 3:
+                raise ConnectionRefusedError("nope")
+            return "ok"
+
+        assert p.call(fn, "POST") == "ok"
+        assert len(calls) == 3
+
+    def test_call_raises_non_retryable_immediately(self):
+        p = retry.RetryPolicy(max_attempts=3)
+        calls = []
+
+        def fn(timeout):
+            calls.append(1)
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            p.call(fn, "POST")
+        assert len(calls) == 1
+
+
+class TestDeadlineMiddleware:
+    def test_expired_deadline_rejected_504_and_edge_mints(self):
+        from aiohttp import web
+
+        seen = []
+
+        async def handler(request):
+            seen.append(retry.remaining())
+            return web.Response(text="ok")
+
+        app = web.Application(
+            middlewares=[retry.aiohttp_middleware("filer", edge=True)])
+        app.router.add_get("/x", handler)
+        t = ServerThread(app).start()
+        try:
+            # already-dead work is refused before the handler runs
+            r = requests.get(f"{t.url}/x", headers={
+                retry.DEADLINE_HEADER: str(time.time() - 5)}, timeout=5)
+            assert r.status_code == 504
+            assert not seen
+            # a live deadline is honoured
+            r = requests.get(f"{t.url}/x", headers={
+                retry.DEADLINE_HEADER: str(time.time() + 20)}, timeout=5)
+            assert r.status_code == 200
+            assert seen and 0 < seen[-1] <= 20
+            # no deadline at the edge: one is minted
+            r = requests.get(f"{t.url}/x", timeout=5)
+            assert r.status_code == 200
+            assert 0 < seen[-1] <= retry.EDGE_BUDGET
+        finally:
+            t.stop()
+
+    def test_internal_server_does_not_mint(self):
+        from aiohttp import web
+
+        seen = []
+
+        async def handler(request):
+            seen.append(retry.remaining())
+            return web.Response(text="ok")
+
+        app = web.Application(
+            middlewares=[retry.aiohttp_middleware("volume")])
+        app.router.add_get("/x", handler)
+        t = ServerThread(app).start()
+        try:
+            r = requests.get(f"{t.url}/x", timeout=5)
+            assert r.status_code == 200
+            assert seen == [None]
+        finally:
+            t.stop()

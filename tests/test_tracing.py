@@ -129,8 +129,10 @@ class TestClusterPropagation:
             requests.put(f"{c.s3_url}/tb")
             requests.put(f"{c.s3_url}/tb/k", data=b"trace me" * 64)
             requests.get(f"{c.s3_url}/tb/k")
-            traces = requests.get(f"{c.s3_url}/debug/traces",
-                                  params={"limit": 50}).json()
+            body = requests.get(f"{c.s3_url}/debug/traces",
+                                params={"limit": 50}).json()
+            traces = body["traces"]
+            assert isinstance(body["breakers"], list)
             assert isinstance(traces, list) and traces
             hit = None
             for t in traces:
@@ -151,7 +153,8 @@ class TestClusterPropagation:
             for url in (c.master_url, c.filer_url, c.volume_url(0)):
                 r = requests.get(url + "/debug/traces?limit=1")
                 assert r.status_code == 200
-                assert isinstance(r.json(), list)
+                assert isinstance(r.json()["traces"], list)
+                assert "breakers" in r.json()
             # and request_trace_seconds is exported with service labels
             m = requests.get(f"{c.s3_url}/metrics").text
             assert 'request_trace_seconds_count{handler="dispatch"' \
