@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json as _json
+import time as _time
 import urllib.parse
 
 from ..utils import faults, retry, tracing
@@ -145,6 +146,8 @@ class HttpPool:
             except faults.FaultInjected as e:
                 # injected before any bytes moved: replayable by design,
                 # but NOT a real peer failure — don't poison the breaker
+                # (a held half-open probe slot is handed back, though)
+                breaker.release_probe()
                 last_exc = e
                 if pol.should_retry(attempt, method, idempotent=idempotent,
                                     conn_failure=True):
@@ -154,6 +157,10 @@ class HttpPool:
                 last_exc = e
                 if e.conn_failure:
                     breaker.record_failure()
+                else:
+                    # progress/timeout: outcome unproven — settle a held
+                    # probe back to open instead of leaking the slot
+                    breaker.probe_inconclusive()
                 if pol.should_retry(attempt, method, idempotent=idempotent,
                                     conn_failure=e.conn_failure):
                     continue
@@ -228,13 +235,17 @@ class HttpPool:
             blob = (head.encode() + b"\r\n" + body,)
         key = (host, port)
         # one attempt's wire budget: the pool timeout clipped to what
-        # is left of the overall deadline the edge minted
-        timeout = self.timeout
+        # is left of the overall deadline the edge minted — tracked as
+        # an ABSOLUTE deadline so the stale-conn drain loop below can't
+        # grant each dial/roundtrip a fresh full budget and overrun the
+        # remaining deadline several-fold
+        budget = self.timeout
         rem = retry.remaining()
         if rem is not None:
             if rem <= 0:
                 raise retry.DeadlineExceeded(f"{method} {url}")
-            timeout = min(timeout, rem)
+            budget = min(budget, rem)
+        attempt_deadline = _time.monotonic() + budget
         last: Exception | None = None
         saw_progress = False
         timed_out = False
@@ -242,6 +253,11 @@ class HttpPool:
         # the server keepalive: drain through them and ALWAYS end on a
         # freshly-dialed attempt before declaring failure
         for _ in range(self.per_host + 1):
+            timeout = attempt_deadline - _time.monotonic()
+            if timeout <= 0:
+                if retry.expired():
+                    raise retry.DeadlineExceeded(f"{method} {url}")
+                break  # attempt budget spent — report the last failure
             pool = self._idle.get(key)
             fresh = not pool
             if pool:

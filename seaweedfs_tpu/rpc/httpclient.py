@@ -111,6 +111,9 @@ class TracingSession(requests.Session):
                 faults.sync_hook("httpclient", method)
                 resp = super().request(method, url, **kw)
             except faults.FaultInjected as e:
+                # the fault fired before any bytes moved: the peer was
+                # never contacted, so hand a held probe slot back
+                breaker.release_probe()
                 last_exc = e
                 if pol.should_retry(attempt, method, conn_failure=True):
                     continue
@@ -123,13 +126,18 @@ class TracingSession(requests.Session):
                 connect_phase = _is_connect_failure(e)
                 if connect_phase:
                     breaker.record_failure()
+                else:
+                    # unproven outcome: a held probe must still settle
+                    breaker.probe_inconclusive()
                 last_exc = e
                 if pol.should_retry(attempt, method,
                                     conn_failure=connect_phase):
                     continue
                 raise
             except requests.exceptions.Timeout:
-                # can't prove the server didn't execute it: no replay
+                # can't prove the server didn't execute it: no replay —
+                # but settle a held probe so the slot never leaks
+                breaker.probe_inconclusive()
                 raise
             breaker.record_success()
             retryable = (resp.status_code == 503 and
@@ -138,6 +146,12 @@ class TracingSession(requests.Session):
                 if pol.should_retry(attempt, method,
                                     status=resp.status_code,
                                     retryable_response=retryable):
+                    # drain the abandoned response back to the pool:
+                    # stream=True call sites would otherwise leak one
+                    # pooled urllib3 conn per retried attempt, exactly
+                    # under the degraded conditions retries fire
+                    resp.close()
+                    resp = None
                     continue
             return resp
         if resp is not None:

@@ -678,15 +678,18 @@ class FilerServer:
             if len(urls) == 1:
                 return await fetch(urls[0])
             # hedged replica read: fire the alternate location when the
-            # primary hasn't answered within the hedge delay
+            # primary is slow (hedge delay) OR failed fast — mirrors
+            # the sync _hedged_fetch in filer/stream.py, which fails
+            # over to the next replica on primary error
             primary = asyncio.ensure_future(fetch(urls[0]))
             done, _ = await asyncio.wait({primary},
                                          timeout=retry.HEDGE_DELAY)
-            if done:
+            if done and primary.exception() is None:
                 return primary.result()
             metrics.counter_add("replica_read_hedges", 1)
-            secondary = asyncio.ensure_future(fetch(urls[1]))
-            racers = {primary, secondary}
+            racers = {asyncio.ensure_future(fetch(urls[1]))}
+            if not done:
+                racers.add(primary)  # still in flight — keep racing it
             while racers:
                 done, racers = await asyncio.wait(
                     racers, return_when=asyncio.FIRST_COMPLETED)

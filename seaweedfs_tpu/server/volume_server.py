@@ -1070,8 +1070,11 @@ class VolumeServer:
                 self._invalidate_lookup(vid)
                 return f"replicate to {peer}: {e}"
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                # the cached peer may be dead or moved — re-resolve on
-                # the next write instead of failing for the whole TTL
+                # outcome unproven (timeout / mid-stream drop): settle a
+                # held half-open probe so the slot doesn't leak, then
+                # re-resolve the cached peer on the next write instead
+                # of failing for the whole TTL
+                breaker.probe_inconclusive()
                 self._invalidate_lookup(vid)
                 return f"replicate to {peer}: {e!r}"
             breaker.record_success()

@@ -281,6 +281,7 @@ class CircuitBreaker:
         self._state = CLOSED
         self._opened_at = 0.0
         self._probing = False
+        self._probe_at = 0.0
         self.trips = 0  # lifetime trip count (metric)
 
     @property
@@ -290,21 +291,32 @@ class CircuitBreaker:
             return self._state
 
     def _maybe_half_open(self) -> None:
+        now = time.time()
         if (self._state == OPEN and
-                time.time() - self._opened_at >= self._cfg.reset_timeout):
+                now - self._opened_at >= self._cfg.reset_timeout):
             self._state = HALF_OPEN
+            self._probing = False
+            return
+        # probe lease: an admitted probe whose caller never settled it
+        # (timeout path, injected fault, crashed thread) must not hold
+        # the slot forever — after reset_timeout the lease expires and
+        # the next caller may probe, so a peer is never fail-fast
+        # process-wide until restart just because one probe got lost
+        if (self._state == HALF_OPEN and self._probing and
+                now - self._probe_at >= self._cfg.reset_timeout):
             self._probing = False
 
     def allow(self) -> bool:
         """May a request go to this peer right now?  In half-open state
         exactly one probe is admitted; the rest fail fast until the
-        probe reports back."""
+        probe reports back (or its lease expires)."""
         with self._lock:
             self._maybe_half_open()
             if self._state == CLOSED:
                 return True
             if self._state == HALF_OPEN and not self._probing:
                 self._probing = True
+                self._probe_at = time.time()
                 return True
             return False
 
@@ -320,6 +332,25 @@ class CircuitBreaker:
             self._failures = 0
             self._state = CLOSED
             self._probing = False
+
+    def probe_inconclusive(self) -> None:
+        """Settle an admitted half-open probe whose attempt ended
+        without proof either way (timed out, dropped mid-stream): the
+        peer is still suspect, so go back to OPEN with a fresh timer —
+        and the probe slot is released rather than leaked."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probing:
+                self._state = OPEN
+                self._opened_at = time.time()
+                self._probing = False
+
+    def release_probe(self) -> None:
+        """Release an admitted probe slot without judging the peer —
+        the attempt never reached it (e.g. an injected fault fired
+        before any bytes moved), so the next caller may probe at once."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probing:
+                self._probing = False
 
     def record_failure(self) -> None:
         """Record one connection-level failure."""

@@ -75,6 +75,56 @@ class TestStateMachine:
         assert br.state == retry.CLOSED
         assert br.allow()
 
+    def test_lost_probe_lease_expires(self):
+        """An admitted probe whose caller never records an outcome
+        (timeout path, crashed thread) must not wedge the breaker in
+        half-open/fail-fast forever: after reset_timeout the lease
+        expires and the next caller may probe."""
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()          # probe admitted... and then lost
+        assert not br.allow()
+        br._probe_at -= br._cfg.reset_timeout + 1.0
+        assert br.state == retry.HALF_OPEN
+        assert br.allow()          # lease expired: a new probe goes out
+        br.record_success()
+        assert br.state == retry.CLOSED
+
+    def test_probe_inconclusive_reopens_and_releases_slot(self):
+        """Timeout / mid-stream drop on the probe: peer still suspect —
+        back to OPEN with a fresh timer, slot released."""
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()
+        br.probe_inconclusive()
+        assert br.state == retry.OPEN
+        assert not br.allow()
+        _rewind(br)
+        assert br.allow()          # next probe window re-arms normally
+
+    def test_release_probe_keeps_half_open(self):
+        """An injected fault never reached the peer: the slot is handed
+        back without judging it, so the next caller probes at once."""
+        br = _breaker(threshold=1)
+        br.record_failure()
+        _rewind(br)
+        assert br.allow()
+        br.release_probe()
+        assert br.state == retry.HALF_OPEN
+        assert br.allow()
+        br.record_success()
+        assert br.state == retry.CLOSED
+
+    def test_settlement_noops_outside_half_open(self):
+        br = _breaker(threshold=3)
+        br.record_failure()
+        br.probe_inconclusive()
+        br.release_probe()
+        assert br.state == retry.CLOSED
+        assert br.snapshot()["consecutive_failures"] == 1
+
     def test_snapshot_shape(self):
         br = _breaker(threshold=1)
         br.record_failure()

@@ -157,3 +157,53 @@ def test_large_body_split_write_roundtrips(loop_run):
         srv.close()
         await srv.wait_closed()
     loop_run(go())
+
+
+def test_stale_drain_bounded_by_attempt_deadline(loop_run, monkeypatch):
+    """The stale-conn drain loop must not grant every iteration a fresh
+    full timeout: once the attempt's clipped budget is spent it stops
+    (one logical attempt stays bounded by the remaining deadline
+    instead of overrunning it per_host-fold)."""
+    from seaweedfs_tpu.rpc import fastclient
+    from seaweedfs_tpu.rpc.fastclient import RequestError
+
+    async def go():
+        accepted = []
+
+        async def handle(reader, writer):
+            accepted.append(1)
+            writer.close()
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        pool = HttpPool(timeout=100.0)
+        key = ("127.0.0.1", port)
+        # two pooled conns, both already closed server-side
+        for _ in range(2):
+            conn = await asyncio.open_connection("127.0.0.1", port)
+            pool._idle.setdefault(key, []).append(conn)
+        await asyncio.sleep(0.05)  # let the server close them
+        accepted.clear()
+
+        # fake clock (aliased module only — asyncio keeps real time):
+        # every monotonic() call burns 60 "seconds", so the 100s budget
+        # is spent after the first dead-conn iteration
+        class _FakeTime:
+            _t = 0.0
+
+            @classmethod
+            def monotonic(cls):
+                cls._t += 60.0
+                return cls._t
+
+        monkeypatch.setattr(fastclient, "_time", _FakeTime)
+        with pytest.raises(RequestError):
+            await pool._request("GET", f"http://127.0.0.1:{port}/x")
+        # budget exhausted after one iteration: the second pooled conn
+        # was never drained and no fresh dial went out
+        assert not accepted, "fresh dial must not outlive the budget"
+        assert len(pool._idle[key]) == 1
+        await pool.close()
+        srv.close()
+        await srv.wait_closed()
+    loop_run(go())

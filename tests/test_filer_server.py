@@ -363,3 +363,83 @@ class TestSaveInside:
             assert m.get("chunks") and not m.get("content")
         finally:
             cluster.filer.save_to_filer_limit = 0
+
+
+class TestAsyncHedgedReadFailover:
+    """Unit tests for FilerServer._read_chunk_async with a stubbed
+    fastclient pool (no cluster): the hedge must fire the alternate
+    replica when the primary FAILS FAST inside the hedge window, not
+    only when it is slow — mirroring filer/stream._hedged_fetch."""
+
+    def _server(self, pool, urls):
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        srv = object.__new__(FilerServer)
+        srv.masters = SimpleNamespace(
+            lookup_urls_cached=lambda fid: list(urls))
+        srv._fast_pool = pool
+        return srv
+
+    def test_primary_fast_failure_fails_over(self):
+        import asyncio
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        calls = []
+
+        class _Pool:
+            async def request(self, method, url, headers=None):
+                calls.append(url)
+                if "replica-a" in url:
+                    raise ConnectionRefusedError("replica a down")
+                return SimpleNamespace(status_code=200, content=b"DATA")
+
+        urls = ["http://replica-a/3,ab", "http://replica-b/3,ab"]
+        srv = self._server(_Pool(), urls)
+        chunk = SimpleNamespace(fid="3,ab", size=4)
+        out = asyncio.run(FilerServer._read_chunk_async(srv, chunk, 0, 4))
+        assert out == b"DATA"
+        assert calls == urls, "secondary must fire on primary failure"
+
+    def test_slow_primary_hedges_and_loser_is_cancelled(self, monkeypatch):
+        import asyncio
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.utils import retry
+
+        monkeypatch.setattr(retry, "HEDGE_DELAY", 0.01)
+
+        class _Pool:
+            async def request(self, method, url, headers=None):
+                if "replica-a" in url:
+                    await asyncio.sleep(5.0)  # sick primary
+                return SimpleNamespace(status_code=200, content=b"HEDGED")
+
+        urls = ["http://replica-a/3,ab", "http://replica-b/3,ab"]
+        srv = self._server(_Pool(), urls)
+        chunk = SimpleNamespace(fid="3,ab", size=6)
+
+        async def go():
+            return await asyncio.wait_for(
+                FilerServer._read_chunk_async(srv, chunk, 0, 6), 2.0)
+
+        assert asyncio.run(go()) == b"HEDGED"
+
+    def test_all_replicas_down_returns_none_for_fallback(self):
+        import asyncio
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        class _Pool:
+            async def request(self, method, url, headers=None):
+                raise ConnectionRefusedError("down")
+
+        srv = self._server(_Pool(), ["http://a/3,ab", "http://b/3,ab"])
+        chunk = SimpleNamespace(fid="3,ab", size=4)
+        out = asyncio.run(FilerServer._read_chunk_async(srv, chunk, 0, 4))
+        assert out is None  # caller falls back to the threaded reader

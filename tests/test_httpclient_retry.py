@@ -1,0 +1,66 @@
+"""TracingSession retry-loop hygiene: an abandoned 502/503/504
+response must be closed before the next attempt — stream=True call
+sites (ftpd, backup, s3 client) otherwise leak one pooled urllib3
+connection per retried attempt, exactly under the degraded conditions
+retries fire."""
+import io
+
+import requests
+
+from seaweedfs_tpu.rpc import httpclient
+from seaweedfs_tpu.utils import retry
+
+
+def _fake_response(status: int, headers: dict | None = None):
+    r = requests.Response()
+    r.status_code = status
+    r.raw = io.BytesIO(b"")
+    r.headers.update(headers or {})
+    return r
+
+
+def test_status_retry_closes_abandoned_response(monkeypatch):
+    retry.reset_breakers()
+    closed = []
+    served = []
+
+    def fake_request(self, method, url, **kw):
+        r = _fake_response(503 if not served else 200)
+        served.append(r)
+        orig_close = r.close
+        r.close = lambda: (closed.append(r), orig_close())[-1]
+        return r
+
+    monkeypatch.setattr(requests.Session, "request", fake_request)
+    try:
+        sess = httpclient.TracingSession()
+        resp = sess.request("GET", "http://peer-leak:1234/x")
+        assert resp.status_code == 200
+        assert len(served) == 2
+        assert closed == [served[0]], \
+            "the abandoned 503 must be drained back to the pool"
+        assert resp not in closed, "the returned response stays open"
+    finally:
+        retry.reset_breakers()
+
+
+def test_exhausted_status_retries_return_last_response_open(monkeypatch):
+    """When every attempt yields a retryable status, the final response
+    is returned (not closed) so the caller can read the error body."""
+    retry.reset_breakers()
+    served = []
+
+    def fake_request(self, method, url, **kw):
+        r = _fake_response(503)
+        served.append(r)
+        return r
+
+    monkeypatch.setattr(requests.Session, "request", fake_request)
+    try:
+        sess = httpclient.TracingSession()
+        resp = sess.request("GET", "http://peer-exhaust:1234/x")
+        assert resp.status_code == 503
+        assert resp is served[-1]
+        assert len(served) == retry.policy().max_attempts
+    finally:
+        retry.reset_breakers()
