@@ -155,127 +155,238 @@ def cpu_backend_name() -> str:
     return _probe_cpu_backend()
 
 
+# the request size the process-wide choice represents: bulk encodes
+# stream in multi-MB blocks, so "which backend for big work" is "which
+# backend at the top of the measured curve"
+_ROUTER_BULK_BYTES = 64 << 20
+
+
+def _env_override() -> str | None:
+    """SEAWEEDFS_TPU_EC_BACKEND, validated; None when unset/auto."""
+    env = os.environ.get(_AUTO_ENV, "").strip()
+    if not env or env == "auto":
+        return None
+    # validate at selection time, not deep inside the first EC op
+    try:
+        get_backend(env)
+        return env
+    except KeyError as e:
+        try:
+            from ..utils import glog
+
+            glog.warning("ignoring %s=%r: %s", _AUTO_ENV, env, e)
+        except Exception:  # pragma: no cover
+            pass
+        return None
+
+
+def _decide(curve: dict, nbytes: int) -> str:
+    """Router core: the measured device e2e rate interpolated at this
+    request size versus the measured CPU-codec rate — the device
+    backend is only ever chosen when the *measured end-to-end* feed
+    beats the CPU, never from a derived estimate."""
+    from . import probe
+
+    cpu_name = curve.get("cpu_backend") or _probe_cpu_backend()
+    dev_rate = probe.e2e_mbps_at(curve, nbytes)
+    if dev_rate is None:
+        return cpu_name
+    cpu_rate = curve.get("cpu_mbps")
+    if cpu_rate is not None and dev_rate <= cpu_rate:
+        return cpu_name
+    name = curve.get("device_backend")
+    if not name:
+        return cpu_name
+    try:
+        get_backend(name)
+        return name
+    except KeyError:
+        return cpu_name
+
+
+def choose_backend_for_size(nbytes: int) -> str:
+    """Backend for a request of `nbytes`, from the measured size x
+    depth curve (ec/probe.py): interpolate the device e2e rate at this
+    size, compare to the measured CPU rate, pick the winner. Override
+    with env SEAWEEDFS_TPU_EC_BACKEND. First use pays the sweep (or
+    reads the disk cache); after that it is a dict lookup."""
+    env = _env_override()
+    if env is not None:
+        return env
+    from . import probe
+
+    return _decide(probe.get_curve(), nbytes)
+
+
+def pipeline_depth_for(nbytes: int) -> int:
+    """Streaming-pipeline depth the measured curve recommends for
+    blocks of `nbytes` (2 when nothing is measured — the classic
+    double buffer)."""
+    from . import probe
+
+    curve = probe.peek()
+    if curve is None:
+        return 2
+    return probe.depth_at(curve, nbytes)
+
+
 def choose_auto_backend() -> str:
-    """Pick the production codec backend from measurement, not faith.
+    """Process-wide codec choice for bulk work, from measurement, not
+    faith: the size x depth sweep of the real pipelined feed
+    (ec/probe.py) interpolated at the bulk request size. A TPU behind
+    fast DMA beats the CPU codec by orders of magnitude; the same TPU
+    behind a slow tunnel LOSES to the AVX2 library no matter how fast
+    its MXU is — and only the measured e2e curve can tell the cases
+    apart. Override with env SEAWEEDFS_TPU_EC_BACKEND.
 
-    The e2e file encode path (write_ec_files) is transfer-bound on the
-    device side: every data byte crosses host->device and every parity
-    byte device->host. A TPU behind fast DMA (PCIe/on-host) beats the
-    CPU codec by orders of magnitude; the same TPU behind a slow
-    tunnel LOSES to the AVX2 library no matter how fast its MXU is.
-    So: probe the actual round-trip bandwidth of the default jax
-    device, derate by the encode transfer ratio (1 + m/k per data
-    byte), compare against a measured CPU-codec rate, and pick the
-    winner. Override with env SEAWEEDFS_TPU_EC_BACKEND.
-
-    The decision is cached per process; probing costs one ~4MB
-    round-trip on the device plus ~1MB through the CPU codec.
+    The decision is cached per process; the sweep result is cached on
+    disk (TTL + host fingerprint), so across serving processes the
+    probe is paid once per host per TTL window.
     """
     global _auto_choice, _auto_probe
-    env = os.environ.get(_AUTO_ENV, "").strip()
-    if env and env != "auto":
-        # validate at selection time, not deep inside the first EC op
-        try:
-            get_backend(env)
-            metrics.gauge_set("ec_codec_chosen_backend", 1,
-                              {"backend": env})
-            return env
-        except KeyError as e:
-            try:
-                from ..utils import glog
-
-                glog.warning("ignoring %s=%r: %s", _AUTO_ENV, env, e)
-            except Exception:  # pragma: no cover
-                pass
+    env = _env_override()
+    if env is not None:
+        metrics.gauge_set("ec_codec_chosen_backend", 1,
+                          {"backend": env})
+        return env
     if _auto_choice is not None:
         return _auto_choice
-    import time
+    from . import probe
 
-    cpu_name = _probe_cpu_backend()
-    choice = cpu_name
-    probe: dict = {"cpu_backend": cpu_name}
     try:
-        coef = rs_matrix.parity_rows(10, 4)
-        blk = np.random.default_rng(0).integers(
-            0, 256, (10, 1 << 20), dtype=np.uint8)
-        cpu = get_backend(cpu_name)
-        cpu.coded_matmul(coef, blk)  # warm (native lib load, caches)
-        t0 = time.perf_counter()
-        cpu.coded_matmul(coef, blk)
-        cpu_rate = blk.nbytes / (time.perf_counter() - t0)
-        probe["cpu_mbps"] = round(cpu_rate / 1e6, 1)
-
-        import importlib.util
-
-        if importlib.util.find_spec("jax") is not None:
-            import jax
-
-            dev = jax.devices()[0]
-            probe["device"] = dev.platform
-            if dev.platform != "cpu":
-                x = np.random.default_rng(1).integers(
-                    0, 256, 4 << 20, dtype=np.uint8)
-                np.asarray(jax.device_put(x[:4096]))  # warm the path
-                t0 = time.perf_counter()
-                back = np.asarray(jax.device_put(x))
-                dt = time.perf_counter() - t0
-                assert back.shape == x.shape
-                bw = 2 * x.nbytes / dt  # per-direction, symmetric est.
-                probe["dma_mbps"] = round(bw / 1e6, 1)
-                # encode streams (1 + m/k) bytes over the link per data
-                # byte; even with perfect stage overlap a shared link
-                # bounds e2e at bw / 1.4 for RS(10,4)
-                est = bw / 1.4
-                probe["device_e2e_est_mbps"] = round(est / 1e6, 1)
-                if est > cpu_rate:
-                    for dev_name in ("pallas", "jax"):
-                        try:
-                            get_backend(dev_name)
-                            choice = dev_name
-                            break
-                        except KeyError:
-                            continue
+        curve = probe.get_curve()
+        choice = _decide(curve, _ROUTER_BULK_BYTES)
+        summary = probe.summary(curve)
     except Exception as e:  # pragma: no cover - probe must never fatal
-        probe["error"] = repr(e)
+        choice = _probe_cpu_backend()
+        summary = {"error": repr(e)}
     _auto_choice = choice
-    probe["chosen"] = choice
-    _auto_probe = probe
+    summary["chosen"] = choice
+    _auto_probe = summary
     metrics.gauge_set("ec_codec_chosen_backend", 1, {"backend": choice})
     try:
         from ..utils import glog
 
-        glog.info("ec auto backend: %s", probe)
+        glog.info("ec auto backend: %s", summary)
     except Exception:  # pragma: no cover
         pass
     return choice
 
 
+def router_buckets(curve: dict) -> list[dict]:
+    """Per-size-bucket routing table (one row per swept size): what
+    the router would pick for a request of that size and the measured
+    rates behind the decision — the operator-facing 'why native (or
+    device)' answer."""
+    from . import probe
+
+    env = _env_override()
+    out = []
+    for size in probe.SWEEP_SIZES:
+        dev_rate = probe.e2e_mbps_at(curve, size)
+        out.append({
+            "size_mb": size >> 20,
+            "backend": env if env is not None else _decide(curve, size),
+            "pinned_by_env": env is not None,
+            "device_e2e_mbps": (round(dev_rate, 2)
+                                if dev_rate is not None else None),
+            "cpu_mbps": curve.get("cpu_mbps"),
+            "depth": probe.depth_at(curve, size),
+        })
+    return out
+
+
+def probe_snapshot() -> dict:
+    """Router state for /debug/ec and /cluster/status: the measured
+    curve, where it came from (process sweep vs disk cache), how stale
+    it is, and the per-size-bucket decision. Never triggers a sweep —
+    an unprobed process says so instead of stalling the debug handler
+    for the probe's budget."""
+    import time as _t
+
+    from . import probe
+
+    snap: dict = {
+        "env_override": os.environ.get(_AUTO_ENV, "").strip() or None,
+        "process_choice": _auto_choice,
+        "cpu_backend": _probe_cpu_backend(),
+        "cache_path": probe.cache_path(),
+        "cache_ttl_s": probe.cache_ttl_s(),
+    }
+    curve = probe.peek()
+    if curve is None:
+        snap["probe"] = {"state": "unprobed"}
+        return snap
+    measured_at = float(curve.get("measured_at") or 0)
+    snap["probe"] = {
+        "state": "measured",
+        "source": curve.get("source"),
+        "age_s": round(max(0.0, _t.time() - measured_at), 1),
+        "summary": probe.summary(curve),
+        "rows": curve.get("rows", []),
+    }
+    snap["buckets"] = router_buckets(curve)
+    return snap
+
+
+async def handle_debug_ec(request):
+    """GET /debug/ec — shared route handler for all servers: the
+    router's measured curve, cache age and per-bucket decision."""
+    from aiohttp import web
+
+    return web.json_response(probe_snapshot())
+
+
 class AutoCodec:
-    """`-ec.backend=auto`: lazily resolves to the measured-fastest
-    backend for the e2e file path at first use (see
-    choose_auto_backend). Lazy so that constructing a Store never pays
-    the probe unless an EC op actually runs."""
+    """`-ec.backend=auto`: routes each op to the measured-fastest
+    backend for its size — the per-request interpolation of the probe
+    curve (choose_backend_for_size). Lazy so that constructing a Store
+    never pays the probe unless an EC op actually runs. Callers that
+    must keep a whole multi-dispatch operation on ONE backend (the
+    file encode/rebuild paths) pin it first via resolve_for(total
+    request bytes)."""
 
     name = "auto"
 
     def __init__(self):
         self._impl: CodecBackend | None = None
+        self._pinned = False
 
     @property
     def chosen(self) -> str | None:
         return getattr(self._impl, "name", None)
 
     def _resolve(self) -> CodecBackend:
-        if self._impl is None:
+        """Process-wide (bulk-size) choice, pinned."""
+        if not self._pinned:
             self._impl = get_backend(choose_auto_backend())
+            self._pinned = True
+        return self._impl
+
+    def resolve_for(self, nbytes: int) -> CodecBackend:
+        """Pin the backend the measured curve picks for a request of
+        `nbytes` — the whole operation then rides one backend even as
+        it streams through many dispatches."""
+        self._impl = get_backend(choose_backend_for_size(nbytes))
+        self._pinned = True
+        return self._impl
+
+    def _backend_for(self, nbytes: int) -> CodecBackend:
+        if self._pinned:
+            return self._impl
+        self._impl = get_backend(choose_backend_for_size(nbytes))
         return self._impl
 
     def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
-        return self._resolve().coded_matmul(coef, shards)
+        shards = np.asarray(shards, dtype=np.uint8)
+        return self._backend_for(shards.nbytes).coded_matmul(coef,
+                                                             shards)
 
     def coded_matmul_stream(self, coef: np.ndarray, blocks,
                             depth: int = 2):
-        impl = self._resolve()
+        # streams are bulk by construction: route like a large request
+        impl = (self._impl if self._pinned
+                else self._backend_for(_ROUTER_BULK_BYTES))
         stream = getattr(impl, "coded_matmul_stream", None)
         if stream is not None:
             yield from stream(coef, blocks, depth=depth)

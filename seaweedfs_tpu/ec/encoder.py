@@ -168,10 +168,17 @@ def write_ec_files(base: str, backend: str = "auto",
     n_large, n_small = geo.row_layout(dat_size, large_block, small_block,
                                       data_shards=k)
 
-    # resolve `auto` so the dispatch below sees the real backend
+    # resolve `auto` so the dispatch below sees the real backend; the
+    # router interpolates the measured bandwidth curve at THIS
+    # volume's size, so a small volume can route to the CPU codec
+    # while a bulk encode on the same host rides the device
     backend_name = getattr(rs.backend, "name", "")
     if backend_name == "auto":
-        rs.backend._resolve()
+        resolve_for = getattr(rs.backend, "resolve_for", None)
+        if resolve_for is not None:
+            resolve_for(dat_size)
+        else:
+            rs.backend._resolve()
         backend_name = getattr(rs.backend, "chosen", "") or ""
     if backend_name == "native" and dat_size:
         # the whole read -> parity -> write loop in one native call:
@@ -289,6 +296,11 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
         rs.backend._resolve()
         backend_name = getattr(rs.backend, "chosen", "") or ""
     wide = backend_name not in ("numpy", "native")
+    # pipeline depth from the measured curve at this dispatch size
+    # (double-buffer default when nothing is measured)
+    from .backend import pipeline_depth_for
+
+    depth = pipeline_depth_for(k * chunk)
     w = _AsyncWriter()
     try:
         def gen():
@@ -298,7 +310,7 @@ def _encode_region(rs: ReedSolomon, dat: np.ndarray, start: int, n_rows: int,
                     w.put(outs[i], data[i])
                 yield data
 
-        for parity in rs.encode_stream(gen()):
+        for parity in rs.encode_stream(gen(), depth=depth):
             for j in range(rs.m):
                 w.put(outs[k + j], parity[j])
     finally:
@@ -356,6 +368,9 @@ def rebuild_ec_files(base: str, backend: str = "auto",
     from ..ops import rs_matrix
 
     rows, inputs = rs_matrix.recovery_rows(rs.k, rs.m, present, missing)
+    from .backend import pipeline_depth_for
+
+    depth = pipeline_depth_for(len(inputs) * chunk)
     try:
         def gen():
             for c0 in range(0, shard_size, chunk):
@@ -364,7 +379,8 @@ def rebuild_ec_files(base: str, backend: str = "auto",
 
         w = _AsyncWriter()
         try:
-            for rec in rs.matmul_stream(rows, gen(), op="reconstruct"):
+            for rec in rs.matmul_stream(rows, gen(), depth=depth,
+                                        op="reconstruct"):
                 for j, i in enumerate(missing):
                     w.put(outs[i], rec[j])
         finally:

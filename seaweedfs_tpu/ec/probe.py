@@ -1,0 +1,469 @@
+"""Measured host<->device bandwidth curve for the EC feed router.
+
+Round 5's auto-router decided from ONE synchronous 4MB device_put and
+a derived guess (`bw / 1.4`). Both papers the roadmap cites
+(arXiv:2108.02692, arXiv:1709.05365) say the same thing about erasure
+coding: throughput is decided by data-movement scheduling, so the only
+honest router input is the *measured end-to-end rate of the actual
+pipelined feed* at the sizes production requests come in. This module
+produces that: a size x depth sweep of the real streaming codec
+(ops/codec_jax pipeline — committed device_put upload thread, kernel,
+drain thread), each row paired with a shaped transfer-only ceiling
+twin (same bytes over the link, codec replaced by a trivial slice), so
+a published device number always carries the link bound it ran under.
+
+The sweep result is cached on disk (JSON) with a TTL and a host
+fingerprint — serving processes on the same machine read the curve
+instead of re-paying the probe; a different host, device, jax version
+or probe schema invalidates it, as does corruption (any parse/shape
+error -> fresh sweep, never a crash).
+
+Interpolation: `e2e_mbps_at(curve, nbytes)` is piecewise-linear in
+log2(size) over the best depth per measured size, clamped at both
+ends — monotone between measured points by construction, so the
+router can never invent a hump the sweep didn't see.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time as _time
+
+import numpy as np
+
+# probe schema version: bump when the sweep method or JSON layout
+# changes so stale caches self-invalidate
+PROBE_VERSION = 1
+
+SWEEP_SIZES = (1 << 20, 4 << 20, 16 << 20, 64 << 20)
+SWEEP_DEPTHS = (1, 2, 4)
+# RS(10,4): the codec the production feed runs
+_K, _M = 10, 4
+
+_CACHE_ENV = "SEAWEEDFS_TPU_EC_PROBE_CACHE"
+_TTL_ENV = "SEAWEEDFS_TPU_EC_PROBE_TTL"
+_BUDGET_ENV = "SEAWEEDFS_TPU_EC_PROBE_BUDGET"
+DEFAULT_TTL_S = 24 * 3600.0
+# wall budget for one full sweep: on a fast link the whole table costs
+# well under this; on a slow link the budget is what keeps a serving
+# process's first EC op from stalling for minutes — unaffordable rows
+# are skipped and marked, and the curve clamps to the largest measured
+DEFAULT_BUDGET_S = 45.0
+
+_curve: dict | None = None  # process cache of the active curve
+
+
+def cache_path() -> str:
+    p = os.environ.get(_CACHE_ENV, "").strip()
+    if p:
+        return p
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "seaweedfs_tpu", "ec_probe.json")
+
+
+def cache_ttl_s() -> float:
+    try:
+        return float(os.environ.get(_TTL_ENV, DEFAULT_TTL_S))
+    except ValueError:
+        return DEFAULT_TTL_S
+
+
+def _device() -> tuple[str, str, int] | None:
+    """(platform, kind, count) of the default jax device, or None when
+    jax is absent or only CPU devices exist (no feed to probe)."""
+    import importlib.util
+
+    if importlib.util.find_spec("jax") is None:
+        return None
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return None
+    if dev.platform == "cpu":
+        return None
+    return (dev.platform, getattr(dev, "device_kind", "") or "",
+            len(jax.devices()))
+
+
+def host_fingerprint() -> dict:
+    """What must match for a cached curve to be trusted: same machine,
+    same device behind the same jax, same probe schema."""
+    import platform as _plat
+
+    fp = {"probe_version": PROBE_VERSION,
+          "host": _plat.node(),
+          "machine": _plat.machine()}
+    dev = _device()
+    fp["device"] = ({"platform": dev[0], "kind": dev[1], "count": dev[2]}
+                    if dev else None)
+    try:
+        import jax
+
+        fp["jax"] = jax.__version__
+    except Exception:
+        fp["jax"] = None
+    return fp
+
+
+# ----------------------------------------------------------------------
+# measurement
+# ----------------------------------------------------------------------
+
+def measure_cpu_mbps(backend) -> float:
+    """Steady rate of the CPU-side codec on the encode shape (10x1MB
+    RS(10,4) parity), input bytes per second."""
+    from ..ops import rs_matrix
+
+    coef = rs_matrix.parity_rows(_K, _M)
+    blk = np.random.default_rng(0).integers(
+        0, 256, (_K, 1 << 20), dtype=np.uint8)
+    backend.coded_matmul(coef, blk)  # warm (native lib load, caches)
+    t0 = _time.perf_counter()
+    backend.coded_matmul(coef, blk)
+    return blk.nbytes / (_time.perf_counter() - t0) / 1e6
+
+
+def _measure_e2e_row(codec, coef, size: int, depth: int,
+                     n_blocks: int) -> float:
+    """Pipelined e2e MB/s at one (size, depth): n_blocks distinct
+    (k, size/k) blocks through the staged streaming pipeline; rate is
+    input bytes / wall from first pread to last yield."""
+    w = max(1, size // _K)
+    rng = np.random.default_rng(size ^ depth)
+    blocks = [rng.integers(0, 256, (_K, w), dtype=np.uint8)
+              for _ in range(n_blocks)]
+    t0 = _time.perf_counter()
+    got = 0
+    for out in codec.coded_matmul_stream(coef, iter(blocks), depth=depth):
+        got += 1
+        assert out.shape == (_M, w)
+    assert got == n_blocks
+    return n_blocks * _K * w / (_time.perf_counter() - t0) / 1e6
+
+
+_slice_rows = None
+
+
+def _get_slice_rows():
+    """Module-level jitted (k, w) -> (m, w) row slice: one jit cache
+    shared by every ceiling row, so shapes compiled during the
+    per-size warm pass stay compiled for the timed rows."""
+    global _slice_rows
+    if _slice_rows is None:
+        import jax
+
+        _slice_rows = jax.jit(lambda x: x[:_M])
+    return _slice_rows
+
+
+def _measure_xfer_ceiling(codec, size: int, depth: int,
+                          n_blocks: int) -> float:
+    """Shaped transfer-only twin of the row above: the same (k, w)
+    uint8 blocks cross H2D and an (m, w) slice crosses D2H through the
+    same committed placement and the same depth-bounded overlap, but
+    the kernel is a free row slice — what the link alone supports for
+    this traffic shape. The paired-ceiling protocol bench.py already
+    applies to file encode, extended to device rows."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    slice_rows = _get_slice_rows()
+    w = max(1, size // _K)
+    rng = np.random.default_rng(size * 31 + depth)
+    blocks = [rng.integers(0, 256, (_K, w), dtype=np.uint8)
+              for _ in range(n_blocks)]
+    depth = max(1, depth)
+    t0 = _time.perf_counter()
+    with ThreadPoolExecutor(1) as up_ex, ThreadPoolExecutor(1) as down_ex:
+        pending: deque = deque()
+
+        def up(b):
+            dev = codec._h2d(b)
+            dev.block_until_ready()
+            return slice_rows(dev)
+
+        def down(fut):
+            return np.asarray(fut.result())
+
+        for b in blocks:
+            pending.append(down_ex.submit(down, up_ex.submit(up, b)))
+            while len(pending) >= depth:
+                pending.popleft().result()
+        while pending:
+            pending.popleft().result()
+    return n_blocks * _K * w / (_time.perf_counter() - t0) / 1e6
+
+
+def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
+              budget_s: float | None = None,
+              with_ceilings: bool = True) -> dict:
+    """Measure the curve. Always includes the CPU codec rate; device
+    rows only when a non-CPU device exists. Never raises: a failed row
+    is recorded with its error and the sweep moves on."""
+    from . import backend as ecb
+
+    if budget_s is None:
+        try:
+            budget_s = float(os.environ.get(_BUDGET_ENV,
+                                            DEFAULT_BUDGET_S))
+        except ValueError:
+            budget_s = DEFAULT_BUDGET_S
+    t_start = _time.perf_counter()
+    curve: dict = {"fingerprint": host_fingerprint(),
+                   "measured_at": _time.time(),
+                   "budget_s": budget_s,
+                   "rows": []}
+    cpu_name = ecb.cpu_backend_name()
+    curve["cpu_backend"] = cpu_name
+    try:
+        curve["cpu_mbps"] = round(
+            measure_cpu_mbps(ecb.get_backend(cpu_name)), 1)
+    except Exception as e:  # pragma: no cover - probe must never fatal
+        curve["cpu_mbps"] = None
+        curve["cpu_error"] = repr(e)
+
+    dev = _device()
+    curve["device"] = ({"platform": dev[0], "kind": dev[1],
+                        "count": dev[2]} if dev else None)
+    if dev is None:
+        return curve
+
+    # device backend preference mirrors the router: fused kernel first
+    codec = None
+    for name in ("pallas", "jax"):
+        try:
+            codec = ecb.get_backend(name)
+            curve["device_backend"] = name
+            break
+        except KeyError:
+            continue
+    if codec is None:
+        curve["device_error"] = "no device codec backend importable"
+        return curve
+
+    from ..ops import rs_matrix
+
+    coef = rs_matrix.parity_rows(_K, _M)
+    try:
+        # spin up the path (first device_put, executor machinery)
+        # outside every timed row; per-size XLA compiles get their own
+        # warm pass below so no (size, depth) row is billed a compile
+        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2)
+    except Exception as e:
+        curve["device_error"] = repr(e)
+        return curve
+
+    last_rate: float | None = None
+
+    def remaining() -> float:
+        return budget_s - (_time.perf_counter() - t_start)
+
+    def affordable(nbytes: int) -> bool:
+        # projection from the last measured rate; before any rate is
+        # known, only a positive budget is required (the smallest size
+        # is the probe's own floor)
+        if last_rate:
+            return nbytes / 1e6 / last_rate <= remaining()
+        return remaining() > 0
+
+    for size in sorted(sizes):
+        # one warm block at this exact width compiles the padded-shape
+        # kernels (codec + ceiling slice) so depth=1 isn't billed for
+        # XLA compile while depth=4 rides its cache
+        if not affordable(2 * size):
+            for depth in depths:
+                curve["rows"].append({"size": int(size),
+                                      "depth": int(depth),
+                                      "skipped": "budget"})
+            continue
+        try:
+            _measure_e2e_row(codec, coef, size, 1, n_blocks=1)
+            if with_ceilings:
+                _measure_xfer_ceiling(codec, size, 1, n_blocks=1)
+        except Exception as e:  # pragma: no cover - keep sweeping
+            for depth in depths:
+                curve["rows"].append({"size": int(size),
+                                      "depth": int(depth),
+                                      "error": repr(e)})
+            continue
+        for depth in depths:
+            n_blocks = depth + 2
+            row = {"size": int(size), "depth": int(depth),
+                   "blocks": n_blocks}
+            cost = n_blocks * size * (2 if with_ceilings else 1)
+            if not affordable(cost):
+                # a row that would blow the remaining budget is skipped
+                # and marked — the table says so instead of silently
+                # truncating
+                row["skipped"] = "budget"
+                curve["rows"].append(row)
+                continue
+            try:
+                rate = _measure_e2e_row(codec, coef, size, depth,
+                                        n_blocks)
+                row["e2e_mbps"] = round(rate, 2)
+                last_rate = rate
+                if with_ceilings:
+                    ceil = _measure_xfer_ceiling(codec, size, depth,
+                                                 n_blocks)
+                    row["xfer_ceiling_mbps"] = round(ceil, 2)
+                    if ceil > 0:
+                        row["vs_ceiling"] = round(rate / ceil, 2)
+            except Exception as e:  # pragma: no cover - keep sweeping
+                row["error"] = repr(e)
+            curve["rows"].append(row)
+    curve["sweep_seconds"] = round(_time.perf_counter() - t_start, 2)
+    return curve
+
+
+# ----------------------------------------------------------------------
+# disk cache
+# ----------------------------------------------------------------------
+
+def load_cached(path: str | None = None,
+                ttl_s: float | None = None) -> dict | None:
+    """The cached curve if present, parseable, same-host and fresh —
+    else None. Corruption and expiry both land here as None: the
+    caller re-sweeps, it never crashes."""
+    path = path or cache_path()
+    ttl_s = cache_ttl_s() if ttl_s is None else ttl_s
+    try:
+        with open(path, encoding="utf-8") as f:
+            curve = json.load(f)
+        if not isinstance(curve, dict):
+            return None
+        if not isinstance(curve.get("rows"), list):
+            return None
+        if curve.get("fingerprint") != host_fingerprint():
+            return None
+        age = _time.time() - float(curve.get("measured_at", 0))
+        if age < 0 or age > ttl_s:
+            return None
+        return curve
+    except Exception:
+        return None
+
+
+def save_cache(curve: dict, path: str | None = None) -> None:
+    """Best-effort atomic write (rename) so a crashed writer leaves
+    the old cache intact, not a half-written JSON."""
+    path = path or cache_path()
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(curve, f, indent=1)
+        os.replace(tmp, path)
+    except Exception:  # pragma: no cover - cache is an optimization
+        pass
+
+
+def get_curve(refresh: bool = False) -> dict:
+    """The active curve: process memo -> disk cache -> fresh sweep
+    (persisted only when a device was actually measured — a CPU-only
+    probe is cheap enough to redo and says nothing about the link)."""
+    global _curve
+    if _curve is not None and not refresh:
+        return _curve
+    curve = None if refresh else load_cached()
+    if curve is None:
+        curve = run_sweep()
+        if curve.get("device") is not None:
+            save_cache(curve)
+        curve["source"] = "fresh"
+    else:
+        curve["source"] = "cache"
+    _curve = curve
+    return curve
+
+
+def peek() -> dict | None:
+    """The curve if this process already has one (memo or a valid disk
+    cache) — never sweeps. Debug surfaces use this so a GET can't
+    stall behind the probe budget."""
+    global _curve
+    if _curve is not None:
+        return _curve
+    curve = load_cached()
+    if curve is not None:
+        curve["source"] = "cache"
+        _curve = curve
+    return curve
+
+
+def invalidate() -> None:
+    """Drop the process memo (tests; ops can also just delete the
+    cache file and restart)."""
+    global _curve
+    _curve = None
+
+
+# ----------------------------------------------------------------------
+# curve reading
+# ----------------------------------------------------------------------
+
+def measured_rows(curve: dict) -> list[dict]:
+    return [r for r in curve.get("rows", [])
+            if isinstance(r.get("e2e_mbps"), (int, float))]
+
+
+def best_by_size(curve: dict) -> list[tuple[int, float, int]]:
+    """[(size, best_e2e_mbps, best_depth)] ascending by size."""
+    best: dict[int, tuple[float, int]] = {}
+    for r in measured_rows(curve):
+        size, rate, depth = int(r["size"]), float(r["e2e_mbps"]), \
+            int(r["depth"])
+        if size not in best or rate > best[size][0]:
+            best[size] = (rate, depth)
+    return [(s, best[s][0], best[s][1]) for s in sorted(best)]
+
+
+def e2e_mbps_at(curve: dict, nbytes: int) -> float | None:
+    """Device e2e MB/s the measured curve predicts for a request of
+    `nbytes`: piecewise-linear in log2(size) over the best depth per
+    measured size, clamped to the measured range (no extrapolated
+    optimism past the largest row that actually ran)."""
+    pts = best_by_size(curve)
+    if not pts:
+        return None
+    nbytes = max(1, int(nbytes))
+    if len(pts) == 1 or nbytes <= pts[0][0]:
+        return pts[0][1]
+    if nbytes >= pts[-1][0]:
+        return pts[-1][1]
+    xs = np.log2([p[0] for p in pts])
+    ys = [p[1] for p in pts]
+    return float(np.interp(np.log2(nbytes), xs, ys))
+
+
+def depth_at(curve: dict, nbytes: int) -> int:
+    """Pipeline depth of the nearest measured size (default 2 when the
+    curve is empty): what the feed should run for this request size."""
+    pts = best_by_size(curve)
+    if not pts:
+        return 2
+    nbytes = max(1, int(nbytes))
+    target = np.log2(nbytes)
+    best = min(pts, key=lambda p: abs(np.log2(p[0]) - target))
+    return best[2]
+
+
+def summary(curve: dict) -> dict:
+    """Compact view for logs and /debug/ec: per-size best rates plus
+    the CPU rate the router compares against."""
+    return {
+        "cpu_backend": curve.get("cpu_backend"),
+        "cpu_mbps": curve.get("cpu_mbps"),
+        "device": curve.get("device"),
+        "device_backend": curve.get("device_backend"),
+        "best_by_size_mb": {
+            str(s >> 20): {"e2e_mbps": round(r, 2), "depth": d}
+            for s, r, d in best_by_size(curve)},
+        "skipped_rows": sum(1 for r in curve.get("rows", [])
+                            if r.get("skipped")),
+        "measured_at": curve.get("measured_at"),
+        "source": curve.get("source"),
+    }

@@ -16,9 +16,24 @@ Equivalent of the reference's hot loops enc.Encode / enc.Reconstruct
 (/root/reference/weed/storage/erasure_coding/ec_encoder.go:190,274), but
 batched: callers collapse (batch, k, stripe) into (k, batch*stripe) columns
 so thousands of stripes ride one dispatch.
+
+The streaming entry point (coded_matmul_stream) is a depth-N staged
+pipeline: a dedicated upload thread commits block k+1 to the device
+(jax.device_put with an explicit SingleDeviceSharding, so placement is
+decided once, not re-negotiated per call) while the device runs block
+k's kernel and a dedicated drain thread reads block k-1 back. Input
+device buffers are donated to the kernel on real accelerators so XLA
+can reuse them for the bit-plane intermediate, and readback goes
+through dlpack when the consumer and producer share an address space
+(CPU devices: zero-copy). Every stage is timed into
+ec_codec_stage_seconds{stage,backend} — pread (waiting on the block
+source), h2d, kernel, d2h, and relay (finished results waiting for the
+consumer) — which is what lets bench/VERDICT attribute the
+encode-vs-ceiling gap instead of guessing.
 """
 from __future__ import annotations
 
+import time as _time
 from collections import OrderedDict
 from functools import partial
 
@@ -27,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import gf256
+from ..utils import metrics
 
 # Column slab each jitted call processes; callers pad up to a multiple.
 # 2 MiB columns x 8k bit-rows in bf16 keeps the working set well inside HBM
@@ -34,12 +50,27 @@ from . import gf256
 DEFAULT_SLAB = 1 << 21
 
 
-@partial(jax.jit, donate_argnums=())
-def _bit_matmul(a_bits: jax.Array, shards: jax.Array) -> jax.Array:
-    """a_bits: (8m, 8k) bf16 0/1; shards: (k, n) uint8 -> (m, n) uint8."""
+def _bit_matmul_body(a_bits: jax.Array, shards: jax.Array) -> jax.Array:
     from .bits import coded_matmul_bits
 
     return coded_matmul_bits(a_bits, shards)
+
+
+# a_bits: (8m, 8k) bf16 0/1; shards: (k, n) uint8 -> (m, n) uint8.
+_bit_matmul = jax.jit(_bit_matmul_body)
+# pipeline variant: the device input block is dead after the kernel, so
+# donating it lets XLA reuse the buffer for the (8k, n) bit-plane
+# intermediate instead of allocating fresh HBM per in-flight block
+_bit_matmul_donated = jax.jit(_bit_matmul_body, donate_argnums=(1,))
+
+
+def observe_stage(backend: str, stage: str, seconds: float) -> None:
+    """Per-stage feed timing (pread/h2d/kernel/d2h/relay) — the
+    attribution VERDICT round 5 asked for. Lives next to
+    ec_codec_seconds; one extra label dimension, one histogram per
+    (stage, backend)."""
+    metrics.histogram_observe("ec_codec_stage_seconds", seconds,
+                              {"stage": stage, "backend": backend})
 
 
 def bit_matrix(coef: np.ndarray) -> jax.Array:
@@ -64,6 +95,8 @@ class JaxCodec:
     def __init__(self, slab: int = DEFAULT_SLAB):
         self.slab = slab
         self._bitmats: "OrderedDict[bytes, jax.Array]" = OrderedDict()
+        self._sharding = None
+        self._donate: bool | None = None
 
     def _coef_bits(self, coef: np.ndarray) -> jax.Array:
         key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
@@ -77,6 +110,62 @@ class JaxCodec:
             self._bitmats.move_to_end(key)
         return bm
 
+    # ------------------------------------------------------------------
+    # placement / transfer / dispatch primitives (shared with PallasCodec)
+    # ------------------------------------------------------------------
+    def _placement(self):
+        """Committed single-device placement: device_put against an
+        explicit sharding starts the copy immediately and pins the
+        array, so back-to-back uploads from the feed thread queue on
+        the DMA engine instead of waiting for lazy placement."""
+        if self._sharding is None:
+            from jax.sharding import SingleDeviceSharding
+
+            self._sharding = SingleDeviceSharding(jax.devices()[0])
+        return self._sharding
+
+    def _h2d(self, chunk: np.ndarray) -> jax.Array:
+        return jax.device_put(chunk, self._placement())
+
+    def _pad_width(self, n: int) -> int:
+        """Pad sub-slab column counts to power-of-two buckets (>=256) so
+        XLA compiles at most log2(slab/256) shapes for sub-slab calls."""
+        padded = 256
+        while padded < n:
+            padded <<= 1
+        return min(padded, max(self.slab, n))
+
+    def _split(self, shards: np.ndarray) -> list[tuple[np.ndarray, int]]:
+        """Host-side slab split + padding: [(padded_chunk, true_width)].
+        Padding happens before H2D so the device never sees a shape it
+        has to relayout."""
+        n = shards.shape[1]
+        slab = self.slab
+        if n <= slab:
+            return [(_pad_cols(shards, self._pad_width(n)), n)]
+        out = []
+        for off in range(0, n, slab):
+            chunk = shards[:, off:off + slab]
+            w = chunk.shape[1]
+            out.append((_pad_cols(chunk, self._pad_width(w)), w))
+        return out
+
+    def _run(self, mats, dev: jax.Array) -> jax.Array:
+        """Dispatch the kernel on an already-on-device padded block."""
+        if self._donate is None:
+            # donation on the CPU backend logs an unusable-buffer
+            # warning per call; only enable where it buys HBM reuse
+            self._donate = jax.devices()[0].platform != "cpu"
+        fn = _bit_matmul_donated if self._donate else _bit_matmul
+        return fn(mats, dev)
+
+    def _dispatch(self, mats, shards: np.ndarray) -> list:
+        """Issue the async device calls for one (k, n) column block,
+        slab-split and bucket-padded; returns [(device_array, width)]
+        without forcing any transfer back."""
+        return [(self._run(mats, self._h2d(chunk)), w)
+                for chunk, w in self._split(shards)]
+
     def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
         coef = np.asarray(coef, dtype=np.uint8)
         m, k = coef.shape
@@ -85,81 +174,134 @@ class JaxCodec:
         n = shards.shape[1]
         if n == 0:
             return np.zeros((m, 0), dtype=np.uint8)
-        a_bits = self._coef_bits(coef)
-        return _collect(self._dispatch(a_bits, shards))
-
-    def _dispatch(self, a_bits, shards: np.ndarray) -> list:
-        """Issue the async device calls for one (k, n) column block,
-        slab-split and bucket-padded; returns [(device_array, width)]
-        without forcing any transfer back."""
-        n = shards.shape[1]
-        slab = self.slab
-        if n <= slab:
-            # pad to power-of-two buckets (>=256) so XLA compiles at most
-            # log2(slab/256) shapes for sub-slab calls
-            padded = 256
-            while padded < n:
-                padded <<= 1
-            padded = min(padded, slab)  # n <= slab, so padded >= n still
-            return [(self._run(a_bits, _pad_cols(shards, padded)), n)]
-        out = []
-        for off in range(0, n, slab):
-            chunk = shards[:, off:off + slab]
-            w = chunk.shape[1]
-            if w < slab:
-                chunk = _pad_cols(chunk, slab)
-            out.append((self._run(a_bits, chunk), w))
-        return out
+        mats = self._coef_bits(coef)
+        return _collect(self._dispatch(mats, shards))
 
     def coded_matmul_stream(self, coef: np.ndarray, blocks,
                             depth: int = 2):
         """Streaming pipeline: for each (k, w) uint8 column block from
         the iterable `blocks`, yield the matching (m, w) result, in
-        order. Up to `depth` blocks are in flight at once — the
-        producer side issues H2D + compute (both asynchronous under
-        jax's dispatch model) while a single fetch thread drains D2H —
-        so on hardware with independent DMA engines the three stages
-        overlap instead of serializing (the reference streams 256KB
-        buffers through its CPU codec synchronously,
-        ec_encoder.go:198-235; a device codec lives or dies by hiding
-        the transfer latency).
+        order, with up to `depth` blocks in flight.
+
+        Three stages on three threads so they genuinely overlap (the
+        reference streams 256KB buffers through its CPU codec
+        synchronously, ec_encoder.go:198-235; a device codec lives or
+        dies by hiding transfer latency):
+
+          caller thread   pread   next(blocks) + host pad/split
+          upload thread   h2d     committed device_put, blocks until
+                                  the copy lands, then issues the
+                                  kernel (async under jax dispatch)
+          drain thread    kernel  block_until_ready on the result
+                          d2h     dlpack/np.asarray readback
+
+        While the drain thread reads block k-1 back, the device runs
+        block k's kernel and the upload thread pushes block k+1 — the
+        double-buffered schedule at depth=2, deeper when asked. Each
+        stage records ec_codec_stage_seconds{stage}; `relay` is the
+        time a finished block waited for the consumer (writer
+        backpressure + queue residence), so pread+h2d+kernel+d2h+relay
+        accounts for the whole e2e gap versus the link ceiling.
         """
         from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import Future, ThreadPoolExecutor
 
         coef = np.asarray(coef, dtype=np.uint8)
         m = coef.shape[0]
-        a_bits = self._coef_bits(coef)
+        mats = self._coef_bits(coef)
+        depth = max(1, int(depth))
+        backend = self.name
 
-        with ThreadPoolExecutor(1) as ex:
+        def upload(block: np.ndarray):
+            t0 = _time.perf_counter()
+            chunks = self._split(block)
+            devs = [(self._h2d(chunk), w) for chunk, w in chunks]
+            for d, _ in devs:
+                # wait for the copies, not the compute: the h2d stage
+                # time must be the transfer alone, and issuing the next
+                # upload before the kernel keeps the DMA engine busy
+                d.block_until_ready()
+            t1 = _time.perf_counter()
+            outs = [(self._run(mats, d), w) for d, w in devs]
+            observe_stage(backend, "h2d", t1 - t0)
+            return outs
+
+        def drain(up_fut):
+            outs = up_fut.result()
+            t0 = _time.perf_counter()
+            for d, _ in outs:
+                d.block_until_ready()
+            t1 = _time.perf_counter()
+            arr = _collect(outs)
+            t2 = _time.perf_counter()
+            observe_stage(backend, "kernel", t1 - t0)
+            observe_stage(backend, "d2h", t2 - t1)
+            return arr, t2
+
+        up_ex = ThreadPoolExecutor(1, thread_name_prefix="ec-h2d")
+        down_ex = ThreadPoolExecutor(1, thread_name_prefix="ec-d2h")
+
+        def finish(fut) -> np.ndarray:
+            arr, t_done = fut.result()
+            relay = _time.perf_counter() - t_done
+            if relay > 0:
+                observe_stage(backend, "relay", relay)
+            return arr
+
+        try:
             pending: deque = deque()
-            for block in blocks:
+            it = iter(blocks)
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    block = next(it)
+                except StopIteration:
+                    break
+                observe_stage(backend, "pread",
+                              _time.perf_counter() - t0)
                 block = np.asarray(block, dtype=np.uint8)
                 if block.shape[1] == 0:
                     # empty result still rides the queue: yielding it
                     # directly would reorder it ahead of pending blocks
-                    pending.append(ex.submit(
-                        lambda: np.zeros((m, 0), dtype=np.uint8)))
+                    f: Future = Future()
+                    f.set_result((np.zeros((m, 0), dtype=np.uint8),
+                                  _time.perf_counter()))
+                    pending.append(f)
                 else:
-                    pending.append(
-                        ex.submit(_collect, self._dispatch(a_bits, block)))
-                while len(pending) > depth:
-                    yield pending.popleft().result()
+                    up = up_ex.submit(upload, block)
+                    pending.append(down_ex.submit(drain, up))
+                while len(pending) >= depth:
+                    yield finish(pending.popleft())
             while pending:
-                yield pending.popleft().result()
+                yield finish(pending.popleft())
+        finally:
+            # bounded: at most `depth` blocks in flight, and upload
+            # tasks cannot deadlock on drain tasks, so waiting here
+            # can't hang; cancel_futures covers generator early-close
+            up_ex.shutdown(wait=True, cancel_futures=True)
+            down_ex.shutdown(wait=True, cancel_futures=True)
 
-    def _run(self, a_bits: jax.Array, shards: np.ndarray) -> jax.Array:
-        return _bit_matmul(a_bits, jnp.asarray(shards))
+
+def _readback(dev: jax.Array) -> np.ndarray:
+    """D2H for one device result. dlpack first: on CPU devices (and
+    any platform sharing the host address space) it aliases the device
+    buffer instead of copying — the consumer only reads, so the
+    read-only view is fine. Accelerators fall back to np.asarray."""
+    try:
+        return np.from_dlpack(dev)
+    except Exception:
+        return np.asarray(dev)
 
 
 def _collect(devs: list) -> np.ndarray:
     """Force D2H on a _dispatch result and reassemble the (m, n) block
-    (shared by the sync path and the streaming fetch thread)."""
+    (shared by the sync path and the streaming drain thread)."""
     if len(devs) == 1:
         dev, w = devs[0]
-        return np.asarray(dev)[:, :w]
+        out = _readback(dev)
+        return out[:, :w] if out.shape[1] != w else out
     return np.concatenate(
-        [np.asarray(dev)[:, :w] for dev, w in devs], axis=1)
+        [_readback(dev)[:, :w] for dev, w in devs], axis=1)
 
 
 def _pad_cols(arr: np.ndarray, n: int) -> np.ndarray:

@@ -85,10 +85,9 @@ def packing_matrix(m: int) -> jax.Array:
     return jnp.asarray(p, dtype=jnp.bfloat16)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def coded_matmul_pallas_pm(a_pm: jax.Array, pack: jax.Array,
-                           shards: jax.Array,
-                           interpret: bool = False) -> jax.Array:
+def _coded_matmul_pallas_pm_impl(a_pm: jax.Array, pack: jax.Array,
+                                 shards: jax.Array,
+                                 interpret: bool = False) -> jax.Array:
     """a_pm: (8m, 8k) bf16 plane-major coefficient matrix;
     pack: (m, 8m) bf16; shards: (k, n) uint8 with n % COL_TILE == 0
     -> (m, n) uint8."""
@@ -112,6 +111,15 @@ def coded_matmul_pallas_pm(a_pm: jax.Array, pack: jax.Array,
     )(a_pm, pack, shards)
 
 
+coded_matmul_pallas_pm = jax.jit(_coded_matmul_pallas_pm_impl,
+                                 static_argnames=("interpret",))
+# pipeline variant: the uploaded block is dead after the kernel —
+# donating it lets XLA recycle its HBM for in-flight staging buffers
+coded_matmul_pallas_pm_donated = jax.jit(
+    _coded_matmul_pallas_pm_impl, static_argnames=("interpret",),
+    donate_argnums=(2,))
+
+
 def coded_matmul_pallas(a_bits: jax.Array, shards: jax.Array,
                         interpret: bool = False) -> jax.Array:
     """Drop-in signature match for bits.coded_matmul_bits (a_bits is
@@ -133,10 +141,11 @@ def _make_pallas_codec_class():
 
     class PallasCodec(JaxCodec):
         """Codec backend running the fused Pallas kernel
-        (-ec.backend=pallas). Reuses JaxCodec's slabbing + shape
-        bucketing; only the per-coefficient matrices and the dispatch
-        differ. Column counts are padded to COL_TILE multiples per
-        dispatch."""
+        (-ec.backend=pallas). Reuses JaxCodec's slabbing, committed
+        H2D placement and the staged streaming pipeline; only the
+        per-coefficient matrices, the column padding (COL_TILE
+        multiples, applied host-side before H2D) and the kernel
+        dispatch differ."""
 
         name = "pallas"
 
@@ -160,15 +169,19 @@ def _make_pallas_codec_class():
                 self._mats.move_to_end(key)
             return mats
 
-        def _run(self, mats, shards: np.ndarray) -> jax.Array:
+        def _pad_width(self, n: int) -> int:
+            # the kernel's grid walks COL_TILE lanes per step; padding
+            # happens on the host (JaxCodec._split) so the device
+            # never relayouts
+            return n + (-n) % COL_TILE
+
+        def _run(self, mats, dev: jax.Array) -> jax.Array:
             a_pm, pack = mats
-            n = shards.shape[1]
-            pad = (-n) % COL_TILE
-            if pad:
-                shards = np.pad(shards, ((0, 0), (0, pad)))
-            out = coded_matmul_pallas_pm(a_pm, pack,
-                                         jnp.asarray(shards))
-            return out[:, :n] if pad else out
+            if self._donate is None:
+                self._donate = jax.devices()[0].platform != "cpu"
+            fn = (coded_matmul_pallas_pm_donated if self._donate
+                  else coded_matmul_pallas_pm)
+            return fn(a_pm, pack, dev)
 
     return PallasCodec
 

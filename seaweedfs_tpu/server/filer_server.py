@@ -326,6 +326,7 @@ class FilerServer:
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
+            web.get("/debug/ec", self.handle_debug_ec),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
             web.post("/dlm/lock", self.handle_dlm_lock),
             web.post("/dlm/unlock", self.handle_dlm_unlock),
@@ -1189,6 +1190,11 @@ class FilerServer:
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
+
+    async def handle_debug_ec(self, req: web.Request) -> web.Response:
+        from ..ec import backend as ec_backend
+
+        return await ec_backend.handle_debug_ec(req)
 
 
 def _q_get(q, timeout):

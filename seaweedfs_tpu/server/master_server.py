@@ -30,6 +30,17 @@ from ..utils import faults, retry, tracing
 from ..utils.security import Guard
 
 
+def _ec_router_snapshot() -> dict:
+    """EC router state for /cluster/status — reads the probe cache
+    only (never triggers a sweep from the control plane)."""
+    try:
+        from ..ec import backend as ec_backend
+
+        return ec_backend.probe_snapshot()
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": str(e)}
+
+
 class MasterServer:
     def __init__(self, volume_size_limit: int = 30 << 30,
                  default_replication: str = "000",
@@ -180,6 +191,7 @@ class MasterServer:
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
+            web.get("/debug/ec", self.handle_debug_ec),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -541,7 +553,13 @@ class MasterServer:
             "VacuumDisabled": self.vacuum_disabled,
             "Topology": self.topo.to_dict(),
             "Breakers": retry.breakers_snapshot(),
+            "EcRouter": _ec_router_snapshot(),
         })
+
+    async def handle_debug_ec(self, req: web.Request) -> web.Response:
+        from ..ec import backend as ec_backend
+
+        return await ec_backend.handle_debug_ec(req)
 
     async def handle_vacuum_now(self, req: web.Request) -> web.Response:
         """/vol/vacuum?garbageThreshold=0.3 — the on-demand cluster

@@ -153,6 +153,7 @@ class VolumeServer:
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
+            web.get("/debug/ec", self.handle_debug_ec),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/delete_volume", self.handle_delete_volume),
             web.post("/admin/mark_readonly", self.handle_mark_readonly),
@@ -1884,6 +1885,11 @@ class VolumeServer:
         return out
 
     # ------------------------------------------------------------------
+    async def handle_debug_ec(self, req: web.Request) -> web.Response:
+        from ..ec import backend as ec_backend
+
+        return await ec_backend.handle_debug_ec(req)
+
     async def handle_status(self, req: web.Request) -> web.Response:
         hb = self.store.collect_heartbeat()
         out = {"Version": "seaweedfs-tpu", **hb}

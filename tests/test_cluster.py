@@ -115,6 +115,25 @@ class TestMasterBehavior:
                       for dc in body["Topology"]["datacenters"]
                       for r in dc["racks"])
         assert n_nodes == 2
+        # EC router state rides along for operators: either measured
+        # (curve + buckets) or an explicit "unprobed" — never missing
+        router = body["EcRouter"]
+        assert router["cpu_backend"] in ("native", "numpy")
+        assert router["probe"]["state"] in ("measured", "unprobed")
+        if router["probe"]["state"] == "measured":
+            assert isinstance(router["buckets"], list)
+
+    def test_debug_ec(self, cluster):
+        """/debug/ec exposes the probe curve, cache age and the chosen
+        backend per size bucket without ever triggering a sweep."""
+        body = requests.get(f"{cluster.master_url}/debug/ec").json()
+        assert body["cache_path"]
+        assert body["cache_ttl_s"] > 0
+        assert body["probe"]["state"] in ("measured", "unprobed")
+        if body["probe"]["state"] == "measured":
+            for b in body["buckets"]:
+                assert set(b) >= {"size_mb", "backend", "depth",
+                                  "device_e2e_mbps", "cpu_mbps"}
 
     def test_grow(self, cluster):
         before = cluster.master.topo.max_volume_id

@@ -95,3 +95,164 @@ def test_backend_registry():
     assert "jax" in ecb.backend_names()
     with pytest.raises(KeyError):
         ecb.get_backend("nope")
+
+
+# ---------------------------------------------------------------------
+# pipelined device feed + measured-curve router (ISSUE 3)
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4, 8])
+def test_pipelined_stream_matches_numpy_all_depths(depth, rng):
+    """The depth-N staged pipeline is bit-identical to the numpy
+    oracle at every depth — including uneven final blocks, a width
+    under one lane tile, and an empty block mid-stream."""
+    from seaweedfs_tpu.ops.codec_jax import JaxCodec
+
+    codec = JaxCodec(slab=1024)
+    coef = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    widths = [1000, 512, 257, 0, 64, 777, 3]
+    blocks = [rng.integers(0, 256, (10, w)).astype(np.uint8)
+              for w in widths]
+    outs = list(codec.coded_matmul_stream(coef, iter(blocks),
+                                          depth=depth))
+    assert len(outs) == len(blocks)
+    for out, blk in zip(outs, blocks):
+        want = codec_numpy.coded_matmul(coef, blk)
+        assert np.array_equal(np.asarray(out), want), depth
+
+
+def test_pipelined_encode_feed_matches_oracle(rng):
+    """models.ec_pipeline host-feed (BASELINE config #3 path) is
+    bit-identical to the jitted batch encode at several depths."""
+    from seaweedfs_tpu.models import ec_pipeline as ep
+
+    blocks = [rng.integers(0, 256, (2, 10, 300 + 17 * i))
+              .astype(np.uint8) for i in range(4)]
+    fn, a_bits = ep.jitted_encode()
+    refs = [np.asarray(fn(a_bits, b)) for b in blocks]
+    for depth in (1, 2, 4):
+        outs = list(ep.pipelined_encode_stream(iter(blocks),
+                                               depth=depth))
+        for out, want in zip(outs, refs):
+            assert np.array_equal(np.asarray(out), want), depth
+
+
+def _mk_curve(cpu_mbps, rows, device=True):
+    import time as _t
+
+    from seaweedfs_tpu.ec import probe
+
+    return {
+        "fingerprint": probe.host_fingerprint(),
+        "measured_at": _t.time(),
+        "rows": rows,
+        "cpu_backend": "numpy",
+        "cpu_mbps": cpu_mbps,
+        "device": ({"platform": "tpu", "kind": "test", "count": 1}
+                   if device else None),
+        "device_backend": "jax",
+    }
+
+
+def _rows(rates_by_size_depth):
+    return [{"size": s, "depth": d, "e2e_mbps": r}
+            for (s, d), r in rates_by_size_depth.items()]
+
+
+def test_router_interpolates_monotonically():
+    """Piecewise-linear in log2(size) over best-depth-per-size,
+    clamped at both ends: monotone input -> monotone output, no hump
+    the sweep didn't measure."""
+    from seaweedfs_tpu.ec import probe
+
+    curve = _mk_curve(50.0, _rows({
+        (1 << 20, 1): 10.0, (1 << 20, 2): 8.0,
+        (4 << 20, 2): 40.0,
+        (16 << 20, 2): 160.0, (16 << 20, 4): 120.0,
+        (64 << 20, 4): 320.0}))
+    xs = [1 << 18, 1 << 20, 2 << 20, 4 << 20, 11 << 20, 16 << 20,
+          40 << 20, 64 << 20, 1 << 30]
+    ys = [probe.e2e_mbps_at(curve, x) for x in xs]
+    assert ys == sorted(ys)
+    assert ys[0] == 10.0 and ys[-1] == 320.0  # clamped, no extrapolation
+    # exact at measured points, best depth wins per size
+    assert probe.e2e_mbps_at(curve, 16 << 20) == 160.0
+    assert probe.depth_at(curve, 16 << 20) == 2
+    assert probe.depth_at(curve, 64 << 20) == 4
+    assert probe.depth_at(curve, 1 << 20) == 1
+
+
+def test_router_never_picks_device_below_cpu_rate(monkeypatch):
+    """A device whose MEASURED e2e is below the measured CPU rate is
+    never selected, at any size — the r05 relay scenario."""
+    monkeypatch.delenv("SEAWEEDFS_TPU_EC_BACKEND", raising=False)
+    slow = _mk_curve(327.0, _rows({
+        (1 << 20, 2): 3.0, (4 << 20, 2): 6.0,
+        (16 << 20, 2): 9.0, (64 << 20, 4): 9.5}))
+    for size in (1 << 18, 1 << 20, 8 << 20, 64 << 20, 1 << 30):
+        assert ecb._decide(slow, size) == "numpy", size
+
+
+def test_router_picks_device_when_measured_faster(monkeypatch):
+    """...and a device that measurably beats the CPU rate at bulk
+    sizes IS selected there, while small requests still route to the
+    CPU codec (per-size decision from the same curve)."""
+    monkeypatch.delenv("SEAWEEDFS_TPU_EC_BACKEND", raising=False)
+    fast = _mk_curve(300.0, _rows({
+        (1 << 20, 1): 50.0, (4 << 20, 2): 250.0,
+        (16 << 20, 2): 900.0, (64 << 20, 4): 2000.0}))
+    assert ecb._decide(fast, 1 << 20) == "numpy"
+    assert ecb._decide(fast, 64 << 20) == "jax"
+    from seaweedfs_tpu.ec import probe
+
+    monkeypatch.setattr(probe, "_curve", fast)
+    assert ecb.choose_backend_for_size(1 << 20) == "numpy"
+    assert ecb.choose_backend_for_size(64 << 20) == "jax"
+    assert ecb.pipeline_depth_for(64 << 20) == 4
+
+
+def test_probe_cache_roundtrip(tmp_path, monkeypatch):
+    from seaweedfs_tpu.ec import probe
+
+    path = str(tmp_path / "ec_probe.json")
+    monkeypatch.setenv("SEAWEEDFS_TPU_EC_PROBE_CACHE", path)
+    curve = _mk_curve(100.0, _rows({(1 << 20, 2): 5.0}))
+    probe.save_cache(curve)
+    got = probe.load_cached()
+    assert got is not None
+    assert got["rows"] == curve["rows"]
+
+
+def test_probe_cache_corrupt_falls_back_to_sweep(tmp_path, monkeypatch):
+    """Corrupt cache JSON -> load returns None -> get_curve re-sweeps;
+    never a crash, never a half-trusted curve."""
+    from seaweedfs_tpu.ec import probe
+
+    path = str(tmp_path / "ec_probe.json")
+    monkeypatch.setenv("SEAWEEDFS_TPU_EC_PROBE_CACHE", path)
+    with open(path, "w") as f:
+        f.write('{"rows": [1, 2')  # truncated JSON
+    assert probe.load_cached() is None
+    sentinel = _mk_curve(1.0, [], device=False)
+    monkeypatch.setattr(probe, "run_sweep", lambda **kw: dict(sentinel))
+    monkeypatch.setattr(probe, "_curve", None)
+    got = probe.get_curve()
+    assert got["source"] == "fresh"
+    assert got["cpu_mbps"] == 1.0
+
+
+def test_probe_cache_expired_or_foreign_falls_back(tmp_path,
+                                                   monkeypatch):
+    from seaweedfs_tpu.ec import probe
+
+    path = str(tmp_path / "ec_probe.json")
+    monkeypatch.setenv("SEAWEEDFS_TPU_EC_PROBE_CACHE", path)
+    expired = _mk_curve(100.0, [])
+    expired["measured_at"] -= probe.cache_ttl_s() + 60
+    probe.save_cache(expired)
+    assert probe.load_cached() is None
+    foreign = _mk_curve(100.0, [])
+    foreign["fingerprint"] = dict(foreign["fingerprint"],
+                                  host="someone-else")
+    probe.save_cache(foreign)
+    assert probe.load_cached() is None

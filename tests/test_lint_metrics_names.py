@@ -61,6 +61,7 @@ def test_known_families_present():
     for _path, src in _iter_sources():
         names.update(n for _c, n in _CALL_RE.findall(src))
     for expected in ("request_trace_seconds", "ec_codec_seconds",
-                     "ec_codec_bytes_total", "ec_codec_chosen_backend",
-                     "s3_request_seconds", "filer_request_seconds"):
+                     "ec_codec_stage_seconds", "ec_codec_bytes_total",
+                     "ec_codec_chosen_backend", "s3_request_seconds",
+                     "filer_request_seconds"):
         assert expected in names, expected
