@@ -146,6 +146,31 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-admin.scriptInterval",
                    dest="admin_script_interval", type=float,
                    default=60.0)
+    p.add_argument("-repair.enabled", dest="repair_enabled",
+                   action="store_true",
+                   help="drive automatic repair of under-replicated "
+                        "volumes and under-parity EC volumes from the "
+                        "redundancy watchdog queue (tracking and "
+                        "/debug/repair reporting are always on)")
+    p.add_argument("-repair.interval", dest="repair_interval",
+                   type=float, default=10.0,
+                   help="seconds between watchdog deficit scans; "
+                        "heartbeat register/loss deltas also trigger "
+                        "an immediate scan")
+    p.add_argument("-repair.concurrency", dest="repair_concurrency",
+                   type=int, default=2,
+                   help="max repairs (volume re-replications / EC "
+                        "shard rebuilds) running at once")
+    p.add_argument("-repair.maxAttempts", dest="repair_max_attempts",
+                   type=int, default=5,
+                   help="attempts per repair task before giving up; "
+                        "retries back off with the shared -retry.* "
+                        "full-jitter policy")
+    p.add_argument("-repair.grace", dest="repair_grace",
+                   type=float, default=0.0,
+                   help="seconds a deficit must persist before repair "
+                        "starts (rides out transient restarts; 0 = "
+                        "repair on first scan)")
 
     p = sub.add_parser("master.follower",
                        help="read-only master follower for lookup traffic")
@@ -926,7 +951,12 @@ def _run_master(args) -> int:
                       me=f"{args.ip}:{args.port}", peers=peers,
                       raft_state_dir=raft_dir or None,
                       admin_scripts=scripts,
-                      admin_script_interval=args.admin_script_interval)
+                      admin_script_interval=args.admin_script_interval,
+                      repair_enabled=args.repair_enabled,
+                      repair_interval=args.repair_interval,
+                      repair_concurrency=args.repair_concurrency,
+                      repair_max_attempts=args.repair_max_attempts,
+                      repair_grace=args.repair_grace)
     t = ServerThread(ms.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     ms.admin_scripts_url = t.url
@@ -1104,6 +1134,7 @@ def _run_server(args) -> int:
     threads = []
     ms = MasterServer(volume_size_limit=args.volumeSizeLimitMB << 20)
     mt = ServerThread(ms.app, host=args.ip, port=args.master_port).start()
+    ms.admin_scripts_url = mt.url
     threads.append(mt)
     print(f"master listening on {mt.url}")
 

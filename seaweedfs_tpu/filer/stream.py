@@ -62,16 +62,22 @@ def _hedged_fetch(fetch: Callable[[str], bytes], urls: list[str],
         errors.append(exc)
         futs.discard(fut)
     # primary slow (or failed fast): fire one alternate replica
+    hedge_fut = None
     if len(urls) > 1:
         metrics.counter_add("replica_read_hedges", 1)
-        futs.add(pool.submit(
-            contextvars.copy_context().run, fetch, urls[1]))
+        hedge_fut = pool.submit(
+            contextvars.copy_context().run, fetch, urls[1])
+        futs.add(hedge_fut)
     # phase 2: first success wins, losers are cancelled best-effort
     while futs:
         done, _ = wait(futs, return_when=FIRST_COMPLETED)
         for fut in done:
             exc = fut.exception()
             if exc is None:
+                if fut is hedge_fut:
+                    # win-rate vs replica_read_hedges is the tuning
+                    # signal for -hedge.delay (ROADMAP open item)
+                    metrics.counter_add("replica_read_hedge_wins", 1)
                 for p in futs:
                     if p is not fut:
                         p.cancel()

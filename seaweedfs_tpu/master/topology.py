@@ -203,6 +203,12 @@ class Topology:
                                 rack_obj, norm_disk(disk_type))
                 rack_obj.nodes[node_id] = node
                 self.nodes[node_id] = node
+                # a re-registering server is a fresh process: drop any
+                # breaker state the dead incarnation accumulated, both
+                # under the admin url and the public one
+                _retry.reset_peer_breaker(node_id)
+                if public_url and public_url != node_id:
+                    _retry.reset_peer_breaker(public_url)
             node.disk_type = norm_disk(disk_type)
             node.last_seen = time.monotonic()
             return node

@@ -1,0 +1,339 @@
+"""Redundancy watchdog + repair queue.
+
+Continuously tracks per-volume replica counts and per-EC-volume live
+shard counts from the same heartbeat/KeepConnected deltas that drive
+the topology (not just a leader cron), surfaces the deficit sets on
+/cluster/status and /debug/repair, and — when ``-repair.enabled`` is
+set — drives re-replication / EC shard rebuild through a
+bounded-concurrency queue.
+
+Rationale: the warehouse-cluster study (arxiv 1309.0186) and the
+all-flash EC study (arxiv 1906.08602) both find time-to-redundancy,
+not encode speed, dominates real availability — repair must start on
+loss detection, not on the next cron tick.  The reference's analogue
+is the volume.fix.replication / ec.rebuild maintenance scripts; here
+those verbs become queue-driven repair primitives.
+
+Repair work reuses the existing machinery end to end: targets come
+from the live topology, copies go over the volume admin API through
+rpc/httpclient (which already carries the retry/deadline/breaker
+policy of utils/retry.py), requeue backoff uses RetryPolicy.backoff,
+and EC rebuilds route through the shell ec.rebuild verb and therefore
+the TPU/CPU codec router.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..ec import geometry as geo
+from ..storage.super_block import ReplicaPlacement
+from ..utils import glog, metrics
+from ..utils import retry as _retry
+
+
+@dataclass
+class RepairTask:
+    vid: int
+    kind: str                 # "replica" | "ec"
+    reason: str               # "watchdog" | "scrub" | "operator"
+    have: int = 0
+    want: int = 0
+    collection: str = ""
+    attempts: int = 0
+    first_seen: float = field(default_factory=time.monotonic)
+    not_before: float = 0.0   # monotonic; requeue backoff gate
+
+    @property
+    def key(self) -> tuple[int, str]:
+        return (self.vid, self.kind)
+
+    def to_dict(self) -> dict:
+        return {"volume": self.vid, "kind": self.kind,
+                "reason": self.reason, "have": self.have,
+                "want": self.want, "collection": self.collection,
+                "attempts": self.attempts,
+                "age_seconds": round(time.monotonic() - self.first_seen,
+                                     3)}
+
+
+class RedundancyWatchdog:
+    """Deficit tracking is ALWAYS on (cheap scan of in-memory topology
+    on every poke/interval); repair driving is opt-in via ``enabled``
+    so operator shells and tests keep exclusive control of the cluster
+    unless self-healing is requested."""
+
+    def __init__(self, master, enabled: bool = False,
+                 interval: float = 10.0, concurrency: int = 2,
+                 max_attempts: int = 5, grace: float = 0.0):
+        self.master = master
+        self.enabled = enabled
+        self.interval = max(0.05, interval)
+        self.concurrency = max(1, concurrency)
+        self.max_attempts = max(1, max_attempts)
+        self.grace = max(0.0, grace)
+        self.under_replicated: list[dict] = []
+        self.under_parity: list[dict] = []
+        self.last_scan_at = 0.0
+        self.scan_count = 0
+        self._tracked: dict[tuple[int, str], RepairTask] = {}
+        self._queued: set[tuple[int, str]] = set()
+        self._inflight: dict[tuple[int, str], float] = {}
+        self._results: deque[dict] = deque(maxlen=50)
+        self._queue: asyncio.Queue[RepairTask] = asyncio.Queue()
+        self._poke = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    # -- lifecycle (aiohttp on_startup / on_cleanup) --------------------
+    async def start(self, app=None) -> None:
+        self._tasks = [asyncio.create_task(self._scan_loop())]
+        if self.enabled:
+            self._tasks += [asyncio.create_task(self._worker(i))
+                            for i in range(self.concurrency)]
+
+    async def stop(self, app=None) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+
+    def poke(self) -> None:
+        """Event-driven rescan request — called from the master's
+        heartbeat register/sync/unregister paths so a lost node is
+        noticed at delta time, not at the next interval tick."""
+        self._poke.set()
+
+    # -- deficit scan ---------------------------------------------------
+    def scan(self) -> tuple[list[dict], list[dict]]:
+        """One pass over the in-memory topology under its lock:
+        under-replicated plain volumes and under-parity EC volumes."""
+        topo = self.master.topo
+        under_replicated: list[dict] = []
+        under_parity: list[dict] = []
+        with topo.lock:
+            for key, layout in topo.layouts.items():
+                want = ReplicaPlacement.parse(key.replication).copy_count
+                if want <= 1:
+                    continue
+                for vid, nodes in layout.locations.items():
+                    have = len(nodes)
+                    if 0 < have < want:
+                        under_replicated.append(
+                            {"volume": vid, "collection": key.collection,
+                             "have": have, "want": want,
+                             "replication": key.replication})
+            for vid, shards in topo.ec_locations.items():
+                k, m = geo.parse_codec(topo.ec_codecs.get(vid, ""))
+                live = sum(1 for nodes in shards.values() if nodes)
+                if 0 < live < k + m:
+                    under_parity.append(
+                        {"volume": vid,
+                         "collection": topo.ec_collections.get(vid, ""),
+                         "have": live, "want": k + m,
+                         "recoverable": live >= k})
+        return under_replicated, under_parity
+
+    def enqueue(self, vid: int, kind: str, reason: str,
+                collection: str = "") -> bool:
+        """External enqueue hook (scrub wiring, /debug/repair POST).
+        Dedupes against tracked/in-flight work; repair only actually
+        runs when the queue is enabled, otherwise the task stays
+        visible as pending."""
+        task = RepairTask(vid=vid, kind=kind, reason=reason,
+                          collection=collection)
+        if task.key in self._inflight:
+            return False
+        prev = self._tracked.get(task.key)
+        if prev is not None:
+            # keep attempt history, refresh the reason
+            prev.reason = reason
+            task = prev
+        else:
+            self._tracked[task.key] = task
+        if self.enabled and task.key not in self._queued:
+            self._queued.add(task.key)
+            self._queue.put_nowait(task)
+        self._report_depth()
+        self.poke()
+        return True
+
+    # -- introspection --------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval": self.interval,
+            "concurrency": self.concurrency,
+            "max_attempts": self.max_attempts,
+            "grace": self.grace,
+            "queue_depth": self._queue.qsize() + len(self._inflight),
+            "scan_count": self.scan_count,
+            "last_scan_age_seconds": (
+                round(time.monotonic() - self.last_scan_at, 3)
+                if self.last_scan_at else None),
+            "under_replicated": self.under_replicated,
+            "under_parity": self.under_parity,
+            "pending": [t.to_dict() for t in self._tracked.values()],
+            "in_flight": [{"volume": vid, "kind": kind,
+                           "running_seconds":
+                               round(time.monotonic() - t0, 3)}
+                          for (vid, kind), t0 in self._inflight.items()],
+            "recent": list(self._results),
+        }
+
+    def _report_depth(self) -> None:
+        metrics.gauge_set("repair_queue_depth",
+                          self._queue.qsize() + len(self._inflight))
+
+    # -- scan loop ------------------------------------------------------
+    async def _scan_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._poke.wait(),
+                                       timeout=self.interval)
+                # coalesce a burst of heartbeat deltas into one scan
+                await asyncio.sleep(min(0.05, self.interval / 4))
+            except asyncio.TimeoutError:
+                pass
+            self._poke.clear()
+            if self.master.raft is not None and \
+                    not self.master.raft.is_leader():
+                # followers own no topology; drop stale deficit views
+                self.under_replicated = []
+                self.under_parity = []
+                continue
+            try:
+                self._scan_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # pragma: no cover - defensive
+                glog.warning(f"repair watchdog scan failed: {e}")
+
+    def _scan_once(self) -> None:
+        ur, up = self.scan()
+        self.under_replicated = ur
+        self.under_parity = up
+        self.last_scan_at = time.monotonic()
+        self.scan_count += 1
+        now = time.monotonic()
+        seen: set[tuple[int, str]] = set()
+        for entry, kind in [(e, "replica") for e in ur] + \
+                           [(e, "ec") for e in up]:
+            if kind == "ec" and not entry.get("recoverable", True):
+                continue  # < k shards: rebuild is impossible
+            key = (entry["volume"], kind)
+            seen.add(key)
+            task = self._tracked.get(key)
+            if task is None:
+                task = RepairTask(vid=entry["volume"], kind=kind,
+                                  reason="watchdog",
+                                  collection=entry.get("collection", ""))
+                self._tracked[key] = task
+            task.have = entry["have"]
+            task.want = entry["want"]
+        # deficits that healed on their own (node came back) drop out
+        for key in list(self._tracked):
+            if key not in seen and key not in self._inflight and \
+                    self._tracked[key].reason == "watchdog":
+                if key not in self._queued:
+                    self._tracked.pop(key)
+        if self.enabled:
+            for key, task in list(self._tracked.items()):
+                if key in self._queued or key in self._inflight:
+                    continue
+                if now - task.first_seen < self.grace:
+                    continue
+                if now < task.not_before:
+                    continue
+                self._queued.add(key)
+                self._queue.put_nowait(task)
+        self._report_depth()
+
+    # -- repair workers -------------------------------------------------
+    async def _worker(self, i: int) -> None:
+        while True:
+            task = await self._queue.get()
+            self._queued.discard(task.key)
+            if task.key not in self._tracked:
+                continue  # healed while queued
+            self._inflight[task.key] = time.monotonic()
+            self._report_depth()
+            t0 = time.monotonic()
+            try:
+                detail, repaired_bytes = await asyncio.to_thread(
+                    self._repair_one, task)
+                ok, err = True, ""
+            except asyncio.CancelledError:
+                self._inflight.pop(task.key, None)
+                raise
+            except Exception as e:
+                ok, err, detail, repaired_bytes = False, str(e), {}, 0
+            dt = time.monotonic() - t0
+            self._inflight.pop(task.key, None)
+            task.attempts += 1
+            metrics.histogram_observe(
+                "repair_seconds", dt,
+                {"kind": task.kind, "outcome": "ok" if ok else "error"})
+            if repaired_bytes:
+                metrics.counter_add("repair_bytes_total", repaired_bytes,
+                                    {"kind": task.kind})
+            self._results.appendleft({
+                "volume": task.vid, "kind": task.kind,
+                "reason": task.reason, "ok": ok,
+                "attempts": task.attempts,
+                "seconds": round(dt, 3), "bytes": repaired_bytes,
+                "error": err, "detail": detail,
+                "finished_at": time.time()})
+            if ok:
+                self._tracked.pop(task.key, None)
+                glog.info(
+                    f"repair[{task.kind}] volume {task.vid} done in "
+                    f"{dt:.2f}s ({repaired_bytes} bytes)")
+            elif task.attempts >= self.max_attempts:
+                self._tracked.pop(task.key, None)
+                glog.warning(
+                    f"repair[{task.kind}] volume {task.vid} gave up "
+                    f"after {task.attempts} attempts: {err}")
+            else:
+                # full-jitter requeue backoff from the shared policy;
+                # the next scan re-enqueues once not_before passes
+                task.not_before = time.monotonic() + \
+                    _retry.policy().backoff(task.attempts)
+                glog.warning(
+                    f"repair[{task.kind}] volume {task.vid} attempt "
+                    f"{task.attempts} failed: {err}")
+                self.poke()
+            self._report_depth()
+
+    def _repair_one(self, task: RepairTask) -> tuple[dict, int]:
+        """Synchronous repair primitive, run in a thread: targeted
+        volume.fix.replication for lost replicas, ec.rebuild (through
+        the codec router) for lost shards.  Holds the cluster admin
+        lock exactly like the admin-scripts cron so repairs serialize
+        against operator shells."""
+        from ..shell.commands_ec import ec_rebuild
+        from ..shell.commands_volume import volume_fix_replication
+        from ..shell.env import CommandEnv
+
+        filers = self.master.membership.list_nodes("filer")
+        filer_url = f"http://{filers[0].address}" if filers else ""
+        env = CommandEnv(self.master.admin_scripts_url,
+                         filer_url=filer_url)
+        try:
+            env.acquire_lock()
+            if task.kind == "replica":
+                fixes = volume_fix_replication(env, volume_id=task.vid)
+                moved = 0
+                for f in fixes:
+                    moved += int(f.get("bytes", 0))
+                return {"fixes": fixes}, moved
+            out = ec_rebuild(env, task.vid, collection=task.collection)
+            rebuilt_bytes = int(out.get("rebuilt_bytes", 0))
+            return out, rebuilt_bytes
+        finally:
+            env.close()

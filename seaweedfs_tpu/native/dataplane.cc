@@ -556,6 +556,33 @@ std::atomic<bool> jwt_required{false};
 std::shared_mutex jwt_mu;
 std::string jwt_secret;  // under jwt_mu; non-empty iff jwt_required
 
+// fault injection (utils/faults.py subset): error probability + fixed
+// delay per op class, set once at spawn via dp_faults before traffic.
+// Rates/delays are written before faults_on flips, so relaxed reads
+// from the IO threads are safe; the seeded RNG sits under its own
+// mutex so a fixed seed gives one deterministic decision sequence.
+std::atomic<bool> faults_on{false};
+std::mutex faults_mu;
+double fault_read_err = 0, fault_write_err = 0;
+double fault_read_delay = 0, fault_write_delay = 0;
+uint64_t fault_rng = 0x9E3779B97F4A7C15ull;
+
+// splitmix64 step -> uniform double in [0, 1)
+double fault_roll() {
+  std::lock_guard<std::mutex> lk(faults_mu);
+  uint64_t z = (fault_rng += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  z ^= z >> 31;
+  return (double)(z >> 11) * 0x1.0p-53;
+}
+
+double wall_now() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (double)ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
 std::shared_ptr<Vol> find_vol(uint32_t vid) {
   std::shared_lock<std::shared_mutex> lk(vols_mu);
   auto it = vols.find(vid);
@@ -728,6 +755,7 @@ struct Request {
   size_t range_len = 0;
   const char* traceparent = nullptr;  // W3C trace context, relayed as-is
   size_t traceparent_len = 0;
+  double deadline = 0;  // X-Sw-Deadline: absolute epoch seconds, 0 = none
 };
 
 // epoll data.ptr discrimination: Conn and PeerConn both lead with an
@@ -900,6 +928,9 @@ ssize_t parse_head(const char* buf, size_t len, Request* r) {
       } else if (ieq(p, klen, "traceparent")) {
         r->traceparent = v;
         r->traceparent_len = vlen;
+      } else if (ieq(p, klen, "x-sw-deadline")) {
+        double d = strtod(std::string(v, vlen).c_str(), nullptr);
+        if (d > 0) r->deadline = d;
       } else if (ieq(p, klen, "content-encoding")) {
         r->proxy_only = true;  // pre-compressed body: python sets the needle flag
       } else if (klen >= 8 && ieq(p, 8, "seaweed-")) {
@@ -999,7 +1030,9 @@ bool parse_fid_path(const char* p, size_t n, uint32_t* vid, uint64_t* key,
   return true;
 }
 
-void simple_response(Conn* c, int code, const char* text, bool keep_alive) {
+// `extra` is a pre-formatted header block ("K: v\r\n..." or "")
+void simple_response_x(Conn* c, int code, const char* text, bool keep_alive,
+                       const char* extra) {
   const char* reason = code == 200   ? "OK"
                        : code == 201 ? "Created"
                        : code == 202 ? "Accepted"
@@ -1011,17 +1044,76 @@ void simple_response(Conn* c, int code, const char* text, bool keep_alive) {
                        : code == 416 ? "Requested Range Not Satisfiable"
                        : code == 500 ? "Internal Server Error"
                        : code == 502 ? "Bad Gateway"
+                       : code == 503 ? "Service Unavailable"
+                       : code == 504 ? "Gateway Timeout"
                                      : "Error";
-  char head[256];
+  char head[384];
   int body_len = (int)strlen(text);
   int n = snprintf(head, sizeof head,
                    "HTTP/1.1 %d %s\r\nContent-Length: %d\r\n"
-                   "Content-Type: text/plain\r\n%s\r\n",
-                   code, reason, body_len,
+                   "Content-Type: text/plain\r\n%s%s\r\n",
+                   code, reason, body_len, extra,
                    keep_alive ? "" : "Connection: close\r\n");
   c->out.append(head, n);
   c->out.append(text, body_len);
   if (!keep_alive) c->want_close = true;
+}
+
+void simple_response(Conn* c, int code, const char* text, bool keep_alive) {
+  simple_response_x(c, code, text, keep_alive, "");
+}
+
+// Deadline + fault gate, run on every parsed client request before
+// dispatch. Replication hops are exempt: the primary already charged
+// the client-facing deadline/fault budget for this write once.
+// Returns false = pass, true = answered here (caller moves to the
+// next pipelined request; when the body was not fully buffered the
+// conn is close-marked so the unread stream cannot desync framing).
+// Injected delays run on the IO thread on purpose: a slow front stalls
+// every conn it owns, which is the failure mode being modelled.
+bool gate_request(Conn* c, const Request& r, size_t avail) {
+  if (r.is_replicate) return false;
+  int deny = 0;
+  const char* extra = "";
+  if (r.deadline > 0 && wall_now() >= r.deadline) {
+    deny = 504;
+  } else if (faults_on.load(std::memory_order_relaxed)) {
+    // same carve-outs as faults.aiohttp_middleware's _SKIP_PATHS
+    static const char* kSkip[] = {"/metrics", "/debug/traces",
+                                  "/debug/breakers", "/status", "/healthz"};
+    for (const char* sp : kSkip)
+      if (r.path_len == strlen(sp) && memcmp(r.path, sp, r.path_len) == 0)
+        return false;
+    bool is_read = ieq(r.method, r.method_len, "GET") ||
+                   ieq(r.method, r.method_len, "HEAD") ||
+                   ieq(r.method, r.method_len, "OPTIONS");
+    double delay = is_read ? fault_read_delay : fault_write_delay;
+    if (delay > 0) usleep((useconds_t)(delay * 1e6));
+    double prob = is_read ? fault_read_err : fault_write_err;
+    if (prob > 0 && fault_roll() < prob) {
+      deny = 503;
+      // same contract as faults.aiohttp_middleware: the handler never
+      // ran, so the retry layer may replay blindly
+      extra = "X-Sw-Retryable: 1\r\nRetry-After: 0\r\n";
+    }
+  }
+  if (!deny) return false;
+  n_errors++;
+  const char* text = deny == 504 ? "deadline exceeded" : "fault injected";
+  bool complete = false;
+  int64_t blen = body_len_buffered(r, c->in.data() + c->in_off + r.head_len,
+                                   avail - r.head_len, &complete);
+  if (complete) {
+    simple_response_x(c, deny, text, r.keep_alive, extra);
+    c->in_off += r.head_len + (size_t)blen;
+    c->sent_100 = false;
+    return true;
+  }
+  // body still in flight: answer-and-close, discard whatever arrives
+  simple_response_x(c, deny, text, false, extra);
+  c->in.clear();
+  c->in_off = 0;
+  return true;
 }
 
 uint64_t now_ns() {
@@ -1736,6 +1828,21 @@ bool proxy_one(Server* s, Conn* c, const Request& r) {
     return send_all(c->fd, c->out.data(), c->out.size()), false;
   }
   int bfd = c->backend_fd;
+  // clip the backend read timeout to the request's remaining deadline
+  // budget (the default 300s accommodates vacuum/EC admin calls); the
+  // keep-alive backend conn gets the default restored for the next
+  // request by the unconditional set here
+  {
+    double rem = 300.0;
+    if (r.deadline > 0) {
+      rem = r.deadline - wall_now();
+      if (rem < 0.05) rem = 0.05;  // expired mid-queue: fail fast
+      if (rem > 300.0) rem = 300.0;
+    }
+    struct timeval tv = {(time_t)rem,
+                         (suseconds_t)((rem - (double)(time_t)rem) * 1e6)};
+    setsockopt(bfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  }
   // 1+2. forward head + body (buffered part first, then streamed) —
   // chunked framing is tracked by the incremental ChunkScan so a
   // body of any size relays without re-parsing from buffer offsets
@@ -2027,6 +2134,11 @@ int pump_inner(Server* s, Conn* c) {
   // until its response is written (HTTP responses must stay ordered)
   if (c->repl_pending) return 0;
   if (c->swrp) return swrp_pump(c);
+  if (c->want_close) {  // close-marked response still flushing:
+    c->in.clear();      // discard whatever else the client streams
+    c->in_off = 0;
+    return 0;
+  }
   while (true) {
     if (c->in_off > 0 && c->in_off == c->in.size()) {
       c->in.clear();
@@ -2068,6 +2180,17 @@ int pump_inner(Server* s, Conn* c) {
     uint64_t key;
     uint32_t cookie;
     bool fid_ok = parse_fid_path(r.path, r.path_len, &vid, &key, &cookie);
+    // deadline/fault gate (SWRP above stays exempt). Deferred while a
+    // fast-path write is still buffering its body — the pump re-parses
+    // that request on every read, and the gate must fire exactly once
+    // per request (seeded RNG) — but run before any dispatch otherwise
+    // (proxied bodies stream without ever being fully buffered here).
+    bool fast_body_waiting =
+        is_post && fid_ok && (!r.has_query || r.is_replicate) &&
+        !r.proxy_only && !r.chunked && r.content_len > 0 &&
+        r.content_len <= (8 << 20) &&
+        avail - r.head_len < (size_t)r.content_len;
+    if (!fast_body_waiting && gate_request(c, r, avail)) continue;
     // fid as the JWT claim sees it: no leading slash, extension excluded
     const char* fid = r.path + 1;
     size_t fid_len = r.path_len ? r.path_len - 1 : 0;
@@ -3464,6 +3587,11 @@ int s3_handle_put(Server* s, Conn* c, const Request& r, const char* head,
 // S3-role pump: the fast paths, with relay for everything else.
 int s3_pump_inner(Server* s, Conn* c) {
   if (c->repl_pending) return 0;  // gated PUT in flight
+  if (c->want_close) {  // close-marked response still flushing
+    c->in.clear();
+    c->in_off = 0;
+    return 0;
+  }
   while (true) {
     if (c->in_off > 0 && c->in_off == c->in.size()) {
       c->in.clear();
@@ -3499,6 +3627,14 @@ int s3_pump_inner(Server* s, Conn* c) {
       std::shared_lock<std::shared_mutex> lk(s3_mu);
       bucket_known = s3_buckets.count(bucket) > 0;
     }
+    // deadline/fault gate — deferred while a fast-path PUT is still
+    // buffering its body so it fires exactly once per request
+    bool fast_put_waiting =
+        is_put && bucket_known && key_len && !r.has_query &&
+        !r.proxy_only && !r.chunked && r.content_len > 0 &&
+        r.content_len <= (1 << 20) &&
+        avail - r.head_len < (size_t)r.content_len;
+    if (!fast_put_waiting && gate_request(c, r, avail)) continue;
     if ((is_get || is_head) && bucket_known && !r.has_query &&
         !r.proxy_only && r.content_len == 0 && !r.chunked &&
         !(is_head && r.range)) {  // AWS honors Range on HEAD: relay
@@ -3838,6 +3974,26 @@ void dp_config(int jwt_req, const char* secret) {
     jwt_secret = secret ? secret : "";
   }
   jwt_required.store(jwt_req != 0 && secret && *secret);
+}
+
+// Fault-injection knobs (the native front's share of a -fault.spec):
+// error probability and fixed delay per op class (read = GET/HEAD,
+// write = POST/PUT/DELETE), plus the RNG seed for deterministic chaos
+// runs. Meant to be set once at spawn, before traffic; all zeros turn
+// the gate off.
+void dp_faults(double read_err, double write_err, double read_delay,
+               double write_delay, uint64_t seed) {
+  auto clamp01 = [](double p) { return p < 0 ? 0.0 : p > 1 ? 1.0 : p; };
+  {
+    std::lock_guard<std::mutex> lk(faults_mu);
+    fault_read_err = clamp01(read_err);
+    fault_write_err = clamp01(write_err);
+    fault_read_delay = read_delay < 0 ? 0 : read_delay;
+    fault_write_delay = write_delay < 0 ? 0 : write_delay;
+    fault_rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  }
+  faults_on.store(fault_read_err > 0 || fault_write_err > 0 ||
+                  fault_read_delay > 0 || fault_write_delay > 0);
 }
 
 // -- native S3 front ---------------------------------------------------------

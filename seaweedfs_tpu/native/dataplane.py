@@ -52,6 +52,10 @@ def _load() -> ctypes.CDLL:
         lib.dp_stop.restype = None
         lib.dp_config.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.dp_config.restype = None
+        lib.dp_faults.argtypes = [ctypes.c_double, ctypes.c_double,
+                                  ctypes.c_double, ctypes.c_double,
+                                  ctypes.c_uint64]
+        lib.dp_faults.restype = None
         lib.dp_set_peers.argtypes = [ctypes.c_uint32, ctypes.c_char_p]
         lib.dp_set_peers.restype = ctypes.c_int
         lib.dp_peers_stale.argtypes = [ctypes.c_uint32]
@@ -220,6 +224,16 @@ class DataPlane:
         """jwt_required + the HS256 secret so the front verifies write
         tokens in-process instead of relaying every guarded write."""
         self._lib.dp_config(1 if jwt_required else 0, secret.encode())
+
+    def set_faults(self, read_err: float = 0.0, write_err: float = 0.0,
+                   read_delay: float = 0.0, write_delay: float = 0.0,
+                   seed: int = 0) -> None:
+        """Mirror this service's share of the -fault.spec into the
+        native front: error probability and fixed delay per op class
+        (read = GET/HEAD, write = POST/PUT/DELETE), with a seeded RNG
+        for deterministic chaos runs. All zeros disables the gate."""
+        self._lib.dp_faults(read_err, write_err, read_delay, write_delay,
+                            seed & 0xFFFFFFFFFFFFFFFF)
 
     # -- volumes --------------------------------------------------------
     def attach(self, vid: int, dat_path: str, idx_path: str, version: int,

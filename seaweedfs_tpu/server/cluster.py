@@ -37,7 +37,10 @@ class Cluster:
                  tier_backends: dict[str, dict] | None = None,
                  admin_scripts: list[str] | None = None,
                  admin_script_interval: float = 60.0,
-                 disk_types: list[str] | None = None):
+                 disk_types: list[str] | None = None,
+                 repair_enabled: bool = False,
+                 repair_interval: float = 10.0,
+                 repair_concurrency: int = 2):
         """topology: optional per-server (data_center, rack) labels;
         disk_types: optional per-server disk class (hdd/ssd)."""
         self.base_dir = base_dir
@@ -46,7 +49,10 @@ class Cluster:
             default_replication=default_replication,
             pulse_seconds=pulse_seconds, jwt_secret=jwt_secret,
             admin_scripts=admin_scripts,
-            admin_script_interval=admin_script_interval)
+            admin_script_interval=admin_script_interval,
+            repair_enabled=repair_enabled,
+            repair_interval=repair_interval,
+            repair_concurrency=repair_concurrency)
         self.master_thread = ServerThread(self.master.app).start()
         self.master.admin_scripts_url = self.master_thread.url
         self.volume_servers: list[VolumeServer] = []

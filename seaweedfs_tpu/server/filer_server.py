@@ -688,7 +688,8 @@ class FilerServer:
             if done and primary.exception() is None:
                 return primary.result()
             metrics.counter_add("replica_read_hedges", 1)
-            racers = {asyncio.ensure_future(fetch(urls[1]))}
+            hedge = asyncio.ensure_future(fetch(urls[1]))
+            racers = {hedge}
             if not done:
                 racers.add(primary)  # still in flight — keep racing it
             while racers:
@@ -696,6 +697,11 @@ class FilerServer:
                     racers, return_when=asyncio.FIRST_COMPLETED)
                 for t in done:
                     if t.exception() is None:
+                        if t is hedge:
+                            # win-rate vs replica_read_hedges tunes
+                            # -hedge.delay (ROADMAP open item)
+                            metrics.counter_add(
+                                "replica_read_hedge_wins", 1)
                         for p in racers:
                             p.cancel()
                         return t.result()

@@ -53,7 +53,12 @@ class MasterServer:
                  raft_state_dir: str | None = None,
                  raft_tick: float = 1.0,
                  admin_scripts: list[str] | None = None,
-                 admin_script_interval: float = 60.0):
+                 admin_script_interval: float = 60.0,
+                 repair_enabled: bool = False,
+                 repair_interval: float = 10.0,
+                 repair_concurrency: int = 2,
+                 repair_max_attempts: int = 5,
+                 repair_grace: float = 0.0):
         self.topo = Topology(volume_size_limit, pulse_seconds)
         self.default_replication = default_replication
         if sequencer == "memory" and peers:
@@ -92,6 +97,14 @@ class MasterServer:
         self.admin_scripts_url = ""
         self.admin_script_runs: list[dict] = []
         self._admin_task: asyncio.Task | None = None
+        # redundancy watchdog: deficit tracking always on, repair
+        # driving gated by -repair.enabled (watchdog.py)
+        from ..master.watchdog import RedundancyWatchdog
+
+        self.watchdog = RedundancyWatchdog(
+            self, enabled=repair_enabled, interval=repair_interval,
+            concurrency=repair_concurrency,
+            max_attempts=repair_max_attempts, grace=repair_grace)
         self.app = self._build_app()
 
     async def _start_admin_scripts(self, app) -> None:
@@ -192,6 +205,8 @@ class MasterServer:
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
             web.get("/debug/ec", self.handle_debug_ec),
+            web.get("/debug/repair", self.handle_debug_repair),
+            web.post("/debug/repair", self.handle_repair_enqueue),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -228,6 +243,8 @@ class MasterServer:
             self._clients.clear()
 
         app.on_shutdown.append(_close_ws_clients)
+        app.on_startup.append(self.watchdog.start)
+        app.on_cleanup.append(self.watchdog.stop)
         if self.admin_scripts:
             app.on_startup.append(self._start_admin_scripts)
             app.on_cleanup.append(self._stop_admin_scripts)
@@ -441,6 +458,7 @@ class MasterServer:
                         node, [(e["id"], e.get("collection", ""),
                                 e["shard_bits"], e.get("codec", ""))
                                for e in hb["ec_shards"]])
+                self.watchdog.poke()
                 await ws.send_json({
                     "volume_size_limit": self.topo.volume_size_limit,
                     "pulse_seconds": self.pulse_seconds,
@@ -449,6 +467,7 @@ class MasterServer:
         finally:
             if node_id is not None:
                 self.topo.unregister_data_node(node_id)
+                self.watchdog.poke()
                 await self._broadcast_all_locations()
         return ws
 
@@ -554,7 +573,38 @@ class MasterServer:
             "Topology": self.topo.to_dict(),
             "Breakers": retry.breakers_snapshot(),
             "EcRouter": _ec_router_snapshot(),
+            "UnderReplicated": self.watchdog.under_replicated,
+            "UnderParity": self.watchdog.under_parity,
+            "RepairQueueDepth": (self.watchdog._queue.qsize() +
+                                 len(self.watchdog._inflight)),
+            "RepairEnabled": self.watchdog.enabled,
         })
+
+    async def handle_debug_repair(self, req: web.Request) -> web.Response:
+        """Watchdog state: deficit sets, queue, in-flight and recent
+        repairs."""
+        return json_ok(self.watchdog.snapshot())
+
+    async def handle_repair_enqueue(self, req: web.Request) -> web.Response:
+        """Enqueue one repair (scrub wiring + operator hook):
+        {"volume": vid, "kind": "replica"|"ec", "reason": "..."}."""
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
+        body = await req.json()
+        try:
+            vid = int(body["volume"])
+        except (KeyError, TypeError, ValueError):
+            return json_error("repair enqueue requires a volume id",
+                              status=400)
+        kind = body.get("kind", "replica")
+        if kind not in ("replica", "ec"):
+            return json_error(f"unknown repair kind {kind!r}", status=400)
+        accepted = self.watchdog.enqueue(
+            vid, kind, body.get("reason", "operator"),
+            collection=body.get("collection", ""))
+        return json_ok({"accepted": accepted,
+                        "enabled": self.watchdog.enabled})
 
     async def handle_debug_ec(self, req: web.Request) -> web.Response:
         from ..ec import backend as ec_backend

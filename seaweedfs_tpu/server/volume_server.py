@@ -211,6 +211,11 @@ class VolumeServer:
         port = dp.start(public_port, backend_port, workers,
                         listen_ip=listen_ip)
         dp.config(self.guard.enabled, self.guard.secret)
+        if faults.enabled():
+            # mirror this service's share of -fault.spec so requests the
+            # front answers natively see the same chaos as relayed ones
+            re, we, rd, wd = faults.native_params("volume")
+            dp.set_faults(re, we, rd, wd, seed=faults.seed())
         self.dp = dp
         for loc in self.store.locations:
             for v in loc.volumes.values():
@@ -1193,6 +1198,7 @@ class VolumeServer:
             return web.json_response({"error": "volume exists"}, status=409)
         loc = min(self.store.locations, key=lambda l: l.volume_count)
         base = loc.base_name(collection, vid)
+        copied = 0
         async with aiohttp.ClientSession() as sess:
             for ext in (".dat", ".idx"):
                 async with sess.get(
@@ -1206,13 +1212,14 @@ class VolumeServer:
                     with open(base + ext, "wb") as f:
                         async for chunk in resp.content.iter_chunked(1 << 20):
                             f.write(chunk)
+                            copied += len(chunk)
         from ..storage.volume import Volume
 
         loc.volumes[vid] = await asyncio.to_thread(
             Volume, loc.dir, collection, vid)
         self._dp_attach(loc.volumes[vid])
         self.poke_heartbeat()
-        return web.json_response({"volume": vid})
+        return web.json_response({"volume": vid, "bytes": copied})
 
     async def handle_volume_unmount(self, req: web.Request) -> web.Response:
         """VolumeUnmount (volume_grpc_admin.go): close + forget a volume,
@@ -1429,7 +1436,19 @@ class VolumeServer:
                 self.store.rebuild_ec_shards, vid)
         except (KeyError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
-        return web.json_response({"rebuilt_shards": rebuilt})
+        rebuilt_bytes = 0
+        base = self.store._ec_base(vid)
+        if base:
+            from ..ec import geometry as geo
+
+            for sid in rebuilt:
+                try:
+                    rebuilt_bytes += os.path.getsize(
+                        base + geo.shard_ext(sid))
+                except OSError:
+                    pass
+        return web.json_response({"rebuilt_shards": rebuilt,
+                                  "rebuilt_bytes": rebuilt_bytes})
 
     async def handle_ec_copy(self, req: web.Request) -> web.Response:
         """VolumeEcShardsCopy (:126): pull shard files (and optionally

@@ -133,7 +133,8 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
         env.vs_post(rebuilder, "/admin/ec/delete",
                     {"volume": volume_id, "shard_ids": borrowed})
     env.wait_for_ec_registration(volume_id, k + m)
-    return {"rebuilt": rebuilt, "rebuilder": rebuilder}
+    return {"rebuilt": rebuilt, "rebuilder": rebuilder,
+            "rebuilt_bytes": out.get("rebuilt_bytes", 0)}
 
 
 def ec_balance(env: CommandEnv, collection: str = "") -> list[dict]:
@@ -223,13 +224,18 @@ def ec_decode(env: CommandEnv, volume_id: int,
 
 
 def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
-              backend: str = "numpy") -> dict:
+              backend: str = "numpy", quarantine: bool = True) -> dict:
     """Parity-check an EC volume's spread shards: fetch the same
     aligned prefix of every shard from its holder and run the codec
     backend's RS verify (batched GF(256) matmul — `-backend=jax` puts
     the check on the TPU). Any aligned prefix of all 14 shards is
     itself a valid codeword set, so `sample_mb` bounds IO while still
-    exercising every shard end-to-end; 0 means full shards."""
+    exercising every shard end-to-end; 0 means full shards.
+
+    With ``quarantine`` (default), a parity mismatch that pinpoints to
+    exactly one corrupt shard deletes that shard on its holder and
+    enqueues an ec rebuild on the master repair queue instead of only
+    reporting the failure."""
     import numpy as np
 
     from ..ec.backend import ReedSolomon
@@ -260,5 +266,54 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
     stack = np.stack([s[:n] for s in shards])
     rs = ReedSolomon(k, m, backend=backend)
     ok = bool(rs.verify(stack))
-    return {"volume": volume_id, "verified": ok,
-            "bytes_checked_per_shard": int(n), "backend": backend}
+    out = {"volume": volume_id, "verified": ok,
+           "bytes_checked_per_shard": int(n), "backend": backend}
+    if not ok and quarantine:
+        rows = {sid: stack[sid] for sid in range(k + m)}
+        corrupt = _locate_corrupt_shard(rs, rows)
+        out["corrupt_shard"] = corrupt
+        if corrupt is not None:
+            # the shard is regenerable from the other k+m-1: delete it
+            # (a merely-unmounted file would poison a later local
+            # rebuild on the same server) and let the repair queue
+            # rebuild it through the codec router
+            from .commands_volume import enqueue_repair
+
+            env.vs_post(locs[corrupt][0], "/admin/ec/delete",
+                        {"volume": volume_id, "shard_ids": [corrupt]})
+            out["quarantined"] = True
+            out["repair_enqueued"] = enqueue_repair(
+                env, volume_id, "ec", "scrub", collection=_col)
+    return out
+
+
+def _locate_corrupt_shard(rs, rows: dict) -> int | None:
+    """Pinpoint a single corrupt shard by reconstruction: decode the
+    codeword from k clean shards and the one id whose fetched bytes
+    disagree with the reconstruction is the corruption.  When the
+    first basis (lowest k ids) contains the corrupt shard the decode
+    disagrees in many places; retry excluding one basis member at a
+    time.  None = not attributable to exactly one shard (multiple
+    corruptions or systematic failure) — caller reports only."""
+    import numpy as np
+
+    total = rs.k + rs.m
+
+    def mismatches(basis: list[int]) -> list[int]:
+        recon = rs.reconstruct({sid: rows[sid] for sid in basis},
+                               missing=[i for i in range(total)
+                                        if i not in basis])
+        return [i for i in range(total) if i not in basis and
+                not np.array_equal(recon[i], rows[i])]
+
+    basis = list(range(rs.k))
+    bad = mismatches(basis)
+    if len(bad) == 1:
+        return bad[0]
+    if not bad:
+        return None
+    for c in basis:
+        alt = [i for i in range(total) if i != c][:rs.k]
+        if mismatches(alt) == [c]:
+            return c
+    return None

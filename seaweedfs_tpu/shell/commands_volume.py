@@ -117,10 +117,13 @@ def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]
     return done
 
 
-def volume_fix_replication(env: CommandEnv) -> list[dict]:
+def volume_fix_replication(env: CommandEnv,
+                           volume_id: int = 0) -> list[dict]:
     """Re-replicate under-replicated volumes: copy .dat/.idx from a
     healthy replica to a server that lacks the volume
-    (command_volume_fix_replication.go)."""
+    (command_volume_fix_replication.go).  ``volume_id`` restricts the
+    pass to one volume — the master's repair queue uses that for
+    targeted per-deficit repairs."""
     env.confirm_locked()
     nodes = env.data_nodes()
     by_vid: dict[int, list[dict]] = defaultdict(list)
@@ -129,6 +132,8 @@ def volume_fix_replication(env: CommandEnv) -> list[dict]:
             by_vid[vid].append(n)
     fixes = []
     for vid, holders in by_vid.items():
+        if volume_id and vid != volume_id:
+            continue
         rp = _volume_replication(env, vid, holders)
         want = rp.copy_count
         have = len(holders)
@@ -156,10 +161,12 @@ def volume_fix_replication(env: CommandEnv) -> list[dict]:
         src = holders[0]["url"]
         col = env.volume_collection(vid)
         for target in candidates[:want - have]:
-            env.vs_post(target["url"], "/admin/volume_copy",
-                        {"volume": vid, "collection": col, "source": src})
+            out = env.vs_post(target["url"], "/admin/volume_copy",
+                              {"volume": vid, "collection": col,
+                               "source": src})
             fixes.append({"volume": vid, "from": src,
-                          "to": target["url"]})
+                          "to": target["url"],
+                          "bytes": out.get("bytes", 0)})
     return fixes
 
 
@@ -662,12 +669,17 @@ def collection_delete(env: CommandEnv, collection: str) -> list[int]:
 
 
 def volume_scrub(env: CommandEnv, volume_id: int = 0,
-                 collection: str = "", limit: int = 0) -> list[dict]:
+                 collection: str = "", limit: int = 0,
+                 quarantine: bool = True) -> list[dict]:
     """Full-read needle verification across the cluster (the
     per-volume arm of cluster scrub, BASELINE config #5): every
     replica of every targeted volume re-reads its live needles so disk
     reads, size checks and CRC32C all fire. ec.verify covers the EC
-    arm."""
+    arm.
+
+    With ``quarantine`` (default) a replica with CRC mismatches is
+    pulled out of service and a re-replication is enqueued on the
+    master's repair queue instead of only being reported."""
     targets: list[tuple[int, str]] = []
     if volume_id:
         for url in env.volume_locations(volume_id):
@@ -687,5 +699,46 @@ def volume_scrub(env: CommandEnv, volume_id: int = 0,
         r = env.vs_post(url, "/admin/volume_scrub",
                         {"volume": vid, "limit": limit})
         r["server"] = url
+        if quarantine and r.get("bad"):
+            r["quarantine"] = _quarantine_corrupt_replica(env, vid, url)
         out.append(r)
     return out
+
+
+def _quarantine_corrupt_replica(env: CommandEnv, vid: int,
+                                url: str) -> dict:
+    """Self-healing arm of scrub: with a healthy replica elsewhere the
+    corrupt copy is unmounted (files stay on disk for forensics) and a
+    targeted re-replication goes on the master repair queue; a
+    last-copy volume is only marked readonly — dropping it would take
+    the remaining good needles offline too."""
+    others = [u for u in env.volume_locations(vid) if u != url]
+    if not others:
+        try:
+            env.vs_post(url, "/admin/mark_readonly", {"volume": vid})
+        except ShellError as e:
+            return {"action": "error", "error": str(e)}
+        return {"action": "readonly", "repair_enqueued": False}
+    try:
+        env.vs_post(url, "/admin/volume_unmount", {"volume": vid})
+    except ShellError as e:
+        return {"action": "error", "error": str(e)}
+    return {"action": "unmounted",
+            "repair_enqueued": enqueue_repair(env, vid, "replica",
+                                              "scrub")}
+
+
+def enqueue_repair(env: CommandEnv, vid: int, kind: str, reason: str,
+                   collection: str = "") -> bool:
+    """Put one repair on the master's watchdog queue (POST
+    /debug/repair); False when the master is unreachable — the
+    watchdog's own deficit scan still picks the loss up."""
+    try:
+        resp = session().post(f"{env.master_url}/debug/repair",
+                             json={"volume": vid, "kind": kind,
+                                   "reason": reason,
+                                   "collection": collection},
+                             timeout=30)
+        return resp.status_code < 300
+    except Exception:
+        return False

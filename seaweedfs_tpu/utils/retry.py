@@ -406,6 +406,18 @@ class BreakerRegistry:
         with self._lock:
             self._breakers.clear()
 
+    def reset_peer(self, peer: str) -> bool:
+        """Forget one peer's breaker (same normalization as
+        ``for_peer``). A volume server that re-registers after a
+        restart is a fresh process — it must not inherit the dead
+        process's OPEN breaker, or every client shuns it for a full
+        reset_timeout after it came back healthy. Returns True when
+        state existed and was dropped."""
+        peer = peer.strip().removeprefix("http://").removeprefix("https://")
+        peer = peer.split("/", 1)[0]
+        with self._lock:
+            return self._breakers.pop(peer, None) is not None
+
 
 _registry = BreakerRegistry()
 
@@ -421,6 +433,11 @@ def breakers_snapshot() -> list[dict]:
 def reset_breakers() -> None:
     """Test hook: forget all peer state."""
     _registry.reset()
+
+
+def reset_peer_breaker(peer: str) -> bool:
+    """Drop one peer's breaker state (see BreakerRegistry.reset_peer)."""
+    return _registry.reset_peer(peer)
 
 
 # ---------------------------------------------------------------------------

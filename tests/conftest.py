@@ -30,6 +30,17 @@ def pytest_sessionstart(session):
     assert jax.devices()[0].platform == "cpu", jax.devices()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, excluded from the tier-1 gate "
+        "(pytest -m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / process-kill robustness test "
+        "(select the whole family with pytest -m chaos)")
+
+
 import pytest  # noqa: E402
 
 

@@ -174,3 +174,78 @@ class TestRegistry:
         assert isinstance(err, ConnectionError)
         assert err.peer == "p:1"
         assert err.retry_after == 2.5
+
+    def test_reset_peer_drops_one_breaker(self):
+        tripped = retry.breaker_for("10.0.0.1:8080")
+        for _ in range(10):
+            tripped.record_failure()
+        bystander = retry.breaker_for("10.0.0.2:8080")
+        bystander.record_failure()
+        assert tripped.state == retry.OPEN
+        # same normalization as for_peer: a url resets the bare peer
+        assert retry.reset_peer_breaker("http://10.0.0.1:8080/x") is True
+        assert retry.breaker_for("10.0.0.1:8080").state == retry.CLOSED
+        assert retry.breaker_for("10.0.0.1:8080") is not tripped
+        # untouched peers keep their state
+        snap = retry.breaker_for("10.0.0.2:8080").snapshot()
+        assert snap["consecutive_failures"] == 1
+
+    def test_reset_peer_absent_is_false(self):
+        assert retry.reset_peer_breaker("nobody:1") is False
+
+
+class TestReregistrationReset:
+    """A volume server that re-registers after a restart is a fresh
+    process: the master must not keep routing decisions on the dead
+    incarnation's OPEN breaker."""
+
+    def setup_method(self):
+        retry.reset_breakers()
+
+    def teardown_method(self):
+        retry.reset_breakers()
+
+    def test_fresh_registration_resets_breaker(self):
+        from seaweedfs_tpu.master.topology import Topology
+
+        topo = Topology()
+        node_id = "127.0.0.1:18080"
+        br = retry.breaker_for(node_id)
+        for _ in range(10):
+            br.record_failure()
+        assert br.state == retry.OPEN
+        topo.register_node(node_id, "127.0.0.1", 18080,
+                           "127.0.0.1:18080", 8)
+        assert retry.breaker_for(node_id).state == retry.CLOSED
+
+    def test_heartbeat_of_known_node_keeps_state(self):
+        """Only a FRESH registration resets: the periodic heartbeat of
+        an already-registered node must not wipe live failure
+        evidence."""
+        from seaweedfs_tpu.master.topology import Topology
+
+        topo = Topology()
+        node_id = "127.0.0.1:18081"
+        topo.register_node(node_id, "127.0.0.1", 18081,
+                           "127.0.0.1:18081", 8)
+        retry.breaker_for(node_id).record_failure()
+        topo.register_node(node_id, "127.0.0.1", 18081,
+                           "127.0.0.1:18081", 8)
+        snap = retry.breaker_for(node_id).snapshot()
+        assert snap["consecutive_failures"] == 1
+
+    def test_reregistration_after_unregister_resets(self):
+        from seaweedfs_tpu.master.topology import Topology
+
+        topo = Topology()
+        node_id = "127.0.0.1:18082"
+        topo.register_node(node_id, "127.0.0.1", 18082,
+                           "127.0.0.1:18082", 8)
+        topo.unregister_data_node(node_id)
+        br = retry.breaker_for(node_id)
+        for _ in range(10):
+            br.record_failure()
+        assert br.state == retry.OPEN
+        topo.register_node(node_id, "127.0.0.1", 18082,
+                           "127.0.0.1:18082", 8)
+        assert retry.breaker_for(node_id).state == retry.CLOSED

@@ -17,6 +17,8 @@ from seaweedfs_tpu.rpc.httpclient import session
 from seaweedfs_tpu.server.cluster import Cluster
 from seaweedfs_tpu.utils import faults, retry
 
+pytestmark = pytest.mark.chaos
+
 CHAOS_SPEC = ("volume:*:error=0.05,filer:*:error=0.05,"
               "volume:*:delay=30ms,filer:*:delay=30ms")
 CYCLES = 200

@@ -20,6 +20,8 @@ from seaweedfs_tpu.shell.env import CommandEnv
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.chaos
+
 
 def free_port():
     s = socket.socket()
@@ -230,3 +232,64 @@ def test_ec_degraded_read_after_shard_holder_death(cluster, tmp_path):
         else:
             raise AssertionError(f"{fid} unreadable after death")
     assert ok == len(payloads)
+
+
+def test_kill_volume_server_during_multipart_upload(cluster):
+    """S3 multipart upload survives a SIGKILL between parts: part 1's
+    chunks were replicated (001), a fresh server restores write
+    capacity, and the completed object reads back bit-exact."""
+    import xml.etree.ElementTree as ET
+
+    master = cluster["master"]
+    procs = cluster["procs"]
+    NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+    fport, sport = free_port(), free_port()
+    filer = f"http://127.0.0.1:{fport}"
+    s3 = f"http://127.0.0.1:{sport}"
+    procs.spawn("filer", "filer", "-port", str(fport),
+                "-master", master, "-store", "leveldb",
+                "-store.path", str(cluster["tmp"] / "filerdb"))
+    wait(lambda: requests.get(f"{filer}/status", timeout=1).ok,
+         msg="filer up")
+    procs.spawn("s3", "s3", "-port", str(sport), "-filer", filer)
+    wait(lambda: requests.get(f"{s3}/status", timeout=1).ok,
+         msg="s3 up")
+
+    assert requests.put(f"{s3}/mp").status_code in (200, 409)
+    r = requests.post(f"{s3}/mp/crash.bin?uploads")
+    upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+    part1 = bytes(range(256)) * 4096  # 1 MiB
+    pr = requests.put(f"{s3}/mp/crash.bin",
+                      params={"partNumber": "1", "uploadId": upload_id},
+                      data=part1)
+    assert pr.status_code == 200, pr.text
+
+    # SIGKILL one chunk holder mid-upload, then restore write capacity
+    # (001 replication needs two live servers) with a fresh node
+    procs.sigkill("v1")
+    wait(lambda: _node_count(master) == 1, timeout=40,
+         msg="dead node dropped")
+    v3p = free_port()
+    d3 = cluster["tmp"] / "mp_v3"
+    d3.mkdir()
+    procs.spawn("v3", "volume", "-port", str(v3p), "-dir", str(d3),
+                "-max", "8", "-mserver", master.replace("http://", ""))
+    wait(lambda: _node_count(master) == 2, msg="replacement joined")
+
+    part2 = b"tail-after-the-crash" * 64
+    pr = requests.put(f"{s3}/mp/crash.bin",
+                      params={"partNumber": "2", "uploadId": upload_id},
+                      data=part2)
+    assert pr.status_code == 200, pr.text
+    body = ("<CompleteMultipartUpload>"
+            "<Part><PartNumber>1</PartNumber></Part>"
+            "<Part><PartNumber>2</PartNumber></Part>"
+            "</CompleteMultipartUpload>").encode()
+    cr = requests.post(f"{s3}/mp/crash.bin",
+                       params={"uploadId": upload_id}, data=body)
+    assert cr.status_code == 200, cr.text
+
+    got = requests.get(f"{s3}/mp/crash.bin")
+    assert got.status_code == 200
+    assert got.content == part1 + part2

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.rpc.httpclient import session
 from seaweedfs_tpu.server.cluster import Cluster
 from seaweedfs_tpu.shell import commands_ec, commands_volume
 from seaweedfs_tpu.shell.env import CommandEnv
@@ -29,15 +30,23 @@ def env(cluster):
     return e
 
 
-def fill_volume(cluster, col, n=20, size=4096):
+def fill_volume(cluster, col, n=20, size=4096, replication=""):
     rng = np.random.default_rng(1)
-    a0 = verbs.assign(cluster.master_url, collection=col)
+    a0 = verbs.assign(cluster.master_url, collection=col,
+                      replication=replication)
     vid = int(a0.fid.split(",")[0])
     verbs.upload(a0, rng.bytes(size))
     for _ in range(n - 1):
-        a = verbs.assign(cluster.master_url, collection=col)
+        a = verbs.assign(cluster.master_url, collection=col,
+                         replication=replication)
         verbs.upload(a, rng.bytes(size))
     return vid
+
+
+def repair_pending(cluster) -> set:
+    r = session().get(cluster.master_url + "/debug/repair",
+                     timeout=30).json()
+    return {(p["volume"], p["kind"]) for p in r["pending"]}
 
 
 class TestVolumeScrub:
@@ -63,8 +72,63 @@ class TestVolumeScrub:
         out = commands_volume.volume_scrub(env, volume_id=vid)
         bad = [b for r in out for b in r["bad"]]
         assert any(b["id"] == key for b in bad)
+        # single replica: quarantine can only freeze it (readonly) —
+        # dropping the last copy would lose the healthy needles too
+        q = [r["quarantine"] for r in out if r.get("bad")]
+        assert q and q[0]["action"] == "readonly"
+        assert not q[0]["repair_enqueued"]
         # restore so other tests aren't poisoned
         v.dat.write_at(orig, byte_off)
+
+    def test_corrupt_replica_quarantined_and_repair_enqueued(
+            self, cluster, env):
+        col = "qr" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=6, replication="001")
+        locs = set(env.volume_locations(vid))
+        assert len(locs) == 2
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        corrupt_url = store.public_url
+        v = store.find_volume(vid)
+        key, off, size = next(v.nm.live_items())
+        from seaweedfs_tpu.storage import types as t
+        byte_off = t.offset_to_actual(off) + t.NEEDLE_HEADER_SIZE + 2
+        orig = v.dat.read_at(1, byte_off)
+        v.dat.write_at(bytes([orig[0] ^ 0xFF]), byte_off)
+        out = commands_volume.volume_scrub(env, volume_id=vid)
+        q = [r for r in out if r.get("bad")]
+        assert len(q) == 1 and q[0]["server"] == corrupt_url
+        assert q[0]["quarantine"]["action"] == "unmounted"
+        assert q[0]["quarantine"]["repair_enqueued"] is True
+        # the corrupt replica left the topology; the healthy one serves
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if corrupt_url not in env.volume_locations(vid):
+                break
+            time.sleep(0.1)
+        assert corrupt_url not in env.volume_locations(vid)
+        # and the loss is on the master's repair queue as pending work
+        assert (vid, "replica") in repair_pending(cluster)
+
+    def test_scrub_report_only_mode(self, cluster, env):
+        col = "ro" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=4)
+        store = next(s for s in cluster.stores
+                     if s.find_volume(vid) is not None)
+        v = store.find_volume(vid)
+        key, off, size = next(v.nm.live_items())
+        from seaweedfs_tpu.storage import types as t
+        byte_off = t.offset_to_actual(off) + t.NEEDLE_HEADER_SIZE + 2
+        orig = v.dat.read_at(1, byte_off)
+        v.dat.write_at(bytes([orig[0] ^ 0xFF]), byte_off)
+        try:
+            out = commands_volume.volume_scrub(env, volume_id=vid,
+                                               quarantine=False)
+            assert any(r["bad"] for r in out)
+            assert all("quarantine" not in r for r in out)
+        finally:
+            v.dat.write_at(orig, byte_off)
 
     def test_scrub_all_with_limit(self, cluster, env):
         out = commands_volume.volume_scrub(env, limit=3)
@@ -93,12 +157,44 @@ class TestEcVerify:
             f.seek(10)
             f.write(bytes([orig[0] ^ 0x5A]))
         try:
-            out = commands_ec.ec_verify(env, vid, sample_mb=1)
+            out = commands_ec.ec_verify(env, vid, sample_mb=1,
+                                        quarantine=False)
             assert out["verified"] is False
         finally:
             with open(shard.path, "r+b") as f:
                 f.seek(10)
                 f.write(orig)
+
+    def test_corrupt_shard_quarantined_and_rebuild_enqueued(
+            self, cluster, env):
+        col = "evq" + secrets.token_hex(3)
+        vid = fill_volume(cluster, col, n=12, size=8192)
+        commands_ec.ec_encode(env, vid)
+        ecv = next(s.ec_volumes[vid] for s in cluster.stores
+                   if vid in s.ec_volumes)
+        sid, shard = next(iter(ecv.shards.items()))
+        orig = shard.read_at(10, 1)
+        with open(shard.path, "r+b") as f:
+            f.seek(10)
+            f.write(bytes([orig[0] ^ 0x5A]))
+        out = commands_ec.ec_verify(env, vid, sample_mb=1)
+        assert out["verified"] is False
+        assert out["corrupt_shard"] == sid
+        assert out["quarantined"] is True
+        assert out["repair_enqueued"] is True
+        # the corrupt shard is gone from its holder and the rebuild is
+        # pending on the master's repair queue
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sid not in env.ec_shard_locations(vid):
+                break
+            time.sleep(0.1)
+        assert sid not in env.ec_shard_locations(vid)
+        assert (vid, "ec") in repair_pending(cluster)
+        # still recoverable: 13 of 14 shards live
+        live = sum(len(u) for u in env.ec_shard_locations(vid).values())
+        assert live == 13
 
     def test_missing_shards_reported(self, env):
         out = commands_ec.ec_verify(env, 999_999)

@@ -1,0 +1,151 @@
+"""Master redundancy watchdog: deficit detection from heartbeat loss,
+/debug/repair visibility, and the bounded repair queue that drives
+volume re-replication back to full redundancy (PR 4 tentpole)."""
+import time
+
+import pytest
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.rpc.httpclient import session
+from seaweedfs_tpu.server.cluster import Cluster
+
+
+def _wait(pred, timeout=15, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"{msg} never became true")
+
+
+def _locations(cluster, vid):
+    r = session().get(cluster.master_url + "/dir/lookup",
+                      params={"volumeId": str(vid)}, timeout=5).json()
+    return [loc["url"] for loc in r.get("locations", [])]
+
+
+def _repair(cluster):
+    return session().get(cluster.master_url + "/debug/repair",
+                         timeout=5).json()
+
+
+def _kill_holder(cluster, vid):
+    """Stop the server thread of one replica holder; returns its url."""
+    victim = next(i for i, s in enumerate(cluster.stores)
+                  if s.find_volume(vid) is not None)
+    url = cluster.stores[victim].public_url
+    cluster.volume_threads[victim].stop()
+    return url
+
+
+def _write_replicated(cluster, n=5):
+    a0 = verbs.assign(cluster.master_url, replication="001")
+    vid = int(a0.fid.split(",")[0])
+    verbs.upload(a0, b"watchdog-payload-0")
+    fids = [a0.fid]
+    for i in range(1, n):
+        a = verbs.assign(cluster.master_url, replication="001")
+        verbs.upload(a, b"watchdog-payload-%d" % i)
+        if int(a.fid.split(",")[0]) == vid:
+            fids.append(a.fid)
+    return vid, fids
+
+
+class TestDeficitVisibility:
+    """Watchdog disabled: deficits are surfaced and tracked as pending
+    work, but nothing repairs on its own (volume.fix.replication and
+    the chaos e2e rely on manual control)."""
+
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        c = Cluster(str(tmp_path), n_volume_servers=3,
+                    pulse_seconds=0.3, volume_size_limit=8 << 20,
+                    repair_enabled=False, repair_interval=0.5)
+        yield c
+        c.stop()
+
+    def test_under_replicated_surfaced_and_pending(self, cluster):
+        vid, _ = _write_replicated(cluster)
+        assert len(_locations(cluster, vid)) == 2
+        _kill_holder(cluster, vid)
+        _wait(lambda: any(u["volume"] == vid for u in session().get(
+            cluster.master_url + "/cluster/status", timeout=5
+        ).json()["UnderReplicated"]), msg="deficit in /cluster/status")
+        st = session().get(cluster.master_url + "/cluster/status",
+                           timeout=5).json()
+        row = next(u for u in st["UnderReplicated"]
+                   if u["volume"] == vid)
+        assert (row["have"], row["want"]) == (1, 2)
+        assert st["RepairEnabled"] is False
+        rep = _repair(cluster)
+        assert rep["enabled"] is False
+        assert any(p["volume"] == vid and p["kind"] == "replica"
+                   for p in rep["pending"])
+        # nothing is being repaired behind the operator's back
+        assert rep["queue_depth"] == 0 and rep["in_flight"] == []
+
+    def test_manual_enqueue_validation(self, cluster):
+        r = session().post(cluster.master_url + "/debug/repair",
+                           json={"volume": 1, "kind": "bogus"},
+                           timeout=5)
+        assert r.status_code == 400
+        r = session().post(cluster.master_url + "/debug/repair",
+                           json={"volume": "x", "kind": "replica"},
+                           timeout=5)
+        assert r.status_code == 400
+        r = session().post(cluster.master_url + "/debug/repair",
+                           json={"volume": 7, "kind": "replica",
+                                 "reason": "test"}, timeout=5)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["accepted"] is True and body["enabled"] is False
+        assert (7, "replica") in {(p["volume"], p["kind"])
+                                  for p in _repair(cluster)["pending"]}
+
+
+class TestAutoRepair:
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        c = Cluster(str(tmp_path), n_volume_servers=3,
+                    pulse_seconds=0.3, volume_size_limit=8 << 20,
+                    repair_enabled=True, repair_interval=0.5)
+        yield c
+        c.stop()
+
+    def test_replica_restored_within_interval(self, cluster):
+        vid, fids = _write_replicated(cluster)
+        dead = _kill_holder(cluster, vid)
+        # the watchdog notices the loss and re-replicates without any
+        # operator involvement
+        _wait(lambda: len(_locations(cluster, vid)) == 2
+              and dead not in _locations(cluster, vid),
+              timeout=20, msg="replica restored")
+        rep = _repair(cluster)
+        assert rep["enabled"] is True
+        oks = [r for r in rep["recent"]
+               if r["volume"] == vid and r["ok"]]
+        assert oks and oks[-1]["kind"] == "replica"
+        # deficit views drained back to clean
+        _wait(lambda: session().get(
+            cluster.master_url + "/cluster/status", timeout=5
+        ).json()["UnderReplicated"] == [], msg="deficit cleared")
+        # every payload is served by the healed copy too
+        for fid in fids:
+            for url in _locations(cluster, vid):
+                assert session().get(f"http://{url}/{fid}",
+                                     timeout=5).status_code == 200
+        # repair metrics surfaced
+        text = session().get(cluster.master_url + "/metrics",
+                             timeout=5).text
+        assert "repair_seconds" in text
+        assert "repair_bytes_total" in text
+        assert "repair_queue_depth" in text
+
+    def test_snapshot_shape(self, cluster):
+        rep = _repair(cluster)
+        for key in ("enabled", "interval", "concurrency",
+                    "max_attempts", "grace", "queue_depth",
+                    "scan_count", "under_replicated", "under_parity",
+                    "pending", "in_flight", "recent"):
+            assert key in rep, key
+        assert rep["interval"] == 0.5 and rep["concurrency"] == 2
