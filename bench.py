@@ -625,6 +625,179 @@ def bench_filer_streaming(rng) -> dict:
     return out
 
 
+def bench_hedge_sweep(argv: list[str]) -> int:
+    """`python bench.py hedge-sweep [--lag 0.15] [--objects 16]
+    [--reads 3] [--delays 0.02,0.05,0.1,0.2,0.35]`
+
+    The -hedge.delay tuning surface (ROADMAP hedge item): replay
+    replicated reads under injected replica lag across several hedge
+    delays and report the win-rate from the `replica_read_hedges` /
+    `replica_read_hedge_wins` counters. The master and both volume
+    servers run as real subprocesses so the lag can ride `-fault.spec
+    volume:read:delay=...` on ONE volume server only — the process-wide
+    fault config can't model an asymmetric replica in-process — while
+    the filer (where hedging happens) runs in-process so each sweep
+    point retunes retry.HEDGE_DELAY directly and reads counter deltas
+    without scraping."""
+    import os
+    import shutil
+    import signal as _signal
+    import socket
+    import subprocess
+    import tempfile
+
+    import requests as rq
+
+    from seaweedfs_tpu.rpc.http import ServerThread
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.utils import metrics, retry
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    lag = float(opt("--lag", "0.15"))
+    n_objects = int(opt("--objects", "16"))
+    n_reads = int(opt("--reads", "3"))
+    delays = [float(d) for d in
+              opt("--delays", "0.02,0.05,0.1,0.2,0.35").split(",")]
+    obj_size = 32 << 10
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_http(url: str, timeout: float = 30) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                rq.get(url, timeout=1)
+                return
+            except rq.RequestException:
+                time.sleep(0.15)
+        raise TimeoutError(f"{url} never came up")
+
+    def counter(name: str) -> float:
+        with metrics._lock:
+            return sum(v for (n, _), v in metrics._counters.items()
+                       if n == name)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PYTHONPATH=repo)
+    tmp = tempfile.mkdtemp(prefix="hedge_sweep_")
+    procs: list[subprocess.Popen] = []
+
+    def spawn(*args: str) -> None:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *args], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+    filer_thread = None
+    results = []
+    try:
+        mport = free_port()
+        master = f"http://127.0.0.1:{mport}"
+        spawn("master", "-port", str(mport), "-volumeSizeLimitMB", "64",
+              "-defaultReplication", "001")
+        wait_http(f"{master}/cluster/status")
+        vports = [free_port(), free_port()]
+        for i, vp in enumerate(vports):
+            d = os.path.join(tmp, f"vol{i}")
+            os.makedirs(d)
+            args = ["volume", "-port", str(vp), "-dir", d,
+                    "-mserver", f"127.0.0.1:{mport}",
+                    "-dataplane", "python"]
+            if i == 1:  # the sick replica: python path so the fault
+                # middleware delays every read deterministically
+                args = ["-fault.spec",
+                        f"volume:read:delay={int(lag * 1000)}ms"] + args
+            spawn(*args)
+            wait_http(f"http://127.0.0.1:{vp}/status")
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            topo = rq.get(f"{master}/cluster/status").json()["Topology"]
+            n = sum(len(r["nodes"]) for dc in topo["datacenters"]
+                    for r in dc["racks"])
+            if n >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("volume servers never registered")
+
+        fs = FilerServer(master, store="memory", replication="001")
+        filer_thread = ServerThread(fs.app, host="127.0.0.1",
+                                    port=0).start()
+        fs.address = filer_thread.address
+        filer_url = filer_thread.url
+        rng = np.random.default_rng(7)
+        for i in range(n_objects):
+            body = rng.integers(0, 256, obj_size,
+                                dtype=np.uint8).tobytes()
+            r = rq.post(f"{filer_url}/hedge/obj{i}", data=body,
+                        timeout=30)
+            assert r.status_code == 201, (r.status_code, r.text)
+
+        log(f"hedge sweep: lag={lag * 1e3:.0f}ms on replica #1, "
+            f"{n_objects} objects x {n_reads} reads per delay")
+        for d in delays:
+            retry.configure(hedge_delay=d)
+            h0 = counter("replica_read_hedges")
+            w0 = counter("replica_read_hedge_wins")
+            lats = []
+            for _ in range(n_reads):
+                for i in range(n_objects):
+                    t0 = time.perf_counter()
+                    r = rq.get(f"{filer_url}/hedge/obj{i}", timeout=30)
+                    lats.append(time.perf_counter() - t0)
+                    assert r.status_code == 200, r.status_code
+            hedges = counter("replica_read_hedges") - h0
+            wins = counter("replica_read_hedge_wins") - w0
+            lats_ms = np.sort(np.array(lats)) * 1e3
+            row = {
+                "hedge_delay_ms": round(d * 1e3, 1),
+                "reads": len(lats),
+                "hedges": int(hedges),
+                "hedge_wins": int(wins),
+                "win_rate": round(wins / hedges, 3) if hedges else None,
+                "p50_ms": round(float(np.percentile(lats_ms, 50)), 1),
+                "p95_ms": round(float(np.percentile(lats_ms, 95)), 1),
+            }
+            results.append(row)
+            log(f"  delay {row['hedge_delay_ms']:6.1f}ms: "
+                f"hedges {row['hedges']:4d}  wins {row['hedge_wins']:4d}"
+                f"  win_rate {row['win_rate']}"
+                f"  p50 {row['p50_ms']}ms  p95 {row['p95_ms']}ms")
+        # headline: the delay with the best p95 (the tail is what
+        # hedging exists to cut)
+        best = min(results, key=lambda r: r["p95_ms"])
+        print(json.dumps({
+            "metric": "hedge_sweep_best_delay",
+            "value": best["hedge_delay_ms"],
+            "unit": "ms",
+            "extra": {"lag_ms": lag * 1e3, "sweep": results},
+        }), flush=True)
+        return 0
+    finally:
+        if filer_thread is not None:
+            try:
+                filer_thread.stop()
+            except Exception:
+                pass
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(_signal.SIGINT)
+        for p in reversed(procs):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     rng = np.random.default_rng(0)
     from seaweedfs_tpu.ops import rs_matrix
@@ -712,4 +885,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "hedge-sweep":
+        sys.exit(bench_hedge_sweep(sys.argv[2:]))
     main()

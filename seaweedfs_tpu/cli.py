@@ -69,6 +69,17 @@ def main(argv: list[str] | None = None) -> int:
         help="spans kept in the in-process ring served at "
              "/debug/traces; place BEFORE the subcommand")
     parser.add_argument(
+        "-trace.sample", dest="trace_sample", type=float, default=1.0,
+        help="head-sampling fraction (0..1) of traces shipped to the "
+             "master's span collector; the verdict hashes the trace-id "
+             "so every process keeps the same traces; place BEFORE "
+             "the subcommand")
+    parser.add_argument(
+        "-trace.otlpUrl", dest="trace_otlp_url", default="",
+        help="master only: push collected traces as OTLP/JSON to this "
+             "HTTP endpoint (e.g. a Jaeger/Tempo collector's "
+             "/v1/traces); place BEFORE the subcommand")
+    parser.add_argument(
         "-fault.spec", dest="fault_spec", default="",
         help="deterministic fault injection for internal hops, e.g. "
              "'volume:read:error=0.05,filer:*:delay=30ms' "
@@ -171,6 +182,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds a deficit must persist before repair "
                         "starts (rides out transient restarts; 0 = "
                         "repair on first scan)")
+    p.add_argument("-master.traceStore", dest="trace_store_size",
+                   type=int, default=2048,
+                   help="max traces kept in the cluster span "
+                        "collector (tail-based retention pins "
+                        "error/slow traces)")
+    p.add_argument("-master.scrapeInterval", dest="scrape_interval",
+                   type=float, default=10.0,
+                   help="seconds between metrics-federation sweeps "
+                        "over every registered node's /metrics")
 
     p = sub.add_parser("master.follower",
                        help="read-only master follower for lookup traffic")
@@ -525,7 +545,8 @@ def main(argv: list[str] | None = None) -> int:
     from .utils import tracing as _tracing
 
     _tracing.configure(slow_threshold=args.trace_slow_threshold,
-                       buffer_size=args.trace_buffer_size)
+                       buffer_size=args.trace_buffer_size,
+                       sample_rate=args.trace_sample)
     from .utils import faults as _faults
     from .utils import retry as _retry
 
@@ -823,6 +844,12 @@ def _dispatch(args) -> int:
         t = ServerThread(w.app, host=args.ip, port=args.port,
                          ssl_context=_ssl_ctx(args)).start()
         print(f"webdav listening on {t.url}")
+        from .rpc.trace_push import master_from_filer
+
+        _filer = args.filer if args.filer.startswith("http") else \
+            f"http://{args.filer}"
+        _start_span_pusher(lambda: master_from_filer(_filer), "webdav",
+                           t.address)
         run_apps_forever([t])
         return 0
     if args.cmd == "iam":
@@ -927,6 +954,18 @@ def _dispatch(args) -> int:
     return 1
 
 
+def _start_span_pusher(master_url, service: str, instance: str):
+    """Ship this process's finished spans to the master's collector
+    (rpc/trace_push.py). `master_url` may be a callable for gateways
+    that must resolve the master through their filer. Never fatal: a
+    process that can't push still serves (drops are counted)."""
+    from .rpc.trace_push import SpanPusher
+
+    sp = SpanPusher(master_url, service, instance)
+    sp.start()
+    return sp
+
+
 def _run_master(args) -> int:
     from .rpc.http import ServerThread, run_apps_forever
     from .server.master_server import MasterServer
@@ -956,7 +995,10 @@ def _run_master(args) -> int:
                       repair_interval=args.repair_interval,
                       repair_concurrency=args.repair_concurrency,
                       repair_max_attempts=args.repair_max_attempts,
-                      repair_grace=args.repair_grace)
+                      repair_grace=args.repair_grace,
+                      trace_store_size=args.trace_store_size,
+                      scrape_interval=args.scrape_interval,
+                      otlp_url=args.trace_otlp_url)
     t = ServerThread(ms.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     ms.admin_scripts_url = t.url
@@ -996,6 +1038,10 @@ def _run_volume(args) -> int:
         print(f"volume server listening on http://{store.public_url} "
               f"(native data plane; python backend :{t.port}), "
               f"dirs={dirs}")
+    master = args.mserver.split(",")[0].strip()
+    if not master.startswith("http"):
+        master = "http://" + master
+    _start_span_pusher(master, "volume", store.public_url)
     run_apps_forever([t])
     return 0
 
@@ -1103,6 +1149,7 @@ def _run_filer(args) -> int:
                      ssl_context=_ssl_ctx(args)).start()
     fs.address = t.address
     print(f"filer listening on {t.url} (store={args.store})")
+    _start_span_pusher(master, "filer", t.address)
     run_apps_forever([t])
     return 0
 
@@ -1121,6 +1168,11 @@ def _run_s3(args) -> int:
     t = ServerThread(s3.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     print(f"s3 gateway listening on {t.url}")
+    from .rpc.trace_push import master_from_filer
+
+    # gateways only know their filer; re-resolving per flush keeps the
+    # pusher pointed at the master across failovers
+    _start_span_pusher(lambda: master_from_filer(filer), "s3", t.address)
     run_apps_forever([t])
     return 0
 

@@ -594,6 +594,27 @@ std::atomic<int64_t> n_fast_get{0}, n_fast_post{0}, n_proxied{0}, n_errors{0};
 std::atomic<int64_t> n_fast_delete{0}, n_repl_post{0}, n_jwt_reject{0},
     n_fanout_fail{0};
 
+// front visibility counters, surfaced through dp_front_stats: responses
+// the native front wrote itself, bucketed by status class, plus payload
+// bytes in (uploaded bodies) / out (served bodies). The host process
+// merges them into /metrics as native_front_requests_total{code} /
+// native_front_bytes_total, so -dataplane native traffic shows up in
+// the cluster metrics federation like any Python-served request.
+std::atomic<int64_t> n_front_2xx{0}, n_front_3xx{0}, n_front_4xx{0},
+    n_front_5xx{0}, n_front_bytes_in{0}, n_front_bytes_out{0};
+
+void count_resp(int code, int64_t bytes_out) {
+  if (code < 300)
+    n_front_2xx++;
+  else if (code < 400)
+    n_front_3xx++;
+  else if (code < 500)
+    n_front_4xx++;
+  else
+    n_front_5xx++;
+  if (bytes_out > 0) n_front_bytes_out += bytes_out;
+}
+
 // ---------------------------------------------------------------------------
 // JWT (HS256) verification — mirrors utils/security.py verify_jwt +
 // Guard.check and the reference's maybeCheckJwtAuthorization
@@ -1057,6 +1078,7 @@ void simple_response_x(Conn* c, int code, const char* text, bool keep_alive,
   c->out.append(head, n);
   c->out.append(text, body_len);
   if (!keep_alive) c->want_close = true;
+  count_resp(code, body_len);
 }
 
 void simple_response(Conn* c, int code, const char* text, bool keep_alive) {
@@ -1364,6 +1386,7 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
                         r.keep_alive ? "" : "Connection: close\r\n");
       c->out.append(h416, hn);
       if (!r.keep_alive) c->want_close = true;
+      count_resp(416, 0);
       return true;
     }
     partial = rc == 1;
@@ -1405,6 +1428,8 @@ bool handle_get(Conn* c, const Request& r, uint32_t vid, uint64_t key,
   if (!is_head)
     c->out.append((const char*)data + start_i, (size_t)(end_i - start_i + 1));
   n_fast_get++;
+  count_resp(partial ? 206 : 200,
+             is_head ? 0 : (int64_t)(end_i - start_i + 1));
   return true;
 }
 
@@ -1488,6 +1513,8 @@ void respond_post_ok(Conn* c, bool keep_alive, int64_t body_len,
   c->out.append(resp, n);
   c->out.append(jbody, bl);
   if (!keep_alive) c->want_close = true;
+  count_resp(201, bl);
+  n_front_bytes_in += body_len;
 }
 
 void respond_delete_ok(Conn* c, bool keep_alive, int64_t reclaimed) {
@@ -1502,6 +1529,7 @@ void respond_delete_ok(Conn* c, bool keep_alive, int64_t reclaimed) {
   c->out.append(resp, n);
   c->out.append(jbody, bl);
   if (!keep_alive) c->want_close = true;
+  count_resp(202, bl);
 }
 
 // POST fast path: plain body, no metadata, writable local volume.
@@ -4369,6 +4397,19 @@ void dp_http_stats(int64_t* out) {
   out[5] = n_repl_post.load();
   out[6] = n_jwt_reject.load();
   out[7] = n_fanout_fail.load();
+}
+
+// out[0..5] = 2xx, 3xx, 4xx, 5xx responses written by the native
+// front itself, payload bytes in (uploads), payload bytes out (served
+// bodies). Monotonic snapshot for the host's /metrics merge
+// (native_front_requests_total{code} / native_front_bytes_total).
+void dp_front_stats(int64_t* out) {
+  out[0] = n_front_2xx.load();
+  out[1] = n_front_3xx.load();
+  out[2] = n_front_4xx.load();
+  out[3] = n_front_5xx.load();
+  out[4] = n_front_bytes_in.load();
+  out[5] = n_front_bytes_out.load();
 }
 
 // ---------------------------------------------------------------------------

@@ -94,6 +94,13 @@ def _load() -> ctypes.CDLL:
         lib.dp_export.restype = ctypes.c_int64
         lib.dp_http_stats.argtypes = [i64p]
         lib.dp_http_stats.restype = None
+        try:
+            # missing from prebuilt .so files older than the front
+            # counters — front_stats() then reports None
+            lib.dp_front_stats.argtypes = [i64p]
+            lib.dp_front_stats.restype = None
+        except AttributeError:
+            pass
         lib.dp_bench.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
                                  ctypes.c_int, ctypes.c_char_p,
                                  ctypes.c_char_p, ctypes.c_int64,
@@ -375,6 +382,19 @@ class DataPlane:
                 "proxied": out[2], "errors": out[3],
                 "fast_delete": out[4], "repl_post": out[5],
                 "jwt_reject": out[6], "fanout_fail": out[7]}
+
+    def front_stats(self) -> dict | None:
+        """Native-front response/byte counters (monotonic snapshot for
+        the host's /metrics merge); None when the loaded library
+        predates dp_front_stats."""
+        fn = getattr(self._lib, "dp_front_stats", None)
+        if fn is None:
+            return None
+        out = (ctypes.c_int64 * 6)()
+        fn(out)
+        return {"2xx": int(out[0]), "3xx": int(out[1]),
+                "4xx": int(out[2]), "5xx": int(out[3]),
+                "bytes_in": int(out[4]), "bytes_out": int(out[5])}
 
 
 class NativeNeedleMap:

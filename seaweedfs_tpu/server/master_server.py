@@ -58,7 +58,10 @@ class MasterServer:
                  repair_interval: float = 10.0,
                  repair_concurrency: int = 2,
                  repair_max_attempts: int = 5,
-                 repair_grace: float = 0.0):
+                 repair_grace: float = 0.0,
+                 trace_store_size: int = 2048,
+                 scrape_interval: float = 10.0,
+                 otlp_url: str = ""):
         self.topo = Topology(volume_size_limit, pulse_seconds)
         self.default_replication = default_replication
         if sequencer == "memory" and peers:
@@ -105,6 +108,15 @@ class MasterServer:
             self, enabled=repair_enabled, interval=repair_interval,
             concurrency=repair_concurrency,
             max_attempts=repair_max_attempts, grace=repair_grace)
+        # cluster observability plane (master/collector.py): span
+        # collector + OTLP export + metrics federation
+        from ..master.collector import MetricsFederator, SpanCollector
+
+        self.collector = SpanCollector(max_traces=trace_store_size)
+        self.federator = MetricsFederator(self, interval=scrape_interval)
+        self.otlp_url = otlp_url
+        self._obs_stop: asyncio.Event | None = None
+        self._obs_tasks: list[asyncio.Task] = []
         self.app = self._build_app()
 
     async def _start_admin_scripts(self, app) -> None:
@@ -215,6 +227,10 @@ class MasterServer:
             web.get("/vol/status", self.handle_vol_status),
             web.get("/dir/status", self.handle_dir_status),
             web.get("/cluster/status", self.handle_cluster_status),
+            web.get("/cluster/traces", self.handle_cluster_traces),
+            web.post("/cluster/traces/push",
+                     self.handle_cluster_traces_push),
+            web.get("/cluster/metrics", self.handle_cluster_metrics),
             web.get("/cluster/leader", self.handle_cluster_leader),
             web.post("/cluster/announce", self.handle_cluster_announce),
             web.get("/cluster/nodes", self.handle_cluster_nodes),
@@ -245,6 +261,8 @@ class MasterServer:
         app.on_shutdown.append(_close_ws_clients)
         app.on_startup.append(self.watchdog.start)
         app.on_cleanup.append(self.watchdog.stop)
+        app.on_startup.append(self._start_observability)
+        app.on_cleanup.append(self._stop_observability)
         if self.admin_scripts:
             app.on_startup.append(self._start_admin_scripts)
             app.on_cleanup.append(self._stop_admin_scripts)
@@ -578,7 +596,150 @@ class MasterServer:
             "RepairQueueDepth": (self.watchdog._queue.qsize() +
                                  len(self.watchdog._inflight)),
             "RepairEnabled": self.watchdog.enabled,
+            "Observability": {
+                **self.collector.observability(),
+                "Federation": self.federator.observability(),
+            },
         })
+
+    # ------------------------------------------------------------------
+    # observability plane (master/collector.py)
+    # ------------------------------------------------------------------
+    def _self_instance(self) -> str:
+        """This master's instance label (host:port once the runner has
+        bound the listen socket, a stable placeholder before that)."""
+        url = self.admin_scripts_url
+        if url:
+            return url.split("://", 1)[-1].rstrip("/")
+        return "master"
+
+    def _local_span_sink(self, rec: dict) -> None:
+        """tracing sink: the master's own spans feed the collector
+        in-process (same sampling verdict as remote pushers)."""
+        if not tracing.sample_decision(rec.get("trace_id", "")):
+            return
+        self.collector.add_spans(self._self_instance(),
+                                 rec.get("service") or "master", [rec])
+
+    async def _start_observability(self, app) -> None:
+        tracing.add_sink(self._local_span_sink)
+        self._obs_stop = asyncio.Event()
+        self._obs_tasks = [
+            asyncio.create_task(self.federator.run(self._obs_stop))]
+        if self.otlp_url:
+            self._obs_tasks.append(
+                asyncio.create_task(self._otlp_push_loop(self._obs_stop)))
+
+    async def _stop_observability(self, app) -> None:
+        tracing.remove_sink(self._local_span_sink)
+        if self._obs_stop is not None:
+            self._obs_stop.set()
+        for task in self._obs_tasks:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._obs_tasks = []
+
+    async def _otlp_push_loop(self, stop: asyncio.Event) -> None:
+        """-trace.otlpUrl: POST OTLP/JSON batches of settled traces to
+        an external collector (Jaeger/Tempo OTLP HTTP endpoint)."""
+        from ..rpc import httpclient
+        from ..utils import glog, metrics
+
+        url = self.otlp_url
+        if not url.startswith("http"):
+            url = "http://" + url
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), 5.0)
+                break
+            except asyncio.TimeoutError:
+                pass
+            ids = self.collector.drain_otlp_pending()
+            if not ids:
+                continue
+            payload = self.collector.to_otlp(trace_ids=ids)
+            n_spans = sum(
+                len(ss["spans"])
+                for rs in payload["resourceSpans"]
+                for ss in rs["scopeSpans"])
+
+            def post():
+                return httpclient.session().post(
+                    url, json=payload,
+                    headers={"Content-Type": "application/json"},
+                    timeout=(5.0, 10.0))
+
+            try:
+                r = await asyncio.to_thread(post)
+                if r.status_code < 300:
+                    metrics.counter_add("otlp_spans_exported_total",
+                                        n_spans)
+                else:
+                    metrics.counter_add("otlp_export_failures_total", 1)
+            except Exception as e:
+                metrics.counter_add("otlp_export_failures_total", 1)
+                glog.v(2, "otlp export to %s failed: %s", url, e)
+
+    async def handle_cluster_traces(self, req: web.Request) -> web.Response:
+        """GET /cluster/traces — cross-process trace store.
+        ?trace_id= for one stitched tree, ?format=otlp for OTLP/JSON,
+        ?limit= for the list size."""
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        trace_id = req.query.get("trace_id", "")
+        if req.query.get("format") == "otlp":
+            ids = [trace_id] if trace_id else None
+            return web.json_response(
+                self.collector.to_otlp(trace_ids=ids, limit=limit))
+        if trace_id:
+            tree = self.collector.get_trace(trace_id)
+            if tree is None:
+                return json_error(f"trace {trace_id} not found",
+                                  status=404)
+            return web.json_response(tree)
+        return web.json_response(
+            {"traces": self.collector.list_traces(limit=limit),
+             "observability": self.collector.observability()})
+
+    async def handle_cluster_traces_push(self, req: web.Request
+                                         ) -> web.Response:
+        """POST /cluster/traces/push — one SpanPusher batch:
+        {"instance", "service", "spans": [...], "dropped": n}."""
+        try:
+            d = await req.json()
+        except Exception:
+            return json_error("push body must be JSON", status=400)
+        spans = d.get("spans")
+        if not isinstance(spans, list):
+            return json_error("push requires a spans list", status=400)
+        accepted = self.collector.add_spans(
+            str(d.get("instance") or req.remote or "unknown"),
+            str(d.get("service") or "unknown"),
+            [s for s in spans if isinstance(s, dict)],
+            dropped=int(d.get("dropped") or 0))
+        return json_ok({"accepted": accepted})
+
+    async def handle_cluster_metrics(self, req: web.Request
+                                     ) -> web.Response:
+        """GET /cluster/metrics — the federated, instance-labeled
+        exposition of every registered node plus this master."""
+        # first-hit freshness: any target the loop hasn't scraped yet
+        # gets one on-demand sweep so a new node shows up immediately
+        targets = self.federator.targets()
+        with self.federator._lock:
+            missing = [t for t in targets
+                       if t not in self.federator._scraped]
+        if missing:
+            await asyncio.to_thread(self.federator.scrape_once)
+        return web.Response(
+            text=self.federator.merged(
+                self_instance=self._self_instance()),
+            content_type="text/plain")
 
     async def handle_debug_repair(self, req: web.Request) -> web.Response:
         """Watchdog state: deficit sets, queue, in-flight and recent

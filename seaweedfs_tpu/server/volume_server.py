@@ -1914,6 +1914,9 @@ class VolumeServer:
         out = {"Version": "seaweedfs-tpu", **hb}
         if self.dp is not None:
             out["native_dataplane"] = self.dp.http_stats()
+            front = self.dp.front_stats()
+            if front is not None:
+                out["native_front"] = front
         return web.json_response(out)
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
@@ -1954,8 +1957,34 @@ class VolumeServer:
                           self._upload_flight.value)
         metrics.gauge_set("volume_server_in_flight_download_bytes",
                           self._download_flight.value)
-        return web.Response(text=metrics.render(),
-                            content_type="text/plain")
+        text = metrics.render()
+        text += self._native_front_exposition()
+        return web.Response(text=text, content_type="text/plain")
+
+    def _native_front_exposition(self) -> str:
+        """Native data-plane front counters appended to /metrics.
+        These are monotonic snapshots owned by the C library, so they
+        render directly instead of being pumped through the registry
+        (counter_add would double-count on every scrape)."""
+        if self.dp is None:
+            return ""
+        try:
+            st = self.dp.front_stats()
+        except Exception:
+            return ""
+        if st is None:
+            return ""
+        lines = ["# TYPE native_front_requests_total counter"]
+        for code in ("2xx", "3xx", "4xx", "5xx"):
+            lines.append(
+                f'native_front_requests_total{{code="{code}"}} '
+                f'{st[code]}')
+        lines.append("# TYPE native_front_bytes_total counter")
+        for direction in ("in", "out"):
+            lines.append(
+                f'native_front_bytes_total{{direction="{direction}"}} '
+                f'{st["bytes_" + direction]}')
+        return "\n".join(lines) + "\n"
 
     async def handle_ui(self, req: web.Request) -> web.Response:
         """Status page (server/volume_server_ui/ equivalent)."""

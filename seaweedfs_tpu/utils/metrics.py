@@ -128,14 +128,17 @@ def reset() -> None:
 
 _push_thread = None
 _push_stop = None
+_push_lock = threading.Lock()  # start/stop may race across threads
 
 
 def start_push(gateway_url: str, job: str,
                interval_seconds: float = 15.0,
                instance: str = "") -> None:
+    """Start the background pusher (idempotent while one is alive).
+    Each iteration renders the LIVE registry, so counters registered
+    after start_push (the collector/federation families included) ride
+    along without a restart."""
     global _push_thread, _push_stop
-    if _push_thread is not None and _push_thread.is_alive():
-        return
     import threading as _th
 
     import requests as _rq
@@ -146,29 +149,35 @@ def start_push(gateway_url: str, job: str,
     url += f"/metrics/job/{job}"
     if instance:
         url += f"/instance/{instance}"
-    stop = _th.Event()  # captured locally: stop_push nulling the global
-                        # must not crash a loop mid-iteration
 
-    def loop():
-        while not stop.wait(interval_seconds):
-            try:
-                _rq.put(url, data=render().encode(),
-                        headers={"Content-Type": "text/plain"},
-                        timeout=10)
-            except _rq.RequestException:
-                pass  # gateway outages must never hurt the server
+    with _push_lock:
+        if _push_thread is not None and _push_thread.is_alive():
+            return
+        stop = _th.Event()  # captured locally: stop_push nulling the
+                            # global must not crash a loop mid-iteration
 
-    _push_stop = stop
-    _push_thread = _th.Thread(target=loop, daemon=True)
-    _push_thread.start()
+        def loop():
+            while not stop.wait(interval_seconds):
+                try:
+                    _rq.put(url, data=render().encode(),
+                            headers={"Content-Type": "text/plain"},
+                            timeout=10)
+                except _rq.RequestException:
+                    pass  # gateway outages must never hurt the server
+
+        _push_stop = stop
+        _push_thread = _th.Thread(target=loop, daemon=True)
+        _push_thread.start()
 
 
 def stop_push(timeout: float = 5.0) -> None:
-    """Signal the pusher and join it (bounded); safe to start_push again."""
+    """Signal the pusher and join it (bounded); safe to start_push
+    again — and a no-op when called before any start_push."""
     global _push_thread, _push_stop
-    thread, stop = _push_thread, _push_stop
-    _push_thread = None
-    _push_stop = None
+    with _push_lock:
+        thread, stop = _push_thread, _push_stop
+        _push_thread = None
+        _push_stop = None
     if stop is not None:
         stop.set()
     if thread is not None:

@@ -35,21 +35,69 @@ _lock = threading.Lock()
 _buffer_size = 1024
 _spans: deque = deque(maxlen=_buffer_size)
 _slow_threshold = 1.0  # seconds; <= 0 disables the slow-request log
+_sample_rate = 1.0  # head-sampling fraction for the cluster collector
+_sinks: list = []  # finished-span observers (cluster span pusher)
 
 
 def configure(slow_threshold: float | None = None,
-              buffer_size: int | None = None) -> None:
-    """Adjust tracing knobs (CLI: -trace.slowThreshold/-trace.bufferSize).
+              buffer_size: int | None = None,
+              sample_rate: float | None = None) -> None:
+    """Adjust tracing knobs (CLI: -trace.slowThreshold/-trace.bufferSize/
+    -trace.sample).
 
     Resizing the ring keeps the most recent spans.
     """
-    global _slow_threshold, _buffer_size, _spans
+    global _slow_threshold, _buffer_size, _spans, _sample_rate
     with _lock:
         if slow_threshold is not None:
             _slow_threshold = float(slow_threshold)
         if buffer_size is not None and int(buffer_size) != _buffer_size:
             _buffer_size = max(1, int(buffer_size))
             _spans = deque(_spans, maxlen=_buffer_size)
+        if sample_rate is not None:
+            _sample_rate = min(1.0, max(0.0, float(sample_rate)))
+
+
+def sample_rate() -> float:
+    return _sample_rate
+
+
+def sample_decision(trace_id: str, rate: float | None = None) -> bool:
+    """Deterministic head-sampling verdict for one trace.
+
+    Hashes the trace-id's low 32 bits against the rate so every process
+    reaches the same keep/drop decision without coordination — a kept
+    trace is kept on all hops and stitches completely on the master.
+    Malformed ids are kept (losing them would hide bugs, not traffic).
+    """
+    r = _sample_rate if rate is None else rate
+    if r >= 1.0:
+        return True
+    if r <= 0.0:
+        return False
+    try:
+        bucket = int(trace_id[-8:], 16)
+    except (ValueError, TypeError):
+        return True
+    return bucket < r * 0x100000000
+
+
+# -- span sinks ---------------------------------------------------------
+# Observers called with each finished span record (a plain dict); the
+# cluster span pusher registers here. Called outside the ring lock and
+# exceptions are swallowed: a broken sink must never fail a request.
+
+
+def add_sink(fn) -> None:
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    with _lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
 
 
 def reset() -> None:
@@ -192,6 +240,11 @@ def _finish(rec: dict) -> None:
              "handler": rec["name"] or "unknown"})
     if slow:
         _log_slow(rec)
+    for sink in list(_sinks):
+        try:
+            sink(rec)
+        except Exception:
+            pass
 
 
 def _span_tree(trace_id: str) -> list[dict]:
@@ -240,7 +293,9 @@ def traces_json(limit: int = 20) -> list[dict]:
 
 # -- aiohttp glue (lazy imports: core stays stdlib-importable) ----------
 
-_SKIP_PATHS = {"/metrics", "/debug/traces"}
+_SKIP_PATHS = {"/metrics", "/status", "/healthz", "/debug/traces",
+               "/cluster/traces", "/cluster/traces/push",
+               "/cluster/metrics"}
 
 
 def aiohttp_middleware(service: str):
