@@ -625,6 +625,172 @@ def bench_filer_streaming(rng) -> dict:
     return out
 
 
+def bench_mesh_sweep(argv: list[str]) -> int:
+    """`python bench.py mesh-sweep [--devices 8] [--size-mb 64]
+    [--depth 2] [--codes 10.4,28.4] [--out MULTICHIP_r06.json]`
+
+    Scaling-efficiency table for the `-ec.backend=mesh` codec: encode
+    and rebuild streaming throughput at 1..N devices (powers of two),
+    with efficiency vs linear scaling from the 1-device mesh rate and
+    a shaped transfer-only ceiling at N (same blocks over the link,
+    kernel replaced by a free row slice). Falls back to a virtual CPU
+    mesh (XLA host-platform device override, the multichip dryrun's
+    setup) when fewer than N real chips are visible, and exits 0 with
+    a {"skipped": true} line when even that cannot provide 2 devices —
+    the CI-safe behaviour for single-device hosts."""
+    import os
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    n_target = int(opt("--devices", "8"))
+    size = int(float(opt("--size-mb", "64")) * (1 << 20))
+    depth = int(opt("--depth", "2"))
+    codes = [tuple(int(x) for x in c.split("."))
+             for c in opt("--codes", "10.4,28.4").split(",")]
+    out_path = opt("--out", "MULTICHIP_r06.json")
+
+    # XLA_FLAGS is consulted when the CPU backend is created, not at
+    # jax import, so setting it here + re-resolving backends suffices
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={n_target}"
+        ).strip()
+    import jax
+
+    if len(jax.devices()) < n_target:
+        jax.config.update("jax_platforms", "cpu")
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    n_have = len(jax.devices())
+    if n_have < 2:
+        print(json.dumps({"metric": "mesh_sweep", "skipped": True,
+                          "reason": f"single-device host ({n_have})"}),
+              flush=True)
+        return 0
+    n = min(n_target, n_have)
+
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.ec import probe
+    from seaweedfs_tpu.ops import rs_matrix
+    from seaweedfs_tpu.ops.codec_mesh import MeshCodec
+    from seaweedfs_tpu.parallel.mesh import make_mesh
+
+    counts = []
+    c = 1
+    while c <= n:
+        counts.append(c)
+        c *= 2
+    if counts[-1] != n:
+        counts.append(n)
+    n_blocks = depth + 2
+
+    def xfer_ceiling(codec: MeshCodec, k: int, m: int) -> float:
+        """Shaped transfer-only twin at this codec's device count: the
+        same (k, w) blocks scatter H2D and an (vol, m, per) slice
+        gathers D2H, kernel replaced by a free row slice."""
+        slice_rows = jax.jit(lambda x: x[:, :m])
+        w = max(1, size // k)
+        rng = np.random.default_rng(99)
+        blocks = [rng.integers(0, 256, (k, w), dtype=np.uint8)
+                  for _ in range(n_blocks)]
+
+        def up(b):
+            batched, _ = codec._to_batched(b)
+            dev = codec._h2d(batched)
+            dev.block_until_ready()
+            return slice_rows(dev)
+
+        def down(fut):
+            return np.asarray(fut.result())
+
+        up(blocks[0])  # warm the compile outside the timed run
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(1) as up_ex, \
+                ThreadPoolExecutor(1) as down_ex:
+            pending: deque = deque()
+            for b in blocks:
+                pending.append(
+                    down_ex.submit(down, up_ex.submit(up, b)))
+                while len(pending) >= max(1, depth):
+                    pending.popleft().result()
+            while pending:
+                pending.popleft().result()
+        return n_blocks * k * w / (time.perf_counter() - t0) / 1e6
+
+    platform = jax.devices()[0].platform
+    result: dict = {"metric": "mesh_sweep", "skipped": False,
+                    "n_devices": n, "platform": platform,
+                    "size_mb": size >> 20,
+                    "depth": depth, "blocks": n_blocks, "codes": {}}
+    if platform == "cpu":
+        # the virtual mesh timeshares one host's cores: it proves the
+        # sharded path end-to-end but CANNOT show chip scaling —
+        # efficiency columns on this platform are not a perf claim
+        result["note"] = ("virtual CPU mesh (device count forced via "
+                          "XLA host-platform override); correctness/"
+                          "plumbing run, not a scaling measurement")
+    for k, m in codes:
+        enc_coef = rs_matrix.parity_rows(k, m)
+        missing = list(range(m))
+        present = [i for i in range(k + m) if i not in missing][:k]
+        rb_coef, _inputs = rs_matrix.recovery_rows(k, m, present,
+                                                   missing)
+        rows = []
+        base: dict[str, float] = {}
+        for ndev in counts:
+            codec = MeshCodec(mesh=make_mesh(ndev))
+            row: dict = {"devices": ndev,
+                         "mesh": {"vol": codec.vol, "col": codec.col}}
+            for op, coef in (("encode", enc_coef),
+                             ("rebuild", rb_coef)):
+                # warm pass compiles this (code, device-count) shape so
+                # the timed row isn't billed for XLA compile
+                probe._measure_e2e_row(codec, coef, min(size, 1 << 20),
+                                       1, 1, k=k, m=m)
+                rate = probe._measure_e2e_row(codec, coef, size, depth,
+                                              n_blocks, k=k, m=m)
+                row[f"{op}_mbps"] = round(rate, 1)
+                if ndev == 1:
+                    base[op] = rate
+                elif base.get(op):
+                    row[f"{op}_efficiency"] = round(
+                        rate / (ndev * base[op]), 3)
+            if ndev == counts[-1]:
+                ceil = xfer_ceiling(codec, k, m)
+                row["xfer_ceiling_mbps"] = round(ceil, 1)
+                if ceil > 0:
+                    row["rebuild_vs_ceiling"] = round(
+                        row["rebuild_mbps"] / ceil, 3)
+            rows.append(row)
+            log(f"mesh-sweep rs({k},{m}) x{ndev}: " + " ".join(
+                f"{key}={val}" for key, val in row.items()
+                if key not in ("devices", "mesh")))
+        result["codes"][f"{k}.{m}"] = rows
+
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    largest = result["codes"][f"{codes[0][0]}.{codes[0][1]}"][-1]
+    print(json.dumps({
+        "metric": "mesh_sweep",
+        "value": largest.get("rebuild_mbps"),
+        "unit": "MB/s",
+        "devices": n,
+        "rebuild_efficiency": largest.get("rebuild_efficiency"),
+        "rebuild_vs_ceiling": largest.get("rebuild_vs_ceiling"),
+        "out": out_path,
+    }), flush=True)
+    return 0
+
+
 def bench_hedge_sweep(argv: list[str]) -> int:
     """`python bench.py hedge-sweep [--lag 0.15] [--objects 16]
     [--reads 3] [--delays 0.02,0.05,0.1,0.2,0.35]`
@@ -887,4 +1053,6 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "hedge-sweep":
         sys.exit(bench_hedge_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh-sweep":
+        sys.exit(bench_mesh_sweep(sys.argv[2:]))
     main()

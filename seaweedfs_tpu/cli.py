@@ -207,7 +207,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-mserver", default="127.0.0.1:9333")
     p.add_argument("-dataCenter", default="DefaultDataCenter")
     p.add_argument("-rack", default="DefaultRack")
-    p.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    p.add_argument("-ec.backend", dest="ec_backend", default="auto",
+                   help="erasure-coding codec: auto (measured-curve "
+                        "router) | native | numpy | jax | pallas | "
+                        "mesh (all local devices)")
+    p.add_argument("-ec.mesh.devices", dest="ec_mesh_devices",
+                   type=int, default=0,
+                   help="devices the mesh codec spans "
+                        "(0 = all local devices)")
+    p.add_argument("-ec.mesh.col", dest="ec_mesh_col", type=int,
+                   default=0,
+                   help="column-parallel axis of the mesh codec's "
+                        "(vol, col) grid; must divide the device "
+                        "count (0 = heuristic)")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact | btree "
                         "(on-disk index for RAM-constrained servers)")
@@ -251,7 +263,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-filer.store", dest="filer_store", default="sqlite")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
-    p.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    p.add_argument("-ec.backend", dest="ec_backend", default="auto",
+                   help="erasure-coding codec: auto (measured-curve "
+                        "router) | native | numpy | jax | pallas | "
+                        "mesh (all local devices)")
+    p.add_argument("-ec.mesh.devices", dest="ec_mesh_devices",
+                   type=int, default=0,
+                   help="devices the mesh codec spans "
+                        "(0 = all local devices)")
+    p.add_argument("-ec.mesh.col", dest="ec_mesh_col", type=int,
+                   default=0,
+                   help="column-parallel axis of the mesh codec's "
+                        "(vol, col) grid; must divide the device "
+                        "count (0 = heuristic)")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact | btree "
                         "(on-disk index for RAM-constrained servers)")
@@ -547,6 +571,14 @@ def main(argv: list[str] | None = None) -> int:
     _tracing.configure(slow_threshold=args.trace_slow_threshold,
                        buffer_size=args.trace_buffer_size,
                        sample_rate=args.trace_sample)
+    # mesh shape knobs travel by env so the codec registry (and any
+    # worker process it spawns) sees them without plumbing args through
+    # every Store constructor
+    if getattr(args, "ec_mesh_devices", 0):
+        os.environ["SEAWEEDFS_TPU_EC_MESH_DEVICES"] = str(
+            args.ec_mesh_devices)
+    if getattr(args, "ec_mesh_col", 0):
+        os.environ["SEAWEEDFS_TPU_EC_MESH_COL"] = str(args.ec_mesh_col)
     from .utils import faults as _faults
     from .utils import retry as _retry
 

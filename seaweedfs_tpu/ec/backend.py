@@ -93,7 +93,7 @@ def available_backend_names() -> list[str]:
     lookup), without constructing instances or importing jax."""
     import importlib.util
 
-    deps = {"numpy": "numpy", "jax": "jax",
+    deps = {"numpy": "numpy", "jax": "jax", "mesh": "jax",
             "pallas": "seaweedfs_tpu.ops.codec_pallas",
             "native": "seaweedfs_tpu.ops.codec_native"}
     out = []
@@ -129,6 +129,13 @@ def _register_builtins() -> None:
         return codec_pallas.PallasCodec()
 
     register("pallas", _pallas_factory)
+
+    def _mesh_factory():
+        from ..ops import codec_mesh
+
+        return codec_mesh.MeshCodec()
+
+    register("mesh", _mesh_factory)
     register("auto", AutoCodec)
 
 
@@ -181,27 +188,35 @@ def _env_override() -> str | None:
 
 
 def _decide(curve: dict, nbytes: int) -> str:
-    """Router core: the measured device e2e rate interpolated at this
-    request size versus the measured CPU-codec rate — the device
-    backend is only ever chosen when the *measured end-to-end* feed
-    beats the CPU, never from a derived estimate."""
+    """Router core: the measured e2e rates interpolated at this
+    request size versus the measured CPU-codec rate — a device
+    backend (single-chip or mesh) is only ever chosen when its
+    *measured end-to-end* feed beats the CPU, never from a derived
+    estimate. Three-way since the mesh codec landed: the mesh rows of
+    the same sweep compete against the single-chip rows, so small
+    requests that can't amortize the scatter stay single-chip while
+    bulk streams ride all devices."""
     from . import probe
 
     cpu_name = curve.get("cpu_backend") or _probe_cpu_backend()
-    dev_rate = probe.e2e_mbps_at(curve, nbytes)
-    if dev_rate is None:
-        return cpu_name
     cpu_rate = curve.get("cpu_mbps")
-    if cpu_rate is not None and dev_rate <= cpu_rate:
-        return cpu_name
-    name = curve.get("device_backend")
-    if not name:
-        return cpu_name
-    try:
-        get_backend(name)
-        return name
-    except KeyError:
-        return cpu_name
+    candidates = []
+    dev_rate = probe.e2e_mbps_at(curve, nbytes)
+    dev_name = curve.get("device_backend")
+    if dev_rate is not None and dev_name:
+        candidates.append((dev_rate, dev_name))
+    mesh_rate = probe.mesh_mbps_at(curve, nbytes)
+    if mesh_rate is not None:
+        candidates.append((mesh_rate, "mesh"))
+    for rate, name in sorted(candidates, reverse=True):
+        if cpu_rate is not None and rate <= cpu_rate:
+            continue
+        try:
+            get_backend(name)
+            return name
+        except KeyError:
+            continue
+    return cpu_name
 
 
 def choose_backend_for_size(nbytes: int) -> str:
@@ -221,12 +236,18 @@ def choose_backend_for_size(nbytes: int) -> str:
 def pipeline_depth_for(nbytes: int) -> int:
     """Streaming-pipeline depth the measured curve recommends for
     blocks of `nbytes` (2 when nothing is measured — the classic
-    double buffer)."""
+    double buffer). When the router would send this size to the mesh,
+    the depth comes from the mesh rows — the scatter across N devices
+    has its own overlap sweet spot."""
     from . import probe
 
     curve = probe.peek()
     if curve is None:
         return 2
+    env = _env_override()
+    choice = env if env is not None else _decide(curve, nbytes)
+    if choice == "mesh":
+        return probe.mesh_depth_at(curve, nbytes)
     return probe.depth_at(curve, nbytes)
 
 
@@ -284,16 +305,40 @@ def router_buckets(curve: dict) -> list[dict]:
     out = []
     for size in probe.SWEEP_SIZES:
         dev_rate = probe.e2e_mbps_at(curve, size)
+        mesh_rate = probe.mesh_mbps_at(curve, size)
+        backend = env if env is not None else _decide(curve, size)
+        depth = (probe.mesh_depth_at(curve, size) if backend == "mesh"
+                 else probe.depth_at(curve, size))
         out.append({
             "size_mb": size >> 20,
-            "backend": env if env is not None else _decide(curve, size),
+            "backend": backend,
             "pinned_by_env": env is not None,
             "device_e2e_mbps": (round(dev_rate, 2)
                                 if dev_rate is not None else None),
+            "mesh_e2e_mbps": (round(mesh_rate, 2)
+                              if mesh_rate is not None else None),
             "cpu_mbps": curve.get("cpu_mbps"),
-            "depth": probe.depth_at(curve, size),
+            "depth": depth,
         })
     return out
+
+
+def mesh_geometry() -> dict | None:
+    """Mesh codec geometry for /debug/ec and /cluster/status: the live
+    instance's shape when one exists (never constructs one — a debug
+    GET must not pay device init), else the configured knobs."""
+    inst = _instances.get("mesh")
+    if inst is not None and hasattr(inst, "describe"):
+        geo = dict(inst.describe())
+        geo["state"] = "active"
+        return geo
+    try:
+        from ..parallel import mesh as pmesh
+
+        n_devices, col = pmesh.mesh_config()
+    except Exception:  # jax absent: no mesh to describe
+        return None
+    return {"state": "unbuilt", "devices": n_devices, "col": col}
 
 
 def probe_snapshot() -> dict:
@@ -312,6 +357,7 @@ def probe_snapshot() -> dict:
         "cpu_backend": _probe_cpu_backend(),
         "cache_path": probe.cache_path(),
         "cache_ttl_s": probe.cache_ttl_s(),
+        "mesh": mesh_geometry(),
     }
     curve = probe.peek()
     if curve is None:

@@ -32,8 +32,9 @@ import time as _time
 import numpy as np
 
 # probe schema version: bump when the sweep method or JSON layout
-# changes so stale caches self-invalidate
-PROBE_VERSION = 1
+# changes so stale caches self-invalidate (2: mesh rows + visible
+# device count in the fingerprint)
+PROBE_VERSION = 2
 
 SWEEP_SIZES = (1 << 20, 4 << 20, 16 << 20, 64 << 20)
 SWEEP_DEPTHS = (1, 2, 4)
@@ -88,9 +89,29 @@ def _device() -> tuple[str, str, int] | None:
             len(jax.devices()))
 
 
+def _visible_device_count() -> int | None:
+    """Total visible jax devices on ANY platform (None when jax is
+    absent). The accelerator-only `_device()` is not enough for the
+    fingerprint: on a CPU-only host it returns None regardless of how
+    many virtual devices are configured, so a curve swept with 1
+    device would survive the host growing to 8 — and a mesh curve
+    would keep routing after devices vanish."""
+    import importlib.util
+
+    if importlib.util.find_spec("jax") is None:
+        return None
+    import jax
+
+    try:
+        return len(jax.devices())
+    except Exception:
+        return None
+
+
 def host_fingerprint() -> dict:
     """What must match for a cached curve to be trusted: same machine,
-    same device behind the same jax, same probe schema."""
+    same visible device set behind the same jax, same mesh shape knobs,
+    same probe schema."""
     import platform as _plat
 
     fp = {"probe_version": PROBE_VERSION,
@@ -99,6 +120,13 @@ def host_fingerprint() -> dict:
     dev = _device()
     fp["device"] = ({"platform": dev[0], "kind": dev[1], "count": dev[2]}
                     if dev else None)
+    fp["device_count"] = _visible_device_count()
+    try:
+        from ..parallel import mesh as pmesh
+
+        fp["mesh_config"] = list(pmesh.mesh_config())
+    except Exception:
+        fp["mesh_config"] = None
     try:
         import jax
 
@@ -127,21 +155,23 @@ def measure_cpu_mbps(backend) -> float:
 
 
 def _measure_e2e_row(codec, coef, size: int, depth: int,
-                     n_blocks: int) -> float:
+                     n_blocks: int, k: int = _K, m: int = _M) -> float:
     """Pipelined e2e MB/s at one (size, depth): n_blocks distinct
     (k, size/k) blocks through the staged streaming pipeline; rate is
-    input bytes / wall from first pread to last yield."""
-    w = max(1, size // _K)
+    input bytes / wall from first pread to last yield. k/m default to
+    the production RS(10,4) shape; the mesh rows and wide-code bench
+    pass their own."""
+    w = max(1, size // k)
     rng = np.random.default_rng(size ^ depth)
-    blocks = [rng.integers(0, 256, (_K, w), dtype=np.uint8)
+    blocks = [rng.integers(0, 256, (k, w), dtype=np.uint8)
               for _ in range(n_blocks)]
     t0 = _time.perf_counter()
     got = 0
     for out in codec.coded_matmul_stream(coef, iter(blocks), depth=depth):
         got += 1
-        assert out.shape == (_M, w)
+        assert out.shape == (m, w)
     assert got == n_blocks
-    return n_blocks * _K * w / (_time.perf_counter() - t0) / 1e6
+    return n_blocks * k * w / (_time.perf_counter() - t0) / 1e6
 
 
 _slice_rows = None
@@ -315,8 +345,77 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
             except Exception as e:  # pragma: no cover - keep sweeping
                 row["error"] = repr(e)
             curve["rows"].append(row)
+
+    # mesh rows: the same protocol against the sharded codec when more
+    # than one device is visible — the mesh's scatter/gather overhead
+    # is real, so its curve is measured, never derived from the
+    # single-chip rows times N
+    if dev[2] > 1:
+        last_rate = _sweep_mesh_rows(curve, sizes, depths, remaining,
+                                     last_rate)
     curve["sweep_seconds"] = round(_time.perf_counter() - t_start, 2)
     return curve
+
+
+def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
+                     last_rate: float | None) -> float | None:
+    """size x depth rows for the mesh codec, appended to
+    curve["mesh_rows"] with the mesh geometry in curve["mesh"]; shares
+    the sweep's wall budget (`remaining`) so a slow link can't make the
+    probe cost 2x its cap."""
+    from . import backend as ecb
+    from ..ops import rs_matrix
+
+    try:
+        codec = ecb.get_backend("mesh")
+    except KeyError as e:
+        curve["mesh_error"] = repr(e)
+        return last_rate
+    curve["mesh"] = codec.describe()
+    coef = rs_matrix.parity_rows(_K, _M)
+
+    def affordable(nbytes: int) -> bool:
+        if last_rate:
+            return nbytes / 1e6 / last_rate <= remaining()
+        return remaining() > 0
+
+    try:
+        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2)
+    except Exception as e:  # pragma: no cover - probe must never fatal
+        curve["mesh_error"] = repr(e)
+        return last_rate
+
+    rows = curve.setdefault("mesh_rows", [])
+    for size in sorted(sizes):
+        if not affordable(2 * size):
+            for depth in depths:
+                rows.append({"size": int(size), "depth": int(depth),
+                             "skipped": "budget"})
+            continue
+        try:
+            _measure_e2e_row(codec, coef, size, 1, n_blocks=1)
+        except Exception as e:  # pragma: no cover - keep sweeping
+            for depth in depths:
+                rows.append({"size": int(size), "depth": int(depth),
+                             "error": repr(e)})
+            continue
+        for depth in depths:
+            n_blocks = depth + 2
+            row = {"size": int(size), "depth": int(depth),
+                   "blocks": n_blocks}
+            if not affordable(n_blocks * size):
+                row["skipped"] = "budget"
+                rows.append(row)
+                continue
+            try:
+                rate = _measure_e2e_row(codec, coef, size, depth,
+                                        n_blocks)
+                row["e2e_mbps"] = round(rate, 2)
+                last_rate = rate
+            except Exception as e:  # pragma: no cover - keep sweeping
+                row["error"] = repr(e)
+            rows.append(row)
+    return last_rate
 
 
 # ----------------------------------------------------------------------
@@ -405,15 +504,16 @@ def invalidate() -> None:
 # curve reading
 # ----------------------------------------------------------------------
 
-def measured_rows(curve: dict) -> list[dict]:
-    return [r for r in curve.get("rows", [])
+def measured_rows(curve: dict, key: str = "rows") -> list[dict]:
+    return [r for r in curve.get(key, [])
             if isinstance(r.get("e2e_mbps"), (int, float))]
 
 
-def best_by_size(curve: dict) -> list[tuple[int, float, int]]:
+def best_by_size(curve: dict,
+                 key: str = "rows") -> list[tuple[int, float, int]]:
     """[(size, best_e2e_mbps, best_depth)] ascending by size."""
     best: dict[int, tuple[float, int]] = {}
-    for r in measured_rows(curve):
+    for r in measured_rows(curve, key):
         size, rate, depth = int(r["size"]), float(r["e2e_mbps"]), \
             int(r["depth"])
         if size not in best or rate > best[size][0]:
@@ -421,12 +521,8 @@ def best_by_size(curve: dict) -> list[tuple[int, float, int]]:
     return [(s, best[s][0], best[s][1]) for s in sorted(best)]
 
 
-def e2e_mbps_at(curve: dict, nbytes: int) -> float | None:
-    """Device e2e MB/s the measured curve predicts for a request of
-    `nbytes`: piecewise-linear in log2(size) over the best depth per
-    measured size, clamped to the measured range (no extrapolated
-    optimism past the largest row that actually ran)."""
-    pts = best_by_size(curve)
+def _interp_at(pts: list[tuple[int, float, int]],
+               nbytes: int) -> float | None:
     if not pts:
         return None
     nbytes = max(1, int(nbytes))
@@ -439,10 +535,22 @@ def e2e_mbps_at(curve: dict, nbytes: int) -> float | None:
     return float(np.interp(np.log2(nbytes), xs, ys))
 
 
-def depth_at(curve: dict, nbytes: int) -> int:
-    """Pipeline depth of the nearest measured size (default 2 when the
-    curve is empty): what the feed should run for this request size."""
-    pts = best_by_size(curve)
+def e2e_mbps_at(curve: dict, nbytes: int) -> float | None:
+    """Device e2e MB/s the measured curve predicts for a request of
+    `nbytes`: piecewise-linear in log2(size) over the best depth per
+    measured size, clamped to the measured range (no extrapolated
+    optimism past the largest row that actually ran)."""
+    return _interp_at(best_by_size(curve), nbytes)
+
+
+def mesh_mbps_at(curve: dict, nbytes: int) -> float | None:
+    """Mesh-codec e2e MB/s at `nbytes` — same interpolation over the
+    mesh rows; None when no mesh was swept (single-device host)."""
+    return _interp_at(best_by_size(curve, "mesh_rows"), nbytes)
+
+
+def _nearest_depth(pts: list[tuple[int, float, int]],
+                   nbytes: int) -> int:
     if not pts:
         return 2
     nbytes = max(1, int(nbytes))
@@ -451,10 +559,22 @@ def depth_at(curve: dict, nbytes: int) -> int:
     return best[2]
 
 
+def depth_at(curve: dict, nbytes: int) -> int:
+    """Pipeline depth of the nearest measured size (default 2 when the
+    curve is empty): what the feed should run for this request size."""
+    return _nearest_depth(best_by_size(curve), nbytes)
+
+
+def mesh_depth_at(curve: dict, nbytes: int) -> int:
+    """Pipeline depth the mesh rows recommend at `nbytes` (2 when no
+    mesh row was measured)."""
+    return _nearest_depth(best_by_size(curve, "mesh_rows"), nbytes)
+
+
 def summary(curve: dict) -> dict:
     """Compact view for logs and /debug/ec: per-size best rates plus
     the CPU rate the router compares against."""
-    return {
+    out = {
         "cpu_backend": curve.get("cpu_backend"),
         "cpu_mbps": curve.get("cpu_mbps"),
         "device": curve.get("device"),
@@ -467,3 +587,11 @@ def summary(curve: dict) -> dict:
         "measured_at": curve.get("measured_at"),
         "source": curve.get("source"),
     }
+    if curve.get("mesh") is not None:
+        out["mesh"] = curve["mesh"]
+        out["mesh_best_by_size_mb"] = {
+            str(s >> 20): {"e2e_mbps": round(r, 2), "depth": d}
+            for s, r, d in best_by_size(curve, "mesh_rows")}
+    if curve.get("mesh_error"):
+        out["mesh_error"] = curve["mesh_error"]
+    return out

@@ -131,35 +131,61 @@ def _staged_feed(blocks, upload, drain, depth: int, backend: str):
 
 
 def pipelined_encode_stream(stripe_blocks, k: int = 10, m: int = 4,
-                            depth: int = 2):
+                            depth: int = 2, mesh=None):
     """Batched-encode feed (config #3: 64x1GB volumes through the
     sidecar). `stripe_blocks` yields (B, k, n) uint8 host arrays;
     yields (B, m, n) np.uint8 parity blocks in order, bit-identical to
-    encode_batch on the same input."""
+    encode_batch on the same input.
+
+    With `mesh` (a parallel.mesh (vol, col) mesh) each block is
+    zero-padded to the mesh grain (pad_to_mesh), scattered with one
+    sharded device_put (batch over vol, columns over col) and the
+    jitted step runs on every device; outputs are trimmed back to the
+    caller's shape, so uneven volume tails ride the mesh unchanged."""
     import time
 
     from jax.sharding import SingleDeviceSharding
 
     from ..ops.codec_jax import _readback, observe_stage
 
-    fn, a_bits = jitted_encode(k, m)
-    sharding = SingleDeviceSharding(jax.devices()[0])
-    backend = "ec_pipeline"
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import COL_AXIS, VOL_AXIS, pad_to_mesh
+
+        a_bits = jnp.asarray(parity_bit_matrix(k, m), dtype=jnp.bfloat16)
+        data_sh = NamedSharding(mesh, P(VOL_AXIS, None, COL_AXIS))
+        repl = NamedSharding(mesh, P())
+        fn = jax.jit(encode_batch, in_shardings=(repl, data_sh),
+                     out_shardings=data_sh)
+        a_bits = jax.device_put(a_bits, repl)
+        sharding = data_sh
+        backend = "ec_pipeline_mesh"
+    else:
+        fn, a_bits = jitted_encode(k, m)
+        sharding = SingleDeviceSharding(jax.devices()[0])
+        backend = "ec_pipeline"
 
     def upload(block):
         t0 = time.perf_counter()
-        dev = jax.device_put(np.ascontiguousarray(block), sharding)
+        block = np.ascontiguousarray(block)
+        orig = None
+        if mesh is not None:
+            block, orig = pad_to_mesh(block, mesh)
+        dev = jax.device_put(block, sharding)
         jax.block_until_ready(dev)
         observe_stage(backend, "h2d", time.perf_counter() - t0)
-        return fn(a_bits, dev)
+        return fn(a_bits, dev), orig
 
     def drain(up_fut):
-        out = up_fut.result()
+        out, orig = up_fut.result()
         t0 = time.perf_counter()
         jax.block_until_ready(out)
         t1 = time.perf_counter()
         observe_stage(backend, "kernel", t1 - t0)
         host = _readback(out)
+        if orig is not None and (host.shape[0], host.shape[2]) != orig:
+            host = np.ascontiguousarray(host[:orig[0], :, :orig[1]])
         t2 = time.perf_counter()
         observe_stage(backend, "d2h", t2 - t1)
         return host, t2
@@ -169,29 +195,47 @@ def pipelined_encode_stream(stripe_blocks, k: int = 10, m: int = 4,
 
 
 def pipelined_scrub(pair_blocks, k: int = 10, m: int = 4,
-                    depth: int = 2) -> tuple[int, int]:
+                    depth: int = 2, mesh=None) -> tuple[int, int]:
     """Cluster-scrub feed (config #5: RS parity verify over a volume
     fleet). `pair_blocks` yields (stripes, expected_parity) uint8 host
     pairs; returns (total_mismatched_bytes, n_blocks). Only the int64
     scrub scalar crosses back over the link per block, so the feed
     stays H2D/kernel bound — the honest shape for a read-mostly scrub.
-    """
+
+    With `mesh`, each pair is zero-padded to the mesh grain and both
+    tensors scatter over (vol, col); padding stripes encode to zero
+    parity and the padded expected parity is also zero, so the psum'd
+    mismatch count is untouched — `volume.scrub -all` saturates every
+    local device with no caller-visible shape constraints."""
     import time
 
     from jax.sharding import SingleDeviceSharding
 
     from ..ops.codec_jax import observe_stage
 
-    step = jax.jit(encode_scrub_step)
-    a_bits = jnp.asarray(parity_bit_matrix(k, m), dtype=jnp.bfloat16)
-    sharding = SingleDeviceSharding(jax.devices()[0])
-    backend = "ec_scrub"
+    if mesh is not None:
+        from ..parallel.mesh import pad_to_mesh
+
+        step, a_bits, data_sh = sharded_encode_scrub(mesh, k, m)
+        sharding = data_sh
+        backend = "ec_scrub_mesh"
+    else:
+        step = jax.jit(encode_scrub_step)
+        a_bits = jnp.asarray(parity_bit_matrix(k, m),
+                             dtype=jnp.bfloat16)
+        sharding = SingleDeviceSharding(jax.devices()[0])
+        backend = "ec_scrub"
 
     def upload(pair):
         stripes, expected = pair
         t0 = time.perf_counter()
-        dev_s = jax.device_put(np.ascontiguousarray(stripes), sharding)
-        dev_e = jax.device_put(np.ascontiguousarray(expected), sharding)
+        stripes = np.ascontiguousarray(stripes)
+        expected = np.ascontiguousarray(expected)
+        if mesh is not None:
+            stripes, _ = pad_to_mesh(stripes, mesh)
+            expected, _ = pad_to_mesh(expected, mesh)
+        dev_s = jax.device_put(stripes, sharding)
+        dev_e = jax.device_put(expected, sharding)
         jax.block_until_ready((dev_s, dev_e))
         observe_stage(backend, "h2d", time.perf_counter() - t0)
         return step(a_bits, dev_s, dev_e)

@@ -9,12 +9,46 @@ with psum collectives over both axes.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 VOL_AXIS = "vol"
 COL_AXIS = "col"
+
+# production mesh shape knobs (-ec.mesh.devices / -ec.mesh.col set
+# these; the MeshCodec reads them at construction)
+DEVICES_ENV = "SEAWEEDFS_TPU_EC_MESH_DEVICES"
+COL_ENV = "SEAWEEDFS_TPU_EC_MESH_COL"
+
+
+def mesh_config() -> tuple[int | None, int | None]:
+    """(n_devices, col_parallel) from the environment; None means the
+    defaults (all local devices / the make_mesh heuristic). Garbage
+    values are ignored, not fatal — a bad flag must not take down a
+    volume server whose CPU codec still works."""
+    def _positive_int(name: str) -> int | None:
+        v = os.environ.get(name, "").strip()
+        if not v:
+            return None
+        try:
+            n = int(v)
+        except ValueError:
+            return None
+        return n if n > 0 else None
+
+    return _positive_int(DEVICES_ENV), _positive_int(COL_ENV)
+
+
+def describe(mesh: Mesh) -> dict:
+    """Operator-facing mesh geometry for /debug/ec and the probe
+    fingerprint: device count, (vol, col) shape, platform."""
+    vol, col = (int(x) for x in mesh.devices.shape)
+    first = mesh.devices.flat[0]
+    return {"devices": int(mesh.devices.size), "vol": vol, "col": col,
+            "platform": getattr(first, "platform", "unknown")}
 
 
 def make_mesh(n_devices: int | None = None,

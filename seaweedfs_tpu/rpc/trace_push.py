@@ -15,6 +15,11 @@ trace arrives complete from all hops. A sampled-out span is *skipped*,
 not dropped — ``trace_spans_dropped_total`` counts only real loss
 (queue overflow / push give-up), so zero drops at any sample rate
 means the collector saw everything it was meant to see.
+
+One tail-sampling exception: spans slower than ``-trace.slowThreshold``
+are pushed even when head sampling drops their trace (counted by
+``trace_push_tail_kept_total``), so a 1% sample rate still surfaces
+every slow outlier.
 """
 from __future__ import annotations
 
@@ -96,7 +101,19 @@ class SpanPusher:
 
     def _enqueue(self, rec: dict) -> None:
         if not tracing.sample_decision(rec.get("trace_id", "")):
-            return  # sampled out everywhere — not a drop
+            # keep-if-slow tail pass: a span over -trace.slowThreshold
+            # is pushed even when head sampling dropped its trace, so
+            # low sample rates still surface every slow outlier (the
+            # rest of that trace stays sampled out — the collector gets
+            # a partial trace, flagged by the counter)
+            thresh = tracing.slow_threshold()
+            try:
+                duration = float(rec.get("duration") or 0.0)
+            except (TypeError, ValueError):
+                duration = 0.0
+            if thresh <= 0 or duration < thresh:
+                return  # sampled out everywhere — not a drop
+            metrics.counter_add("trace_push_tail_kept_total", 1)
         with self._lock:
             if len(self._q) >= self.queue_max:
                 self._q.popleft()

@@ -62,6 +62,12 @@ def sample_rate() -> float:
     return _sample_rate
 
 
+def slow_threshold() -> float:
+    """-trace.slowThreshold in seconds; <= 0 means disabled. Shared by
+    the slow-request log and the span pusher's keep-if-slow pass."""
+    return _slow_threshold
+
+
 def sample_decision(trace_id: str, rate: float | None = None) -> bool:
     """Deterministic head-sampling verdict for one trace.
 

@@ -39,6 +39,11 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection / process-kill robustness test "
         "(select the whole family with pytest -m chaos)")
+    config.addinivalue_line(
+        "markers",
+        "mesh: multi-device mesh-codec test; skips itself on hosts "
+        "where fewer than 2 jax devices are visible (CI runs them on "
+        "the 8-device virtual CPU mesh this conftest forces)")
 
 
 import pytest  # noqa: E402

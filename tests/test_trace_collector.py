@@ -440,6 +440,40 @@ class TestSpanPusher:
         assert len(sp._q) == 0
         assert _counter("trace_spans_dropped_total") == dropped0
 
+    def test_slow_span_tail_kept_despite_sampling(self, sample_config):
+        """Keep-if-slow tail pass: with head sampling at 0, a span over
+        -trace.slowThreshold is still enqueued and counted."""
+        thresh = tracing.slow_threshold()
+        tracing.configure(sample_rate=0.0, slow_threshold=0.5)
+        try:
+            sp = SpanPusher("http://127.0.0.1:1", "s", "i")
+            kept0 = _counter("trace_push_tail_kept_total")
+            sp._enqueue(_rec(duration=0.1))    # fast: sampled out
+            assert len(sp._q) == 0
+            sp._enqueue(_rec(duration=0.7))    # slow: tail-kept
+            assert len(sp._q) == 1
+            assert _counter("trace_push_tail_kept_total") == kept0 + 1
+            # a disabled threshold (<= 0) disables the tail pass too
+            tracing.configure(slow_threshold=0.0)
+            sp._enqueue(_rec(duration=99.0))
+            assert len(sp._q) == 1
+        finally:
+            tracing.configure(slow_threshold=thresh)
+
+    def test_tail_keep_not_counted_when_head_sampled(self, sample_config):
+        """A slow span whose trace IS head-sampled rides the normal
+        path — the tail counter only counts rescues."""
+        thresh = tracing.slow_threshold()
+        tracing.configure(sample_rate=1.0, slow_threshold=0.5)
+        try:
+            sp = SpanPusher("http://127.0.0.1:1", "s", "i")
+            kept0 = _counter("trace_push_tail_kept_total")
+            sp._enqueue(_rec(duration=0.7))
+            assert len(sp._q) == 1
+            assert _counter("trace_push_tail_kept_total") == kept0
+        finally:
+            tracing.configure(slow_threshold=thresh)
+
     def test_stop_before_start_is_safe(self):
         SpanPusher("http://127.0.0.1:1", "s", "i").stop()
 
