@@ -964,6 +964,195 @@ def bench_hedge_sweep(argv: list[str]) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_repair_sweep(argv: list[str]) -> int:
+    """`python bench.py repair-sweep [--caps 0,2000000,1000000,500000]
+    [--out BENCH_REPAIR.json]`
+
+    The PR-7 tuning surface: repair-time vs foreground-impact under
+    -repair.maxBytesPerSec.  For each cap a fresh 6-node / 3-rack
+    in-process cluster takes a whole-rack kill (rack B) mid-workload;
+    the row reports how long the watchdog took to restore rack-spread
+    redundancy, the bytes it pushed through the shaper, and the
+    foreground read p50/p99 sampled DURING the repair.  A final row
+    contrasts partial-stripe vs full-stripe single-shard EC repair on
+    the repair_read_bytes_total{mode} counters."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.operation import verbs
+    from seaweedfs_tpu.rpc.httpclient import session
+    from seaweedfs_tpu.server.cluster import Cluster
+    from seaweedfs_tpu.shell import commands_ec
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.utils import metrics, ratelimit
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    caps = [float(c) for c in
+            opt("--caps", "0,2000000,1000000,500000").split(",")]
+    out_path = opt("--out", "BENCH_REPAIR.json")
+    topology = [("dc1", "rA"), ("dc1", "rA"), ("dc1", "rB"),
+                ("dc1", "rB"), ("dc1", "rC"), ("dc1", "rC")]
+    dead = (2, 3)
+
+    def counter(name: str, mode: str | None = None) -> float:
+        labels = (("mode", mode),) if mode else ()
+        with metrics._lock:
+            return metrics._counters.get((name, labels), 0.0)
+
+    def locations(master_url: str, vid: int) -> list[str]:
+        r = session().get(master_url + "/dir/lookup",
+                          params={"volumeId": str(vid)},
+                          timeout=5).json()
+        return [loc["url"] for loc in r.get("locations", [])]
+
+    def rack_kill_point(cap: float) -> dict:
+        ratelimit.reset()
+        tmp = tempfile.mkdtemp(prefix="repair_sweep_")
+        c = Cluster(tmp, n_volume_servers=6, pulse_seconds=0.3,
+                    volume_size_limit=8 << 20,
+                    default_replication="010", topology=topology,
+                    repair_enabled=True, repair_interval=0.5,
+                    repair_max_bytes_per_sec=cap)
+        try:
+            dead_urls = {c.stores[i].public_url for i in dead}
+            rng = np.random.default_rng(11)
+            fids, affected = [], set()
+            for ci in range(15):
+                for _ in range(4):
+                    a = verbs.assign(c.master_url,
+                                     collection=f"rs{ci}")
+                    verbs.upload(a, rng.bytes(30_000))
+                    fids.append(a.fid)
+                vid = int(a.fid.split(",")[0])
+                if set(locations(c.master_url, vid)) & dead_urls:
+                    affected.add(vid)
+                if len(affected) >= 3:
+                    break
+            vids = sorted({int(f.split(",")[0]) for f in fids})
+            bw0 = counter("repair_bw_bytes_total")
+            t0 = time.monotonic()
+            for i in dead:
+                c.volume_threads[i].stop()
+            lats = []
+            t_done = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                fid = fids[len(lats) % len(fids)]
+                vid = int(fid.split(",")[0])
+                live = [u for u in locations(c.master_url, vid)
+                        if u not in dead_urls]
+                if live:
+                    t = time.monotonic()
+                    session().get(f"http://{live[0]}/{fid}",
+                                  timeout=10)
+                    lats.append(time.monotonic() - t)
+                if all(len(set(locations(c.master_url, v))
+                           - dead_urls) == 2 for v in vids):
+                    t_done = time.monotonic()
+                    break
+                time.sleep(0.05)
+            moved = counter("repair_bw_bytes_total") - bw0
+            secs = (t_done - t0) if t_done else None
+            lats_ms = np.sort(np.array(lats)) * 1e3 if lats else None
+            return {
+                "cap_bps": cap or None,
+                "volumes_hit": len(affected),
+                "repair_seconds": round(secs, 3) if secs else None,
+                "repair_bytes": int(moved),
+                "repair_bps": (round(moved / secs) if secs else None),
+                "fg_reads": len(lats),
+                "fg_p50_ms": (round(float(np.percentile(lats_ms, 50)),
+                                    1) if lats else None),
+                "fg_p99_ms": (round(float(np.percentile(lats_ms, 99)),
+                                    1) if lats else None),
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def ec_partial_vs_full() -> dict:
+        ratelimit.reset()
+        tmp = tempfile.mkdtemp(prefix="repair_sweep_ec_")
+        c = Cluster(tmp, n_volume_servers=3,
+                    volume_size_limit=4 << 20, max_volumes=40)
+        try:
+            env = CommandEnv(c.master_url)
+            env.acquire_lock()
+            rng = np.random.default_rng(3)
+            a0 = verbs.assign(c.master_url, collection="ecbench")
+            vid = int(a0.fid.split(",")[0])
+            verbs.upload(a0, rng.bytes(40_000))
+            for _ in range(29):
+                a = verbs.assign(c.master_url, collection="ecbench")
+                if int(a.fid.split(",")[0]) == vid:
+                    verbs.upload(a, rng.bytes(40_000))
+            commands_ec.ec_encode(env, vid)
+
+            def drop(sid: int) -> None:
+                for url in env.ec_shard_locations(vid).get(sid, []):
+                    env.vs_post(url, "/admin/ec/delete",
+                                {"volume": vid, "shard_ids": [sid]})
+
+            drop(3)
+            p0 = counter("repair_read_bytes_total", "partial")
+            t0 = time.monotonic()
+            commands_ec.ec_rebuild(env, vid, partial=True)
+            t_partial = time.monotonic() - t0
+            partial = counter("repair_read_bytes_total", "partial") - p0
+            drop(3)
+            f0 = counter("repair_read_bytes_total", "full")
+            t0 = time.monotonic()
+            commands_ec.ec_rebuild(env, vid, partial=False)
+            t_full = time.monotonic() - t0
+            full = counter("repair_read_bytes_total", "full") - f0
+            return {
+                "partial_read_bytes": int(partial),
+                "full_read_bytes": int(full),
+                "traffic_ratio": (round(full / partial, 2)
+                                  if partial else None),
+                "partial_seconds": round(t_partial, 3),
+                "full_seconds": round(t_full, 3),
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    sweep = []
+    for cap in caps:
+        row = rack_kill_point(cap)
+        sweep.append(row)
+        log(f"repair-sweep cap={row['cap_bps'] or 'unlimited'}: "
+            f"repair {row['repair_seconds']}s "
+            f"({row['repair_bytes']} B @ {row['repair_bps']} B/s)  "
+            f"fg p50 {row['fg_p50_ms']}ms p99 {row['fg_p99_ms']}ms")
+    ec_row = ec_partial_vs_full()
+    log(f"repair-sweep ec: partial {ec_row['partial_read_bytes']} B "
+        f"vs full {ec_row['full_read_bytes']} B "
+        f"(x{ec_row['traffic_ratio']} saving)")
+    result = {
+        "bench": "repair-sweep",
+        "scenario": "whole-rack kill, 6 nodes / 3 racks, "
+                    "replication 010, watchdog-driven repair",
+        "sweep": sweep,
+        "ec_partial_vs_full": ec_row,
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "repair_sweep_traffic_ratio",
+        "value": ec_row["traffic_ratio"],
+        "unit": "x",
+        "extra": {"sweep": sweep},
+        "out": out_path,
+    }), flush=True)
+    return 0
+
+
 def main() -> None:
     rng = np.random.default_rng(0)
     from seaweedfs_tpu.ops import rs_matrix
@@ -1055,4 +1244,6 @@ if __name__ == "__main__":
         sys.exit(bench_hedge_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "mesh-sweep":
         sys.exit(bench_mesh_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "repair-sweep":
+        sys.exit(bench_repair_sweep(sys.argv[2:]))
     main()

@@ -182,6 +182,26 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds a deficit must persist before repair "
                         "starts (rides out transient restarts; 0 = "
                         "repair on first scan)")
+    p.add_argument("-repair.maxBytesPerSec",
+                   dest="repair_max_bytes_per_sec",
+                   type=float, default=0.0,
+                   help="per-node repair byte-rate cap: every repair "
+                        "copy/reconstruction read debits a shared "
+                        "token bucket on its source AND destination "
+                        "volume server, so bulk repair cannot "
+                        "saturate the data plane after a rack loss "
+                        "(fill/debt live in /cluster/status; 0 = "
+                        "unshaped)")
+    p.add_argument("-repair.partialEc", dest="repair_partial_ec",
+                   type=lambda s: s.lower() not in
+                   ("0", "false", "no"),
+                   default=True,
+                   help="rebuild a lost EC shard from a partial-"
+                        "stripe degraded read of only the k shard "
+                        "ranges reconstruction needs, instead of "
+                        "borrowing every surviving shard file "
+                        "(repair_read_bytes_total{mode} accounts the "
+                        "saving; false = always full-stripe)")
     p.add_argument("-master.traceStore", dest="trace_store_size",
                    type=int, default=2048,
                    help="max traces kept in the cluster span "
@@ -1028,6 +1048,9 @@ def _run_master(args) -> int:
                       repair_concurrency=args.repair_concurrency,
                       repair_max_attempts=args.repair_max_attempts,
                       repair_grace=args.repair_grace,
+                      repair_max_bytes_per_sec=(
+                          args.repair_max_bytes_per_sec),
+                      repair_partial_ec=args.repair_partial_ec,
                       trace_store_size=args.trace_store_size,
                       scrape_interval=args.scrape_interval,
                       otlp_url=args.trace_otlp_url)

@@ -50,6 +50,10 @@ class DataNode:
         # layout key each vid was registered under — needed to leave
         # the OLD layout when replication/ttl/disk class changes
         self.volume_layout_keys: dict[int, "LayoutKey"] = {}
+        # last heartbeated repair token-bucket state
+        # ({"rate","burst","fill","debt"}) — None until the node has
+        # ever shaped repair traffic
+        self.repair_bw: dict | None = None
         self.last_seen = time.monotonic()
 
     @property
@@ -488,6 +492,7 @@ class Topology:
                                            n.ec_shards.items()},
                             "max_volumes": n.max_volumes,
                             "disk_type": n.disk_type,
+                            "repair_bw": n.repair_bw,
                             # this process's circuit-breaker view of
                             # the node (closed/open/half-open)
                             "breaker": _retry.breaker_for(n.url).state,

@@ -67,13 +67,25 @@ class RedundancyWatchdog:
 
     def __init__(self, master, enabled: bool = False,
                  interval: float = 10.0, concurrency: int = 2,
-                 max_attempts: int = 5, grace: float = 0.0):
+                 max_attempts: int = 5, grace: float = 0.0,
+                 max_bytes_per_sec: float = 0.0,
+                 partial_ec: bool = True):
         self.master = master
         self.enabled = enabled
         self.interval = max(0.05, interval)
         self.concurrency = max(1, concurrency)
         self.max_attempts = max(1, max_attempts)
         self.grace = max(0.0, grace)
+        # -repair.maxBytesPerSec: per-node repair byte-rate cap, sent
+        # with every copy so each volume server shapes its own side
+        # against one shared "repair" token bucket (utils.ratelimit);
+        # 0 = unshaped
+        self.max_bytes_per_sec = max(0.0, max_bytes_per_sec)
+        # -repair.partialEc: single/few-shard rebuilds stream only the
+        # k shard ranges reconstruction needs (mode="partial") instead
+        # of borrowing the full surviving stripe
+        self.partial_ec = partial_ec
+        self.placement_violations = 0
         self.under_replicated: list[dict] = []
         self.under_parity: list[dict] = []
         self.last_scan_at = 0.0
@@ -171,6 +183,9 @@ class RedundancyWatchdog:
             "concurrency": self.concurrency,
             "max_attempts": self.max_attempts,
             "grace": self.grace,
+            "max_bytes_per_sec": self.max_bytes_per_sec,
+            "partial_ec": self.partial_ec,
+            "placement_violations": self.placement_violations,
             "queue_depth": self._queue.qsize() + len(self._inflight),
             "scan_count": self.scan_count,
             "last_scan_age_seconds": (
@@ -327,13 +342,32 @@ class RedundancyWatchdog:
         try:
             env.acquire_lock()
             if task.kind == "replica":
-                fixes = volume_fix_replication(env, volume_id=task.vid)
+                fixes = volume_fix_replication(
+                    env, volume_id=task.vid,
+                    max_bps=self.max_bytes_per_sec)
                 moved = 0
+                violations = 0
                 for f in fixes:
                     moved += int(f.get("bytes", 0))
+                    violations += int(f.get("placement_violations", 0))
+                self._count_violations("replica", violations)
                 return {"fixes": fixes}, moved
-            out = ec_rebuild(env, task.vid, collection=task.collection)
+            out = ec_rebuild(env, task.vid, collection=task.collection,
+                             max_bps=self.max_bytes_per_sec,
+                             partial=self.partial_ec)
+            self._count_violations(
+                "ec", int(out.get("placement_violations", 0)))
             rebuilt_bytes = int(out.get("rebuilt_bytes", 0))
             return out, rebuilt_bytes
         finally:
             env.close()
+
+    def _count_violations(self, kind: str, n: int) -> None:
+        """A violation = a repair forced to break rack/DC spread
+        because no spread-preserving node had free slots — redundancy
+        won, but the operator should add racks (surfaced in
+        /cluster/status and repair_placement_violations_total)."""
+        if n > 0:
+            self.placement_violations += n
+            metrics.counter_add("repair_placement_violations_total", n,
+                                {"kind": kind})

@@ -40,7 +40,10 @@ class Cluster:
                  disk_types: list[str] | None = None,
                  repair_enabled: bool = False,
                  repair_interval: float = 10.0,
-                 repair_concurrency: int = 2):
+                 repair_concurrency: int = 2,
+                 repair_max_bytes_per_sec: float = 0.0,
+                 repair_partial_ec: bool = True,
+                 repair_grace: float = 0.0):
         """topology: optional per-server (data_center, rack) labels;
         disk_types: optional per-server disk class (hdd/ssd)."""
         self.base_dir = base_dir
@@ -52,7 +55,10 @@ class Cluster:
             admin_script_interval=admin_script_interval,
             repair_enabled=repair_enabled,
             repair_interval=repair_interval,
-            repair_concurrency=repair_concurrency)
+            repair_concurrency=repair_concurrency,
+            repair_max_bytes_per_sec=repair_max_bytes_per_sec,
+            repair_partial_ec=repair_partial_ec,
+            repair_grace=repair_grace)
         self.master_thread = ServerThread(self.master.app).start()
         self.master.admin_scripts_url = self.master_thread.url
         self.volume_servers: list[VolumeServer] = []

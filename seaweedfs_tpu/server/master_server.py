@@ -59,6 +59,8 @@ class MasterServer:
                  repair_concurrency: int = 2,
                  repair_max_attempts: int = 5,
                  repair_grace: float = 0.0,
+                 repair_max_bytes_per_sec: float = 0.0,
+                 repair_partial_ec: bool = True,
                  trace_store_size: int = 2048,
                  scrape_interval: float = 10.0,
                  otlp_url: str = ""):
@@ -107,7 +109,9 @@ class MasterServer:
         self.watchdog = RedundancyWatchdog(
             self, enabled=repair_enabled, interval=repair_interval,
             concurrency=repair_concurrency,
-            max_attempts=repair_max_attempts, grace=repair_grace)
+            max_attempts=repair_max_attempts, grace=repair_grace,
+            max_bytes_per_sec=repair_max_bytes_per_sec,
+            partial_ec=repair_partial_ec)
         # cluster observability plane (master/collector.py): span
         # collector + OTLP export + metrics federation
         from ..master.collector import MetricsFederator, SpanCollector
@@ -476,6 +480,10 @@ class MasterServer:
                         node, [(e["id"], e.get("collection", ""),
                                 e["shard_bits"], e.get("codec", ""))
                                for e in hb["ec_shards"]])
+                # live repair-bucket fill/debt piggybacked on the
+                # heartbeat -> visible in /cluster/status per node
+                if "repair_bw" in hb:
+                    node.repair_bw = hb["repair_bw"]
                 self.watchdog.poke()
                 await ws.send_json({
                     "volume_size_limit": self.topo.volume_size_limit,
@@ -596,6 +604,11 @@ class MasterServer:
             "RepairQueueDepth": (self.watchdog._queue.qsize() +
                                  len(self.watchdog._inflight)),
             "RepairEnabled": self.watchdog.enabled,
+            "RepairMaxBytesPerSec": self.watchdog.max_bytes_per_sec,
+            "RepairPlacementViolations":
+                self.watchdog.placement_violations,
+            # per-node repair bucket fill/debt as last heartbeated
+            "RepairBandwidth": self._repair_bandwidth(),
             "Observability": {
                 **self.collector.observability(),
                 "Federation": self.federator.observability(),
@@ -746,24 +759,42 @@ class MasterServer:
         repairs."""
         return json_ok(self.watchdog.snapshot())
 
+    def _repair_bandwidth(self) -> dict:
+        with self.topo.lock:
+            return {n.url: n.repair_bw
+                    for n in self.topo.nodes.values()
+                    if n.repair_bw is not None}
+
     async def handle_repair_enqueue(self, req: web.Request) -> web.Response:
         """Enqueue one repair (scrub wiring + operator hook):
-        {"volume": vid, "kind": "replica"|"ec", "reason": "..."}."""
+        {"volume": vid, "kind": "replica"|"ec", "reason": "..."}.
+        Every malformed input is a 400 with a JSON error — never a 500
+        and never a silent accept."""
         redir = self._leader_redirect(req)
         if redir is not None:
             return redir
-        body = await req.json()
+        try:
+            body = await req.json()
+        except Exception:
+            return json_error("repair enqueue body must be JSON",
+                              status=400)
+        if not isinstance(body, dict):
+            return json_error("repair enqueue body must be a JSON "
+                              "object", status=400)
         try:
             vid = int(body["volume"])
         except (KeyError, TypeError, ValueError):
-            return json_error("repair enqueue requires a volume id",
+            return json_error("repair enqueue requires an integer "
+                              "volume id", status=400)
+        if vid <= 0:
+            return json_error(f"volume id must be positive, got {vid}",
                               status=400)
         kind = body.get("kind", "replica")
         if kind not in ("replica", "ec"):
             return json_error(f"unknown repair kind {kind!r}", status=400)
         accepted = self.watchdog.enqueue(
-            vid, kind, body.get("reason", "operator"),
-            collection=body.get("collection", ""))
+            vid, kind, str(body.get("reason", "operator")),
+            collection=str(body.get("collection", "")))
         return json_ok({"accepted": accepted,
                         "enabled": self.watchdog.enabled})
 

@@ -29,7 +29,8 @@ from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
 from ..storage.store import Store
-from ..utils import faults, glog, httprange, metrics, retry, tracing
+from ..utils import faults, glog, httprange, metrics, ratelimit, retry, \
+    tracing
 from ..utils.security import Guard
 
 
@@ -175,6 +176,8 @@ class VolumeServer:
             web.post("/admin/tier_download", self.handle_tier_download),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
+            web.post("/admin/ec/rebuild_partial",
+                     self.handle_ec_rebuild_partial),
             web.post("/admin/ec/copy", self.handle_ec_copy),
             web.post("/admin/ec/mount", self.handle_ec_mount),
             web.post("/admin/ec/unmount", self.handle_ec_unmount),
@@ -403,6 +406,13 @@ class VolumeServer:
                             hb["data_center"] = self.data_center
                             hb["rack"] = self.rack
                             hb["disk_type"] = self.disk_type
+                            bw = ratelimit.snapshot().get("repair")
+                            if bw is not None:
+                                hb["repair_bw"] = bw
+                                metrics.gauge_set(
+                                    "repair_bw_fill_bytes", bw["fill"])
+                                metrics.gauge_set(
+                                    "repair_bw_debt_bytes", bw["debt"])
                             await ws.send_json(hb)
                             msg = await ws.receive(
                                 timeout=self.pulse_seconds * 4)
@@ -429,6 +439,32 @@ class VolumeServer:
 
     def poke_heartbeat(self) -> None:
         self._hb_wake.set()
+
+    # ------------------------------------------------------------------
+    # repair bandwidth shaping: one node-wide "repair" token bucket
+    # shared by every repair role this server plays (copy source via
+    # ?bps= on copy_file/shard_read, copy destination via max_bps in
+    # volume_copy/ec/copy bodies, partial-rebuild fetcher), so the
+    # per-node cap holds no matter how many transfers overlap
+    # ------------------------------------------------------------------
+    async def _repair_throttle(self, max_bps: float, n: int) -> None:
+        """Async-side shaping: debit ``n`` repair bytes and sleep out
+        the wait off the event loop."""
+        if n <= 0:
+            return
+        metrics.counter_add("repair_bw_bytes_total", n)
+        if max_bps and max_bps > 0:
+            wait = ratelimit.bucket("repair", max_bps).reserve(n)
+            if wait > 0:
+                await asyncio.sleep(wait)
+
+    def _repair_throttle_sync(self, max_bps: float, n: int) -> None:
+        """Thread-side shaping (partial rebuild fetch loop)."""
+        if n <= 0:
+            return
+        metrics.counter_add("repair_bw_bytes_total", n)
+        if max_bps and max_bps > 0:
+            ratelimit.bucket("repair", max_bps).acquire(n)
 
     # ------------------------------------------------------------------
     # data plane: GET/HEAD/POST/DELETE /<vid>,<fid>
@@ -1194,6 +1230,7 @@ class VolumeServer:
         vid = int(body["volume"])
         collection = body.get("collection", "")
         source = body["source"]
+        max_bps = float(body.get("max_bps", 0) or 0)
         if self.store.has_volume(vid):
             return web.json_response({"error": "volume exists"}, status=409)
         loc = min(self.store.locations, key=lambda l: l.volume_count)
@@ -1204,13 +1241,18 @@ class VolumeServer:
                 async with sess.get(
                         f"http://{source}/admin/copy_file",
                         params={"volume": vid, "collection": collection,
-                                "ext": ext}) as resp:
+                                "ext": ext, "bps": max_bps},
+                        timeout=aiohttp.ClientTimeout(total=None)) as resp:
                     if resp.status != 200:
                         return web.json_response(
                             {"error": f"copy {ext} from {source}: "
                                       f"{resp.status}"}, status=502)
                     with open(base + ext, "wb") as f:
                         async for chunk in resp.content.iter_chunked(1 << 20):
+                            # destination-side debit of the shared
+                            # repair bucket; the source debits its own
+                            # via ?bps=, giving a per-node total cap
+                            await self._repair_throttle(max_bps, len(chunk))
                             f.write(chunk)
                             copied += len(chunk)
         from ..storage.volume import Volume
@@ -1450,6 +1492,174 @@ class VolumeServer:
         return web.json_response({"rebuilt_shards": rebuilt,
                                   "rebuilt_bytes": rebuilt_bytes})
 
+    async def handle_ec_rebuild_partial(self, req: web.Request) -> web.Response:
+        """Traffic-minimal shard reconstruction: instead of borrowing
+        every surviving shard file (full stripe, the ec/copy +
+        ec/rebuild path), stream only the k shard ranges the codec
+        needs through the degraded-read guard's first-k-wins fan-out
+        and rebuild the missing shard(s) chunk by chunk — the
+        partial-stripe repair the warehouse study (arXiv 1309.0186)
+        motivates. Bytes fetched are accounted as
+        repair_read_bytes_total{mode="partial"} (the classic path
+        counts mode="full"), so the saving is measurable."""
+        body = await req.json()
+        vid = int(body["volume"])
+        collection = body.get("collection", "")
+        missing = sorted({int(s) for s in body["shard_ids"]})
+        max_bps = float(body.get("max_bps", 0) or 0)
+        chunk = int(body.get("chunk", 4 << 20))
+        if not missing or chunk <= 0:
+            return web.json_response(
+                {"error": "need shard_ids and chunk > 0"}, status=400)
+        try:
+            result = await asyncio.to_thread(
+                self._partial_ec_rebuild_sync, vid, collection,
+                missing, max_bps, chunk)
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        self.store.mount_ec_shards(vid, collection, missing)
+        self.poke_heartbeat()
+        return web.json_response(result)
+
+    def _partial_ec_rebuild_sync(self, vid: int, collection: str,
+                                 missing: list[int], max_bps: float,
+                                 chunk: int) -> dict:
+        import numpy as np
+
+        from ..ec.backend import ReedSolomon
+        from ..ec.encoder import codec_of
+        from ..rpc.httpclient import session
+
+        # land the rebuilt files beside already-mounted shards so
+        # ec.mount finds them (same rule as handle_ec_copy)
+        loc = self.store.locations[0]
+        ecv = self.store.ec_volumes.get(vid)
+        if ecv is not None:
+            for cand in self.store.locations:
+                if cand.dir == ecv.dir:
+                    loc = cand
+                    break
+        base = loc.base_name(collection, vid)
+        me = f"{self.store.ip}:{self.store.port}"
+        holders = {int(s): [h for h in urls if h != me]
+                   for s, urls in self._ec_holders(vid).items()}
+        local_sids = sorted(s for s in (ecv.shards if ecv else {})
+                            if s not in missing)
+        remote_sids = sorted(s for s, urls in holders.items()
+                             if urls and s not in missing
+                             and s not in local_sids)
+        hosts: list[str] = []
+        for urls in holders.values():
+            for u in urls:
+                if u not in hosts:
+                    hosts.append(u)
+        net_bytes = 0
+        # the sorted needle index (and codec sidecar) must exist
+        # locally before the rebuilt shard can be mounted
+        if not os.path.exists(base + ".ecx"):
+            for ext in (".ecx", ".vif"):
+                blob = None
+                for h in hosts:
+                    try:
+                        r = session().get(
+                            f"http://{h}/admin/copy_file",
+                            params={"volume": vid,
+                                    "collection": collection,
+                                    "ext": ext, "bps": max_bps},
+                            timeout=60)
+                    except Exception:
+                        continue
+                    if r.status_code == 200:
+                        blob = r.content
+                        break
+                if blob is None:
+                    if ext == ".ecx":
+                        raise ValueError(f"vid {vid}: no holder "
+                                         f"serves .ecx")
+                    try:  # no .vif anywhere = default RS(10,4)
+                        os.unlink(base + ".vif")
+                    except FileNotFoundError:
+                        pass
+                    continue
+                with open(base + ext, "wb") as f:
+                    f.write(blob)
+                self._repair_throttle_sync(max_bps, len(blob))
+                net_bytes += len(blob)
+        k, m = codec_of(base)
+        if len(local_sids) + len(remote_sids) < k:
+            raise ValueError(
+                f"vid {vid}: {len(local_sids) + len(remote_sids)} "
+                f"shards reachable, need {k}")
+        shard_size = None
+        if local_sids:
+            shard_size = ecv.shards[local_sids[0]].size
+        else:
+            for s in remote_sids:
+                for h in holders[s]:
+                    try:
+                        r = session().get(
+                            f"http://{h}/admin/ec/shard_read",
+                            params={"volume": vid, "shard": s,
+                                    "stat": "1"}, timeout=10)
+                    except Exception:
+                        continue
+                    if r.status_code == 200:
+                        shard_size = int(r.json()["size"])
+                        break
+                if shard_size is not None:
+                    break
+        if not shard_size:
+            raise ValueError(f"vid {vid}: cannot stat shard size")
+        rs = ReedSolomon(k, m, backend=self.store.ec_backend)
+        written = 0
+        files = {s: open(base + geo.shard_ext(s), "wb")
+                 for s in missing}
+        try:
+            for off in range(0, shard_size, chunk):
+                n = min(chunk, shard_size - off)
+                rows: dict[int, object] = {}
+                for s in local_sids:
+                    if len(rows) >= k:
+                        break
+                    rows[s] = np.frombuffer(
+                        ecv.shards[s].read_at(off, n), dtype=np.uint8)
+                need = k - len(rows)
+                if need > 0:
+                    # pace the loop BEFORE the fan-out so the burst
+                    # the first-k-wins fetch admits is already paid for
+                    self._repair_throttle_sync(max_bps, need * n)
+                    fetched = self._remote_shards_fetch_sync(
+                        vid, remote_sids, off, n, need=need,
+                        deadline=max(30.0, self.store.ec_read_deadline),
+                        bps=max_bps)
+                    for s in sorted(fetched)[:need]:
+                        rows[s] = np.frombuffer(fetched[s],
+                                                dtype=np.uint8)
+                    net_bytes += need * n
+                if len(rows) < k:
+                    raise ValueError(
+                        f"vid {vid}: only {len(rows)}/{k} shard "
+                        f"ranges at +{off}")
+                rec = rs.reconstruct(rows, missing=missing)
+                for s in missing:
+                    row = np.asarray(rec[s], dtype=np.uint8).tobytes()
+                    files[s].write(row)
+                    written += len(row)
+        except Exception:
+            for s, f in files.items():
+                f.close()
+                try:  # never leave a torn shard for ec.mount to find
+                    os.unlink(base + geo.shard_ext(s))
+                except FileNotFoundError:
+                    pass
+            raise
+        for f in files.values():
+            f.close()
+        metrics.counter_add("repair_read_bytes_total", net_bytes,
+                            {"mode": "partial"})
+        return {"rebuilt_shards": missing, "rebuilt_bytes": written,
+                "read_bytes": net_bytes}
+
     async def handle_ec_copy(self, req: web.Request) -> web.Response:
         """VolumeEcShardsCopy (:126): pull shard files (and optionally
         .ecx/.ecj) from a source server's copy_file endpoint."""
@@ -1458,6 +1668,11 @@ class VolumeServer:
         collection = body.get("collection", "")
         shard_ids = body["shard_ids"]
         source = body["source"]
+        max_bps = float(body.get("max_bps", 0) or 0)
+        # repair=true marks shards borrowed for a FULL-stripe rebuild,
+        # so repair_read_bytes_total{mode} can contrast full vs the
+        # partial path (handle_ec_rebuild_partial)
+        is_repair = bool(body.get("repair", False))
         # if shards of this ec volume are already mounted from another
         # disk location, the new files must land beside them — writing
         # to locations[0] would strand them where ec.mount never looks
@@ -1477,12 +1692,14 @@ class VolumeServer:
         # the .vif sidecar names the volume's EC codec: a wide-code
         # shard set copied without it would be misread as RS(10,4)
         exts += [".vif"]
+        copied = 0
         async with aiohttp.ClientSession() as sess:
             for ext in exts:
                 async with sess.get(
                         f"http://{source}/admin/copy_file",
                         params={"volume": vid, "collection": collection,
-                                "ext": ext}) as resp:
+                                "ext": ext, "bps": max_bps},
+                        timeout=aiohttp.ClientTimeout(total=None)) as resp:
                     if resp.status == 404 and ext in (".ecj", ".vif"):
                         if ext == ".vif":
                             # source has no codec sidecar (default
@@ -1500,8 +1717,13 @@ class VolumeServer:
                                       f"{resp.status}"}, status=502)
                     with open(base + ext, "wb") as f:
                         async for chunk in resp.content.iter_chunked(1 << 20):
+                            await self._repair_throttle(max_bps, len(chunk))
                             f.write(chunk)
-        return web.json_response({"copied": exts})
+                            copied += len(chunk)
+        if is_repair and copied:
+            metrics.counter_add("repair_read_bytes_total", copied,
+                                {"mode": "full"})
+        return web.json_response({"copied": exts, "bytes": copied})
 
     async def handle_ec_mount(self, req: web.Request) -> web.Response:
         body = await req.json()
@@ -1563,9 +1785,18 @@ class VolumeServer:
         shard = ecv.shards.get(sid) if ecv else None
         if shard is None:
             return web.Response(status=404, text="shard not found")
+        if req.query.get("stat") == "1":
+            # size probe: the partial rebuilder plans its chunk loop
+            # from a peer's shard length without moving shard bytes
+            return web.json_response({"volume": vid, "shard": sid,
+                                      "size": shard.size})
         if size < 0:
             size = shard.size - offset
         data = await asyncio.to_thread(shard.read_at, offset, size)
+        # ?bps= = repair pull: shape the source side too
+        bps = float(req.query.get("bps", 0) or 0)
+        if bps > 0:
+            await self._repair_throttle(bps, len(data))
         return web.Response(body=data,
                             content_type="application/octet-stream")
 
@@ -1780,6 +2011,9 @@ class VolumeServer:
                 break
         if path is None:
             return web.Response(status=404, text=f"{ext} not found")
+        # ?bps= marks a repair pull and shapes the SOURCE side against
+        # this node's shared repair bucket
+        bps = float(req.query.get("bps", 0) or 0)
         resp = web.StreamResponse()
         resp.content_length = os.path.getsize(path)
         await resp.prepare(req)
@@ -1788,6 +2022,8 @@ class VolumeServer:
                 chunk = await asyncio.to_thread(f.read, 1 << 20)
                 if not chunk:
                     break
+                if bps > 0:
+                    await self._repair_throttle(bps, len(chunk))
                 await resp.write(chunk)
         await resp.write_eof()
         return resp
@@ -1827,7 +2063,8 @@ class VolumeServer:
 
     def _fetch_shard_from_holders(self, vid: int, sid: int,
                                   holders: list, offset: int, size: int,
-                                  deadline_t: float) -> bytes | None:
+                                  deadline_t: float,
+                                  bps: float = 0.0) -> bytes | None:
         import requests
 
         from ..rpc.httpclient import session
@@ -1836,11 +2073,14 @@ class VolumeServer:
             remaining = deadline_t - time.monotonic()
             if remaining <= 0:
                 return None
+            params = {"volume": vid, "shard": sid,
+                      "offset": offset, "size": size}
+            if bps > 0:  # repair pull: let the source shape its side
+                params["bps"] = bps
             try:
                 r = session().get(
                     f"http://{holder}/admin/ec/shard_read",
-                    params={"volume": vid, "shard": sid,
-                            "offset": offset, "size": size},
+                    params=params,
                     timeout=min(remaining, 10.0))
                 if r.status_code == 200:
                     return r.content
@@ -1859,7 +2099,8 @@ class VolumeServer:
 
     def _remote_shards_fetch_sync(self, vid: int, sids: list, offset: int,
                                   size: int, need: int,
-                                  deadline: float) -> dict:
+                                  deadline: float,
+                                  bps: float = 0.0) -> dict:
         """Concurrent first-k-wins shard-range fan-out for degraded
         reads (goroutine fan-out in store_ec.go:349-393): every
         candidate shard is requested at once; the call returns as soon
@@ -1886,7 +2127,7 @@ class VolumeServer:
                 futs[pool.submit(
                     contextvars.copy_context().run,
                     self._fetch_shard_from_holders, vid, sid, holders,
-                    offset, size, deadline_t)] = sid
+                    offset, size, deadline_t, bps)] = sid
         out: dict[int, bytes] = {}
         pending = set(futs)
         while pending and len(out) < need:

@@ -46,23 +46,21 @@ def ec_encode(env: CommandEnv, volume_id: int,
 def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
                      source: str,
                      total: int = geo.TOTAL_SHARDS) -> dict[int, str]:
-    """Allocate shards to servers by descending free slots
-    (command_ec_encode.go:145 spreadEcShards, balanced like
-    command_ec_common.go:111)."""
+    """Allocate shards to servers rack-aware (command_ec_encode.go:145
+    spreadEcShards): round-robin across RACKS first, nodes inside a
+    rack by free capacity, so a rack loss costs the fewest shards of
+    any one volume — the same spreading contract repair preserves
+    (master.placement)."""
+    from ..master import placement as pl
+
     nodes = env.data_nodes()
     if not nodes:
         raise ShellError("no data nodes")
-    # round-robin over nodes sorted by free capacity
-    def free(n):
-        return n["max_volumes"] - len(n["volumes"]) - \
-            sum(bin(b).count("1") for b in n["ec_volumes"].values()) / \
-            geo.TOTAL_SHARDS
-
-    order = sorted(nodes, key=free, reverse=True)
+    order = pl.ec_spread_order(nodes, total)
     placement: dict[int, str] = {}
     per_node: dict[str, list[int]] = defaultdict(list)
     for sid in range(total):
-        node = order[sid % len(order)]
+        node = order[sid]
         placement[sid] = node["url"]
         per_node[node["url"]].append(sid)
     for url, sids in per_node.items():
@@ -85,11 +83,22 @@ def spread_ec_shards(env: CommandEnv, vid: int, collection: str,
 
 
 def ec_rebuild(env: CommandEnv, volume_id: int,
-               collection: str = "") -> dict:
-    """Rebuild missing shards of an EC volume on the emptiest server
-    (command_ec_rebuild.go:58-229): copy >= k present shards to the
-    rebuilder, run the local rebuild, mount the rebuilt shards, drop the
-    borrowed copies."""
+               collection: str = "", max_bps: float = 0,
+               partial: bool = True) -> dict:
+    """Rebuild missing shards of an EC volume
+    (command_ec_rebuild.go:58-229).
+
+    The rebuilder is chosen by master.placement.select_ec_rebuilder —
+    a node holding no shard of the volume, in the rack with the fewest
+    of its shards — because the rebuilt shard lives where it is
+    rebuilt.  When ``partial`` (default) and <= m shards are missing,
+    the rebuilder's /admin/ec/rebuild_partial streams only the k shard
+    ranges reconstruction needs (mode="partial" byte accounting)
+    instead of borrowing every surviving shard file; the classic
+    full-stripe path remains as fallback (mode="full").  ``max_bps``
+    shapes all transfers against each node's repair bucket."""
+    from ..master import placement as pl
+
     env.confirm_locked()
     reg_collection, (k, m), locations = env.ec_info(volume_id)
     if not collection:
@@ -104,9 +113,27 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
             f"volume {volume_id}: only {len(present)} shards survive, "
             f"need {k}")
     nodes = env.data_nodes()
-    rebuilder = max(
-        nodes,
-        key=lambda n: n["max_volumes"] - len(n["volumes"]))["url"]
+    node, violations = pl.select_ec_rebuilder(nodes, volume_id,
+                                              locations)
+    if node is None:  # every node full: fall back to emptiest
+        node = max(nodes,
+                   key=lambda n: n["max_volumes"] - len(n["volumes"]))
+    rebuilder = node["url"]
+    if partial and len(missing) <= m:
+        try:
+            out = env.vs_post(rebuilder, "/admin/ec/rebuild_partial",
+                              {"volume": volume_id,
+                               "collection": collection,
+                               "shard_ids": missing,
+                               "max_bps": max_bps})
+            env.wait_for_ec_registration(volume_id, k + m)
+            return {"rebuilt": out["rebuilt_shards"],
+                    "rebuilder": rebuilder, "mode": "partial",
+                    "rebuilt_bytes": out.get("rebuilt_bytes", 0),
+                    "read_bytes": out.get("read_bytes", 0),
+                    "placement_violations": violations}
+        except ShellError:
+            pass  # stale holder map / peer down: full path below
     local = set()
     for sid, urls in locations.items():
         if rebuilder in urls:
@@ -121,7 +148,8 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
                     {"volume": volume_id, "collection": collection,
                      "shard_ids": [sid], "source": src,
                      "copy_ecx": not local and not borrowed,
-                     "copy_ecj": False})
+                     "copy_ecj": False, "max_bps": max_bps,
+                     "repair": True})
         borrowed.append(sid)
     out = env.vs_post(rebuilder, "/admin/ec/rebuild",
                       {"volume": volume_id})
@@ -133,8 +161,9 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
         env.vs_post(rebuilder, "/admin/ec/delete",
                     {"volume": volume_id, "shard_ids": borrowed})
     env.wait_for_ec_registration(volume_id, k + m)
-    return {"rebuilt": rebuilt, "rebuilder": rebuilder,
-            "rebuilt_bytes": out.get("rebuilt_bytes", 0)}
+    return {"rebuilt": rebuilt, "rebuilder": rebuilder, "mode": "full",
+            "rebuilt_bytes": out.get("rebuilt_bytes", 0),
+            "placement_violations": violations}
 
 
 def ec_balance(env: CommandEnv, collection: str = "") -> list[dict]:

@@ -117,13 +117,23 @@ def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[dict]
     return done
 
 
-def volume_fix_replication(env: CommandEnv,
-                           volume_id: int = 0) -> list[dict]:
+def volume_fix_replication(env: CommandEnv, volume_id: int = 0,
+                           max_bps: float = 0) -> list[dict]:
     """Re-replicate under-replicated volumes: copy .dat/.idx from a
     healthy replica to a server that lacks the volume
     (command_volume_fix_replication.go).  ``volume_id`` restricts the
     pass to one volume — the master's repair queue uses that for
-    targeted per-deficit repairs."""
+    targeted per-deficit repairs.  ``max_bps`` shapes every copy
+    against the source and destination nodes' repair token buckets.
+
+    Targets come from master.placement.select_replica_targets, the
+    same rack/DC spreading contract the master applies at write
+    assignment: a replica lost from a diff-rack/diff-dc slot is
+    recreated in a DIFFERENT rack/dc than the survivors, or one rack
+    failure could still lose every copy.  Forced spread breaks are
+    reported per fix as ``placement_violations``."""
+    from ..master import placement
+
     env.confirm_locked()
     nodes = env.data_nodes()
     by_vid: dict[int, list[dict]] = defaultdict(list)
@@ -139,34 +149,19 @@ def volume_fix_replication(env: CommandEnv,
         have = len(holders)
         if have >= want:
             continue
-        holder_urls = {n["url"] for n in holders}
-        candidates = [n for n in nodes if n["url"] not in holder_urls
-                      and len(n["volumes"]) < n["max_volumes"]]
-        # honor the superblock's placement digits: a replica lost from
-        # a diff-rack/diff-dc slot must be recreated in a DIFFERENT
-        # rack/dc than the survivors, or one rack failure can still
-        # lose every copy (xyz scheme, replica_placement.go)
-        holder_dcs = {n["dc"] for n in holders}
-        holder_racks = {(n["dc"], n["rack"]) for n in holders}
-        if rp.diff_dc and len(holder_dcs) <= rp.diff_dc:
-            preferred = [n for n in candidates
-                         if n["dc"] not in holder_dcs]
-            candidates = preferred or candidates
-        elif rp.diff_rack and \
-                len(holder_racks) <= rp.diff_rack:
-            preferred = [n for n in candidates
-                         if (n["dc"], n["rack"]) not in holder_racks]
-            candidates = preferred or candidates
-        candidates.sort(key=lambda n: len(n["volumes"]))
+        targets, violations = placement.select_replica_targets(
+            nodes, holders, rp, want - have)
         src = holders[0]["url"]
         col = env.volume_collection(vid)
-        for target in candidates[:want - have]:
+        for target in targets:
             out = env.vs_post(target["url"], "/admin/volume_copy",
                               {"volume": vid, "collection": col,
-                               "source": src})
+                               "source": src, "max_bps": max_bps})
             fixes.append({"volume": vid, "from": src,
                           "to": target["url"],
-                          "bytes": out.get("bytes", 0)})
+                          "bytes": out.get("bytes", 0),
+                          "placement_violations": violations})
+            violations = 0  # attribute the batch's count once
     return fixes
 
 

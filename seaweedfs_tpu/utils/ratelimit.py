@@ -1,0 +1,147 @@
+"""Token-bucket byte-rate shaping for repair traffic.
+
+The repair plane moves bulk bytes (replica re-copies, EC shard
+reconstruction reads) over the same NICs and disks that serve
+foreground traffic; the warehouse-cluster study (arxiv 1309.0186)
+measures repair as the DOMINANT cross-rack load when it runs
+unshaped. `-repair.maxBytesPerSec` caps it with one bucket per node:
+every repair byte a node sends (copy_file / shard_read source side)
+or receives (volume_copy / ec/copy destination side) draws from that
+node's bucket, so the per-node total holds regardless of how many
+concurrent transfers the bounded-concurrency workers drive.
+
+Design notes:
+
+* Reservation-style accounting: ``reserve(n)`` debits the bucket
+  immediately and returns how long the caller must sleep before the
+  bytes are genuinely available. Debiting under one lock makes grants
+  strictly FIFO (no starvation: a large request queues ahead of later
+  small ones rather than being overtaken forever), and lets both sync
+  callers (``acquire`` sleeps) and asyncio handlers (``await
+  asyncio.sleep(reserve(n))``) share one bucket without blocking an
+  event loop.
+* The bucket starts EMPTY and the burst allowance is small
+  (``rate/8`` by default): admitted bytes over any window w are
+  bounded by ``rate*w + burst``, so a 1-second window can exceed the
+  cap by at most 12.5% and only right after an idle period.
+* ``debt`` is the number of bytes already granted but not yet payable
+  at the current fill — the queueing backlog operators see in
+  /cluster/status when repair is saturating its cap.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    """Thread-safe byte token bucket; rate <= 0 means unlimited."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        self._lock = threading.Lock()
+        self._t = time.monotonic()
+        self.configure(rate, burst)
+
+    def configure(self, rate: float, burst: float | None = None) -> None:
+        """(Re)set the rate; keeps accumulated debt so a live rate
+        change never forgives bytes already granted."""
+        with self._lock:
+            self.rate = float(rate)
+            self.burst = (float(burst) if burst is not None
+                          else max(64 << 10, self.rate / 8.0))
+            if not hasattr(self, "_tokens"):
+                self._tokens = 0.0  # start empty: no day-one burst
+
+    def _refill_locked(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def reserve(self, n: int) -> float:
+        """Debit ``n`` bytes; return seconds the caller must wait
+        before using them (0.0 = immediately available)."""
+        if self.rate <= 0 or n <= 0:
+            return 0.0
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self._tokens -= n
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.rate
+
+    def cancel(self, n: int) -> None:
+        """Return ``n`` bytes debited by a reserve that timed out."""
+        if self.rate <= 0 or n <= 0:
+            return
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self._tokens = min(self.burst, self._tokens + n)
+
+    def acquire(self, n: int, timeout: float | None = None) -> bool:
+        """Blocking reserve: sleep until ``n`` bytes are available.
+        With ``timeout``, refuse (and un-debit) when the queue is so
+        deep the wait would exceed it."""
+        wait = self.reserve(n)
+        if timeout is not None and wait > timeout:
+            self.cancel(n)
+            return False
+        if wait > 0:
+            time.sleep(wait)
+        return True
+
+    @property
+    def fill(self) -> float:
+        """Bytes available right now (>= 0)."""
+        if self.rate <= 0:
+            return float("inf")
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return max(0.0, self._tokens)
+
+    @property
+    def debt(self) -> float:
+        """Bytes granted beyond the current fill (queue backlog)."""
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return max(0.0, -self._tokens)
+
+    def state(self) -> dict:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return {"rate": self.rate,
+                    "burst": self.burst,
+                    "fill": round(max(0.0, self._tokens), 1),
+                    "debt": round(max(0.0, -self._tokens), 1)}
+
+
+# -- process-local bucket registry ---------------------------------------
+# One named bucket per shaping domain (volume servers use "repair" for
+# their node-wide repair cap). The rate arrives with each throttled
+# request (the master is the single place the cap is configured), so
+# the registry re-configures on change instead of erroring.
+
+_buckets: dict[str, TokenBucket] = {}
+_reg_lock = threading.Lock()
+
+
+def bucket(key: str, rate: float) -> TokenBucket:
+    with _reg_lock:
+        b = _buckets.get(key)
+        if b is None:
+            b = _buckets[key] = TokenBucket(rate)
+        elif b.rate != float(rate):
+            b.configure(rate)
+        return b
+
+
+def snapshot() -> dict[str, dict]:
+    with _reg_lock:
+        return {key: b.state() for key, b in _buckets.items()}
+
+
+def reset() -> None:
+    """Test hook: drop all registered buckets."""
+    with _reg_lock:
+        _buckets.clear()

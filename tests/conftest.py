@@ -44,6 +44,10 @@ def pytest_configure(config):
         "mesh: multi-device mesh-codec test; skips itself on hosts "
         "where fewer than 2 jax devices are visible (CI runs them on "
         "the 8-device virtual CPU mesh this conftest forces)")
+    config.addinivalue_line(
+        "markers",
+        "rackloss: whole-rack-kill chaos scenario (placement-aware, "
+        "bandwidth-shaped repair); selectable/excludable like chaos")
 
 
 import pytest  # noqa: E402

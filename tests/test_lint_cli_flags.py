@@ -46,5 +46,7 @@ def test_robustness_flags_have_help():
     # the whole documented surface this PR series promises
     for expected in ("-repair.enabled", "-repair.interval",
                      "-repair.concurrency", "-repair.maxAttempts",
-                     "-repair.grace", "-fault.spec", "-fault.seed"):
+                     "-repair.grace", "-repair.maxBytesPerSec",
+                     "-repair.partialEc",
+                     "-fault.spec", "-fault.seed"):
         assert expected in flags, f"{expected} flag missing from cli.py"

@@ -20,13 +20,14 @@ PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 
 # Every key is bounded by construction: enum-like (kind, op, stage,
-# outcome, method, direction), a fixed deployment set (backend,
-# service, handler, collection, instance), HTTP classes (code), or the
+# outcome, method, direction, mode — repair read mode is exactly
+# {partial, full}), a fixed deployment set (backend, service, handler,
+# collection, instance), HTTP classes (code), or the
 # histogram-internal bucket bound (le).
 ALLOWED = {
     "backend", "code", "collection", "direction", "handler",
-    "instance", "kind", "le", "method", "op", "outcome", "service",
-    "stage",
+    "instance", "kind", "le", "method", "mode", "op", "outcome",
+    "service", "stage",
 }
 
 

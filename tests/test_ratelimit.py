@@ -1,0 +1,169 @@
+"""Token-bucket repair shaping (utils/ratelimit.py).
+
+The contract the repair plane depends on:
+
+* over ANY observation window w, admitted bytes <= rate*w + burst
+  (the bucket starts empty and the default burst is rate/8, so a
+  1-second window can overshoot the cap by at most 12.5%) — verified
+  under concurrent workers;
+* grants are FIFO (reservation debits under one lock), so a large
+  request is never overtaken forever by later small ones;
+* cancel() un-debits a timed-out reservation; live reconfiguration
+  keeps accumulated debt.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.utils import ratelimit
+from seaweedfs_tpu.utils.ratelimit import TokenBucket
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    ratelimit.reset()
+    yield
+    ratelimit.reset()
+
+
+class TestReserve:
+    def test_unlimited_never_waits(self):
+        b = TokenBucket(0)
+        assert b.reserve(1 << 30) == 0.0
+        assert b.fill == float("inf")
+        assert b.debt == 0.0
+
+    def test_empty_start_charges_first_bytes(self):
+        # no day-one burst: the very first reservation already pays
+        # full price, so repair cannot blast a fresh node
+        b = TokenBucket(1000)
+        wait = b.reserve(1000)
+        assert 0.9 <= wait <= 1.1
+
+    def test_wait_is_debt_over_rate(self):
+        b = TokenBucket(1000, burst=0)
+        b.reserve(500)
+        wait = b.reserve(500)
+        assert 0.9 <= wait <= 1.1
+        assert b.debt == pytest.approx(1000, rel=0.1)
+
+    def test_cancel_un_debits(self):
+        b = TokenBucket(1000, burst=0)
+        b.reserve(5000)
+        before = b.debt
+        b.cancel(5000)
+        assert b.debt <= before - 4999
+
+    def test_acquire_timeout_refuses_and_cancels(self):
+        b = TokenBucket(1000, burst=0)
+        assert b.acquire(10_000, timeout=0.05) is False
+        # the refused bytes were returned: a small grant goes through
+        assert b.reserve(1) < 0.2
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(1_000_000, burst=2000)
+        b.cancel(10 << 20)  # massive credit attempt
+        assert b.fill <= 2000
+
+    def test_configure_keeps_debt(self):
+        b = TokenBucket(1000, burst=0)
+        b.reserve(2000)
+        b.configure(2000)
+        # debt survives the rate change (no byte forgiveness)
+        assert b.debt >= 1500
+        assert b.state()["rate"] == 2000
+
+
+class TestFifo:
+    def test_large_request_not_overtaken(self):
+        # reservation-style accounting: once the big request has
+        # debited, every later small request queues BEHIND it
+        b = TokenBucket(100_000, burst=0)
+        w_big = b.reserve(200_000)
+        assert w_big > 1.0
+        waits = [b.reserve(1_000) for _ in range(20)]
+        assert all(w >= w_big for w in waits)
+        # strictly increasing modulo clock refill between calls
+        assert waits[-1] > waits[0]
+
+
+class TestConcurrentCap:
+    def test_cap_never_exceeded_over_any_window(self):
+        """6 workers hammer one bucket; admission timestamps must
+        satisfy bytes(any window w) <= rate*w + burst + one chunk."""
+        rate, chunk = 400_000, 20_000
+        b = TokenBucket(rate)
+        grants: list[tuple[float, int]] = []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + 1.2
+
+        def worker():
+            while time.monotonic() < stop_at:
+                if b.acquire(chunk, timeout=2.0):
+                    with lock:
+                        grants.append((time.monotonic(), chunk))
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert grants, "no bytes admitted at all"
+        total = sum(n for _, n in grants)
+        elapsed = max(g[0] for g in grants) - t0
+        # whole-run average: rate + the one-burst allowance
+        assert total <= rate * max(elapsed, 0.01) + b.burst + chunk
+        # sliding 0.5s windows anchored at each grant
+        times = sorted(t for t, _ in grants)
+        for w in (0.25, 0.5, 1.0):
+            for anchor in times:
+                in_win = sum(n for t, n in grants
+                             if anchor <= t <= anchor + w)
+                assert in_win <= rate * w + b.burst + chunk, \
+                    f"window {w}s admitted {in_win} bytes"
+
+    def test_no_worker_starves(self):
+        """Every concurrent worker gets SOME bytes through — FIFO
+        reservations cannot shut one thread out."""
+        b = TokenBucket(500_000)
+        got = [0] * 4
+        stop_at = time.monotonic() + 0.8
+
+        def worker(i):
+            while time.monotonic() < stop_at:
+                if b.acquire(10_000, timeout=2.0):
+                    got[i] += 10_000
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(g > 0 for g in got), got
+
+
+class TestRegistry:
+    def test_bucket_get_or_create_and_reconfigure(self):
+        b1 = ratelimit.bucket("repair", 1000)
+        b2 = ratelimit.bucket("repair", 1000)
+        assert b1 is b2
+        b3 = ratelimit.bucket("repair", 2000)  # live rate change
+        assert b3 is b1
+        assert b1.rate == 2000
+
+    def test_snapshot_shape(self):
+        ratelimit.bucket("repair", 1234).reserve(100)
+        snap = ratelimit.snapshot()
+        assert set(snap) == {"repair"}
+        assert set(snap["repair"]) == {"rate", "burst", "fill", "debt"}
+        assert snap["repair"]["rate"] == 1234
+
+    def test_reset_drops_buckets(self):
+        ratelimit.bucket("repair", 10)
+        ratelimit.reset()
+        assert ratelimit.snapshot() == {}

@@ -1,0 +1,189 @@
+"""Placement-aware repair target selection (master/placement.py).
+
+Property tests over randomized topologies plus deterministic spread
+cases: selection must NEVER pick a node already holding a copy, must
+prefer cross-rack/cross-dc spread whenever a spread-preserving node
+has free slots (violations == 0 there), and must count a violation —
+while still repairing — when the survivors leave no such node.
+"""
+from __future__ import annotations
+
+import random
+
+from seaweedfs_tpu.master import placement
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+def node(url, dc="dc1", rack="r1", volumes=(), max_volumes=10,
+         ec=None):
+    return {"url": url, "dc": dc, "rack": rack,
+            "volumes": list(volumes), "max_volumes": max_volumes,
+            "ec_volumes": dict(ec or {})}
+
+
+class TestFreeSlots:
+    def test_matches_datanode_formula(self):
+        n = node("a", volumes=[1, 2], max_volumes=10,
+                 ec={"7": (1 << 14) - 1})  # 14 shards = 1 slot
+        assert placement.free_slots(n) == 10 - 2 - 1
+
+    def test_full_node_has_none(self):
+        assert placement.free_slots(
+            node("a", volumes=range(5), max_volumes=5)) == 0
+
+
+class TestReplicaTargets:
+    def test_never_picks_holder_property(self):
+        rng = random.Random(7)
+        for _ in range(300):
+            nodes = []
+            for d in range(rng.randint(1, 3)):
+                for r in range(rng.randint(1, 3)):
+                    for i in range(rng.randint(1, 3)):
+                        nodes.append(node(
+                            f"d{d}r{r}n{i}", dc=f"dc{d}",
+                            rack=f"r{r}",
+                            volumes=range(rng.randint(0, 4)),
+                            max_volumes=rng.choice([0, 2, 5, 8])))
+            rp = rng.choice(["001", "010", "011", "020", "100", "200"])
+            want = ReplicaPlacement.parse(rp).copy_count
+            holders = rng.sample(nodes,
+                                 rng.randint(1, min(len(nodes), want)))
+            need = max(1, want - len(holders))
+            targets, violations = placement.select_replica_targets(
+                nodes, holders, rp, need)
+            holder_urls = {h["url"] for h in holders}
+            urls = [t["url"] for t in targets]
+            assert not holder_urls & set(urls), "picked a holder"
+            assert len(set(urls)) == len(urls), "picked a node twice"
+            for t in targets:
+                assert placement.free_slots(t) > 0, "picked a full node"
+            assert violations >= 0
+
+    def test_prefers_cross_rack_when_slots_exist(self):
+        # survivor in rack A; racks B and C have room -> the new
+        # replica must extend rack spread, zero violations
+        nodes = [node("a1", rack="rA"), node("a2", rack="rA"),
+                 node("b1", rack="rB"), node("c1", rack="rC")]
+        targets, violations = placement.select_replica_targets(
+            nodes, [nodes[0]], "010", 1)
+        assert len(targets) == 1
+        assert targets[0]["rack"] in ("rB", "rC")
+        assert violations == 0
+
+    def test_prefers_cross_rack_even_when_not_required(self):
+        # rp 001 (same-rack allowed): with equal load, still take the
+        # free spread — a healed cluster should not be weaker
+        nodes = [node("a1", rack="rA"), node("a2", rack="rA"),
+                 node("b1", rack="rB")]
+        targets, _ = placement.select_replica_targets(
+            nodes, [nodes[0]], "001", 1)
+        assert targets[0]["url"] == "b1"
+
+    def test_forced_colocation_counts_violation(self):
+        # every free-slot survivor is in the holder's rack: repair
+        # proceeds (redundancy beats placement) but flags it
+        nodes = [node("a1", rack="rA"), node("a2", rack="rA"),
+                 node("b1", rack="rB", volumes=range(5),
+                      max_volumes=5)]  # rB full
+        targets, violations = placement.select_replica_targets(
+            nodes, [nodes[0]], "010", 1)
+        assert [t["url"] for t in targets] == ["a2"]
+        assert violations == 1
+
+    def test_dc_spread_outranks_rack_spread(self):
+        nodes = [node("x", dc="dc1", rack="rA"),
+                 node("y", dc="dc1", rack="rB"),
+                 node("z", dc="dc2", rack="rC")]
+        targets, violations = placement.select_replica_targets(
+            nodes, [nodes[0]], "100", 1)
+        assert targets[0]["url"] == "z"
+        assert violations == 0
+
+    def test_multi_target_spread_updates_between_picks(self):
+        # need two new replicas on rp 020: they must land in two
+        # DIFFERENT new racks, not both in the same one
+        nodes = [node("a1", rack="rA"),
+                 node("b1", rack="rB"), node("b2", rack="rB"),
+                 node("c1", rack="rC")]
+        targets, violations = placement.select_replica_targets(
+            nodes, [nodes[0]], "020", 2)
+        assert len({t["rack"] for t in targets}) == 2
+        assert violations == 0
+
+    def test_no_candidates_returns_empty(self):
+        nodes = [node("a1", volumes=range(3), max_volumes=3)]
+        targets, violations = placement.select_replica_targets(
+            nodes, [node("h", rack="rZ")], "010", 1)
+        assert targets == [] and violations == 0
+
+
+class TestEcRebuilder:
+    def _locs(self, assign: dict[int, str]) -> dict[int, list[str]]:
+        return {sid: [url] for sid, url in assign.items()}
+
+    def test_prefers_shardless_node_in_lightest_rack(self):
+        nodes = [node("a1", rack="rA"), node("b1", rack="rB"),
+                 node("c1", rack="rC")]
+        # rA holds 5 shards, rB 4 — rC holds none and must win
+        locs = self._locs({i: "a1" for i in range(5)} |
+                          {i + 5: "b1" for i in range(4)})
+        chosen, violations = placement.select_ec_rebuilder(
+            nodes, 1, locs)
+        assert chosen["url"] == "c1"
+        assert violations == 0
+
+    def test_never_picks_holder_when_free_node_exists(self):
+        rng = random.Random(11)
+        for _ in range(200):
+            nodes = [node(f"n{i}", rack=f"r{i % 3}",
+                          max_volumes=rng.choice([1, 4, 8]))
+                     for i in range(rng.randint(3, 8))]
+            holders = rng.sample(nodes, rng.randint(1, len(nodes) - 1))
+            locs = {sid: [h["url"]]
+                    for sid, h in enumerate(holders)}
+            chosen, violations = placement.select_ec_rebuilder(
+                nodes, 9, locs)
+            holder_urls = {h["url"] for h in holders}
+            free_nonholders = [n for n in nodes
+                               if n["url"] not in holder_urls
+                               and placement.free_slots(n) > 0]
+            if free_nonholders:
+                assert chosen["url"] not in holder_urls
+                assert violations == 0
+
+    def test_forced_colocation_flagged(self):
+        nodes = [node("a1", rack="rA"), node("b1", rack="rB")]
+        locs = self._locs({0: "a1", 1: "b1"})
+        chosen, violations = placement.select_ec_rebuilder(
+            nodes, 3, locs)
+        assert chosen is not None
+        assert violations == 1
+
+    def test_all_full_returns_none(self):
+        nodes = [node("a1", volumes=range(3), max_volumes=3)]
+        chosen, violations = placement.select_ec_rebuilder(
+            nodes, 3, {})
+        assert chosen is None and violations == 0
+
+
+class TestEcSpreadOrder:
+    def test_rack_balanced_14_shards_3_racks(self):
+        nodes = [node(f"{r}{i}", rack=r, max_volumes=40)
+                 for r in ("rA", "rB", "rC") for i in range(2)]
+        order = placement.ec_spread_order(nodes, 14)
+        assert len(order) == 14
+        by_rack: dict[str, int] = {}
+        for n in order:
+            by_rack[n["rack"]] = by_rack.get(n["rack"], 0) + 1
+        # 14 over 3 racks -> 5,5,4: a rack loss costs at most 5 shards
+        assert max(by_rack.values()) - min(by_rack.values()) <= 1
+        assert max(by_rack.values()) == 5
+
+    def test_single_rack_round_robins_nodes(self):
+        nodes = [node(f"n{i}", max_volumes=40) for i in range(3)]
+        order = placement.ec_spread_order(nodes, 6)
+        counts: dict[str, int] = {}
+        for n in order:
+            counts[n["url"]] = counts.get(n["url"], 0) + 1
+        assert set(counts.values()) == {2}

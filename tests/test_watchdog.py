@@ -93,6 +93,26 @@ class TestDeficitVisibility:
                            json={"volume": "x", "kind": "replica"},
                            timeout=5)
         assert r.status_code == 400
+        # non-JSON body: 400 with a JSON error, not a 500
+        r = session().post(cluster.master_url + "/debug/repair",
+                           data=b"\x00not json",
+                           headers={"Content-Type":
+                                    "application/json"},
+                           timeout=5)
+        assert r.status_code == 400
+        assert "error" in r.json()
+        # JSON but not an object
+        r = session().post(cluster.master_url + "/debug/repair",
+                           json=[1, 2, 3], timeout=5)
+        assert r.status_code == 400
+        assert "error" in r.json()
+        # non-positive volume ids are never silently accepted
+        for bad_vid in (0, -3):
+            r = session().post(cluster.master_url + "/debug/repair",
+                               json={"volume": bad_vid,
+                                     "kind": "replica"}, timeout=5)
+            assert r.status_code == 400, bad_vid
+            assert "error" in r.json()
         r = session().post(cluster.master_url + "/debug/repair",
                            json={"volume": 7, "kind": "replica",
                                  "reason": "test"}, timeout=5)
