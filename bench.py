@@ -964,6 +964,298 @@ def bench_hedge_sweep(argv: list[str]) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_qos_sweep(argv: list[str]) -> int:
+    """`python bench.py qos-sweep [--duration 6] [--tame-rps 20]
+    [--greedy-rps 150] [--rate 204800] [--slo-ms 750]
+    [--out BENCH_QOS.json]`
+
+    The PR-8 protection-layer surface: an OPEN-LOOP (arrival-rate, not
+    closed-loop) mixed-tenant workload drives both gateway fronts past
+    saturation. A tame tenant arrives well inside its provisioned
+    rate; a greedy tenant arrives several times over it. The edge QoS
+    layer must rate-limit the greedy tenant (503 + Retry-After +
+    X-Sw-Retryable, counted in qos_shed_total) while the tame tenant
+    keeps 100% success and its p99 inside the SLO — at the filer front
+    (tenant = path prefix) AND the s3 front (tenant = access key).
+    Master + volume run as real subprocesses; the filer and s3
+    gateways run in-process so the sweep configures utils/qos directly
+    and reads counters without scraping (the hedge-sweep pattern)."""
+    import os
+    import shutil
+    import signal as _signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    import requests as rq
+
+    from seaweedfs_tpu.rpc.http import ServerThread
+    from seaweedfs_tpu.s3.server import S3ApiServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.utils import metrics, qos
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    duration = float(opt("--duration", "6"))
+    tame_rps = float(opt("--tame-rps", "20"))
+    greedy_rps = float(opt("--greedy-rps", "150"))
+    rate = float(opt("--rate", str(50 * 4096)))  # ~25 8KiB-req/s cap
+    slo_ms = float(opt("--slo-ms", "750"))
+    out_path = opt("--out", "BENCH_QOS.json")
+    tame_body = b"t" * 512       # floor-charged (4096)
+    greedy_body = b"g" * 8192    # body-charged: 4x over capacity at
+    # greedy_rps, so the sweep saturates by construction
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_http(url: str, timeout: float = 30) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                rq.get(url, timeout=1)
+                return
+            except rq.RequestException:
+                time.sleep(0.15)
+        raise TimeoutError(f"{url} never came up")
+
+    def counter(name: str, **labels) -> float:
+        want = tuple(sorted(labels.items()))
+        with metrics._lock:
+            return sum(v for (n, lab), v in metrics._counters.items()
+                       if n == name and set(want) <= set(lab))
+
+    def run_phase(gateway: str, url_of, tenants: dict) -> dict:
+        """Open-loop load: each tenant's arrivals fire on a fixed
+        schedule regardless of completions (a stalled gateway gets
+        MORE concurrent load, exactly like real traffic — the failure
+        mode a closed-loop bench can never show). Outstanding client
+        threads are capped; an arrival that finds the cap exhausted is
+        counted, not delayed — the schedule never blocks."""
+        stats = {t: {"sent": 0, "acked": 0, "shed": 0, "errors": 0,
+                     "client_capped": 0, "lats": []}
+                 for t in tenants}
+        lock = threading.Lock()
+        sem = threading.Semaphore(192)
+        workers: list[threading.Thread] = []
+
+        def fire(tenant: str, url: str, body: bytes) -> None:
+            try:
+                t0 = time.perf_counter()
+                try:
+                    r = rq.put(url, data=body, timeout=30)
+                    code = r.status_code
+                except rq.RequestException:
+                    code = -1
+                lat = time.perf_counter() - t0
+                with lock:
+                    st = stats[tenant]
+                    if code in (200, 201):
+                        st["acked"] += 1
+                        st["lats"].append(lat)
+                    elif code == 503:
+                        st["shed"] += 1
+                    else:
+                        st["errors"] += 1
+            finally:
+                sem.release()
+
+        def generate(tenant: str) -> None:
+            rps, body = tenants[tenant]
+            t0 = time.monotonic()
+            end = t0 + duration
+            i = 0
+            while True:
+                due = t0 + i / rps
+                if due >= end:
+                    break
+                now = time.monotonic()
+                if due > now:
+                    time.sleep(due - now)
+                with lock:
+                    stats[tenant]["sent"] += 1
+                if sem.acquire(blocking=False):
+                    th = threading.Thread(
+                        target=fire,
+                        args=(tenant, url_of(tenant, i), body),
+                        daemon=True)
+                    th.start()
+                    workers.append(th)
+                else:
+                    with lock:
+                        stats[tenant]["client_capped"] += 1
+                i += 1
+
+        gens = [threading.Thread(target=generate, args=(t,))
+                for t in tenants]
+        for g in gens:
+            g.start()
+        for g in gens:
+            g.join()
+        for w in workers:
+            w.join(timeout=35)
+        rows = {}
+        for t, st in stats.items():
+            lats_ms = np.sort(np.array(st["lats"])) * 1e3 \
+                if st["lats"] else np.array([0.0])
+            rows[t] = {
+                "sent": st["sent"], "acked": st["acked"],
+                "shed": st["shed"], "errors": st["errors"],
+                "client_capped": st["client_capped"],
+                "shed_frac": round(st["shed"] / max(1, st["sent"]), 3),
+                "p50_ms": round(float(np.percentile(lats_ms, 50)), 1),
+                "p99_ms": round(float(np.percentile(lats_ms, 99)), 1),
+                "qos_shed_total": counter("qos_shed_total", tenant=t),
+                "qos_admitted_total": counter("qos_admitted_total",
+                                              tenant=t),
+            }
+            log(f"  [{gateway}] {t:10s} sent {st['sent']:4d}  acked "
+                f"{st['acked']:4d}  shed {st['shed']:4d}  errors "
+                f"{st['errors']:3d}  p50 {rows[t]['p50_ms']}ms  p99 "
+                f"{rows[t]['p99_ms']}ms")
+        return rows
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PYTHONPATH=repo)
+    tmp = tempfile.mkdtemp(prefix="qos_sweep_")
+    procs: list[subprocess.Popen] = []
+
+    def spawn(*args: str) -> None:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *args], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+    filer_thread = s3_thread = None
+    try:
+        mport = free_port()
+        master = f"http://127.0.0.1:{mport}"
+        spawn("master", "-port", str(mport),
+              "-volumeSizeLimitMB", "64")
+        wait_http(f"{master}/cluster/status")
+        vp = free_port()
+        vd = os.path.join(tmp, "vol0")
+        os.makedirs(vd)
+        spawn("volume", "-port", str(vp), "-dir", vd,
+              "-mserver", f"127.0.0.1:{mport}")
+        wait_http(f"http://127.0.0.1:{vp}/status")
+
+        fs = FilerServer(master, store="memory")
+        filer_thread = ServerThread(fs.app, host="127.0.0.1",
+                                    port=0).start()
+        fs.address = filer_thread.address
+        filer_url = filer_thread.url
+        s3srv = S3ApiServer(filer_url)
+        s3_thread = ServerThread(s3srv.app, host="127.0.0.1",
+                                 port=0).start()
+        s3_url = s3_thread.url
+        r = rq.put(f"{s3_url}/qosbench", timeout=10)
+        assert r.status_code == 200, (r.status_code, r.text)
+
+        # provision every tenant at `rate`; the S3 gateway's own
+        # filer traffic (path prefix "buckets") rides unshaped — in a
+        # real deployment the two gateways are separate processes with
+        # separate registries, in-process they share one
+        qos.reset()
+        qos.configure(enabled=True, rate=rate, max_delay=0.3,
+                      request_floor=4096)
+        qos.load_spec({"tenants": {"buckets": {"rate": 0}}})
+
+        log(f"qos sweep: rate {rate:.0f} B/s/tenant, tame "
+            f"{tame_rps:.0f} rps x {len(tame_body)}B, greedy "
+            f"{greedy_rps:.0f} rps x {len(greedy_body)}B, "
+            f"{duration:.0f}s per gateway")
+        filer_rows = run_phase(
+            "filer",
+            lambda t, i: f"{filer_url}/{t}/o{i}",
+            {"tamef": (tame_rps, tame_body),
+             "greedyf": (greedy_rps, greedy_body)})
+        s3_rows = run_phase(
+            "s3",
+            lambda t, i: (f"{s3_url}/qosbench/{t}/o{i}"
+                          f"?X-Amz-Credential={t}/20260101/us-east-1"
+                          "/s3/aws4_request"),
+            {"AKIDTAME": (tame_rps, tame_body),
+             "AKIDGREEDY": (greedy_rps, greedy_body)})
+
+        # per-tenant SLOs: the whole point of the layer
+        failures = []
+        for gw, rows, tame, greedy in (
+                ("filer", filer_rows, "tamef", "greedyf"),
+                ("s3", s3_rows, "AKIDTAME", "AKIDGREEDY")):
+            tr, gr = rows[tame], rows[greedy]
+            if tr["shed"] or tr["errors"]:
+                failures.append(f"{gw}: tame tenant lost requests "
+                                f"({tr['shed']} shed, "
+                                f"{tr['errors']} errors)")
+            if tr["p99_ms"] > slo_ms:
+                failures.append(f"{gw}: tame p99 {tr['p99_ms']}ms "
+                                f"over the {slo_ms}ms SLO")
+            if gr["shed_frac"] < 0.3:
+                failures.append(f"{gw}: greedy tenant only "
+                                f"{gr['shed_frac']:.0%} shed — not "
+                                "rate-limited")
+            if gr["errors"]:
+                failures.append(f"{gw}: greedy tenant saw "
+                                f"{gr['errors']} non-shed errors")
+        result = {
+            "config": {
+                "duration_s": duration, "tame_rps": tame_rps,
+                "greedy_rps": greedy_rps,
+                "rate_bytes_per_sec": rate, "max_delay_s": 0.3,
+                "request_floor": 4096,
+                "tame_body": len(tame_body),
+                "greedy_body": len(greedy_body),
+                "tame_slo_p99_ms": slo_ms,
+                "workload": "open-loop fixed-rate arrivals "
+                            "(schedule never blocks on completions)",
+            },
+            "filer_gateway": filer_rows,
+            "s3_gateway": s3_rows,
+            "slo_failures": failures,
+        }
+        with open(os.path.join(repo, out_path), "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        worst_tame_p99 = max(
+            filer_rows["tamef"]["p99_ms"], s3_rows["AKIDTAME"]["p99_ms"])
+        print(json.dumps({
+            "metric": "qos_sweep_tame_p99_ms",
+            "value": worst_tame_p99,
+            "unit": "ms",
+            "extra": {"slo_ms": slo_ms, "failures": failures,
+                      "out": out_path},
+        }), flush=True)
+        if failures:
+            log("SLO FAILURES:\n  " + "\n  ".join(failures))
+            return 1
+        return 0
+    finally:
+        qos.reset()
+        for t in (s3_thread, filer_thread):
+            if t is not None:
+                try:
+                    t.stop()
+                except Exception:
+                    pass
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(_signal.SIGINT)
+        for p in reversed(procs):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_repair_sweep(argv: list[str]) -> int:
     """`python bench.py repair-sweep [--caps 0,2000000,1000000,500000]
     [--out BENCH_REPAIR.json]`
@@ -1246,4 +1538,6 @@ if __name__ == "__main__":
         sys.exit(bench_mesh_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "repair-sweep":
         sys.exit(bench_repair_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "qos-sweep":
+        sys.exit(bench_qos_sweep(sys.argv[2:]))
     main()

@@ -127,6 +127,46 @@ def main(argv: list[str] | None = None) -> int:
              "alternate location (default 0.35); place BEFORE the "
              "subcommand")
     parser.add_argument(
+        "-qos.enabled", dest="qos_enabled", action="store_true",
+        help="per-tenant QoS + overload shedding at the s3/filer "
+             "gateway edge (tenant = access key at s3, first path "
+             "segment at the filer); place BEFORE the subcommand")
+    parser.add_argument(
+        "-qos.rate", dest="qos_rate", type=float, default=None,
+        help="default per-tenant byte rate at the gateway edge "
+             "(bytes/sec; 0 = unlimited); place BEFORE the subcommand")
+    parser.add_argument(
+        "-qos.burst", dest="qos_burst", type=float, default=None,
+        help="default per-tenant burst allowance in bytes (default "
+             "max(64KiB, rate/8)); place BEFORE the subcommand")
+    parser.add_argument(
+        "-qos.maxTenants", dest="qos_max_tenants", type=int,
+        default=None,
+        help="distinct tenant buckets a gateway tracks before later "
+             "tenants share the __overflow__ bucket — bounds both "
+             "memory and the tenant metric label (default 256); "
+             "place BEFORE the subcommand")
+    parser.add_argument(
+        "-qos.maxDelay", dest="qos_max_delay", type=float,
+        default=None,
+        help="seconds of quoted queue delay beyond which a request "
+             "is shed with 503 instead of paced (default 2.0); "
+             "requests whose X-Sw-Deadline budget is smaller than "
+             "the quote are shed regardless; place BEFORE the "
+             "subcommand")
+    parser.add_argument(
+        "-qos.requestFloor", dest="qos_request_floor", type=int,
+        default=None,
+        help="minimum bytes charged per request so body-less ops "
+             "(GET/HEAD/LIST) are shaped too (default 4096); place "
+             "BEFORE the subcommand")
+    parser.add_argument(
+        "-qos.spec", dest="qos_spec", default="",
+        help="path to a per-tenant JSON spec "
+             "('{\"default\": {\"rate\":...}, \"tenants\": {\"akid\": "
+             "{\"rate\":..., \"priority\":...}}}'), hot-reloaded on "
+             "mtime change; place BEFORE the subcommand")
+    parser.add_argument(
         "-security", default="",
         help="path to a security config JSON (scaffold "
              "-config=security): enables HTTPS (+ optional mutual "
@@ -600,6 +640,7 @@ def main(argv: list[str] | None = None) -> int:
     if getattr(args, "ec_mesh_col", 0):
         os.environ["SEAWEEDFS_TPU_EC_MESH_COL"] = str(args.ec_mesh_col)
     from .utils import faults as _faults
+    from .utils import qos as _qos
     from .utils import retry as _retry
 
     _faults.configure(spec=args.fault_spec or None,
@@ -611,6 +652,13 @@ def main(argv: list[str] | None = None) -> int:
                      breaker_failures=args.breaker_failures,
                      breaker_reset=args.breaker_reset,
                      hedge_delay=args.hedge_delay)
+    _qos.configure(enabled=args.qos_enabled or None,
+                   rate=args.qos_rate,
+                   burst=args.qos_burst,
+                   max_tenants=args.qos_max_tenants,
+                   max_delay=args.qos_max_delay,
+                   request_floor=args.qos_request_floor,
+                   spec=args.qos_spec or None)
     if args.memprofile:
         import tracemalloc
 

@@ -24,6 +24,9 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+from collections import OrderedDict
+
+from ..utils import metrics
 
 STREAMING_SIGNED = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 STREAMING_UNSIGNED = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
@@ -35,7 +38,8 @@ class ChunkSignatureError(Exception):
     pass
 
 
-_KEY_CACHE: dict[tuple[str, str, str, str], bytes] = {}
+_KEY_CACHE: OrderedDict[tuple[str, str, str, str], bytes] = OrderedDict()
+_KEY_CACHE_CAP = 1024
 
 
 def signing_key(secret: str, datestamp: str, region: str,
@@ -43,19 +47,28 @@ def signing_key(secret: str, datestamp: str, region: str,
     """Derived AWS4 signing key, memoized: the derivation chain is 4
     HMACs but its inputs only change once per DAY per identity —
     re-deriving per request was ~half the gateway's SigV4 verify cost.
-    The cache stays tiny (identities x days) and clears itself on
-    rollover."""
+    LRU-bounded at 1024 entries: identity churn at high tenant counts
+    evicts only the coldest key, instead of the old clear-everything
+    policy whose rollover re-derived every live identity's key at
+    once (a thundering herd exactly when the gateway is busiest)."""
     ck = (secret, datestamp, region, service)
     hit = _KEY_CACHE.get(ck)
     if hit is not None:
+        _KEY_CACHE.move_to_end(ck)
+        metrics.counter_add("s3_signing_key_cache_total",
+                            labels={"outcome": "hit"})
         return hit
     k = hmac.new(("AWS4" + secret).encode(), datestamp.encode(),
                  hashlib.sha256).digest()
     for msg in (region, service, "aws4_request"):
         k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
-    if len(_KEY_CACHE) > 1024:  # datestamp rollover / identity churn
-        _KEY_CACHE.clear()
+    metrics.counter_add("s3_signing_key_cache_total",
+                        labels={"outcome": "miss"})
     _KEY_CACHE[ck] = k
+    if len(_KEY_CACHE) > _KEY_CACHE_CAP:
+        _KEY_CACHE.popitem(last=False)  # coldest (identity, day) only
+        metrics.counter_add("s3_signing_key_cache_total",
+                            labels={"outcome": "evict"})
     return k
 
 

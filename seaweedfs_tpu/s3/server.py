@@ -30,7 +30,7 @@ from ..rpc import httpclient
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
-from ..utils import extheaders, faults, metrics, retry, tracing
+from ..utils import extheaders, faults, metrics, qos, retry, tracing
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, IdentityAccessManagement, S3AuthError)
 
@@ -290,6 +290,10 @@ class S3ApiServer:
             client_max_size=1 << 30,
             middlewares=[tracing.aiohttp_middleware("s3"),
                          retry.aiohttp_middleware("s3", edge=True),
+                         # qos AFTER retry: the deadline middleware
+                         # binds the budget the admission check prices
+                         # the queue delay against
+                         qos.aiohttp_middleware("s3", qos.s3_tenant),
                          faults.aiohttp_middleware("s3"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
@@ -297,6 +301,7 @@ class S3ApiServer:
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
+            web.get("/debug/qos", qos.handle_debug_qos_factory()),
             web.route("*", "/{tail:.*}", self.dispatch),
         ])
         return app

@@ -30,7 +30,7 @@ from ..filer import (Entry, FileChunk, Filer, etag_chunks,
 from ..filer.filechunks import MANIFEST_BATCH
 from ..filer.filer import DirectoryNotEmptyError
 from ..operation import verbs
-from ..utils import faults, httprange, metrics, retry, tracing
+from ..utils import faults, httprange, metrics, qos, retry, tracing
 from ..wdclient.client import MasterClient
 
 DEFAULT_CHUNK_SIZE = 8 << 20  # autochunk default (`-maxMB=8` upstream)
@@ -319,6 +319,11 @@ class FilerServer:
             client_max_size=1 << 40,
             middlewares=[tracing.aiohttp_middleware("filer"),
                          retry.aiohttp_middleware("filer", edge=True),
+                         # qos AFTER retry: admission prices the queue
+                         # delay against the deadline budget retry
+                         # just bound
+                         qos.aiohttp_middleware("filer",
+                                                qos.filer_tenant),
                          faults.aiohttp_middleware("filer"), error_mw])
         app.add_routes([
             web.get("/status", self.handle_status),
@@ -326,6 +331,7 @@ class FilerServer:
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
+            web.get("/debug/qos", qos.handle_debug_qos_factory()),
             web.get("/debug/ec", self.handle_debug_ec),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
             web.post("/dlm/lock", self.handle_dlm_lock),

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import time
 import zlib
 
@@ -609,6 +610,10 @@ class MasterServer:
                 self.watchdog.placement_violations,
             # per-node repair bucket fill/debt as last heartbeated
             "RepairBandwidth": self._repair_bandwidth(),
+            # edge QoS shed/admit totals summarized from the federated
+            # gateway scrapes (the raw per-tenant series live in
+            # /cluster/metrics)
+            "Qos": self._qos_summary(),
             "Observability": {
                 **self.collector.observability(),
                 "Federation": self.federator.observability(),
@@ -764,6 +769,38 @@ class MasterServer:
             return {n.url: n.repair_bw
                     for n in self.topo.nodes.values()
                     if n.repair_bw is not None}
+
+    _QOS_SERIES = re.compile(
+        r'^(qos_shed_total|qos_admitted_total)\{([^}]*)\}\s+'
+        r'([0-9.eE+-]+)\s*$')
+
+    def _qos_summary(self) -> dict:
+        """Cluster-wide admit/shed totals per tenant, folded from the
+        last federated scrape of each gateway (the master itself never
+        runs the edge layer, so its view is the scraped corpus)."""
+        with self.federator._lock:
+            texts = [s["text"] for s in self.federator._scraped.values()
+                     if s.get("text")]
+        admitted: dict[str, float] = {}
+        shed: dict[str, dict[str, float]] = {}
+        for text in texts:
+            for line in text.splitlines():
+                m = self._QOS_SERIES.match(line.strip())
+                if not m:
+                    continue
+                fam, rawlab, val = m.groups()
+                labels = dict(
+                    p.split("=", 1) for p in rawlab.split(",") if "=" in p)
+                tenant = labels.get("tenant", "").strip('"')
+                if not tenant:
+                    continue
+                if fam == "qos_admitted_total":
+                    admitted[tenant] = admitted.get(tenant, 0) + float(val)
+                else:
+                    reason = labels.get("reason", "").strip('"')
+                    by = shed.setdefault(tenant, {})
+                    by[reason] = by.get(reason, 0) + float(val)
+        return {"Admitted": admitted, "Shed": shed}
 
     async def handle_repair_enqueue(self, req: web.Request) -> web.Response:
         """Enqueue one repair (scrub wiring + operator hook):

@@ -39,18 +39,27 @@ class TokenBucket:
 
     def __init__(self, rate: float, burst: float | None = None):
         self._lock = threading.Lock()
+        # waiters park on the condition so a live configure() can wake
+        # them to re-price their remaining wait at the new rate
+        self._cond = threading.Condition(self._lock)
         self._t = time.monotonic()
         self.configure(rate, burst)
 
     def configure(self, rate: float, burst: float | None = None) -> None:
         """(Re)set the rate; keeps accumulated debt so a live rate
-        change never forgives bytes already granted."""
+        change never forgives bytes already granted. Sleeping waiters
+        are woken to re-price what they still owe at the new rate — a
+        raise un-strands them early, a cut extends their wait instead
+        of letting them duck under the new cap."""
         with self._lock:
             self.rate = float(rate)
             self.burst = (float(burst) if burst is not None
                           else max(64 << 10, self.rate / 8.0))
             if not hasattr(self, "_tokens"):
                 self._tokens = 0.0  # start empty: no day-one burst
+            elif self._tokens > self.burst:
+                self._tokens = self.burst  # a burst cut caps the fill
+            self._cond.notify_all()
 
     def _refill_locked(self, now: float) -> None:
         self._tokens = min(self.burst,
@@ -77,16 +86,99 @@ class TokenBucket:
             self._refill_locked(time.monotonic())
             self._tokens = min(self.burst, self._tokens + n)
 
+    def _owed(self, n: int) -> float:
+        """Debit ``n`` bytes; return the refill BYTES still owed before
+        the grant matures (0.0 = immediately available). Unlike the
+        seconds `reserve` quotes, owed bytes stay correct across a
+        live `configure`: the remaining wait is owed/rate at whatever
+        the rate currently is."""
+        if self.rate <= 0 or n <= 0:
+            return 0.0
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self._tokens -= n
+            return max(0.0, -self._tokens)
+
+    def _pay(self, owed: float, deadline: float | None) -> bool:
+        """Sleep until ``owed`` bytes have been refilled at the
+        prevailing (possibly re-configured) rate. Each configure()
+        wakes the wait so the residue is re-priced — a FIFO waiter is
+        never stranded sleeping a stale quote."""
+        with self._cond:
+            while owed > 1e-9:
+                rate = self.rate
+                if rate <= 0:
+                    return True  # now unlimited: everything is paid
+                wait = owed / rate
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                t0 = time.monotonic()
+                self._cond.wait(wait)
+                # configure() notifies, ending the slice — but the
+                # tail between the change and the wake-up ran at the
+                # NEW rate, so deduct at whichever rate is lower:
+                # conservative, never undercharges the live cap
+                now_rate = self.rate
+                paid_rate = min(rate, now_rate) if now_rate > 0 else rate
+                owed -= (time.monotonic() - t0) * paid_rate
+        return True
+
     def acquire(self, n: int, timeout: float | None = None) -> bool:
         """Blocking reserve: sleep until ``n`` bytes are available.
         With ``timeout``, refuse (and un-debit) when the queue is so
         deep the wait would exceed it."""
-        wait = self.reserve(n)
-        if timeout is not None and wait > timeout:
+        if self.rate <= 0 or n <= 0:
+            return True
+        owed = self._owed(n)
+        if timeout is not None and owed > timeout * self.rate:
             self.cancel(n)
             return False
-        if wait > 0:
-            time.sleep(wait)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        if owed > 0 and not self._pay(owed, deadline):
+            self.cancel(n)
+            return False
+        return True
+
+    async def acquire_async(self, n: int,
+                            timeout: float | None = None) -> bool:
+        """Event-loop-friendly acquire: identical accounting, but the
+        wait is `await asyncio.sleep(...)` — never a blocking sleep on
+        the loop thread. A rate CUT mid-wait is honoured (the residue
+        re-prices each slice and the waiter sleeps longer); a raise is
+        picked up on the next slice boundary, so an async waiter may
+        oversleep its original quote but can never violate the cap."""
+        import asyncio
+
+        if self.rate <= 0 or n <= 0:
+            return True
+        owed = self._owed(n)
+        if timeout is not None and owed > timeout * self.rate:
+            self.cancel(n)
+            return False
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while owed > 1e-9:
+            rate = self.rate
+            if rate <= 0:
+                return True
+            wait = owed / rate
+            if deadline is not None:
+                wait = min(wait, deadline - time.monotonic())
+                if wait <= 0:
+                    self.cancel(n)
+                    return False
+            t0 = time.monotonic()
+            await asyncio.sleep(wait)
+            # no condition to wake an async sleeper, so the slice may
+            # span a configure(): deduct at the LOWER of the rates it
+            # straddled — a cut is honoured in full, a raise is picked
+            # up next slice (oversleep, never a cap violation)
+            now_rate = self.rate
+            paid_rate = min(rate, now_rate) if now_rate > 0 else rate
+            owed -= (time.monotonic() - t0) * paid_rate
         return True
 
     @property

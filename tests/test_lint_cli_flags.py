@@ -1,14 +1,14 @@
 """Fast tier-1 lint: every robustness CLI knob (-repair.*, -fault.*,
--retry.*) registered in cli.py carries non-empty help text — these
-flags gate chaos/repair behaviour and an undocumented one is
-effectively invisible to operators."""
+-retry.*, -qos.*) registered in cli.py carries non-empty help text —
+these flags gate chaos/repair/overload behaviour and an undocumented
+one is effectively invisible to operators."""
 import ast
 import os
 
 CLI_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu", "cli.py")
 
-PREFIXES = ("-repair.", "-fault.", "-retry.")
+PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.")
 
 
 def _add_argument_calls(tree):
@@ -38,7 +38,8 @@ def test_robustness_flags_have_help():
                 # one Constant; anything else is computed — accept it
                 help_text = "<computed>"
         flags.setdefault(flag, []).append(help_text.strip())
-    assert flags, "no -repair./-fault./-retry. flags found in cli.py"
+    assert flags, "no -repair./-fault./-retry./-qos. flags found in " \
+        "cli.py"
     undocumented = sorted(f for f, helps in flags.items()
                           if any(not h for h in helps))
     assert not undocumented, (
@@ -48,5 +49,8 @@ def test_robustness_flags_have_help():
                      "-repair.concurrency", "-repair.maxAttempts",
                      "-repair.grace", "-repair.maxBytesPerSec",
                      "-repair.partialEc",
-                     "-fault.spec", "-fault.seed"):
+                     "-fault.spec", "-fault.seed",
+                     "-qos.enabled", "-qos.rate", "-qos.burst",
+                     "-qos.maxTenants", "-qos.maxDelay",
+                     "-qos.requestFloor", "-qos.spec"):
         assert expected in flags, f"{expected} flag missing from cli.py"

@@ -21,13 +21,17 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 
 # Every key is bounded by construction: enum-like (kind, op, stage,
 # outcome, method, direction, mode — repair read mode is exactly
-# {partial, full}), a fixed deployment set (backend, service, handler,
-# collection, instance), HTTP classes (code), or the
-# histogram-internal bucket bound (le).
+# {partial, full}; reason is the QoS shed verdict, exactly {rate,
+# deadline}), a fixed deployment set (backend, service, handler,
+# collection, instance), HTTP classes (code), the histogram-internal
+# bucket bound (le), or capped by a registry (tenant: at most
+# -qos.maxTenants distinct values plus __overflow__ — utils/qos.py
+# folds every later tenant into that one bucket precisely so this
+# label stays bounded).
 ALLOWED = {
     "backend", "code", "collection", "direction", "handler",
     "instance", "kind", "le", "method", "mode", "op", "outcome",
-    "service", "stage",
+    "reason", "service", "stage", "tenant",
 }
 
 

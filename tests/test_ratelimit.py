@@ -147,6 +147,119 @@ class TestConcurrentCap:
         assert all(g > 0 for g in got), got
 
 
+class TestConfigureRacesWaiters:
+    """Hot rate changes mid-overload (the -qos.spec reload path) must
+    re-price sleeping FIFO waiters — never strand them at a stale
+    quote — and cancel() around a configure() must not leak debt."""
+
+    def test_rate_raise_unstrands_sleeping_waiter(self):
+        # at 1000 B/s the waiter owes ~2s; raising to 1e6 mid-sleep
+        # must wake it far sooner than the original quote
+        b = TokenBucket(1000, burst=0)
+        b.reserve(1000)  # backlog ahead of the waiter
+        done = threading.Event()
+
+        def waiter():
+            assert b.acquire(1000, timeout=30.0)
+            done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.15)  # let it park on the ~2s wait
+        b.configure(1_000_000)
+        assert done.wait(0.5), \
+            "waiter still asleep at the pre-raise quote"
+        t.join()
+
+    def test_rate_cut_extends_waiter_instead_of_undercharging(self):
+        # cut mid-wait: the residue re-prices at the NEW rate, so the
+        # waiter finishes later than its original quote — bytes
+        # granted are never cheaper than the live cap
+        b = TokenBucket(10_000, burst=0)
+        b.reserve(2_000)  # quote for the next waiter: ~0.2s + own
+        t0 = time.monotonic()
+        done = threading.Event()
+
+        def waiter():
+            assert b.acquire(2_000, timeout=30.0)
+            done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        b.configure(1_000)  # 10x cut: remaining debt now ~3s worth
+        assert not done.wait(0.5), \
+            "waiter finished at the pre-cut price"
+        b.configure(1_000_000)  # release it so the test ends quickly
+        assert done.wait(1.0)
+        t.join()
+        assert time.monotonic() - t0 >= 0.5
+
+    def test_concurrent_configure_reserve_cancel_no_debt_leak(self):
+        # hammer configure() against reserve/cancel pairs from many
+        # threads: every reservation is cancelled, so once the dust
+        # settles the bucket owes nothing (no stranded debt) and no
+        # thread deadlocks
+        b = TokenBucket(50_000)
+        stop_at = time.monotonic() + 0.6
+        errors: list[BaseException] = []
+
+        def churn():
+            try:
+                while time.monotonic() < stop_at:
+                    b.reserve(7_000)
+                    b.cancel(7_000)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        def reconfigure():
+            rates = [10_000, 200_000, 50_000, 1_000]
+            i = 0
+            try:
+                while time.monotonic() < stop_at:
+                    b.configure(rates[i % len(rates)])
+                    i += 1
+                    time.sleep(0.005)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn) for _ in range(5)] \
+            + [threading.Thread(target=reconfigure)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads), \
+            "ratelimit thread wedged across configure()"
+        assert not errors, errors
+        # every reserve was cancelled: nothing may remain owed
+        assert b.debt == 0.0
+
+    def test_configure_wakes_acquire_async_on_rate_cut(self):
+        # the async path re-prices its residue each slice: a cut
+        # mid-wait stretches the sleep rather than undercharging
+        import asyncio
+
+        async def run():
+            b = TokenBucket(100_000, burst=0)
+            b.reserve(10_000)  # ~0.1s owed to the next waiter
+
+            async def cut_soon():
+                await asyncio.sleep(0.02)
+                b.configure(1_000)
+
+            t0 = time.monotonic()
+            ok, _ = await asyncio.gather(
+                b.acquire_async(1_000, timeout=30.0), cut_soon())
+            assert ok
+            return time.monotonic() - t0
+
+        # pre-cut quote was ~0.11s; after the 100x cut the residue
+        # alone is seconds — finishing before 0.3s would mean the cut
+        # was ignored
+        assert asyncio.run(run()) > 0.3
+
+
 class TestRegistry:
     def test_bucket_get_or_create_and_reconfigure(self):
         b1 = ratelimit.bucket("repair", 1000)
