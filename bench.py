@@ -1531,6 +1531,291 @@ def main() -> None:
         log(f"informational benches aborted: {e!r}")
 
 
+def bench_meta_sweep(argv: list[str]) -> int:
+    """`python bench.py meta-sweep [--keys 1000000] [--buckets 8]
+    [--shards 8] [--duration 15] [--rps 400] [--out BENCH_META.json]`
+
+    The PR-9 metadata-plane surface: a million-key namespace under an
+    OPEN-LOOP listing-heavy mixed workload (70% paged listings, 20%
+    point lookups, 10% native-front-style write bursts), measured at
+    the store layer for three geometries — a single grown weedkv
+    store (the baseline whose read p99 the whole PR attacks: its
+    compactions merge the ENTIRE keyspace under one lock), the
+    sharded composite (compactions shrink 1/shards and stall only
+    their own shard's reads), and sharded + the exactly-invalidated
+    read-through cache (hits never touch an engine at all). Arrivals
+    ride the qos-sweep fixed-schedule generator: a stalled store gets
+    MORE concurrent load, never less — so a compaction pause lands in
+    the p99 the way it lands in production, not hidden by a
+    closed-loop client politely waiting it out."""
+    import os
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.filer import make_store
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.sharded_store import _child_snapshot
+    from seaweedfs_tpu.filer.store_cache import CachingStore
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    keys = int(opt("--keys", "1000000"))
+    buckets = int(opt("--buckets", "8"))
+    shards = int(opt("--shards", "8"))
+    duration = float(opt("--duration", "15"))
+    rps = float(opt("--rps", "400"))
+    out_path = opt("--out", "BENCH_META.json")
+    page = 100          # listing page size (S3 list-objects style)
+    hot_pages = 32      # page-aligned cursor set per bucket (choice is
+    # min-of-two-draws, i.e. triangular-skewed toward page 0 — clients
+    # overwhelmingly list from the start)
+    hot_keys = 1024     # zipf head for point lookups: real metadata
+    # traffic re-reads a tiny head (the native front GETs the same
+    # hot objects at 50k rps), so the head must be small enough to
+    # actually repeat within the phase
+    burst = 64          # entries per write burst (native-front batch)
+    per_bucket = keys // buckets
+
+    def mkentry(path: str) -> Entry:
+        return Entry(full_path=path, mode=0o644, mtime=1000.0,
+                     crtime=1000.0)
+
+    def grow(store) -> float:
+        t0 = time.perf_counter()
+        store.insert_entry(Entry(full_path="/buckets", mode=0o40755,
+                                 mtime=1000.0, crtime=1000.0))
+        for b in range(buckets):
+            store.insert_entry(Entry(full_path=f"/buckets/bkt{b}",
+                                     mode=0o40755, mtime=1000.0,
+                                     crtime=1000.0))
+        done = 0
+        while done < keys:
+            store.begin_batch()
+            try:
+                for i in range(done, min(done + 50_000, keys)):
+                    e = mkentry(f"/buckets/bkt{i % buckets}/"
+                                f"obj{i // buckets:08d}")
+                    store.insert_entry_encoded(e, e.to_dict())
+            finally:
+                store.end_batch()
+            done = min(done + 50_000, keys)
+        return time.perf_counter() - t0
+
+    def run_phase(store, label: str) -> dict:
+        """Open-loop mixed load (the qos-sweep generator, pointed at
+        the store API instead of a gateway): arrivals fire on a fixed
+        schedule regardless of completions; an arrival that finds the
+        thread cap exhausted is counted, not delayed."""
+        rng = random.Random(20_260_805)
+        stats = {"sent": 0, "client_capped": 0, "errors": 0,
+                 "list": [], "find": [], "write": []}
+        next_key = [keys]  # write bursts extend the namespace
+        lock = threading.Lock()
+        sem = threading.Semaphore(128)
+        workers: list[threading.Thread] = []
+
+        def fire(kind: str, arg) -> None:
+            try:
+                t0 = time.perf_counter()
+                try:
+                    if kind == "list":
+                        b, p = arg
+                        store.list_directory_entries(
+                            f"/buckets/bkt{b}",
+                            start_from=f"obj{p * page:08d}",
+                            inclusive=True, limit=page)
+                    elif kind == "find":
+                        store.find_entry(arg)
+                    else:  # write burst, batched like the native
+                        # front's applier recv loop
+                        base, b = arg
+                        store.begin_batch()
+                        try:
+                            for j in range(burst):
+                                e = mkentry(f"/buckets/bkt{b}/"
+                                            f"obj{base + j:08d}")
+                                store.insert_entry_encoded(e, e.to_dict())
+                        finally:
+                            store.end_batch()
+                    lat = time.perf_counter() - t0
+                    with lock:
+                        stats[kind].append(lat)
+                except Exception:
+                    with lock:
+                        stats["errors"] += 1
+            finally:
+                sem.release()
+
+        t0 = time.monotonic()
+        end = t0 + duration
+        i = 0
+        while True:
+            due = t0 + i / rps
+            if due >= end:
+                break
+            now = time.monotonic()
+            if due > now:
+                time.sleep(due - now)
+            r = rng.random()
+            if r < 0.70:
+                kind = "list"
+                arg = (rng.randrange(buckets),
+                       min(rng.randrange(hot_pages),
+                           rng.randrange(hot_pages)))
+            elif r < 0.90:
+                kind = "find"
+                k = rng.randrange(hot_keys) if rng.random() < 0.8 \
+                    else rng.randrange(keys)
+                arg = f"/buckets/bkt{k % buckets}/obj{k // buckets:08d}"
+            else:
+                kind = "write"
+                with lock:
+                    base, next_key[0] = next_key[0], next_key[0] + burst
+                arg = (base // buckets, rng.randrange(buckets))
+            with lock:
+                stats["sent"] += 1
+            if sem.acquire(blocking=False):
+                th = threading.Thread(target=fire, args=(kind, arg),
+                                      daemon=True)
+                th.start()
+                workers.append(th)
+            else:
+                with lock:
+                    stats["client_capped"] += 1
+            i += 1
+        for w in workers:
+            w.join(timeout=60)
+
+        def pct(lats: list, q: float) -> float:
+            arr = np.sort(np.array(lats)) * 1e3 if lats \
+                else np.array([0.0])
+            return round(float(np.percentile(arr, q)), 2)
+
+        reads = stats["list"] + stats["find"]
+        row = {
+            "sent": stats["sent"], "errors": stats["errors"],
+            "client_capped": stats["client_capped"],
+            "completed": {k: len(stats[k])
+                          for k in ("list", "find", "write")},
+            "read_p50_ms": pct(reads, 50), "read_p99_ms": pct(reads, 99),
+            "list_p50_ms": pct(stats["list"], 50),
+            "list_p99_ms": pct(stats["list"], 99),
+            "find_p50_ms": pct(stats["find"], 50),
+            "find_p99_ms": pct(stats["find"], 99),
+            "write_p50_ms": pct(stats["write"], 50),
+            "write_p99_ms": pct(stats["write"], 99),
+        }
+        log(f"  [{label}] sent {row['sent']}  capped "
+            f"{row['client_capped']}  errors {row['errors']}  read p50 "
+            f"{row['read_p50_ms']}ms  p99 {row['read_p99_ms']}ms")
+        return row
+
+    tmp = tempfile.mkdtemp(prefix="meta_sweep_")
+    rows = {}
+    try:
+        configs = [
+            ("single_leveldb",
+             lambda: make_store("leveldb",
+                                path=os.path.join(tmp, "base"))),
+            ("sharded",
+             lambda: make_store("sharded",
+                                path=os.path.join(tmp, "shard"),
+                                shards=shards, child="leveldb")),
+            ("sharded_cached",
+             lambda: CachingStore(
+                 make_store("sharded", path=os.path.join(tmp, "shardc"),
+                            shards=shards, child="leveldb"),
+                 entries=131072, pages=4096)),
+        ]
+        for label, build in configs:
+            store = build()
+            log(f"meta sweep [{label}]: growing {keys} keys across "
+                f"{buckets} buckets...")
+            grow_s = grow(store)
+            log(f"  [{label}] grew in {grow_s:.0f}s "
+                f"({keys / grow_s:.0f}/s)")
+            rows[label] = run_phase(store, label)
+            rows[label]["grow_s"] = round(grow_s, 1)
+            rows[label]["grow_keys_per_s"] = round(keys / grow_s)
+            snap = getattr(store, "debug_snapshot", None)
+            rows[label]["geometry"] = snap() if snap \
+                else _child_snapshot(store)
+            if isinstance(store, CachingStore):
+                rows[label]["cache"] = store.stats()
+            store.close()
+            for sub in ("base", "shard", "shardc"):
+                shutil.rmtree(os.path.join(tmp, sub),
+                              ignore_errors=True)
+
+        base_p99 = rows["single_leveldb"]["read_p99_ms"]
+        best_p99 = rows["sharded_cached"]["read_p99_ms"]
+        speedup = round(base_p99 / max(best_p99, 1e-3), 1)
+        result = {
+            "config": {
+                "keys": keys, "buckets": buckets, "shards": shards,
+                "duration_s": duration, "rps": rps,
+                "page": page, "hot_pages": hot_pages,
+                "hot_keys": hot_keys, "write_burst": burst,
+                "mix": "70% paged listings / 20% point lookups / "
+                       "10% batched write bursts",
+                "workload": "open-loop fixed-rate arrivals at the "
+                            "store API (schedule never blocks on "
+                            "completions); in-phase write bursts keep "
+                            "memtable flushes and compactions "
+                            "happening DURING measurement",
+            },
+            "platform": {
+                "cores": os.cpu_count(),
+                "note": "single shared core: generator, workers and "
+                        "store engine contend like the 1-core CI VM "
+                        "the gateway numbers below came from",
+            },
+            "results": rows,
+            "read_p99_speedup_vs_single": speedup,
+            "context": {
+                "why_these_numbers_matter": (
+                    "the native S3 front already pushed the data "
+                    "plane past the python filer (BENCH_GATEWAY.json "
+                    "r5): the residual write cost is create_entry "
+                    "itself and the residual read risk is the grown "
+                    "single store's whole-keyspace compactions — the "
+                    "two things this sweep isolates"),
+                "gateway_numbers": {
+                    "s3_native_front_r5": {
+                        "write_rps": 10092.8, "read_rps": 49678.7,
+                        "write_p50_ms": 1.31, "read_p50_ms": 0.3,
+                        "read_p99_ms": 0.6},
+                    "write_path_analysis_r5": {
+                        "create_entry_us_leveldb": 42,
+                        "write_rps_with_memory_store": 10364},
+                    "machine": "1-core CI VM (all roles share the "
+                               "core)",
+                },
+            },
+        }
+        with open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                out_path), "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({
+            "metric": "meta_sweep_read_p99_speedup",
+            "value": speedup,
+            "unit": "x",
+            "extra": {"single_p99_ms": base_p99,
+                      "sharded_p99_ms": rows["sharded"]["read_p99_ms"],
+                      "cached_p99_ms": best_p99, "out": out_path},
+        }), flush=True)
+        return 0 if speedup >= 2.0 else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "hedge-sweep":
         sys.exit(bench_hedge_sweep(sys.argv[2:]))
@@ -1540,4 +1825,6 @@ if __name__ == "__main__":
         sys.exit(bench_repair_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "qos-sweep":
         sys.exit(bench_qos_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "meta-sweep":
+        sys.exit(bench_meta_sweep(sys.argv[2:]))
     main()

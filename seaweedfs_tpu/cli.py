@@ -321,6 +321,23 @@ def main(argv: list[str] | None = None) -> int:
                    choices=["auto", "native", "python"],
                    help="C++ front for the volume hot path")
     p.add_argument("-filer.store", dest="filer_store", default="sqlite")
+    p.add_argument("-filer.store.shards", dest="filer_store_shards",
+                   type=int, default=0,
+                   help="partition the filer namespace across N "
+                        "independent -filer.store engines (bucket/"
+                        "first-segment routing, consistent-hash ring; "
+                        "compaction stays per-shard); 0 = single store")
+    p.add_argument("-filer.cache.entries", dest="filer_cache_entries",
+                   type=int, default=0,
+                   help="read-through metadata cache: max cached "
+                        "entries (positive + negative), exactly "
+                        "invalidated via the meta event log; "
+                        "0 = cache off")
+    p.add_argument("-filer.cache.pages", dest="filer_cache_pages",
+                   type=int, default=0,
+                   help="read-through metadata cache: max cached "
+                        "directory-listing pages; 0 = default when "
+                        "-filer.cache.entries is set, else off")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-ec.backend", dest="ec_backend", default="auto",
@@ -359,6 +376,23 @@ def main(argv: list[str] | None = None) -> int:
                    help="db username (mysql/postgres/cassandra)")
     p.add_argument("-store.password", dest="store_password", default="")
     p.add_argument("-store.database", dest="store_database", default="")
+    p.add_argument("-filer.store.shards", dest="filer_store_shards",
+                   type=int, default=0,
+                   help="partition the filer namespace across N "
+                        "independent -store engines (bucket/"
+                        "first-segment routing, consistent-hash ring; "
+                        "compaction stays per-shard); 0 = single store")
+    p.add_argument("-filer.cache.entries", dest="filer_cache_entries",
+                   type=int, default=0,
+                   help="read-through metadata cache: max cached "
+                        "entries (positive + negative), exactly "
+                        "invalidated via the meta event log; "
+                        "0 = cache off")
+    p.add_argument("-filer.cache.pages", dest="filer_cache_pages",
+                   type=int, default=0,
+                   help="read-through metadata cache: max cached "
+                        "directory-listing pages; 0 = default when "
+                        "-filer.cache.entries is set, else off")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
@@ -1247,7 +1281,10 @@ def _run_filer(args) -> int:
                      replication=args.replication,
                      store_options=store_options,
                      cipher=args.encrypt_volume_data,
-                     save_to_filer_limit=args.save_to_filer_limit)
+                     save_to_filer_limit=args.save_to_filer_limit,
+                     store_shards=args.filer_store_shards,
+                     cache_entries=args.filer_cache_entries,
+                     cache_pages=args.filer_cache_pages)
     t = ServerThread(fs.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     fs.address = t.address
@@ -1327,7 +1364,10 @@ def _run_server(args) -> int:
         filer_dir = os.path.join(args.dir, "filer")
         os.makedirs(filer_dir, exist_ok=True)
         fs = FilerServer(mt.url, store=args.filer_store,
-                         store_path=os.path.join(filer_dir, "filer.db"))
+                         store_path=os.path.join(filer_dir, "filer.db"),
+                         store_shards=args.filer_store_shards,
+                         cache_entries=args.filer_cache_entries,
+                         cache_pages=args.filer_cache_pages)
         ft = ServerThread(fs.app, host=args.ip, port=args.filer_port).start()
         fs.address = ft.address
         threads.append(ft)

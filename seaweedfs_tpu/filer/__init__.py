@@ -25,8 +25,11 @@ from . import rocksdb_store as _rocksdb_store  # registers rocksdb (C API)
 from . import mongodb_store as _mongodb_store  # registers mongodb (OP_MSG)
 from . import redis_store as _redis_store    # registers redis
 from . import redis_cluster_store as _redis_cluster  # registers redis_cluster
+from . import sharded_store as _sharded_store  # registers "sharded"
 from .filerstore import (STORES, FilerStore, MemoryStore, SqliteStore,
                          make_store, register_store)
+from .sharded_store import ShardedStore
+from .store_cache import CachingStore
 from .stream import ChunkStreamReader, read_fid, stream_content
 
 __all__ = [
@@ -37,6 +40,6 @@ __all__ = [
     "resolve_chunk_manifest", "view_from_chunks",
     "Filer", "norm_path",
     "STORES", "FilerStore", "MemoryStore", "SqliteStore", "make_store",
-    "register_store",
+    "register_store", "ShardedStore", "CachingStore",
     "ChunkStreamReader", "read_fid", "stream_content",
 ]

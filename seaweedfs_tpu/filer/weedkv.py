@@ -334,16 +334,29 @@ class WeedKV:
             if len(self._segments) >= COMPACT_SEGMENT_COUNT:
                 self.compact()
 
+    SLOW_COMPACTION_SECONDS = 1.0
+
     def compact(self) -> None:
         """Merge all segments into one, dropping tombstones and
-        shadowed versions."""
+        shadowed versions. Reads and writes stall on the store lock
+        for the whole merge — which is why the time and volume are
+        first-class metrics (filer_store_compaction_*): a grown
+        store's read p99 IS this pause."""
+        import time
+
+        from ..utils import glog, metrics
+
         with self._lock:
             if len(self._segments) <= 1:
                 return
+            t0 = time.perf_counter()
+            n_segments = len(self._segments)
             merged: dict[bytes, bytes | None] = {}
+            read_bytes = 0
             for seg in self._segments:  # oldest first
                 for k, v in zip(seg.keys, seg.values):
                     merged[k] = v
+                    read_bytes += len(k) + len(v or b"")
             live = sorted((k, v) for k, v in merged.items()
                           if v is not None)
             path = os.path.join(self.dir, f"{self._next_seg:06d}.sst")
@@ -356,6 +369,20 @@ class WeedKV:
                     os.remove(seg.path)
                 except OSError:
                     pass
+            dt = time.perf_counter() - t0
+        metrics.histogram_observe("filer_store_compaction_seconds", dt)
+        metrics.counter_add("filer_store_compaction_bytes_total",
+                            read_bytes)
+        if dt >= self.SLOW_COMPACTION_SECONDS:
+            glog.warning(
+                "slow compaction: %s merged %d segments "
+                "(%d keys, %d bytes) in %.2fs — reads stalled for the "
+                "duration", self.dir, n_segments, len(live),
+                read_bytes, dt)
+        else:
+            glog.v(1, "compacted %s: %d segments -> 1 (%d keys, "
+                   "%d bytes) in %.3fs", self.dir, n_segments,
+                   len(live), read_bytes, dt)
 
     def close(self) -> None:
         with self._lock:

@@ -46,7 +46,10 @@ class FilerServer:
                  announce_pulse: float = 3.0,
                  store_options: dict | None = None,
                  cipher: bool = False,
-                 save_to_filer_limit: int = 0):
+                 save_to_filer_limit: int = 0,
+                 store_shards: int = 0,
+                 cache_entries: int = 0,
+                 cache_pages: int = 0):
         self.master_url = master_url.rstrip("/")
         self.masters = MasterClient(self.master_url)
         self.collection = collection
@@ -60,9 +63,32 @@ class FilerServer:
         # ciphertext under a per-chunk key kept in the entry metadata
         # (filer_server_handlers_write_cipher.go; util/cipher.go)
         self.cipher = cipher
+        # -filer.store.shards: partition the namespace across N child
+        # engines of the requested kind (filer/sharded_store.py) so
+        # compaction parallelizes and stays per-shard
+        if store_shards > 1 and isinstance(store, str) \
+                and store != "sharded":
+            from ..filer import make_store
+
+            store = make_store("sharded", path=store_path,
+                               shards=store_shards, child=store,
+                               child_options=store_options or {})
         self.filer = Filer(store, on_delete_chunks=self._delete_chunks,
                            signature=signature, path=store_path,
                            **(store_options or {}))
+        # -filer.cache.*: read-through entry + listing-page cache,
+        # exactly invalidated through the meta event log (zero
+        # staleness for python AND native mutation paths)
+        if cache_entries > 0 or cache_pages > 0:
+            from ..filer import CachingStore
+            from ..filer.store_cache import DEFAULT_ENTRIES, DEFAULT_PAGES
+
+            cached = CachingStore(
+                self.filer.store,
+                entries=cache_entries or DEFAULT_ENTRIES,
+                pages=cache_pages or DEFAULT_PAGES)
+            cached.attach(self.filer.meta_log)
+            self.filer.store = cached
         # cluster membership + distributed lock manager: this filer's
         # address is resolved after the listen socket binds (the runner
         # sets .address, like volume servers' store.port)
@@ -333,6 +359,7 @@ class FilerServer:
                     retry.handle_debug_breakers_factory()),
             web.get("/debug/qos", qos.handle_debug_qos_factory()),
             web.get("/debug/ec", self.handle_debug_ec),
+            web.get("/debug/filer", self.handle_debug_filer),
             web.get("/ws/meta_subscribe", self.handle_meta_subscribe),
             web.post("/dlm/lock", self.handle_dlm_lock),
             web.post("/dlm/unlock", self.handle_dlm_unlock),
@@ -1200,8 +1227,26 @@ class FilerServer:
             "cipher": self.cipher})
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
+        # sharded/cached stores refresh their gauges per scrape so the
+        # master's federation picks up live per-shard + cache numbers
+        publish = getattr(self.filer.store, "publish_metrics", None)
+        if publish is not None:
+            publish()
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
+
+    async def handle_debug_filer(self, req: web.Request) -> web.Response:
+        """GET /debug/filer — metadata-store snapshot: shard geometry
+        and sizes, cache hit/negative/evict counters, compaction debt
+        (segments awaiting merge per engine)."""
+        from ..filer.sharded_store import _child_snapshot
+
+        store = self.filer.store
+        snap = getattr(store, "debug_snapshot", None)
+        return web.json_response({
+            "store": store.name,
+            "snapshot": snap() if snap else _child_snapshot(store),
+        })
 
     async def handle_debug_ec(self, req: web.Request) -> web.Response:
         from ..ec import backend as ec_backend

@@ -1,0 +1,161 @@
+"""Zero-staleness contract for the read-through metadata cache.
+
+Mutations arrive through BOTH channels the filer supports — the python
+Filer API and the native S3 front's entry-applier channel
+(s3/native_front.py `_apply_one`) — and every test asserts immediate
+read-after-write through the cache, with NO sleeps: the cache's
+invalidation rides the meta event log's sync listeners, which run
+inside the mutation (under the filer mutation lock), so by the time a
+write returns there is nothing asynchronous left to wait for.
+
+Each test also proves the cache is actually in the read path (hit
+counters move) — a cache that silently fell out of the path would
+trivially pass staleness checks.
+"""
+import pytest
+
+from seaweedfs_tpu.filer import Filer, make_store
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.store_cache import CachingStore
+from seaweedfs_tpu.s3.native_front import NativeS3Front
+
+
+@pytest.fixture
+def filer(tmp_path):
+    inner = make_store("sharded", path=str(tmp_path / "db"), shards=2,
+                       child="leveldb")
+    cached = CachingStore(inner, entries=4096, pages=256)
+    f = Filer(cached)
+    cached.attach(f.meta_log)
+    yield f
+    f.close()
+
+
+@pytest.fixture
+def front(filer):
+    """A native S3 front driving the SAME filer through its applier
+    channel — no sockets, the test feeds `_apply_one` TSV records the
+    way the gateway's burst loop does."""
+    nf = NativeS3Front.__new__(NativeS3Front)
+    nf.filer = filer
+    return nf
+
+
+def _cache(filer) -> CachingStore:
+    return filer.store
+
+
+def _put_line(rec_id, bucket, key, size=3, etag="abc"):
+    return (f"{rec_id}\tput\t{bucket}\t{key}\t1,01010101\t{size}\t"
+            f"{etag}\ttext/plain").encode()
+
+
+def _del_line(rec_id, bucket, key):
+    return f"{rec_id}\tdel\t{bucket}\t{key}".encode()
+
+
+def test_python_api_read_after_write(filer):
+    c = _cache(filer)
+    filer.create_entry(Entry(full_path="/d/f", mode=0o644, content=b"v1"))
+    s0 = c.stats()
+    got = filer.find_entry("/d/f")
+    assert got is not None and got.content == b"v1"
+    # the write itself warmed the cache — that read was a hit
+    assert c.stats().get("hits_entry", 0) > s0.get("hits_entry", 0)
+
+    filer.update_entry(Entry(full_path="/d/f", mode=0o644, content=b"v2"))
+    assert filer.find_entry("/d/f").content == b"v2"
+
+    filer.delete_entry("/d/f")
+    assert filer.find_entry("/d/f") is None
+
+
+def test_python_api_listing_invalidation(filer):
+    c = _cache(filer)
+    for i in range(5):
+        filer.create_entry(Entry(full_path=f"/dir/f{i}", mode=0o644))
+    assert len(filer.list_entries("/dir")) == 5
+    s0 = c.stats()
+    assert len(filer.list_entries("/dir")) == 5  # served from the page
+    assert c.stats().get("hits_page", 0) > s0.get("hits_page", 0)
+
+    filer.create_entry(Entry(full_path="/dir/f5", mode=0o644))
+    assert [e.name for e in filer.list_entries("/dir")] == \
+        [f"f{i}" for i in range(6)]
+    filer.delete_entry("/dir/f0")
+    assert [e.name for e in filer.list_entries("/dir")] == \
+        [f"f{i}" for i in range(1, 6)]
+
+
+def test_native_channel_read_after_write(filer, front):
+    c = _cache(filer)
+    # negative-cache the path FIRST — the hard case: a stale miss
+    # marker must be overridden by the native write's event
+    assert filer.find_entry("/buckets/b/k") is None
+    s0 = c.stats()
+    assert filer.find_entry("/buckets/b/k") is None
+    assert c.stats().get("hits_negative", 0) > s0.get("hits_negative", 0)
+
+    assert front._apply_one(_put_line(1, "b", "k")) == "1 200\n"
+    got = filer.find_entry("/buckets/b/k")
+    assert got is not None and got.chunks and got.chunks[0].size == 3
+
+    # overwrite through the channel: new etag visible immediately
+    assert front._apply_one(_put_line(2, "b", "k", etag="def")) == \
+        "2 200\n"
+    assert filer.find_entry("/buckets/b/k").md5 == "def"
+
+    # delete through the channel: gone immediately
+    assert front._apply_one(_del_line(3, "b", "k")) == "3 200\n"
+    assert filer.find_entry("/buckets/b/k") is None
+
+
+def test_native_channel_listing_invalidation(filer, front):
+    c = _cache(filer)
+    for i in range(3):
+        front._apply_one(_put_line(i, "logs", f"day{i}"))
+    assert [e.name for e in filer.list_entries("/buckets/logs")] == \
+        ["day0", "day1", "day2"]
+    s0 = c.stats()
+    filer.list_entries("/buckets/logs")
+    assert c.stats().get("hits_page", 0) > s0.get("hits_page", 0)
+
+    # a batched burst (begin/end_batch around appliers, like the
+    # gateway's recv loop) is visible the moment end_batch returns
+    store = filer.store
+    store.begin_batch()
+    try:
+        front._apply_one(_put_line(7, "logs", "day3"))
+        front._apply_one(_del_line(8, "logs", "day0"))
+    finally:
+        store.end_batch()
+    assert [e.name for e in filer.list_entries("/buckets/logs")] == \
+        ["day1", "day2", "day3"]
+
+
+def test_channels_interleave_without_staleness(filer, front):
+    """Alternate writers on one key: each mutation's successor read
+    must see exactly that mutation, whichever channel made it."""
+    path = "/buckets/mix/obj"
+    front._apply_one(_put_line(1, "mix", "obj", etag="e1"))
+    assert filer.find_entry(path).md5 == "e1"
+    filer.update_entry(Entry(full_path=path, mode=0o644, md5="e2"))
+    assert filer.find_entry(path).md5 == "e2"
+    front._apply_one(_put_line(2, "mix", "obj", etag="e3"))
+    assert filer.find_entry(path).md5 == "e3"
+    filer.delete_entry(path)
+    assert filer.find_entry(path) is None
+    front._apply_one(_put_line(3, "mix", "obj", etag="e4"))
+    assert filer.find_entry(path).md5 == "e4"
+
+
+def test_ttl_entries_never_cached(filer):
+    c = _cache(filer)
+    filer.create_entry(Entry(full_path="/tmp/x", mode=0o644, ttl_sec=60))
+    assert filer.find_entry("/tmp/x") is not None
+    with c._lock:
+        assert "/tmp/x" not in c._entries.data
+    # pages containing TTL'd entries are not cached either
+    filer.list_entries("/tmp")
+    with c._lock:
+        assert not any(k[0] == "/tmp" for k in c._pages.data)

@@ -1,14 +1,16 @@
 """Fast tier-1 lint: every robustness CLI knob (-repair.*, -fault.*,
--retry.*, -qos.*) registered in cli.py carries non-empty help text —
-these flags gate chaos/repair/overload behaviour and an undocumented
-one is effectively invisible to operators."""
+-retry.*, -qos.*, -filer.store.*, -filer.cache.*) registered in cli.py
+carries non-empty help text — these flags gate chaos/repair/overload/
+metadata-plane behaviour and an undocumented one is effectively
+invisible to operators."""
 import ast
 import os
 
 CLI_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu", "cli.py")
 
-PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.")
+PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
+            "-filer.store.", "-filer.cache.")
 
 
 def _add_argument_calls(tree):
@@ -52,5 +54,7 @@ def test_robustness_flags_have_help():
                      "-fault.spec", "-fault.seed",
                      "-qos.enabled", "-qos.rate", "-qos.burst",
                      "-qos.maxTenants", "-qos.maxDelay",
-                     "-qos.requestFloor", "-qos.spec"):
+                     "-qos.requestFloor", "-qos.spec",
+                     "-filer.store.shards", "-filer.cache.entries",
+                     "-filer.cache.pages"):
         assert expected in flags, f"{expected} flag missing from cli.py"

@@ -27,11 +27,12 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 # bucket bound (le), or capped by a registry (tenant: at most
 # -qos.maxTenants distinct values plus __overflow__ — utils/qos.py
 # folds every later tenant into that one bucket precisely so this
-# label stays bounded).
+# label stays bounded; shard: exactly -filer.store.shards values,
+# fixed at store construction in filer/sharded_store.py).
 ALLOWED = {
     "backend", "code", "collection", "direction", "handler",
     "instance", "kind", "le", "method", "mode", "op", "outcome",
-    "reason", "service", "stage", "tenant",
+    "reason", "service", "shard", "stage", "tenant",
 }
 
 
