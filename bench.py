@@ -1445,6 +1445,177 @@ def bench_repair_sweep(argv: list[str]) -> int:
     return 0
 
 
+def bench_tier_sweep(argv: list[str]) -> int:
+    """`python bench.py tier-sweep [--caps 0,1000000,500000]
+    [--out BENCH_TIER.json]`
+
+    The tiering tuning surface: encode-offload throughput vs
+    foreground impact under -tier.maxBytesPerSec.  For each cap a
+    fresh 3-node in-process cluster runs the full automated lifecycle
+    (idle volume -> seal into EC -> offload to a local-dir cold tier)
+    while a foreground read workload hammers a separate hot
+    collection; the row reports the seal (EC encode) and offload
+    durations straight from the controller's transition log, the
+    offloaded bytes, the effective offload rate, whether that rate
+    stayed within the cap, and the foreground p50/p99 sampled DURING
+    the lifecycle.
+
+    Honest platform notes: everything is in-process CPU — localhost
+    HTTP between threads, a local directory standing in for the cold
+    object store, and JAX-on-CPU behind the EC router — so the
+    absolute numbers characterize the pipeline and the shaper, not a
+    real network or a real TPU host."""
+    import os
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.operation import verbs
+    from seaweedfs_tpu.rpc.httpclient import session
+    from seaweedfs_tpu.server.cluster import Cluster
+    from seaweedfs_tpu.utils import metrics, ratelimit
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    caps = [float(c) for c in
+            opt("--caps", "0,1000000,500000").split(",")]
+    out_path = opt("--out", "BENCH_TIER.json")
+
+    def counter(name: str, direction: str) -> float:
+        labels = (("dir", direction),)
+        with metrics._lock:
+            return metrics._counters.get((name, labels), 0.0)
+
+    def lifecycle_point(cap: float) -> dict:
+        ratelimit.reset()
+        tmp = tempfile.mkdtemp(prefix="tier_sweep_")
+        cold = os.path.join(tmp, "cold")
+        c = Cluster(os.path.join(tmp, "cluster"), n_volume_servers=3,
+                    volume_size_limit=8 << 20, max_volumes=40,
+                    pulse_seconds=0.3,
+                    tier_enabled=True, tier_interval=0.3,
+                    tier_seal_after_idle=1.0,
+                    tier_offload_after_idle=0.5,
+                    tier_recall_reads=10**9,
+                    tier_max_bytes_per_sec=cap,
+                    tier_remote={"type": "local", "root": cold})
+        try:
+            rng = np.random.default_rng(5)
+            # the cold candidate: ~1.5MB in one collection volume,
+            # then left idle so the controller seals and offloads it
+            a0 = verbs.assign(c.master_url, collection="cold")
+            vid = int(a0.fid.split(",")[0])
+            verbs.upload(a0, rng.bytes(40_000))
+            size = 40_000
+            for _ in range(80):
+                a = verbs.assign(c.master_url, collection="cold")
+                if int(a.fid.split(",")[0]) != vid:
+                    continue
+                verbs.upload(a, rng.bytes(20_000))
+                size += 20_000
+            # the foreground workload: a hot collection read in a
+            # tight loop (the reads also keep it heat-pinned in the
+            # hot tier while the cold volume moves)
+            fg = verbs.assign(c.master_url, collection="fg")
+            verbs.upload(fg, rng.bytes(10_000))
+            fg_url = None
+            b0 = counter("tier_bytes_moved_total", "offload")
+            lats = []
+            deadline = time.monotonic() + 120
+            recent = []
+            while time.monotonic() < deadline:
+                if fg_url is None:
+                    r = session().get(
+                        c.master_url + "/dir/lookup",
+                        params={"volumeId": fg.fid.split(",")[0]},
+                        timeout=5).json()
+                    locs = r.get("locations", [])
+                    fg_url = locs[0]["url"] if locs else None
+                if fg_url:
+                    t = time.monotonic()
+                    session().get(f"http://{fg_url}/{fg.fid}",
+                                  timeout=10)
+                    lats.append(time.monotonic() - t)
+                snap = session().get(
+                    c.master_url + "/debug/tiering", timeout=5).json()
+                state = snap["volumes"].get(str(vid), {}).get("state")
+                if state == "remote":
+                    recent = snap["recent"]
+                    break
+                time.sleep(0.02)
+            moved = counter("tier_bytes_moved_total", "offload") - b0
+            seal = next((r for r in recent if r["ok"]
+                         and r["volume"] == vid
+                         and r["transition"] == "seal"), None)
+            offload = next((r for r in recent if r["ok"]
+                            and r["volume"] == vid
+                            and r["transition"] == "offload"), None)
+            bps = (moved / offload["seconds"]
+                   if offload and offload["seconds"] else None)
+            lats_ms = np.sort(np.array(lats)) * 1e3 if lats else None
+            return {
+                "cap_bps": cap or None,
+                "data_bytes": size,
+                "seal_seconds": (round(seal["seconds"], 3)
+                                 if seal else None),
+                "offload_seconds": (round(offload["seconds"], 3)
+                                    if offload else None),
+                "offload_bytes": int(moved),
+                "offload_bps": round(bps) if bps else None,
+                # shaper compliance: the effective rate must sit at or
+                # under the cap (15% slack covers bucket burst + the
+                # first unshaped fill)
+                "within_cap": (bool(bps and bps <= cap * 1.15)
+                               if cap else None),
+                "fg_reads": len(lats),
+                "fg_p50_ms": (round(float(np.percentile(lats_ms, 50)),
+                                    1) if lats else None),
+                "fg_p99_ms": (round(float(np.percentile(lats_ms, 99)),
+                                    1) if lats else None),
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    sweep = []
+    for cap in caps:
+        row = lifecycle_point(cap)
+        sweep.append(row)
+        log(f"tier-sweep cap={row['cap_bps'] or 'unlimited'}: "
+            f"seal {row['seal_seconds']}s, offload "
+            f"{row['offload_seconds']}s ({row['offload_bytes']} B @ "
+            f"{row['offload_bps']} B/s, within_cap="
+            f"{row['within_cap']})  fg p50 {row['fg_p50_ms']}ms "
+            f"p99 {row['fg_p99_ms']}ms")
+    capped = [r for r in sweep if r["cap_bps"]]
+    result = {
+        "bench": "tier-sweep",
+        "scenario": "automated hot->EC->cold lifecycle, 3 in-process "
+                    "nodes, local-dir cold tier, foreground reads "
+                    "during the move",
+        "platform": "in-process CPU (localhost HTTP, local-dir "
+                    "remote, jax-on-cpu EC); rates characterize the "
+                    "pipeline + shaper, not a real network",
+        "sweep": sweep,
+        "all_within_cap": (all(r["within_cap"] for r in capped)
+                           if capped else None),
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "tier_sweep_offload_bps",
+        "value": sweep[0]["offload_bps"] if sweep else None,
+        "unit": "B/s",
+        "extra": {"sweep": sweep,
+                  "all_within_cap": result["all_within_cap"]},
+        "out": out_path,
+    }), flush=True)
+    return 0
+
+
 def main() -> None:
     rng = np.random.default_rng(0)
     from seaweedfs_tpu.ops import rs_matrix
@@ -1827,4 +1998,6 @@ if __name__ == "__main__":
         sys.exit(bench_qos_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "meta-sweep":
         sys.exit(bench_meta_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "tier-sweep":
+        sys.exit(bench_tier_sweep(sys.argv[2:]))
     main()

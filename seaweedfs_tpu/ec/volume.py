@@ -60,6 +60,8 @@ class EcVolumeShard:
     shard_id: int
     path: str
 
+    remote = False
+
     def __post_init__(self):
         self._f = open(self.path, "rb")
         self.size = os.path.getsize(self.path)
@@ -70,6 +72,31 @@ class EcVolumeShard:
 
     def close(self) -> None:
         self._f.close()
+
+
+@dataclass
+class RemoteEcShard:
+    """A shard whose bytes live on a remote tier (cold storage): same
+    read_at/size/close surface as EcVolumeShard, so the degraded-read
+    ladder (local interval -> remote fan-out -> reconstruction) serves
+    tiered volumes unchanged — a "local" interval read becomes a ranged
+    read of the remote object. The .ecx/.ecj indexes stay on local
+    disk, so needle location costs no remote round-trip."""
+
+    collection: str
+    vid: int
+    shard_id: int
+    key: str   # object key within the remote storage
+    size: int  # shard byte length, recorded at offload time
+    reader: "callable"  # fn(key, offset, size) -> bytes
+
+    remote = True
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        return self.reader(self.key, offset, size)
+
+    def close(self) -> None:
+        pass
 
 
 class EcVolume:
@@ -108,6 +135,21 @@ class EcVolume:
             return self.shards[shard_id]
         path = self.base_name() + geo.shard_ext(shard_id)
         shard = EcVolumeShard(self.collection, self.vid, shard_id, path)
+        self.shards[shard_id] = shard
+        if self._shard_size is None:
+            self._shard_size = shard.size
+        return shard
+
+    def mount_remote_shard(self, shard_id: int, key: str, size: int,
+                           reader) -> RemoteEcShard:
+        """Mount a shard backed by a remote object instead of a local
+        file (tiered cold storage; manifest-driven, storage/store.py
+        tier_offload_ec / restart rediscovery)."""
+        prev = self.shards.get(shard_id)
+        if prev is not None:
+            prev.close()
+        shard = RemoteEcShard(self.collection, self.vid, shard_id,
+                              key, size, reader)
         self.shards[shard_id] = shard
         if self._shard_size is None:
             self._shard_size = shard.size

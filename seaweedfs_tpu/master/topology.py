@@ -33,6 +33,11 @@ class VolumeInfo:
     ttl: tuple[int, int] = (0, 0)
     version: int = 3
     modified_at: int = 0  # unix seconds of the last write
+    # heat signals for the tiering controller (heartbeat-reported;
+    # defaults keep old construction sites and tests valid)
+    last_read_at: float = 0.0
+    read_count: int = 0
+    remote: bool = False
 
 
 class DataNode:
@@ -54,6 +59,8 @@ class DataNode:
         # ({"rate","burst","fill","debt"}) — None until the node has
         # ever shaped repair traffic
         self.repair_bw: dict | None = None
+        # ditto for the tier bucket (bulk offload/recall shaping)
+        self.tier_bw: dict | None = None
         self.last_seen = time.monotonic()
 
     @property
@@ -186,6 +193,9 @@ class Topology:
         self.ec_locations: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
         self.ec_codecs: dict[int, str] = {}  # vid -> "k.m" wide codes
+        # tiering: per-node EC heat/remote report,
+        # vid -> node id -> {"remote", "last_read_at", "read_count"}
+        self.ec_meta: dict[int, dict[str, dict]] = {}
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
@@ -244,8 +254,10 @@ class Topology:
                 self.max_volume_id = max(self.max_volume_id, vid)
 
     def sync_node_ec_shards(self, node: DataNode,
-                            shards: list[tuple[int, str, int, str]]) -> None:
-        """shards: [(vid, collection, shard_bits, codec)]
+                            shards: list[tuple]) -> None:
+        """shards: [(vid, collection, shard_bits, codec)] with an
+        optional 5th element — the node's tiering meta dict
+        ({"remote", "last_read_at", "read_count"})
         (topology_ec.go:16; codec '' = RS(10,4), 'k.m' = wide tier)."""
         with self.lock:
             new = {s[0]: s[2] for s in shards}
@@ -258,9 +270,14 @@ class Topology:
                         self._unregister_ec_shard(vid, sid, node)
                 if now_bits == 0:
                     node.ec_shards.pop(vid, None)
-            for vid, col, bits, codec in shards:
+                    meta = self.ec_meta.get(vid)
+                    if meta is not None:
+                        meta.pop(node.id, None)
+            for vid, col, bits, codec, *rest in shards:
                 if bits == 0:
                     continue
+                if rest and rest[0]:
+                    self.ec_meta.setdefault(vid, {})[node.id] = rest[0]
                 node.ec_shards[vid] = bits
                 self.ec_collections[vid] = col
                 if codec:
@@ -290,6 +307,9 @@ class Topology:
                 for sid in range(geo.MAX_SHARD_COUNT):
                     if node.ec_shards[vid] >> sid & 1:
                         self._unregister_ec_shard(vid, sid, node)
+                meta = self.ec_meta.get(vid)
+                if meta is not None:
+                    meta.pop(node_id, None)
             node.rack.nodes.pop(node_id, None)
 
     def _layout(self, collection: str, replication: str,
@@ -337,6 +357,23 @@ class Topology:
             self.ec_locations.pop(vid, None)
             self.ec_collections.pop(vid, None)
             self.ec_codecs.pop(vid, None)
+            self.ec_meta.pop(vid, None)
+
+    def ec_tier_view(self, vid: int) -> dict:
+        """Cluster-wide tier view of one EC volume: remote only when
+        EVERY reporting holder says its shards are remote; heat is the
+        hottest/most-read signal across holders."""
+        with self.lock:
+            metas = list(self.ec_meta.get(vid, {}).values())
+            return {
+                "remote": bool(metas) and
+                all(m.get("remote") for m in metas),
+                "last_read_at": max(
+                    (m.get("last_read_at", 0.0) for m in metas),
+                    default=0.0),
+                "read_count": sum(
+                    m.get("read_count", 0) for m in metas),
+            }
 
     # -- lookup ---------------------------------------------------------
     def lookup(self, vid: int) -> list[DataNode]:
@@ -493,6 +530,7 @@ class Topology:
                             "max_volumes": n.max_volumes,
                             "disk_type": n.disk_type,
                             "repair_bw": n.repair_bw,
+                            "tier_bw": n.tier_bw,
                             # this process's circuit-breaker view of
                             # the node (closed/open/half-open)
                             "breaker": _retry.breaker_for(n.url).state,

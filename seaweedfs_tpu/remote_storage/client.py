@@ -185,6 +185,30 @@ def register_remote(type_name: str,
     _makers[type_name] = maker
 
 
+def parse_remote_spec(spec: str) -> dict:
+    """Parse a CLI/shell remote-tier spec into a client conf dict:
+    full JSON (`{"type": "s3", ...}`) or the `local:<root>` shorthand
+    (`-tier.remote=local:/mnt/cold`)."""
+    spec = spec.strip()
+    if not spec:
+        raise ValueError("empty remote storage spec")
+    if spec.startswith("{"):
+        import json
+
+        conf = json.loads(spec)
+        if not isinstance(conf, dict) or "type" not in conf:
+            raise ValueError(
+                "remote storage spec JSON needs a 'type' field")
+        return conf
+    if ":" in spec:
+        t, _, rest = spec.partition(":")
+        if t == "local":
+            return {"type": "local", "root": rest}
+    raise ValueError(
+        f"bad remote storage spec {spec!r}: use JSON with a 'type' "
+        "field or the local:<root> shorthand")
+
+
 def make_client(conf: dict) -> RemoteStorageClient:
     t = conf.get("type", "")
     if t in UNAVAILABLE_TYPES:

@@ -43,7 +43,17 @@ class Cluster:
                  repair_concurrency: int = 2,
                  repair_max_bytes_per_sec: float = 0.0,
                  repair_partial_ec: bool = True,
-                 repair_grace: float = 0.0):
+                 repair_grace: float = 0.0,
+                 tier_enabled: bool = False,
+                 tier_interval: float = 30.0,
+                 tier_concurrency: int = 1,
+                 tier_seal_after_idle: float = 3600.0,
+                 tier_offload_after_idle: float = 7200.0,
+                 tier_recall_reads: int = 3,
+                 tier_recall_window: float = 300.0,
+                 tier_max_bytes_per_sec: float = 0.0,
+                 tier_remote: dict | None = None,
+                 tier_state_dir: str = ""):
         """topology: optional per-server (data_center, rack) labels;
         disk_types: optional per-server disk class (hdd/ssd)."""
         self.base_dir = base_dir
@@ -58,7 +68,17 @@ class Cluster:
             repair_concurrency=repair_concurrency,
             repair_max_bytes_per_sec=repair_max_bytes_per_sec,
             repair_partial_ec=repair_partial_ec,
-            repair_grace=repair_grace)
+            repair_grace=repair_grace,
+            tier_enabled=tier_enabled,
+            tier_interval=tier_interval,
+            tier_concurrency=tier_concurrency,
+            tier_seal_after_idle=tier_seal_after_idle,
+            tier_offload_after_idle=tier_offload_after_idle,
+            tier_recall_reads=tier_recall_reads,
+            tier_recall_window=tier_recall_window,
+            tier_max_bytes_per_sec=tier_max_bytes_per_sec,
+            tier_remote=tier_remote,
+            tier_state_dir=tier_state_dir)
         self.master_thread = ServerThread(self.master.app).start()
         self.master.admin_scripts_url = self.master_thread.url
         self.volume_servers: list[VolumeServer] = []

@@ -62,6 +62,17 @@ class MasterServer:
                  repair_grace: float = 0.0,
                  repair_max_bytes_per_sec: float = 0.0,
                  repair_partial_ec: bool = True,
+                 tier_enabled: bool = False,
+                 tier_interval: float = 30.0,
+                 tier_concurrency: int = 1,
+                 tier_seal_after_idle: float = 3600.0,
+                 tier_offload_after_idle: float = 7200.0,
+                 tier_recall_reads: int = 3,
+                 tier_recall_window: float = 300.0,
+                 tier_max_attempts: int = 5,
+                 tier_max_bytes_per_sec: float = 0.0,
+                 tier_remote: dict | None = None,
+                 tier_state_dir: str = "",
                  trace_store_size: int = 2048,
                  scrape_interval: float = 10.0,
                  otlp_url: str = ""):
@@ -113,6 +124,20 @@ class MasterServer:
             max_attempts=repair_max_attempts, grace=repair_grace,
             max_bytes_per_sec=repair_max_bytes_per_sec,
             partial_ec=repair_partial_ec)
+        # tiering lifecycle controller: heat/tier bookkeeping always
+        # on, data movement gated by -tier.enabled (tiering.py)
+        from ..master.tiering import TieringController
+
+        self.tiering = TieringController(
+            self, enabled=tier_enabled, interval=tier_interval,
+            concurrency=tier_concurrency,
+            seal_after_idle=tier_seal_after_idle,
+            offload_after_idle=tier_offload_after_idle,
+            recall_reads=tier_recall_reads,
+            recall_window=tier_recall_window,
+            max_attempts=tier_max_attempts,
+            max_bytes_per_sec=tier_max_bytes_per_sec,
+            remote=tier_remote, state_dir=tier_state_dir)
         # cluster observability plane (master/collector.py): span
         # collector + OTLP export + metrics federation
         from ..master.collector import MetricsFederator, SpanCollector
@@ -224,6 +249,8 @@ class MasterServer:
             web.get("/debug/ec", self.handle_debug_ec),
             web.get("/debug/repair", self.handle_debug_repair),
             web.post("/debug/repair", self.handle_repair_enqueue),
+            web.get("/debug/tiering", self.handle_debug_tiering),
+            web.post("/debug/tiering", self.handle_tier_enqueue),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -266,6 +293,8 @@ class MasterServer:
         app.on_shutdown.append(_close_ws_clients)
         app.on_startup.append(self.watchdog.start)
         app.on_cleanup.append(self.watchdog.stop)
+        app.on_startup.append(self.tiering.start)
+        app.on_cleanup.append(self.tiering.stop)
         app.on_startup.append(self._start_observability)
         app.on_cleanup.append(self._stop_observability)
         if self.admin_scripts:
@@ -475,17 +504,26 @@ class MasterServer:
                                 "replica_placement", "000"),
                             ttl=tuple(v.get("ttl", (0, 0))),
                             modified_at=v.get("modified_at", 0),
+                            last_read_at=v.get("last_read_at", 0.0),
+                            read_count=v.get("read_count", 0),
                         ) for v in hb["volumes"]])
                 if "ec_shards" in hb:
                     self.topo.sync_node_ec_shards(
                         node, [(e["id"], e.get("collection", ""),
-                                e["shard_bits"], e.get("codec", ""))
+                                e["shard_bits"], e.get("codec", ""),
+                                {"remote": e.get("remote", False),
+                                 "last_read_at":
+                                     e.get("last_read_at", 0.0),
+                                 "read_count": e.get("read_count", 0)})
                                for e in hb["ec_shards"]])
-                # live repair-bucket fill/debt piggybacked on the
+                # live repair/tier-bucket fill/debt piggybacked on the
                 # heartbeat -> visible in /cluster/status per node
                 if "repair_bw" in hb:
                     node.repair_bw = hb["repair_bw"]
+                if "tier_bw" in hb:
+                    node.tier_bw = hb["tier_bw"]
                 self.watchdog.poke()
+                self.tiering.poke()
                 await ws.send_json({
                     "volume_size_limit": self.topo.volume_size_limit,
                     "pulse_seconds": self.pulse_seconds,
@@ -495,6 +533,7 @@ class MasterServer:
             if node_id is not None:
                 self.topo.unregister_data_node(node_id)
                 self.watchdog.poke()
+                self.tiering.poke()
                 await self._broadcast_all_locations()
         return ws
 
@@ -610,6 +649,9 @@ class MasterServer:
                 self.watchdog.placement_violations,
             # per-node repair bucket fill/debt as last heartbeated
             "RepairBandwidth": self._repair_bandwidth(),
+            # tiering lifecycle: per-tier volume counts, queue depth,
+            # per-node tier bucket state, cluster-wide bytes moved
+            "Tiering": self._tiering_summary(),
             # edge QoS shed/admit totals summarized from the federated
             # gateway scrapes (the raw per-tenant series live in
             # /cluster/metrics)
@@ -770,6 +812,44 @@ class MasterServer:
                     for n in self.topo.nodes.values()
                     if n.repair_bw is not None}
 
+    _TIER_BYTES_SERIES = re.compile(
+        r'^tier_bytes_moved_total\{([^}]*)\}\s+([0-9.eE+-]+)\s*$')
+
+    def _tiering_summary(self) -> dict:
+        """The /cluster/status tiering fold: controller state plus
+        cluster-wide offload/recall byte totals summed from the last
+        federated scrape of each volume server (the movement happens
+        node-side, so the master's view is the scraped corpus)."""
+        snap = self.tiering.snapshot()
+        with self.topo.lock:
+            tier_bw = {n.url: n.tier_bw
+                       for n in self.topo.nodes.values()
+                       if n.tier_bw is not None}
+        with self.federator._lock:
+            texts = [s["text"] for s in self.federator._scraped.values()
+                     if s.get("text")]
+        moved: dict[str, float] = {}
+        for text in texts:
+            for line in text.splitlines():
+                m = self._TIER_BYTES_SERIES.match(line.strip())
+                if not m:
+                    continue
+                rawlab, val = m.groups()
+                labels = dict(
+                    p.split("=", 1) for p in rawlab.split(",") if "=" in p)
+                d = labels.get("dir", "").strip('"')
+                if d:
+                    moved[d] = moved.get(d, 0) + float(val)
+        return {
+            "Enabled": snap["enabled"],
+            "TierCounts": snap["tier_counts"],
+            "QueueDepth": snap["queue_depth"],
+            "RemoteConfigured": snap["remote_configured"],
+            "MaxBytesPerSec": snap["max_bytes_per_sec"],
+            "TierBandwidth": tier_bw,
+            "BytesMoved": moved,
+        }
+
     _QOS_SERIES = re.compile(
         r'^(qos_shed_total|qos_admitted_total)\{([^}]*)\}\s+'
         r'([0-9.eE+-]+)\s*$')
@@ -834,6 +914,44 @@ class MasterServer:
             collection=str(body.get("collection", "")))
         return json_ok({"accepted": accepted,
                         "enabled": self.watchdog.enabled})
+
+    async def handle_debug_tiering(self, req: web.Request) -> web.Response:
+        """Tiering controller state: per-volume tier states, pending
+        wants, in-flight transitions and recent results."""
+        return json_ok(self.tiering.snapshot())
+
+    async def handle_tier_enqueue(self, req: web.Request) -> web.Response:
+        """Operator hook: force one tier transition.
+        {"volume": vid, "transition": "seal"|"offload"|"recall"}.
+        Malformed input is always a 400 with a JSON error."""
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
+        try:
+            body = await req.json()
+        except Exception:
+            return json_error("tiering enqueue body must be JSON",
+                              status=400)
+        if not isinstance(body, dict):
+            return json_error("tiering enqueue body must be a JSON "
+                              "object", status=400)
+        try:
+            vid = int(body["volume"])
+        except (KeyError, TypeError, ValueError):
+            return json_error("tiering enqueue requires an integer "
+                              "volume id", status=400)
+        if vid <= 0:
+            return json_error(f"volume id must be positive, got {vid}",
+                              status=400)
+        try:
+            accepted = self.tiering.enqueue(
+                vid, str(body.get("transition", "")),
+                reason=str(body.get("reason", "operator")),
+                collection=str(body.get("collection", "")))
+        except ValueError as e:
+            return json_error(str(e), status=400)
+        return json_ok({"accepted": accepted,
+                        "enabled": self.tiering.enabled})
 
     async def handle_debug_ec(self, req: web.Request) -> web.Response:
         from ..ec import backend as ec_backend

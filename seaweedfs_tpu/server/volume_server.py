@@ -174,6 +174,8 @@ class VolumeServer:
             web.post("/admin/vacuum_compact", self.handle_vacuum_compact),
             web.post("/admin/tier_upload", self.handle_tier_upload),
             web.post("/admin/tier_download", self.handle_tier_download),
+            web.post("/admin/tier_offload", self.handle_tier_offload),
+            web.post("/admin/tier_recall", self.handle_tier_recall),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
             web.post("/admin/ec/rebuild_partial",
@@ -413,6 +415,13 @@ class VolumeServer:
                                     "repair_bw_fill_bytes", bw["fill"])
                                 metrics.gauge_set(
                                     "repair_bw_debt_bytes", bw["debt"])
+                            tbw = ratelimit.snapshot().get("tier")
+                            if tbw is not None:
+                                hb["tier_bw"] = tbw
+                                metrics.gauge_set(
+                                    "tier_bw_fill_bytes", tbw["fill"])
+                                metrics.gauge_set(
+                                    "tier_bw_debt_bytes", tbw["debt"])
                             await ws.send_json(hb)
                             msg = await ws.receive(
                                 timeout=self.pulse_seconds * 4)
@@ -1456,6 +1465,64 @@ class VolumeServer:
         self.poke_heartbeat()
         return web.json_response({"volume": v.vid,
                                   "size": v.content_size()})
+
+    # ------------------------------------------------------------------
+    # admin: EC-shard cold tier (master/tiering.py offload/recall arms)
+    # ------------------------------------------------------------------
+    def _tier_throttle_sync(self, max_bps: float, direction: str):
+        """Per-shard shaping hook for bulk tier movement: debit the
+        node-wide "tier" token bucket (so overlapping offloads and
+        recalls share one cap) and account the bytes by direction."""
+        def throttle(n: int) -> None:
+            if n <= 0:
+                return
+            metrics.counter_add("tier_bytes_moved_total", n,
+                                {"dir": direction})
+            if max_bps and max_bps > 0:
+                ratelimit.bucket("tier", max_bps).acquire(n)
+        return throttle
+
+    async def handle_tier_offload(self, req: web.Request) -> web.Response:
+        """Move this server's local shards of one EC volume to the
+        remote tier named by `remote` (a remote_storage client conf);
+        reads keep flowing through the remote-backed shard objects."""
+        body = await req.json()
+        vid = int(body["volume"])
+        remote_conf = body["remote"]
+        if not isinstance(remote_conf, dict) or "type" not in remote_conf:
+            return web.json_response(
+                {"error": "remote must be a client conf with a type"},
+                status=400)
+        max_bps = float(body.get("max_bps", 0) or 0)
+        try:
+            result = await asyncio.to_thread(
+                self.store.tier_offload_ec, vid, remote_conf,
+                self._tier_throttle_sync(max_bps, "offload"))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (ValueError, OSError) as e:
+            return web.json_response({"error": str(e)}, status=502)
+        self.poke_heartbeat()
+        return web.json_response(result)
+
+    async def handle_tier_recall(self, req: web.Request) -> web.Response:
+        """Bring this server's offloaded shards back to local disk
+        (the first half of a recall; the controller then runs
+        ec.decode to re-materialize the plain volume)."""
+        body = await req.json()
+        vid = int(body["volume"])
+        max_bps = float(body.get("max_bps", 0) or 0)
+        try:
+            result = await asyncio.to_thread(
+                self.store.tier_recall_ec, vid,
+                self._tier_throttle_sync(max_bps, "recall"),
+                bool(body.get("deleteRemote", True)))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (ValueError, OSError) as e:
+            return web.json_response({"error": str(e)}, status=502)
+        self.poke_heartbeat()
+        return web.json_response(result)
 
     # ------------------------------------------------------------------
     # admin: erasure coding (volume_grpc_erasure_coding.go)

@@ -526,6 +526,59 @@ def volume_tier_download(env: CommandEnv, vid: int) -> list[dict]:
             for i, url in enumerate(urls)]
 
 
+def volume_tier_offload(env: CommandEnv, vid: int, remote_conf: dict,
+                        max_bps: float = 0.0) -> list[dict]:
+    """Offload an EC volume's shard bytes to a cold remote tier on
+    every holder (the warm→cold arm of the master tiering controller).
+    Each server uploads ITS OWN local shards and swaps in remote-backed
+    shard objects, so reads keep flowing through the degraded-read
+    guard; .ecx/.ecj indexes stay local. Idempotent per server —
+    re-running after a partial failure resumes where it stopped."""
+    env.confirm_locked()
+    locations = env.ec_shard_locations(vid)
+    if not locations:
+        raise ShellError(f"ec volume {vid} not found")
+    servers: list[str] = []
+    for urls in locations.values():
+        for u in urls:
+            if u not in servers:
+                servers.append(u)
+    return [{"server": u,
+             **env.vs_post(u, "/admin/tier_offload",
+                           {"volume": vid, "remote": remote_conf,
+                            "max_bps": max_bps})}
+            for u in servers]
+
+
+def volume_tier_recall(env: CommandEnv, vid: int,
+                       max_bps: float = 0.0,
+                       decode: bool = True) -> dict:
+    """Bring an offloaded EC volume's shard bytes back to local disk
+    on every holder, then (decode=True) re-materialize the plain
+    volume via ec.decode — the cold→hot recall arm of the tiering
+    controller. Each server deletes its remote objects only after its
+    shards are local again, so a crash mid-recall loses nothing."""
+    env.confirm_locked()
+    locations = env.ec_shard_locations(vid)
+    if not locations:
+        raise ShellError(f"ec volume {vid} not found")
+    servers: list[str] = []
+    for urls in locations.values():
+        for u in urls:
+            if u not in servers:
+                servers.append(u)
+    recalled = [{"server": u,
+                 **env.vs_post(u, "/admin/tier_recall",
+                               {"volume": vid, "max_bps": max_bps})}
+                for u in servers]
+    out = {"volume": vid, "recalled": recalled}
+    if decode:
+        from .commands_ec import ec_decode
+
+        out["decoded"] = ec_decode(env, vid)
+    return out
+
+
 def volume_configure_replication(env: CommandEnv, vid: int,
                                  replication: str) -> list[dict]:
     """Rewrite the replica placement in every replica's superblock

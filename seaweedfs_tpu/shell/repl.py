@@ -44,6 +44,10 @@ HELP = """commands:
   volume.fsck                       filer chunks vs volume needles
   volume.tier.upload -volumeId=N [-dest=s3.default] [-keepLocalDatFile]
   volume.tier.download -volumeId=N  bring a tiered .dat back to disk
+  volume.tier.offload -volumeId=N -remote='{"type":...}' [-maxBps=0]
+                                    offload EC shard bytes to cold tier
+  volume.tier.recall -volumeId=N [-maxBps=0] [-noDecode]
+                                    recall cold shards + decode to volume
   volume.scrub [-volumeId=N] [-collection=C] [-limit=N]
                                     full-read CRC verification
   ec.encode -volumeId=N [-codec=k.m]  erasure-code a volume (wide tier)
@@ -207,6 +211,18 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "volume.tier.download":
         return commands_volume.volume_tier_download(
             env, int(opts["volumeId"]))
+    if cmd == "volume.tier.offload":
+        from ..remote_storage.client import parse_remote_spec
+
+        return commands_volume.volume_tier_offload(
+            env, int(opts["volumeId"]),
+            parse_remote_spec(opts["remote"]),
+            max_bps=float(opts.get("maxBps", 0) or 0))
+    if cmd == "volume.tier.recall":
+        return commands_volume.volume_tier_recall(
+            env, int(opts["volumeId"]),
+            max_bps=float(opts.get("maxBps", 0) or 0),
+            decode="noDecode" not in opts)
     # -- erasure coding -------------------------------------------------
     if cmd == "ec.encode":
         return commands_ec.ec_encode(env, int(opts["volumeId"]),

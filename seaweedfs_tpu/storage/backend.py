@@ -356,18 +356,21 @@ class MmapFile:
 
 class RcloneFile:
     """Placeholder for the rclone backend (backend/rclone_backend/):
-    needs the rclone binary, which this environment does not ship."""
+    needs the rclone binary, which this environment does not ship.
+    Marked unavailable so `create()` fails fast at construction with a
+    clear error instead of a bare NotImplementedError at use time; a
+    build that bundles rclone re-registers a real factory via
+    `register("rclone", ...)`."""
+
+    available = False
+    unavailable_reason = ("needs the rclone binary on PATH, which this "
+                          "build does not ship; tier to s3 instead "
+                          "(backend 's3')")
 
     def __init__(self, *a, **kw):
-        import shutil as _sh
-
-        if _sh.which("rclone") is None:
-            raise RuntimeError(
-                "the rclone volume backend needs the rclone binary on "
-                "PATH; tier to s3 instead (backend 's3')")
-        raise NotImplementedError(
-            "rclone backend wiring is gated until a build with the "
-            "binary present")
+        raise RuntimeError(
+            f"backend 'rclone' not available in this build: "
+            f"{self.unavailable_reason}")
 
 
 _factories: dict[str, Callable[..., StorageFile]] = {
@@ -388,10 +391,16 @@ def register(name: str, factory: Callable[..., StorageFile]) -> None:
 
 def create(kind: str, *args, **kwargs) -> StorageFile:
     try:
-        return _factories[kind](*args, **kwargs)
+        factory = _factories[kind]
     except KeyError:
         raise KeyError(f"unknown storage backend {kind!r}; "
                        f"known: {sorted(_factories)}") from None
+    if not getattr(factory, "available", True):
+        # fail fast at construction, before any volume state exists
+        raise RuntimeError(
+            f"backend {kind!r} not available in this build: "
+            f"{getattr(factory, 'unavailable_reason', 'unavailable')}")
+    return factory(*args, **kwargs)
 
 
 def configure_storage(name: str, **conf) -> S3BackendStorage:

@@ -16,6 +16,9 @@ from .volume import Volume
 
 _VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.(?:dat|vif)$")
 _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
+# remote-shard manifest: shards of this EC volume whose bytes were
+# offloaded to a cold remote tier (storage/store.py tier_offload_ec)
+_RSM_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.rsm$")
 
 
 def parse_volume_filename(name: str) -> tuple[str, int] | None:
@@ -86,6 +89,25 @@ class DiskLocation:
                 col, vid, shard = e
                 entry = self.ec_shards.setdefault(vid, EcShardSet(col, vid))
                 entry.shard_ids.add(shard)
+                continue
+            r = _RSM_RE.match(name)
+            if r is not None:
+                # offloaded shards: registered so the store re-mounts
+                # them remote-backed after a restart (tier recall needs
+                # the EC volume to stay served while its bytes are cold)
+                col, vid = r.group("col") or "", int(r.group("vid"))
+                entry = self.ec_shards.setdefault(vid, EcShardSet(col, vid))
+                try:
+                    import json as _json
+
+                    with open(os.path.join(self.dir, name),
+                              encoding="utf-8") as f:
+                        man = _json.load(f)
+                    entry.shard_ids.update(
+                        int(s) for s in man.get("shards", {}))
+                except Exception as ex:
+                    self.load_errors.append(
+                        (vid, f"rsm manifest: {type(ex).__name__}: {ex}"))
 
     def try_load_volume(self, vid: int) -> bool:
         """Load one volume's on-disk files if present (VolumeMount)."""
@@ -144,7 +166,7 @@ class DiskLocation:
                     os.remove(base + ".vif")
                 except FileNotFoundError:
                     pass
-            for ext in (".ecx", ".ecj"):
+            for ext in (".ecx", ".ecj", ".rsm"):
                 try:
                     os.remove(base + ext)
                 except FileNotFoundError:

@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers",
         "rackloss: whole-rack-kill chaos scenario (placement-aware, "
         "bandwidth-shaped repair); selectable/excludable like chaos")
+    config.addinivalue_line(
+        "markers",
+        "tier: tiered-storage lifecycle test (hot -> warm EC -> cold "
+        "remote); selectable with pytest -m tier")
 
 
 import pytest  # noqa: E402

@@ -1,8 +1,8 @@
 """Fast tier-1 lint: every robustness CLI knob (-repair.*, -fault.*,
--retry.*, -qos.*, -filer.store.*, -filer.cache.*) registered in cli.py
-carries non-empty help text — these flags gate chaos/repair/overload/
-metadata-plane behaviour and an undocumented one is effectively
-invisible to operators."""
+-retry.*, -qos.*, -filer.store.*, -filer.cache.*, -tier.*) registered
+in cli.py carries non-empty help text — these flags gate chaos/repair/
+overload/metadata-plane/tiering behaviour and an undocumented one is
+effectively invisible to operators."""
 import ast
 import os
 
@@ -10,7 +10,7 @@ CLI_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu", "cli.py")
 
 PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
-            "-filer.store.", "-filer.cache.")
+            "-filer.store.", "-filer.cache.", "-tier.")
 
 
 def _add_argument_calls(tree):
@@ -56,5 +56,11 @@ def test_robustness_flags_have_help():
                      "-qos.maxTenants", "-qos.maxDelay",
                      "-qos.requestFloor", "-qos.spec",
                      "-filer.store.shards", "-filer.cache.entries",
-                     "-filer.cache.pages"):
+                     "-filer.cache.pages",
+                     "-tier.enabled", "-tier.interval",
+                     "-tier.concurrency", "-tier.sealAfterIdle",
+                     "-tier.offloadAfterIdle", "-tier.recallReads",
+                     "-tier.recallWindow", "-tier.maxAttempts",
+                     "-tier.maxBytesPerSec", "-tier.remote",
+                     "-tier.stateDir"):
         assert expected in flags, f"{expected} flag missing from cli.py"

@@ -28,11 +28,14 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 # -qos.maxTenants distinct values plus __overflow__ — utils/qos.py
 # folds every later tenant into that one bucket precisely so this
 # label stays bounded; shard: exactly -filer.store.shards values,
-# fixed at store construction in filer/sharded_store.py).
+# fixed at store construction in filer/sharded_store.py; from/to/tier
+# are drawn from the fixed tier-state enum in master/tiering.py
+# (TIERS/TRANSITIONS) and dir is exactly {offload, recall}).
 ALLOWED = {
-    "backend", "code", "collection", "direction", "handler",
-    "instance", "kind", "le", "method", "mode", "op", "outcome",
-    "reason", "service", "shard", "stage", "tenant",
+    "backend", "code", "collection", "dir", "direction", "from",
+    "handler", "instance", "kind", "le", "method", "mode", "op",
+    "outcome", "reason", "service", "shard", "stage", "tenant",
+    "tier", "to",
 }
 
 
