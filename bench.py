@@ -1987,6 +1987,129 @@ def bench_meta_sweep(argv: list[str]) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_filer_sweep(argv: list[str]) -> int:
+    """`python bench.py filer-sweep [--n 3000] [--size 1024]
+    [--conc 16] [--out BENCH_GATEWAY.json]`
+
+    The round-11 native-filer-front measurement: plain-file PUT/GET/
+    DELETE through the C++ filer front (dataplane.cc ROLE_FILER +
+    filer/native_front.py, the combined `server -filer -dataplane
+    native` shape) against the same harness that produced
+    filer_path_r5 — raw pre-framed HTTP replayed by the native
+    keep-alive client (dp_bench_raw), fresh leveldb store, every role
+    sharing the core. Writes the `filer_path_r11_native_front` row
+    into BENCH_GATEWAY.json next to the r5 baseline it is gated
+    against (>=4x on every hot verb)."""
+    import os
+    import shutil
+    import tempfile
+    import urllib.parse
+
+    from seaweedfs_tpu.native import dataplane as dpmod
+    from seaweedfs_tpu.server.cluster import Cluster
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    n = int(opt("--n", "3000"))
+    size = int(opt("--size", "1024"))
+    conc = int(opt("--conc", "16"))
+    out_path = opt("--out", "BENCH_GATEWAY.json")
+    if not dpmod.available():
+        print(json.dumps({"metric": "filer_sweep", "skipped": True,
+                          "reason": "native dataplane unavailable"}))
+        return 0
+
+    tmp = tempfile.mkdtemp(prefix="filersweep")
+    cluster = Cluster(tmp, n_volume_servers=1,
+                      volume_size_limit=1 << 30, with_filer=True,
+                      filer_store="leveldb", filer_native=True)
+    try:
+        front = cluster.filer_front
+        deadline = time.time() + 15
+        while time.time() < deadline and front.front.pool_level() == 0:
+            time.sleep(0.05)
+        netloc = urllib.parse.urlsplit(cluster.filer_url).netloc
+        host, _, port = netloc.partition(":")
+        payload = bytes(ord("a") + (i * 31 + 7) % 26
+                        for i in range(size))
+
+        def build(method: str, path: str, body: bytes) -> bytes:
+            head = (f"{method} {path} HTTP/1.1\r\n"
+                    f"Host: {netloc}\r\n"
+                    f"Content-Length: {len(body)}\r\n")
+            if body:
+                head += "Content-Type: application/octet-stream\r\n"
+            return head.encode() + b"\r\n" + body
+
+        puts = [build("PUT", f"/bench/{i:07d}", payload)
+                for i in range(n)]
+        gets = [build("GET", f"/bench/{i:07d}", b"") for i in range(n)]
+        dels = [build("DELETE", f"/bench/{i:07d}", b"")
+                for i in range(n)]
+
+        def pct(lat, p):
+            return round(float(np.percentile(lat, p)) * 1000, 2) \
+                if len(lat) else 0.0
+
+        rows = {}
+        errors = 0
+        for verb, reqs in (("write", puts), ("read", gets),
+                           ("delete", dels)):
+            wall, lat, err = dpmod.bench_raw(host, int(port or 80),
+                                             reqs, conc)
+            lat = lat[lat > 0]
+            rows[f"{verb}_rps"] = round((n - err) / wall, 1)
+            rows[f"{verb}_p50_ms"] = pct(lat, 50)
+            rows[f"{verb}_p99_ms"] = pct(lat, 99)
+            errors += err
+            log(f"filer-sweep {verb}: {rows[f'{verb}_rps']} rps "
+                f"p50={rows[f'{verb}_p50_ms']}ms err={err}")
+        counters = front.stats()
+        # the r5 python-path baseline this round is gated against
+        base_w, base_r = 2431.5, 4917.6
+        result = dict(rows)
+        result.update({
+            "errors": errors,
+            "native_counters": counters,
+            "vs_filer_path_r5": {
+                "write": round(rows["write_rps"] / base_w, 1),
+                "read": round(rows["read_rps"] / base_r, 1),
+            },
+            "config": {"n": n, "size": size, "concurrency": conc,
+                       "client": "native raw-replay (dp_bench_raw)",
+                       "store": "fresh leveldb"},
+        })
+        full = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            out_path)
+        try:
+            with open(full) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        doc["filer_path_r11_native_front"] = result
+        with open(full, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(json.dumps({
+            "metric": "filer_native_front_write_rps",
+            "value": rows["write_rps"],
+            "unit": "rps",
+            "extra": {"read_rps": rows["read_rps"],
+                      "delete_rps": rows["delete_rps"],
+                      "errors": errors, "out": out_path},
+        }, default=int), flush=True)
+        ok = (errors == 0
+              and rows["write_rps"] >= 4 * base_w
+              and rows["read_rps"] >= 4 * base_r)
+        return 0 if ok else 1
+    finally:
+        cluster.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "hedge-sweep":
         sys.exit(bench_hedge_sweep(sys.argv[2:]))
@@ -2000,4 +2123,6 @@ if __name__ == "__main__":
         sys.exit(bench_meta_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "tier-sweep":
         sys.exit(bench_tier_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "filer-sweep":
+        sys.exit(bench_filer_sweep(sys.argv[2:]))
     main()

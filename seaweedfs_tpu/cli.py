@@ -364,6 +364,17 @@ def main(argv: list[str] | None = None) -> int:
                    default=8080)
     p.add_argument("-filer", action="store_true")
     p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    p.add_argument("-filer.native", dest="filer_native", default="auto",
+                   choices=["auto", "native", "python"],
+                   help="native C++ filer front for plain-file "
+                        "GET/PUT/HEAD/DELETE (needs -dataplane native; "
+                        "listings, renames and every other verb relay "
+                        "to the python filer app)")
+    p.add_argument("-filer.native.workers", dest="filer_native_workers",
+                   type=int, default=2,
+                   help="relay worker threads of the native filer "
+                        "front (requests it cannot serve natively are "
+                        "proxied to the python filer app)")
     p.add_argument("-s3", action="store_true")
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-s3.config", dest="s3_config", default="",
@@ -449,6 +460,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="read-through metadata cache: max cached "
                         "directory-listing pages; 0 = default when "
                         "-filer.cache.entries is set, else off")
+    p.add_argument("-filer.native", dest="filer_native", default="python",
+                   choices=["auto", "native", "python"],
+                   help="native C++ filer front for plain-file "
+                        "GET/PUT/HEAD/DELETE; only the combined "
+                        "`server` command can honor 'native' (the "
+                        "front appends to an in-process volume store), "
+                        "a standalone filer always serves python")
+    p.add_argument("-filer.native.workers", dest="filer_native_workers",
+                   type=int, default=2,
+                   help="relay worker threads of the native filer "
+                        "front (combined `server` mode only)")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
@@ -1335,6 +1357,10 @@ def _run_filer(args) -> int:
     from .rpc.http import ServerThread, run_apps_forever
     from .server.filer_server import FilerServer
 
+    if getattr(args, "filer_native", "python") == "native":
+        raise SystemExit(
+            "-filer.native=native needs an in-process volume store: "
+            "use the combined `server` command with -dataplane native")
     master = args.master if args.master.startswith("http") else \
         f"http://{args.master}"
     store_options = {}
@@ -1440,10 +1466,35 @@ def _run_server(args) -> int:
                          store_shards=args.filer_store_shards,
                          cache_entries=args.filer_cache_entries,
                          cache_pages=args.filer_cache_pages)
-        ft = ServerThread(fs.app, host=args.ip, port=args.filer_port).start()
-        fs.address = ft.address
-        threads.append(ft)
-        print(f"filer listening on {ft.url}")
+        want_native_filer = args.filer_native != "python" and native_volume
+        if args.filer_native == "native" and not native_volume:
+            raise SystemExit("-filer.native=native needs the native "
+                             "volume data plane in-process "
+                             "(-dataplane native)")
+        if want_native_filer:
+            from .filer.native_front import NativeFilerFront
+
+            # python filer app demoted to relay backend on a loopback
+            # port; the native front owns the public filer port (the S3
+            # gateway below keeps talking to the python app directly —
+            # its internal filer calls are query-parameterized and
+            # would only relay through the front anyway)
+            ft = ServerThread(fs.app, host="127.0.0.1", port=0).start()
+            fs.address = ft.address
+            threads.append(ft)
+            filer_front = NativeFilerFront(
+                fs, mt.url, args.filer_port, ft.port, listen_ip=args.ip,
+                workers=args.filer_native_workers)
+            fs._native_front = filer_front  # keeps the threads alive
+            print(f"filer listening on "
+                  f"http://{args.ip}:{filer_front.port} (native front; "
+                  f"python backend :{ft.port})")
+        else:
+            ft = ServerThread(fs.app, host=args.ip,
+                              port=args.filer_port).start()
+            fs.address = ft.address
+            threads.append(ft)
+            print(f"filer listening on {ft.url}")
         if args.s3:
             import json as _json
 

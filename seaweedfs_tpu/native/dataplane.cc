@@ -556,15 +556,31 @@ std::atomic<bool> jwt_required{false};
 std::shared_mutex jwt_mu;
 std::string jwt_secret;  // under jwt_mu; non-empty iff jwt_required
 
+// server roles; N_ROLES sizes the per-role fault/counter tables below
+constexpr int ROLE_VOLUME = 0;
+constexpr int ROLE_S3 = 1;
+constexpr int ROLE_FILER = 2;
+constexpr int N_ROLES = 3;
+
+// Which role's server the current thread serves. Every native response
+// is written on the owning server's IO/worker thread (channel acks
+// included: chan_read runs on that server's IO thread), so a
+// thread_local set once at thread start routes gate_request and
+// count_resp to the right per-role slot without threading a Server*
+// through every call site. Threads that never serve requests (bench
+// clients) keep the volume default and never call either function.
+thread_local int t_role = ROLE_VOLUME;
+
 // fault injection (utils/faults.py subset): error probability + fixed
-// delay per op class, set once at spawn via dp_faults before traffic.
-// Rates/delays are written before faults_on flips, so relaxed reads
-// from the IO threads are safe; the seeded RNG sits under its own
-// mutex so a fixed seed gives one deterministic decision sequence.
-std::atomic<bool> faults_on{false};
+// delay per op class and role, set at spawn via dp_faults /
+// dp_role_faults before traffic. Rates/delays are written before
+// faults_on flips, so relaxed reads from the IO threads are safe; the
+// seeded RNG sits under its own mutex so a fixed seed gives one
+// deterministic decision sequence.
+std::atomic<bool> faults_on[N_ROLES] = {{false}, {false}, {false}};
 std::mutex faults_mu;
-double fault_read_err = 0, fault_write_err = 0;
-double fault_read_delay = 0, fault_write_delay = 0;
+double fault_read_err[N_ROLES] = {0}, fault_write_err[N_ROLES] = {0};
+double fault_read_delay[N_ROLES] = {0}, fault_write_delay[N_ROLES] = {0};
 uint64_t fault_rng = 0x9E3779B97F4A7C15ull;
 
 // splitmix64 step -> uniform double in [0, 1)
@@ -575,6 +591,22 @@ double fault_roll() {
   z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
   z ^= z >> 31;
   return (double)(z >> 11) * 0x1.0p-53;
+}
+
+void set_role_faults(int role, double read_err, double write_err,
+                     double read_delay, double write_delay,
+                     uint64_t seed) {
+  auto clamp01 = [](double v) { return v < 0 ? 0 : (v > 1 ? 1 : v); };
+  std::lock_guard<std::mutex> lk(faults_mu);
+  fault_read_err[role] = clamp01(read_err);
+  fault_write_err[role] = clamp01(write_err);
+  fault_read_delay[role] = read_delay < 0 ? 0 : read_delay;
+  fault_write_delay[role] = write_delay < 0 ? 0 : write_delay;
+  fault_rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  faults_on[role].store(fault_read_err[role] > 0 ||
+                        fault_write_err[role] > 0 ||
+                        fault_read_delay[role] > 0 ||
+                        fault_write_delay[role] > 0);
 }
 
 double wall_now() {
@@ -594,25 +626,30 @@ std::atomic<int64_t> n_fast_get{0}, n_fast_post{0}, n_proxied{0}, n_errors{0};
 std::atomic<int64_t> n_fast_delete{0}, n_repl_post{0}, n_jwt_reject{0},
     n_fanout_fail{0};
 
-// front visibility counters, surfaced through dp_front_stats: responses
-// the native front wrote itself, bucketed by status class, plus payload
+// front visibility counters, surfaced through dp_front_stats (summed
+// across roles) and dp_role_front_stats (per role): responses the
+// native front wrote itself, bucketed by status class, plus payload
 // bytes in (uploaded bodies) / out (served bodies). The host process
 // merges them into /metrics as native_front_requests_total{code} /
 // native_front_bytes_total, so -dataplane native traffic shows up in
 // the cluster metrics federation like any Python-served request.
-std::atomic<int64_t> n_front_2xx{0}, n_front_3xx{0}, n_front_4xx{0},
-    n_front_5xx{0}, n_front_bytes_in{0}, n_front_bytes_out{0};
+struct FrontStats {
+  std::atomic<int64_t> n_2xx{0}, n_3xx{0}, n_4xx{0}, n_5xx{0};
+  std::atomic<int64_t> bytes_in{0}, bytes_out{0};
+};
+FrontStats front_stats[N_ROLES];
 
 void count_resp(int code, int64_t bytes_out) {
+  FrontStats& fs = front_stats[t_role];
   if (code < 300)
-    n_front_2xx++;
+    fs.n_2xx++;
   else if (code < 400)
-    n_front_3xx++;
+    fs.n_3xx++;
   else if (code < 500)
-    n_front_4xx++;
+    fs.n_4xx++;
   else
-    n_front_5xx++;
-  if (bytes_out > 0) n_front_bytes_out += bytes_out;
+    fs.n_5xx++;
+  if (bytes_out > 0) fs.bytes_out += bytes_out;
 }
 
 // ---------------------------------------------------------------------------
@@ -816,9 +853,6 @@ struct ChanTag {
   int kind = KIND_CHAN;
 };
 
-constexpr int ROLE_VOLUME = 0;
-constexpr int ROLE_S3 = 1;
-
 struct Server {
   int role = ROLE_VOLUME;
   uint16_t backend_port = 0;
@@ -845,8 +879,8 @@ struct Server {
   // conn currently inside pump(): a synchronous fan-out failure must
   // not re-enter that conn's pump from finalize_repl
   Conn* pumping = nullptr;
-  // S3 role only: the entry channel to the in-process python filer.
-  // Records out (TSV lines, see s3_handle_put), acks in
+  // S3/filer roles only: the entry channel to the in-process python
+  // filer. Records out (TSV lines, see s3_handle_put), acks in
   // ("id status\n"); both batched per epoll pass like the peer wires.
   int chan_fd = -1;
   ChanTag chan_tag;
@@ -859,8 +893,9 @@ struct Server {
   uint64_t next_op_id = 1;
 };
 
-Server* g_srv = nullptr;    // volume front (one per process)
-Server* g_s3srv = nullptr;  // S3 front (combined-server processes)
+Server* g_srv = nullptr;      // volume front (one per process)
+Server* g_s3srv = nullptr;    // S3 front (combined-server processes)
+Server* g_filersrv = nullptr; // filer front (combined-server processes)
 
 void set_nonblock(int fd, bool nb) {
   int fl = fcntl(fd, F_GETFL, 0);
@@ -1099,7 +1134,7 @@ bool gate_request(Conn* c, const Request& r, size_t avail) {
   const char* extra = "";
   if (r.deadline > 0 && wall_now() >= r.deadline) {
     deny = 504;
-  } else if (faults_on.load(std::memory_order_relaxed)) {
+  } else if (faults_on[t_role].load(std::memory_order_relaxed)) {
     // same carve-outs as faults.aiohttp_middleware's _SKIP_PATHS
     static const char* kSkip[] = {"/metrics", "/debug/traces",
                                   "/debug/breakers", "/status", "/healthz"};
@@ -1109,9 +1144,13 @@ bool gate_request(Conn* c, const Request& r, size_t avail) {
     bool is_read = ieq(r.method, r.method_len, "GET") ||
                    ieq(r.method, r.method_len, "HEAD") ||
                    ieq(r.method, r.method_len, "OPTIONS");
-    double delay = is_read ? fault_read_delay : fault_write_delay;
+    double delay, prob;
+    {
+      std::lock_guard<std::mutex> lk(faults_mu);
+      delay = is_read ? fault_read_delay[t_role] : fault_write_delay[t_role];
+      prob = is_read ? fault_read_err[t_role] : fault_write_err[t_role];
+    }
     if (delay > 0) usleep((useconds_t)(delay * 1e6));
-    double prob = is_read ? fault_read_err : fault_write_err;
     if (prob > 0 && fault_roll() < prob) {
       deny = 503;
       // same contract as faults.aiohttp_middleware: the handler never
@@ -1514,7 +1553,7 @@ void respond_post_ok(Conn* c, bool keep_alive, int64_t body_len,
   c->out.append(jbody, bl);
   if (!keep_alive) c->want_close = true;
   count_resp(201, bl);
-  n_front_bytes_in += body_len;
+  front_stats[t_role].bytes_in += body_len;
 }
 
 void respond_delete_ok(Conn* c, bool keep_alive, int64_t reclaimed) {
@@ -2281,12 +2320,15 @@ int pump_inner(Server* s, Conn* c) {
   return 0;
 }
 
-int s3_pump_inner(Server* s, Conn* c);  // S3-role twin, defined below
+int s3_pump_inner(Server* s, Conn* c);     // S3-role twin, defined below
+int filer_pump_inner(Server* s, Conn* c);  // filer-role twin, below
 
 int pump(Server* s, Conn* c) {
   Conn* prev = s->pumping;
   s->pumping = c;
-  int st = s->role == ROLE_S3 ? s3_pump_inner(s, c) : pump_inner(s, c);
+  int st = s->role == ROLE_S3     ? s3_pump_inner(s, c)
+           : s->role == ROLE_FILER ? filer_pump_inner(s, c)
+                                   : pump_inner(s, c);
   s->pumping = prev;
   return st;
 }
@@ -2978,7 +3020,46 @@ std::unordered_map<std::string, S3Ent> s3_cache;  // "/bucket/key"
 constexpr size_t S3_CACHE_CAP = 200000;
 
 std::atomic<int64_t> n_s3_put{0}, n_s3_get{0}, n_s3_reject{0},
-    n_s3_chan_fail{0}, n_s3_del{0};
+    n_s3_chan_fail{0}, n_s3_del{0}, n_s3_part{0};
+
+// live multipart upload ids ("bucket\tupload_id"), synced by the python
+// glue's meta listener from /buckets/<b>/.uploads/<id>/ marker dirs; a
+// part-upload PUT whose id is absent relays to python (which answers
+// NoSuchUpload itself — no XML parity burden here)
+std::shared_mutex s3_upload_mu;
+std::unordered_set<std::string> s3_uploads;
+
+// ---- native filer front (role ROLE_FILER) state ----
+// Entry cache keyed by the normalized full path ("/dir/file"). Like the
+// S3 cache it is positive-only and maintained exclusively by the python
+// glue's meta-event listener, so it inherits the zero-staleness
+// contract: any mutation (either channel) emits a meta event before the
+// mutating call returns, and the listener runs synchronously on it.
+struct FilerEnt {
+  uint32_t vid;
+  uint64_t key;
+  uint32_t cookie;
+  int64_t size;
+  int64_t mtime;  // unix seconds
+  std::string etag, mime;
+  std::string ext;  // response-ready "x-seaweed-ext-k: v\r\n" block
+};
+std::shared_mutex filer_cache_mu;
+std::unordered_map<std::string, FilerEnt> filer_cache;
+constexpr size_t FILER_CACHE_CAP = 200000;
+
+// pre-assigned fid slots for native filer PUTs (default collection /
+// replication — anything else relays)
+std::mutex filer_pool_mu;
+std::deque<S3Slot> filer_pool;
+
+// Native writes are only sound while the python filer would apply no
+// per-path policy the front can't see: the glue clears this whenever
+// filer.conf rules, cipher, or save-inline limits are active.
+std::atomic<bool> filer_writes_on{false};
+
+std::atomic<int64_t> n_filer_put{0}, n_filer_get{0}, n_filer_del{0},
+    n_filer_chan_fail{0};
 
 // scan the raw request head for one header (case-insensitive name)
 bool find_header(const char* head, size_t head_len, const char* name,
@@ -3054,6 +3135,7 @@ void s3_error(Conn* c, int status, const char* code, const char* msg,
   c->out.append(body, bl);
   if (!keep_alive) c->want_close = true;
   n_s3_reject++;
+  count_resp(status, bl);
 }
 
 constexpr const char* EMPTY_SHA256 =
@@ -3070,7 +3152,7 @@ enum class S3Auth { OK, REJECTED, RELAY };
 S3Auth s3_auth(Conn* c, const Request& r, const char* head,
                const char* method, bool need_write,
                const std::string& bucket, const uint8_t* body,
-               int64_t body_len) {
+               int64_t body_len, const std::string& canon_query = "") {
   {
     std::shared_lock<std::shared_mutex> lk(s3_mu);
     if (s3_open_mode) return S3Auth::OK;
@@ -3178,7 +3260,9 @@ S3Auth s3_auth(Conn* c, const Request& r, const char* head,
   creq += method;
   creq += '\n';
   creq.append(r.path, r.path_len);
-  creq += "\n\n";  // empty canonical query
+  creq += '\n';
+  creq += canon_query;  // "" for the query-less fast paths
+  creq += '\n';
   for (const auto& nm : names) {
     creq += nm;
     creq += ':';
@@ -3252,11 +3336,23 @@ S3Auth s3_auth(Conn* c, const Request& r, const char* head,
   return S3Auth::OK;
 }
 
+// One gated channel mutation awaiting the python applier's ack. The
+// response shape on success is per-kind (S3 and filer fronts share the
+// channel machinery; is_delete kept as a kind alias for readability).
+constexpr int OP_S3_PUT = 0;    // 200 + ETag + Content-Length: 0
+constexpr int OP_S3_DEL = 1;    // 204 No Content
+constexpr int OP_S3_PART = 2;   // part upload: same wire shape as PUT
+constexpr int OP_FILER_PUT = 3; // 201 + {"name","size","etag"} json
+constexpr int OP_FILER_DEL = 4; // 204 No Content
+
 struct S3Op {
   Conn* client;
   bool keep_alive = true;
-  bool is_delete = false;
+  bool is_delete = false;  // OP_S3_DEL / OP_FILER_DEL
+  int kind = OP_S3_PUT;
   std::string etag;
+  std::string name;      // OP_FILER_PUT: final path segment
+  int64_t size = 0;      // OP_FILER_PUT: body size for the json reply
 };
 
 void arm_chan(Server* s, uint32_t events) {
@@ -3280,7 +3376,8 @@ void chan_flush(Server* s) {
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-    n_s3_chan_fail++;  // applier died: pending ops fail via chan_read EOF
+    // applier died: pending ops fail via chan_read EOF
+    (s->role == ROLE_FILER ? n_filer_chan_fail : n_s3_chan_fail)++;
     break;
   }
   if (s->chan_out_off == s->chan_out.size()) {
@@ -3300,27 +3397,61 @@ void s3_finalize(Server* s, S3Op* op, int status) {
     return;
   }
   if (status >= 200 && status < 300) {
-    char head[256];
+    char head[512];
     int hl;
     if (op->is_delete) {
-      // S3 DeleteObject: 204 whether or not the key existed
+      // S3 DeleteObject / filer DELETE: 204 whether or not the key
+      // existed (the python filer answers 204 the same way)
       hl = snprintf(head, sizeof head,
                     "HTTP/1.1 204 No Content\r\n%s\r\n",
                     op->keep_alive ? "" : "Connection: close\r\n");
-      n_s3_del++;
+      if (op->kind == OP_FILER_DEL)
+        n_filer_del++;
+      else
+        n_s3_del++;
+      count_resp(204, 0);
+    } else if (op->kind == OP_FILER_PUT) {
+      // byte-match the python filer's 201 json
+      // (web.json_response({"name","size","etag"}))
+      char jbody[384];
+      int bl = snprintf(jbody, sizeof jbody,
+                        "{\"name\": \"%s\", \"size\": %lld, "
+                        "\"etag\": \"%s\"}",
+                        op->name.c_str(), (long long)op->size,
+                        op->etag.c_str());
+      hl = snprintf(head, sizeof head,
+                    "HTTP/1.1 201 Created\r\n"
+                    "Content-Type: application/json; charset=utf-8\r\n"
+                    "Content-Length: %d\r\n%s\r\n",
+                    bl, op->keep_alive ? "" : "Connection: close\r\n");
+      c->out.append(head, hl);
+      c->out.append(jbody, bl);
+      hl = 0;
+      n_filer_put++;
+      count_resp(201, bl);
+      front_stats[t_role].bytes_in += op->size;
     } else {
       hl = snprintf(head, sizeof head,
                     "HTTP/1.1 200 OK\r\nETag: \"%s\"\r\n"
                     "Content-Length: 0\r\n%s\r\n",
                     op->etag.c_str(),
                     op->keep_alive ? "" : "Connection: close\r\n");
-      n_s3_put++;
+      if (op->kind == OP_S3_PART)
+        n_s3_part++;
+      else
+        n_s3_put++;
+      count_resp(200, 0);
+      front_stats[t_role].bytes_in += op->size;
     }
-    c->out.append(head, hl);
+    if (hl) c->out.append(head, hl);
     if (!op->keep_alive) c->want_close = true;
   } else {
-    s3_error(c, 500, "InternalError", "metadata mutation failed", "", 0,
-             op->keep_alive);
+    if (op->kind == OP_FILER_PUT || op->kind == OP_FILER_DEL) {
+      simple_response(c, 500, "metadata mutation failed", op->keep_alive);
+    } else {
+      s3_error(c, 500, "InternalError", "metadata mutation failed", "", 0,
+               op->keep_alive);
+    }
   }
   c->sent_100 = false;
   delete op;
@@ -3362,7 +3493,7 @@ void chan_read(Server* s) {
   }
   if (dead) {
     // the python applier is gone: fail every gated PUT loudly
-    n_s3_chan_fail++;
+    (s->role == ROLE_FILER ? n_filer_chan_fail : n_s3_chan_fail)++;
     std::unordered_map<uint64_t, S3Op*> pending;
     pending.swap(s->s3_pending);
     for (auto& [id, op] : pending) s3_finalize(s, op, 500);
@@ -3481,6 +3612,7 @@ bool s3_serve_cached(Conn* c, const Request& r, const S3Ent& ent,
   c->out.append("\r\n");
   if (!is_head) c->out.append((const char*)data + start, body_len);
   if (!r.keep_alive) c->want_close = true;
+  count_resp(partial ? 206 : 200, is_head ? 0 : body_len);
   return true;
 }
 
@@ -3606,6 +3738,125 @@ int s3_handle_put(Server* s, Conn* c, const Request& r, const char* head,
   op->client = c;
   op->keep_alive = r.keep_alive;
   op->etag = etag;
+  op->size = body_len;
+  s->s3_pending[id] = op;
+  c->repl_pending = true;
+  s->chan_out += rec;  // flushed once per epoll batch
+  return 1;
+}
+
+// Recognize exactly "partNumber=N&uploadId=H" (either order, nothing
+// else, unreserved bytes only — so the canonical-query form used for
+// SigV4 is the literal sorted pair). Returns false = not a plain part
+// upload: relay.
+bool parse_part_query(const char* q, size_t qlen, std::string* upload_id,
+                      long* part_num) {
+  std::string pn, uid;
+  size_t i = 0;
+  while (i < qlen) {
+    size_t amp = i;
+    while (amp < qlen && q[amp] != '&') amp++;
+    const char* eq = (const char*)memchr(q + i, '=', amp - i);
+    if (!eq) return false;
+    std::string k(q + i, eq - q - i);
+    std::string v(eq + 1, q + amp - eq - 1);
+    if (k == "partNumber" && pn.empty())
+      pn = v;
+    else if (k == "uploadId" && uid.empty())
+      uid = v;
+    else
+      return false;  // extra/duplicate params: python's call
+    i = amp + 1;
+  }
+  if (pn.empty() || pn.size() > 5 || uid.empty()) return false;
+  for (char ch : pn)
+    if (!isdigit((unsigned char)ch)) return false;
+  for (char ch : uid)
+    if (!(isalnum((unsigned char)ch) || ch == '-' || ch == '.' ||
+          ch == '_' || ch == '~'))
+      return false;  // would need percent-encoding in the canonical form
+  long n = strtol(pn.c_str(), nullptr, 10);
+  if (n < 1 || n > 10000) return false;  // python answers InvalidArgument
+  *upload_id = uid;
+  *part_num = n;
+  return true;
+}
+
+// Multipart part-upload fast path (UploadPart is the highest-volume
+// verb the S3 front still relayed): append the part bytes locally and
+// gate the part-entry insert (/buckets/<b>/.uploads/<id>/NNNNN.part)
+// through the channel. Returns 0 to relay — notably when the upload id
+// is not in the live set, so python's NoSuchUpload XML applies.
+int s3_handle_part(Server* s, Conn* c, const Request& r, const char* head,
+                   const std::string& bucket, const std::string& upload_id,
+                   long part_num, const uint8_t* body, int64_t body_len) {
+  {
+    std::shared_lock<std::shared_mutex> lk(s3_upload_mu);
+    if (!s3_uploads.count(bucket + "\t" + upload_id)) return 0;
+  }
+  char cq[128];
+  snprintf(cq, sizeof cq, "partNumber=%ld&uploadId=%s", part_num,
+           upload_id.c_str());
+  S3Auth a = s3_auth(c, r, head, "PUT", true, bucket, body, body_len, cq);
+  if (a == S3Auth::RELAY) return 0;
+  if (a == S3Auth::REJECTED) return 1;
+  S3Slot slot;
+  {
+    std::lock_guard<std::mutex> lk(s3_pool_mu);
+    auto it = s3_pools.find(bucket);
+    if (it == s3_pools.end() || it->second.empty()) return 0;
+    slot = it->second.front();
+  }
+  std::shared_ptr<Vol> v = find_vol(slot.vid);
+  if (!v) return 0;
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached || v->read_only || v->has_replicas) return 0;
+  }
+  {
+    std::lock_guard<std::mutex> lk(s3_pool_mu);
+    s3_pools[bucket].pop_front();
+  }
+  uint32_t crc = 0;
+  int st = append_plain(v, slot.key, slot.cookie, body, body_len, &crc);
+  if (st == 0 || st == 409) return 0;
+  if (st != 201) {
+    n_errors++;
+    s3_error(c, 500, "InternalError", "volume write failed", r.path,
+             r.path_len, r.keep_alive);
+    return 1;
+  }
+  // etag of the PART bytes: CompleteMultipartUpload composes the final
+  // "-N" etag from the parts' md5s, exactly like the python path's
+  // fullmd5 POST
+  std::string etag = md5_hex(body, (size_t)body_len);
+  char fid[48];
+  int fl = snprintf(fid, sizeof fid, "%u,%llx%08x", slot.vid,
+                    (unsigned long long)slot.key, slot.cookie);
+  // id \t part \t bucket \t upload_id \t part_number \t fid \t size
+  //    \t etag\n
+  uint64_t id = s->next_op_id++;
+  std::string rec;
+  rec.reserve(160);
+  char nbuf[64];
+  snprintf(nbuf, sizeof nbuf, "%llu\tpart\t", (unsigned long long)id);
+  rec += nbuf;
+  rec += bucket;
+  rec += '\t';
+  rec += upload_id;
+  snprintf(nbuf, sizeof nbuf, "\t%ld\t", part_num);
+  rec += nbuf;
+  rec.append(fid, fl);
+  snprintf(nbuf, sizeof nbuf, "\t%lld\t", (long long)body_len);
+  rec += nbuf;
+  rec += etag;
+  rec += '\n';
+  S3Op* op = new S3Op();
+  op->client = c;
+  op->keep_alive = r.keep_alive;
+  op->kind = OP_S3_PART;
+  op->etag = etag;
+  op->size = body_len;
   s->s3_pending[id] = op;
   c->repl_pending = true;
   s->chan_out += rec;  // flushed once per epoll batch
@@ -3655,12 +3906,21 @@ int s3_pump_inner(Server* s, Conn* c) {
       std::shared_lock<std::shared_mutex> lk(s3_mu);
       bucket_known = s3_buckets.count(bucket) > 0;
     }
+    // part-upload query recognized once per parse (PUT only)
+    std::string upload_id;
+    long part_num = 0;
+    bool is_part =
+        is_put && r.has_query && key_len &&
+        parse_part_query(r.query, r.query_len, &upload_id, &part_num);
     // deadline/fault gate — deferred while a fast-path PUT is still
     // buffering its body so it fires exactly once per request
+    // parts get a wider body gate: S3's own floor makes every
+    // non-final part >= 5MB, so a 1MB cap would relay all of them
+    int64_t put_max = is_part ? (16 << 20) : (1 << 20);
     bool fast_put_waiting =
-        is_put && bucket_known && key_len && !r.has_query &&
+        is_put && bucket_known && key_len && (!r.has_query || is_part) &&
         !r.proxy_only && !r.chunked && r.content_len > 0 &&
-        r.content_len <= (1 << 20) &&
+        r.content_len <= put_max &&
         avail - r.head_len < (size_t)r.content_len;
     if (!fast_put_waiting && gate_request(c, r, avail)) continue;
     if ((is_get || is_head) && bucket_known && !r.has_query &&
@@ -3692,9 +3952,9 @@ int s3_pump_inner(Server* s, Conn* c) {
         }
       }
       // miss / unsure: relay below
-    } else if (is_put && bucket_known && key_len && !r.has_query &&
-               !r.proxy_only && !r.chunked && r.content_len > 0 &&
-               r.content_len <= (1 << 20)) {
+    } else if (is_put && bucket_known && key_len &&
+               (!r.has_query || is_part) && !r.proxy_only && !r.chunked &&
+               r.content_len > 0 && r.content_len <= put_max) {
       if (r.expect_100 && !c->sent_100 &&
           avail - r.head_len < (size_t)r.content_len) {
         c->out.append("HTTP/1.1 100 Continue\r\n\r\n");
@@ -3702,8 +3962,11 @@ int s3_pump_inner(Server* s, Conn* c) {
       }
       if (avail - r.head_len < (size_t)r.content_len) break;
       const uint8_t* body = (const uint8_t*)head + r.head_len;
-      int took = s3_handle_put(s, c, r, head, bucket, key, key_len, body,
-                               r.content_len);
+      int took =
+          is_part ? s3_handle_part(s, c, r, head, bucket, upload_id,
+                                   part_num, body, r.content_len)
+                  : s3_handle_put(s, c, r, head, bucket, key, key_len,
+                                  body, r.content_len);
       if (took) {
         c->in_off += r.head_len + r.content_len;
         c->sent_100 = false;
@@ -3728,7 +3991,394 @@ int s3_pump_inner(Server* s, Conn* c) {
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Native filer front (role ROLE_FILER) — the filer HTTP gateway's hot
+// verbs (GET/PUT/HEAD/DELETE of plain files) in C++, byte-matching the
+// python handlers; everything else (listings, renames, WebDAV, tagging,
+// query verbs, conditional policy) relays to the python filer app.
+// Mutations ride the same TSV applier channel shape as the S3 front, so
+// the zero-staleness cache contract holds across both fronts.
+// ---------------------------------------------------------------------------
+
+// Reserved route prefixes the python app handles itself (the catch-all
+// file routes sit behind these in its route table).
+bool filer_reserved_path(const char* p, size_t n) {
+  static const char* kFirst[] = {"status", "metrics", "debug",
+                                 "ws", "dlm", "kv", "healthz"};
+  const char* seg = p + 1;
+  const char* slash = (const char*)memchr(seg, '/', n - 1);
+  size_t seg_len = slash ? (size_t)(slash - seg) : n - 1;
+  for (const char* f : kFirst)
+    if (seg_len == strlen(f) && memcmp(seg, f, seg_len) == 0) return true;
+  return false;
+}
+
+// A path the fast paths may serve: already in norm_path() form (no
+// empty or "." segments, no trailing slash) and restricted to bytes
+// that need no percent-decoding, no json escaping in the 201 body, and
+// no TSV escaping on the channel. Anything else relays so the python
+// normalization/unicode semantics apply verbatim.
+bool filer_path_ok(const char* p, size_t n) {
+  if (n < 2 || p[0] != '/' || p[n - 1] == '/') return false;
+  for (size_t i = 0; i < n; i++) {
+    char c = p[i];
+    if (!(isalnum((unsigned char)c) || c == '/' || c == '-' ||
+          c == '.' || c == '_' || c == '~'))
+      return false;
+  }
+  for (size_t i = 0; i + 1 < n; i++) {
+    if (p[i] != '/') continue;
+    if (p[i + 1] == '/') return false;                        // "//"
+    if (p[i + 1] == '.' && (i + 2 == n || p[i + 2] == '/'))
+      return false;                                           // "/./"
+  }
+  return !filer_reserved_path(p, n);
+}
+
+// Serve a filer GET/HEAD from the cache entry's local needle,
+// byte-matching handle_get's plain-file path: ETag/If-None-Match,
+// Last-Modified, Accept-Ranges, X-Seaweed-Entry and armored s3_* ext
+// headers, single-range 206 (on HEAD too — the python filer honors
+// Range on HEAD), and the bare 416. false = relay.
+bool filer_serve_cached(Conn* c, const Request& r, const char* head,
+                        const FilerEnt& ent, bool is_head) {
+  std::shared_ptr<Vol> v = find_vol(ent.vid);
+  if (!v) return false;
+  int64_t off;
+  int32_t size;
+  int version;
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached) return false;
+    auto it = v->map.find(ent.key);
+    if (it == v->map.end() || it->second.size <= 0) return false;
+    off = it->second.offset;
+    size = it->second.size;
+    version = v->version;
+  }
+  int64_t rec_len = disk_size(size, version);
+  std::string rec;
+  rec.resize(rec_len);
+  if (pread(v->dat_fd, &rec[0], rec_len, off) != rec_len) return false;
+  const uint8_t* p = (const uint8_t*)rec.data();
+  if (be64(p + 4) != ent.key || be32(p) != ent.cookie) return false;
+  uint32_t data_size = be32(p + HEADER);
+  if ((int64_t)data_size + 5 > size) return false;
+  const uint8_t* data = p + HEADER + 4;
+  uint8_t flags = data[data_size];
+  if (flags & FLAG_IS_COMPRESSED) return false;  // python inflates
+  uint32_t stored_crc = be32(p + HEADER + size);
+  uint32_t actual = data_size ? crc32c(0, data, data_size) : 0;
+  if (data_size && stored_crc != actual &&
+      stored_crc != legacy_crc_value(actual))
+    return false;  // corrupt: python's read path reports it properly
+  const char* mime = ent.mime.empty() ? "application/octet-stream"
+                                      : ent.mime.c_str();
+  char lm[40] = "";
+  struct tm tmv;
+  time_t mt = (time_t)ent.mtime;
+  gmtime_r(&mt, &tmv);
+  strftime(lm, sizeof lm, "%a, %d %b %Y %H:%M:%S GMT", &tmv);
+  // shared trailer: ETag .. ext block, same fields handle_get builds
+  char common[256];
+  int cl = snprintf(common, sizeof common,
+                    "ETag: \"%s\"\r\nLast-Modified: %s\r\n"
+                    "Accept-Ranges: bytes\r\nX-Seaweed-Entry: file\r\n",
+                    ent.etag.c_str(), lm);
+  if (cl >= (int)sizeof common) return false;
+  // If-None-Match precedes the Range logic, exactly like handle_get
+  const char* inm;
+  size_t inm_len;
+  if (find_header(head, r.head_len, "if-none-match", &inm, &inm_len) &&
+      inm_len == ent.etag.size() + 2 && inm[0] == '"' &&
+      inm[inm_len - 1] == '"' &&
+      memcmp(inm + 1, ent.etag.data(), ent.etag.size()) == 0) {
+    // no Content-Length: a 304 never carries a body (RFC 7232) and the
+    // python stack (aiohttp) omits it — parity is byte-level
+    c->out.append("HTTP/1.1 304 Not Modified\r\n");
+    c->out.append(common, cl);
+    c->out.append(ent.ext);
+    if (!r.keep_alive) c->out.append("Connection: close\r\n");
+    c->out.append("\r\n");
+    if (!r.keep_alive) c->want_close = true;
+    count_resp(304, 0);
+    return true;
+  }
+  int64_t start = 0, end = (int64_t)data_size - 1;
+  bool partial = false;
+  if (r.range) {
+    int rc = parse_byte_range(r.range, r.range_len, (int64_t)data_size,
+                              &start, &end);
+    if (rc == -2) {
+      // handle_get's bare 416: only Content-Range advertised (the
+      // python stack omits Content-Length on HEAD — parity is
+      // byte-level)
+      char h416[160];
+      int hn = snprintf(h416, sizeof h416,
+                        "HTTP/1.1 416 Range Not Satisfiable\r\n"
+                        "%s"
+                        "Content-Range: bytes */%u\r\n%s\r\n",
+                        is_head ? "" : "Content-Length: 0\r\n",
+                        data_size,
+                        r.keep_alive ? "" : "Connection: close\r\n");
+      c->out.append(h416, hn);
+      if (!r.keep_alive) c->want_close = true;
+      count_resp(416, 0);
+      return true;
+    }
+    if (rc < 0) return false;  // malformed/multi-range: python decides
+    partial = rc == 1;
+  }
+  int64_t body_len = end - start + 1;
+  // HEAD advertises the would-be body length (range-aware, like the
+  // python handler) and sends no body
+  char h[640];
+  int hl = snprintf(h, sizeof h,
+                    "HTTP/1.1 %s\r\nContent-Type: %s\r\n"
+                    "Content-Length: %lld\r\n",
+                    partial ? "206 Partial Content" : "200 OK", mime,
+                    (long long)body_len);
+  if (hl >= (int)sizeof h) return false;
+  c->out.append(h, hl);
+  if (partial) {
+    char crng[96];
+    int cn = snprintf(crng, sizeof crng,
+                      "Content-Range: bytes %lld-%lld/%u\r\n",
+                      (long long)start, (long long)end, data_size);
+    c->out.append(crng, cn);
+  }
+  c->out.append(common, cl);
+  c->out.append(ent.ext);
+  if (!r.keep_alive) c->out.append("Connection: close\r\n");
+  c->out.append("\r\n");
+  if (!is_head) c->out.append((const char*)data + start, body_len);
+  if (!r.keep_alive) c->want_close = true;
+  count_resp(partial ? 206 : 200, is_head ? 0 : body_len);
+  return true;
+}
+
+// Filer PUT/POST fast path: local append + gated entry insert through
+// the channel (the applier runs Filer.create_entry with the server's
+// default collection/replication — the writes gate guarantees no
+// filer.conf rule would have said otherwise). Returns 0 to relay.
+int filer_handle_put(Server* s, Conn* c, const Request& r,
+                     const char* head, const uint8_t* body,
+                     int64_t body_len) {
+  auto ascii_clean = [](const char* q, const char* qe) {
+    for (; q < qe; q++) {
+      unsigned char ch = (unsigned char)*q;
+      if (ch < 0x20 || ch >= 0x7f) return false;
+    }
+    return true;
+  };
+  // headers that change python's write semantics relay: Content-MD5
+  // (pre-validated + whole-stream md5), x-seaweed-ext-* (extended
+  // attrs), multipart/form-data (form decode)
+  const char* ct = nullptr;
+  size_t ct_len = 0;
+  if (find_header(head, r.head_len, "content-type", &ct, &ct_len)) {
+    if (!ascii_clean(ct, ct + ct_len)) return 0;
+    if (ct_len >= 19 && strncasecmp(ct, "multipart/form-data", 19) == 0)
+      return 0;
+  }
+  {
+    const char* q;
+    size_t ql;
+    if (find_header(head, r.head_len, "content-md5", &q, &ql)) return 0;
+    const char* hp = (const char*)memchr(head, '\n', r.head_len);
+    const char* end = head + r.head_len;
+    hp = hp ? hp + 1 : end;
+    while (hp < end) {
+      const char* le = (const char*)memchr(hp, '\n', end - hp);
+      if (!le) break;
+      const char* colon = (const char*)memchr(hp, ':', le - hp);
+      if (colon && colon - hp > 14 &&
+          strncasecmp(hp, "x-seaweed-ext-", 14) == 0)
+        return 0;
+      hp = le + 1;
+    }
+  }
+  S3Slot slot;
+  {
+    std::lock_guard<std::mutex> lk(filer_pool_mu);
+    if (filer_pool.empty()) return 0;  // dry: relay, refill replenishes
+    slot = filer_pool.front();
+  }
+  std::shared_ptr<Vol> v = find_vol(slot.vid);
+  if (!v) return 0;
+  {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->detached || v->read_only || v->has_replicas) return 0;
+  }
+  {
+    std::lock_guard<std::mutex> lk(filer_pool_mu);
+    filer_pool.pop_front();
+  }
+  uint32_t crc = 0;
+  int st = append_plain(v, slot.key, slot.cookie, body, body_len, &crc);
+  if (st == 0 || st == 409) return 0;  // python re-resolves placement
+  if (st != 201) {
+    n_errors++;
+    simple_response(c, 500, "volume write failed", r.keep_alive);
+    return 1;
+  }
+  // the chunk md5 IS the file md5 for a single-chunk entry, so this is
+  // both the 201 body's etag and the entry's md5 (handle_put parity)
+  std::string etag = md5_hex(body, (size_t)body_len);
+  char fid[48];
+  int fl = snprintf(fid, sizeof fid, "%u,%llx%08x", slot.vid,
+                    (unsigned long long)slot.key, slot.cookie);
+  // id \t put \t path \t fid \t size \t etag \t mime\n
+  // (deletes: id \t del \t path\n) — path passed filer_path_ok
+  // (unreserved bytes), mime gated printable-ASCII above
+  uint64_t id = s->next_op_id++;
+  std::string rec;
+  rec.reserve(160 + r.path_len);
+  char nbuf[48];
+  snprintf(nbuf, sizeof nbuf, "%llu\tput\t", (unsigned long long)id);
+  rec += nbuf;
+  rec.append(r.path, r.path_len);
+  rec += '\t';
+  rec.append(fid, fl);
+  snprintf(nbuf, sizeof nbuf, "\t%lld\t", (long long)body_len);
+  rec += nbuf;
+  rec += etag;
+  rec += '\t';
+  if (ct) rec.append(ct, ct_len);
+  rec += '\n';
+  const char* base = (const char*)memrchr(r.path, '/', r.path_len);
+  S3Op* op = new S3Op();
+  op->client = c;
+  op->keep_alive = r.keep_alive;
+  op->kind = OP_FILER_PUT;
+  op->etag = etag;
+  op->size = body_len;
+  op->name.assign(base + 1, r.path + r.path_len - base - 1);
+  s->s3_pending[id] = op;
+  c->repl_pending = true;
+  s->chan_out += rec;  // flushed once per epoll batch
+  return 1;
+}
+
+// Filer DELETE fast path — only for paths the cache proves are plain
+// files (directories keep python's recursive/conflict semantics). The
+// metadata delete rides the channel so chunk reclamation and the
+// invalidating meta event happen exactly as in the python path.
+int filer_handle_delete(Server* s, Conn* c, const Request& r) {
+  uint64_t id = s->next_op_id++;
+  std::string rec;
+  rec.reserve(32 + r.path_len);
+  char nbuf[48];
+  snprintf(nbuf, sizeof nbuf, "%llu\tdel\t", (unsigned long long)id);
+  rec += nbuf;
+  rec.append(r.path, r.path_len);
+  rec += '\n';
+  S3Op* op = new S3Op();
+  op->client = c;
+  op->keep_alive = r.keep_alive;
+  op->is_delete = true;
+  op->kind = OP_FILER_DEL;
+  s->s3_pending[id] = op;
+  c->repl_pending = true;
+  s->chan_out += rec;
+  return 1;
+}
+
+// Filer-role pump: hot plain-file verbs, relay for everything else.
+int filer_pump_inner(Server* s, Conn* c) {
+  if (c->repl_pending) return 0;  // gated mutation in flight
+  if (c->want_close) {
+    c->in.clear();
+    c->in_off = 0;
+    return 0;
+  }
+  while (true) {
+    if (c->in_off > 0 && c->in_off == c->in.size()) {
+      c->in.clear();
+      c->in_off = 0;
+    }
+    size_t avail = c->in.size() - c->in_off;
+    if (avail == 0) break;
+    Request r;
+    const char* head = c->in.data() + c->in_off;
+    ssize_t hl = parse_head(head, avail, &r);
+    if (hl < 0) return -1;
+    if (hl == 0) break;
+    bool is_get = ieq(r.method, r.method_len, "GET");
+    bool is_head = ieq(r.method, r.method_len, "HEAD");
+    // the python filer routes POST and PUT to the same handler
+    bool is_put = ieq(r.method, r.method_len, "PUT") ||
+                  ieq(r.method, r.method_len, "POST");
+    bool path_ok = filer_path_ok(r.path, r.path_len);
+    bool writes_on = filer_writes_on.load(std::memory_order_relaxed);
+    // deadline/fault gate — deferred while a fast-path PUT body is
+    // still buffering so it fires exactly once per request
+    bool fast_put_waiting =
+        is_put && writes_on && path_ok && !r.has_query && !r.proxy_only &&
+        !r.chunked && r.content_len > 0 && r.content_len <= (1 << 20) &&
+        avail - r.head_len < (size_t)r.content_len;
+    if (!fast_put_waiting && gate_request(c, r, avail)) continue;
+    if ((is_get || is_head) && path_ok && !r.has_query && !r.proxy_only &&
+        r.content_len == 0 && !r.chunked) {
+      FilerEnt ent;
+      bool hit = false;
+      {
+        std::shared_lock<std::shared_mutex> lk(filer_cache_mu);
+        auto it = filer_cache.find(std::string(r.path, r.path_len));
+        if (it != filer_cache.end()) {
+          ent = it->second;
+          hit = true;
+        }
+      }
+      if (hit && filer_serve_cached(c, r, head, ent, is_head)) {
+        c->in_off += r.head_len;
+        c->sent_100 = false;
+        n_filer_get++;
+        continue;
+      }
+      // miss (maybe a 404, a directory, an inline/multi-chunk entry):
+      // relay below — the cache is positive-plain-files-only
+    } else if (is_put && writes_on && path_ok && !r.has_query &&
+               !r.proxy_only && !r.chunked && r.content_len > 0 &&
+               r.content_len <= (1 << 20)) {
+      if (r.expect_100 && !c->sent_100 &&
+          avail - r.head_len < (size_t)r.content_len) {
+        c->out.append("HTTP/1.1 100 Continue\r\n\r\n");
+        c->sent_100 = true;
+      }
+      if (avail - r.head_len < (size_t)r.content_len) break;
+      const uint8_t* body = (const uint8_t*)head + r.head_len;
+      int took = filer_handle_put(s, c, r, head, body, r.content_len);
+      if (took) {
+        c->in_off += r.head_len + r.content_len;
+        c->sent_100 = false;
+        if (c->repl_pending) return 0;  // awaiting the applier's ack
+        continue;
+      }
+      // fall through to relay
+    } else if (ieq(r.method, r.method_len, "DELETE") && path_ok &&
+               !r.has_query && !r.proxy_only && !r.chunked &&
+               r.content_len == 0) {
+      bool hit = false;
+      {
+        std::shared_lock<std::shared_mutex> lk(filer_cache_mu);
+        hit = filer_cache.count(std::string(r.path, r.path_len)) > 0;
+      }
+      if (hit && filer_handle_delete(s, c, r)) {
+        c->in_off += r.head_len;
+        c->sent_100 = false;
+        if (c->repl_pending) return 0;
+        continue;
+      }
+      // unknown path: relay (python's 404/recursive semantics)
+    }
+    return proxy_handoff(s, c, r, avail);
+  }
+  return 0;
+}
+
 void io_loop(Server* s) {
+  t_role = s->role;
   struct epoll_event evs[128];
   while (!s->stop.load()) {
     int n = epoll_wait(s->epoll_fd, evs, 128, 1000);
@@ -3818,6 +4468,7 @@ void io_loop(Server* s) {
 }
 
 void worker_loop(Server* s) {
+  t_role = s->role;
   while (true) {
     Conn* c;
     {
@@ -4008,20 +4659,19 @@ void dp_config(int jwt_req, const char* secret) {
 // error probability and fixed delay per op class (read = GET/HEAD,
 // write = POST/PUT/DELETE), plus the RNG seed for deterministic chaos
 // runs. Meant to be set once at spawn, before traffic; all zeros turn
-// the gate off.
+// the gate off. dp_faults keeps the historical contract (volume role);
+// dp_role_faults addresses any role so each native front gets its own
+// -fault.spec gate (faults.native_params("volume"/"s3"/"filer")).
 void dp_faults(double read_err, double write_err, double read_delay,
                double write_delay, uint64_t seed) {
-  auto clamp01 = [](double p) { return p < 0 ? 0.0 : p > 1 ? 1.0 : p; };
-  {
-    std::lock_guard<std::mutex> lk(faults_mu);
-    fault_read_err = clamp01(read_err);
-    fault_write_err = clamp01(write_err);
-    fault_read_delay = read_delay < 0 ? 0 : read_delay;
-    fault_write_delay = write_delay < 0 ? 0 : write_delay;
-    fault_rng = seed ? seed : 0x9E3779B97F4A7C15ull;
-  }
-  faults_on.store(fault_read_err > 0 || fault_write_err > 0 ||
-                  fault_read_delay > 0 || fault_write_delay > 0);
+  set_role_faults(ROLE_VOLUME, read_err, write_err, read_delay,
+                  write_delay, seed);
+}
+
+void dp_role_faults(int role, double read_err, double write_err,
+                    double read_delay, double write_delay, uint64_t seed) {
+  if (role < 0 || role >= N_ROLES) return;
+  set_role_faults(role, read_err, write_err, read_delay, write_delay, seed);
 }
 
 // -- native S3 front ---------------------------------------------------------
@@ -4092,6 +4742,21 @@ void dp_s3_set_identities(const char* tsv) {
   s3_open_mode = idents.empty();
   s3_idents.swap(idents);
   s3_keycache.clear();  // secrets may have rotated
+}
+
+// Known in-flight multipart uploads, maintained incrementally from the
+// filer meta events for the .uploads marker directories: present=1 on
+// initiate, 0 on complete/abort. Only marked uploads take the native
+// part-upload path; unknown ids relay so python's NoSuchUpload XML is
+// byte-identical.
+void dp_s3_upload_mark(const char* bucket, const char* upload_id,
+                       int present) {
+  std::string k = std::string(bucket) + "\t" + upload_id;
+  std::unique_lock<std::shared_mutex> lk(s3_upload_mu);
+  if (present)
+    s3_uploads.insert(std::move(k));
+  else
+    s3_uploads.erase(k);
 }
 
 void dp_s3_set_buckets(const char* csv) {
@@ -4171,6 +4836,100 @@ void dp_s3_stats(int64_t* out) {
   out[2] = n_s3_reject.load();
   out[3] = n_s3_chan_fail.load();
   out[4] = n_s3_del.load();
+  out[5] = n_s3_part.load();
+}
+
+// -- native filer front ------------------------------------------------------
+
+int dp_filer_start(uint16_t listen_port, uint16_t backend_port,
+                   int n_proxy_workers, uint16_t* actual_port,
+                   const char* listen_ip, int chan_fd) {
+  return start_server(&g_filersrv, ROLE_FILER, listen_port, backend_port,
+                      n_proxy_workers, actual_port, listen_ip, chan_fd);
+}
+
+void dp_filer_stop(void) {
+  stop_server(&g_filersrv);
+  filer_writes_on.store(false);
+  {
+    std::lock_guard<std::mutex> lk(filer_pool_mu);
+    filer_pool.clear();
+  }
+  {
+    std::unique_lock<std::shared_mutex> ulk(s3_upload_mu);
+    s3_uploads.clear();  // populated via the same filer meta stream
+  }
+  std::unique_lock<std::shared_mutex> clk(filer_cache_mu);
+  filer_cache.clear();
+}
+
+// Entry cache maintenance — like dp_s3_cache_put, called ONLY from the
+// filer's serialized meta event stream so ordering matches the store.
+// `ext_block` is a response-ready "x-seaweed-ext-k: v\r\n" blob.
+int dp_filer_cache_put(const char* path, const char* fid, int64_t size,
+                       const char* etag, const char* mime,
+                       const char* ext_block, int64_t mtime) {
+  std::string fp = std::string("/") + fid;
+  FilerEnt ent;
+  if (!parse_fid_path(fp.c_str(), fp.size(), &ent.vid, &ent.key,
+                      &ent.cookie))
+    return -EINVAL;
+  ent.size = size;
+  ent.mtime = mtime;
+  ent.etag = etag ? etag : "";
+  ent.mime = mime ? mime : "";
+  ent.ext = ext_block ? ext_block : "";
+  std::unique_lock<std::shared_mutex> lk(filer_cache_mu);
+  if (filer_cache.size() >= FILER_CACHE_CAP) filer_cache.clear();
+  filer_cache[path] = std::move(ent);
+  return 0;
+}
+
+void dp_filer_invalidate(const char* path, int is_prefix) {
+  std::unique_lock<std::shared_mutex> lk(filer_cache_mu);
+  if (!is_prefix) {
+    filer_cache.erase(path);
+    return;
+  }
+  size_t plen = strlen(path);
+  for (auto it = filer_cache.begin(); it != filer_cache.end();) {
+    if (it->first.compare(0, plen, path) == 0)
+      it = filer_cache.erase(it);
+    else
+      ++it;
+  }
+}
+
+int dp_filer_push_fids(const char* fid, int count) {
+  std::string path = std::string("/") + fid;
+  uint32_t vid, cookie;
+  uint64_t key;
+  if (!parse_fid_path(path.c_str(), path.size(), &vid, &key, &cookie))
+    return -EINVAL;
+  std::lock_guard<std::mutex> lk(filer_pool_mu);
+  for (int i = 0; i < count; i++)
+    filer_pool.push_back({vid, key + (uint64_t)i, cookie});
+  return 0;
+}
+
+int dp_filer_pool_level(void) {
+  std::lock_guard<std::mutex> lk(filer_pool_mu);
+  return (int)filer_pool.size();
+}
+
+// The write fast path is only sound while the filer would apply its
+// defaults verbatim (no filer.conf path rules, no cipher, no
+// save-inside-filer inlining); the glue re-checks each refill tick and
+// flips this gate.
+void dp_filer_set_writes(int on) {
+  filer_writes_on.store(on != 0);
+}
+
+void dp_filer_stats(int64_t* out) {
+  out[0] = n_filer_put.load();
+  out[1] = n_filer_get.load();
+  out[2] = n_filer_del.load();
+  out[3] = n_filer_chan_fail.load();
 }
 
 // test hook: md5 hex of a buffer (validates the in-tree MD5)
@@ -4400,16 +5159,31 @@ void dp_http_stats(int64_t* out) {
 }
 
 // out[0..5] = 2xx, 3xx, 4xx, 5xx responses written by the native
-// front itself, payload bytes in (uploads), payload bytes out (served
-// bodies). Monotonic snapshot for the host's /metrics merge
-// (native_front_requests_total{code} / native_front_bytes_total).
+// fronts, payload bytes in (uploads), payload bytes out (served
+// bodies). dp_front_stats sums all roles (the historical series);
+// dp_role_front_stats snapshots one role so the host can federate
+// per-front families (native_front_requests_total{front=...}).
 void dp_front_stats(int64_t* out) {
-  out[0] = n_front_2xx.load();
-  out[1] = n_front_3xx.load();
-  out[2] = n_front_4xx.load();
-  out[3] = n_front_5xx.load();
-  out[4] = n_front_bytes_in.load();
-  out[5] = n_front_bytes_out.load();
+  for (int i = 0; i < 6; i++) out[i] = 0;
+  for (int r = 0; r < N_ROLES; r++) {
+    out[0] += front_stats[r].n_2xx.load();
+    out[1] += front_stats[r].n_3xx.load();
+    out[2] += front_stats[r].n_4xx.load();
+    out[3] += front_stats[r].n_5xx.load();
+    out[4] += front_stats[r].bytes_in.load();
+    out[5] += front_stats[r].bytes_out.load();
+  }
+}
+
+void dp_role_front_stats(int role, int64_t* out) {
+  for (int i = 0; i < 6; i++) out[i] = 0;
+  if (role < 0 || role >= N_ROLES) return;
+  out[0] = front_stats[role].n_2xx.load();
+  out[1] = front_stats[role].n_3xx.load();
+  out[2] = front_stats[role].n_4xx.load();
+  out[3] = front_stats[role].n_5xx.load();
+  out[4] = front_stats[role].bytes_in.load();
+  out[5] = front_stats[role].bytes_out.load();
 }
 
 // ---------------------------------------------------------------------------

@@ -22,6 +22,11 @@ from ..storage import types as t
 _lib = None
 _load_lock = threading.Lock()
 
+# role ids, mirroring ROLE_* in dataplane.cc
+ROLE_VOLUME = 0
+ROLE_S3 = 1
+ROLE_FILER = 2
+
 
 def available() -> bool:
     from . import build as _b
@@ -138,6 +143,45 @@ def _load() -> ctypes.CDLL:
         lib.dp_s3_stats.restype = None
         lib.dp_md5_hex.argtypes = [u8p, ctypes.c_int64, ctypes.c_char_p]
         lib.dp_md5_hex.restype = None
+        try:
+            # role-addressed fronts (filer front + per-role faults and
+            # counters) — absent from prebuilt .so files older than the
+            # filer front; the callers degrade gracefully
+            lib.dp_role_faults.argtypes = [ctypes.c_int, ctypes.c_double,
+                                           ctypes.c_double, ctypes.c_double,
+                                           ctypes.c_double, ctypes.c_uint64]
+            lib.dp_role_faults.restype = None
+            lib.dp_role_front_stats.argtypes = [ctypes.c_int, i64p]
+            lib.dp_role_front_stats.restype = None
+            lib.dp_s3_upload_mark.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_char_p, ctypes.c_int]
+            lib.dp_s3_upload_mark.restype = None
+            lib.dp_filer_start.argtypes = [ctypes.c_uint16, ctypes.c_uint16,
+                                           ctypes.c_int,
+                                           ctypes.POINTER(ctypes.c_uint16),
+                                           ctypes.c_char_p, ctypes.c_int]
+            lib.dp_filer_start.restype = ctypes.c_int
+            lib.dp_filer_stop.argtypes = []
+            lib.dp_filer_stop.restype = None
+            lib.dp_filer_cache_put.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int64]
+            lib.dp_filer_cache_put.restype = ctypes.c_int
+            lib.dp_filer_invalidate.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int]
+            lib.dp_filer_invalidate.restype = None
+            lib.dp_filer_push_fids.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_int]
+            lib.dp_filer_push_fids.restype = ctypes.c_int
+            lib.dp_filer_pool_level.argtypes = []
+            lib.dp_filer_pool_level.restype = ctypes.c_int
+            lib.dp_filer_set_writes.argtypes = [ctypes.c_int]
+            lib.dp_filer_set_writes.restype = None
+            lib.dp_filer_stats.argtypes = [i64p]
+            lib.dp_filer_stats.restype = None
+        except AttributeError:
+            pass
         _lib = lib
         return lib
 
@@ -396,6 +440,19 @@ class DataPlane:
                 "4xx": int(out[2]), "5xx": int(out[3]),
                 "bytes_in": int(out[4]), "bytes_out": int(out[5])}
 
+    def role_front_stats(self, role: int) -> dict | None:
+        """Per-role front counters (ROLE_VOLUME/ROLE_S3/ROLE_FILER) for
+        the per-front /metrics families; None when the loaded library
+        predates the role-addressed fronts."""
+        fn = getattr(self._lib, "dp_role_front_stats", None)
+        if fn is None:
+            return None
+        out = (ctypes.c_int64 * 6)()
+        fn(role, out)
+        return {"2xx": int(out[0]), "3xx": int(out[1]),
+                "4xx": int(out[2]), "5xx": int(out[3]),
+                "bytes_in": int(out[4]), "bytes_out": int(out[5])}
+
 
 class NativeNeedleMap:
     """needle_map interface over an attached volume's native map —
@@ -524,10 +581,98 @@ class S3Front:
     def invalidate(self, path: str, prefix: bool = False) -> None:
         self._lib.dp_s3_invalidate(path.encode(), 1 if prefix else 0)
 
+    def upload_mark(self, bucket: str, upload_id: str,
+                    present: bool) -> None:
+        """Mark a multipart upload id as in flight (initiate) or gone
+        (complete/abort); only marked uploads take the native
+        part-upload path."""
+        fn = getattr(self._lib, "dp_s3_upload_mark", None)
+        if fn is not None:
+            fn(bucket.encode(), upload_id.encode(), 1 if present else 0)
+
+    def set_faults(self, read_err: float = 0.0, write_err: float = 0.0,
+                   read_delay: float = 0.0, write_delay: float = 0.0,
+                   seed: int = 0) -> None:
+        """This front's share of a -fault.spec (service 's3')."""
+        fn = getattr(self._lib, "dp_role_faults", None)
+        if fn is not None:
+            fn(ROLE_S3, read_err, write_err, read_delay, write_delay,
+               seed & 0xFFFFFFFFFFFFFFFF)
+
     def stats(self) -> dict:
-        out = np.zeros(5, np.int64)
+        out = np.zeros(6, np.int64)
         self._lib.dp_s3_stats(
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return {"fast_put": int(out[0]), "fast_get": int(out[1]),
                 "rejected": int(out[2]), "chan_fail": int(out[3]),
-                "fast_del": int(out[4])}
+                "fast_del": int(out[4]), "fast_part": int(out[5])}
+
+
+class FilerFront:
+    """The native filer gateway front (one per process, combined-server
+    mode): owns the public filer port, serves GET/PUT/HEAD/DELETE of
+    plain files natively against the LOCAL volume store, and relays
+    every other verb/path class to the python filer app on
+    `backend_port`. Entry mutations ride the same TSV applier channel
+    shape as the S3 front (`chan_sock` socketpair created by the
+    caller), so the zero-staleness cache contract holds across both
+    fronts. See the filer-front block in dataplane.cc."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self.port = 0
+        if not hasattr(self._lib, "dp_filer_start"):
+            raise OSError("loaded dataplane library predates the filer "
+                          "front; rebuild it")
+
+    def start(self, listen_port: int, backend_port: int, chan_fd: int,
+              workers: int = 2, listen_ip: str = "") -> int:
+        actual = ctypes.c_uint16(0)
+        rc = self._lib.dp_filer_start(listen_port, backend_port, workers,
+                                      ctypes.byref(actual),
+                                      listen_ip.encode(), chan_fd)
+        if rc != 0:
+            raise OSError(-rc, f"dp_filer_start failed: {os.strerror(-rc)}")
+        self.port = int(actual.value)
+        return self.port
+
+    def stop(self) -> None:
+        self._lib.dp_filer_stop()
+
+    def push_fids(self, fid: str, count: int) -> None:
+        rc = self._lib.dp_filer_push_fids(fid.encode(), count)
+        if rc != 0:
+            raise ValueError(f"bad fid {fid!r}")
+
+    def pool_level(self) -> int:
+        return int(self._lib.dp_filer_pool_level())
+
+    def set_writes(self, on: bool) -> None:
+        """Enable the native PUT/DELETE fast path — only sound while
+        the python filer would apply its defaults verbatim (no
+        filer.conf path rules, no cipher, no save-inside inlining)."""
+        self._lib.dp_filer_set_writes(1 if on else 0)
+
+    def cache_put(self, path: str, fid: str, size: int, etag: str,
+                  mime: str, ext_block: str, mtime: int) -> None:
+        self._lib.dp_filer_cache_put(path.encode(), fid.encode(), size,
+                                     etag.encode(), mime.encode(),
+                                     ext_block.encode(), mtime)
+
+    def invalidate(self, path: str, prefix: bool = False) -> None:
+        self._lib.dp_filer_invalidate(path.encode(), 1 if prefix else 0)
+
+    def set_faults(self, read_err: float = 0.0, write_err: float = 0.0,
+                   read_delay: float = 0.0, write_delay: float = 0.0,
+                   seed: int = 0) -> None:
+        """This front's share of a -fault.spec (service 'filer')."""
+        self._lib.dp_role_faults(ROLE_FILER, read_err, write_err,
+                                 read_delay, write_delay,
+                                 seed & 0xFFFFFFFFFFFFFFFF)
+
+    def stats(self) -> dict:
+        out = np.zeros(4, np.int64)
+        self._lib.dp_filer_stats(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return {"fast_put": int(out[0]), "fast_get": int(out[1]),
+                "fast_del": int(out[2]), "chan_fail": int(out[3])}

@@ -28,9 +28,11 @@ import threading
 import time
 
 from ..filer import Entry, FileChunk
+from ..utils import faults
 from .auth import ACTION_ADMIN, ACTION_READ, ACTION_WRITE
 
 BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"
 POOL_LOW = 512
 POOL_BATCH = 2048
 CACHEABLE_MAX = 8 << 20
@@ -59,8 +61,14 @@ class NativeS3Front:
         # this object's GC can't double-close a number the OS may have
         # already handed to an unrelated socket
         self._chan_c.detach()
+        if faults.enabled():
+            # this front's share of -fault.spec (service 's3'), same
+            # mirror-at-spawn contract as the volume front
+            re_, we, rd, wd = faults.native_params("s3")
+            self.front.set_faults(re_, we, rd, wd, seed=faults.seed())
         self._sync_identities()
         self._load_buckets()
+        self._load_uploads()
         self.filer.meta_log.sync_listeners.append(self._on_meta_event)
         self._applier = threading.Thread(target=self._applier_loop,
                                          daemon=True,
@@ -130,6 +138,16 @@ class NativeS3Front:
         self._buckets = buckets
         self.front.set_buckets(sorted(buckets))
 
+    def _load_uploads(self) -> None:
+        """Mark multipart uploads already in flight at spawn; the meta
+        listener keeps the set exact from here on."""
+        for bucket in self._buckets:
+            entries = self.filer.list_entries(
+                f"{BUCKETS_DIR}/{bucket}/{UPLOADS_DIR}", limit=10000)
+            for e in entries:
+                if e.is_directory:
+                    self.front.upload_mark(bucket, e.name, True)
+
     # -- meta events (SYNC: under the filer mutation lock) --------------
     def _on_meta_event(self, ev: dict) -> None:
         d = ev["directory"]
@@ -154,6 +172,14 @@ class NativeS3Front:
                         self._buckets.add(name)
                     self.front.set_buckets(sorted(self._buckets))
                 continue
+            # /bucket/.uploads/<id> marker dirs gate the native
+            # part-upload path: present from initiate until
+            # complete/abort deletes the directory
+            segs = rel.split("/")
+            if is_dir and len(segs) == 4 and segs[2] == UPLOADS_DIR:
+                present = not (which == "old_entry"
+                               and ev["new_entry"] is None)
+                self.front.upload_mark(segs[1], segs[3], present)
             if which == "old_entry" or ev["new_entry"] is None \
                     or is_dir:
                 self.front.invalidate(rel, prefix=is_dir)
@@ -223,9 +249,11 @@ class NativeS3Front:
                     break
 
     def _apply_one(self, line: bytes) -> str:
-        # TSV record from the front (see s3_handle_put/_delete):
+        # TSV record from the front (see s3_handle_put/_delete/_part):
         #   id \t put \t bucket \t key \t fid \t size \t etag \t mime
         #   [\t k=v]...          |  id \t del \t bucket \t key
+        #   |  id \t part \t bucket \t upload_id \t part_number \t fid
+        #   \t size \t etag
         rec_id = b"0"
         try:
             cols = line.split(b"\t")
@@ -233,6 +261,22 @@ class NativeS3Front:
             op = cols[1]
             bucket = cols[2].decode()
             key = cols[3].decode()
+            if op == b"part":
+                # same entry _upload_part's filer POST would create:
+                # part md5 = md5 of the PART bytes (fullmd5), one chunk,
+                # never inlined (saveInside=false)
+                etag = cols[7].decode()
+                path = (f"{BUCKETS_DIR}/{bucket}/{UPLOADS_DIR}/{key}/"
+                        f"{int(cols[4]):05d}.part")
+                entry = Entry(
+                    full_path=path, mime="application/octet-stream",
+                    md5=etag, collection=bucket,
+                    chunks=[FileChunk(fid=cols[5].decode(), offset=0,
+                                      size=int(cols[6]),
+                                      mtime_ns=time.time_ns(),
+                                      etag=etag)])
+                self.filer.create_entry(entry, gc_old_chunks=True)
+                return f"{rec_id.decode()} 200\n"
             path = f"{BUCKETS_DIR}/{bucket}/{key}"
             if op == b"del":
                 # delete_entry of a missing path is a no-op — S3

@@ -31,6 +31,7 @@ class Cluster:
                  with_filer: bool = False,
                  filer_store: str = "memory",
                  filer_cipher: bool = False,
+                 filer_native: bool = False,
                  with_s3: bool = False,
                  s3_native: bool = False,
                  s3_config: dict | None = None,
@@ -128,6 +129,7 @@ class Cluster:
         self.s3 = None
         self.s3_thread: ServerThread | None = None
         self.s3_front = None
+        self.filer_front = None  # before s3: filer_url reads it
         if with_s3:
             from ..s3.server import S3ApiServer
             self.s3 = S3ApiServer(self.filer_url, iam_config=s3_config)
@@ -147,6 +149,21 @@ class Cluster:
                     self.s3, self.filer.filer, self.master_url, 0,
                     self.s3_thread.port)
                 self.s3._native_front = self.s3_front
+        if filer_native and self.filer is not None:
+            # same shape as s3_native: native volume front on server 0
+            # (the filer front appends to process-local vols) + the
+            # native filer front owning the public port, python filer
+            # app demoted to relay backend
+            from ..filer.native_front import NativeFilerFront
+
+            if self.volume_servers[0].dp is None:
+                backend = self.volume_threads[0]
+                public = self.volume_servers[0].enable_native(
+                    0, backend.port)
+                self.stores[0].port = public
+                self.stores[0].public_url = f"127.0.0.1:{public}"
+            self.filer_front = NativeFilerFront(
+                self.filer, self.master_url, 0, self.filer_thread.port)
         self.broker = None
         self.broker_thread: ServerThread | None = None
         self.wait_for_nodes(n_volume_servers)
@@ -165,6 +182,8 @@ class Cluster:
 
     @property
     def filer_url(self) -> str:
+        if self.filer_front is not None:
+            return f"http://127.0.0.1:{self.filer_front.port}"
         if self.filer_thread is None:
             raise RuntimeError("cluster started without a filer")
         return self.filer_thread.url
@@ -215,6 +234,8 @@ class Cluster:
             self.s3_front.stop()
         if self.s3_thread is not None:
             self.s3_thread.stop()
+        if self.filer_front is not None:
+            self.filer_front.stop()
         if self.filer_thread is not None:
             self.filer_thread.stop()
         for t in self.volume_threads:

@@ -2273,7 +2273,10 @@ class VolumeServer:
         """Native data-plane front counters appended to /metrics.
         These are monotonic snapshots owned by the C library, so they
         render directly instead of being pumped through the registry
-        (counter_add would double-count on every scrape)."""
+        (counter_add would double-count on every scrape).
+        `native_front_*` keeps its historical meaning (the volume
+        front); `native_fronts_*{front=...}` breaks all three roles
+        out per front for the "Native fronts" dashboard panel."""
         if self.dp is None:
             return ""
         try:
@@ -2292,6 +2295,35 @@ class VolumeServer:
             lines.append(
                 f'native_front_bytes_total{{direction="{direction}"}} '
                 f'{st["bytes_" + direction]}')
+        # per-role families: the S3/filer fronts run in this process
+        # (combined-server mode shares the one C library), so their
+        # counters federate through this volume server's /metrics
+        from ..native import dataplane as dpmod
+
+        per_role = []
+        for front, role in (("volume", dpmod.ROLE_VOLUME),
+                            ("s3", dpmod.ROLE_S3),
+                            ("filer", dpmod.ROLE_FILER)):
+            try:
+                rst = self.dp.role_front_stats(role)
+            except Exception:
+                rst = None
+            if rst is not None:
+                per_role.append((front, rst))
+        if per_role:
+            lines.append("# TYPE native_fronts_requests_total counter")
+            for front, rst in per_role:
+                for code in ("2xx", "3xx", "4xx", "5xx"):
+                    lines.append(
+                        f'native_fronts_requests_total{{front="{front}"'
+                        f',code="{code}"}} {rst[code]}')
+            lines.append("# TYPE native_fronts_bytes_total counter")
+            for front, rst in per_role:
+                for direction in ("in", "out"):
+                    lines.append(
+                        f'native_fronts_bytes_total{{front="{front}"'
+                        f',direction="{direction}"}} '
+                        f'{rst["bytes_" + direction]}')
         return "\n".join(lines) + "\n"
 
     async def handle_ui(self, req: web.Request) -> web.Response:

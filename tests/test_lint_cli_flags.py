@@ -1,8 +1,9 @@
 """Fast tier-1 lint: every robustness CLI knob (-repair.*, -fault.*,
--retry.*, -qos.*, -filer.store.*, -filer.cache.*, -tier.*) registered
-in cli.py carries non-empty help text — these flags gate chaos/repair/
-overload/metadata-plane/tiering behaviour and an undocumented one is
-effectively invisible to operators."""
+-retry.*, -qos.*, -filer.store.*, -filer.cache.*, -filer.native*,
+-tier.*) registered in cli.py carries non-empty help text — these
+flags gate chaos/repair/overload/metadata-plane/tiering/native-front
+behaviour and an undocumented one is effectively invisible to
+operators."""
 import ast
 import os
 
@@ -10,7 +11,8 @@ CLI_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu", "cli.py")
 
 PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
-            "-filer.store.", "-filer.cache.", "-tier.")
+            "-filer.store.", "-filer.cache.", "-filer.native",
+            "-tier.")
 
 
 def _add_argument_calls(tree):
@@ -57,6 +59,7 @@ def test_robustness_flags_have_help():
                      "-qos.requestFloor", "-qos.spec",
                      "-filer.store.shards", "-filer.cache.entries",
                      "-filer.cache.pages",
+                     "-filer.native", "-filer.native.workers",
                      "-tier.enabled", "-tier.interval",
                      "-tier.concurrency", "-tier.sealAfterIdle",
                      "-tier.offloadAfterIdle", "-tier.recallReads",

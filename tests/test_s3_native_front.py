@@ -209,6 +209,61 @@ def test_native_range_get(cluster, s3):
     assert r.status == 416
 
 
+def test_native_multipart_part_upload(cluster, s3):
+    """Part PUTs ride the native path (the one hot verb of a multipart
+    upload): initiate/complete stay python, but every part between them
+    is appended and recorded in C++ — and the assembled object must be
+    what python would have built."""
+    import xml.etree.ElementTree as ET
+
+    r = s3.post("/nf/mpu.bin", **{"uploads": ""})
+    assert r.status == 200
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    upload_id = ET.fromstring(r.body).find(f"{ns}UploadId").text
+    before = cluster.s3_front.stats()
+    payloads = [b"P" * (5 << 20), b"Q" * (5 << 20), b"R" * 333]
+    parts = []
+    for i, data in enumerate(payloads, start=1):
+        pr = s3.put("/nf/mpu.bin", data,
+                    **{"partNumber": str(i), "uploadId": upload_id})
+        assert pr.status == 200
+        assert pr.header("etag") == \
+            f'"{hashlib.md5(data).hexdigest()}"'
+        parts.append((i, pr.header("etag")))
+    after = cluster.s3_front.stats()
+    assert after["fast_part"] >= before["fast_part"] + 3
+    assert after["chan_fail"] == 0
+    doc = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+        for n, e in parts) + "</CompleteMultipartUpload>"
+    cr = s3.post("/nf/mpu.bin", doc.encode(), **{"uploadId": upload_id})
+    assert cr.status == 200
+    g = s3.get("/nf/mpu.bin")
+    assert g.status == 200 and g.body == b"".join(payloads)
+    # the upload id is retired with the marker dir: a straggler part
+    # relays to python's NoSuchUpload instead of appending blindly
+    late = s3.put("/nf/mpu.bin", b"late",
+                  **{"partNumber": "4", "uploadId": upload_id})
+    assert late.status == 404 and b"NoSuchUpload" in late.body
+    assert cluster.s3_front.stats()["fast_part"] == after["fast_part"]
+
+
+def test_native_part_abort_discards(cluster, s3):
+    import xml.etree.ElementTree as ET
+
+    r = s3.post("/nf/mpab.bin", **{"uploads": ""})
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    upload_id = ET.fromstring(r.body).find(f"{ns}UploadId").text
+    before = cluster.s3_front.stats()["fast_part"]
+    pr = s3.put("/nf/mpab.bin", b"x" * 2048,
+                **{"partNumber": "1", "uploadId": upload_id})
+    assert pr.status == 200
+    assert cluster.s3_front.stats()["fast_part"] == before + 1
+    assert s3.delete("/nf/mpab.bin",
+                     **{"uploadId": upload_id}).status == 204
+    assert s3.get("/nf/mpab.bin").status == 404
+
+
 def test_range_overflow_is_safe(cluster, s3):
     """64-bit-overflowing range numbers must behave like python's
     unbounded ints (saturate, then the bounds rules apply) — a wrapped
