@@ -2110,7 +2110,32 @@ def bench_filer_sweep(argv: list[str]) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_lint_time(argv: list[str]) -> int:
+    """Wall-clock of one full static-analysis pass (every rule, every
+    file). The engine's one-parse-per-file design is what keeps the
+    lint gate inside the tier-1 budget — gate it at 10 s so a rule
+    that quietly reintroduces per-rule re-parsing fails loudly."""
+    gate_s = float(argv[0]) if argv else 10.0
+    from seaweedfs_tpu.analysis.engine import Engine
+
+    t0 = time.monotonic()
+    run = Engine().execute()
+    elapsed = time.monotonic() - t0
+    print(json.dumps({
+        "metric": "lint_time",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "gate_s": gate_s,
+        "extra": {"files_scanned": run.files_scanned,
+                  "findings": len(run.findings),
+                  "rules": len(Engine().rules)},
+    }), flush=True)
+    return 0 if elapsed < gate_s and not run.findings else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "lint-time":
+        sys.exit(bench_lint_time(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "hedge-sweep":
         sys.exit(bench_hedge_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "mesh-sweep":

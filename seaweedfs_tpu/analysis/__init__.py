@@ -1,0 +1,30 @@
+"""Unified static-analysis plane.
+
+One parse per file, many rules per parse: every project lint that used
+to re-walk the tree with its own visitor (timeouts, async-sleep, CLI
+flags, metric names, device sync, label cardinality) plus the
+concurrency-discipline rules (lock discipline, async hygiene, context
+propagation, resource safety, jax hygiene) and the C++ text-contract
+pass over dataplane.cc all run as registered visitors over a single
+shared AST walk.
+
+Surface:
+
+  python -m seaweedfs_tpu.analysis          # text report, exit 1 on findings
+  python -m seaweedfs_tpu.analysis --json   # machine-readable
+  # sw-lint: disable=<rule>[,<rule>...]     # per-line suppression
+  seaweedfs_tpu/analysis/baseline.json      # grandfathered findings
+
+The pytest lint wrappers (tests/test_lint_*.py, tests/test_analysis_*)
+call :func:`run_cached` so one engine pass serves every lint test in a
+session (``pytest -m lint``).
+"""
+from .engine import (  # noqa: F401
+    Engine,
+    Finding,
+    RunResult,
+    all_rules,
+    default_roots,
+    load_baseline,
+    run_cached,
+)

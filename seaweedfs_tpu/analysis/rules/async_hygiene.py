@@ -1,0 +1,116 @@
+"""Async hygiene: no blocking calls on gateway event loops.
+
+Generalizes the old async-sleep lint (test_lint_async_sleep.py): the
+gateways are single event loops, so one blocking call on the loop
+thread stalls EVERY in-flight request behind it. Beyond ``time.sleep``
+this flags sync HTTP (``session().<verb>``, ``requests.<verb>``),
+raw socket connects, subprocess waits, and blocking lock acquisition
+inside ``async def`` bodies. A nested *sync* ``def`` (e.g. a worker
+handed to ``asyncio.to_thread``) legitimately may block — it runs off
+the loop — so only calls whose innermost enclosing function is async
+count.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import PKG_PREFIX, Rule, register
+from .http_discipline import is_requests_verb, is_session_verb
+
+SERVING_DIRS = ("server/", "filer/", "s3/", "mount/")
+EDGE_MODULES = ("utils/qos.py", "utils/retry.py", "utils/faults.py",
+                "utils/ratelimit.py")
+
+LOCKISH = ("lock", "rlock", "mutex", "cond", "cv", "condition", "sem",
+           "semaphore")
+
+
+def lockish_name(expr: ast.expr) -> str | None:
+    """Trailing identifier of a lock-looking receiver (``self._lock``,
+    ``bucket._cond`` ...), else None."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    if name is None:
+        return None
+    tail = name.lower().lstrip("_").split("_")[-1]
+    return name if tail in LOCKISH else None
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep" and \
+            isinstance(f.value, ast.Name) and f.value.id in ("time",
+                                                            "_time"):
+        return True
+    return isinstance(f, ast.Name) and f.id == "sleep"
+
+
+def _is_subprocess_wait(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("run", "check_call", "check_output", "call")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("subprocess", "_subprocess"))
+
+
+def _is_socket_connect(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr == "create_connection"
+            and isinstance(f.value, ast.Name) and f.value.id == "socket")
+
+
+def blocking_reason(call: ast.Call) -> str | None:
+    """Why this call blocks the loop, or None if it doesn't."""
+    if _is_time_sleep(call):
+        return "time.sleep blocks the event loop; await asyncio.sleep"
+    if is_session_verb(call) or is_requests_verb(call):
+        return ("sync HTTP on the event loop; use the async client or "
+                "asyncio.to_thread")
+    if _is_subprocess_wait(call):
+        return ("blocking subprocess wait on the event loop; use "
+                "asyncio.create_subprocess_exec")
+    if _is_socket_connect(call):
+        return ("blocking socket connect on the event loop; use "
+                "loop.sock_connect / asyncio streams")
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "acquire" and \
+            lockish_name(f.value):
+        nonblocking = any(
+            kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False for kw in call.keywords)
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if not nonblocking and not has_timeout:
+            return ("blocking lock acquire on the event loop; use the "
+                    "async acquisition path (acquire_async / "
+                    "run_in_executor)")
+    return None
+
+
+@register
+class AsyncHygieneRule(Rule):
+    name = "async-hygiene"
+    description = ("no blocking call (sleep, sync HTTP, subprocess, "
+                   "socket connect, lock acquire) inside async def in "
+                   "gateway/edge code")
+
+    def wants(self, rel: str) -> bool:
+        if not rel.startswith(PKG_PREFIX) or not rel.endswith(".py"):
+            return False
+        sub = rel[len(PKG_PREFIX):]
+        return sub.startswith(SERVING_DIRS) or sub in EDGE_MODULES
+
+    def visit_AsyncFunctionDef(self, ctx, node) -> None:
+        ctx.run.stats["async_functions"] = \
+            ctx.run.stats.get("async_functions", 0) + 1
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if not ctx.in_async():
+            return
+        reason = blocking_reason(node)
+        if reason:
+            self.report(ctx, node,
+                        f"in async def {ctx.func.name}: {reason}")

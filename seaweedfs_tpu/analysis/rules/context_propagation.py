@@ -1,0 +1,92 @@
+"""Context propagation across thread hops and HTTP servers.
+
+The tracing/deadline plane rides contextvars (utils/tracing.py,
+utils/retry.py). Two ways to silently drop it:
+
+1. An ``executor.submit(fn, ...)`` / per-request ``Thread(target=...)``
+   in traced modules runs ``fn`` on a bare thread — the trace and the
+   deadline vanish and the hop becomes invisible in /debug/traces and
+   unbounded in time. The sanctioned shape is
+   ``pool.submit(contextvars.copy_context().run, fn, ...)``.
+   Long-lived service threads (appliers, accept loops) carry no
+   request context by design, so only submits — plus Threads created
+   inside request handlers or async bodies — are checked.
+2. A ``web.Application`` without ``retry.aiohttp_middleware`` never
+   parses ``X-Sw-Deadline``: every handler behind it does dead work
+   for callers that already gave up, and mints no budget for its own
+   downstream hops.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import PKG_PREFIX, Rule, register
+
+TRACED_DIRS = ("server/", "filer/", "s3/", "mount/", "webdav/")
+
+
+def _is_copy_context_run(expr: ast.expr) -> bool:
+    """``contextvars.copy_context().run`` (any module alias)."""
+    return (isinstance(expr, ast.Attribute) and expr.attr == "run"
+            and isinstance(expr.value, ast.Call)
+            and isinstance(expr.value.func, ast.Attribute)
+            and expr.value.func.attr == "copy_context")
+
+
+@register
+class ContextPropagationRule(Rule):
+    name = "context-propagation"
+    description = ("executor submits in traced modules wrap "
+                   "contextvars.copy_context(); every web.Application "
+                   "registers the deadline middleware")
+
+    def wants(self, rel: str) -> bool:
+        if not rel.startswith(PKG_PREFIX) or not rel.endswith(".py"):
+            return False
+        return rel[len(PKG_PREFIX):].startswith(TRACED_DIRS)
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "submit":
+            ctx.run.stats["submit_sites"] = \
+                ctx.run.stats.get("submit_sites", 0) + 1
+            if not node.args or not _is_copy_context_run(node.args[0]):
+                self.report(ctx, node,
+                            "executor.submit without "
+                            "contextvars.copy_context().run — the "
+                            "trace and deadline are dropped on the "
+                            "thread hop")
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "Thread" or \
+                isinstance(f, ast.Name) and f.id == "Thread":
+            func = ctx.func
+            per_request = func is not None and (
+                isinstance(func, ast.AsyncFunctionDef)
+                or func.name.startswith("handle_"))
+            if not per_request:
+                return  # service thread: carries no request context
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None or not _is_copy_context_run(target):
+                self.report(ctx, node,
+                            "per-request Thread(target=...) without "
+                            "contextvars.copy_context().run")
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "Application" \
+                and isinstance(f.value, ast.Name) and f.value.id == "web":
+            mw = next((kw.value for kw in node.keywords
+                       if kw.arg == "middlewares"), None)
+            ok = False
+            if mw is not None:
+                for sub in ast.walk(mw):
+                    if isinstance(sub, ast.Attribute) and \
+                            sub.attr == "aiohttp_middleware" and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id == "retry":
+                        ok = True
+            if not ok:
+                self.report(ctx, node,
+                            "web.Application without "
+                            "retry.aiohttp_middleware — handlers "
+                            "behind it never see X-Sw-Deadline and do "
+                            "dead work for callers that gave up")

@@ -1,0 +1,70 @@
+"""Sync-HTTP discipline (the lint formerly in test_lint_timeouts.py).
+
+All sync HTTP in the package flows through rpc/httpclient.py's
+``session()`` — the one place that enforces timeouts, deadline
+propagation, retries, and circuit breaking. A raw ``requests.get(...)``
+bypasses the whole robustness layer; a ``session()`` call without
+``timeout=`` can hang a worker thread forever on one dead peer
+(requests has no default timeout).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import PKG_PREFIX, Rule, register
+
+VERBS = {"get", "post", "put", "delete", "head", "patch", "options",
+         "request"}
+ALLOWED_RAW = {PKG_PREFIX + "rpc/httpclient.py"}
+
+
+def is_requests_verb(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr in VERBS
+            and isinstance(f.value, ast.Name) and f.value.id == "requests")
+
+
+def is_session_verb(call: ast.Call) -> bool:
+    """``session().<verb>(...)`` — the pooled-adapter call shape."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr in VERBS
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Name)
+            and f.value.func.id == "session")
+
+
+@register
+class RawRequestsRule(Rule):
+    name = "raw-requests"
+    description = ("requests.<verb>() bypasses the retry/deadline/"
+                   "breaker layer; use rpc.httpclient.session()")
+
+    def wants(self, rel: str) -> bool:
+        return (rel.startswith(PKG_PREFIX) and rel.endswith(".py")
+                and rel not in ALLOWED_RAW)
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if is_requests_verb(node):
+            self.report(ctx, node,
+                        f"raw requests.{node.func.attr}() bypasses the "
+                        "retry/deadline/breaker layer; use "
+                        "rpc.httpclient.session()")
+
+
+@register
+class SessionTimeoutRule(Rule):
+    name = "session-timeout"
+    description = ("every session().<verb>() call must pass an "
+                   "explicit timeout= (a hung peer would pin the "
+                   "worker forever)")
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if not is_session_verb(node):
+            return
+        ctx.run.stats["session_calls"] = \
+            ctx.run.stats.get("session_calls", 0) + 1
+        if not any(kw.arg == "timeout" for kw in node.keywords) and \
+                not any(kw.arg is None for kw in node.keywords):
+            self.report(ctx, node,
+                        f"session().{node.func.attr}() without an "
+                        "explicit timeout=")

@@ -864,14 +864,14 @@ def _dispatch(args) -> int:
     if args.cmd == "filer.cat":
         import sys as _sys
 
-        r = session().get(f"{args.filer.rstrip('/')}/"
-                    f"{args.path.lstrip('/')}", stream=True,
-                    timeout=600)
-        if r.status_code >= 300:
-            print(r.text, file=_sys.stderr)
-            return 1
-        for chunk in r.iter_content(1 << 20):
-            _sys.stdout.buffer.write(chunk)
+        with session().get(f"{args.filer.rstrip('/')}/"
+                           f"{args.path.lstrip('/')}", stream=True,
+                           timeout=600) as r:
+            if r.status_code >= 300:
+                print(r.text, file=_sys.stderr)
+                return 1
+            for chunk in r.iter_content(1 << 20):
+                _sys.stdout.buffer.write(chunk)
         return 0
     if args.cmd == "filer.copy":
         return _run_filer_copy(args)

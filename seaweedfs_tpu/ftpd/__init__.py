@@ -246,16 +246,18 @@ class FtpSession(threading.Thread):
         if offset:
             headers["Range"] = f"bytes={offset}-"
         r = self._filer("GET", path, headers=headers, stream=True)
-        if r.status_code not in (200, 206):
-            self.reply(550, "no such file")
-            return
-        self.reply(150, "opening data connection")
-        data = self._open_data()
         try:
-            for chunk in r.iter_content(256 << 10):
-                data.sendall(chunk)
+            if r.status_code not in (200, 206):
+                self.reply(550, "no such file")
+                return
+            self.reply(150, "opening data connection")
+            data = self._open_data()
+            try:
+                for chunk in r.iter_content(256 << 10):
+                    data.sendall(chunk)
+            finally:
+                data.close()
         finally:
-            data.close()
             r.close()
         self.reply(226, "transfer complete")
 
@@ -275,9 +277,11 @@ class FtpSession(threading.Thread):
             if append:
                 # prefix with the existing content, streamed
                 r = self._filer("GET", path, stream=True)
-                if r.status_code == 200:
-                    shutil.copyfileobj(r.raw, spool, 256 << 10)
-                r.close()
+                try:
+                    if r.status_code == 200:
+                        shutil.copyfileobj(r.raw, spool, 256 << 10)
+                finally:
+                    r.close()
             while True:
                 chunk = data.recv(256 << 10)
                 if not chunk:

@@ -4,6 +4,12 @@ libseaweed_dataplane.so (HTTP data plane).
 Run directly (`python seaweedfs_tpu/native/build.py`) or let
 seaweedfs_tpu.native build lazily on first import. No pybind11 — the
 ABI is a C `extern "C"` surface consumed via ctypes.
+
+Sanitizer builds: ``SEAWEEDFS_TPU_DP_SANITIZE={asan,tsan}`` selects an
+instrumented data-plane build. Each mode caches its own .so
+(libseaweed_dataplane.asan.so / .tsan.so) so switching modes never
+races the plain library, and instrumented builds drop -O3/-march for
+-O1 -g -fno-omit-frame-pointer so reports carry usable stacks.
 """
 from __future__ import annotations
 
@@ -17,17 +23,44 @@ LIB = os.path.join(HERE, "libseaweed_native.so")
 DP_SRC = os.path.join(HERE, "dataplane.cc")
 DP_LIB = os.path.join(HERE, "libseaweed_dataplane.so")
 
+SANITIZE_ENV = "SEAWEEDFS_TPU_DP_SANITIZE"
+SANITIZE_FLAGS = {
+    "asan": ["-fsanitize=address"],
+    "tsan": ["-fsanitize=thread"],
+}
+
+
+def sanitize_mode() -> str:
+    """'' (plain), 'asan', or 'tsan' — from the environment."""
+    mode = os.environ.get(SANITIZE_ENV, "").strip().lower()
+    if mode in ("", "0", "off", "none"):
+        return ""
+    if mode not in SANITIZE_FLAGS:
+        raise ValueError(
+            f"{SANITIZE_ENV}={mode!r}: expected one of "
+            f"{sorted(SANITIZE_FLAGS)} (or empty)")
+    return mode
+
+
+def dp_lib_path(mode: str | None = None) -> str:
+    mode = sanitize_mode() if mode is None else mode
+    if not mode:
+        return DP_LIB
+    base, ext = os.path.splitext(DP_LIB)
+    return f"{base}.{mode}{ext}"
+
 
 def _compile(src: str, lib: str, verbose: bool,
-             extra: list[str] | None = None) -> str:
+             extra: list[str] | None = None,
+             opt: list[str] | None = None) -> str:
     if os.path.exists(lib) and \
             os.path.getmtime(lib) >= os.path.getmtime(src):
         return lib
     # compile to a temp name + rename so a concurrent process never
     # dlopens a half-written library
     tmp = lib + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-           "-std=c++17", "-o", tmp, src] + (extra or [])
+    cmd = ["g++"] + (opt or ["-O3", "-march=native"]) + \
+        ["-shared", "-fPIC", "-std=c++17", "-o", tmp, src] + (extra or [])
     if verbose:
         print("+", " ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)
@@ -40,13 +73,21 @@ def build(verbose: bool = True) -> str:
     return _compile(SRC, LIB, verbose)
 
 
-def build_dataplane(verbose: bool = True) -> str:
-    """Compile the data-plane library; returns its path."""
-    return _compile(DP_SRC, DP_LIB, verbose, extra=["-pthread"])
+def build_dataplane(verbose: bool = True,
+                    mode: str | None = None) -> str:
+    """Compile the data-plane library; returns its path. `mode` (or
+    the SEAWEEDFS_TPU_DP_SANITIZE env var) selects an instrumented
+    build cached under its own name."""
+    mode = sanitize_mode() if mode is None else mode
+    if not mode:
+        return _compile(DP_SRC, DP_LIB, verbose, extra=["-pthread"])
+    return _compile(DP_SRC, dp_lib_path(mode), verbose,
+                    extra=["-pthread"] + SANITIZE_FLAGS[mode],
+                    opt=["-O1", "-g", "-fno-omit-frame-pointer"])
 
 
 if __name__ == "__main__":
     build()
     print(LIB)
     build_dataplane()
-    print(DP_LIB)
+    print(dp_lib_path())

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
 import threading
 
 import numpy as np
@@ -20,6 +21,7 @@ from ..storage import idx as idxmod
 from ..storage import types as t
 
 _lib = None
+_lib_mode: str | None = None  # sanitize mode the cached _lib was built in
 _load_lock = threading.Lock()
 
 # role ids, mirroring ROLE_* in dataplane.cc
@@ -32,17 +34,67 @@ def available() -> bool:
     from . import build as _b
     import shutil
 
-    return os.path.exists(_b.DP_LIB) or shutil.which("g++") is not None
+    return os.path.exists(_b.dp_lib_path()) or \
+        shutil.which("g++") is not None
+
+
+def sanitizer_env(mode: str, log_dir: str) -> dict[str, str]:
+    """Environment for a *new* python process that will dlopen the
+    sanitized data plane. The interpreter itself is uninstrumented, so
+    the sanitizer runtime must be LD_PRELOADed before python starts —
+    setting these in an already-running process does nothing, which is
+    why the sanitize suite spawns subprocesses.
+
+    halt_on_error=1 turns any report into a nonzero exit (the test
+    gate); detect_leaks=0 because CPython itself "leaks" arenas at
+    exit and would drown real reports; log_path redirects reports to
+    files the caller can assert empty.
+    """
+    from . import build as _b
+
+    if mode not in _b.SANITIZE_FLAGS:
+        raise ValueError(f"unknown sanitize mode {mode!r}")
+    rt = {"asan": "libasan.so", "tsan": "libtsan.so"}[mode]
+    preload = subprocess.run(
+        ["gcc", f"-print-file-name={rt}"],
+        capture_output=True, text=True, check=True).stdout.strip()
+    log_path = os.path.join(log_dir, f"{mode}-report")
+    common = f"halt_on_error=1:log_path={log_path}:exitcode=66"
+    env = {
+        _b.SANITIZE_ENV: mode,
+        "LD_PRELOAD": preload,
+    }
+    if mode == "asan":
+        env["ASAN_OPTIONS"] = common + ":detect_leaks=0"
+    else:
+        # ignore_noninstrumented_modules: uninstrumented CPython
+        # extension modules (e.g. _socket) look racy to TSan because
+        # their atomics read as plain accesses; races are still
+        # reported whenever any frame lands in the instrumented
+        # data plane, which is the surface under test
+        env["TSAN_OPTIONS"] = (common + ":report_signal_unsafe=0"
+                               ":ignore_noninstrumented_modules=1")
+    return env
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
-    if _lib is not None:
+    global _lib, _lib_mode
+    from . import build as _b
+
+    mode = _b.sanitize_mode()
+    if _lib is not None and _lib_mode == mode:
         return _lib
     with _load_lock:
         if _lib is not None:
+            if _lib_mode != mode:
+                # a sanitized .so cannot be safely swapped into a
+                # process that already holds the plain one (and the
+                # sanitizer runtime must be preloaded at exec time)
+                raise RuntimeError(
+                    f"data plane already loaded in mode "
+                    f"{_lib_mode or 'plain'!r}; start a new process "
+                    f"for {_b.SANITIZE_ENV}={mode}")
             return _lib
-        from . import build as _b
 
         lib = ctypes.CDLL(_b.build_dataplane(verbose=False))
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -183,6 +235,7 @@ def _load() -> ctypes.CDLL:
         except AttributeError:
             pass
         _lib = lib
+        _lib_mode = mode
         return lib
 
 

@@ -155,14 +155,14 @@ class S3Client:
     def download_to(self, key: str, dest_path: str) -> int:
         import requests
         url = self.url(key)
-        r = session().get(url, headers=self.headers("GET", url),
-                         stream=True, timeout=3600)
-        r.raise_for_status()
-        n = 0
-        with open(dest_path, "wb") as out:
-            for blob in r.iter_content(4 << 20):
-                out.write(blob)
-                n += len(blob)
+        with session().get(url, headers=self.headers("GET", url),
+                           stream=True, timeout=3600) as r:
+            r.raise_for_status()
+            n = 0
+            with open(dest_path, "wb") as out:
+                for blob in r.iter_content(4 << 20):
+                    out.write(blob)
+                    n += len(blob)
         return n
 
     # -- listing --------------------------------------------------------

@@ -14,6 +14,7 @@ from __future__ import annotations
 from aiohttp import web
 
 from ..rpc.http import json_error, json_ok
+from ..utils import retry
 from ..wdclient.client import MasterClient
 
 
@@ -22,7 +23,8 @@ class MasterFollower:
         self.client = MasterClient(master_urls, subscribe=True)
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[retry.aiohttp_middleware("master-follower")])
         app.add_routes([
             web.get("/dir/lookup", self.handle_lookup),
             web.get("/status", self.handle_status),

@@ -16,7 +16,7 @@ from xml.sax.saxutils import escape
 import aiohttp
 from aiohttp import web
 
-from ..utils import tracing
+from ..utils import retry, tracing
 
 DAV_NS = "DAV:"
 
@@ -74,7 +74,8 @@ class WebDavServer:
     def _build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1 << 40,
-            middlewares=[tracing.aiohttp_middleware("webdav")])
+            middlewares=[tracing.aiohttp_middleware("webdav"),
+                         retry.aiohttp_middleware("webdav", edge=True)])
         app.add_routes([
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.route("*", "/{path:.*}", self.dispatch),

@@ -52,6 +52,15 @@ def pytest_configure(config):
         "markers",
         "tier: tiered-storage lifecycle test (hot -> warm EC -> cold "
         "remote); selectable with pytest -m tier")
+    config.addinivalue_line(
+        "markers",
+        "lint: static-analysis gate (seaweedfs_tpu/analysis/); "
+        "pytest -m lint runs the whole analyzer in one engine pass")
+    config.addinivalue_line(
+        "markers",
+        "sanitize: rebuilds the native data plane under ASan/TSan and "
+        "re-runs the parity + concurrency suites in a subprocess; "
+        "slow, needs gcc + libasan/libtsan")
 
 
 import pytest  # noqa: E402

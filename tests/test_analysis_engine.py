@@ -1,0 +1,169 @@
+"""The analysis engine itself: one parse per file, suppression and
+baseline round-trips, CLI output formats, and the shared-pass cache
+the lint wrappers ride."""
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from seaweedfs_tpu.analysis import run_cached
+from seaweedfs_tpu.analysis.engine import Engine, save_baseline
+
+pytestmark = pytest.mark.lint
+
+
+def _mini_repo(tmp_path, files: dict) -> str:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+BLOCKING_ASYNC = (
+    "import time\n"
+    "async def handle_x(req):\n"
+    "    time.sleep(1)\n"
+)
+
+
+def test_one_parse_per_file_across_all_rules(tmp_path, monkeypatch):
+    """Every registered rule runs off ONE ast.parse of each file — the
+    whole point of the engine vs. six lints re-parsing the package."""
+    root = _mini_repo(tmp_path, {
+        "seaweedfs_tpu/server/a.py": BLOCKING_ASYNC,
+        "seaweedfs_tpu/filer/b.py": "x = 1\n",
+        "seaweedfs_tpu/utils/qos.py": "y = 2\n",
+    })
+    real_parse = ast.parse
+    parsed: dict[str, int] = {}
+
+    def counting_parse(source, filename="<unknown>", *a, **kw):
+        if str(filename).startswith(root):
+            parsed[filename] = parsed.get(filename, 0) + 1
+        return real_parse(source, filename, *a, **kw)
+
+    monkeypatch.setattr(ast, "parse", counting_parse)
+    eng = Engine(roots=[root], baseline_path=None, repo_root=root)
+    run = eng.execute()
+    assert parsed and all(n == 1 for n in parsed.values()), parsed
+    assert all(n == 1 for n in run.parse_counts.values())
+    assert run.by_rule("async-hygiene"), "control finding missing"
+
+
+def test_suppression_comment_moves_finding_aside(tmp_path):
+    src = ("import time\n"
+           "async def handle_x(req):\n"
+           "    time.sleep(1)  # sw-lint: disable=async-hygiene\n")
+    root = _mini_repo(tmp_path, {"seaweedfs_tpu/server/a.py": src})
+    run = Engine(roots=[root], baseline_path=None,
+                 repo_root=root).execute()
+    assert not run.by_rule("async-hygiene")
+    assert [f.rule for f in run.suppressed] == ["async-hygiene"]
+
+
+def test_suppress_all_and_unrelated_rule(tmp_path):
+    src_all = BLOCKING_ASYNC.replace(
+        "time.sleep(1)", "time.sleep(1)  # sw-lint: disable=all")
+    src_other = BLOCKING_ASYNC.replace(
+        "time.sleep(1)", "time.sleep(1)  # sw-lint: disable=device-sync")
+    root = _mini_repo(tmp_path, {
+        "seaweedfs_tpu/server/a.py": src_all,
+        "seaweedfs_tpu/server/b.py": src_other,
+    })
+    run = Engine(roots=[root], baseline_path=None,
+                 repo_root=root).execute()
+    # `all` suppresses; a different rule's name does not
+    assert [f.path for f in run.by_rule("async-hygiene")] == \
+        ["seaweedfs_tpu/server/b.py"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    root = _mini_repo(tmp_path,
+                      {"seaweedfs_tpu/server/a.py": BLOCKING_ASYNC})
+    first = Engine(roots=[root], baseline_path=None,
+                   repo_root=root).execute()
+    assert first.findings
+    bl = tmp_path / "baseline.json"
+    save_baseline(first.findings, str(bl))
+    second = Engine(roots=[root], baseline_path=str(bl),
+                    repo_root=root).execute()
+    assert not second.findings
+    assert len(second.baselined) == len(first.findings)
+    # baseline keys are line-number independent: prepending a comment
+    # shifts every line but the finding stays budgeted
+    p = tmp_path / "seaweedfs_tpu/server/a.py"
+    p.write_text("# a new leading comment\n" + BLOCKING_ASYNC)
+    third = Engine(roots=[root], baseline_path=str(bl),
+                   repo_root=root).execute()
+    assert not third.findings and third.baselined
+
+
+def test_baseline_budget_is_a_multiset(tmp_path):
+    """Two identical violations, one baselined entry: exactly one
+    surfaces."""
+    src = ("import time\n"
+           "async def handle_x(req):\n"
+           "    time.sleep(1)\n"
+           "async def handle_y(req):\n"
+           "    time.sleep(1)\n")
+    root = _mini_repo(tmp_path, {"seaweedfs_tpu/server/a.py": src})
+    first = Engine(roots=[root], baseline_path=None,
+                   repo_root=root).execute()
+    assert len(first.by_rule("async-hygiene")) == 2
+    bl = tmp_path / "baseline.json"
+    save_baseline(first.findings[:1], str(bl))
+    second = Engine(roots=[root], baseline_path=str(bl),
+                    repo_root=root).execute()
+    assert len(second.by_rule("async-hygiene")) == 1
+    assert len(second.baselined) == 1
+
+
+def test_rule_subset_and_unknown_rule(tmp_path):
+    root = _mini_repo(tmp_path,
+                      {"seaweedfs_tpu/server/a.py": BLOCKING_ASYNC})
+    run = Engine(roots=[root], rule_names=["device-sync"],
+                 baseline_path=None, repo_root=root).execute()
+    assert not run.findings  # async-hygiene not loaded
+    with pytest.raises(ValueError):
+        Engine(rule_names=["no-such-rule"])
+
+
+def test_cli_text_and_json_zero_findings_over_repo():
+    """The acceptance gate: the checked-in tree is clean, both output
+    modes agree, and exit status is 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["findings"] == []
+    assert doc["files_scanned"] > 100
+    text = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert text.returncode == 0, text.stdout + text.stderr
+    assert "0 finding(s)" in text.stdout
+
+
+def test_cli_list_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    for rule in ("lock-discipline", "async-hygiene",
+                 "context-propagation", "resource-safety",
+                 "jax-hygiene", "dp-faults", "raw-requests",
+                 "session-timeout", "cli-flag-help", "metric-names",
+                 "device-sync", "label-cardinality"):
+        assert rule in out.stdout, rule
+
+
+def test_run_cached_shares_one_result():
+    assert run_cached() is run_cached()
+    # the wrappers' shared pass really did parse each file once
+    assert all(n == 1 for n in run_cached().parse_counts.values())

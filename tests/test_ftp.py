@@ -48,6 +48,38 @@ def test_bad_login_rejected(ftp_srv):
     c.close()
 
 
+def test_retr_missing_file_closes_stream_response(ftp, monkeypatch):
+    """Regression: the 550 early-return in _cmd_retr used to leak the
+    stream=True filer response, pinning a pooled connection per failed
+    download."""
+    import time as _time
+
+    from seaweedfs_tpu import ftpd as ftpd_mod
+
+    closed = []
+    orig = ftpd_mod.FtpSession._filer
+
+    def tracking(self, method, path, **kw):
+        r = orig(self, method, path, **kw)
+        if kw.get("stream"):
+            inner = r.close
+            def close_and_record():
+                closed.append(True)
+                inner()
+            r.close = close_and_record
+        return r
+
+    monkeypatch.setattr(ftpd_mod.FtpSession, "_filer", tracking)
+    with pytest.raises(ftplib.error_perm):
+        ftp.retrbinary("RETR definitely-missing.bin", lambda b: None)
+    # the close runs on the session thread after the 550 reply
+    for _ in range(100):
+        if closed:
+            break
+        _time.sleep(0.02)
+    assert closed, "stream response for missing file never closed"
+
+
 def test_store_retrieve_roundtrip(ftp):
     payload = b"ftp payload " * 1000
     ftp.storbinary("STOR big.bin", io.BytesIO(payload))

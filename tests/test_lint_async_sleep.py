@@ -1,103 +1,36 @@
-"""Fast tier-1 lint: no blocking ``time.sleep`` inside ``async def``
-bodies in gateway / edge-QoS code.
+"""Fast tier-1 lint: no blocking calls inside ``async def`` bodies in
+gateway / edge-QoS code.
 
-The gateways are single event loops: one blocking sleep on the loop
-thread stalls EVERY in-flight request behind it — which is exactly how
-an "overload protection" layer would manufacture the overload it
-exists to shed. The ROADMAP calls out the native fault-injection delay
-(which sleeps on the IO thread, by design, outside the loop) as the
-pattern NOT to reuse; the sanctioned shapes are ``await
-asyncio.sleep(...)`` (faults.async_hook, qos middleware pacing) and
-the reservation-style ``TokenBucket`` whose quotes async callers await
-(utils/ratelimit.py).
+The gateways are single event loops: one blocking sleep (or sync HTTP
+hop, or unbounded lock acquire) on the loop thread stalls EVERY
+in-flight request behind it — which is exactly how an "overload
+protection" layer would manufacture the overload it exists to shed.
 
-AST-based: only calls lexically inside an ``async def`` body count.
-A nested *sync* ``def`` (e.g. a worker handed to
-``asyncio.to_thread``) legitimately may sleep — it runs off the loop —
-so the scan does not descend into nested sync functions.
-"""
-import ast
+The rule logic lives in seaweedfs_tpu/analysis/rules/async_hygiene.py
+(now generalized from time.sleep to any blocking call); this module
+keeps the historical entrypoints as thin wrappers over the shared
+engine pass, plus the negative controls."""
 import os
+import re
+
+import pytest
+
+from seaweedfs_tpu.analysis import run_cached
+
+pytestmark = pytest.mark.lint
 
 PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu")
 
-# everything that serves requests on an event loop, plus the edge
-# stack the gateways compose (deadline/retry, fault injection, QoS,
-# rate limiting)
-SERVING_DIRS = ("server", "filer", "s3", "mount")
-EDGE_MODULES = (os.path.join("utils", "qos.py"),
-                os.path.join("utils", "retry.py"),
-                os.path.join("utils", "faults.py"),
-                os.path.join("utils", "ratelimit.py"))
-
-
-def _iter_sources():
-    seen = set()
-    for sub in SERVING_DIRS:
-        base = os.path.join(PKG_DIR, sub)
-        if not os.path.isdir(base):
-            continue
-        for root, _dirs, files in os.walk(base):
-            for fn in sorted(files):
-                if fn.endswith(".py"):
-                    path = os.path.join(root, fn)
-                    seen.add(path)
-                    yield path
-    for rel in EDGE_MODULES:
-        path = os.path.join(PKG_DIR, rel)
-        if os.path.isfile(path) and path not in seen:
-            yield path
-
-
-def _is_time_sleep(call: ast.Call) -> bool:
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr == "sleep" and \
-            isinstance(f.value, ast.Name) and f.value.id == "time":
-        return True
-    # `from time import sleep` style
-    return isinstance(f, ast.Name) and f.id == "sleep"
-
-
-def _blocking_sleeps_in_async(fn: ast.AsyncFunctionDef):
-    """time.sleep call sites inside this async function's own body —
-    NOT inside nested sync defs (those run off-loop via to_thread /
-    executors) but INCLUDING nested async defs."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, ast.FunctionDef):
-            continue  # sync nested def: off-loop by construction
-        if isinstance(node, ast.Call) and _is_time_sleep(node):
-            yield node
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _collect():
-    offenders, n_async = [], 0
-    for path in _iter_sources():
-        rel = os.path.relpath(path, PKG_DIR)
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.AsyncFunctionDef):
-                n_async += 1
-                for call in _blocking_sleeps_in_async(node):
-                    offenders.append(
-                        f"{rel}:{call.lineno}: time.sleep inside "
-                        f"async def {node.name} — blocks the event "
-                        "loop; await asyncio.sleep(...) instead")
-    return offenders, n_async
-
 
 def test_no_blocking_sleep_on_the_event_loop():
-    offenders, n_async = _collect()
-    assert n_async > 50, (
-        f"only {n_async} async functions scanned — the lint's scope "
-        "no longer covers the gateways?")
+    run = run_cached()
+    assert run.stats["async_functions"] > 50, (
+        f"only {run.stats['async_functions']} async functions scanned "
+        "— the lint's scope no longer covers the gateways?")
+    offenders = [f.render() for f in run.by_rule("async-hygiene")]
     assert not offenders, (
-        "blocking sleeps on gateway event loops:\n"
-        + "\n".join(offenders))
+        "blocking calls on gateway event loops:\n" + "\n".join(offenders))
 
 
 def test_async_delays_exist_and_are_loop_friendly():
@@ -105,8 +38,6 @@ def test_async_delays_exist_and_are_loop_friendly():
     injection, QoS pacing, async acquisition) — it must do so via
     asyncio.sleep, so if those call sites vanished the lint above
     would be guarding an empty set."""
-    import re
-
     found = 0
     for rel in (os.path.join("utils", "faults.py"),
                 os.path.join("utils", "qos.py"),

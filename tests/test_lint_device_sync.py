@@ -5,101 +5,26 @@ accelerator directly: a bare ``jax.device_get``/``.block_until_ready()``
 stalls a request thread behind the (possibly relayed) link for the
 whole transfer, and an argless ``jax.device_put(x)`` uploads to an
 UNCOMMITTED default device — XLA is then free to re-copy the array per
-executable, silently doubling link traffic. All device traffic belongs
-in the staged pipeline (ops/codec_jax.py) behind the measured router
-(ec/backend.py), which uses committed shardings and overlapped
-transfers and reports per-stage timings.
-"""
-import os
-import re
+executable, silently doubling link traffic.
 
-PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "seaweedfs_tpu")
+The rule logic lives in seaweedfs_tpu/analysis/rules/device_sync.py;
+this module keeps the historical entrypoints as thin wrappers over the
+shared engine pass. The negative control now rides the jax-hygiene
+rule's stats: the pipeline layer's drain sites are where sync lives."""
+import pytest
 
-# request-serving packages: anything here runs inside an event loop or
-# a per-request worker thread
-SERVING_DIRS = ("server", "filer", "s3", "mount")
+from seaweedfs_tpu.analysis import run_cached
 
-_DEVICE_GET_RE = re.compile(r"\bjax\.device_get\s*\(")
-_BLOCK_RE = re.compile(r"\.block_until_ready\s*\(")
-_DEVICE_PUT_RE = re.compile(r"\bdevice_put\s*\(")
-
-
-def _iter_serving_sources():
-    for sub in SERVING_DIRS:
-        base = os.path.join(PKG_DIR, sub)
-        if not os.path.isdir(base):
-            continue
-        for root, _dirs, files in os.walk(base):
-            for fn in files:
-                if fn.endswith(".py"):
-                    path = os.path.join(root, fn)
-                    with open(path, encoding="utf-8") as f:
-                        yield os.path.relpath(path, PKG_DIR), f.read()
-
-
-def _call_args(src: str, open_paren: int) -> str:
-    """Argument text of the call whose '(' is at ``open_paren``
-    (balanced-paren scan, lint-grade)."""
-    depth = 0
-    for i in range(open_paren, min(len(src), open_paren + 4000)):
-        c = src[i]
-        if c == "(":
-            depth += 1
-        elif c == ")":
-            depth -= 1
-            if depth == 0:
-                return src[open_paren + 1:i]
-    return src[open_paren + 1:open_paren + 4000]
-
-
-def _has_top_level_comma(args: str) -> bool:
-    depth = 0
-    for c in args:
-        if c in "([{":
-            depth += 1
-        elif c in ")]}":
-            depth -= 1
-        elif c == "," and depth == 0:
-            return True
-    return False
-
-
-def _line(src: str, pos: int) -> int:
-    return src.count("\n", 0, pos) + 1
+pytestmark = pytest.mark.lint
 
 
 def test_no_bare_device_sync_in_serving_code():
-    offenders = []
-    for rel, src in _iter_serving_sources():
-        for m in _DEVICE_GET_RE.finditer(src):
-            offenders.append(
-                f"{rel}:{_line(src, m.start())}: jax.device_get — "
-                "synchronous D2H in a request thread")
-        for m in _BLOCK_RE.finditer(src):
-            offenders.append(
-                f"{rel}:{_line(src, m.start())}: .block_until_ready() "
-                "— blocks the request thread on the device")
-        for m in _DEVICE_PUT_RE.finditer(src):
-            args = _call_args(src, m.end() - 1)
-            if not _has_top_level_comma(args):
-                offenders.append(
-                    f"{rel}:{_line(src, m.start())}: device_put with "
-                    "no placement — uncommitted upload, XLA may "
-                    "re-copy per executable")
-    assert not offenders, (
-        "bare device synchronization in serving code; route through "
-        "the staged pipeline (ops/codec_jax.py) via the EC router "
-        "(ec/backend.py):\n" + "\n".join(offenders))
+    offenders = [f.render() for f in run_cached().by_rule("device-sync")]
+    assert not offenders, "\n".join(offenders)
 
 
 def test_pipeline_layer_is_where_sync_lives():
-    """Negative control: the fence is about placement, not the
-    primitives — the staged pipeline layer itself MUST wait on the
-    device (that is its job), so the lint would be vacuous if these
-    calls existed nowhere."""
-    path = os.path.join(PKG_DIR, "ops", "codec_jax.py")
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    assert _BLOCK_RE.search(src), "pipeline no longer waits on device?"
-    assert _DEVICE_PUT_RE.search(src)
+    """Negative control: the staged pipeline genuinely synchronizes at
+    its drain sites (that's its contract) — if those call sites
+    vanished, the serving-side lint would be guarding an empty set."""
+    assert run_cached().stats["feed_sync_sites"] > 0

@@ -1,143 +1,38 @@
-"""Fast tier-1 lint: every label dict passed to the metrics registry
-uses only allowlisted, bounded-cardinality label keys.
+"""Fast tier-1 lint: metric label keys come from a fixed allowlist and
+label dicts are statically resolvable.
 
 Prometheus memory and the federated /cluster/metrics corpus scale with
 the number of distinct label values; a per-request key (path, volume
-id, trace id...) turns one family into millions of series. The sibling
-lint (test_lint_metrics_names.py) guards family *names*; this one
-guards label *keys* via the AST: label dicts must be literal — either
-inline or a simple ``lab = {...}`` assignment in the same module — so
-their keys are statically checkable, and every key must come from the
-allowlist below. Adding a key here is a deliberate cardinality
-decision, reviewed like one.
-"""
-import ast
-import os
+id, trace id...) turns one family into millions of series.
 
-PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "seaweedfs_tpu")
+The rule logic (including the ALLOWED key set) lives in
+seaweedfs_tpu/analysis/rules/label_cardinality.py; this module keeps
+the historical entrypoints as thin wrappers over the shared engine
+pass, including the rot check that every allowlisted key is still used
+somewhere."""
+import pytest
 
-_FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
+from seaweedfs_tpu.analysis import run_cached
 
-# Every key is bounded by construction: enum-like (kind, op, stage,
-# outcome, method, direction, mode — repair read mode is exactly
-# {partial, full}; reason is the QoS shed verdict, exactly {rate,
-# deadline}), a fixed deployment set (backend, service, handler,
-# collection, instance), HTTP classes (code), the histogram-internal
-# bucket bound (le), or capped by a registry (tenant: at most
-# -qos.maxTenants distinct values plus __overflow__ — utils/qos.py
-# folds every later tenant into that one bucket precisely so this
-# label stays bounded; shard: exactly -filer.store.shards values,
-# fixed at store construction in filer/sharded_store.py; from/to/tier
-# are drawn from the fixed tier-state enum in master/tiering.py
-# (TIERS/TRANSITIONS) and dir is exactly {offload, recall}).
-ALLOWED = {
-    "backend", "code", "collection", "dir", "direction", "from",
-    "handler", "instance", "kind", "le", "method", "mode", "op",
-    "outcome", "reason", "service", "shard", "stage", "tenant",
-    "tier", "to",
-}
-
-
-def _iter_modules():
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                with open(path, encoding="utf-8") as f:
-                    yield path, ast.parse(f.read(), filename=path)
-
-
-def _labels_node(call: ast.Call) -> ast.expr | None:
-    """The labels argument of one registry call, if present."""
-    for kw in call.keywords:
-        if kw.arg == "labels":
-            return kw.value
-    if len(call.args) >= 3:
-        return call.args[2]
-    return None
-
-
-def _called_name(call: ast.Call) -> str:
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return ""
-
-
-def _collect_label_sites():
-    """-> (sites, used_keys): each site is (where, keys|None, problem)."""
-    sites = []
-    used = set()
-    for path, tree in _iter_modules():
-        rel = os.path.relpath(path, PKG_DIR)
-        # simple local resolution: Name -> every dict literal assigned
-        # to it anywhere in the module (call sites use `lab = {...}`
-        # immediately above the calls, so this is exact in practice)
-        assigned: dict[str, list[ast.Dict]] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign) and \
-                    isinstance(node.value, ast.Dict):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        assigned.setdefault(tgt.id, []).append(node.value)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _called_name(node) not in _FUNCS:
-                continue
-            lab = _labels_node(node)
-            if lab is None or (isinstance(lab, ast.Constant)
-                               and lab.value is None):
-                continue
-            where = f"{rel}:{node.lineno}"
-            dicts: list[ast.Dict]
-            if isinstance(lab, ast.Dict):
-                dicts = [lab]
-            elif isinstance(lab, ast.Name) and lab.id in assigned:
-                dicts = assigned[lab.id]
-            else:
-                sites.append((where, None,
-                              "labels must be a literal dict (inline "
-                              "or a plain `name = {...}` assignment)"))
-                continue
-            for d in dicts:
-                for k in d.keys:
-                    if k is None:  # dict unpacking: keys unknowable
-                        sites.append((where, None,
-                                      "**-unpacking hides label keys"))
-                    elif not (isinstance(k, ast.Constant)
-                              and isinstance(k.value, str)):
-                        sites.append((where, None,
-                                      "label keys must be string "
-                                      "literals"))
-                    else:
-                        used.add(k.value)
-                        sites.append((where, k.value, ""))
-    return sites, used
+pytestmark = pytest.mark.lint
 
 
 def test_label_dicts_are_statically_resolvable():
-    sites, _used = _collect_label_sites()
-    assert sites, "no labeled metric call sites found"
-    bad = [(w, msg) for w, _k, msg in sites if msg]
-    assert not bad, f"unresolvable label dicts: {bad}"
+    run = run_cached()
+    assert run.stats["label_sites"] > 0, "no labeled metric call sites"
+    offenders = [f.render() for f in run.by_rule("label-cardinality")
+                 if "allowlist" not in f.message]
+    assert not offenders, "\n".join(offenders)
 
 
 def test_label_keys_are_allowlisted():
-    sites, used = _collect_label_sites()
-    offenders = sorted({(w, k) for w, k, msg in sites
-                        if not msg and k not in ALLOWED})
-    assert not offenders, (
-        f"label keys outside the cardinality allowlist: {offenders} — "
-        "if the key is genuinely bounded, add it to ALLOWED in "
-        "tests/test_lint_label_cardinality.py with a justification")
-    # the allowlist must not rot: `le` is emitted by the histogram
-    # renderer itself and `direction` by the volume server's manually
-    # rendered native_front exposition, so neither appears at a
-    # registry call site — everything else must
-    unused = ALLOWED - used
-    assert unused <= {"le", "direction"}, \
-        f"allowlisted label keys no longer used anywhere: {unused}"
+    run = run_cached()
+    offenders = [f.render() for f in run.by_rule("label-cardinality")
+                 if "allowlist" in f.message]
+    assert not offenders, "\n".join(offenders)
+    # the allowlist must not rot: renderer-emitted keys (le,
+    # direction) never appear at a registry call site — everything
+    # else must
+    assert run.stats["label_keys_unused"] == [], (
+        "allowlisted label keys no longer used anywhere: "
+        f"{run.stats['label_keys_unused']}")

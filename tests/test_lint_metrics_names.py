@@ -1,31 +1,25 @@
 """Fast tier-1 lint: the whole package byte-compiles, and every metric
 name literal registered through utils/metrics.py is a valid Prometheus
 name used with exactly one metric type (a name emitted both as a
-counter and a histogram would render a corrupt exposition)."""
+counter and a histogram would render a corrupt exposition).
+
+The name-discipline logic lives in
+seaweedfs_tpu/analysis/rules/metrics_names.py; this module keeps the
+historical entrypoints as thin wrappers over the shared engine pass.
+The byte-compile check stays here — it is a property of the package,
+not a visitor rule."""
 import os
-import re
 import subprocess
 import sys
 
+import pytest
+
+from seaweedfs_tpu.analysis import run_cached
+
+pytestmark = pytest.mark.lint
+
 PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "seaweedfs_tpu")
-
-_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-# first string-literal argument of each registry entry point
-_CALL_RE = re.compile(
-    r"\b(counter_add|gauge_set|histogram_observe)\(\s*\n?\s*"
-    r"""["']([^"']+)["']""")
-_KIND = {"counter_add": "counter", "gauge_set": "gauge",
-         "histogram_observe": "histogram"}
-
-
-def _iter_sources():
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in files:
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                with open(path, encoding="utf-8") as f:
-                    yield path, f.read()
 
 
 def test_package_byte_compiles():
@@ -36,30 +30,16 @@ def test_package_byte_compiles():
 
 
 def test_metric_names_valid_and_unique_per_type():
-    uses: dict[str, dict[str, list[str]]] = {}
-    for path, src in _iter_sources():
-        for call, name in _CALL_RE.findall(src):
-            uses.setdefault(name, {}).setdefault(
-                _KIND[call], []).append(os.path.relpath(path, PKG_DIR))
-    assert uses, "no metric registrations found under seaweedfs_tpu/"
-    bad_names = [n for n in uses if not _NAME_RE.match(n)]
-    assert not bad_names, f"invalid metric names: {bad_names}"
-    multi = {n: kinds for n, kinds in uses.items() if len(kinds) > 1}
-    assert not multi, f"metric names used with multiple types: {multi}"
-    # histogram families implicitly own <name>_sum / <name>_count /
-    # <name>_bucket series — no other metric may squat on those
-    hists = {n for n, kinds in uses.items() if "histogram" in kinds}
-    clashes = [n for n in uses for h in hists
-               if n != h and n in (h + "_sum", h + "_count",
-                                   h + "_bucket")]
-    assert not clashes, f"names colliding with histogram series: {clashes}"
+    run = run_cached()
+    assert run.stats["metric_names"] > 0, (
+        "no metric registrations found under seaweedfs_tpu/")
+    offenders = [f.render() for f in run.by_rule("metric-names")]
+    assert not offenders, "\n".join(offenders)
 
 
 def test_known_families_present():
     # the observability surface this build documents in README.md
-    names = set()
-    for _path, src in _iter_sources():
-        names.update(n for _c, n in _CALL_RE.findall(src))
+    names = set(run_cached().stats["metric_name_list"])
     for expected in ("request_trace_seconds", "ec_codec_seconds",
                      "ec_codec_stage_seconds", "ec_codec_bytes_total",
                      "ec_codec_chosen_backend", "s3_request_seconds",

@@ -3,76 +3,30 @@ rpc/httpclient.py's session() — the one place that enforces timeouts,
 deadline propagation, retries, and circuit breaking — and every
 outbound call passes an explicit timeout.
 
-A raw ``requests.get(...)`` bypasses the whole robustness layer; a
-call without ``timeout=`` can hang a worker thread forever on one
-dead peer (requests has no default timeout)."""
-import os
-import re
+The rule logic lives in seaweedfs_tpu/analysis/rules/http_discipline.py;
+this module keeps the historical entrypoints as thin wrappers over the
+shared engine pass, plus the negative control that proves the rules
+still guard a non-empty surface."""
+import pytest
 
-PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "seaweedfs_tpu")
+from seaweedfs_tpu.analysis import run_cached
 
-_VERBS = r"(?:get|post|put|delete|head|patch|options|request)"
-# module-level requests verb calls — the bypass the lint exists to stop
-_RAW_RE = re.compile(rf"\brequests\.{_VERBS}\s*\(")
-# outbound calls through the pooled adapter
-_SESSION_RE = re.compile(rf"\bsession\(\)\s*\.\s*{_VERBS}\s*\(")
-
-_ALLOWED_RAW = {os.path.join("rpc", "httpclient.py")}
-
-
-def _iter_sources():
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in files:
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                with open(path, encoding="utf-8") as f:
-                    yield os.path.relpath(path, PKG_DIR), f.read()
-
-
-def _call_span(src: str, open_paren: int) -> str:
-    """The argument text of the call whose '(' is at ``open_paren``
-    (balanced-paren scan; good enough for lint-grade extraction)."""
-    depth = 0
-    for i in range(open_paren, min(len(src), open_paren + 4000)):
-        c = src[i]
-        if c == "(":
-            depth += 1
-        elif c == ")":
-            depth -= 1
-            if depth == 0:
-                return src[open_paren:i + 1]
-    return src[open_paren:open_paren + 4000]
+pytestmark = pytest.mark.lint
 
 
 def test_no_raw_requests_calls_outside_httpclient():
-    offenders = []
-    for rel, src in _iter_sources():
-        if rel in _ALLOWED_RAW:
-            continue
-        for m in _RAW_RE.finditer(src):
-            line = src.count("\n", 0, m.start()) + 1
-            offenders.append(f"{rel}:{line}: {m.group(0)}...")
-    assert not offenders, (
-        "raw requests.<verb>() bypasses the retry/deadline/breaker "
-        "layer; use rpc.httpclient.session() instead:\n"
-        + "\n".join(offenders))
+    offenders = [f.render() for f in run_cached().by_rule("raw-requests")]
+    assert not offenders, "\n".join(offenders)
 
 
 def test_every_session_call_has_explicit_timeout():
-    offenders = []
-    for rel, src in _iter_sources():
-        for m in _SESSION_RE.finditer(src):
-            span = _call_span(src, src.index("(", m.end() - 1))
-            if "timeout" not in span:
-                line = src.count("\n", 0, m.start()) + 1
-                offenders.append(f"{rel}:{line}")
-    assert not offenders, (
-        "session() calls without an explicit timeout= (a hung peer "
-        "would pin the worker forever):\n" + "\n".join(offenders))
+    offenders = [f.render()
+                 for f in run_cached().by_rule("session-timeout")]
+    assert not offenders, "\n".join(offenders)
 
 
 def test_session_is_actually_used():
-    # the lint is vacuous if nothing routes through the adapter
-    n = sum(len(_SESSION_RE.findall(src)) for _rel, src in _iter_sources())
-    assert n > 30, f"only {n} session() call sites found"
+    """Negative control: the pooled session is the package's actual
+    HTTP surface — if its call sites vanished, the lints above would
+    be guarding an empty set."""
+    assert run_cached().stats["session_calls"] > 30

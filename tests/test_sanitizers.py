@@ -1,0 +1,97 @@
+"""Run the native data plane's parity + concurrency suites under ASan
+and TSan.
+
+The instrumented .so must be dlopened by a python process whose
+dynamic loader already mapped the sanitizer runtime — LD_PRELOAD at
+exec time — so each mode spawns a fresh subprocess pytest run with the
+environment from ``dataplane.sanitizer_env``. ``halt_on_error=1``
+turns any finding into a nonzero exit, and ``log_path`` redirection
+lets the parent assert that zero report files were written (a belt for
+the exit-code suspenders: some TSan deadlock reports don't halt).
+"""
+import glob
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from seaweedfs_tpu.native import build as nbuild
+from seaweedfs_tpu.native import dataplane
+
+pytestmark = pytest.mark.sanitize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the native hot-path surface: S3/filer front parity + the mixed-path
+# mutation race (appliers vs meta events vs native readers)
+SUITES = [
+    "tests/test_s3_native_front.py",
+    "tests/test_filer_native_front.py",
+    "tests/test_native_front_races.py::"
+    "test_s3_front_concurrent_mixed_path_mutations",
+]
+
+
+def _runtime_present(mode: str) -> bool:
+    rt = {"asan": "libasan.so", "tsan": "libtsan.so"}[mode]
+    try:
+        out = subprocess.run(["gcc", f"-print-file-name={rt}"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    path = out.stdout.strip()
+    return out.returncode == 0 and os.path.isabs(path) and \
+        os.path.exists(path)
+
+
+def _run_sanitized(mode: str, tmp_path) -> None:
+    if shutil.which("g++") is None or not _runtime_present(mode):
+        pytest.skip(f"no toolchain/runtime for {mode}")
+    # build here so a compile failure reads as such, not as a timeout
+    lib = nbuild.build_dataplane(verbose=False, mode=mode)
+    assert os.path.exists(lib) and lib.endswith(f".{mode}.so")
+    env = dict(os.environ)
+    env.update(dataplane.sanitizer_env(mode, str(tmp_path)))
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *SUITES],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540)
+    reports = sorted(glob.glob(os.path.join(str(tmp_path),
+                                            f"{mode}-report.*")))
+    blobs = "".join(open(p, errors="replace").read() for p in reports)
+    assert out.returncode == 0 and not reports, (
+        f"{mode} run rc={out.returncode}\n--- stdout ---\n"
+        f"{out.stdout[-4000:]}\n--- stderr ---\n{out.stderr[-2000:]}"
+        f"\n--- reports ---\n{blobs[-4000:]}")
+
+
+def test_native_suites_clean_under_asan(tmp_path):
+    _run_sanitized("asan", tmp_path)
+
+
+def test_native_suites_clean_under_tsan(tmp_path):
+    _run_sanitized("tsan", tmp_path)
+
+
+def test_sanitize_mode_selects_distinct_cached_lib(monkeypatch):
+    monkeypatch.setenv(nbuild.SANITIZE_ENV, "asan")
+    assert nbuild.dp_lib_path().endswith(".asan.so")
+    monkeypatch.setenv(nbuild.SANITIZE_ENV, "tsan")
+    assert nbuild.dp_lib_path().endswith(".tsan.so")
+    monkeypatch.delenv(nbuild.SANITIZE_ENV)
+    assert nbuild.dp_lib_path() == nbuild.DP_LIB
+    monkeypatch.setenv(nbuild.SANITIZE_ENV, "bogus")
+    with pytest.raises(ValueError):
+        nbuild.sanitize_mode()
+
+
+def test_loaded_mode_cannot_be_swapped_in_process(monkeypatch):
+    if not dataplane.available():
+        pytest.skip("no native toolchain")
+    dataplane._load()  # plain mode
+    monkeypatch.setenv(nbuild.SANITIZE_ENV, "asan")
+    with pytest.raises(RuntimeError, match="already loaded"):
+        dataplane._load()

@@ -1,0 +1,105 @@
+"""Regression tests for the stream-close and deadline-middleware fixes
+the resource-safety / context-propagation analyzer rules surfaced:
+leaked ``stream=True`` responses pin pooled connections (cli filer.cat,
+s3 download_to, ftpd RETR/APPE), and an app without the retry
+middleware never rejects already-dead work."""
+import io
+
+import pytest
+
+
+class _FakeStreamResponse:
+    """Just enough requests.Response: stream body + close tracking."""
+
+    def __init__(self, status_code=200, chunks=(b"data",)):
+        self.status_code = status_code
+        self.text = "err" if status_code >= 300 else ""
+        self._chunks = list(chunks)
+        self.closed = False
+
+    def iter_content(self, _n):
+        yield from self._chunks
+
+    def raise_for_status(self):
+        if self.status_code >= 300:
+            raise RuntimeError(f"status {self.status_code}")
+
+    def close(self):
+        self.closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _FakeSession:
+    def __init__(self, resp):
+        self.resp = resp
+
+    def get(self, *_a, **_kw):
+        return self.resp
+
+
+def test_cli_filer_cat_closes_response_on_both_paths(monkeypatch,
+                                                     capsys):
+    from seaweedfs_tpu import cli
+
+    ok = _FakeStreamResponse(200, chunks=(b"hello",))
+    monkeypatch.setattr(cli, "session", lambda: _FakeSession(ok))
+    monkeypatch.setattr("sys.stdout", io.TextIOWrapper(
+        io.BytesIO(), write_through=True), raising=False)
+    assert cli.main(["filer.cat", "/f.bin"]) == 0
+    assert ok.closed
+
+    err = _FakeStreamResponse(404)
+    monkeypatch.setattr(cli, "session", lambda: _FakeSession(err))
+    assert cli.main(["filer.cat", "/nope.bin"]) == 1
+    assert err.closed
+
+
+def test_s3_download_to_closes_response_on_error(monkeypatch, tmp_path):
+    from seaweedfs_tpu.s3 import client as s3c
+
+    err = _FakeStreamResponse(500)
+    monkeypatch.setattr(s3c, "session", lambda: _FakeSession(err))
+    c = s3c.S3Client("http://127.0.0.1:1", "b", "k", "s")
+    with pytest.raises(RuntimeError):
+        c.download_to("key", str(tmp_path / "out.bin"))
+    assert err.closed
+
+    ok = _FakeStreamResponse(200, chunks=(b"abc", b"def"))
+    monkeypatch.setattr(s3c, "session", lambda: _FakeSession(ok))
+    assert c.download_to("key", str(tmp_path / "out2.bin")) == 6
+    assert ok.closed
+    assert (tmp_path / "out2.bin").read_bytes() == b"abcdef"
+
+
+def test_master_follower_app_rejects_expired_deadline():
+    """The follower's app now runs retry.aiohttp_middleware: a request
+    whose X-Sw-Deadline already passed is answered 504 before the
+    handler does any lookup work."""
+    import requests
+
+    from seaweedfs_tpu.rpc.http import ServerThread
+    from seaweedfs_tpu.server.master_follower import MasterFollower
+
+    mf = MasterFollower.__new__(MasterFollower)  # no MasterClient loop
+    t = ServerThread(mf.build_app()).start()
+    try:
+        r = requests.get(f"{t.url}/dir/lookup",
+                         params={"volumeId": "1"},
+                         headers={"X-Sw-Deadline": "1.0"}, timeout=10)
+        assert r.status_code == 504
+    finally:
+        t.stop()
+
+
+def test_webdav_app_rejects_expired_deadline():
+    from seaweedfs_tpu.webdav.server import WebDavServer
+
+    dav = WebDavServer.__new__(WebDavServer)
+    dav._locks = {}
+    mws = dav._build_app().middlewares
+    assert len(mws) >= 2, "webdav app lost the deadline middleware"
