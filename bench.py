@@ -1256,6 +1256,331 @@ def bench_qos_sweep(argv: list[str]) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_workload_sweep(argv: list[str]) -> int:
+    """`python bench.py workload-sweep [--duration 4] [--puts 400]
+    [--overhead-gate-pct 2] [--out BENCH_WORKLOAD.json]`
+
+    The workload-telemetry-plane proof, in three parts. (1) ORACLE:
+    the quantile sketch's p50/p90/p99 on a phase-shifting stream must
+    match an exact numpy oracle within the documented relative-error
+    bound (alpha), and merging two sketches must equal sketching the
+    concatenated stream bucket-for-bucket. (2) OVERHEAD: the gateway
+    hot path (filer PUT) is timed with sketches off then on; enabled
+    p99 must land within --overhead-gate-pct of disabled (plus a
+    small absolute epsilon for localhost HTTP jitter), and a micro
+    loop gates the raw ns/record cost. (3) END-TO-END: a real master
+    + volume subprocess pair and an in-process filer gateway carry
+    sketches over the production wires — heartbeat for volume heat,
+    metrics federation for tenant demand — and the master must show
+    all three advisors at /debug/workload with live recommendations,
+    accept a POST override, and federate workload_* + up gauges into
+    /cluster/metrics."""
+    import os
+    import shutil
+    import signal as _signal
+    import socket
+    import subprocess
+    import tempfile
+
+    import requests as rq
+
+    from seaweedfs_tpu.rpc.http import ServerThread
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.utils import qos
+    from seaweedfs_tpu.utils import sketch as _sketch
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    duration = float(opt("--duration", "4"))
+    puts = int(opt("--puts", "400"))
+    gate_pct = float(opt("--overhead-gate-pct", "2"))
+    out_path = opt("--out", "BENCH_WORKLOAD.json")
+    # localhost HTTP p99 sits at a few ms; a relative-only gate at 2%
+    # would be inside the scheduler's noise floor, so the gate is
+    # off_p99 * (1 + pct) + epsilon
+    eps_ms = 2.0
+    failures: list[str] = []
+
+    # -- part 1: sketch vs exact oracle on a phase-shifting stream ----
+    rng = np.random.default_rng(1234)
+    alpha = _sketch.DEFAULT_ALPHA
+    phase_a = rng.lognormal(mean=8.0, sigma=1.0, size=20000)  # ~3 KiB
+    phase_b = rng.lognormal(mean=14.0, sigma=1.0, size=20000)  # ~1 MiB
+    stream = np.concatenate([phase_a, phase_b])
+    sk = _sketch.QuantileSketch(alpha=alpha)
+    for v in stream:
+        sk.record(float(v))
+    oracle_rows = {}
+    for q in (0.5, 0.9, 0.99):
+        # the sketch's rank walk returns the order statistic at
+        # floor(q*(n-1)); "lower" is that element, not an interpolant
+        exact = float(np.quantile(stream, q, method="lower"))
+        got = sk.quantile(q)
+        rel = abs(got - exact) / exact
+        oracle_rows[f"p{int(q * 100)}"] = {
+            "exact": round(exact, 2), "sketch": round(got, 2),
+            "rel_err": round(rel, 5)}
+        if rel > alpha:
+            failures.append(f"oracle: p{int(q * 100)} rel err "
+                            f"{rel:.4f} over the alpha={alpha} bound")
+    a_sk, b_sk, both = (_sketch.QuantileSketch(alpha=alpha)
+                        for _ in range(3))
+    for v in phase_a:
+        a_sk.record(float(v))
+        both.record(float(v))
+    for v in phase_b:
+        b_sk.record(float(v))
+        both.record(float(v))
+    a_sk.merge(b_sk)
+    merge_exact = (a_sk.buckets == both.buckets
+                   and a_sk.count == both.count)
+    if not merge_exact:
+        failures.append("merge(a, b) != sketch(a ++ b) — federation "
+                        "merges are not bucket-exact")
+    log(f"workload-sweep oracle: {json.dumps(oracle_rows)} "
+        f"merge_exact={merge_exact}")
+
+    # -- part 1b: raw record cost ------------------------------------
+    micro = _sketch.QuantileSketch(alpha=alpha)
+    vals = [float(v) for v in rng.lognormal(10.0, 2.0, size=200000)]
+    t0 = time.perf_counter()
+    for v in vals:
+        micro.record(v)
+    ns_per_record = (time.perf_counter() - t0) / len(vals) * 1e9
+    record_gate_ns = 5000.0
+    if ns_per_record > record_gate_ns:
+        failures.append(f"record() costs {ns_per_record:.0f} ns — "
+                        f"over the {record_gate_ns:.0f} ns hot-path "
+                        "budget")
+    log(f"workload-sweep record cost: {ns_per_record:.0f} ns/record "
+        f"({len(micro.buckets)} buckets)")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_http(url: str, timeout: float = 30) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                rq.get(url, timeout=1)
+                return
+            except rq.RequestException:
+                time.sleep(0.15)
+        raise TimeoutError(f"{url} never came up")
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PYTHONPATH=repo)
+    tmp = tempfile.mkdtemp(prefix="workload_sweep_")
+    procs: list[subprocess.Popen] = []
+
+    def spawn(*args: str) -> None:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *args], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+    filer_thread = None
+    tel_enabled0 = _sketch.enabled()
+    try:
+        mport = free_port()
+        master = f"http://127.0.0.1:{mport}"
+        # 1 s federation sweeps so tenant demand reaches the advisor
+        # inside the bench window
+        spawn("master", "-port", str(mport), "-volumeSizeLimitMB",
+              "64", "-master.scrapeInterval", "1")
+        wait_http(f"{master}/cluster/status")
+        vp = free_port()
+        vd = os.path.join(tmp, "vol0")
+        os.makedirs(vd)
+        # the C++ native front answers fid GET/PUT without calling
+        # back into python, so the store's sketch taps never see that
+        # traffic — pin the pure-python plane the telemetry lives in
+        spawn("volume", "-port", str(vp), "-dir", vd,
+              "-mserver", f"127.0.0.1:{mport}",
+              "-dataplane", "python")
+        wait_http(f"http://127.0.0.1:{vp}/status")
+
+        fs = FilerServer(master, store="memory")
+        filer_thread = ServerThread(fs.app, host="127.0.0.1",
+                                    port=0).start()
+        fs.address = filer_thread.address
+        filer_url = filer_thread.url
+        qos.reset()  # shaping off; demand sketches run regardless
+
+        def drive(tag: str, n: int) -> dict:
+            """Closed-loop two-tenant PUT+GET traffic with a body-size
+            phase shift halfway — the workload the sketches must
+            characterize. Returns latency percentiles in ms."""
+            lats = []
+            sess = rq.Session()
+            for i in range(n):
+                tenant = "acme" if i % 3 else "bulk"
+                body = b"x" * (1024 if i < n // 2 else 65536)
+                t0 = time.perf_counter()
+                r = sess.put(f"{filer_url}/{tenant}/{tag}-{i % 40}",
+                             data=body, timeout=30)
+                lats.append(time.perf_counter() - t0)
+                if r.status_code not in (200, 201):
+                    failures.append(f"{tag}: PUT {r.status_code}")
+                    break
+                if i % 4 == 0:  # re-reads feed the gap sketches
+                    sess.get(f"{filer_url}/{tenant}/{tag}-{i % 40}",
+                             timeout=30)
+            arr = np.sort(np.array(lats)) * 1e3
+            return {"n": len(lats),
+                    "p50_ms": round(float(np.percentile(arr, 50)), 2),
+                    "p99_ms": round(float(np.percentile(arr, 99)), 2)}
+
+        # -- part 2: gateway hot path, sketches off vs on ------------
+        drive("warm", 60)  # warm volume assignment + page cache
+        _sketch.configure(enabled=False)
+        off = drive("off", puts)
+        _sketch.configure(enabled=True)
+        on = drive("on", puts)
+        overhead_pct = ((on["p99_ms"] - off["p99_ms"])
+                        / max(off["p99_ms"], 1e-9) * 100)
+        gate_ms = off["p99_ms"] * (1 + gate_pct / 100) + eps_ms
+        if on["p99_ms"] > gate_ms:
+            failures.append(
+                f"gateway p99 with sketches {on['p99_ms']}ms vs "
+                f"{off['p99_ms']}ms without — over the "
+                f"{gate_pct:.0f}% + {eps_ms:.0f}ms gate")
+        log(f"workload-sweep gateway: off p99 {off['p99_ms']}ms, "
+            f"on p99 {on['p99_ms']}ms ({overhead_pct:+.1f}%)")
+
+        # -- part 3: the plane end to end ----------------------------
+        # volume heartbeats every 5 s; federation sweeps every 1 s —
+        # poll until both wires have delivered
+        snap = {}
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            snap = rq.get(f"{master}/debug/workload",
+                          timeout=5).json()
+            if (snap.get("nodes")
+                    and snap["cluster"]["read_size"]["count"]
+                    and snap.get("tenants")):
+                break
+            time.sleep(0.5)
+        advisors = snap.get("advisors", {})
+        if not snap.get("nodes"):
+            failures.append("no volume heartbeat carried workload "
+                            "sketches to the master")
+        if set(advisors) != {"seal", "qos", "repair"}:
+            failures.append(f"advisors missing: {sorted(advisors)}")
+        seal = advisors.get("seal", {})
+        repair = advisors.get("repair", {})
+        qos_adv = advisors.get("qos", {})
+        if not isinstance(seal.get("recommended"), (int, float)):
+            failures.append("seal advisor has no recommendation "
+                            "despite read-gap samples")
+        if not isinstance(repair.get("recommended"), (int, float)):
+            failures.append("repair advisor has no recommendation "
+                            "despite foreground traffic")
+        if not qos_adv.get("tenants"):
+            failures.append("qos advisor saw no tenant demand via "
+                            "the metrics federation")
+
+        r = rq.post(f"{master}/debug/workload",
+                    json={"advisor": "seal", "override": 1234.5},
+                    timeout=5)
+        ok = (r.status_code == 200
+              and rq.get(f"{master}/debug/workload", timeout=5)
+              .json()["advisors"]["seal"].get("override") == 1234.5)
+        if not ok:
+            failures.append("POST /debug/workload override did not "
+                            "round-trip")
+        bad = rq.post(f"{master}/debug/workload",
+                      json={"advisor": "bogus", "override": 1},
+                      timeout=5)
+        if bad.status_code != 400:
+            failures.append("malformed override accepted")
+
+        fed = rq.get(f"{master}/cluster/metrics", timeout=10).text
+        if "workload_advisor_effective" not in fed \
+                or "workload_read_size_bytes" not in fed:
+            failures.append("workload_* gauges missing from "
+                            "/cluster/metrics")
+        if not any(ln.startswith("up{instance=") and ln.endswith(" 1")
+                   for ln in fed.splitlines()):
+            failures.append("no up{instance=...} 1 gauge in the "
+                            "federated corpus")
+        tenant_fed = "workload_tenant_rate_rps" in fed
+        if not tenant_fed:
+            failures.append("tenant demand gauges not federated from "
+                            "the gateway")
+
+        result = {
+            "config": {"alpha": alpha, "puts": puts,
+                       "duration_s": duration,
+                       "overhead_gate_pct": gate_pct,
+                       "overhead_eps_ms": eps_ms,
+                       "record_gate_ns": record_gate_ns,
+                       "workload": "two-tenant PUT+GET, body-size "
+                                   "phase shift halfway"},
+            "oracle": {"rows": oracle_rows,
+                       "merge_equals_concat": merge_exact,
+                       "alpha_bound": alpha},
+            "record_ns": round(ns_per_record, 1),
+            "gateway_hot_path": {"sketches_off": off,
+                                 "sketches_on": on,
+                                 "p99_overhead_pct":
+                                     round(overhead_pct, 2)},
+            "advisors": {
+                "seal": {k: seal.get(k) for k in
+                         ("current", "recommended", "coverage",
+                          "effective", "override")},
+                "repair": {k: repair.get(k) for k in
+                           ("current", "recommended", "effective")},
+                "qos_tenants": sorted(qos_adv.get("tenants", {})),
+            },
+            "federated": {"workload_gauges": "workload_" in fed,
+                          "tenant_demand": tenant_fed,
+                          "up_gauge": "up{instance=" in fed},
+            "failures": failures,
+        }
+        with open(os.path.join(repo, out_path), "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        worst_rel = max(r["rel_err"] for r in oracle_rows.values())
+        print(json.dumps({
+            "metric": "workload_sweep_oracle_rel_err",
+            "value": worst_rel,
+            "unit": "ratio",
+            "extra": {"alpha_bound": alpha,
+                      "gateway_p99_overhead_pct":
+                          round(overhead_pct, 2),
+                      "record_ns": round(ns_per_record, 1),
+                      "failures": failures, "out": out_path},
+        }), flush=True)
+        if failures:
+            log("WORKLOAD-SWEEP FAILURES:\n  " + "\n  ".join(failures))
+            return 1
+        return 0
+    finally:
+        _sketch.configure(enabled=tel_enabled0)
+        qos.reset()
+        if filer_thread is not None:
+            try:
+                filer_thread.stop()
+            except Exception:
+                pass
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(_signal.SIGINT)
+        for p in reversed(procs):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_repair_sweep(argv: list[str]) -> int:
     """`python bench.py repair-sweep [--caps 0,2000000,1000000,500000]
     [--out BENCH_REPAIR.json]`
@@ -2144,6 +2469,8 @@ if __name__ == "__main__":
         sys.exit(bench_repair_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "qos-sweep":
         sys.exit(bench_qos_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "workload-sweep":
+        sys.exit(bench_workload_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "meta-sweep":
         sys.exit(bench_meta_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "tier-sweep":

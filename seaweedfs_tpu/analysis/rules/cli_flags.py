@@ -1,10 +1,12 @@
 """CLI flag help (the lint formerly in test_lint_cli_flags.py).
 
 Every robustness CLI knob (-repair.*, -fault.*, -retry.*, -qos.*,
--filer.store.*, -filer.cache.*, -filer.native*, -tier.*) registered in
-cli.py must carry non-empty help text — these flags gate chaos /
-repair / overload / metadata-plane / tiering / native-front behaviour
-and an undocumented one is effectively invisible to operators.
+-filer.store.*, -filer.cache.*, -filer.native*, -tier.*,
+-telemetry.*, -advisor.*) registered in cli.py must carry non-empty
+help text — these flags gate chaos / repair / overload /
+metadata-plane / tiering / native-front / workload-telemetry
+behaviour and an undocumented one is effectively invisible to
+operators.
 """
 from __future__ import annotations
 
@@ -14,7 +16,7 @@ from ..engine import PKG_PREFIX, Rule, register
 
 PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
             "-filer.store.", "-filer.cache.", "-filer.native",
-            "-tier.")
+            "-tier.", "-telemetry.", "-advisor.")
 
 # the documented surface this PR series promises; rot here means a
 # flag was dropped without its docs/tests following
@@ -29,7 +31,10 @@ EXPECTED = (
     "-tier.enabled", "-tier.interval", "-tier.concurrency",
     "-tier.sealAfterIdle", "-tier.offloadAfterIdle", "-tier.recallReads",
     "-tier.recallWindow", "-tier.maxAttempts", "-tier.maxBytesPerSec",
-    "-tier.remote", "-tier.stateDir")
+    "-tier.remote", "-tier.stateDir",
+    "-telemetry.enabled", "-telemetry.alpha", "-telemetry.window",
+    "-advisor.sealQuantile", "-advisor.demandQuantile",
+    "-advisor.headroom")
 
 
 @register

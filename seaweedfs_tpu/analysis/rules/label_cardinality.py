@@ -24,11 +24,12 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 # (code), the histogram-internal bound (le), or capped by a registry
 # (tenant: -qos.maxTenants + __overflow__; shard: exactly
 # -filer.store.shards values; from/to/tier: the tier-state enum in
-# master/tiering.py; dir: exactly {offload, recall}).
+# master/tiering.py; dir: exactly {offload, recall}; q: the fixed
+# quantile points {0.5, 0.9, 0.99} the workload sketches export).
 ALLOWED = {
     "backend", "code", "collection", "dir", "direction", "from",
     "handler", "instance", "kind", "le", "method", "mode", "op",
-    "outcome", "reason", "service", "shard", "stage", "tenant",
+    "outcome", "q", "reason", "service", "shard", "stage", "tenant",
     "tier", "to",
 }
 

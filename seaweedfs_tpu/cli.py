@@ -167,6 +167,26 @@ def main(argv: list[str] | None = None) -> int:
              "{\"rate\":..., \"priority\":...}}}'), hot-reloaded on "
              "mtime change; place BEFORE the subcommand")
     parser.add_argument(
+        "-telemetry.enabled", dest="telemetry_enabled",
+        type=lambda s: s.lower() not in ("0", "false", "no"),
+        default=True,
+        help="record workload sketches (per-volume heat histograms, "
+             "per-tenant demand) and ship them on the heartbeat; "
+             "false disables every record path (default true); "
+             "place BEFORE the subcommand")
+    parser.add_argument(
+        "-telemetry.alpha", dest="telemetry_alpha", type=float,
+        default=None,
+        help="relative-error bound of the quantile sketches: any "
+             "reported quantile is within alpha of the true value "
+             "(default 0.01 = 1%%); place BEFORE the subcommand")
+    parser.add_argument(
+        "-telemetry.window", dest="telemetry_window", type=float,
+        default=None,
+        help="sliding-window horizon in seconds for workload "
+             "sketches; older samples age out (default 300); place "
+             "BEFORE the subcommand")
+    parser.add_argument(
         "-security", default="",
         help="path to a security config JSON (scaffold "
              "-config=security): enables HTTPS (+ optional mutual "
@@ -307,6 +327,24 @@ def main(argv: list[str] | None = None) -> int:
                    type=float, default=10.0,
                    help="seconds between metrics-federation sweeps "
                         "over every registered node's /metrics")
+    p.add_argument("-advisor.sealQuantile",
+                   dest="advisor_seal_quantile", type=float,
+                   default=0.95,
+                   help="idle-gap quantile the auto-seal advisor "
+                        "targets: it recommends -tier.sealAfterIdle "
+                        "just above this fraction of observed "
+                        "inter-access gaps (default 0.95)")
+    p.add_argument("-advisor.demandQuantile",
+                   dest="advisor_demand_quantile", type=float,
+                   default=0.9,
+                   help="per-tenant demand quantile the QoS advisor "
+                        "sizes provisioned rates against "
+                        "(default 0.9)")
+    p.add_argument("-advisor.headroom", dest="advisor_headroom",
+                   type=float, default=1.5,
+                   help="multiplier applied on top of observed "
+                        "demand/idle quantiles before recommending "
+                        "a threshold (default 1.5)")
 
     p = sub.add_parser("master.follower",
                        help="read-only master follower for lookup traffic")
@@ -754,6 +792,7 @@ def main(argv: list[str] | None = None) -> int:
     from .utils import faults as _faults
     from .utils import qos as _qos
     from .utils import retry as _retry
+    from .utils import sketch as _sketch
 
     _faults.configure(spec=args.fault_spec or None,
                       seed=args.fault_seed or None)
@@ -771,6 +810,9 @@ def main(argv: list[str] | None = None) -> int:
                    max_delay=args.qos_max_delay,
                    request_floor=args.qos_request_floor,
                    spec=args.qos_spec or None)
+    _sketch.configure(enabled=args.telemetry_enabled,
+                      alpha=args.telemetry_alpha,
+                      window=args.telemetry_window)
     if args.memprofile:
         import tracemalloc
 
@@ -1229,7 +1271,11 @@ def _run_master(args) -> int:
                       tier_state_dir=args.tier_state_dir,
                       trace_store_size=args.trace_store_size,
                       scrape_interval=args.scrape_interval,
-                      otlp_url=args.trace_otlp_url)
+                      otlp_url=args.trace_otlp_url,
+                      advisor_seal_quantile=args.advisor_seal_quantile,
+                      advisor_demand_quantile=(
+                          args.advisor_demand_quantile),
+                      advisor_headroom=args.advisor_headroom)
     t = ServerThread(ms.app, host=args.ip, port=args.port,
                      ssl_context=_ssl_ctx(args)).start()
     ms.admin_scripts_url = t.url

@@ -314,9 +314,17 @@ class MetricsFederator:
     every instance that has pushed spans (covers S3/WebDAV gateways,
     which register with neither)."""
 
-    def __init__(self, master, interval: float = 10.0):
+    def __init__(self, master, interval: float = 10.0,
+                 stale_after: float | None = None):
         self.master = master
         self.interval = float(interval)
+        # a crashed node's last scrape must not serve frozen gauges
+        # forever: past this cutoff its series are dropped from the
+        # merged corpus and its synthetic `up` gauge flips to 0.
+        # Default: 3 missed scrape intervals, floored at 30s so tests
+        # with sub-second intervals don't flap
+        self.stale_after = (float(stale_after) if stale_after
+                            else max(3.0 * self.interval, 30.0))
         self._lock = threading.Lock()
         # instance -> {"text": str, "ts": wall, "error": str}
         self._scraped: dict[str, dict] = {}
@@ -386,7 +394,10 @@ class MetricsFederator:
 
     def merged(self, self_instance: str = "") -> str:
         """The federated exposition: every scraped node's series plus
-        the master's own registry, all labeled with `instance`."""
+        the master's own registry, all labeled with `instance`. Emits
+        a synthetic `up{instance}` gauge per target (1 = scraped
+        within the staleness cutoff) and DROPS the series of stale
+        instances — a dead node answers up 0, not frozen gauges."""
         now = time.time()
         with self._lock:
             samples = {i: dict(s) for i, s in self._scraped.items()}
@@ -397,16 +408,30 @@ class MetricsFederator:
                 "cluster_scrape_staleness_seconds",
                 round(st, 3) if st != float("inf") else -1,
                 {"instance": inst})
+        stale = {i for i, st in staleness.items()
+                 if st > self.stale_after}
         if self_instance:
-            # render AFTER the staleness gauges so they ride along
+            # render AFTER the staleness gauges so they ride along;
+            # the master's own registry is by definition fresh
             samples[self_instance] = {"text": metrics.render(),
                                       "ts": now, "error": ""}
+            stale.discard(self_instance)
         # family -> (type line, [series lines]) keeps one # TYPE per
         # family across instances (duplicate TYPE lines are invalid)
         types: dict[str, str] = {}
         series: dict[str, list[str]] = {}
         order: list[str] = []
+        types["up"] = "# TYPE up gauge"
+        series["up"] = []
+        order.append("up")
         for inst in sorted(samples):
+            labeled = _inject_instance(
+                f"up {0 if inst in stale else 1}", inst)
+            if labeled is not None:
+                series["up"].append(labeled)
+        for inst in sorted(samples):
+            if inst in stale:
+                continue
             for line in samples[inst]["text"].splitlines():
                 line = line.strip()
                 if not line:
@@ -444,6 +469,8 @@ class MetricsFederator:
                 inst: {
                     "StalenessSeconds": round(now - s["ts"], 3)
                     if s["ts"] else None,
+                    "Up": bool(s["ts"]) and
+                    (now - s["ts"]) <= self.stale_after,
                     "Error": s["error"] or None,
                 } for inst, s in sorted(self._scraped.items())}
 

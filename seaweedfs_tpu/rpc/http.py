@@ -126,3 +126,23 @@ def parse_json_body(text: str) -> dict:
     if not isinstance(v, dict):
         raise ValueError("json body must be an object")
     return v
+
+
+def debug_index_factory(service: str, endpoints: dict[str, str]):
+    """GET /debug — one self-describing index of a server's debug
+    surface, so operators need no tribal knowledge of paths. Every
+    server registers this with its own {path: one-line description}
+    map; ?format=text renders a plain listing for terminals."""
+    listing = dict(sorted(endpoints.items()))
+
+    async def handle(request: web.Request) -> web.Response:
+        if request.query.get("format") == "text":
+            width = max(len(p) for p in listing)
+            lines = [f"{service} debug endpoints:"] + [
+                f"  {path.ljust(width)}  {desc}"
+                for path, desc in listing.items()]
+            return web.Response(text="\n".join(lines) + "\n",
+                                content_type="text/plain")
+        return web.json_response({"service": service,
+                                  "endpoints": listing})
+    return handle

@@ -27,6 +27,7 @@ import xml.etree.ElementTree as ET
 import requests
 
 from ..rpc import httpclient
+from ..rpc.http import debug_index_factory
 from aiohttp import web
 
 from ..filer.entry import Entry as FilerEntry
@@ -298,6 +299,14 @@ class S3ApiServer:
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            # /debug index BEFORE the catch-all dispatch, or it would
+            # be parsed as a bucket name
+            web.get("/debug", debug_index_factory("s3", {
+                "/debug/traces": "recent spans recorded in-process",
+                "/debug/breakers": "circuit breaker states",
+                "/debug/qos": "per-tenant admission buckets + shed "
+                              "counts",
+            })),
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
@@ -314,6 +323,9 @@ class S3ApiServer:
         return web.json_response(out)
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
+        # per-tenant demand sketches -> workload_tenant_* gauges so
+        # tenant demand rides federation to the master's aggregator
+        qos.export_demand_metrics()
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

@@ -30,6 +30,7 @@ from ..filer import (Entry, FileChunk, Filer, etag_chunks,
 from ..filer.filechunks import MANIFEST_BATCH
 from ..filer.filer import DirectoryNotEmptyError
 from ..operation import verbs
+from ..rpc.http import debug_index_factory
 from ..utils import faults, httprange, metrics, qos, retry, tracing
 from ..wdclient.client import MasterClient
 
@@ -354,6 +355,17 @@ class FilerServer:
         app.add_routes([
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            # /debug index BEFORE the catch-all path routes below, or
+            # the filer would treat it as a file read
+            web.get("/debug", debug_index_factory("filer", {
+                "/debug/traces": "recent spans recorded in-process",
+                "/debug/breakers": "circuit breaker states",
+                "/debug/qos": "per-tenant admission buckets + shed "
+                              "counts",
+                "/debug/ec": "EC codec router: probe curve + backends",
+                "/debug/filer": "metadata store shards, cache, "
+                                "compaction debt",
+            })),
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
@@ -1232,6 +1244,9 @@ class FilerServer:
         publish = getattr(self.filer.store, "publish_metrics", None)
         if publish is not None:
             publish()
+        # per-tenant demand sketches -> workload_tenant_* gauges so
+        # tenant demand rides federation to the master's aggregator
+        qos.export_demand_metrics()
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

@@ -25,7 +25,7 @@ from aiohttp import web
 from ..master.sequence import MemorySequencer, SnowflakeSequencer
 from ..master.topology import (NoFreeSlots, NoWritableVolume, Topology,
                                VolumeInfo)
-from ..rpc.http import json_error, json_ok
+from ..rpc.http import debug_index_factory, json_error, json_ok
 from ..storage import types as t
 from ..utils import faults, retry, tracing
 from ..utils.security import Guard
@@ -75,7 +75,10 @@ class MasterServer:
                  tier_state_dir: str = "",
                  trace_store_size: int = 2048,
                  scrape_interval: float = 10.0,
-                 otlp_url: str = ""):
+                 otlp_url: str = "",
+                 advisor_seal_quantile: float = 0.95,
+                 advisor_demand_quantile: float = 0.9,
+                 advisor_headroom: float = 1.5):
         self.topo = Topology(volume_size_limit, pulse_seconds)
         self.default_replication = default_replication
         if sequencer == "memory" and peers:
@@ -145,6 +148,14 @@ class MasterServer:
         self.collector = SpanCollector(max_traces=trace_store_size)
         self.federator = MetricsFederator(self, interval=scrape_interval)
         self.otlp_url = otlp_url
+        # workload-characterization plane (master/workload.py):
+        # heartbeat sketch aggregation + recommend-only advisors
+        from ..master.workload import WorkloadAggregator
+
+        self.workload = WorkloadAggregator(
+            self, seal_quantile=advisor_seal_quantile,
+            demand_quantile=advisor_demand_quantile,
+            headroom=advisor_headroom)
         self._obs_stop: asyncio.Event | None = None
         self._obs_tasks: list[asyncio.Task] = []
         self.app = self._build_app()
@@ -243,6 +254,18 @@ class MasterServer:
                          retry.aiohttp_middleware("master"),
                          faults.aiohttp_middleware("master")])
         app.add_routes([
+            web.get("/debug", debug_index_factory("master", {
+                "/debug/traces": "recent spans recorded in-process",
+                "/debug/breakers": "circuit breaker states",
+                "/debug/ec": "EC codec router: probe curve + backends",
+                "/debug/repair": "watchdog deficits, queue, history "
+                                 "(POST enqueues one repair)",
+                "/debug/tiering": "tier states and transitions (POST "
+                                  "forces one)",
+                "/debug/workload": "heat/demand distributions + "
+                                   "threshold advisors (POST sets an "
+                                   "advisor override)",
+            })),
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
@@ -251,6 +274,8 @@ class MasterServer:
             web.post("/debug/repair", self.handle_repair_enqueue),
             web.get("/debug/tiering", self.handle_debug_tiering),
             web.post("/debug/tiering", self.handle_tier_enqueue),
+            web.get("/debug/workload", self.handle_debug_workload),
+            web.post("/debug/workload", self.handle_workload_override),
             web.get("/dir/assign", self.handle_assign),
             web.post("/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -522,6 +547,11 @@ class MasterServer:
                     node.repair_bw = hb["repair_bw"]
                 if "tier_bw" in hb:
                     node.tier_bw = hb["tier_bw"]
+                # per-volume heat sketches + node byte rates for the
+                # workload aggregator (compact encodings, PR-gated by
+                # -telemetry.enabled on the volume server side)
+                if "workload" in hb:
+                    self.workload.ingest(node_id, hb["workload"])
                 self.watchdog.poke()
                 self.tiering.poke()
                 await ws.send_json({
@@ -532,6 +562,7 @@ class MasterServer:
         finally:
             if node_id is not None:
                 self.topo.unregister_data_node(node_id)
+                self.workload.forget(node_id)
                 self.watchdog.poke()
                 self.tiering.poke()
                 await self._broadcast_all_locations()
@@ -656,6 +687,10 @@ class MasterServer:
             # gateway scrapes (the raw per-tenant series live in
             # /cluster/metrics)
             "Qos": self._qos_summary(),
+            # measured-distribution plane: nodes reporting sketches,
+            # tenants seen, and the three advisors' current vs
+            # recommended thresholds (detail at /debug/workload)
+            "Workload": self.workload.status_fold(),
             "Observability": {
                 **self.collector.observability(),
                 "Federation": self.federator.observability(),
@@ -745,13 +780,14 @@ class MasterServer:
 
     async def handle_cluster_traces(self, req: web.Request) -> web.Response:
         """GET /cluster/traces — cross-process trace store.
-        ?trace_id= for one stitched tree, ?format=otlp for OTLP/JSON,
-        ?limit= for the list size."""
+        ?trace_id= (alias ?trace=) for one stitched tree,
+        ?format=otlp for OTLP/JSON, ?limit= for the list size."""
         try:
             limit = int(req.query.get("limit", "50"))
         except ValueError:
             limit = 50
-        trace_id = req.query.get("trace_id", "")
+        trace_id = req.query.get("trace_id", "") or \
+            req.query.get("trace", "")
         if req.query.get("format") == "otlp":
             ids = [trace_id] if trace_id else None
             return web.json_response(
@@ -796,6 +832,9 @@ class MasterServer:
                        if t not in self.federator._scraped]
         if missing:
             await asyncio.to_thread(self.federator.scrape_once)
+        # the merged corpus embeds this master's own registry render —
+        # refresh the workload_* gauges first, same as handle_metrics
+        self.workload.export_gauges()
         return web.Response(
             text=self.federator.merged(
                 self_instance=self._self_instance()),
@@ -953,6 +992,45 @@ class MasterServer:
         return json_ok({"accepted": accepted,
                         "enabled": self.tiering.enabled})
 
+    async def handle_debug_workload(self, req: web.Request
+                                    ) -> web.Response:
+        """GET /debug/workload — cluster heat/demand distributions,
+        per-node provenance, and the three advisors with current-flag
+        vs recommendation deltas."""
+        return json_ok(self.workload.snapshot())
+
+    async def handle_workload_override(self, req: web.Request
+                                       ) -> web.Response:
+        """POST /debug/workload — set/clear one advisor override:
+        {"advisor": "seal"|"qos"|"repair", "override": number|null,
+        "tenant": "..." (qos only)}. Malformed input is always a 400
+        with a JSON error."""
+        redir = self._leader_redirect(req)
+        if redir is not None:
+            return redir
+        try:
+            body = await req.json()
+        except Exception:
+            return json_error("workload override body must be JSON",
+                              status=400)
+        if not isinstance(body, dict):
+            return json_error("workload override body must be a JSON "
+                              "object", status=400)
+        if "advisor" not in body:
+            return json_error("workload override requires an "
+                              "'advisor' field", status=400)
+        if "override" not in body:
+            return json_error("workload override requires an "
+                              "'override' field (number or null)",
+                              status=400)
+        try:
+            out = self.workload.set_override(
+                str(body["advisor"]), body["override"],
+                tenant=str(body.get("tenant", "")))
+        except ValueError as e:
+            return json_error(str(e), status=400)
+        return json_ok(out)
+
     async def handle_debug_ec(self, req: web.Request) -> web.Response:
         from ..ec import backend as ec_backend
 
@@ -1108,6 +1186,9 @@ class MasterServer:
                 metrics.gauge_set("master_volumes", total, lab)
                 metrics.gauge_set("master_writable_volumes", writable,
                                   lab)
+        # workload distributions + advisor gauges refresh per scrape,
+        # so /cluster/metrics federates the advisors' current view
+        self.workload.export_gauges()
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
 

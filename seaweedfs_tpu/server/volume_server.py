@@ -28,6 +28,7 @@ from ..ec.decoder import find_dat_size, write_dat_file, write_idx_from_ecx
 from ..storage import backend
 from ..storage import needle as ndl
 from ..storage import types as t
+from ..rpc.http import debug_index_factory
 from ..storage.store import Store
 from ..utils import faults, glog, httprange, metrics, ratelimit, retry, \
     tracing
@@ -151,6 +152,11 @@ class VolumeServer:
             web.get("/ui/index.html", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            web.get("/debug", debug_index_factory("volume", {
+                "/debug/traces": "recent spans recorded in-process",
+                "/debug/breakers": "circuit breaker states",
+                "/debug/ec": "EC codec router: probe curve + backends",
+            })),
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),

@@ -23,6 +23,7 @@ from ..ec.backend import ReedSolomon
 from ..ec.backend import cpu_backend_name as ec_cpu_backend
 from ..ec.encoder import rebuild_ec_files, write_ec_files, write_sorted_ecx
 from ..ec.volume import EcVolume
+from ..utils import sketch as _sketch
 from . import needle as ndl
 from . import types as t
 from .disk_location import DiskLocation
@@ -89,11 +90,23 @@ class Store:
         self.ec_read_deadline = 10.0
         self._rs = ReedSolomon(geo.DATA_SHARDS, geo.PARITY_SHARDS,
                                backend=ec_backend)
-        # per-volume heat: last read wall time + cumulative read count,
-        # reported in heartbeats so the master's tiering controller can
-        # age volumes by real access, not just write mtime
+        # per-volume heat: last read/write wall time + cumulative
+        # counts, reported in heartbeats so the master's tiering
+        # controller can age volumes by real access, not just write
+        # mtime
         self._heat: dict[int, dict] = {}
         self._heat_lock = threading.Lock()
+        # per-volume workload sketches (read/write inter-access gaps +
+        # request sizes) behind the same short lock; compact encodings
+        # ride the heartbeat `workload` key when telemetry is enabled
+        self._wl: dict[int, dict] = {}
+        # node-level foreground byte-rate accounting: current-second
+        # tally, last completed second, all-time per-second peak — the
+        # repair-cap advisor's headroom inputs
+        self._bps_sec = 0
+        self._bps_cur = 0
+        self._bps_last = 0
+        self._bps_peak = 0
         for loc in self.locations:
             loc.load_existing()
             for vid, entry in loc.ec_shards.items():
@@ -218,37 +231,125 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        return v.append_needle(n)
+        res = v.append_needle(n)
+        self.record_write(vid, nbytes=res[1])
+        return res
 
     def read_needle(self, vid: int, needle_id: int,
                     cookie: int | None = None,
                     read_deleted: bool = False) -> Needle:
         v = self.find_volume(vid)
         if v is not None:
-            self.record_read(vid)
-            return v.read_needle(needle_id, cookie,
-                                 read_deleted=read_deleted)
+            out = v.read_needle(needle_id, cookie,
+                                read_deleted=read_deleted)
+            self.record_read(vid, nbytes=out.size)
+            return out
         if vid in self.ec_volumes:
             return self.read_ec_needle(vid, needle_id, cookie)
         raise KeyError(f"volume {vid} not found")
 
-    def record_read(self, vid: int) -> None:
+    @staticmethod
+    def _new_heat() -> dict:
+        return {"last_read_at": 0.0, "read_count": 0,
+                "last_write_at": 0.0, "write_count": 0}
+
+    def _wl_for(self, vid: int) -> dict:
+        # caller holds _heat_lock; rg/wg = read/write inter-access
+        # gaps, rs/ws = read/write request sizes
+        wl = self._wl.get(vid)
+        if wl is None:
+            wl = self._wl[vid] = {k: _sketch.windowed()
+                                  for k in ("rg", "rs", "wg", "ws")}
+        return wl
+
+    def _account_bytes(self, nbytes: int, now: float) -> None:
+        # caller holds _heat_lock
+        sec = int(now)
+        if sec != self._bps_sec:
+            if self._bps_sec:
+                self._bps_last = self._bps_cur
+                if self._bps_cur > self._bps_peak:
+                    self._bps_peak = self._bps_cur
+            self._bps_sec = sec
+            self._bps_cur = 0
+        if nbytes > 0:
+            self._bps_cur += int(nbytes)
+
+    def record_read(self, vid: int, nbytes: int = 0) -> None:
         """Heat accounting for one serving read of a volume — cheap
-        enough for the GET hot path (dict store under a short lock)."""
+        enough for the GET hot path (dict store under a short lock).
+        With telemetry on, also sketches the inter-read gap and the
+        needle size into the volume's sliding-window histograms."""
         now = time.time()
+        tele = _sketch.enabled()
         with self._heat_lock:
             h = self._heat.get(vid)
             if h is None:
-                h = self._heat[vid] = {"last_read_at": 0.0,
-                                       "read_count": 0}
+                h = self._heat[vid] = self._new_heat()
+            prev = h["last_read_at"]
             h["last_read_at"] = now
             h["read_count"] += 1
+            if tele:
+                wl = self._wl_for(vid)
+                if prev:
+                    wl["rg"].record(now - prev, now)
+                if nbytes > 0:
+                    wl["rs"].record(nbytes, now)
+                self._account_bytes(nbytes, now)
+
+    def record_write(self, vid: int, nbytes: int = 0) -> None:
+        """Write-side twin of record_read, tapped from write_needle."""
+        now = time.time()
+        tele = _sketch.enabled()
+        with self._heat_lock:
+            h = self._heat.get(vid)
+            if h is None:
+                h = self._heat[vid] = self._new_heat()
+            prev = h["last_write_at"]
+            h["last_write_at"] = now
+            h["write_count"] += 1
+            if tele:
+                wl = self._wl_for(vid)
+                if prev:
+                    wl["wg"].record(now - prev, now)
+                if nbytes > 0:
+                    wl["ws"].record(nbytes, now)
+                self._account_bytes(nbytes, now)
 
     def volume_heat(self, vid: int) -> dict:
         with self._heat_lock:
             h = self._heat.get(vid)
-            return dict(h) if h else {"last_read_at": 0.0,
-                                      "read_count": 0}
+            return dict(h) if h else self._new_heat()
+
+    def workload_payload(self, now: float | None = None) -> dict:
+        """Compact per-volume sketch encodings + node byte rates for
+        the heartbeat `workload` key (empty sketches are skipped so an
+        idle node costs a few bytes)."""
+        now = time.time() if now is None else now
+        with self._heat_lock:
+            vols = {}
+            for vid, wl in self._wl.items():
+                enc = {k: s.to_dict(now) for k, s in wl.items()}
+                enc = {k: d for k, d in enc.items() if d.get("n")}
+                if enc:
+                    vols[str(vid)] = enc
+            # fg_bps: the most recent complete-or-partial second's
+            # foreground bytes, 0 when the node has gone idle. The
+            # roll in _account_bytes only happens on the NEXT record,
+            # so a just-ended second still sits in _bps_cur here.
+            sec = int(now)
+            if sec == self._bps_sec:
+                fg = max(self._bps_cur, self._bps_last)
+            elif sec - self._bps_sec == 1:
+                fg = self._bps_cur  # that full second just ended
+            else:
+                fg = 0
+            # _bps_cur is always a valid single-second tally, even if
+            # the roll hasn't folded it into _bps_peak yet — a burst
+            # must count toward the peak before the next request lands
+            return {"alpha": _sketch.alpha(), "volumes": vols,
+                    "fg_bps": fg,
+                    "peak_bps": max(self._bps_peak, self._bps_cur)}
 
     def delete_needle(self, vid: int, needle_id: int) -> int:
         v = self.find_volume(vid)
@@ -338,7 +439,6 @@ class Store:
         ecv = self.ec_volumes.get(vid)
         if ecv is None:
             raise KeyError(f"ec volume {vid} not found")
-        self.record_read(vid)
         intervals, size = ecv.needle_intervals(needle_id)
         blob = b"".join(self._read_interval(ecv, iv) for iv in intervals)
         n = Needle.from_bytes(blob)
@@ -346,6 +446,7 @@ class Store:
             raise ValueError(f"size mismatch: ecx {size} vs disk {n.size}")
         if cookie is not None and n.cookie != cookie:
             raise PermissionError("cookie mismatch")
+        self.record_read(vid, nbytes=n.size)
         return n
 
     def _read_interval(self, ecv: EcVolume, iv: geo.Interval) -> bytes:
@@ -617,11 +718,16 @@ class Store:
              **self.volume_heat(vid)}
             for vid, ecv in self.ec_volumes.items()
         ]
-        return {
+        hb = {
             "ip": self.ip, "port": self.port, "public_url": self.public_url,
             "max_volume_count": sum(l.max_volumes for l in self.locations),
             "volumes": volumes, "ec_shards": ec_shards,
         }
+        if _sketch.enabled():
+            # compact sketch encodings for the master's workload
+            # aggregator; unknown keys are ignored by older masters
+            hb["workload"] = self.workload_payload()
+        return hb
 
     def close(self) -> None:
         for loc in self.locations:

@@ -51,6 +51,7 @@ import threading
 import time
 
 from . import metrics
+from . import sketch as _sketch
 from .ratelimit import TokenBucket
 
 OVERFLOW_TENANT = "__overflow__"
@@ -101,6 +102,10 @@ class QosRegistry:
         self._priority: dict[str, float] = {}
         self._admitted: dict[str, int] = {}
         self._shed: dict[tuple[str, str], int] = {}
+        # per-tenant demand sketches (inter-arrival gap, body bytes,
+        # queue delay) — recorded whether or not shaping is enabled,
+        # bounded by the same max_tenants/__overflow__ rule as buckets
+        self._demand: dict[str, dict] = {}
 
     # -- config ---------------------------------------------------------
 
@@ -266,6 +271,97 @@ class QosRegistry:
             self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
         return Admission(tenant, wait=wait)
 
+    # -- tenant demand telemetry ---------------------------------------
+
+    def record_demand(self, tenant: str, cost: int,
+                      wait: float) -> None:
+        """Sketch one request's demand signal for ``tenant`` (arrival
+        gap, body bytes, queue delay). Runs whether or not shaping is
+        enabled — the telemetry plane must see the workload before QoS
+        is ever turned on — and is a no-op when telemetry is off."""
+        if not _sketch.enabled():
+            return
+        now = time.time()
+        tenant = _clean_tenant(tenant)
+        with self._lock:
+            d = self._demand.get(tenant)
+            if d is None:
+                if len(self._demand) >= self.max_tenants and \
+                        tenant != OVERFLOW_TENANT:
+                    # bounded label cardinality, same rule as buckets
+                    tenant = OVERFLOW_TENANT
+                    d = self._demand.get(tenant)
+                if d is None:
+                    d = self._demand[tenant] = {
+                        "gap": _sketch.windowed(),
+                        "bytes": _sketch.windowed(),
+                        "delay": _sketch.windowed(),
+                        "last_at": 0.0}
+            if d["last_at"]:
+                d["gap"].record(now - d["last_at"], now)
+            d["last_at"] = now
+            d["bytes"].record(max(0, int(cost)), now)
+            d["delay"].record(max(0.0, wait), now)
+
+    def _demand_rows_locked(self, now: float) -> list[tuple]:
+        # (tenant, rate_rps, bytes_sketch, delay_sketch, gap_sketch,
+        #  provisioned bytes/sec); caller holds _lock. Rate comes from
+        # the mean inter-arrival gap inside the sliding window — exact
+        # for steady arrivals, window-size independent.
+        rows = []
+        for name, d in self._demand.items():
+            gap = d["gap"].merged(now)
+            by = d["bytes"].merged(now)
+            dl = d["delay"].merged(now)
+            rate = 1.0 / gap.mean if gap.mean > 0 else 0.0
+            b = self._buckets.get(name)
+            prov = b.rate if b is not None else self.default_rate
+            rows.append((name, rate, by, dl, gap, prov))
+        return rows
+
+    def demand_snapshot(self, now: float | None = None) -> dict:
+        """Per-tenant demand digest + the provisioned rate each tenant
+        is currently configured for (the QoS advisor's delta input)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            tenants = {
+                name: {"rate_rps": round(rate, 3),
+                       "bytes_per_sec": round(rate * by.mean, 1),
+                       "bytes": by.summary(),
+                       "delay": dl.summary(),
+                       "gap": gap.summary(),
+                       "provisioned_rate": prov}
+                for name, rate, by, dl, gap, prov
+                in self._demand_rows_locked(now)}
+        return {"alpha": _sketch.alpha(), "window": _sketch.window(),
+                "tenants": tenants}
+
+    def export_demand_metrics(self, now: float | None = None) -> None:
+        """Set ``workload_tenant_*`` gauges from the demand sketches.
+        The gateways call this while rendering /metrics, so per-tenant
+        demand rides the existing federation to the master's workload
+        aggregator instead of needing a new wire."""
+        if not _sketch.enabled():
+            return
+        now = time.time() if now is None else now
+        with self._lock:
+            rows = self._demand_rows_locked(now)
+        for name, rate, by, dl, _gap, prov in rows:
+            lab = {"tenant": name}
+            metrics.gauge_set("workload_tenant_rate_rps", rate,
+                              labels=lab)
+            metrics.gauge_set("workload_tenant_bytes_per_sec",
+                              rate * by.mean, labels=lab)
+            metrics.gauge_set("workload_tenant_provisioned_rate",
+                              prov, labels=lab)
+            for q in ("0.5", "0.9", "0.99"):
+                metrics.gauge_set("workload_tenant_bytes",
+                                  by.quantile(float(q)),
+                                  labels={"tenant": name, "q": q})
+                metrics.gauge_set("workload_tenant_delay_seconds",
+                                  dl.quantile(float(q)),
+                                  labels={"tenant": name, "q": q})
+
     # -- introspection --------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -311,6 +407,7 @@ class QosRegistry:
             self._priority.clear()
             self._admitted.clear()
             self._shed.clear()
+            self._demand.clear()
 
 
 def _clean_tenant(raw: str) -> str:
@@ -342,6 +439,18 @@ def enabled() -> bool:
 
 def snapshot() -> dict:
     return _registry.snapshot()
+
+
+def record_demand(tenant: str, cost: int, wait: float) -> None:
+    _registry.record_demand(tenant, cost, wait)
+
+
+def demand_snapshot(now: float | None = None) -> dict:
+    return _registry.demand_snapshot(now)
+
+
+def export_demand_metrics(now: float | None = None) -> None:
+    _registry.export_demand_metrics(now)
 
 
 def reset() -> None:
@@ -395,21 +504,28 @@ def aiohttp_middleware(service: str, tenant_of):
 
     from . import retry
 
-    _SKIP_PATHS = {"/metrics", "/debug/traces", "/debug/breakers",
-                   "/debug/qos", "/debug/ec", "/status", "/healthz"}
+    _SKIP_PATHS = {"/metrics", "/debug", "/status", "/healthz"}
     # filer control-plane prefixes: lock manager, KV config store and
     # the metadata subscription feed serve the cluster itself — QoS
-    # shaping there would rate-limit identity reloads by tenant "kv"
-    _SKIP_PREFIXES = ("/dlm/", "/kv/", "/ws/")
+    # shaping there would rate-limit identity reloads by tenant "kv".
+    # All /debug/* pages ride the same exemption (they ARE the
+    # instruments; shaping or sketching them would distort the read).
+    _SKIP_PREFIXES = ("/dlm/", "/kv/", "/ws/", "/debug/")
 
     @web.middleware
     async def middleware(request, handler):
-        if not _registry.enabled or request.path in _SKIP_PATHS or \
+        if request.path in _SKIP_PATHS or \
                 request.path.startswith(_SKIP_PREFIXES):
             return await handler(request)
+        tenant = tenant_of(request)
         cost = request.content_length or 0
-        adm = _registry.admit(tenant_of(request), cost,
-                              retry.remaining())
+        if not _registry.enabled:
+            # shaping off: still sketch the tenant's demand — the
+            # workload plane must characterize traffic before QoS is
+            # ever enabled (advisors bootstrap from exactly this)
+            _registry.record_demand(tenant, cost, 0.0)
+            return await handler(request)
+        adm = _registry.admit(tenant, cost, retry.remaining())
         if not adm.admitted:
             return web.json_response(
                 {"error": "per-tenant rate exceeded",
@@ -418,6 +534,7 @@ def aiohttp_middleware(service: str, tenant_of):
                 headers={retry.RETRYABLE_HEADER: "1",
                          "Retry-After": str(max(1, int(math.ceil(
                              adm.retry_after))))})
+        _registry.record_demand(adm.tenant, cost, adm.wait)
         if adm.wait > 0:
             await asyncio.sleep(adm.wait)
         return await handler(request)

@@ -1,0 +1,246 @@
+"""Quantile-sketch substrate of the workload telemetry plane
+(utils/sketch.py): relative-error guarantee vs an exact numpy oracle
+on adversarial stream shapes, bucket-exact merge ≡ concatenation,
+lossless serialization round-trip, sliding-window expiry, and the
+-telemetry.* config surface."""
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.utils import sketch as _sketch
+from seaweedfs_tpu.utils.sketch import QuantileSketch, WindowedSketch
+
+QS = (0.5, 0.9, 0.99)
+
+
+def _stream_uniform(rng, n=20000):
+    return [rng.uniform(1e-6, 1e6) for _ in range(n)]
+
+
+def _stream_bimodal(rng, n=20000):
+    # cache-hit/cache-miss shape: two tight modes 5 decades apart
+    return [rng.gauss(1e-3, 1e-4) if rng.random() < 0.7
+            else rng.gauss(2e2, 10.0) for _ in range(n)]
+
+
+def _stream_heavy_tail(rng, n=20000):
+    # lognormal spanning ~8 decades — the gap/size regime sketches
+    # exist for
+    return [math.exp(rng.gauss(3.0, 2.0)) for _ in range(n)]
+
+
+def _stream_phase_shift(rng, n=20000):
+    # workload changes its mind mid-stream: small sizes then large
+    return ([abs(rng.gauss(4e3, 1e3)) for _ in range(n // 2)]
+            + [abs(rng.gauss(4e6, 1e6)) for _ in range(n - n // 2)])
+
+
+STREAMS = [_stream_uniform, _stream_bimodal, _stream_heavy_tail,
+           _stream_phase_shift]
+
+
+def _assert_within_alpha(sk, values, alpha):
+    # the sketch's rank walk returns the bucket holding the order
+    # statistic at floor(q*(n-1)) — compare against that element
+    # (method="lower"), not numpy's default linear interpolation,
+    # which invents values inside empty gaps between modes
+    arr = np.asarray(values, dtype=float)
+    for q in QS:
+        exact = float(np.quantile(arr, q, method="lower"))
+        got = sk.quantile(q)
+        assert got == pytest.approx(exact, rel=alpha), \
+            f"q={q}: sketch {got} vs exact {exact}"
+
+
+class TestAccuracy:
+    @pytest.mark.parametrize("make", STREAMS,
+                             ids=[f.__name__[8:] for f in STREAMS])
+    def test_quantiles_within_documented_alpha(self, make):
+        rng = random.Random(17)
+        values = make(rng)
+        sk = QuantileSketch(alpha=0.01)
+        for v in values:
+            sk.record(v)
+        _assert_within_alpha(sk, values, sk.alpha)
+
+    def test_tighter_alpha_is_honored(self):
+        rng = random.Random(5)
+        values = _stream_heavy_tail(rng, n=8000)
+        # alpha=0.001 over 7 decades wants ~8k buckets; raise the cap
+        # so collapse doesn't blur the quantiles under test
+        sk = QuantileSketch(alpha=0.001, max_buckets=20000)
+        for v in values:
+            sk.record(v)
+        _assert_within_alpha(sk, values, sk.alpha)
+
+    def test_mean_min_max_exact(self):
+        rng = random.Random(9)
+        values = _stream_uniform(rng, n=2000)
+        sk = QuantileSketch()
+        for v in values:
+            sk.record(v)
+        assert sk.count == len(values)
+        assert sk.mean == pytest.approx(np.mean(values), rel=1e-9)
+        assert sk.min == pytest.approx(min(values))
+        assert sk.max == pytest.approx(max(values))
+
+    def test_zeros_and_negatives_land_in_zero_bucket(self):
+        sk = QuantileSketch()
+        for v in (0.0, -1.5, 0.0, 1e-12):
+            sk.record(v)
+        sk.record(10.0)
+        assert sk.count == 5
+        assert sk.zeros == 4
+        assert sk.quantile(0.5) == 0.0
+        assert sk.quantile(1.0) == pytest.approx(10.0, rel=sk.alpha)
+
+    def test_fraction_below_tracks_cdf(self):
+        sk = QuantileSketch()
+        values = [float(i) for i in range(1, 1001)]
+        for v in values:
+            sk.record(v)
+        assert sk.fraction_below(0.0) == 0.0
+        assert sk.fraction_below(500.0) == pytest.approx(0.5, abs=0.02)
+        assert sk.fraction_below(2000.0) == 1.0
+
+    def test_empty_sketch_reads_zero(self):
+        sk = QuantileSketch()
+        assert sk.quantile(0.99) == 0.0
+        assert sk.mean == 0.0
+        assert sk.fraction_below(1.0) == 0.0
+        assert sk.summary() == {"count": 0, "mean": 0.0}
+
+    def test_bucket_cap_degrades_low_quantiles_only(self):
+        # 1e-6 .. 1e12 at alpha=0.01 wants ~2000 buckets; the cap
+        # folds the smallest together but p90/p99 keep the guarantee
+        rng = random.Random(23)
+        values = [10 ** rng.uniform(-6, 12) for _ in range(30000)]
+        sk = QuantileSketch(alpha=0.01, max_buckets=256)
+        for v in values:
+            sk.record(v)
+        assert len(sk.buckets) <= 256
+        arr = np.asarray(values)
+        for q in (0.9, 0.99):
+            exact = float(np.quantile(arr, q))
+            assert sk.quantile(q) == pytest.approx(exact, rel=sk.alpha)
+
+    def test_alpha_validation(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=0.0)
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=1.0)
+
+
+class TestMergeSerialize:
+    def test_merge_equals_concatenated_stream(self):
+        # the federation contract: bucket-wise addition is EXACTLY the
+        # sketch of the concatenated stream, not merely error-bounded
+        rng = random.Random(31)
+        a_vals = _stream_bimodal(rng, n=5000)
+        b_vals = _stream_heavy_tail(rng, n=5000)
+        a, b, both = (QuantileSketch() for _ in range(3))
+        for v in a_vals:
+            a.record(v)
+            both.record(v)
+        for v in b_vals:
+            b.record(v)
+            both.record(v)
+        a.merge(b)
+        assert a.buckets == both.buckets
+        assert a.zeros == both.zeros
+        assert a.count == both.count
+        assert a.total == pytest.approx(both.total, rel=1e-9)
+        assert (a.min, a.max) == (both.min, both.max)
+
+    def test_merge_alpha_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=0.01).merge(QuantileSketch(alpha=0.02))
+
+    def test_merge_into_empty_and_from_empty(self):
+        src = QuantileSketch()
+        src.record(5.0)
+        dst = QuantileSketch()
+        dst.merge(src)
+        assert dst.count == 1
+        dst.merge(QuantileSketch())
+        assert dst.count == 1
+
+    def test_round_trip_is_lossless(self):
+        rng = random.Random(41)
+        sk = QuantileSketch()
+        for v in _stream_phase_shift(rng, n=4000) + [0.0, -2.0]:
+            sk.record(v)
+        d = json.loads(json.dumps(sk.to_dict()))  # through real JSON
+        back = QuantileSketch.from_dict(d)
+        assert back.buckets == sk.buckets
+        assert back.zeros == sk.zeros and back.count == sk.count
+        assert back.to_dict() == sk.to_dict()
+        for q in QS:
+            assert back.quantile(q) == sk.quantile(q)
+
+    def test_empty_encoding_is_tiny(self):
+        d = QuantileSketch().to_dict()
+        assert d == {"a": _sketch.DEFAULT_ALPHA, "n": 0}
+        assert QuantileSketch.from_dict(d).count == 0
+
+
+class TestWindowed:
+    def test_window_expiry_forgets_old_phase(self):
+        w = WindowedSketch(window=60.0, slices=6)
+        for i in range(100):
+            w.record(1.0, now=1000.0 + i * 0.1)  # old phase: ~1.0
+        for i in range(100):
+            w.record(500.0, now=2000.0 + i * 0.1)  # new phase: ~500
+        m = w.merged(now=2010.0)
+        assert m.count == 100  # old slices aged out entirely
+        assert m.quantile(0.5) == pytest.approx(500.0, rel=m.alpha)
+
+    def test_partial_overlap_keeps_recent_slices(self):
+        w = WindowedSketch(window=60.0, slices=6)
+        w.record(1.0, now=100.0)
+        w.record(2.0, now=130.0)
+        # 45 s later the first slice (10 s long) has aged out, the
+        # second is still inside the trailing window
+        m = w.merged(now=175.0)
+        assert m.count == 1
+        assert m.max == 2.0
+
+    def test_to_dict_matches_merged(self):
+        w = WindowedSketch()
+        w.record(3.0, now=50.0)
+        assert w.to_dict(now=50.0) == w.merged(now=50.0).to_dict()
+
+
+class TestConfig:
+    @pytest.fixture(autouse=True)
+    def _restore(self):
+        en, al, wi = (_sketch.enabled(), _sketch.alpha(),
+                      _sketch.window())
+        yield
+        _sketch.configure(enabled=en, alpha=al, window=wi)
+
+    def test_configure_round_trip(self):
+        _sketch.configure(enabled=False, alpha=0.05, window=120.0)
+        assert _sketch.enabled() is False
+        assert _sketch.alpha() == 0.05
+        assert _sketch.window() == 120.0
+        w = _sketch.windowed()
+        assert w.alpha == 0.05 and w.window == 120.0
+
+    def test_none_leaves_unchanged(self):
+        _sketch.configure(alpha=0.02)
+        _sketch.configure()  # all None
+        assert _sketch.alpha() == 0.02
+
+    def test_bad_alpha_rejected(self):
+        with pytest.raises(ValueError):
+            _sketch.configure(alpha=1.5)
+        with pytest.raises(ValueError):
+            _sketch.configure(alpha=0.0)
+
+    def test_window_floor(self):
+        _sketch.configure(window=0.001)
+        assert _sketch.window() == 1.0

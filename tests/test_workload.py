@@ -1,0 +1,476 @@
+"""Workload-characterization telemetry plane end to end: the volume
+store's heat taps and heartbeat payload (storage/store.py), the
+gateway's tenant-demand sketches (utils/qos.py), the master-side
+aggregator + recommend-only advisors (master/workload.py), the
+/debug/workload + /debug-index + trace-alias endpoints
+(server/master_server.py), and federation staleness/up gauges
+(master/collector.py)."""
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.master.collector import MetricsFederator
+from seaweedfs_tpu.master.workload import WorkloadAggregator
+from seaweedfs_tpu.rpc.http import ServerThread
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.utils import metrics
+from seaweedfs_tpu.utils import qos as _qos
+from seaweedfs_tpu.utils import sketch as _sketch
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Pin telemetry config; restore whatever the session had."""
+    en, al, wi = _sketch.enabled(), _sketch.alpha(), _sketch.window()
+    _sketch.configure(enabled=True, alpha=0.01, window=300.0)
+    yield
+    _sketch.configure(enabled=en, alpha=al, window=wi)
+
+
+def _sk(values, alpha=0.01):
+    s = _sketch.QuantileSketch(alpha=alpha)
+    for v in values:
+        s.record(v)
+    return s.to_dict()
+
+
+def _payload(gaps=(), sizes=(), fg=0.0, peak=0.0, vid="1"):
+    kinds = {}
+    if gaps:
+        kinds["rg"] = _sk(gaps)
+    if sizes:
+        kinds["rs"] = _sk(sizes)
+    return {"alpha": 0.01, "volumes": {vid: kinds} if kinds else {},
+            "fg_bps": fg, "peak_bps": peak}
+
+
+class _FakeFederator:
+    def __init__(self, texts=()):
+        import threading
+        self._lock = threading.Lock()
+        self._scraped = {f"gw:{i}": {"text": t, "ts": time.time(),
+                                     "error": ""}
+                         for i, t in enumerate(texts)}
+
+
+class _FakeMaster:
+    """Just the attributes the aggregator reads."""
+
+    class tiering:
+        seal_after_idle = 3600.0
+
+    class watchdog:
+        max_bytes_per_sec = 0.0
+
+    def __init__(self, texts=()):
+        self.federator = _FakeFederator(texts)
+
+
+# ---------------------------------------------------------------------
+# aggregator: ingest, merge, advisors, overrides
+# ---------------------------------------------------------------------
+
+
+class TestAggregator:
+    def test_ingest_and_seal_advisor(self):
+        agg = WorkloadAggregator(_FakeMaster(), seal_quantile=0.9,
+                                 headroom=1.5)
+        # two nodes, gap distributions around 100 s
+        agg.ingest("n1", _payload(gaps=[100.0] * 90 + [1000.0] * 10))
+        agg.ingest("n2", _payload(gaps=[100.0] * 100, vid="2"))
+        snap = agg.snapshot()
+        assert snap["nodes"]["n1"]["volumes"] == 1
+        assert snap["cluster"]["read_gap"]["count"] == 200
+        adv = snap["advisors"]["seal"]
+        assert adv["current"] == 3600.0
+        # p90 of the merged gaps ~ 100 s; × 1.5 headroom
+        assert adv["recommended"] == pytest.approx(150.0, rel=0.05)
+        assert adv["effective"] == adv["recommended"]
+        assert adv["delta"] == pytest.approx(
+            adv["recommended"] - 3600.0, abs=0.01)
+        assert 0.0 < adv["coverage"] <= 1.0
+
+    def test_per_volume_views_merge_across_nodes(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", _payload(sizes=[4096.0] * 50, vid="7"))
+        agg.ingest("n2", _payload(sizes=[4096.0] * 30, vid="7"))
+        vols = agg.snapshot()["volumes"]
+        assert vols["7"]["read_size"]["count"] == 80
+
+    def test_stale_node_excluded_from_merge_but_shown(self):
+        agg = WorkloadAggregator(_FakeMaster(), stale_after=5.0)
+        agg.ingest("old", _payload(gaps=[10.0] * 20))
+        agg._nodes["old"]["at"] = time.time() - 60.0  # age it
+        agg.ingest("fresh", _payload(gaps=[99.0] * 20, vid="2"))
+        snap = agg.snapshot()
+        assert snap["nodes"]["old"]["stale"] is True
+        assert snap["nodes"]["fresh"]["stale"] is False
+        assert snap["cluster"]["read_gap"]["count"] == 20  # fresh only
+
+    def test_forget_drops_node(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", _payload(gaps=[1.0]))
+        agg.forget("n1")
+        assert agg.snapshot()["nodes"] == {}
+
+    def test_junk_payloads_ignored(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", "not a dict")
+        agg.ingest("n2", {"volumes": {"1": {"rg": "junk",
+                                            "zz": {"a": 0.01}}}})
+        snap = agg.snapshot()
+        assert "n1" not in snap["nodes"]
+        assert snap["nodes"]["n2"]["volumes"] == 0
+
+    def test_repair_advisor_min_slack_across_nodes(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", _payload(fg=100.0, peak=1000.0))
+        agg.ingest("n2", _payload(fg=700.0, peak=1000.0, vid="2"))
+        adv = agg.snapshot()["advisors"]["repair"]
+        # n2 is the bottleneck: only 300 B/s of idle headroom
+        assert adv["recommended"] == 300.0
+        assert adv["node_slack"] == {"n1": 900.0, "n2": 300.0}
+
+    def test_repair_advisor_no_data(self):
+        adv = WorkloadAggregator(
+            _FakeMaster()).snapshot()["advisors"]["repair"]
+        assert adv["recommended"] is None
+        assert adv["effective"] is None
+
+    def test_tenant_demand_folds_federated_scrapes(self):
+        # rates SUM across gateways; provisioned + quantiles take MAX
+        t1 = ('workload_tenant_rate_rps{tenant="acme"} 10\n'
+              'workload_tenant_bytes_per_sec{tenant="acme"} 1000\n'
+              'workload_tenant_provisioned_rate{tenant="acme"} 500\n'
+              'workload_tenant_bytes{tenant="acme",q="0.99"} 4096\n')
+        t2 = ('workload_tenant_rate_rps{tenant="acme",'
+              'instance="gw:1"} 5\n'
+              'workload_tenant_bytes_per_sec{tenant="acme",'
+              'instance="gw:1"} 200\n'
+              'workload_tenant_provisioned_rate{tenant="acme",'
+              'instance="gw:1"} 400\n'
+              'workload_tenant_delay_seconds{tenant="acme",'
+              'q="0.5"} 0.02\n')
+        agg = WorkloadAggregator(_FakeMaster(texts=[t1, t2]),
+                                 headroom=2.0)
+        demand = agg.tenant_demand()
+        assert demand["acme"]["rate_rps"] == 15.0
+        assert demand["acme"]["bytes_per_sec"] == 1200.0
+        assert demand["acme"]["provisioned_rate"] == 500.0
+        assert demand["acme"]["bytes"]["0.99"] == 4096.0
+        assert demand["acme"]["delay"]["0.5"] == 0.02
+        adv = agg.snapshot()["advisors"]["qos"]
+        row = adv["tenants"]["acme"]
+        assert row["recommended"] == 2400.0  # 1200 × headroom
+        assert row["current"] == 500.0
+        assert row["delta"] == 1900.0
+
+    def test_overrides_win_in_effective(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", _payload(gaps=[10.0] * 50))
+        out = agg.set_override("seal", 7200.0)
+        assert out == {"advisor": "seal", "tenant": "",
+                       "override": 7200.0}
+        adv = agg.snapshot()["advisors"]["seal"]
+        assert adv["override"] == 7200.0
+        assert adv["effective"] == 7200.0
+        assert adv["recommended"] != 7200.0  # recommendation unchanged
+        # clear with null: back to recommendation
+        agg.set_override("seal", None)
+        adv = agg.snapshot()["advisors"]["seal"]
+        assert "override" not in adv
+        assert adv["effective"] == adv["recommended"]
+
+    def test_per_tenant_qos_override(self):
+        t = ('workload_tenant_rate_rps{tenant="acme"} 1\n'
+             'workload_tenant_bytes_per_sec{tenant="acme"} 100\n'
+             'workload_tenant_provisioned_rate{tenant="acme"} 50\n')
+        agg = WorkloadAggregator(_FakeMaster(texts=[t]))
+        agg.set_override("qos", 999.0, tenant="acme")
+        row = agg.snapshot()["advisors"]["qos"]["tenants"]["acme"]
+        assert row["override"] == 999.0 and row["effective"] == 999.0
+
+    def test_override_validation(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        with pytest.raises(ValueError):
+            agg.set_override("bogus", 1.0)
+        with pytest.raises(ValueError):
+            agg.set_override("seal", 1.0, tenant="acme")  # qos only
+        with pytest.raises(ValueError):
+            agg.set_override("seal", "not-a-number")
+        with pytest.raises(ValueError):
+            agg.set_override("seal", -5.0)
+        with pytest.raises(ValueError):
+            agg.set_override("seal", float("nan"))
+
+    def test_export_gauges_and_status_fold(self):
+        agg = WorkloadAggregator(_FakeMaster())
+        agg.ingest("n1", _payload(gaps=[10.0] * 50,
+                                  sizes=[4096.0] * 50,
+                                  fg=10.0, peak=100.0))
+        agg.set_override("repair", 42.0)
+        agg.export_gauges()
+        with metrics._lock:
+            g = dict(metrics._gauges)
+        assert g[("workload_nodes_reporting", ())] == 1
+        assert ("workload_read_gap_seconds",
+                (("q", "0.99"),)) in g
+        assert ("workload_read_size_bytes", (("q", "0.5"),)) in g
+        assert g[("workload_advisor_effective",
+                  (("kind", "repair"),))] == 42.0
+        fold = agg.status_fold()
+        assert fold["NodesReporting"] == 1
+        assert fold["Advisors"]["repair"]["Override"] == 42.0
+        assert fold["Advisors"]["seal"]["Recommended"] is not None
+
+
+# ---------------------------------------------------------------------
+# gateway tenant demand (utils/qos.py)
+# ---------------------------------------------------------------------
+
+
+class TestTenantDemand:
+    @pytest.fixture(autouse=True)
+    def _fresh_registry(self):
+        _qos._registry.reset()
+        yield
+        _qos._registry.reset()
+
+    def test_record_and_snapshot(self):
+        for _ in range(20):
+            _qos.record_demand("AKIDEXAMPLE", 4096, 0.01)
+        snap = _qos.demand_snapshot()
+        t = snap["tenants"]["AKIDEXAMPLE"]
+        assert t["bytes"]["count"] == 20
+        assert t["bytes"]["p50"] == pytest.approx(4096, rel=0.02)
+        assert t["delay"]["p90"] == pytest.approx(0.01, rel=0.02)
+        # provisioned_rate reflects config (0 = unprovisioned default)
+        assert t["provisioned_rate"] == 0.0
+        assert snap["alpha"] == _sketch.alpha()
+
+    def test_rate_from_mean_gap(self):
+        reg = _qos.QosRegistry()
+        now = time.time()
+        # synthesize steady 10 rps by driving the sketches directly
+        d = {"gap": _sketch.windowed(), "bytes": _sketch.windowed(),
+             "delay": _sketch.windowed(), "last_at": now}
+        for i in range(50):
+            d["gap"].record(0.1, now)
+            d["bytes"].record(1000, now)
+            d["delay"].record(0.0, now)
+        reg._demand["t"] = d
+        rows = {r[0]: r for r in reg._demand_rows_locked(now)}
+        assert rows["t"][1] == pytest.approx(10.0, rel=0.02)
+        snap = reg.demand_snapshot(now=now)
+        assert snap["tenants"]["t"]["bytes_per_sec"] == pytest.approx(
+            10.0 * 1000, rel=0.05)
+
+    def test_disabled_telemetry_records_nothing(self):
+        _sketch.configure(enabled=False)
+        _qos.record_demand("akid", 100, 0.0)
+        assert _qos.demand_snapshot()["tenants"] == {}
+        _sketch.configure(enabled=True)
+
+    def test_overflow_tenant_bounds_cardinality(self):
+        reg = _qos.QosRegistry()
+        reg.max_tenants = 2
+        for i in range(5):
+            reg.record_demand(f"tenant-{i}", 10, 0.0)
+        snap = reg.demand_snapshot()
+        assert len(snap["tenants"]) <= 3
+        assert _qos.OVERFLOW_TENANT in snap["tenants"]
+
+    def test_export_demand_metrics_gauges(self):
+        for _ in range(5):
+            _qos.record_demand("acme", 2048, 0.005)
+        _qos.export_demand_metrics()
+        with metrics._lock:
+            g = dict(metrics._gauges)
+        assert ("workload_tenant_rate_rps",
+                (("tenant", "acme"),)) in g
+        key = ("workload_tenant_bytes",
+               (("q", "0.99"), ("tenant", "acme")))
+        assert g[key] == pytest.approx(2048, rel=0.02)
+
+
+# ---------------------------------------------------------------------
+# volume store taps -> heartbeat payload
+# ---------------------------------------------------------------------
+
+
+class TestStoreTaps:
+    def test_reads_and_writes_feed_sketches(self, tmp_path):
+        store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+        for _ in range(10):
+            store.record_read(1, nbytes=4096)
+            store.record_write(1, nbytes=1024)
+        p = store.workload_payload()
+        assert p["alpha"] == _sketch.alpha()
+        v = p["volumes"]["1"]
+        assert v["rs"]["n"] == 10
+        assert v["ws"]["n"] == 10
+        # 9 gaps from 10 accesses of each kind
+        assert v["rg"]["n"] == 9 and v["wg"]["n"] == 9
+        assert p["peak_bps"] >= p["fg_bps"] >= 0
+        hb = store.collect_heartbeat()
+        assert hb["workload"]["volumes"]["1"]["rs"]["n"] == 10
+
+    def test_disabled_telemetry_skips_taps_and_heartbeat(self,
+                                                         tmp_path):
+        _sketch.configure(enabled=False)
+        store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+        store.record_read(1, nbytes=4096)
+        assert store.workload_payload()["volumes"] == {}
+        assert "workload" not in store.collect_heartbeat()
+        # heat counters still tick: tiering depends on them
+        assert store.volume_heat(1)["read_count"] == 1
+        _sketch.configure(enabled=True)
+
+    def test_empty_sketches_not_shipped(self, tmp_path):
+        store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+        assert store.workload_payload()["volumes"] == {}
+
+
+# ---------------------------------------------------------------------
+# federation staleness: up gauge + stale-series drop
+# ---------------------------------------------------------------------
+
+
+class TestFederationStaleness:
+    def test_up_gauge_and_stale_drop(self):
+        fed = MetricsFederator(master=None, stale_after=30.0)
+        now = time.time()
+        live = ("# TYPE req_total counter\n"
+                'req_total{code="200"} 5\n')
+        fed._scraped = {
+            "live:1": {"text": live, "ts": now, "error": ""},
+            "dead:2": {"text": live, "ts": now - 300.0, "error": ""},
+        }
+        out = fed.merged()
+        assert 'up{instance="live:1"} 1' in out
+        assert 'up{instance="dead:2"} 0' in out
+        # the dead instance's frozen series are dropped, not re-merged
+        assert 'req_total{instance="live:1",code="200"} 5' in out
+        assert 'instance="dead:2",code="200"' not in out
+        # exactly one TYPE line for the synthetic family
+        assert out.count("# TYPE up gauge") == 1
+
+    def test_never_scraped_is_down(self):
+        fed = MetricsFederator(master=None, stale_after=30.0)
+        fed._scraped = {"gone:9": {"text": "", "ts": 0.0,
+                                   "error": "boom"}}
+        out = fed.merged()
+        assert 'up{instance="gone:9"} 0' in out
+        obs = fed.observability()
+        assert obs["gone:9"]["Up"] is False
+
+    def test_stale_after_defaults_to_3x_interval(self):
+        assert MetricsFederator(master=None,
+                                interval=20.0).stale_after == 60.0
+        # floor of 30 s for fast scrape configs
+        assert MetricsFederator(master=None,
+                                interval=1.0).stale_after == 30.0
+
+
+# ---------------------------------------------------------------------
+# master endpoints (in-process master)
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def master_srv():
+    m = MasterServer(pulse_seconds=0.4, scrape_interval=3600.0)
+    t = ServerThread(m.app).start()
+    yield m, t
+    t.stop()
+
+
+class TestMasterEndpoints:
+    def test_debug_index(self, master_srv):
+        _, t = master_srv
+        body = requests.get(f"{t.url}/debug", timeout=5).json()
+        assert body["service"] == "master"
+        assert "/debug/workload" in body["endpoints"]
+        txt = requests.get(f"{t.url}/debug",
+                           params={"format": "text"}, timeout=5)
+        assert "/debug/workload" in txt.text
+
+    def test_debug_workload_snapshot(self, master_srv):
+        m, t = master_srv
+        m.workload.ingest("vol:1", _payload(gaps=[50.0] * 40,
+                                            fg=10.0, peak=200.0))
+        body = requests.get(f"{t.url}/debug/workload",
+                            timeout=5).json()
+        assert body["telemetry_enabled"] is True
+        assert body["nodes"]["vol:1"]["stale"] is False
+        assert set(body["advisors"]) == {"seal", "qos", "repair"}
+        assert body["advisors"]["repair"]["recommended"] == 190.0
+
+    def test_workload_override_roundtrip(self, master_srv):
+        m, t = master_srv
+        url = f"{t.url}/debug/workload"
+        r = requests.post(url, json={"advisor": "seal",
+                                     "override": 1234.5}, timeout=5)
+        assert r.status_code == 200
+        assert r.json()["override"] == 1234.5
+        adv = requests.get(url, timeout=5).json()["advisors"]["seal"]
+        assert adv["override"] == 1234.5
+        assert adv["effective"] == 1234.5
+        # clear
+        r = requests.post(url, json={"advisor": "seal",
+                                     "override": None}, timeout=5)
+        assert r.status_code == 200
+        assert "override" not in \
+            requests.get(url, timeout=5).json()["advisors"]["seal"]
+
+    def test_workload_override_rejects_bad_bodies(self, master_srv):
+        _, t = master_srv
+        url = f"{t.url}/debug/workload"
+        assert requests.post(url, data=b"not json",
+                             timeout=5).status_code == 400
+        assert requests.post(url, json=[1, 2],
+                             timeout=5).status_code == 400
+        assert requests.post(url, json={"override": 1},
+                             timeout=5).status_code == 400
+        assert requests.post(url, json={"advisor": "seal"},
+                             timeout=5).status_code == 400
+        assert requests.post(url, json={"advisor": "nope",
+                                        "override": 1},
+                             timeout=5).status_code == 400
+        assert requests.post(url, json={"advisor": "seal",
+                                        "override": -1},
+                             timeout=5).status_code == 400
+
+    def test_workload_gauges_in_metrics(self, master_srv):
+        m, t = master_srv
+        m.workload.ingest("vol:1", _payload(gaps=[50.0] * 40))
+        body = requests.get(f"{t.url}/metrics", timeout=5).text
+        assert "workload_nodes_reporting" in body
+        assert 'workload_read_gap_seconds{q="0.99"}' in body
+
+    def test_workload_in_cluster_status(self, master_srv):
+        _, t = master_srv
+        wl = requests.get(f"{t.url}/cluster/status",
+                          timeout=5).json()["Workload"]
+        assert "Advisors" in wl and "NodesReporting" in wl
+        assert set(wl["Advisors"]) == {"seal", "qos", "repair"}
+
+    def test_trace_query_alias(self, master_srv):
+        m, t = master_srv
+        from seaweedfs_tpu.utils import tracing
+        tid = tracing.new_trace_id()
+        m.collector.add_spans("i", "s3", [{
+            "trace_id": tid, "span_id": tracing.new_span_id(),
+            "parent_id": "", "service": "s3", "name": "op",
+            "kind": "server", "peer": "", "start": time.time(),
+            "duration": 0.01, "status": "200"}])
+        # ?trace= is an alias for ?trace_id=
+        tree = requests.get(f"{t.url}/cluster/traces",
+                            params={"trace": tid}, timeout=5).json()
+        assert tree["spans"] == 1
+        r = requests.get(f"{t.url}/cluster/traces",
+                         params={"trace": "f" * 32}, timeout=5)
+        assert r.status_code == 404
+        assert "error" in r.json()
