@@ -1770,6 +1770,249 @@ def bench_repair_sweep(argv: list[str]) -> int:
     return 0
 
 
+def bench_code_sweep(argv: list[str]) -> int:
+    """`python bench.py code-sweep [--codes 10.4,lrc-12.3.2]
+    [--out BENCH_CODES.json]`
+
+    The ISSUE-14 code-family comparison: for each registered code the
+    sweep measures (a) CPU encode throughput plus the bit-plane
+    scheduler's XOR saving, (b) single-shard repair bytes and wall
+    time through the real cluster rebuild paths — partial-stripe
+    (plan-driven for LRC) AND classic full-stripe — on the
+    repair_read_bytes_total{mode} counters, and (c) recovery from a
+    whole-rack kill (one rack per node, the largest loss the code
+    tolerates).  The summary reports LRC's byte saving against both
+    RS(10,4) baselines; the per-code router buckets are recorded so
+    the auto-router's per-code decisions are auditable."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.ec import backend as ecb
+    from seaweedfs_tpu.ec import geometry as ecgeo
+    from seaweedfs_tpu.operation import verbs
+    from seaweedfs_tpu.ops import rs_matrix, schedule
+    from seaweedfs_tpu.server.cluster import Cluster
+    from seaweedfs_tpu.shell import commands_ec
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.utils import metrics, ratelimit
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    codes = opt("--codes", "10.4,lrc-12.3.2").split(",")
+    out_path = opt("--out", "BENCH_CODES.json")
+
+    def counter(name: str, mode: str | None = None) -> float:
+        labels = (("mode", mode),) if mode else ()
+        with metrics._lock:
+            return metrics._counters.get((name, labels), 0.0)
+
+    def encode_row(spec: str) -> dict:
+        code = ecgeo.parse_code(spec)
+        name = ecb.cpu_backend_name()
+        rs = ecb.ReedSolomon.for_codec(spec, backend=name)
+        rng = np.random.default_rng(14)
+        blk = rng.integers(0, 256, (code.k, (8 << 20) // code.k),
+                           dtype=np.uint8)
+        rs.encode(blk)  # warm: native lib load, schedule build
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rs.encode(blk)
+        mbps = reps * blk.nbytes / (time.perf_counter() - t0) / 1e6
+        return {"backend": name, "encode_mbps": round(mbps, 1),
+                "schedule": schedule.summary_for(
+                    rs_matrix.parity_rows_for(code))}
+
+    def fill_volume(c, collection: str) -> tuple["CommandEnv", int]:
+        env = CommandEnv(c.master_url)
+        env.acquire_lock()
+        rng = np.random.default_rng(3)
+        a0 = verbs.assign(c.master_url, collection=collection)
+        vid = int(a0.fid.split(",")[0])
+        verbs.upload(a0, rng.bytes(40_000))
+        for _ in range(29):
+            a = verbs.assign(c.master_url, collection=collection)
+            if int(a.fid.split(",")[0]) == vid:
+                verbs.upload(a, rng.bytes(40_000))
+        return env, vid
+
+    def single_shard_repair(spec: str) -> dict:
+        """Drop ONE data shard, rebuild through the partial path (the
+        plan's fan-in for LRC, k reads for RS), drop it again, rebuild
+        full-stripe — both byte counts from the same counters PR 7
+        established."""
+        ratelimit.reset()
+        tmp = tempfile.mkdtemp(prefix="code_sweep_ec_")
+        c = Cluster(tmp, n_volume_servers=3,
+                    volume_size_limit=4 << 20, max_volumes=40)
+        try:
+            env, vid = fill_volume(c, "codebench")
+            commands_ec.ec_encode(env, vid, codec=spec)
+            code = ecgeo.parse_code(spec)
+            plan = code.repair_plan(
+                [3], [s for s in range(code.total) if s != 3])
+
+            def drop(sid: int) -> None:
+                for url in env.ec_shard_locations(vid).get(sid, []):
+                    env.vs_post(url, "/admin/ec/delete",
+                                {"volume": vid, "shard_ids": [sid]})
+
+            drop(3)
+            p0 = counter("repair_read_bytes_total", "partial")
+            t0 = time.monotonic()
+            commands_ec.ec_rebuild(env, vid, partial=True)
+            t_partial = time.monotonic() - t0
+            partial = counter("repair_read_bytes_total", "partial") - p0
+            drop(3)
+            f0 = counter("repair_read_bytes_total", "full")
+            t0 = time.monotonic()
+            commands_ec.ec_rebuild(env, vid, partial=False)
+            t_full = time.monotonic() - t0
+            full = counter("repair_read_bytes_total", "full") - f0
+            return {
+                "plan_kind": plan.kind if plan else None,
+                "plan_fanin": plan.fanin if plan else None,
+                "partial_read_bytes": int(partial),
+                "partial_seconds": round(t_partial, 3),
+                "full_read_bytes": int(full),
+                "full_seconds": round(t_full, 3),
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def rack_kill(spec: str) -> dict:
+        """One rack per node, 6 racks; kill the rack holding the MOST
+        shards the code can still tolerate and time the rebuild of
+        everything it held."""
+        ratelimit.reset()
+        tmp = tempfile.mkdtemp(prefix="code_sweep_rack_")
+        topology = [("dc1", f"r{i}") for i in range(6)]
+        c = Cluster(tmp, n_volume_servers=6, pulse_seconds=0.3,
+                    volume_size_limit=4 << 20, max_volumes=40,
+                    topology=topology)
+        try:
+            env, vid = fill_volume(c, "rackbench")
+            commands_ec.ec_encode(env, vid, codec=spec)
+            code = ecgeo.parse_code(spec)
+            locs = env.ec_shard_locations(vid)
+            held: dict[str, list[int]] = {}
+            for sid, urls in locs.items():
+                for url in urls:
+                    held.setdefault(url, []).append(sid)
+            # largest rack loss the code tolerates (rank check, not a
+            # count: an LRC group + its local parity may not solve)
+            victims = sorted(
+                (u for u in held
+                 if code.recoverable(set(locs) - set(held[u]))),
+                key=lambda u: len(held[u]), reverse=True)
+            victim = victims[0]
+            lost = sorted(held[victim])
+            idx = next(i for i, s in enumerate(c.stores)
+                       if s.public_url == victim)
+            p0 = counter("repair_read_bytes_total", "partial")
+            f0 = counter("repair_read_bytes_total", "full")
+            t0 = time.monotonic()
+            c.volume_threads[idx].stop()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                live = env.ec_shard_locations(vid)
+                if all(victim not in live.get(s, []) for s in lost):
+                    break
+                time.sleep(0.1)
+            commands_ec.ec_rebuild(env, vid)
+            secs = time.monotonic() - t0
+            read = (counter("repair_read_bytes_total", "partial") - p0
+                    + counter("repair_read_bytes_total", "full") - f0)
+            healed = env.ec_shard_locations(vid)
+            return {
+                "shards_lost": len(lost),
+                "recovery_seconds": round(secs, 3),
+                "repair_read_bytes": int(read),
+                "shards_after": sum(1 for s in range(code.total)
+                                    if healed.get(s)),
+                "total_shards": code.total,
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    rows: dict[str, dict] = {}
+    for spec in codes:
+        code = ecgeo.parse_code(spec)
+        row: dict = {"code": code.describe()}
+        row.update(encode_row(spec))
+        log(f"code-sweep {spec}: encode {row['encode_mbps']} MB/s "
+            f"({row['backend']}, xor saving "
+            f"{row['schedule']['saving']})")
+        row["single_shard"] = single_shard_repair(spec)
+        ss = row["single_shard"]
+        log(f"code-sweep {spec}: single-shard partial "
+            f"{ss['partial_read_bytes']} B in {ss['partial_seconds']}s "
+            f"(fan-in {ss['plan_fanin']}), full "
+            f"{ss['full_read_bytes']} B in {ss['full_seconds']}s")
+        row["rack_kill"] = rack_kill(spec)
+        rk = row["rack_kill"]
+        log(f"code-sweep {spec}: rack kill lost {rk['shards_lost']} "
+            f"shards, recovered in {rk['recovery_seconds']}s "
+            f"({rk['repair_read_bytes']} B read)")
+        # per-code router state: measured CPU/device curves drive the
+        # per-size backend choice; recorded so the decision is auditable
+        ecb.choose_backend_for_size(1 << 20, spec)
+        rows[spec] = row
+
+    summary: dict = {}
+    lrc = next((s for s in codes if ecgeo.parse_code(s).kind == "lrc"),
+               None)
+    rs_spec = next((s for s in codes
+                    if ecgeo.parse_code(s).spec == "10.4"), None)
+    if lrc and rs_spec:
+        lrc_b = rows[lrc]["single_shard"]["partial_read_bytes"]
+        summary = {
+            "lrc": lrc,
+            "lrc_repair_read_bytes": lrc_b,
+            "rs_full_read_bytes":
+                rows[rs_spec]["single_shard"]["full_read_bytes"],
+            "rs_partial_read_bytes":
+                rows[rs_spec]["single_shard"]["partial_read_bytes"],
+            "bytes_vs_rs_full": round(
+                rows[rs_spec]["single_shard"]["full_read_bytes"]
+                / lrc_b, 2) if lrc_b else None,
+            "bytes_vs_rs_partial": round(
+                rows[rs_spec]["single_shard"]["partial_read_bytes"]
+                / lrc_b, 2) if lrc_b else None,
+        }
+        log(f"code-sweep summary: LRC single-shard repair reads "
+            f"{summary['bytes_vs_rs_full']}x fewer bytes than RS full "
+            f"rebuild, {summary['bytes_vs_rs_partial']}x fewer than "
+            f"the partial-stripe path")
+    snap = ecb.probe_snapshot()
+    result = {
+        "bench": "code-sweep",
+        "scenario": "in-process clusters; single-shard repair on 3 "
+                    "nodes, rack kill on 6 nodes / 6 racks (one rack "
+                    "per node, largest tolerable rack chosen)",
+        "codes": rows,
+        "summary": summary,
+        "router": {"default_code": snap["default_code"],
+                   "code_buckets": snap["code_buckets"]},
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "code_sweep_lrc_vs_rs_full_bytes",
+        "value": summary.get("bytes_vs_rs_full"),
+        "unit": "x",
+        "extra": summary,
+        "out": out_path,
+    }), flush=True)
+    return 0
+
+
 def bench_tier_sweep(argv: list[str]) -> int:
     """`python bench.py tier-sweep [--caps 0,1000000,500000]
     [--out BENCH_TIER.json]`
@@ -2467,6 +2710,8 @@ if __name__ == "__main__":
         sys.exit(bench_mesh_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "repair-sweep":
         sys.exit(bench_repair_sweep(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "code-sweep":
+        sys.exit(bench_code_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "qos-sweep":
         sys.exit(bench_qos_sweep(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "workload-sweep":

@@ -2,11 +2,11 @@
 
 Every robustness CLI knob (-repair.*, -fault.*, -retry.*, -qos.*,
 -filer.store.*, -filer.cache.*, -filer.native*, -tier.*,
--telemetry.*, -advisor.*) registered in cli.py must carry non-empty
-help text — these flags gate chaos / repair / overload /
-metadata-plane / tiering / native-front / workload-telemetry
-behaviour and an undocumented one is effectively invisible to
-operators.
+-telemetry.*, -advisor.*, -ec.*) registered in cli.py must carry
+non-empty help text — these flags gate chaos / repair / overload /
+metadata-plane / tiering / native-front / workload-telemetry /
+erasure-code behaviour and an undocumented one is effectively
+invisible to operators.
 """
 from __future__ import annotations
 
@@ -16,7 +16,7 @@ from ..engine import PKG_PREFIX, Rule, register
 
 PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
             "-filer.store.", "-filer.cache.", "-filer.native",
-            "-tier.", "-telemetry.", "-advisor.")
+            "-tier.", "-telemetry.", "-advisor.", "-ec.")
 
 # the documented surface this PR series promises; rot here means a
 # flag was dropped without its docs/tests following
@@ -34,7 +34,8 @@ EXPECTED = (
     "-tier.remote", "-tier.stateDir",
     "-telemetry.enabled", "-telemetry.alpha", "-telemetry.window",
     "-advisor.sealQuantile", "-advisor.demandQuantile",
-    "-advisor.headroom")
+    "-advisor.headroom",
+    "-ec.backend", "-ec.code", "-ec.mesh.devices", "-ec.mesh.col")
 
 
 @register

@@ -30,9 +30,12 @@ FEED_MODULES = (
 )
 
 # drain sites: functions whose contract is "block here" — the staged
-# feed's upload/drain workers and the host readback helpers
+# feed's upload/drain workers, the host readback helpers, and the
+# scheduled-vs-dense measurement probes (run_sched/run_dense time one
+# synchronous kernel each so the chooser compares wall clock, never
+# called on the streaming submit path)
 ALLOWED_SYNC_FUNCS = {"upload", "drain", "finish", "up", "down",
-                      "_readback", "_collect"}
+                      "_readback", "_collect", "run_sched", "run_dense"}
 
 
 def _is_jitted(func: ast.AST) -> bool:

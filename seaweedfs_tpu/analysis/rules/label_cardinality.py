@@ -20,9 +20,12 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 
 # Every key is bounded by construction: enum-like (kind, op, stage,
 # outcome, method, direction, mode, reason), a fixed deployment set
-# (backend, service, handler, collection, instance), HTTP classes
-# (code), the histogram-internal bound (le), or capped by a registry
-# (tenant: -qos.maxTenants + __overflow__; shard: exactly
+# (backend, service, handler, collection, instance), HTTP classes and
+# erasure-code specs (code: status classes on HTTP metrics; on EC
+# metrics the code-family spec, bounded by ec.backend.KNOWN_CODES
+# plus whatever -ec.code names — an operator-chosen constant, not
+# per-request data), the histogram-internal bound (le), or capped by
+# a registry (tenant: -qos.maxTenants + __overflow__; shard: exactly
 # -filer.store.shards values; from/to/tier: the tier-state enum in
 # master/tiering.py; dir: exactly {offload, recall}; q: the fixed
 # quantile points {0.5, 0.9, 0.99} the workload sketches export).

@@ -365,6 +365,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="erasure-coding codec: auto (measured-curve "
                         "router) | native | numpy | jax | pallas | "
                         "mesh (all local devices)")
+    p.add_argument("-ec.code", dest="ec_code", default="",
+                   help="erasure-code family new EC volumes are "
+                        "encoded with: 10.4 (RS default) | 28.4 "
+                        "(wide RS) | lrc-k.l.g e.g. lrc-12.3.2 "
+                        "(k data, l local XOR parities, g global "
+                        "parities; single-shard repair reads one "
+                        "local group instead of k shards); recorded "
+                        "per volume so mixed-code clusters decode "
+                        "correctly")
     p.add_argument("-ec.mesh.devices", dest="ec_mesh_devices",
                    type=int, default=0,
                    help="devices the mesh codec spans "
@@ -449,6 +458,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="erasure-coding codec: auto (measured-curve "
                         "router) | native | numpy | jax | pallas | "
                         "mesh (all local devices)")
+    p.add_argument("-ec.code", dest="ec_code", default="",
+                   help="erasure-code family new EC volumes are "
+                        "encoded with: 10.4 (RS default) | 28.4 "
+                        "(wide RS) | lrc-k.l.g e.g. lrc-12.3.2 "
+                        "(k data, l local XOR parities, g global "
+                        "parities; single-shard repair reads one "
+                        "local group instead of k shards); recorded "
+                        "per volume so mixed-code clusters decode "
+                        "correctly")
     p.add_argument("-ec.mesh.devices", dest="ec_mesh_devices",
                    type=int, default=0,
                    help="devices the mesh codec spans "
@@ -789,6 +807,13 @@ def main(argv: list[str] | None = None) -> int:
             args.ec_mesh_devices)
     if getattr(args, "ec_mesh_col", 0):
         os.environ["SEAWEEDFS_TPU_EC_MESH_COL"] = str(args.ec_mesh_col)
+    # the default code family also travels by env: shell `ec.encode`
+    # (in another process) and the probe fingerprint both consult it
+    if getattr(args, "ec_code", ""):
+        from .ec import geometry as _geo
+
+        _geo.parse_code(args.ec_code)  # fail fast on a bad spec
+        os.environ["SEAWEEDFS_TPU_EC_CODE"] = args.ec_code
     from .utils import faults as _faults
     from .utils import qos as _qos
     from .utils import retry as _retry

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..ops import rs_matrix
 from ..utils import metrics
+from . import geometry as geo
 
 
 def _codec_label(backend) -> str:
@@ -36,18 +37,25 @@ def _codec_label(backend) -> str:
 
 
 def observe_codec(op: str, backend, seconds: float | None = None,
-                  nbytes: int = 0) -> None:
+                  nbytes: int = 0, code: str = "") -> None:
     """Record one codec operation into ec_codec_seconds{op,backend}
     / ec_codec_bytes_total (bytes = input data processed). Either part
     may be skipped (seconds=None / nbytes=0) so streaming paths can
     count bytes at consumption and time at yield without double
-    observations."""
+    observations. When the caller knows its code family, bytes are
+    additionally counted per code (Grafana's "Codes" row charts
+    encode/repair throughput by code without exploding the base
+    series)."""
     lab = {"op": op, "backend": backend if isinstance(backend, str)
            else _codec_label(backend)}
     if seconds is not None:
         metrics.histogram_observe("ec_codec_seconds", seconds, lab)
     if nbytes:
         metrics.counter_add("ec_codec_bytes_total", nbytes, lab)
+        if code:
+            lab2 = {"op": op, "backend": lab["backend"], "code": code}
+            metrics.counter_add("ec_codec_bytes_by_code_total", nbytes,
+                                lab2)
 
 
 class CodecBackend(Protocol):
@@ -143,6 +151,58 @@ _AUTO_ENV = "SEAWEEDFS_TPU_EC_BACKEND"
 _auto_choice: str | None = None
 _auto_probe: dict | None = None
 
+# ----------------------------------------------------------------------
+# code families: registered specs selectable via -ec.code
+# ----------------------------------------------------------------------
+
+_CODE_ENV = "SEAWEEDFS_TPU_EC_CODE"
+
+# the blessed code specs: the RS default, the wide cold-tier RS, and
+# the LRC configs (local XOR groups cut single-loss repair fan-in from
+# k to the group size at a small storage premium, arXiv 1309.0186).
+# Any well-formed spec works with -ec.code; these are the documented,
+# probed and benched ones.
+KNOWN_CODES = ("10.4", "lrc-10.2.2", "lrc-12.3.2", "28.4")
+
+
+def default_code_spec() -> str:
+    """The `-ec.code` process default (env SEAWEEDFS_TPU_EC_CODE):
+    what ec.encode uses when no explicit codec is passed. '' = the
+    classic RS(10,4)."""
+    spec = os.environ.get(_CODE_ENV, "").strip()
+    if not spec:
+        return ""
+    try:
+        geo.parse_code(spec)
+        return spec
+    except (ValueError, TypeError) as e:
+        try:
+            from ..utils import glog
+
+            glog.warning("ignoring %s=%r: %s", _CODE_ENV, spec, e)
+        except Exception:  # pragma: no cover
+            pass
+        return ""
+
+
+def get_code(spec: str = "") -> geo.CodeConfig:
+    """Spec string (as recorded in a volume .vif) -> CodeConfig."""
+    return geo.parse_code(spec or "")
+
+
+def code_table() -> list[dict]:
+    """The registry view for /debug/ec, README and the shell: each
+    known code's structure, storage overhead and repair fan-in. Every
+    backend serves every code (the coefficient matrix is a runtime
+    argument in all of them)."""
+    out = []
+    for spec in KNOWN_CODES:
+        row = get_code(spec).describe()
+        row["backends"] = backend_names()
+        row["default"] = spec == (default_code_spec() or "10.4")
+        out.append(row)
+    return out
+
 
 def _probe_cpu_backend() -> str:
     """Fastest CPU-side codec present: the C++ AVX2 library when it is
@@ -219,21 +279,31 @@ def _decide(curve: dict, nbytes: int) -> str:
     return cpu_name
 
 
-def choose_backend_for_size(nbytes: int) -> str:
-    """Backend for a request of `nbytes`, from the measured size x
-    depth curve (ec/probe.py): interpolate the device e2e rate at this
-    size, compare to the measured CPU rate, pick the winner. Override
-    with env SEAWEEDFS_TPU_EC_BACKEND. First use pays the sweep (or
-    reads the disk cache); after that it is a dict lookup."""
+def _curve_code(code: str) -> str:
+    """Probe-curve key for a code spec: the default RS(10,4) rides the
+    primary curve ('') every existing caller already pays for; any
+    other code gets its own measured curve."""
+    return "" if code in ("", "10.4") else code
+
+
+def choose_backend_for_size(nbytes: int, code: str = "") -> str:
+    """Backend for a request of `nbytes` under code `code`, from the
+    measured size x depth curve (ec/probe.py): interpolate the device
+    e2e rate at this size, compare to the measured CPU rate, pick the
+    winner. Per-code curves keep the router honest — an LRC's wider
+    local rows move the crossover point, so its decision comes from a
+    sweep of ITS coefficient matrix, never the RS one. Override with
+    env SEAWEEDFS_TPU_EC_BACKEND. First use pays the sweep (or reads
+    the disk cache); after that it is a dict lookup."""
     env = _env_override()
     if env is not None:
         return env
     from . import probe
 
-    return _decide(probe.get_curve(), nbytes)
+    return _decide(probe.get_curve(code=_curve_code(code)), nbytes)
 
 
-def pipeline_depth_for(nbytes: int) -> int:
+def pipeline_depth_for(nbytes: int, code: str = "") -> int:
     """Streaming-pipeline depth the measured curve recommends for
     blocks of `nbytes` (2 when nothing is measured — the classic
     double buffer). When the router would send this size to the mesh,
@@ -241,7 +311,7 @@ def pipeline_depth_for(nbytes: int) -> int:
     has its own overlap sweet spot."""
     from . import probe
 
-    curve = probe.peek()
+    curve = probe.peek(code=_curve_code(code))
     if curve is None:
         return 2
     env = _env_override()
@@ -358,7 +428,21 @@ def probe_snapshot() -> dict:
         "cache_path": probe.cache_path(),
         "cache_ttl_s": probe.cache_ttl_s(),
         "mesh": mesh_geometry(),
+        "default_code": default_code_spec() or "10.4",
+        "codes": code_table(),
     }
+    # per-code router state: each known code's measured curve (when
+    # one exists — peek never sweeps) and the bucket choices it yields
+    per_code: dict[str, dict] = {}
+    for spec in KNOWN_CODES:
+        ckey = _curve_code(spec)
+        ccurve = probe.peek(code=ckey)
+        if ccurve is None:
+            per_code[spec] = {"state": "unprobed"}
+        else:
+            per_code[spec] = {"state": "measured",
+                              "buckets": router_buckets(ccurve)}
+    snap["code_buckets"] = per_code
     curve = probe.peek()
     if curve is None:
         snap["probe"] = {"state": "unprobed"}
@@ -394,9 +478,12 @@ class AutoCodec:
 
     name = "auto"
 
-    def __init__(self):
+    def __init__(self, code_spec: str = ""):
         self._impl: CodecBackend | None = None
         self._pinned = False
+        # the code family this instance routes for: per-code measured
+        # curves can move the CPU/device crossover point
+        self.code_spec = code_spec
 
     @property
     def chosen(self) -> str | None:
@@ -405,7 +492,11 @@ class AutoCodec:
     def _resolve(self) -> CodecBackend:
         """Process-wide (bulk-size) choice, pinned."""
         if not self._pinned:
-            self._impl = get_backend(choose_auto_backend())
+            if _curve_code(self.code_spec):
+                self._impl = get_backend(choose_backend_for_size(
+                    _ROUTER_BULK_BYTES, self.code_spec))
+            else:
+                self._impl = get_backend(choose_auto_backend())
             self._pinned = True
         return self._impl
 
@@ -413,14 +504,16 @@ class AutoCodec:
         """Pin the backend the measured curve picks for a request of
         `nbytes` — the whole operation then rides one backend even as
         it streams through many dispatches."""
-        self._impl = get_backend(choose_backend_for_size(nbytes))
+        self._impl = get_backend(choose_backend_for_size(
+            nbytes, self.code_spec))
         self._pinned = True
         return self._impl
 
     def _backend_for(self, nbytes: int) -> CodecBackend:
         if self._pinned:
             return self._impl
-        self._impl = get_backend(choose_backend_for_size(nbytes))
+        self._impl = get_backend(choose_backend_for_size(
+            nbytes, self.code_spec))
         return self._impl
 
     def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
@@ -454,7 +547,12 @@ class ReedSolomon:
     """
 
     def __init__(self, data_shards: int, parity_shards: int,
-                 backend: str | CodecBackend = "numpy"):
+                 backend: str | CodecBackend = "numpy",
+                 code: "geo.CodeConfig | str | None" = None):
+        if code is not None:
+            if isinstance(code, str):
+                code = geo.parse_code(code)
+            data_shards, parity_shards = code.k, code.m
         if data_shards <= 0 or parity_shards <= 0:
             raise ValueError("data_shards and parity_shards must be > 0")
         if data_shards + parity_shards > 256:
@@ -462,9 +560,30 @@ class ReedSolomon:
         self.k = data_shards
         self.m = parity_shards
         self.n = data_shards + parity_shards
+        # the structural code config: RS unless an LRC (or other
+        # structured) spec was passed — repair planning and parity
+        # construction consult it instead of assuming k-of-n
+        self.code = code if code is not None \
+            else geo.CodeConfig(geo.codec_name(data_shards,
+                                               parity_shards),
+                                "rs", data_shards, 0, parity_shards)
+        if backend == "auto" and _curve_code(self.code.spec):
+            # a non-default code routes on its own measured curve, so
+            # it gets its own AutoCodec instead of the shared singleton
+            # (whose pinned choice belongs to the RS(10,4) curve)
+            backend = AutoCodec(self.code.spec)
         self.backend = (get_backend(backend) if isinstance(backend, str)
                         else backend)
-        self._parity_rows = rs_matrix.parity_rows(self.k, self.m)
+        self._parity_rows = rs_matrix.parity_rows_for(self.code)
+
+    @classmethod
+    def for_codec(cls, codec: str,
+                  backend: str | CodecBackend = "numpy"
+                  ) -> "ReedSolomon":
+        """Construct from a .vif codec spec string ('', 'k.m',
+        'lrc-k.l.g') — the one entry point volume readers use, so a
+        mixed-code cluster decodes every volume with its own code."""
+        return cls(0, 0, backend, code=geo.parse_code(codec or ""))
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(k, n) data shards -> (m, n) parity shards."""
@@ -474,7 +593,8 @@ class ReedSolomon:
         out = self.backend.coded_matmul(self._parity_rows, data)
         # label after the call: AutoCodec resolves during its first op
         observe_codec("encode", self.backend,
-                      _time.perf_counter() - t0, data.nbytes)
+                      _time.perf_counter() - t0, data.nbytes,
+                      code=self.code.spec)
         return out
 
     def reconstruct(self, shards: dict[int, np.ndarray],
@@ -489,13 +609,15 @@ class ReedSolomon:
             missing = [i for i in range(self.n) if i not in shards]
         if not missing:
             return {}
-        rows, inputs = rs_matrix.recovery_rows(self.k, self.m, present, missing)
+        rows, inputs = rs_matrix.recovery_rows_for(self.code, present,
+                                                   missing)
         stack = np.stack([np.asarray(shards[i], dtype=np.uint8)
                           for i in inputs])
         t0 = _time.perf_counter()
         out = self.backend.coded_matmul(rows, stack)
         observe_codec("reconstruct", self.backend,
-                      _time.perf_counter() - t0, stack.nbytes)
+                      _time.perf_counter() - t0, stack.nbytes,
+                      code=self.code.spec)
         return {sid: out[i] for i, sid in enumerate(missing)}
 
     def reconstruct_data(self, shards: dict[int, np.ndarray]) -> dict[int, np.ndarray]:

@@ -126,10 +126,18 @@ def write_sorted_ecx(base: str, ext: str = ".ecx") -> None:
 def codec_of(base: str) -> tuple[int, int]:
     """(data_shards, parity_shards) of the shard set at `base`, read
     from the .vif sidecar ('' -> the RS(10,4) default)."""
+    code = code_of(base)
+    return code.k, code.m
+
+
+def code_of(base: str) -> geo.CodeConfig:
+    """Full code config of the shard set at `base` (.vif sidecar) —
+    what rebuild and repair must consult: an LRC's recovery rows and
+    read fan-in differ from RS even at the same (k, m)."""
     from ..storage import volume_info as vinfo
 
     vi = vinfo.maybe_load_volume_info(base + ".vif")
-    return geo.parse_codec(vi.ec_codec if vi else "")
+    return geo.parse_code(vi.ec_codec if vi else "")
 
 
 def _record_codec(base: str, codec: str) -> None:
@@ -148,9 +156,13 @@ def write_ec_files(base: str, backend: str = "auto",
                    chunk: int = DEFAULT_CHUNK,
                    codec: str = "") -> None:
     """Generate .ec00..ecNN from `base`.dat (WriteEcFiles equivalent).
-    `codec` ("k.m") selects a wide code; default RS(10,4)."""
-    k, m = geo.parse_codec(codec)
-    if (k, m) != (geo.DATA_SHARDS, geo.PARITY_SHARDS):
+    `codec` selects the code family: "k.m" a (wide) RS, "lrc-k.l.g" an
+    LRC; default RS(10,4)."""
+    code = geo.parse_code(codec or "")
+    k, m = code.k, code.m
+    # identity is the CODE, not (k, m): lrc-10.2.2 shares RS(10,4)'s
+    # shard count but not its parity bytes, so it must hit the .vif too
+    if code != geo.parse_code(""):
         _record_codec(base, codec)
     else:
         # re-encoding at the default codec must CLEAR a stale wide-code
@@ -162,7 +174,7 @@ def write_ec_files(base: str, backend: str = "auto",
         if vi is not None and vi.ec_codec:
             vi.ec_codec = ""
             vinfo.save_volume_info(base + ".vif", vi)
-    rs = ReedSolomon(k, m, backend=backend)
+    rs = ReedSolomon(k, m, backend=backend, code=code)
     dat_path = base + ".dat"
     dat_size = os.path.getsize(dat_path)
     n_large, n_small = geo.row_layout(dat_size, large_block, small_block,
@@ -192,7 +204,8 @@ def write_ec_files(base: str, backend: str = "auto",
         t0 = _time.perf_counter()
         nat.ec_encode_file(
             dat_path, [base + geo.shard_ext(i) for i in range(k + m)],
-            rs_matrix.parity_rows(k, m), k, m, large_block, small_block)
+            rs_matrix.parity_rows_for(code), k, m, large_block,
+            small_block)
         # the bypass skips rs.encode entirely — record it here or the
         # fastest path would be the only uninstrumented one
         observe_codec("encode", "native", _time.perf_counter() - t0,
@@ -338,7 +351,8 @@ def rebuild_ec_files(base: str, backend: str = "auto",
     """Regenerate missing .ecXX files from the present ones
     (RebuildEcFiles, ec_encoder.go:61). Returns rebuilt shard ids.
     `only_shards` restricts which missing shards are produced."""
-    k, m = codec_of(base)
+    code = code_of(base)
+    k, m = code.k, code.m
     present, missing = [], []
     for i in range(k + m):
         (present if os.path.exists(base + geo.shard_ext(i)) else
@@ -347,30 +361,33 @@ def rebuild_ec_files(base: str, backend: str = "auto",
         missing = [i for i in missing if i in set(only_shards)]
     if not missing:
         return []
-    if len(present) < k:
+    if not code.recoverable(present):
         raise ValueError(
-            f"need >= {k} shards to rebuild, have "
-            f"{len(present)}")
+            f"shards {present} cannot rebuild {code.spec} "
+            f"(need rank {k})")
 
-    rs = ReedSolomon(k, m, backend=backend)
+    rs = ReedSolomon(k, m, backend=backend, code=code)
     sizes = {os.path.getsize(base + geo.shard_ext(i)) for i in present}
     if len(sizes) != 1:
         raise ValueError(f"present shards disagree on size: {sizes}")
     shard_size = sizes.pop()
 
-    ins = {i: np.memmap(base + geo.shard_ext(i), dtype=np.uint8, mode="r")
-           for i in present} if shard_size else {i: np.zeros(0, np.uint8)
-                                                 for i in present}
-    outs = {i: open(base + geo.shard_ext(i), "wb", buffering=0)
-            for i in missing}
-    # one recovery matrix serves every chunk; stream chunks through the
-    # backend pipeline (device codecs overlap read + H2D + compute + D2H)
+    # one recovery matrix serves every chunk; the code's repair plan
+    # picks the inputs (an LRC single-loss reads its group, not k), and
+    # only THOSE shards are opened — repair IO equals the plan's fan-in
     from ..ops import rs_matrix
 
-    rows, inputs = rs_matrix.recovery_rows(rs.k, rs.m, present, missing)
+    rows, inputs = rs_matrix.recovery_rows_for(code, present, missing)
+    ins = {i: np.memmap(base + geo.shard_ext(i), dtype=np.uint8, mode="r")
+           for i in inputs} if shard_size else {i: np.zeros(0, np.uint8)
+                                                for i in inputs}
+    outs = {i: open(base + geo.shard_ext(i), "wb", buffering=0)
+            for i in missing}
+    # stream chunks through the backend pipeline (device codecs
+    # overlap read + H2D + compute + D2H)
     from .backend import pipeline_depth_for
 
-    depth = pipeline_depth_for(len(inputs) * chunk)
+    depth = pipeline_depth_for(len(inputs) * chunk, code=code.spec)
     try:
         def gen():
             for c0 in range(0, shard_size, chunk):
@@ -394,8 +411,9 @@ def rebuild_ec_files(base: str, backend: str = "auto",
 def verify_ec_files(base: str, backend: str = "auto",
                     chunk: int = DEFAULT_CHUNK) -> bool:
     """Parity-check the full shard set (scrub building block)."""
-    k, m = codec_of(base)
-    rs = ReedSolomon(k, m, backend=backend)
+    code = code_of(base)
+    k, m = code.k, code.m
+    rs = ReedSolomon(k, m, backend=backend, code=code)
     paths = [base + geo.shard_ext(i) for i in range(k + m)]
     if not all(os.path.exists(p) for p in paths):
         return False

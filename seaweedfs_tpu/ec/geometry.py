@@ -15,6 +15,7 @@ overhead). The reference hard-codes 10+4.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
@@ -26,10 +27,18 @@ SMALL_BLOCK = 1 << 20  # 1MB
 
 
 def parse_codec(codec: str) -> tuple[int, int]:
-    """'k.m' -> (data_shards, parity_shards); '' -> the RS(10,4)
-    default. Validates against the uint32 shard mask."""
+    """Codec spec -> (data_shards, total_parity_shards).
+
+    Accepts 'k.m' (RS), 'lrc-k.l.g' (LRC: l local XOR parities + g
+    global RS parities, total parity l+g), or '' for the RS(10,4)
+    default. Geometry (stripe layout, shard count, locate math) only
+    needs (k, m); code structure lives in parse_code/CodeConfig.
+    """
     if not codec:
         return DATA_SHARDS, PARITY_SHARDS
+    if codec.startswith("lrc-"):
+        code = parse_code(codec)
+        return code.k, code.m
     k_s, _, m_s = codec.partition(".")
     k, m = int(k_s), int(m_s)
     if k <= 0 or m <= 0 or k + m > MAX_SHARD_COUNT:
@@ -40,6 +49,201 @@ def parse_codec(codec: str) -> tuple[int, int]:
 
 def codec_name(k: int, m: int) -> str:
     return f"{k}.{m}"
+
+
+# ---------------------------------------------------------------------------
+# Code configs: a code is (encode matrix, locality groups, repair plan)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RepairPlan:
+    """How to heal `missing` shards: which surviving shards to read and
+    whether the cheap local (XOR-group) path suffices. `reads` is the
+    exact surviving-shard set a repair must fetch — the degraded-read
+    ladder, the partial-stripe rebuilder and the tiering offload all
+    size their IO from it instead of assuming k-of-n."""
+
+    missing: tuple[int, ...]
+    reads: tuple[int, ...]
+    kind: str  # "local" (XOR group peel) or "global" (matrix solve)
+
+    @property
+    def fanin(self) -> int:
+        return len(self.reads)
+
+
+@dataclass(frozen=True)
+class CodeConfig:
+    """An erasure code: shard roles + locality structure.
+
+    kind "rs": shards [0,k) data, [k,k+m) Reed-Solomon parity.
+    kind "lrc" (lrc-k.l.g, arXiv 1309.0186): shards [0,k) data in l
+    groups of k/l; shard k+i is the XOR parity of group i; shards
+    [k+l, k+l+g) are global RS parities. A single loss inside a group
+    repairs from the k/l surviving group members instead of k shards.
+
+    The encode/recovery matrices live in ops.rs_matrix
+    (encode_matrix_for / recovery_rows_for); this class is pure
+    structure so geometry stays importable without numpy-heavy deps.
+    """
+
+    spec: str
+    kind: str                      # "rs" | "lrc"
+    k: int                         # data shards
+    n_local: int                   # local (XOR) parity shards
+    n_global: int                  # global (RS) parity shards
+
+    @property
+    def m(self) -> int:
+        """Total parity shards (geometry-compatible with RS m)."""
+        return self.n_local + self.n_global
+
+    @property
+    def total(self) -> int:
+        return self.k + self.m
+
+    @property
+    def is_rs(self) -> bool:
+        return self.kind == "rs"
+
+    @property
+    def group_size(self) -> int:
+        """Data shards per locality group (k for RS: one implicit
+        group, repairs read k shards either way)."""
+        return self.k // self.n_local if self.n_local else self.k
+
+    @property
+    def local_groups(self) -> tuple[tuple[int, ...], ...]:
+        """Per group: (data members..., local parity id). Empty for
+        RS — there is no sub-k repair group."""
+        if not self.n_local:
+            return ()
+        gs = self.group_size
+        return tuple(
+            tuple(range(i * gs, (i + 1) * gs)) + (self.k + i,)
+            for i in range(self.n_local))
+
+    @property
+    def global_parities(self) -> tuple[int, ...]:
+        return tuple(range(self.k + self.n_local, self.total))
+
+    def group_of(self, sid: int) -> tuple[int, ...] | None:
+        """The locality group (data members + local parity) a shard
+        belongs to; None for global parities and for RS shards."""
+        for grp in self.local_groups:
+            if sid in grp:
+                return grp
+        return None
+
+    @property
+    def storage_overhead(self) -> float:
+        return self.total / self.k
+
+    @property
+    def repair_fanin(self) -> int:
+        """Shards read to heal ONE lost data/local shard."""
+        return self.group_size if self.n_local else self.k
+
+    def describe(self) -> dict:
+        return {
+            "spec": self.spec, "kind": self.kind, "k": self.k,
+            "locals": self.n_local, "globals": self.n_global,
+            "total": self.total,
+            "storage_overhead": round(self.storage_overhead, 3),
+            "repair_fanin": self.repair_fanin,
+        }
+
+    # -- repair planning ------------------------------------------------
+
+    def recoverable(self, present) -> bool:
+        """Whether the shards in `present` determine all k data shards
+        — an actual GF(256) rank check against this code's encode
+        matrix, not a count heuristic (LRC local-parity rows are
+        dependent with their groups, so k survivors can be
+        insufficient and k-1 survivors can suffice... never for data,
+        but patterns matter)."""
+        present = sorted(set(int(s) for s in present))
+        if self.is_rs:
+            return len(present) >= self.k
+        if len(present) < self.k:
+            return False
+        from ..ops import rs_matrix
+
+        return rs_matrix.rank_of(self, present) >= self.k
+
+    def repair_plan(self, missing, available) -> RepairPlan | None:
+        """The cheapest read set healing `missing` from `available`,
+        or None when unrecoverable.
+
+        Local peel first: any missing shard whose group is otherwise
+        fully present (counting already-peeled repairs) heals from
+        group_size reads. Whatever remains needs a global solve over a
+        greedily-selected independent row set (rs_matrix picks the
+        actual rows; the plan's `reads` is its input set)."""
+        missing = tuple(sorted(set(int(s) for s in missing)))
+        avail = set(int(s) for s in available) - set(missing)
+        if not missing:
+            return RepairPlan((), (), "local")
+        reads: set[int] = set()
+        healed: set[int] = set()
+        have = set(avail)
+        progress = True
+        while progress:
+            progress = False
+            for sid in missing:
+                if sid in healed:
+                    continue
+                grp = self.group_of(sid)
+                if grp is None:
+                    continue
+                others = [x for x in grp if x != sid]
+                if all(x in have for x in others):
+                    reads.update(x for x in others if x in avail)
+                    healed.add(sid)
+                    have.add(sid)
+                    progress = True
+        rest = [sid for sid in missing if sid not in healed]
+        if not rest:
+            return RepairPlan(missing, tuple(sorted(reads)), "local")
+        # global solve for the remainder: rs_matrix selects the input
+        # rows (preferring shards the peel already read)
+        from ..ops import rs_matrix
+
+        inputs = rs_matrix.solve_inputs(self, sorted(avail), rest,
+                                        prefer=sorted(reads))
+        if inputs is None:
+            return None
+        reads.update(inputs)
+        return RepairPlan(missing, tuple(sorted(reads)), "global")
+
+
+@lru_cache(maxsize=64)
+def parse_code(spec: str) -> CodeConfig:
+    """Codec spec -> CodeConfig. '' -> RS(10,4); 'k.m' -> RS(k,m);
+    'lrc-k.l.g' -> LRC with l local XOR groups and g global parities
+    (k divisible by l). The same strings are recorded in volume .vif
+    files, so mixed-code clusters decode correctly."""
+    if not spec:
+        # canonical spec: '' and '10.4' are the same code, one identity
+        return CodeConfig(codec_name(DATA_SHARDS, PARITY_SHARDS),
+                          "rs", DATA_SHARDS, 0, PARITY_SHARDS)
+    if spec.startswith("lrc-"):
+        parts = spec[len("lrc-"):].split(".")
+        if len(parts) != 3:
+            raise ValueError(
+                f"code {spec!r}: expected lrc-<k>.<locals>.<globals>")
+        k, l, g = (int(p) for p in parts)
+        if k <= 0 or l <= 0 or g <= 0:
+            raise ValueError(f"code {spec!r}: need k, locals, globals > 0")
+        if k % l:
+            raise ValueError(
+                f"code {spec!r}: k={k} not divisible into {l} local groups")
+        if k + l + g > MAX_SHARD_COUNT:
+            raise ValueError(
+                f"code {spec!r}: k+locals+globals > {MAX_SHARD_COUNT}")
+        return CodeConfig(spec, "lrc", k, l, g)
+    k, m = parse_codec(spec)
+    return CodeConfig(spec, "rs", k, 0, m)
 
 
 def shard_ext(index: int) -> str:

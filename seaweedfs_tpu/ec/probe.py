@@ -32,9 +32,9 @@ import time as _time
 import numpy as np
 
 # probe schema version: bump when the sweep method or JSON layout
-# changes so stale caches self-invalidate (2: mesh rows + visible
-# device count in the fingerprint)
-PROBE_VERSION = 2
+# changes so stale caches self-invalidate (3: per-code curves + code
+# config in the fingerprint)
+PROBE_VERSION = 3
 
 SWEEP_SIZES = (1 << 20, 4 << 20, 16 << 20, 64 << 20)
 SWEEP_DEPTHS = (1, 2, 4)
@@ -51,16 +51,23 @@ DEFAULT_TTL_S = 24 * 3600.0
 # are skipped and marked, and the curve clamps to the largest measured
 DEFAULT_BUDGET_S = 45.0
 
-_curve: dict | None = None  # process cache of the active curve
+# process cache of the active curves, keyed by code spec ("" = the
+# default RS(10,4) production feed)
+_curves: dict[str, dict] = {}
 
 
-def cache_path() -> str:
+def cache_path(code: str = "") -> str:
     p = os.environ.get(_CACHE_ENV, "").strip()
-    if p:
-        return p
     base = os.environ.get("XDG_CACHE_HOME",
                           os.path.join(os.path.expanduser("~"), ".cache"))
-    return os.path.join(base, "seaweedfs_tpu", "ec_probe.json")
+    if not p:
+        p = os.path.join(base, "seaweedfs_tpu", "ec_probe.json")
+    if not code:
+        return p
+    # per-code curve, sibling of the default cache: a mixed-code
+    # cluster carries one measured curve per code family
+    root, ext = os.path.splitext(p)
+    return f"{root}-{code.replace('.', '_')}{ext or '.json'}"
 
 
 def cache_ttl_s() -> float:
@@ -108,15 +115,40 @@ def _visible_device_count() -> int | None:
         return None
 
 
-def host_fingerprint() -> dict:
+def code_fingerprint(spec: str = "") -> dict:
+    """The code-config part of the fingerprint: the canonical spec and
+    a hash of its encode matrix. A curve swept for one coefficient
+    matrix says nothing about another — if the matrix construction ever
+    changes (or the operator repoints -ec.code), the hash changes and
+    the cache self-invalidates."""
+    import hashlib
+
+    from ..ops import rs_matrix
+    from . import geometry as geo
+
+    code = geo.parse_code(spec or "")
+    mat = rs_matrix.encode_matrix_for(code)
+    return {"spec": code.spec,
+            "matrix_hash": hashlib.sha256(mat.tobytes()).hexdigest()[:16]}
+
+
+def host_fingerprint(code: str = "") -> dict:
     """What must match for a cached curve to be trusted: same machine,
     same visible device set behind the same jax, same mesh shape knobs,
-    same probe schema."""
+    same code config (-ec.code default + the swept code's encode-matrix
+    hash), same probe schema."""
     import platform as _plat
 
     fp = {"probe_version": PROBE_VERSION,
           "host": _plat.node(),
           "machine": _plat.machine()}
+    from . import backend as ecb
+
+    fp["default_code"] = ecb.default_code_spec()
+    try:
+        fp["code"] = code_fingerprint(code)
+    except Exception:  # pragma: no cover - fingerprint must not fatal
+        fp["code"] = {"spec": code or "", "matrix_hash": None}
     dev = _device()
     fp["device"] = ({"platform": dev[0], "kind": dev[1], "count": dev[2]}
                     if dev else None)
@@ -140,14 +172,16 @@ def host_fingerprint() -> dict:
 # measurement
 # ----------------------------------------------------------------------
 
-def measure_cpu_mbps(backend) -> float:
-    """Steady rate of the CPU-side codec on the encode shape (10x1MB
-    RS(10,4) parity), input bytes per second."""
+def measure_cpu_mbps(backend, coef: np.ndarray | None = None,
+                     k: int = _K) -> float:
+    """Steady rate of the CPU-side codec on the encode shape (k x 1MB
+    parity matmul, RS(10,4) by default), input bytes per second."""
     from ..ops import rs_matrix
 
-    coef = rs_matrix.parity_rows(_K, _M)
+    if coef is None:
+        coef = rs_matrix.parity_rows(_K, _M)
     blk = np.random.default_rng(0).integers(
-        0, 256, (_K, 1 << 20), dtype=np.uint8)
+        0, 256, (k, 1 << 20), dtype=np.uint8)
     backend.coded_matmul(coef, blk)  # warm (native lib load, caches)
     t0 = _time.perf_counter()
     backend.coded_matmul(coef, blk)
@@ -174,23 +208,25 @@ def _measure_e2e_row(codec, coef, size: int, depth: int,
     return n_blocks * k * w / (_time.perf_counter() - t0) / 1e6
 
 
-_slice_rows = None
+_slice_rows: dict[int, object] = {}
 
 
-def _get_slice_rows():
-    """Module-level jitted (k, w) -> (m, w) row slice: one jit cache
-    shared by every ceiling row, so shapes compiled during the
-    per-size warm pass stay compiled for the timed rows."""
-    global _slice_rows
-    if _slice_rows is None:
+def _get_slice_rows(m: int = _M):
+    """Jitted (k, w) -> (m, w) row slice, one per output-row count:
+    one jit cache shared by every ceiling row of that code, so shapes
+    compiled during the per-size warm pass stay compiled for the timed
+    rows."""
+    fn = _slice_rows.get(m)
+    if fn is None:
         import jax
 
-        _slice_rows = jax.jit(lambda x: x[:_M])
-    return _slice_rows
+        fn = _slice_rows[m] = jax.jit(lambda x: x[:m])
+    return fn
 
 
 def _measure_xfer_ceiling(codec, size: int, depth: int,
-                          n_blocks: int) -> float:
+                          n_blocks: int, k: int = _K,
+                          m: int = _M) -> float:
     """Shaped transfer-only twin of the row above: the same (k, w)
     uint8 blocks cross H2D and an (m, w) slice crosses D2H through the
     same committed placement and the same depth-bounded overlap, but
@@ -200,10 +236,10 @@ def _measure_xfer_ceiling(codec, size: int, depth: int,
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
-    slice_rows = _get_slice_rows()
-    w = max(1, size // _K)
+    slice_rows = _get_slice_rows(m)
+    w = max(1, size // k)
     rng = np.random.default_rng(size * 31 + depth)
-    blocks = [rng.integers(0, 256, (_K, w), dtype=np.uint8)
+    blocks = [rng.integers(0, 256, (k, w), dtype=np.uint8)
               for _ in range(n_blocks)]
     depth = max(1, depth)
     t0 = _time.perf_counter()
@@ -224,17 +260,23 @@ def _measure_xfer_ceiling(codec, size: int, depth: int,
                 pending.popleft().result()
         while pending:
             pending.popleft().result()
-    return n_blocks * _K * w / (_time.perf_counter() - t0) / 1e6
+    return n_blocks * k * w / (_time.perf_counter() - t0) / 1e6
 
 
 def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
               budget_s: float | None = None,
-              with_ceilings: bool = True) -> dict:
-    """Measure the curve. Always includes the CPU codec rate; device
-    rows only when a non-CPU device exists. Never raises: a failed row
-    is recorded with its error and the sweep moves on."""
+              with_ceilings: bool = True, code: str = "") -> dict:
+    """Measure the curve for one code family (default: the RS(10,4)
+    production feed). Always includes the CPU codec rate; device rows
+    only when a non-CPU device exists. Never raises: a failed row is
+    recorded with its error and the sweep moves on."""
+    from ..ops import rs_matrix
     from . import backend as ecb
+    from . import geometry as geo
 
+    cfg = geo.parse_code(code or "")
+    k, m = cfg.k, cfg.m
+    coef = rs_matrix.encode_matrix_for(cfg)[k:]
     if budget_s is None:
         try:
             budget_s = float(os.environ.get(_BUDGET_ENV,
@@ -242,15 +284,16 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
         except ValueError:
             budget_s = DEFAULT_BUDGET_S
     t_start = _time.perf_counter()
-    curve: dict = {"fingerprint": host_fingerprint(),
+    curve: dict = {"fingerprint": host_fingerprint(code),
                    "measured_at": _time.time(),
                    "budget_s": budget_s,
+                   "code": cfg.spec,
                    "rows": []}
     cpu_name = ecb.cpu_backend_name()
     curve["cpu_backend"] = cpu_name
     try:
         curve["cpu_mbps"] = round(
-            measure_cpu_mbps(ecb.get_backend(cpu_name)), 1)
+            measure_cpu_mbps(ecb.get_backend(cpu_name), coef, k), 1)
     except Exception as e:  # pragma: no cover - probe must never fatal
         curve["cpu_mbps"] = None
         curve["cpu_error"] = repr(e)
@@ -274,14 +317,11 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
         curve["device_error"] = "no device codec backend importable"
         return curve
 
-    from ..ops import rs_matrix
-
-    coef = rs_matrix.parity_rows(_K, _M)
     try:
         # spin up the path (first device_put, executor machinery)
         # outside every timed row; per-size XLA compiles get their own
         # warm pass below so no (size, depth) row is billed a compile
-        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2)
+        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2, k=k, m=m)
     except Exception as e:
         curve["device_error"] = repr(e)
         return curve
@@ -310,9 +350,10 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
                                       "skipped": "budget"})
             continue
         try:
-            _measure_e2e_row(codec, coef, size, 1, n_blocks=1)
+            _measure_e2e_row(codec, coef, size, 1, n_blocks=1, k=k, m=m)
             if with_ceilings:
-                _measure_xfer_ceiling(codec, size, 1, n_blocks=1)
+                _measure_xfer_ceiling(codec, size, 1, n_blocks=1,
+                                      k=k, m=m)
         except Exception as e:  # pragma: no cover - keep sweeping
             for depth in depths:
                 curve["rows"].append({"size": int(size),
@@ -333,12 +374,12 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
                 continue
             try:
                 rate = _measure_e2e_row(codec, coef, size, depth,
-                                        n_blocks)
+                                        n_blocks, k=k, m=m)
                 row["e2e_mbps"] = round(rate, 2)
                 last_rate = rate
                 if with_ceilings:
                     ceil = _measure_xfer_ceiling(codec, size, depth,
-                                                 n_blocks)
+                                                 n_blocks, k=k, m=m)
                     row["xfer_ceiling_mbps"] = round(ceil, 2)
                     if ceil > 0:
                         row["vs_ceiling"] = round(rate / ceil, 2)
@@ -352,19 +393,21 @@ def run_sweep(sizes=SWEEP_SIZES, depths=SWEEP_DEPTHS,
     # single-chip rows times N
     if dev[2] > 1:
         last_rate = _sweep_mesh_rows(curve, sizes, depths, remaining,
-                                     last_rate)
+                                     last_rate, coef=coef, k=k, m=m)
     curve["sweep_seconds"] = round(_time.perf_counter() - t_start, 2)
     return curve
 
 
 def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
-                     last_rate: float | None) -> float | None:
+                     last_rate: float | None,
+                     coef: np.ndarray | None = None, k: int = _K,
+                     m: int = _M) -> float | None:
     """size x depth rows for the mesh codec, appended to
     curve["mesh_rows"] with the mesh geometry in curve["mesh"]; shares
     the sweep's wall budget (`remaining`) so a slow link can't make the
     probe cost 2x its cap."""
-    from . import backend as ecb
     from ..ops import rs_matrix
+    from . import backend as ecb
 
     try:
         codec = ecb.get_backend("mesh")
@@ -372,7 +415,8 @@ def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
         curve["mesh_error"] = repr(e)
         return last_rate
     curve["mesh"] = codec.describe()
-    coef = rs_matrix.parity_rows(_K, _M)
+    if coef is None:
+        coef = rs_matrix.parity_rows(_K, _M)
 
     def affordable(nbytes: int) -> bool:
         if last_rate:
@@ -380,7 +424,7 @@ def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
         return remaining() > 0
 
     try:
-        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2)
+        _measure_e2e_row(codec, coef, 1 << 18, 1, n_blocks=2, k=k, m=m)
     except Exception as e:  # pragma: no cover - probe must never fatal
         curve["mesh_error"] = repr(e)
         return last_rate
@@ -393,7 +437,7 @@ def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
                              "skipped": "budget"})
             continue
         try:
-            _measure_e2e_row(codec, coef, size, 1, n_blocks=1)
+            _measure_e2e_row(codec, coef, size, 1, n_blocks=1, k=k, m=m)
         except Exception as e:  # pragma: no cover - keep sweeping
             for depth in depths:
                 rows.append({"size": int(size), "depth": int(depth),
@@ -409,7 +453,7 @@ def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
                 continue
             try:
                 rate = _measure_e2e_row(codec, coef, size, depth,
-                                        n_blocks)
+                                        n_blocks, k=k, m=m)
                 row["e2e_mbps"] = round(rate, 2)
                 last_rate = rate
             except Exception as e:  # pragma: no cover - keep sweeping
@@ -423,11 +467,12 @@ def _sweep_mesh_rows(curve: dict, sizes, depths, remaining,
 # ----------------------------------------------------------------------
 
 def load_cached(path: str | None = None,
-                ttl_s: float | None = None) -> dict | None:
-    """The cached curve if present, parseable, same-host and fresh —
-    else None. Corruption and expiry both land here as None: the
-    caller re-sweeps, it never crashes."""
-    path = path or cache_path()
+                ttl_s: float | None = None,
+                code: str = "") -> dict | None:
+    """The cached curve if present, parseable, same-host, same-code
+    and fresh — else None. Corruption and expiry both land here as
+    None: the caller re-sweeps, it never crashes."""
+    path = path or cache_path(code)
     ttl_s = cache_ttl_s() if ttl_s is None else ttl_s
     try:
         with open(path, encoding="utf-8") as f:
@@ -436,7 +481,7 @@ def load_cached(path: str | None = None,
             return None
         if not isinstance(curve.get("rows"), list):
             return None
-        if curve.get("fingerprint") != host_fingerprint():
+        if curve.get("fingerprint") != host_fingerprint(code):
             return None
         age = _time.time() - float(curve.get("measured_at", 0))
         if age < 0 or age > ttl_s:
@@ -460,44 +505,44 @@ def save_cache(curve: dict, path: str | None = None) -> None:
         pass
 
 
-def get_curve(refresh: bool = False) -> dict:
-    """The active curve: process memo -> disk cache -> fresh sweep
-    (persisted only when a device was actually measured — a CPU-only
-    probe is cheap enough to redo and says nothing about the link)."""
-    global _curve
-    if _curve is not None and not refresh:
-        return _curve
-    curve = None if refresh else load_cached()
+def get_curve(refresh: bool = False, code: str = "") -> dict:
+    """The active curve for one code family: process memo -> disk
+    cache -> fresh sweep (persisted only when a device was actually
+    measured — a CPU-only probe is cheap enough to redo and says
+    nothing about the link)."""
+    memo = _curves.get(code)
+    if memo is not None and not refresh:
+        return memo
+    curve = None if refresh else load_cached(code=code)
     if curve is None:
-        curve = run_sweep()
+        curve = run_sweep(code=code)
         if curve.get("device") is not None:
-            save_cache(curve)
+            save_cache(curve, cache_path(code))
         curve["source"] = "fresh"
     else:
         curve["source"] = "cache"
-    _curve = curve
+    _curves[code] = curve
     return curve
 
 
-def peek() -> dict | None:
+def peek(code: str = "") -> dict | None:
     """The curve if this process already has one (memo or a valid disk
     cache) — never sweeps. Debug surfaces use this so a GET can't
     stall behind the probe budget."""
-    global _curve
-    if _curve is not None:
-        return _curve
-    curve = load_cached()
+    memo = _curves.get(code)
+    if memo is not None:
+        return memo
+    curve = load_cached(code=code)
     if curve is not None:
         curve["source"] = "cache"
-        _curve = curve
+        _curves[code] = curve
     return curve
 
 
 def invalidate() -> None:
-    """Drop the process memo (tests; ops can also just delete the
-    cache file and restart)."""
-    global _curve
-    _curve = None
+    """Drop the process memo, all codes (tests; ops can also just
+    delete the cache files and restart)."""
+    _curves.clear()
 
 
 # ----------------------------------------------------------------------

@@ -114,7 +114,9 @@ class EcVolume:
         from ..storage import volume_info as vinfo
 
         vi = vinfo.maybe_load_volume_info(base + ".vif")
-        self.k, self.m = geo.parse_codec(vi.ec_codec if vi else "")
+        self.codec = vi.ec_codec if vi else ""
+        self.code = geo.parse_code(self.codec)
+        self.k, self.m = self.code.k, self.code.m
         self.total = self.k + self.m
         self._ecx = idxmod.read_index(base + ".ecx") if \
             os.path.exists(base + ".ecx") else np.empty(0, idxmod.IDX_DTYPE)

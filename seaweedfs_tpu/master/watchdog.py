@@ -141,14 +141,20 @@ class RedundancyWatchdog:
                              "have": have, "want": want,
                              "replication": key.replication})
             for vid, shards in topo.ec_locations.items():
-                k, m = geo.parse_codec(topo.ec_codecs.get(vid, ""))
-                live = sum(1 for nodes in shards.values() if nodes)
-                if 0 < live < k + m:
+                code = geo.parse_code(topo.ec_codecs.get(vid, ""))
+                live_ids = [sid for sid, nodes in shards.items()
+                            if nodes]
+                live = len(live_ids)
+                if 0 < live < code.total:
+                    # recoverability is the CODE's call (GF(256) rank
+                    # for structured codes), not a shard count: k LRC
+                    # survivors can be dependent and thus insufficient
                     under_parity.append(
                         {"volume": vid,
                          "collection": topo.ec_collections.get(vid, ""),
-                         "have": live, "want": k + m,
-                         "recoverable": live >= k})
+                         "have": live, "want": code.total,
+                         "code": code.spec,
+                         "recoverable": code.recoverable(live_ids)})
         return under_replicated, under_parity
 
     def enqueue(self, vid: int, kind: str, reason: str,

@@ -57,6 +57,13 @@ def _load_locked() -> ctypes.CDLL:
     lib.crc32c_batch.restype = None
     lib.native_simd_level.argtypes = []
     lib.native_simd_level.restype = ctypes.c_int
+    try:
+        lib.gf256_scheduled_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), u8p, ctypes.c_int,
+            ctypes.c_int64, u8p]
+        lib.gf256_scheduled_matmul.restype = None
+    except AttributeError:  # stale prebuilt .so without the kernel
+        pass
     i64p = ctypes.POINTER(ctypes.c_int64)
     lib.dat_scan.argtypes = [
         u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
@@ -87,6 +94,28 @@ def coded_matmul(coef: np.ndarray, shards: np.ndarray) -> np.ndarray:
     out = np.empty((m, n), dtype=np.uint8)
     lib.gf256_coded_matmul(_u8p(coef), m, k, _u8p(shards),
                            ctypes.c_int64(n), _u8p(out))
+    return out
+
+
+def has_scheduled() -> bool:
+    """Whether the loaded library carries the scheduled XOR kernel
+    (False only for a stale prebuilt .so with no compiler to refresh)."""
+    return hasattr(load(), "gf256_scheduled_matmul")
+
+
+def scheduled_matmul(prog: np.ndarray, shards: np.ndarray,
+                     m: int) -> np.ndarray:
+    """Run a flattened ops/schedule program (int32, schedule.flatten
+    layout) over (k, n) uint8 shards -> (m, n) uint8. Bit-identical
+    with coded_matmul for the program's coefficient matrix."""
+    lib = load()
+    prog = np.ascontiguousarray(prog, dtype=np.int32)
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    k, n = shards.shape
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.gf256_scheduled_matmul(
+        prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _u8p(shards), k, ctypes.c_int64(n), _u8p(out))
     return out
 
 

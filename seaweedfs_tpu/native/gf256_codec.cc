@@ -133,6 +133,21 @@ void mul_xor_row(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
   for (; i < n; ++i) dst[i] ^= row[src[i]];
 }
 
+// 8x8 bit-matrix transpose (Hacker's Delight 7-3). With byte i of the
+// little-endian word as matrix row i, byte s of the result packs bit s
+// of every input byte — the bytes<->bit-planes pivot of the scheduled
+// XOR kernel below.
+uint64_t bit_transpose8(uint64_t x) {
+  uint64_t t;
+  t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AAULL;
+  x = x ^ t ^ (t << 7);
+  t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCCULL;
+  x = x ^ t ^ (t << 14);
+  t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0ULL;
+  x = x ^ t ^ (t << 28);
+  return x;
+}
+
 // ---- CRC32C (Castagnoli, reflected poly 0x82f63b78) ------------------
 uint32_t CRC_TBL[8][256];
 
@@ -245,6 +260,72 @@ uint32_t crc32c_update(uint32_t crc, const uint8_t* data, int64_t len) {
 void crc32c_batch(const uint8_t* rows, int m, int64_t n, uint32_t* out) {
   for (int i = 0; i < m; ++i)
     out[i] = crc32c_update(0, rows + static_cast<size_t>(i) * n, n);
+}
+
+// Scheduled bit-plane XOR program (ops/schedule.py `flatten` layout):
+// prog = [n_in, n_out, n_ops, (dst, a, b) * n_ops, out_var * n_out]
+// with n_in = 8k input bit-planes (bit s of shard row j is var 8j+s)
+// and n_out = 8m output planes. Columns are processed in cache-sized
+// chunks: bytes pivot to packed bit-planes (bit_transpose8), the op
+// list runs as word-wide XORs over plane rows, planes pivot back to
+// bytes. Bit-identical with gf256_coded_matmul by construction — the
+// schedule rewrites the XOR program, never the shard byte layout.
+void gf256_scheduled_matmul(const int32_t* prog, const uint8_t* shards,
+                            int k, int64_t n, uint8_t* out) {
+  const int n_in = prog[0], n_out = prog[1], n_ops = prog[2];
+  const int32_t* ops = prog + 3;
+  const int32_t* outs = ops + 3 * static_cast<int64_t>(n_ops);
+  const int m = n_out / 8;
+  constexpr int64_t kChunk = 4096;       // column bytes per pass
+  constexpr int64_t kPlane = kChunk / 8; // packed plane bytes
+  constexpr int64_t kWords = kPlane / 8;
+  std::vector<uint64_t> pool(
+      static_cast<size_t>(n_in + n_ops) * kWords);
+  uint8_t* cells = reinterpret_cast<uint8_t*>(pool.data());
+  for (int64_t c0 = 0; c0 < n; c0 += kChunk) {
+    const int64_t w = std::min(kChunk, n - c0);
+    const int64_t wcells = (w + 7) / 8;
+    for (int j = 0; j < k; ++j) {
+      const uint8_t* src = shards + static_cast<size_t>(j) * n + c0;
+      uint8_t* pl = cells + static_cast<size_t>(8 * j) * kPlane;
+      for (int64_t i = 0; i < wcells; ++i) {
+        uint64_t x = 0;
+        const int64_t rem = w - i * 8;
+        std::memcpy(&x, src + i * 8,
+                    rem >= 8 ? 8 : static_cast<size_t>(rem));
+        x = bit_transpose8(x);
+        for (int s = 0; s < 8; ++s)
+          pl[static_cast<size_t>(s) * kPlane + i] =
+              static_cast<uint8_t>(x >> (8 * s));
+      }
+    }
+    for (int o = 0; o < n_ops; ++o) {
+      const int32_t* op = ops + 3 * o;
+      uint64_t* d = pool.data() + static_cast<size_t>(op[0]) * kWords;
+      const uint64_t* a =
+          pool.data() + static_cast<size_t>(op[1]) * kWords;
+      const uint64_t* b =
+          pool.data() + static_cast<size_t>(op[2]) * kWords;
+      for (int64_t i = 0; i < kWords; ++i) d[i] = a[i] ^ b[i];
+    }
+    for (int i = 0; i < m; ++i) {
+      const int32_t* ov = outs + 8 * i;
+      uint8_t* dst = out + static_cast<size_t>(i) * n + c0;
+      for (int64_t j = 0; j < wcells; ++j) {
+        uint64_t x = 0;
+        for (int s = 0; s < 8; ++s) {
+          const int32_t v = ov[s];
+          const uint8_t byte =
+              v < 0 ? 0 : cells[static_cast<size_t>(v) * kPlane + j];
+          x |= static_cast<uint64_t>(byte) << (8 * s);
+        }
+        x = bit_transpose8(x);
+        const int64_t rem = w - j * 8;
+        std::memcpy(dst + j * 8, &x,
+                    rem >= 8 ? 8 : static_cast<size_t>(rem));
+      }
+    }
+  }
 }
 
 int native_simd_level() {

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import gf256
+from . import gf256, schedule
 from ..utils import metrics
 
 # Column slab each jitted call processes; callers pad up to a multiple.
@@ -62,6 +62,30 @@ _bit_matmul = jax.jit(_bit_matmul_body)
 # donating it lets XLA reuse the buffer for the (8k, n) bit-plane
 # intermediate instead of allocating fresh HBM per in-flight block
 _bit_matmul_donated = jax.jit(_bit_matmul_body, donate_argnums=(1,))
+
+
+def _xor_matmul_body(program, shards: jax.Array) -> jax.Array:
+    """The scheduled alternative to the MXU matmul: run the
+    CSE-optimized XOR program (ops/schedule.Program, static) over
+    uint8 bit-planes. Same byte semantics as coded_matmul_bits — the
+    schedule rewrites the program, not the layout — so either kernel
+    can serve any dispatch; which one runs is measured, not assumed."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (shards[:, None, :] >> shifts[None, :, None]) & 1
+    bits = bits.reshape(shards.shape[0] * 8, shards.shape[1])
+    pool = [bits[i] for i in range(program.n_in)]
+    for _, a, b in program.ops:
+        pool.append(pool[a] ^ pool[b])
+    zero = jnp.zeros_like(bits[0])
+    rows = jnp.stack([pool[v] if v >= 0 else zero
+                      for v in program.outputs])
+    from .bits import pack_bits_uint8
+
+    return pack_bits_uint8(rows)
+
+
+# program is hashable (frozen dataclass of tuples) -> valid static arg
+_xor_matmul = jax.jit(_xor_matmul_body, static_argnums=(0,))
 
 
 def observe_stage(backend: str, stage: str, seconds: float) -> None:
@@ -97,6 +121,7 @@ class JaxCodec:
         self._bitmats: "OrderedDict[bytes, jax.Array]" = OrderedDict()
         self._sharding = None
         self._donate: bool | None = None
+        self._chooser = schedule.Chooser()
 
     def _coef_bits(self, coef: np.ndarray) -> jax.Array:
         key = coef.shape[0].to_bytes(2, "big") + coef.tobytes()
@@ -150,8 +175,12 @@ class JaxCodec:
             out.append((_pad_cols(chunk, self._pad_width(w)), w))
         return out
 
-    def _run(self, mats, dev: jax.Array) -> jax.Array:
-        """Dispatch the kernel on an already-on-device padded block."""
+    def _run(self, mats, dev: jax.Array, plan=None) -> jax.Array:
+        """Dispatch the kernel on an already-on-device padded block:
+        the scheduled XOR program when the chooser picked it for this
+        (matrix, size), else the dense MXU bit-matmul."""
+        if plan is not None:
+            return _xor_matmul(plan, dev)
         if self._donate is None:
             # donation on the CPU backend logs an unusable-buffer
             # warning per call; only enable where it buys HBM reuse
@@ -159,11 +188,46 @@ class JaxCodec:
         fn = _bit_matmul_donated if self._donate else _bit_matmul
         return fn(mats, dev)
 
-    def _dispatch(self, mats, shards: np.ndarray) -> list:
+    def _plan_for(self, coef: np.ndarray, nbytes: int):
+        """The scheduled program when measurement says it beats the
+        dense kernel at this (matrix, size bucket); None otherwise.
+        Both candidates are timed once per bucket on a slab-width
+        sample (after a warm/compile call each) — never-slower by
+        construction, pinnable via SEAWEEDFS_TPU_EC_SCHEDULE."""
+        k = coef.shape[1]
+        w = min(max(1, nbytes // max(1, k)), self.slab)
+        sample = None
+        mats = None
+        plan = None
+
+        def prep():
+            nonlocal sample, mats, plan
+            if sample is None:
+                rng = np.random.default_rng(0)
+                chunk = rng.integers(0, 256, (k, self._pad_width(w)),
+                                     dtype=np.uint8)
+                sample = self._h2d(chunk)
+                mats = self._coef_bits(coef)
+                plan = schedule.plan_for(coef)
+
+        def run_sched():
+            prep()
+            _xor_matmul(plan, sample).block_until_ready()
+
+        def run_dense():
+            prep()
+            _bit_matmul(mats, sample).block_until_ready()
+
+        if self._chooser.use_scheduled(coef, nbytes, run_sched,
+                                       run_dense):
+            return schedule.plan_for(coef)
+        return None
+
+    def _dispatch(self, mats, shards: np.ndarray, plan=None) -> list:
         """Issue the async device calls for one (k, n) column block,
         slab-split and bucket-padded; returns [(device_array, width)]
         without forcing any transfer back."""
-        return [(self._run(mats, self._h2d(chunk)), w)
+        return [(self._run(mats, self._h2d(chunk), plan), w)
                 for chunk, w in self._split(shards)]
 
     def coded_matmul(self, coef: np.ndarray, shards) -> np.ndarray:
@@ -174,8 +238,9 @@ class JaxCodec:
         n = shards.shape[1]
         if n == 0:
             return np.zeros((m, 0), dtype=np.uint8)
+        plan = self._plan_for(coef, shards.nbytes)
         mats = self._coef_bits(coef)
-        return _collect(self._dispatch(mats, shards))
+        return _collect(self._dispatch(mats, shards, plan))
 
     def coded_matmul_stream(self, coef: np.ndarray, blocks,
                             depth: int = 2):
@@ -211,6 +276,8 @@ class JaxCodec:
         mats = self._coef_bits(coef)
         depth = max(1, int(depth))
         backend = self.name
+        # streams are bulk: decide scheduled-vs-dense once at slab size
+        plan = self._plan_for(coef, coef.shape[1] * self.slab)
 
         def upload(block: np.ndarray):
             t0 = _time.perf_counter()
@@ -222,7 +289,7 @@ class JaxCodec:
                 # upload before the kernel keeps the DMA engine busy
                 d.block_until_ready()
             t1 = _time.perf_counter()
-            outs = [(self._run(mats, d), w) for d, w in devs]
+            outs = [(self._run(mats, d, plan), w) for d, w in devs]
             observe_stage(backend, "h2d", t1 - t0)
             return outs
 

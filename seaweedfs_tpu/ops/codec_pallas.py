@@ -175,7 +175,12 @@ def _make_pallas_codec_class():
             # never relayouts
             return n + (-n) % COL_TILE
 
-        def _run(self, mats, dev: jax.Array) -> jax.Array:
+        def _plan_for(self, coef, nbytes):
+            # the fused kernel is already a bit-plane program executed
+            # on-device; the scheduled XOR path never applies here
+            return None
+
+        def _run(self, mats, dev: jax.Array, plan=None) -> jax.Array:
             a_pm, pack = mats
             if self._donate is None:
                 self._donate = jax.devices()[0].platform != "cpu"

@@ -1600,7 +1600,7 @@ class VolumeServer:
         import numpy as np
 
         from ..ec.backend import ReedSolomon
-        from ..ec.encoder import codec_of
+        from ..ec.encoder import code_of
         from ..rpc.httpclient import session
 
         # land the rebuilt files beside already-mounted shards so
@@ -1658,11 +1658,23 @@ class VolumeServer:
                     f.write(blob)
                 self._repair_throttle_sync(max_bps, len(blob))
                 net_bytes += len(blob)
-        k, m = codec_of(base)
-        if len(local_sids) + len(remote_sids) < k:
+        code = code_of(base)
+        k, m = code.k, code.m
+        avail = sorted(set(local_sids) | set(remote_sids))
+        # the code's repair plan picks the read set: an LRC single
+        # loss streams its locality group (fan-in k/l), and even a
+        # global solve gets an INDEPENDENT input row set — a first-k
+        # gather can be rank-deficient for structured codes
+        plan = None if code.is_rs else code.repair_plan(missing, avail)
+        if code.is_rs:
+            if len(avail) < k:
+                raise ValueError(
+                    f"vid {vid}: {len(avail)} shards reachable, "
+                    f"need {k}")
+        elif plan is None:
             raise ValueError(
-                f"vid {vid}: {len(local_sids) + len(remote_sids)} "
-                f"shards reachable, need {k}")
+                f"vid {vid}: shards {avail} cannot rebuild "
+                f"{code.spec} shards {missing}")
         shard_size = None
         if local_sids:
             shard_size = ecv.shards[local_sids[0]].size
@@ -1683,7 +1695,15 @@ class VolumeServer:
                     break
         if not shard_size:
             raise ValueError(f"vid {vid}: cannot stat shard size")
-        rs = ReedSolomon(k, m, backend=self.store.ec_backend)
+        rs = ReedSolomon(k, m, backend=self.store.ec_backend,
+                         code=code)
+        # planned reads (structured codes): which shards each chunk
+        # actually touches — locals for free, remotes over the wire
+        plan_local = plan_remote = None
+        if plan is not None:
+            plan_local = [s for s in plan.reads if s in local_sids]
+            plan_remote = [s for s in plan.reads
+                           if s not in local_sids]
         written = 0
         files = {s: open(base + geo.shard_ext(s), "wb")
                  for s in missing}
@@ -1691,27 +1711,33 @@ class VolumeServer:
             for off in range(0, shard_size, chunk):
                 n = min(chunk, shard_size - off)
                 rows: dict[int, object] = {}
-                for s in local_sids:
-                    if len(rows) >= k:
+                local_take = plan_local if plan is not None else \
+                    local_sids
+                for s in local_take:
+                    if plan is None and len(rows) >= k:
                         break
                     rows[s] = np.frombuffer(
                         ecv.shards[s].read_at(off, n), dtype=np.uint8)
-                need = k - len(rows)
+                fetch_sids = plan_remote if plan is not None else \
+                    remote_sids
+                need = len(plan_remote) if plan is not None else \
+                    k - len(rows)
                 if need > 0:
                     # pace the loop BEFORE the fan-out so the burst
                     # the first-k-wins fetch admits is already paid for
                     self._repair_throttle_sync(max_bps, need * n)
                     fetched = self._remote_shards_fetch_sync(
-                        vid, remote_sids, off, n, need=need,
+                        vid, fetch_sids, off, n, need=need,
                         deadline=max(30.0, self.store.ec_read_deadline),
                         bps=max_bps)
                     for s in sorted(fetched)[:need]:
                         rows[s] = np.frombuffer(fetched[s],
                                                 dtype=np.uint8)
                     net_bytes += need * n
-                if len(rows) < k:
+                want = len(plan.reads) if plan is not None else k
+                if len(rows) < want:
                     raise ValueError(
-                        f"vid {vid}: only {len(rows)}/{k} shard "
+                        f"vid {vid}: only {len(rows)}/{want} shard "
                         f"ranges at +{off}")
                 rec = rs.reconstruct(rows, missing=missing)
                 for s in missing:
@@ -1730,6 +1756,9 @@ class VolumeServer:
             f.close()
         metrics.counter_add("repair_read_bytes_total", net_bytes,
                             {"mode": "partial"})
+        lab = {"mode": "partial", "code": code.spec}
+        metrics.counter_add("ec_repair_read_bytes_by_code_total",
+                            net_bytes, lab)
         return {"rebuilt_shards": missing, "rebuilt_bytes": written,
                 "read_bytes": net_bytes}
 
@@ -1796,6 +1825,17 @@ class VolumeServer:
         if is_repair and copied:
             metrics.counter_add("repair_read_bytes_total", copied,
                                 {"mode": "full"})
+            # per-code accounting: the .vif just copied in names the
+            # code family these borrowed bytes repair
+            try:
+                from ..ec.encoder import code_of
+
+                spec = code_of(base).spec
+            except Exception:
+                spec = geo.parse_code("").spec
+            lab = {"mode": "full", "code": spec}
+            metrics.counter_add("ec_repair_read_bytes_by_code_total",
+                                copied, lab)
         return web.json_response({"copied": exts, "bytes": copied})
 
     async def handle_ec_mount(self, req: web.Request) -> web.Response:
@@ -2221,7 +2261,20 @@ class VolumeServer:
     async def handle_debug_ec(self, req: web.Request) -> web.Response:
         from ..ec import backend as ec_backend
 
-        return await ec_backend.handle_debug_ec(req)
+        snap = ec_backend.probe_snapshot()
+        # per-volume view: which code each mounted EC volume actually
+        # runs (k / locals / globals from its .vif), so a mixed-code
+        # cluster is inspectable per volume, not just per process
+        vols = {}
+        for vid, ecv in sorted(self.store.ec_volumes.items()):
+            code = ecv.code
+            vols[str(vid)] = {
+                "code": code.spec, "kind": code.kind, "k": code.k,
+                "locals": code.n_local, "globals": code.n_global,
+                "shards": sorted(ecv.shards),
+            }
+        snap["volumes"] = vols
+        return web.json_response(snap)
 
     async def handle_status(self, req: web.Request) -> web.Response:
         hb = self.store.collect_heartbeat()

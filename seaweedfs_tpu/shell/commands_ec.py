@@ -18,9 +18,15 @@ def ec_encode(env: CommandEnv, volume_id: int,
     """Mark readonly, generate the shard set on the source server,
     spread shards across servers by free slots, then delete the
     original volume everywhere (command_ec_encode.go:95-192).
-    `codec` ("k.m", e.g. "28.4") selects the beyond-reference wide-code
-    tier for cold collections; default RS(10,4)."""
+    `codec` selects the code family — "k.m" (e.g. "28.4") a wide RS
+    tier, "lrc-k.l.g" (e.g. "lrc-12.3.2") a locally-repairable code;
+    empty falls back to the process `-ec.code` default, then
+    RS(10,4)."""
     env.confirm_locked()
+    if not codec:
+        from ..ec.backend import default_code_spec
+
+        codec = default_code_spec()
     k, m = geo.parse_codec(codec)
     total = k + m
     sources = env.volume_locations(volume_id)
@@ -100,7 +106,8 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
     from ..master import placement as pl
 
     env.confirm_locked()
-    reg_collection, (k, m), locations = env.ec_info(volume_id)
+    reg_collection, code, locations = env.ec_full_info(volume_id)
+    k, m = code.k, code.m
     if not collection:
         collection = reg_collection
     present = set(locations)
@@ -108,10 +115,10 @@ def ec_rebuild(env: CommandEnv, volume_id: int,
                if sid not in present]
     if not missing:
         return {"rebuilt": []}
-    if len(present) < k:
+    if not code.recoverable(sorted(present)):
         raise ShellError(
-            f"volume {volume_id}: only {len(present)} shards survive, "
-            f"need {k}")
+            f"volume {volume_id}: shards {sorted(present)} cannot "
+            f"rebuild {code.spec}")
     nodes = env.data_nodes()
     node, violations = pl.select_ec_rebuilder(nodes, volume_id,
                                               locations)
@@ -270,7 +277,8 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
     from ..ec.backend import ReedSolomon
     from ..rpc.httpclient import session
 
-    _col, (k, m), locs = env.ec_info(volume_id)
+    _col, code, locs = env.ec_full_info(volume_id)
+    k, m = code.k, code.m
     missing = [sid for sid in range(k + m) if sid not in locs]
     if missing:
         return {"volume": volume_id, "verified": False,
@@ -293,7 +301,7 @@ def ec_verify(env: CommandEnv, volume_id: int, sample_mb: int = 4,
         shards.append(np.frombuffer(resp.content, dtype=np.uint8))
     n = min(len(s) for s in shards)
     stack = np.stack([s[:n] for s in shards])
-    rs = ReedSolomon(k, m, backend=backend)
+    rs = ReedSolomon(k, m, backend=backend, code=code)
     ok = bool(rs.verify(stack))
     out = {"volume": volume_id, "verified": ok,
            "bytes_checked_per_shard": int(n), "backend": backend}
@@ -328,16 +336,21 @@ def _locate_corrupt_shard(rs, rows: dict) -> int | None:
 
     total = rs.k + rs.m
 
-    def mismatches(basis: list[int]) -> list[int]:
-        recon = rs.reconstruct({sid: rows[sid] for sid in basis},
-                               missing=[i for i in range(total)
-                                        if i not in basis])
+    def mismatches(basis: list[int]) -> list[int] | None:
+        try:
+            recon = rs.reconstruct({sid: rows[sid] for sid in basis},
+                                   missing=[i for i in range(total)
+                                            if i not in basis])
+        except ValueError:
+            # dependent basis (possible for structured codes): this
+            # basis can't decode — inconclusive, try the next
+            return None
         return [i for i in range(total) if i not in basis and
                 not np.array_equal(recon[i], rows[i])]
 
     basis = list(range(rs.k))
     bad = mismatches(basis)
-    if len(bad) == 1:
+    if bad is not None and len(bad) == 1:
         return bad[0]
     if not bad:
         return None

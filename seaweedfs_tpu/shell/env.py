@@ -85,11 +85,18 @@ class CommandEnv:
                                          "dict[int, list[str]]"]:
         """(collection, (k, m), {shard_id: [urls]}) in ONE master
         round trip — /cluster/ec_shards carries all three."""
+        col, code, locs = self.ec_full_info(vid)
+        return col, (code.k, code.m), locs
+
+    def ec_full_info(self, vid: int):
+        """(collection, CodeConfig, {shard_id: [urls]}) in ONE master
+        round trip — the code config (not just its (k, m) geometry)
+        drives rebuild planning for structured codes."""
         from ..ec import geometry as geo
 
         body = self.master_get("/cluster/ec_shards", volumeId=vid)
         return (body.get("collection", ""),
-                geo.parse_codec(body.get("codec", "")),
+                geo.parse_code(body.get("codec", "")),
                 {int(sid): urls
                  for sid, urls in body.get("shards", {}).items()})
 

@@ -480,7 +480,18 @@ class Store:
         remote_shards_fetcher, first-k-wins under ec_read_deadline —
         the reference fans out one goroutine per shard the same way
         (store_ec.go:349-393); a serial walk would pay ≥10 sequential
-        RTTs and a single hung peer would stall the read forever."""
+        RTTs and a single hung peer would stall the read forever.
+
+        Structured codes first consult their repair plan: an LRC heals
+        a single lost shard from its locality group (fan-in k/l), so
+        the ladder reads a handful of shards instead of k. The generic
+        >= k gather below stays as the fallback for multi-loss and for
+        plan shards that turn out unreachable."""
+        if not ecv.code.is_rs:
+            data = self._reconstruct_planned(ecv, missing_sid, offset,
+                                             size)
+            if data is not None:
+                return data
         rows: dict[int, np.ndarray] = {}
         candidates: list[int] = []
         for sid in range(ecv.total):
@@ -517,6 +528,46 @@ class Store:
             rows, [missing_sid])
         return rec[missing_sid].tobytes()
 
+    def _reconstruct_planned(self, ecv: EcVolume, missing_sid: int,
+                             offset: int, size: int) -> bytes | None:
+        """Repair-plan fast path: read exactly the code's planned
+        fan-in for this single loss (the locality group for an LRC
+        data/local shard). Returns None — falling back to the generic
+        >= k ladder — when the plan doesn't beat k reads or one of its
+        shards is unreachable."""
+        plan = ecv.code.repair_plan(
+            [missing_sid],
+            [s for s in range(ecv.total) if s != missing_sid])
+        if plan is None or plan.fanin >= ecv.k:
+            return None
+        rows: dict[int, np.ndarray] = {}
+        remote: list[int] = []
+        for sid in plan.reads:
+            shard = ecv.shards.get(sid)
+            if shard is not None:
+                rows[sid] = np.frombuffer(
+                    shard.read_at(offset, size), dtype=np.uint8)
+            else:
+                remote.append(sid)
+        if remote:
+            if self.remote_shards_fetcher is not None:
+                got = self.remote_shards_fetcher(
+                    ecv.vid, remote, offset, size, len(remote),
+                    self.ec_read_deadline)
+                for sid, data in got.items():
+                    rows[sid] = np.frombuffer(data, dtype=np.uint8)
+            elif self.remote_shard_reader is not None:
+                for sid in remote:
+                    data = self.remote_shard_reader(
+                        ecv.vid, sid, offset, size)
+                    if data is not None:
+                        rows[sid] = np.frombuffer(data, dtype=np.uint8)
+        if set(rows) != set(plan.reads):
+            return None
+        rec = self._rs_for(ecv, interval=True).reconstruct(
+            rows, [missing_sid])
+        return rec[missing_sid].tobytes()
+
     def _rs_for(self, ecv: EcVolume, *,
                 interval: bool = False) -> ReedSolomon:
         """Per-codec ReedSolomon, cached — wide-code volumes carry their
@@ -530,16 +581,20 @@ class Store:
         encode/rebuild keeps the configured backend — that's where the
         device's bandwidth actually wins."""
         backend = ec_cpu_backend() if interval else self.ec_backend
-        if not interval and \
+        # a real EcVolume carries .code from the .vif sidecar; bare
+        # (k, m) stand-ins fall back to the plain RS family
+        code = getattr(ecv, "code", None) or \
+            geo.parse_code("%d.%d" % (ecv.k, ecv.m))
+        if not interval and code.is_rs and \
                 (ecv.k, ecv.m) == (geo.DATA_SHARDS, geo.PARITY_SHARDS):
             return self._rs
         cache = getattr(self, "_rs_cache", None)
         if cache is None:
             cache = self._rs_cache = {}
-        rs = cache.get((ecv.k, ecv.m, backend))
+        rs = cache.get((code.spec, backend))
         if rs is None:
-            rs = cache[(ecv.k, ecv.m, backend)] = ReedSolomon(
-                ecv.k, ecv.m, backend=backend)
+            rs = cache[(code.spec, backend)] = ReedSolomon(
+                ecv.k, ecv.m, backend=backend, code=code)
         return rs
 
     # -- cold-tier offload / recall (remote_storage clients) -------------

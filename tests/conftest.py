@@ -61,6 +61,11 @@ def pytest_configure(config):
         "sanitize: rebuilds the native data plane under ASan/TSan and "
         "re-runs the parity + concurrency suites in a subprocess; "
         "slow, needs gcc + libasan/libtsan")
+    config.addinivalue_line(
+        "markers",
+        "codes: pluggable erasure-code family tests (LRC beside RS, "
+        "repair plans, bit-plane kernel scheduling); selectable with "
+        "pytest -m codes")
 
 
 import pytest  # noqa: E402

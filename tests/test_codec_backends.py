@@ -205,7 +205,7 @@ def test_router_picks_device_when_measured_faster(monkeypatch):
     assert ecb._decide(fast, 64 << 20) == "jax"
     from seaweedfs_tpu.ec import probe
 
-    monkeypatch.setattr(probe, "_curve", fast)
+    monkeypatch.setattr(probe, "_curves", {"": fast})
     assert ecb.choose_backend_for_size(1 << 20) == "numpy"
     assert ecb.choose_backend_for_size(64 << 20) == "jax"
     assert ecb.pipeline_depth_for(64 << 20) == 4
@@ -235,7 +235,7 @@ def test_probe_cache_corrupt_falls_back_to_sweep(tmp_path, monkeypatch):
     assert probe.load_cached() is None
     sentinel = _mk_curve(1.0, [], device=False)
     monkeypatch.setattr(probe, "run_sweep", lambda **kw: dict(sentinel))
-    monkeypatch.setattr(probe, "_curve", None)
+    monkeypatch.setattr(probe, "_curves", {})
     got = probe.get_curve()
     assert got["source"] == "fresh"
     assert got["cpu_mbps"] == 1.0

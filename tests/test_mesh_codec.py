@@ -240,7 +240,7 @@ def test_router_picks_mesh_when_fastest(monkeypatch):
     assert ecb._decide(curve, 1 << 20) == "jax"
     # bulk rides the mesh
     assert ecb._decide(curve, 64 << 20) == "mesh"
-    monkeypatch.setattr(probe, "_curve", curve)
+    monkeypatch.setattr(probe, "_curves", {"": curve})
     assert ecb.choose_backend_for_size(64 << 20) == "mesh"
     # depth for a mesh-routed size comes from the MESH rows
     assert ecb.pipeline_depth_for(64 << 20) == 4
