@@ -135,16 +135,17 @@ def code_fingerprint(spec: str = "") -> dict:
 def host_fingerprint(code: str = "") -> dict:
     """What must match for a cached curve to be trusted: same machine,
     same visible device set behind the same jax, same mesh shape knobs,
-    same code config (-ec.code default + the swept code's encode-matrix
-    hash), same probe schema."""
+    same swept-code config (spec + encode-matrix hash), same probe
+    schema. The process-wide -ec.code DEFAULT is deliberately absent:
+    the swept code is fully captured by code_fingerprint, and baking
+    the default in would invalidate every cached curve — including the
+    RS(10,4) one — on an unrelated config repoint, forcing full
+    re-sweeps fleet-wide."""
     import platform as _plat
 
     fp = {"probe_version": PROBE_VERSION,
           "host": _plat.node(),
           "machine": _plat.machine()}
-    from . import backend as ecb
-
-    fp["default_code"] = ecb.default_code_spec()
     try:
         fp["code"] = code_fingerprint(code)
     except Exception:  # pragma: no cover - fingerprint must not fatal

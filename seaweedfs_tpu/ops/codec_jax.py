@@ -192,10 +192,18 @@ class JaxCodec:
         """The scheduled program when measurement says it beats the
         dense kernel at this (matrix, size bucket); None otherwise.
         Both candidates are timed once per bucket on a slab-width
-        sample (after a warm/compile call each) — never-slower by
-        construction, pinnable via SEAWEEDFS_TPU_EC_SCHEDULE."""
+        sample (after a warm/compile call each), and the verdict is
+        keyed by the SAMPLE's byte size — never-slower at the probed
+        size by construction, pinnable via SEAWEEDFS_TPU_EC_SCHEDULE.
+        The measurement runs on a background thread (the warm calls
+        include an XLA compile of the ~10^3-op unrolled XOR program,
+        multi-second cold): first sight of a (matrix, bucket) serves
+        the dense kernel immediately and upgrades once the verdict
+        lands, so a live read/repair never pays the compile spike."""
         k = coef.shape[1]
-        w = min(max(1, nbytes // max(1, k)), self.slab)
+        w = self._pad_width(
+            min(max(1, nbytes // max(1, k)), self.slab))
+        sample_bytes = min(nbytes, k * w)
         sample = None
         mats = None
         plan = None
@@ -204,8 +212,7 @@ class JaxCodec:
             nonlocal sample, mats, plan
             if sample is None:
                 rng = np.random.default_rng(0)
-                chunk = rng.integers(0, 256, (k, self._pad_width(w)),
-                                     dtype=np.uint8)
+                chunk = rng.integers(0, 256, (k, w), dtype=np.uint8)
                 sample = self._h2d(chunk)
                 mats = self._coef_bits(coef)
                 plan = schedule.plan_for(coef)
@@ -218,8 +225,8 @@ class JaxCodec:
             prep()
             _bit_matmul(mats, sample).block_until_ready()
 
-        if self._chooser.use_scheduled(coef, nbytes, run_sched,
-                                       run_dense):
+        if self._chooser.use_scheduled(coef, sample_bytes, run_sched,
+                                       run_dense, background=True):
             return schedule.plan_for(coef)
         return None
 

@@ -142,14 +142,16 @@ class MeshCodec:
     def _plan_for(self, coef: np.ndarray, nbytes: int):
         """Measured scheduled-vs-dense choice for this (matrix, size
         bucket) — same protocol as JaxCodec._plan_for, against the
-        sharded kernels."""
+        sharded kernels: the verdict is keyed by the sample's own byte
+        size and measured on a background thread, serving the dense
+        kernel until it lands."""
         k = coef.shape[1]
+        w = self._seg_width(max(1, min(nbytes // max(1, k), 4 << 20)))
+        sample_bytes = min(nbytes, self.vol * k * w)
         state: dict = {}
 
         def prep():
             if not state:
-                w = self._seg_width(
-                    max(1, min(nbytes // max(1, k), 4 << 20)))
                 rng = np.random.default_rng(0)
                 batched = rng.integers(
                     0, 256, (self.vol, k, w), dtype=np.uint8)
@@ -174,8 +176,8 @@ class MeshCodec:
             self._fn_meas(state["mats"],
                           state["dev"]).block_until_ready()
 
-        if self._chooser.use_scheduled(coef, nbytes, run_sched,
-                                       run_dense):
+        if self._chooser.use_scheduled(coef, sample_bytes, run_sched,
+                                       run_dense, background=True):
             return schedule.plan_for(coef)
         return None
 

@@ -49,10 +49,14 @@ class NativeCodec:
         coef = np.asarray(coef, dtype=np.uint8)
         shards = np.asarray(shards, dtype=np.uint8)
         if shards.shape[1] and native.has_scheduled():
-            sample = shards[:, :min(shards.shape[1],
-                                    schedule.MIN_SCHED_BYTES)]
+            # sample columns derive from a BYTE cap, and the verdict is
+            # keyed by the sample's own size — the cached decision is
+            # only ever one that was actually measured at that size
+            cap = max(1, schedule.MEASURE_BYTES_MAX // shards.shape[0])
+            sample = shards[:, :cap] if shards.shape[1] > cap \
+                else shards
             if self._chooser.use_scheduled(
-                    coef, shards.nbytes,
+                    coef, sample.nbytes,
                     lambda: self._scheduled(coef, sample),
                     lambda: native.coded_matmul(coef, sample)):
                 return self._scheduled(coef, shards)

@@ -31,6 +31,7 @@ native/gf256_codec.cc.
 from __future__ import annotations
 
 import os
+import threading
 import time as _time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -39,9 +40,15 @@ import numpy as np
 
 from . import gf256
 
-# below this many columns the dense kernels win on dispatch overhead
+# below this many BYTES the dense kernels win on dispatch overhead
 # alone; the chooser never even measures the scheduled path there
 MIN_SCHED_BYTES = 64 << 10
+
+# measurement sample cap: requests bigger than this are decided from a
+# sample of at most this many bytes, and callers key the verdict by the
+# SAMPLE's size (the size actually probed) so the never-slower-at-any-
+# probed-size guarantee stays honest for large requests
+MEASURE_BYTES_MAX = 4 << 20
 
 _SCHED_ENV = "SEAWEEDFS_TPU_EC_SCHEDULE"  # auto (default) | on | off
 
@@ -223,16 +230,26 @@ class Chooser:
     scheduled? `auto` measures both paths once per bucket (after a
     warm call each, so jit/compile is not billed) and caches the
     winner — the guarantee that the scheduled kernel is never slower
-    than the dense one at any probed size holds by construction.
-    `on`/`off` (SEAWEEDFS_TPU_EC_SCHEDULE) pin the answer for tests
-    and benches."""
+    than the dense one at any probed size holds by construction;
+    callers pass the nbytes of the sample they actually measure so the
+    cached verdict is keyed by a probed size. `on`/`off`
+    (SEAWEEDFS_TPU_EC_SCHEDULE) pin the answer for tests and benches.
+
+    `background=True` moves the measurement off the caller's thread:
+    the first sight of a (matrix, bucket) kicks a worker thread and
+    serves the dense kernel until the verdict lands — device backends
+    use this because their warm calls include an XLA compile that
+    would otherwise stall the first live read/repair for seconds."""
 
     max_keys: int = 256
     _won: "OrderedDict[tuple[bytes, int], bool]" = field(
         default_factory=OrderedDict)
+    _pending: set = field(default_factory=set)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def use_scheduled(self, coef: np.ndarray, nbytes: int,
-                      run_sched, run_dense) -> bool:
+                      run_sched, run_dense,
+                      background: bool = False) -> bool:
         m = mode()
         if m == "off":
             return False
@@ -244,10 +261,26 @@ class Chooser:
         if plan.xors >= plan.naive_xors:
             return False
         key = (coef_key(coef), _bucket(nbytes))
-        hit = self._won.get(key)
-        if hit is not None:
-            self._won.move_to_end(key)
-            return hit
+        with self._lock:
+            hit = self._won.get(key)
+            if hit is not None:
+                self._won.move_to_end(key)
+                return hit
+            if key in self._pending:
+                return False  # measurement in flight: dense meanwhile
+            self._pending.add(key)
+        if background:
+            # non-daemon ON PURPOSE: a daemon thread killed mid-XLA-
+            # compile at interpreter shutdown aborts the process
+            # (std::terminate); joining at exit costs at most one
+            # compile and only when a measurement is in flight
+            threading.Thread(
+                target=self._measure, args=(key, run_sched, run_dense),
+                name="ec-sched-measure", daemon=False).start()
+            return False
+        return self._measure(key, run_sched, run_dense)
+
+    def _measure(self, key, run_sched, run_dense) -> bool:
         try:
             run_sched()  # warm: build/compile both paths off the clock
             run_dense()
@@ -260,11 +293,15 @@ class Chooser:
             win = t_s < t_d
         except Exception:
             win = False
-        self._won[key] = win
-        while len(self._won) > self.max_keys:
-            self._won.popitem(last=False)
+        with self._lock:
+            self._pending.discard(key)
+            self._won[key] = win
+            while len(self._won) > self.max_keys:
+                self._won.popitem(last=False)
         return win
 
     def snapshot(self) -> dict:
-        wins = sum(1 for v in self._won.values() if v)
-        return {"buckets": len(self._won), "scheduled_wins": wins}
+        with self._lock:
+            wins = sum(1 for v in self._won.values() if v)
+            return {"buckets": len(self._won), "scheduled_wins": wins,
+                    "measuring": len(self._pending)}

@@ -1698,47 +1698,113 @@ class VolumeServer:
         rs = ReedSolomon(k, m, backend=self.store.ec_backend,
                          code=code)
         # planned reads (structured codes): which shards each chunk
-        # actually touches — locals for free, remotes over the wire
+        # actually touches — locals for free, remotes over the wire.
+        # A planned remote that times out is marked dead and the plan
+        # recomputed without it (structured codes carry substitutable
+        # shards); only when no plan survives does the chunk fall back
+        # to the generic rank-k gather below — a single slow peer must
+        # not abort the whole rebuild the way the RS first-k-wins path
+        # never lets it.
+        dead: set[int] = set()
         plan_local = plan_remote = None
-        if plan is not None:
+
+        def split_plan() -> None:
+            nonlocal plan_local, plan_remote
             plan_local = [s for s in plan.reads if s in local_sids]
             plan_remote = [s for s in plan.reads
                            if s not in local_sids]
+
+        if plan is not None:
+            split_plan()
+        fetch_deadline = max(30.0, self.store.ec_read_deadline)
+
+        def gather_planned(off: int, n: int):
+            """Rows for one chunk via the repair plan, re-planning
+            around unreachable remotes; None -> use the generic
+            gather."""
+            nonlocal plan, net_bytes
+            while plan is not None:
+                rows: dict[int, object] = {}
+                for s in plan_local:
+                    rows[s] = np.frombuffer(
+                        ecv.shards[s].read_at(off, n), dtype=np.uint8)
+                if not plan_remote:
+                    return rows
+                # pace the loop BEFORE the fan-out so the burst the
+                # fetch admits is already paid for
+                self._repair_throttle_sync(max_bps,
+                                           len(plan_remote) * n)
+                fetched = self._remote_shards_fetch_sync(
+                    vid, plan_remote, off, n, need=len(plan_remote),
+                    deadline=fetch_deadline, bps=max_bps)
+                net_bytes += len(fetched) * n
+                short = [s for s in plan_remote if s not in fetched]
+                if not short:
+                    for s in plan_remote:
+                        rows[s] = np.frombuffer(fetched[s],
+                                                dtype=np.uint8)
+                    return rows
+                dead.update(short)
+                plan = code.repair_plan(
+                    missing, [s for s in avail if s not in dead])
+                if plan is not None:
+                    split_plan()
+            return None
+
+        def gather_generic(off: int, n: int) -> dict:
+            """Span-growing gather over ALL reachable shards (dead
+            ones included — they may only have been slow): rank k over
+            the code's encode rows, which for RS is plain first-k."""
+            nonlocal net_bytes
+            from ..ops import rs_matrix
+
+            rows: dict[int, object] = {}
+            span: list[int] = []
+
+            def grows(s: int) -> bool:
+                if len(span) >= k:
+                    return False
+                if code.is_rs:
+                    return True
+                return rs_matrix.rank_of(code, span + [s]) > len(span)
+
+            for s in local_sids:
+                if grows(s):
+                    rows[s] = np.frombuffer(
+                        ecv.shards[s].read_at(off, n), dtype=np.uint8)
+                    span.append(s)
+            cands = list(remote_sids)
+            while len(span) < k and cands:
+                need = k - len(span)
+                self._repair_throttle_sync(max_bps, need * n)
+                fetched = self._remote_shards_fetch_sync(
+                    vid, cands, off, n, need=need,
+                    deadline=fetch_deadline, bps=max_bps)
+                net_bytes += len(fetched) * n
+                if not fetched:
+                    break
+                for s in sorted(fetched):
+                    if grows(s):
+                        rows[s] = np.frombuffer(fetched[s],
+                                                dtype=np.uint8)
+                        span.append(s)
+                cands = [s for s in cands if s not in fetched]
+            if len(span) < k:
+                raise ValueError(
+                    f"vid {vid}: only {len(rows)}/{k} shard "
+                    f"ranges at +{off}")
+            return rows
+
         written = 0
         files = {s: open(base + geo.shard_ext(s), "wb")
                  for s in missing}
         try:
             for off in range(0, shard_size, chunk):
                 n = min(chunk, shard_size - off)
-                rows: dict[int, object] = {}
-                local_take = plan_local if plan is not None else \
-                    local_sids
-                for s in local_take:
-                    if plan is None and len(rows) >= k:
-                        break
-                    rows[s] = np.frombuffer(
-                        ecv.shards[s].read_at(off, n), dtype=np.uint8)
-                fetch_sids = plan_remote if plan is not None else \
-                    remote_sids
-                need = len(plan_remote) if plan is not None else \
-                    k - len(rows)
-                if need > 0:
-                    # pace the loop BEFORE the fan-out so the burst
-                    # the first-k-wins fetch admits is already paid for
-                    self._repair_throttle_sync(max_bps, need * n)
-                    fetched = self._remote_shards_fetch_sync(
-                        vid, fetch_sids, off, n, need=need,
-                        deadline=max(30.0, self.store.ec_read_deadline),
-                        bps=max_bps)
-                    for s in sorted(fetched)[:need]:
-                        rows[s] = np.frombuffer(fetched[s],
-                                                dtype=np.uint8)
-                    net_bytes += need * n
-                want = len(plan.reads) if plan is not None else k
-                if len(rows) < want:
-                    raise ValueError(
-                        f"vid {vid}: only {len(rows)}/{want} shard "
-                        f"ranges at +{off}")
+                rows = gather_planned(off, n) if plan is not None \
+                    else None
+                if rows is None:
+                    rows = gather_generic(off, n)
                 rec = rs.reconstruct(rows, missing=missing)
                 for s in missing:
                     row = np.asarray(rec[s], dtype=np.uint8).tobytes()

@@ -485,42 +485,70 @@ class Store:
         Structured codes first consult their repair plan: an LRC heals
         a single lost shard from its locality group (fan-in k/l), so
         the ladder reads a handful of shards instead of k. The generic
-        >= k gather below stays as the fallback for multi-loss and for
-        plan shards that turn out unreachable."""
+        gather below stays as the fallback for multi-loss and for plan
+        shards that turn out unreachable — it collects shards until
+        their encode rows reach GF(256) rank k, NOT until k shards are
+        in hand: structured codes carry dependent rows (an LRC local
+        parity is the XOR of its group), so a first-k-by-count set can
+        be rank-deficient while independent shards sit reachable."""
         if not ecv.code.is_rs:
             data = self._reconstruct_planned(ecv, missing_sid, offset,
                                              size)
             if data is not None:
                 return data
+        code = ecv.code
         rows: dict[int, np.ndarray] = {}
+        span: list[int] = []   # shard ids backing rows; full-rank by invariant
+
+        def grows(sid: int) -> bool:
+            # for RS any <= k distinct shards are independent, so rank
+            # is the count and the matrix check is skipped
+            if len(span) >= ecv.k:
+                return False
+            if code.is_rs:
+                return True
+            from ..ops import rs_matrix
+
+            return rs_matrix.rank_of(code, span + [sid]) > len(span)
+
         candidates: list[int] = []
         for sid in range(ecv.total):
             if sid == missing_sid:
                 continue
             shard = ecv.shards.get(sid)
-            if shard is not None and len(rows) < ecv.k:
+            if shard is None:
+                candidates.append(sid)
+            elif grows(sid):
                 rows[sid] = np.frombuffer(
                     shard.read_at(offset, size), dtype=np.uint8)
-            elif shard is None:
-                candidates.append(sid)
-        need = ecv.k - len(rows)
-        if need > 0 and candidates:
+                span.append(sid)
+        while len(span) < ecv.k and candidates:
+            need = ecv.k - len(span)
+            got: dict[int, bytes] = {}
             if self.remote_shards_fetcher is not None:
                 got = self.remote_shards_fetcher(
                     ecv.vid, candidates, offset, size, need,
                     self.ec_read_deadline)
-                for sid, data in got.items():
-                    rows[sid] = np.frombuffer(data, dtype=np.uint8)
             elif self.remote_shard_reader is not None:
                 # legacy serial fallback (tools / tests without a server)
-                for sid in candidates:
-                    if len(rows) >= ecv.k:
+                for sid in list(candidates):
+                    if len(got) >= need:
                         break
+                    candidates.remove(sid)  # tried: never re-asked
                     data = self.remote_shard_reader(
                         ecv.vid, sid, offset, size)
                     if data is not None:
-                        rows[sid] = np.frombuffer(data, dtype=np.uint8)
-        if len(rows) < ecv.k:
+                        got[sid] = data
+            if not got:
+                break
+            for sid in sorted(got):
+                if grows(sid):
+                    rows[sid] = np.frombuffer(got[sid], dtype=np.uint8)
+                    span.append(sid)
+            # responders that didn't grow the span are dropped from the
+            # candidate list so the retry round asks for NEW shards
+            candidates = [s for s in candidates if s not in got]
+        if len(span) < ecv.k:
             raise IOError(
                 f"cannot reconstruct shard {missing_sid} of volume "
                 f"{ecv.vid}: only {len(rows)} shards reachable")
@@ -763,9 +791,11 @@ class Store:
         ec_shards = [
             {"id": vid, "collection": ecv.collection,
              "shard_bits": ecv.shard_bits().bits,
-             "codec": geo.codec_name(ecv.k, ecv.m)
-             if (ecv.k, ecv.m) != (geo.DATA_SHARDS, geo.PARITY_SHARDS)
-             else "",
+             # the .vif spec string, NOT a (k, m)-derived name: an LRC
+             # can share RS(10,4)'s geometry (lrc-10.2.2) yet be a
+             # different code, and the master's registry drives repair
+             # planning for structured codes
+             "codec": ecv.codec,
              # tiering: are this node's shards offloaded to the remote
              # tier, and how hot is the EC volume still being read
              "remote": bool(ecv.shards) and

@@ -356,6 +356,120 @@ def test_vif_records_code_and_rebuild_uses_plan(rng, tmp_path):
     assert encoder.verify_ec_files(base, backend="numpy")
 
 
+def test_degraded_gather_skips_dependent_rows(rng, tmp_path):
+    """Regression (store generic gather): with data shard 5 lost, the
+    first-k-BY-COUNT local set {0-4, 6-11, 12} has GF(256) rank 11 —
+    shard 12 is the XOR of its fully-present group — so a count-based
+    gather declared the read dead while an independent global parity
+    sat one fetch away. The gather must grow the row SPAN: skip
+    dependent shards and keep fetching until rank k."""
+    from seaweedfs_tpu.ec.encoder import write_ec_files, write_sorted_ecx
+    from seaweedfs_tpu.storage.store import Store
+
+    code = geo.parse_code(LRC)
+    base = tmp_path / "91"
+    (tmp_path / "91.dat").write_bytes(
+        rng.integers(0, 256, code.k * 1024 * 3, dtype=np.uint8).tobytes())
+    (tmp_path / "91.idx").write_bytes(b"")
+    write_ec_files(str(base), backend="numpy", codec=LRC,
+                   large_block=1 << 14, small_block=1 << 10)
+    write_sorted_ecx(str(base))
+    shards = {s: (tmp_path / ("91" + geo.shard_ext(s))).read_bytes()
+              for s in range(code.total)}
+    # kept local: 0-4, 6-11 plus BOTH dependent local parities 12 and
+    # 14 (each one's data group is fully present). Gone from disk: the
+    # lost shard 5, its group parity 13, and the global parities 15/16
+    # — of which only 16 answers over the wire
+    for s in (5, 13, 15, 16):
+        (tmp_path / ("91" + geo.shard_ext(s))).unlink()
+    store = Store([str(tmp_path)])
+    ecv = store.ec_volumes[91]
+    assert ecv.code == code
+    asked = []
+
+    def fetcher(vid, sids, offset, size, need, deadline):
+        asked.append(tuple(sids))
+        if 16 in sids:
+            return {16: shards[16][offset:offset + size]}
+        return {}
+
+    store.remote_shards_fetcher = fetcher
+    got = store._reconstruct_interval(ecv, 5, 64, 2048)
+    assert got == shards[5][64:64 + 2048]
+    # the planned group read tried (and lost) dark shard 13 first,
+    # then the rank-aware fallback went to the independent parity
+    assert asked[0] == (13,)
+    assert any(16 in sids for sids in asked[1:])
+
+
+def test_chooser_background_measures_off_thread(monkeypatch):
+    """Regression (device codecs): `background=True` must return the
+    dense verdict immediately and measure on a worker thread — device
+    warm-up includes an XLA compile that would otherwise stall the
+    first live read for seconds. Concurrent callers during the
+    measurement also get dense, without starting a second one."""
+    import threading
+    import time
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_EC_SCHEDULE", "auto")
+    ch = schedule.Chooser()
+    coef = rs_matrix.parity_rows(10, 4)
+    gate = threading.Event()
+    sched_runs = []
+
+    def run_sched():
+        gate.wait(10)
+        sched_runs.append(1)
+
+    def run_dense():
+        time.sleep(0.002)
+
+    n = schedule.MIN_SCHED_BYTES
+    assert ch.use_scheduled(coef, n, run_sched, run_dense,
+                            background=True) is False
+    assert ch.use_scheduled(coef, n, run_sched, run_dense,
+                            background=True) is False  # in flight
+    assert ch.snapshot()["measuring"] == 1
+    gate.set()
+    deadline = time.monotonic() + 10
+    while ch.snapshot()["measuring"] and time.monotonic() < deadline:
+        time.sleep(0.005)
+    snap = ch.snapshot()
+    assert snap["measuring"] == 0 and snap["buckets"] == 1
+    # warm + timed = exactly one measurement despite two callers
+    assert len(sched_runs) == 2
+    # verdict landed: the scheduled closure beat the 2ms dense one
+    assert ch.use_scheduled(coef, n, run_sched, run_dense,
+                            background=True) is True
+
+
+def test_native_sample_cap_keys_verdict_by_probed_size(rng, monkeypatch):
+    """Requests past MEASURE_BYTES_MAX are decided from a byte-capped
+    sample and the cached verdict is keyed by the SAMPLE's size — the
+    chooser only ever records sizes it actually measured."""
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.ops import codec_native
+
+    try:
+        codec = codec_native.NativeCodec()
+    except Exception as e:
+        pytest.skip(f"native codec unavailable: {e}")
+    if not native.has_scheduled():
+        pytest.skip("scheduled kernel not in this libgf256 build")
+    monkeypatch.setenv("SEAWEEDFS_TPU_EC_SCHEDULE", "auto")
+    coef = rs_matrix.parity_rows(10, 4)
+    width = schedule.MEASURE_BYTES_MAX // 10 * 2  # 2x the sample cap
+    data = rng.integers(0, 256, (10, width), dtype=np.uint8)
+    got = codec.coded_matmul(coef, data)
+    assert np.array_equal(np.asarray(got),
+                          codec_numpy.coded_matmul(coef, data))
+    keys = list(codec._chooser._won)
+    assert len(keys) == 1
+    sample_bytes = 10 * (schedule.MEASURE_BYTES_MAX // 10)
+    assert keys[0][1] == schedule._bucket(sample_bytes)
+    assert keys[0][1] != schedule._bucket(data.nbytes)
+
+
 def test_probe_fingerprint_differs_per_code():
     from seaweedfs_tpu.ec import probe
 
@@ -364,6 +478,9 @@ def test_probe_fingerprint_differs_per_code():
     assert fp_rs["spec"] == "10.4" and fp_lrc["spec"] == LRC
     assert fp_rs["matrix_hash"] != fp_lrc["matrix_hash"]
     assert probe.cache_path(LRC) != probe.cache_path("")
+    # the process-wide -ec.code default must NOT be in the host
+    # fingerprint: repointing it would invalidate every cached curve
+    assert "default_code" not in probe.host_fingerprint(LRC)
 
 
 def test_code_table_and_snapshot_surface_codes():

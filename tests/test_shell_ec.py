@@ -178,6 +178,68 @@ class TestPartialRepairTraffic:
         for fid, data in list(payloads.items())[:3]:
             assert requests.get(f"http://{holder}/{fid}").content == data
 
+    def test_partial_rebuild_survives_dark_planned_shard(
+            self, cluster, env, sealed_volume):
+        """Regression: a planned remote shard that never answers must
+        not abort a structured-code partial rebuild. The server marks
+        it dead, re-plans around it (LRCs carry substitutable shards),
+        and still heals the lost shard bit-for-bit."""
+        vid, payloads = sealed_volume
+        commands_ec.ec_encode(env, vid, codec="lrc-10.2.2")
+        col, reg_code, locs = env.ec_full_info(vid)
+        assert reg_code.spec == "lrc-10.2.2"
+        # golden copy of data shard 1 before losing it everywhere
+        holder = next(s for s in cluster.volume_servers
+                      if f"{s.store.ip}:{s.store.port}" == locs[1][0])
+        shard = holder.store.ec_volumes[vid].shards[1]
+        golden = shard.read_at(0, shard.size)
+        self._drop_shard(env, vid, 1)
+        plan = reg_code.repair_plan(
+            [1], [s for s in range(reg_code.total) if s != 1])
+        assert plan is not None and plan.kind == "local"
+        # pick a rebuilder that must fetch >= 1 planned shard remotely,
+        # then black that shard out at its fan-out layer
+        rebuilder = dark = None
+        for srv in cluster.volume_servers:
+            ecv = srv.store.ec_volumes.get(vid)
+            mine = set(ecv.shards) if ecv is not None else set()
+            short = [s for s in plan.reads if s not in mine]
+            if short:
+                rebuilder, dark = srv, short[0]
+                break
+        assert rebuilder is not None
+        orig = rebuilder._remote_shards_fetch_sync
+        darkened = []
+
+        def no_answer_from_dark(vid_, sids, offset, size, need,
+                                deadline, bps=0.0):
+            live = [s for s in sids if s != dark]
+            if len(live) != len(sids):
+                darkened.append(dark)
+            if not live:
+                return {}
+            return orig(vid_, live, offset, size,
+                        need=min(need, len(live)), deadline=deadline,
+                        bps=bps)
+
+        rebuilder._remote_shards_fetch_sync = no_answer_from_dark
+        try:
+            out = env.vs_post(
+                f"{rebuilder.store.ip}:{rebuilder.store.port}",
+                "/admin/ec/rebuild_partial",
+                {"volume": vid, "collection": col, "shard_ids": [1]})
+        finally:
+            rebuilder._remote_shards_fetch_sync = orig
+        assert out["rebuilt_shards"] == [1]
+        assert darkened, "the dark shard never entered a plan"
+        healed = rebuilder.store.ec_volumes[vid].shards[1]
+        assert healed.read_at(0, healed.size) == golden
+        # the healed volume still serves reads
+        locs2 = env.ec_shard_locations(vid)
+        fid, data = next(iter(payloads.items()))
+        assert requests.get(
+            f"http://{locs2[1][0]}/{fid}").content == data
+
     def test_partial_rebuild_rejects_garbage(self, cluster, env,
                                              sealed_volume):
         vid, _ = sealed_volume
