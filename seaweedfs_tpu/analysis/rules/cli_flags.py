@@ -2,11 +2,11 @@
 
 Every robustness CLI knob (-repair.*, -fault.*, -retry.*, -qos.*,
 -filer.store.*, -filer.cache.*, -filer.native*, -tier.*,
--telemetry.*, -advisor.*, -ec.*) registered in cli.py must carry
-non-empty help text — these flags gate chaos / repair / overload /
-metadata-plane / tiering / native-front / workload-telemetry /
-erasure-code behaviour and an undocumented one is effectively
-invisible to operators.
+-telemetry.*, -advisor.*, -ec.*, -commit.*) registered in cli.py
+must carry non-empty help text — these flags gate chaos / repair /
+overload / metadata-plane / tiering / native-front /
+workload-telemetry / erasure-code / write-durability behaviour and
+an undocumented one is effectively invisible to operators.
 """
 from __future__ import annotations
 
@@ -16,7 +16,7 @@ from ..engine import PKG_PREFIX, Rule, register
 
 PREFIXES = ("-repair.", "-fault.", "-retry.", "-qos.",
             "-filer.store.", "-filer.cache.", "-filer.native",
-            "-tier.", "-telemetry.", "-advisor.", "-ec.")
+            "-tier.", "-telemetry.", "-advisor.", "-ec.", "-commit.")
 
 # the documented surface this PR series promises; rot here means a
 # flag was dropped without its docs/tests following
@@ -35,7 +35,8 @@ EXPECTED = (
     "-telemetry.enabled", "-telemetry.alpha", "-telemetry.window",
     "-advisor.sealQuantile", "-advisor.demandQuantile",
     "-advisor.headroom",
-    "-ec.backend", "-ec.code", "-ec.mesh.devices", "-ec.mesh.col")
+    "-ec.backend", "-ec.code", "-ec.mesh.devices", "-ec.mesh.col",
+    "-commit.durability", "-commit.maxDelay", "-commit.maxBytes")
 
 
 @register

@@ -48,6 +48,15 @@ class ContextPropagationRule(Rule):
     def visit_Call(self, ctx, node: ast.Call) -> None:
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr == "submit":
+            recv = f.value
+            recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                else recv.id if isinstance(recv, ast.Name) else ""
+            if recv_name == "commit" or recv_name.endswith("_commit"):
+                # CommitScheduler.submit enqueues a (volume, nbytes)
+                # pair, not a callable: no user code crosses the hop
+                # and the ack ticket is awaited in the caller's own
+                # context, so there is nothing to copy
+                return
             ctx.run.stats["submit_sites"] = \
                 ctx.run.stats.get("submit_sites", 0) + 1
             if not node.args or not _is_copy_context_run(node.args[0]):

@@ -29,6 +29,9 @@ _FUNCS = {"counter_add", "gauge_set", "histogram_observe"}
 # -filer.store.shards values; from/to/tier: the tier-state enum in
 # master/tiering.py; dir: exactly {offload, recall}; q: the fixed
 # quantile points {0.5, 0.9, 0.99} the workload sketches export).
+# `stage` also carries the write-commit pipeline's fixed set
+# {queue, fsync, replicate, ack} — bounded by the pipeline shape,
+# never per-request data.
 ALLOWED = {
     "backend", "code", "collection", "dir", "direction", "from",
     "handler", "instance", "kind", "le", "method", "mode", "op",

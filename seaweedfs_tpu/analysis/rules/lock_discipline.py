@@ -18,6 +18,14 @@ Three contracts, all learned the hard way by every storage system:
    (``_mutation_lock`` outer, ``_hardlink_lock`` inner — documented
    at their construction site) must never invert; an inversion is a
    deadlock waiting for the right interleaving.
+4. **commit-fsync**: the group-commit scheduler
+   (``storage/commit.py``) must never fsync while holding any lock —
+   the whole point of group commit is that writers keep appending
+   (under the volume write lock) while the previous batch's fsync is
+   in flight; an fsync under a lock in the committer re-serializes
+   the pipeline and turns every batch window into a convoy. Any
+   ``os.fsync`` / ``.sync()`` / ``.commit_batch()`` call inside a
+   ``with <lock>`` block there is a violation.
 
 Condition ``.wait()`` is exempt under its own lock (it releases it),
 and nested ``def``s are not scanned (they run elsewhere).
@@ -36,6 +44,11 @@ WRAPPER_FUNCS = {"acquire", "release", "__enter__", "__exit__",
 # declared lock order: (outer, inner) — acquiring `outer` while
 # `inner` is held is an inversion
 ORDER = [("_mutation_lock", "_hardlink_lock")]
+
+# contract 4: files where durability syscalls may never run under a
+# lock, and the calls that count as one
+FSYNC_FREE_FILES = ("storage/commit.py",)
+FSYNC_CALLS = {"fsync", "sync", "commit_batch"}
 
 
 def _recv_text(expr: ast.expr) -> str:
@@ -94,7 +107,8 @@ class LockDisciplineRule(Rule):
     name = "lock-discipline"
     description = ("acquire outside with needs release-in-finally; no "
                    "blocking call while a lock is held; declared lock "
-                   "order never inverts")
+                   "order never inverts; the group-commit scheduler "
+                   "never fsyncs under a lock")
 
     def begin_file(self, ctx) -> None:
         self._covered: set[int] = set()
@@ -160,6 +174,17 @@ class LockDisciplineRule(Rule):
         f = call.func
         # Condition.wait releases its lock — the sanctioned shape
         if isinstance(f, ast.Attribute) and f.attr == "wait":
+            return
+        # contract 4: no durability syscall under a lock in the
+        # group-commit scheduler
+        if isinstance(f, ast.Attribute) and f.attr in FSYNC_CALLS and \
+                any(ctx.rel.endswith(p) for p in FSYNC_FREE_FILES):
+            self.report(ctx, call,
+                        f"committer fsyncs under {'/'.join(held)}: "
+                        f"{_recv_text(f)}() while a lock is held "
+                        "re-serializes the group-commit pipeline — "
+                        "snapshot the queue under the lock, release, "
+                        "then fsync")
             return
         reason = blocking_reason(call)
         if reason is None and isinstance(f, ast.Attribute) and \

@@ -29,6 +29,31 @@ def _ssl_ctx(args):
     return context_from_config(load_security_config(path))
 
 
+def _add_commit_flags(p) -> None:
+    """Group-commit write-pipeline knobs, shared by the volume and
+    combined-server commands (storage/commit.py + the native fronts)."""
+    p.add_argument(
+        "-commit.durability", dest="commit_durability",
+        default="buffered", choices=["buffered", "batch", "sync"],
+        help="write ack contract: buffered = ack after the userspace "
+             "append (today's semantics), batch = ack only after the "
+             "covering group-commit fsync (~1 fsync/batch), sync = "
+             "per-write fsync oracle; recorded per response in the "
+             "X-Sw-Durability header")
+    p.add_argument(
+        "-commit.maxDelay", dest="commit_max_delay", type=float,
+        default=0.002,
+        help="seconds the group-commit batch window stays open after "
+             "its first write before the covering fsync (default "
+             "0.002); smaller = lower ack latency, larger = more "
+             "coalescing")
+    p.add_argument(
+        "-commit.maxBytes", dest="commit_max_bytes", type=int,
+        default=4 << 20,
+        help="bytes that close the group-commit batch window early, "
+             "before -commit.maxDelay elapses (default 4MiB)")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="seaweedfs-tpu",
@@ -402,6 +427,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-jwt.secret", dest="jwt_secret", default="",
                    help="HS256 secret for write authorization; must "
                         "match the master's -jwt.secret")
+    _add_commit_flags(p)
 
     p = sub.add_parser("server", help="combined master+volume(+filer+s3)")
     p.add_argument("-dir", default="./data")
@@ -479,6 +505,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory | compact | btree "
                         "(on-disk index for RAM-constrained servers)")
+    _add_commit_flags(p)
 
     p = sub.add_parser("filer", help="start a filer server")
     p.add_argument("-port", type=int, default=8888)
@@ -1326,7 +1353,10 @@ def _run_volume(args) -> int:
                       jwt_secret=args.jwt_secret,
                       concurrent_upload_limit=args.upload_limit_mb << 20,
                       concurrent_download_limit=args.download_limit_mb
-                      << 20)
+                      << 20,
+                      commit_durability=args.commit_durability,
+                      commit_max_delay=args.commit_max_delay,
+                      commit_max_bytes=args.commit_max_bytes)
     native_port = _start_volume_front(vs, args, dirs)
     if native_port is None:
         t = ServerThread(vs.app, host=args.ip, port=args.port).start()
@@ -1504,7 +1534,10 @@ def _run_server(args) -> int:
     store = Store([vol_dir], ip=args.ip, port=args.volume_port,
                   ec_backend=args.ec_backend,
                   needle_map_kind=args.index)
-    vs = VolumeServer(store, mt.url)
+    vs = VolumeServer(store, mt.url,
+                      commit_durability=args.commit_durability,
+                      commit_max_delay=args.commit_max_delay,
+                      commit_max_bytes=args.commit_max_bytes)
 
     class _VolArgs:  # reuse the standalone volume front resolution
         dataplane = args.dataplane

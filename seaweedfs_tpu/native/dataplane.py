@@ -196,6 +196,17 @@ def _load() -> ctypes.CDLL:
         lib.dp_md5_hex.argtypes = [u8p, ctypes.c_int64, ctypes.c_char_p]
         lib.dp_md5_hex.restype = None
         try:
+            # group-commit pipeline — absent from prebuilt .so files
+            # older than the write pipeline; callers degrade to the
+            # buffered contract
+            lib.dp_set_commit.argtypes = [ctypes.c_int, ctypes.c_double,
+                                          ctypes.c_longlong]
+            lib.dp_set_commit.restype = ctypes.c_int
+            lib.dp_commit_stats.argtypes = [i64p]
+            lib.dp_commit_stats.restype = None
+        except AttributeError:
+            pass
+        try:
             # role-addressed fronts (filer front + per-role faults and
             # counters) — absent from prebuilt .so files older than the
             # filer front; the callers degrade gracefully
@@ -328,6 +339,35 @@ class DataPlane:
         """jwt_required + the HS256 secret so the front verifies write
         tokens in-process instead of relaying every guarded write."""
         self._lib.dp_config(1 if jwt_required else 0, secret.encode())
+
+    def set_commit(self, durability: str, max_delay: float,
+                   max_bytes: int) -> None:
+        """Push the group-commit ack contract (-commit.*) to every
+        native front in this process: 'buffered' acks after pwrite
+        (today's semantics), 'batch' acks from the fsync-completion
+        callback, 'sync' fsyncs inline per write. No-op on libraries
+        that predate the write pipeline (buffered contract holds)."""
+        fn = getattr(self._lib, "dp_set_commit", None)
+        if fn is None:
+            return
+        modes = {"buffered": 0, "batch": 1, "sync": 2}
+        if durability not in modes:
+            raise ValueError(f"unknown durability {durability!r}")
+        fn(modes[durability], max_delay, max_bytes)
+
+    def commit_stats(self) -> dict | None:
+        """Group-commit counters (monotonic except queue_depth) for
+        /debug/commit and the /metrics merge; None when the loaded
+        library predates the write pipeline."""
+        fn = getattr(self._lib, "dp_commit_stats", None)
+        if fn is None:
+            return None
+        out = (ctypes.c_int64 * 6)()
+        fn(out)
+        return {"batches": int(out[0]), "fsyncs": int(out[1]),
+                "writes": int(out[2]), "bytes": int(out[3]),
+                "fsync_seconds": int(out[4]) / 1e9,
+                "queue_depth": int(out[5])}
 
     def set_faults(self, read_err: float = 0.0, write_err: float = 0.0,
                    read_delay: float = 0.0, write_delay: float = 0.0,

@@ -54,7 +54,10 @@ class Cluster:
                  tier_recall_window: float = 300.0,
                  tier_max_bytes_per_sec: float = 0.0,
                  tier_remote: dict | None = None,
-                 tier_state_dir: str = ""):
+                 tier_state_dir: str = "",
+                 commit_durability: str = "buffered",
+                 commit_max_delay: float = 0.002,
+                 commit_max_bytes: int = 4 << 20):
         """topology: optional per-server (data_center, rack) labels;
         disk_types: optional per-server disk class (hdd/ssd)."""
         self.base_dir = base_dir
@@ -103,7 +106,10 @@ class Cluster:
                               tier_backends=tier_backends,
                               disk_type=(disk_types[i]
                                      if disk_types and i < len(disk_types)
-                                     else "hdd"))
+                                     else "hdd"),
+                              commit_durability=commit_durability,
+                              commit_max_delay=commit_max_delay,
+                              commit_max_bytes=commit_max_bytes)
             thread = ServerThread(vs.app).start()
             store.port = thread.port
             store.public_url = thread.address

@@ -93,7 +93,10 @@ class VolumeServer:
                  tier_backends: dict[str, dict] | None = None,
                  disk_type: str = "hdd",
                  concurrent_upload_limit: int = 256 << 20,
-                 concurrent_download_limit: int = 256 << 20):
+                 concurrent_download_limit: int = 256 << 20,
+                 commit_durability: str = "buffered",
+                 commit_max_delay: float = 0.002,
+                 commit_max_bytes: int = 4 << 20):
         self.store = store
         self.disk_type = disk_type
         # comma-separated list in HA mode; heartbeats follow the raft
@@ -115,6 +118,15 @@ class VolumeServer:
         self._dp_maint: dict[int, int] = {}  # vid -> open windows
         self._dp_maint_lock = _threading.Lock()
         self._write_sem = asyncio.Semaphore(max_concurrent_writes)
+        # group-commit pipeline (storage/commit.py): runs in every
+        # durability mode — buffered rides it for idx/btree commit
+        # hygiene (the old COMMIT_EVERY cadence), batch gates acks on
+        # the covering fsync, sync is the per-write fsync oracle
+        from ..storage.commit import CommitScheduler
+
+        self.commit = CommitScheduler(durability=commit_durability,
+                                      max_delay=commit_max_delay,
+                                      max_bytes=commit_max_bytes)
         self._upload_flight = InFlightLimiter(concurrent_upload_limit)
         self._download_flight = InFlightLimiter(concurrent_download_limit)
         self._hb_task: asyncio.Task | None = None
@@ -156,11 +168,14 @@ class VolumeServer:
                 "/debug/traces": "recent spans recorded in-process",
                 "/debug/breakers": "circuit breaker states",
                 "/debug/ec": "EC codec router: probe curve + backends",
+                "/debug/commit": "group-commit pipeline: window, "
+                                 "queue depth, durability mode",
             })),
             web.get("/debug/traces", tracing.handle_debug_traces),
             web.get("/debug/breakers",
                     retry.handle_debug_breakers_factory()),
             web.get("/debug/ec", self.handle_debug_ec),
+            web.get("/debug/commit", self.handle_debug_commit),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/delete_volume", self.handle_delete_volume),
             web.post("/admin/mark_readonly", self.handle_mark_readonly),
@@ -222,6 +237,8 @@ class VolumeServer:
         port = dp.start(public_port, backend_port, workers,
                         listen_ip=listen_ip)
         dp.config(self.guard.enabled, self.guard.secret)
+        dp.set_commit(self.commit.durability, self.commit.max_delay,
+                      self.commit.max_bytes)
         if faults.enabled():
             # mirror this service's share of -fault.spec so requests the
             # front answers natively see the same chaos as relayed ones
@@ -375,6 +392,7 @@ class VolumeServer:
             mc.stop()
         if self.dp is not None:
             await asyncio.to_thread(self.disable_native)
+        await asyncio.to_thread(self.commit.stop)
         await asyncio.to_thread(self.store.close)
 
     # ------------------------------------------------------------------
@@ -931,6 +949,9 @@ class VolumeServer:
             if did:
                 n.data = body
                 n.flags |= ndl.FLAG_IS_COMPRESSED
+        durability = self.commit.durability
+        want_fsync = req.query.get("fsync") in ("true", "1")
+        ticket = None
         async with self._write_sem:
             try:
                 # small appends land in the page cache in ~10us: the
@@ -940,33 +961,64 @@ class VolumeServer:
                     self.store.find_volume(vid),
                     len(n.data) <= (64 << 10),
                     self.store.write_needle, vid, n)
-                if req.query.get("fsync") in ("true", "1"):
-                    # ?fsync=true: durable before the ack (the filer
-                    # forwards its own ?fsync / filer.conf fsync rule
-                    # here; volume_server_handlers_write.go honors the
-                    # same param). fsync is per-inode, so the python
-                    # handle syncs appends made by the native front too.
-                    v_f = self.store.find_volume(vid)
-                    if v_f is not None and hasattr(v_f.dat, "sync"):
-                        await asyncio.to_thread(v_f.dat.sync)
+                v_w = self.store.find_volume(vid)
+                if durability == "sync" or want_fsync:
+                    # per-write fsync oracle, and the ?fsync=true
+                    # contract (the filer forwards its own ?fsync /
+                    # filer.conf fsync rule here;
+                    # volume_server_handlers_write.go honors the same
+                    # param). fsync is per-inode, so this covers
+                    # appends made by the native front too.
+                    if v_w is not None:
+                        await asyncio.to_thread(v_w.sync)
+                elif v_w is not None:
+                    # enqueue on the group-commit pipeline: in batch
+                    # mode the ack below waits for the covering fsync;
+                    # buffered mode never waits but still feeds the
+                    # batched idx/btree commit cadence
+                    ticket = self.commit.submit(
+                        v_w, len(n.data),
+                        loop=asyncio.get_running_loop()
+                        if durability == "batch" else None)
             except KeyError:
                 return web.Response(status=404)
             except PermissionError as e:
                 return web.Response(status=409, text=str(e))
-        # replica fan-out (store_replicate.go:24): skip when this IS the
-        # replicated copy (type=replicate marks secondary writes)
+        # replica fan-out (store_replicate.go:24): skip when this IS
+        # the replicated copy (type=replicate marks secondary writes).
+        # The peer sends start NOW — right after the page-cache append
+        # — while the batch fsync runs; only the ack below waits on
+        # local durability, overlapping network and disk.
+        repl_task = None
+        t_repl = time.perf_counter()
         if req.query.get("type") != "replicate":
-            err = await self._replicate(req, fid, n.data, "POST",
-                                        needle=n)
+            repl_task = asyncio.ensure_future(
+                self._replicate(req, fid, n.data, "POST", needle=n))
+        if durability == "batch" and ticket is not None:
+            await ticket
+            if ticket.error is not None:
+                if repl_task is not None:
+                    await repl_task
+                return web.Response(
+                    status=500, text=f"commit failed: {ticket.error}")
+        if repl_task is not None:
+            err = await repl_task
+            metrics.histogram_observe(
+                "write_commit_seconds",
+                time.perf_counter() - t_repl, {"stage": "replicate"})
             if err:
                 return web.Response(status=500, text=err)
         self.poke_heartbeat()
-        metrics.histogram_observe("volume_server_write_seconds",
-                                  time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        metrics.histogram_observe("volume_server_write_seconds", elapsed)
+        metrics.histogram_observe("write_commit_seconds", elapsed,
+                                  {"stage": "ack"})
         return web.json_response(
             {"name": n.name.decode("utf-8", "replace") if n.name
              else "",
-             "size": len(n.data), "eTag": n.etag()}, status=201)
+             "size": len(n.data), "eTag": n.etag()}, status=201,
+            headers={"X-Sw-Durability":
+                     "sync" if want_fsync else durability})
 
     async def _delete_fid(self, req, fid, vid, key) -> web.Response:
         try:
@@ -2342,6 +2394,20 @@ class VolumeServer:
         snap["volumes"] = vols
         return web.json_response(snap)
 
+    async def handle_debug_commit(self, req: web.Request) -> web.Response:
+        """Group-commit pipeline snapshot: current window, queue depth,
+        durability mode, batch-size/bytes distributions — plus the
+        native front's commit counters when the C++ plane serves the
+        hot path (its commit queue is a separate instance of the same
+        design, so both views matter)."""
+        snap = self.commit.snapshot()
+        if self.dp is not None:
+            try:
+                snap["native"] = self.dp.commit_stats()
+            except Exception:
+                pass
+        return web.json_response(snap)
+
     async def handle_status(self, req: web.Request) -> web.Response:
         hb = self.store.collect_heartbeat()
         out = {"Version": "seaweedfs-tpu", **hb}
@@ -2390,9 +2456,42 @@ class VolumeServer:
                           self._upload_flight.value)
         metrics.gauge_set("volume_server_in_flight_download_bytes",
                           self._download_flight.value)
+        cs = self.commit.snapshot()
+        metrics.gauge_set("write_commit_queue_depth", cs["queue_depth"])
         text = metrics.render()
         text += self._native_front_exposition()
+        text += self._native_commit_exposition()
         return web.Response(text=text, content_type="text/plain")
+
+    def _native_commit_exposition(self) -> str:
+        """Native commit-queue counters appended to /metrics — same
+        render-direct treatment as _native_front_exposition (monotonic
+        snapshots owned by the C library)."""
+        if self.dp is None:
+            return ""
+        try:
+            st = self.dp.commit_stats()
+        except Exception:
+            return ""
+        if not st:
+            return ""
+        lines = []
+        for name in ("batches", "fsyncs", "writes", "bytes"):
+            if name in st:
+                lines.append(
+                    f"# TYPE native_commit_{name}_total counter")
+                lines.append(
+                    f"native_commit_{name}_total {st[name]}")
+        if "fsync_seconds" in st:
+            lines.append("# TYPE native_commit_fsync_seconds_total "
+                         "counter")
+            lines.append("native_commit_fsync_seconds_total "
+                         f"{st['fsync_seconds']:.6f}")
+        if "queue_depth" in st:
+            lines.append("# TYPE native_commit_queue_depth gauge")
+            lines.append(f"native_commit_queue_depth "
+                         f"{st['queue_depth']}")
+        return "\n".join(lines) + "\n" if lines else ""
 
     def _native_front_exposition(self) -> str:
         """Native data-plane front counters appended to /metrics.

@@ -95,6 +95,15 @@ class DiskFile:
             self._f.flush()
             os.fsync(self._f.fileno())
 
+    def datasync(self) -> None:
+        """flush + fdatasync: forces the data and the size metadata
+        needed to retrieve it, skipping the mtime journal ordering —
+        ~3x cheaper than fsync on ext4 appends, which is what the
+        group-commit batch flush amortizes."""
+        with self._lock:
+            self._f.flush()
+            os.fdatasync(self._f.fileno())
+
     def close(self) -> None:
         with self._lock:
             try:

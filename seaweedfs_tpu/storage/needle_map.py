@@ -370,8 +370,6 @@ class BtreeNeedleMap:
     truncated .idx (vacuum commit) triggers a full rebuild.
     """
 
-    COMMIT_EVERY = 4096  # puts per transaction (per-put fsync is ~1ms)
-
     def __init__(self, idx_path: str):
         import sqlite3
 
@@ -465,12 +463,9 @@ class BtreeNeedleMap:
         with self._lock:
             return self._lookup(key)
 
-    def _bump(self) -> None:
-        self._dirty += 1
-        if self._dirty >= self.COMMIT_EVERY:
-            self._db.commit()
-            self._dirty = 0
-
+    # no standalone commit cadence here: transaction sizing is owned by
+    # the group-commit scheduler (storage/commit.py), whose batch close
+    # calls sync()/set_watermark so idx durability matches .dat acks
     def put(self, key: int, offset: int, size: int) -> None:
         with self._lock:
             old = self._lookup(key)
@@ -491,7 +486,7 @@ class BtreeNeedleMap:
                 self.file_count += 1
                 self.file_bytes += size
             self.max_key = max(self.max_key, key)
-            self._bump()
+            self._dirty += 1
 
     def delete(self, key: int) -> int:
         with self._lock:
@@ -505,7 +500,7 @@ class BtreeNeedleMap:
             self.deleted_bytes += old[1]
             self.file_count -= 1
             self.file_bytes -= old[1]
-            self._bump()
+            self._dirty += 1
             return old[1]
 
     def recount_live(self) -> None:

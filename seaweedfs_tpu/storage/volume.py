@@ -362,12 +362,23 @@ class Volume:
 
     def check_integrity(self) -> None:
         """Crash recovery on load (CheckAndFixVolumeDataIntegrity,
-        volume_checking.go:17):
+        volume_checking.go:17, extended for group commit):
 
         1. truncate a torn .dat tail to the 8-byte record grid;
-        2. drop index entries pointing at/past the .dat EOF (idx flushed
+        2. torn-BATCH tail: a group-commit window can die mid-flush
+           (kill between a batch's appends), leaving CRC-good records
+           and then a partial one beyond the last indexed record. Walk
+           that unindexed tail, REPLAY every CRC-clean record into the
+           needle map + .idx (the batch committer fsyncs only the
+           .dat — acked idx entries are regained right here), and cut
+           the .dat at the first corrupt one — the torn batch suffix
+           drops as one unit while every record before the cut
+           survives bit-for-bit. Batch-mode acks release only after
+           the covering .dat fsync, so an acked needle always sits
+           below the cut and is re-indexed, never dropped;
+        3. drop index entries pointing at/past the .dat EOF (idx flushed
            ahead of an unwritten data record);
-        3. spot-check the last live entry parses with the right id — a
+        4. spot-check the last live entry parses with the right id — a
            mismatch means the whole index is stale (e.g. torn compact
            commit) and is rebuilt by scanning the .dat.
         """
@@ -376,6 +387,15 @@ class Volume:
         if aligned != size:
             self.dat.truncate(aligned)
             size = aligned
+        anchor = self.super_block.block_size
+        for key, off, sz in self.nm.live_items():
+            end = t.offset_to_actual(off) + ndl.disk_size(sz, self.version)
+            if end <= size:
+                anchor = max(anchor, end)
+        cut = self._recover_tail(anchor, size)
+        if cut is not None:
+            self.dat.truncate(cut)
+            size = cut
         stale = []
         last = None
         for key, off, sz in self.nm.live_items():
@@ -400,6 +420,49 @@ class Volume:
                 consistent = False
         if not consistent:
             self.rebuild_index()
+
+    def _recover_tail(self, offset: int, size: int) -> int | None:
+        """Walk .dat records in [offset, size) verifying each parses
+        CRC-clean (tombstones have no payload and pass trivially), and
+        REPLAY every sound record into the needle map + .idx. The .idx
+        appends in the same order as the .dat under the write lock, so
+        an idx loss is always a suffix: the batch committer fsyncs only
+        the .dat and relies on this replay to regain the covering idx
+        entries after a crash. The anchor is a safe underestimate
+        (live-entry maximum), so already-indexed records re-apply
+        idempotently — the nm state check skips their idx re-append to
+        keep clean reloads byte-stable.
+        -> the byte offset of the first bad/partial record — the
+        torn-batch truncation cut — or None when the tail is sound."""
+        while offset + t.NEEDLE_HEADER_SIZE <= size:
+            try:
+                head = self.dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
+                _, nid, size_u32 = struct.unpack(">IQI", head)
+                nsize = max(t.u32_to_size(size_u32), 0)
+                disk = ndl.disk_size(nsize, self.version)
+                if offset + disk > size:
+                    return offset  # partial record: torn mid-append
+                blob = self.dat.read_at(disk, offset)
+                ndl.Needle.from_bytes(blob, self.version)
+            except Exception:
+                return offset
+            stored = t.actual_to_offset(offset)
+            if nsize > 0:
+                if self.nm.get(nid) != (stored, nsize):
+                    self.nm.put(nid, stored, nsize)
+                    idxmod.append_entry(self._idx_f, nid, stored, nsize)
+            elif self.nm.get(nid) is not None:
+                try:
+                    self.nm.delete(nid)
+                except KeyError:
+                    pass
+                else:
+                    idxmod.append_entry(self._idx_f, nid, 0,
+                                        t.TOMBSTONE_SIZE)
+            offset += disk
+        if offset != size:
+            return offset  # sub-header residue on the record grid
+        return None
 
     def rebuild_index(self) -> None:
         """Offline .idx reconstruction by scanning the .dat — the
@@ -846,6 +909,33 @@ class Volume:
             self.nm = nmap.load_needle_map(base + ".idx",
                                            kind=self.needle_map_kind)
             self._idx_f = open(base + ".idx", "ab")
+
+    def commit_batch(self, durable: bool) -> None:
+        """One group-commit step (storage/commit.py committer thread).
+
+        durable=True fsyncs the .dat ONLY — one journal commit per
+        batch, not two. The .idx is flushed to userspace but rides the
+        page cache: acked idx entries are recoverable from the fsynced
+        .dat via check_integrity's tail replay (the .idx appends in
+        .dat order, so any loss is a suffix the replay regains).
+        durable=False is the buffered-mode hygiene commit that
+        replaced the needle map's COMMIT_EVERY cadence: flush the .idx
+        and commit the btree transaction (userspace durability, no
+        fsync). Takes no lock, same contract as sync() — the committer
+        serializes behind vacuum swaps."""
+        if durable:
+            dat = self.dat
+            (dat.datasync if hasattr(dat, "datasync") else dat.sync)()
+            if self.delegate is None:
+                self._idx_f.flush()
+                if hasattr(self.nm, "set_watermark"):
+                    self.nm.set_watermark(self._idx_f.tell())
+            return
+        if self.delegate is not None:
+            return  # native appends are unbuffered pwrites already
+        self._idx_f.flush()
+        if hasattr(self.nm, "set_watermark"):
+            self.nm.set_watermark(self._idx_f.tell())
 
     def sync(self) -> None:
         self.dat.sync()

@@ -66,6 +66,11 @@ def pytest_configure(config):
         "codes: pluggable erasure-code family tests (LRC beside RS, "
         "repair plans, bit-plane kernel scheduling); selectable with "
         "pytest -m codes")
+    config.addinivalue_line(
+        "markers",
+        "durability: write-path durability-contract tests (group "
+        "commit, ack ordering, X-Sw-Durability headers, "
+        "crash-consistency); selectable with pytest -m durability")
 
 
 import pytest  # noqa: E402

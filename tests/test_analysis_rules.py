@@ -93,6 +93,37 @@ def test_lock_order_inversion_fires(tmp_path):
     assert [f.line for f in findings if "inversion" in f.message] == [4]
 
 
+def test_commit_fsync_under_lock_fires(tmp_path):
+    run = _run(tmp_path, {"seaweedfs_tpu/storage/commit.py": (
+        "import os\n"
+        "class S:\n"
+        "    def bad(self):\n"
+        "        with self._cond:\n"
+        "            os.fsync(self.fd)\n"
+        "    def good(self):\n"
+        "        with self._cond:\n"
+        "            batch = list(self._q)\n"
+        "        os.fsync(self.fd)\n"
+    )}, rules=["lock-discipline"])
+    findings = run.by_rule("lock-discipline")
+    assert [f.line for f in findings if "fsync" in f.message] == [5]
+
+
+def test_commit_fsync_outside_commit_py_allowed(tmp_path):
+    # the contract is scoped to the group-commit scheduler: a volume's
+    # own sync-under-lock elsewhere is contract 2's business (fsync is
+    # not in BLOCKING — direct IO is allowed under the write lock)
+    run = _run(tmp_path, {"seaweedfs_tpu/storage/other.py": (
+        "import os\n"
+        "class S:\n"
+        "    def ok(self):\n"
+        "        with self._cond:\n"
+        "            os.fsync(self.fd)\n"
+    )}, rules=["lock-discipline"])
+    assert not [f for f in run.by_rule("lock-discipline")
+                if "fsync" in f.message]
+
+
 # -- async-hygiene ------------------------------------------------------
 
 def test_async_blocking_calls_fire(tmp_path):
@@ -148,6 +179,17 @@ def test_untraced_dirs_out_of_scope(tmp_path):
     run = _run(tmp_path, {"seaweedfs_tpu/ops/a.py": (
         "def kick(pool, fn):\n"
         "    pool.submit(fn)\n"
+    )}, rules=["context-propagation"])
+    assert not run.findings
+
+
+def test_commit_scheduler_submit_allowed(tmp_path):
+    # CommitScheduler.submit enqueues data, not a callable — no user
+    # code crosses the thread hop, so no copy_context is needed
+    run = _run(tmp_path, {"seaweedfs_tpu/server/a.py": (
+        "async def _write_fid(self, v, n):\n"
+        "    ticket = self.commit.submit(v, len(n))\n"
+        "    await ticket\n"
     )}, rules=["context-propagation"])
     assert not run.findings
 

@@ -12,6 +12,11 @@ import json
 import pytest
 import requests
 
+# the product path imports AESGCM lazily (only when -encryptVolumeData
+# is on); these tests exercise it for real, so they need the package
+pytest.importorskip(
+    "cryptography", reason="cipher tests need the cryptography package")
+
 from seaweedfs_tpu.server.cluster import Cluster
 from seaweedfs_tpu.server.filer_server import FilerServer
 from seaweedfs_tpu.rpc.http import ServerThread

@@ -1,0 +1,306 @@
+"""Durability-contract suite for the group-commit write pipeline.
+
+Three layers, mirroring the pipeline's structure:
+
+1. scheduler unit contracts — ack ordering against a counting fsync
+   shim: a ``batch`` ack never releases before its covering fsync
+   lands, ``buffered`` never pays one, coalescing amortizes many
+   acks onto one fsync;
+2. the python volume front — PUTs under all three ``-commit.durability``
+   modes assert the ``X-Sw-Durability`` response header, the
+   ``?fsync=true`` per-request upgrade, ``/debug/commit`` introspection,
+   and byte-identical read-back after a full server restart;
+3. the native C++ front — same header/mode matrix over the epoll data
+   plane, with fsync accounting from ``dp_commit_stats`` proving the
+   coalescing (batch: fsyncs ≪ writes) and the oracle (sync: one
+   fsync pair per write).
+
+Select the family with ``pytest -m durability``.
+"""
+import hashlib
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.native import dataplane as dpmod
+from seaweedfs_tpu.operation import verbs
+from seaweedfs_tpu.server.cluster import Cluster
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage.commit import (CommitScheduler,
+                                          DURABILITY_MODES)
+from seaweedfs_tpu.storage.volume import Volume
+
+pytestmark = pytest.mark.durability
+
+
+def _incompressible(n: int, seed: bytes = b"durability") -> bytes:
+    """Deterministic bytes gzip cannot shrink (a sha256 chain), so the
+    stored needle is byte-identical to the payload on every path."""
+    out, block = bytearray(), seed
+    while len(out) < n:
+        block = hashlib.sha256(block).digest()
+        out += block
+    return bytes(out[:n])
+
+
+def _parse_fid(fid: str) -> tuple[int, int, int]:
+    vid_s, rest = fid.split(",")
+    rest = rest.split("_")[0]
+    return int(vid_s), int(rest[:-8] or "0", 16), int(rest[-8:], 16)
+
+
+# -- 1. scheduler ack-ordering against a counting fsync shim -----------
+
+class _ShimVolume:
+    """Counts commit_batch calls; optionally stalls the durable path so
+    the test can observe 'ack not yet released' mid-fsync."""
+
+    def __init__(self, gate: threading.Event | None = None):
+        self.write_lock = threading.Lock()
+        self.fsyncs = 0
+        self.flushes = 0
+        self.gate = gate
+
+    def commit_batch(self, durable: bool) -> None:
+        if durable:
+            if self.gate is not None:
+                assert self.gate.wait(5.0)
+            self.fsyncs += 1
+        else:
+            self.flushes += 1
+
+
+class TestSchedulerContract:
+    def test_batch_ack_waits_for_covering_fsync(self):
+        gate = threading.Event()
+        v = _ShimVolume(gate)
+        sched = CommitScheduler("batch", max_delay=0.001)
+        try:
+            t = sched.submit(v, 100)
+            # the committer is stalled inside fsync: the ack MUST NOT
+            # have been released yet
+            assert not t.wait(0.1)
+            assert v.fsyncs == 0
+            gate.set()
+            assert t.wait(2.0)
+            assert v.fsyncs == 1 and t.error is None
+            assert t.fsync_seconds >= 0.05  # covered the stall
+        finally:
+            gate.set()
+            sched.stop()
+
+    def test_batch_coalesces_many_acks_onto_one_fsync(self):
+        v = _ShimVolume()
+        sched = CommitScheduler("batch", max_delay=0.005)
+        try:
+            tickets = [sched.submit(v, 64) for _ in range(50)]
+            for t in tickets:
+                assert t.wait(2.0)
+            # 50 durable acks, far fewer fsyncs (same-window coalesce)
+            assert 1 <= v.fsyncs <= 5
+            snap = sched.snapshot()
+            assert snap["commits"] == 50
+            assert snap["batches"] == v.fsyncs
+            assert snap["fsyncs"] == v.fsyncs
+            assert snap["batch_size"]["count"] >= 1
+        finally:
+            sched.stop()
+
+    def test_buffered_never_pays_an_fsync(self):
+        v = _ShimVolume()
+        sched = CommitScheduler("buffered", max_delay=0.001)
+        try:
+            t = sched.submit(v, 100)
+            assert t.wait(2.0)
+            # the batch still closed (idx commit cadence) but stayed
+            # in the page cache
+            assert v.fsyncs == 0 and v.flushes >= 1
+        finally:
+            sched.stop()
+
+    def test_mode_validation(self):
+        with pytest.raises(ValueError):
+            CommitScheduler("paranoid")
+        assert DURABILITY_MODES == ("buffered", "batch", "sync")
+
+
+# -- 2. python front: header matrix + restart read-back ----------------
+
+class TestPythonFront:
+    @pytest.mark.parametrize("mode", DURABILITY_MODES)
+    def test_put_header_and_readback(self, tmp_path, mode):
+        payload = _incompressible(4096, mode.encode())
+        c = Cluster(str(tmp_path), n_volume_servers=1,
+                    commit_durability=mode, commit_max_delay=0.002)
+        try:
+            a = verbs.assign(c.master_url)
+            r = requests.post(f"http://{a.url}/{a.fid}",
+                              files={"file": ("a.bin", payload)},
+                              timeout=10)
+            assert r.status_code == 201
+            assert r.headers["X-Sw-Durability"] == mode
+            got = requests.get(f"http://{a.url}/{a.fid}", timeout=10)
+            assert got.content == payload
+
+            # ?fsync=true upgrades any mode to the sync contract
+            a2 = verbs.assign(c.master_url)
+            r2 = requests.post(f"http://{a2.url}/{a2.fid}?fsync=true",
+                               files={"file": ("b.bin", payload)},
+                               timeout=10)
+            assert r2.headers["X-Sw-Durability"] == "sync"
+
+            snap = requests.get(c.volume_url(0) + "/debug/commit",
+                                timeout=10).json()
+            assert snap["durability"] == mode
+            assert snap["max_delay_seconds"] == pytest.approx(0.002)
+            for k in ("queue_depth", "batches", "commits", "fsyncs",
+                      "batch_size", "batch_bytes"):
+                assert k in snap
+            if mode == "batch":
+                assert snap["fsyncs"] >= 1
+        finally:
+            c.stop()
+
+    def test_batch_acks_survive_restart_byte_identical(self, tmp_path):
+        """Every 201 the client saw in batch mode reads back bit-exact
+        from a cold reopen of the same directory."""
+        acked: list[tuple[str, bytes]] = []
+        c = Cluster(str(tmp_path), n_volume_servers=1,
+                    commit_durability="batch", commit_max_delay=0.001)
+        try:
+            for i in range(8):
+                payload = _incompressible(1024 + i, b"restart%d" % i)
+                a = verbs.assign(c.master_url)
+                r = requests.post(f"http://{a.url}/{a.fid}",
+                                  files={"file": ("r.bin", payload)},
+                                  timeout=10)
+                assert r.status_code == 201
+                assert r.headers["X-Sw-Durability"] == "batch"
+                acked.append((a.fid, payload))
+        finally:
+            c.stop()
+        # cold reopen, volume-layer read (no server, no page cache of
+        # the old process's unsynced state to hide behind)
+        vols: dict[int, Volume] = {}
+        try:
+            for fid, payload in acked:
+                vid, key, cookie = _parse_fid(fid)
+                if vid not in vols:
+                    vols[vid] = Volume(
+                        str(tmp_path / "vol0_0"), "", vid)
+                n = vols[vid].read_needle(key, cookie)
+                assert n.data == payload, fid
+        finally:
+            for v in vols.values():
+                v.close()
+
+
+# -- 3. native front: header matrix + fsync accounting -----------------
+
+needs_native = pytest.mark.skipif(
+    not dpmod.available(), reason="no g++ / prebuilt dataplane library")
+
+
+@pytest.fixture
+def dp():
+    d = dpmod.DataPlane()
+    d.start(0, 1)
+    yield d
+    # commit mode is plane-global: restore the default so later native
+    # tests in this process see buffered semantics
+    d.set_commit("buffered", 0.002, 4 << 20)
+    d.stop()
+
+
+def _post(port, fid, body):
+    r = requests.post(f"http://127.0.0.1:{port}/{fid}", data=body,
+                      timeout=10)
+    return r
+
+
+@needs_native
+class TestNativeFront:
+    def test_batch_header_coalescing_and_restart(self, tmp_path, dp):
+        v = Volume(str(tmp_path), "", 7, create=True)
+        assert v.attach_native(dp)
+        dp.set_commit("batch", 0.002, 4 << 20)
+        s0 = dp.commit_stats()
+        n_writes, per_thread = 32, 8
+        payloads = {i: _incompressible(4096, b"native%d" % i)
+                    for i in range(n_writes)}
+        errs: list = []
+
+        def worker(ids):
+            for i in ids:
+                try:
+                    r = _post(dp.port, f"7,{i + 16:x}aabbcc{i:02x}",
+                              payloads[i])
+                    assert r.status_code == 201, r.text
+                    assert r.headers["X-Sw-Durability"] == "batch"
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+        threads = [threading.Thread(
+            target=worker,
+            args=(range(k, n_writes, per_thread),))
+            for k in range(per_thread)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        s1 = dp.commit_stats()
+        d_writes = s1["writes"] - s0["writes"]
+        d_fsyncs = s1["fsyncs"] - s0["fsyncs"]
+        assert d_writes == n_writes
+        assert s1["batches"] > s0["batches"]
+        # coalescing: one .dat fsync per batch, batches ≪ writes
+        assert 1 <= d_fsyncs < n_writes
+        for i in range(n_writes):
+            got = requests.get(
+                f"http://127.0.0.1:{dp.port}/7,{i + 16:x}aabbcc{i:02x}",
+                timeout=10)
+            assert got.content == payloads[i]
+        v.detach_native()
+        v.close()
+        # restart: cold reopen serves every batch-acked byte
+        v2 = Volume(str(tmp_path), "", 7)
+        for i in range(n_writes):
+            assert v2.read_needle(i + 16, 0xAABBCC00 + i).data \
+                == payloads[i]
+        v2.close()
+
+    def test_sync_mode_is_a_per_write_fsync_oracle(self, tmp_path, dp):
+        v = Volume(str(tmp_path), "", 8, create=True)
+        assert v.attach_native(dp)
+        dp.set_commit("sync", 0.002, 4 << 20)
+        s0 = dp.commit_stats()
+        for i in range(5):
+            r = _post(dp.port, f"8,{i + 1:x}11111111", b"s" * 512)
+            assert r.status_code == 201
+            assert r.headers["X-Sw-Durability"] == "sync"
+        s1 = dp.commit_stats()
+        # commit_sync_inline: one dat + one idx fsync per write
+        assert s1["fsyncs"] - s0["fsyncs"] == 2 * 5
+        assert s1["writes"] - s0["writes"] == 5
+        v.detach_native()
+        v.close()
+
+    def test_buffered_default_pays_nothing(self, tmp_path, dp):
+        v = Volume(str(tmp_path), "", 9, create=True)
+        assert v.attach_native(dp)
+        s0 = dp.commit_stats()
+        r = _post(dp.port, "9,1deadbeef", b"fast")
+        assert r.status_code == 201
+        assert r.headers["X-Sw-Durability"] == "buffered"
+        s1 = dp.commit_stats()
+        assert s1["fsyncs"] == s0["fsyncs"]
+        v.detach_native()
+        v.close()
+
+    def test_set_commit_validates(self, dp):
+        with pytest.raises(ValueError):
+            dp.set_commit("paranoid", 0.002, 4 << 20)
